@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU backend *before* jax is imported
+anywhere, so `shard_map`/mesh tests exercise real multi-device sharding
+without TPU hardware (the standard JAX fake-backend idiom — SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from log_parser_tpu.config import ScoringConfig  # noqa: E402
+
+
+@pytest.fixture
+def default_config() -> ScoringConfig:
+    return ScoringConfig()
+
+
+class FakeClock:
+    """Deterministic, manually-advanced clock for frequency-window tests."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture
+def fake_clock() -> FakeClock:
+    return FakeClock()

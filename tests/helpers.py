@@ -1,0 +1,64 @@
+"""Shared builders for pattern-set fixtures used across the test suite."""
+
+from __future__ import annotations
+
+from log_parser_tpu.models.pattern import (
+    ContextExtraction,
+    Pattern,
+    PatternSet,
+    PatternSetMetadata,
+    PrimaryPattern,
+    SecondaryPattern,
+    SequenceEvent,
+    SequencePattern,
+)
+
+
+def make_pattern(
+    pattern_id: str = "p1",
+    regex: str = "ERROR",
+    confidence: float = 0.8,
+    severity: str = "HIGH",
+    secondaries: list[tuple[str, float, int]] | None = None,
+    sequences: list[tuple[float, list[str]]] | None = None,
+    context: tuple[int, int] | None = None,
+    name: str | None = None,
+) -> Pattern:
+    return Pattern(
+        id=pattern_id,
+        name=name or pattern_id,
+        severity=severity,
+        primary_pattern=PrimaryPattern(regex=regex, confidence=confidence),
+        secondary_patterns=(
+            [
+                SecondaryPattern(regex=r, weight=w, proximity_window=win)
+                for r, w, win in secondaries
+            ]
+            if secondaries
+            else None
+        ),
+        sequence_patterns=(
+            [
+                SequencePattern(
+                    description=f"seq{i}",
+                    bonus_multiplier=bonus,
+                    events=[SequenceEvent(regex=r) for r in event_regexes],
+                )
+                for i, (bonus, event_regexes) in enumerate(sequences)
+            ]
+            if sequences
+            else None
+        ),
+        context_extraction=(
+            ContextExtraction(lines_before=context[0], lines_after=context[1])
+            if context
+            else None
+        ),
+    )
+
+
+def make_pattern_set(patterns: list[Pattern], library_id: str = "lib1") -> PatternSet:
+    return PatternSet(
+        metadata=PatternSetMetadata(library_id=library_id, name=library_id),
+        patterns=patterns,
+    )

@@ -1,0 +1,364 @@
+"""Golden reference engine: hand-computed scores and reference quirks.
+
+These tests pin the exact JVM semantics (SURVEY.md §3) that every TPU kernel
+is later property-tested against.
+"""
+
+import math
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models.pod import PodFailureData
+from tests.conftest import FakeClock
+from tests.helpers import make_pattern, make_pattern_set
+
+
+def analyze(patterns, logs, config=None, clock=None, library_id="lib1"):
+    analyzer = GoldenAnalyzer(
+        [make_pattern_set(patterns, library_id)],
+        config or ScoringConfig(),
+        clock=clock or FakeClock(),
+    )
+    return analyzer.analyze(PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs))
+
+
+class TestHandComputedScore:
+    def test_full_formula(self):
+        """Hand-computed end-to-end score on a 20-line log.
+
+        Primary at index 2 (pos 0.1 -> chrono 2.0), HIGH (3.0), confidence
+        0.8, one secondary at distance 3 (weight 0.6, decay 10), context
+        window +-1 line containing one WARN (+0.2) and the OOM line itself
+        (\\w*Error -> +0.3), no sequences, fresh frequency state.
+        """
+        lines = ["line %d ok" % i for i in range(20)]
+        lines[1] = "INFO starting app"
+        lines[2] = "java.lang.OutOfMemoryError: Java heap space"
+        lines[3] = "WARN low memory"
+        lines[5] = "detected memory pressure in cgroup"
+        pattern = make_pattern(
+            "oom",
+            regex="OutOfMemoryError",
+            confidence=0.8,
+            severity="HIGH",
+            secondaries=[("memory pressure", 0.6, 10)],
+            context=(1, 1),
+        )
+        result = analyze([pattern], "\n".join(lines))
+        assert len(result.events) == 1
+        event = result.events[0]
+        assert event.line_number == 3
+        expected = 0.8 * 3.0 * 2.0 * (1.0 + 0.6 * math.exp(-0.3)) * 1.0 * 1.5 * 1.0
+        assert event.score == pytest.approx(expected, abs=1e-12)
+
+    def test_no_factors(self):
+        """One INFO match mid-log, no secondaries/sequences/context rules.
+
+        Context still includes the matched line itself (EventContext always
+        carries matchedLine, AnalysisService.java:135)."""
+        lines = ["x"] * 10
+        lines[6] = "some ERROR here"
+        pattern = make_pattern("e", regex="ERROR", confidence=0.5, severity="INFO")
+        result = analyze([pattern], "\n".join(lines))
+        event = result.events[0]
+        # pos = 6/10 = 0.6 > 0.5 -> late zone: 0.5 + (1 - 0.6) = 0.9
+        # context: matched line has "ERROR" -> +0.4 -> factor 1.4
+        expected = 0.5 * 1.0 * 0.9 * 1.0 * 1.0 * 1.4 * 1.0
+        assert event.score == pytest.approx(expected, abs=1e-12)
+
+
+class TestChronologicalZones:
+    @pytest.mark.parametrize(
+        "idx,total,expected",
+        [
+            (0, 100, 1.5 + 0.2 * (1.0 / 0.2)),  # pos 0 -> max early bonus 2.5
+            (20, 100, 1.5),  # pos exactly 0.2 -> boundary of early zone
+            (35, 100, 1.0 + 0.15 * (0.5 / 0.3)),  # middle zone
+            (50, 100, 1.0),  # pos exactly 0.5 -> boundary of middle zone
+            (75, 100, 0.5 + 0.25),  # late zone
+            (99, 100, 0.5 + 0.01),
+        ],
+    )
+    def test_zone(self, idx, total, expected):
+        lines = ["x"] * total
+        lines[idx] = "MATCHME"
+        result = analyze([make_pattern("c", regex="MATCHME", confidence=1.0, severity="INFO")],
+                         "\n".join(lines))
+        # isolate chronological: no context rules -> context factor from the
+        # matched line only ("MATCHME" hits nothing) -> 1.0
+        assert result.events[0].score == pytest.approx(expected, abs=1e-12)
+
+
+class TestProximity:
+    def test_window_clamped_by_max_window(self):
+        """Secondary just outside min(max_window, proximity_window) is ignored."""
+        lines = ["x"] * 300
+        lines[0] = "PRIMARY"
+        lines[150] = "SECONDARY"
+        pattern = make_pattern(
+            "p", regex="PRIMARY", confidence=1.0, severity="INFO",
+            secondaries=[("SECONDARY", 1.0, 500)],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        assert result.events[0].score == pytest.approx(2.5 * 1.0, abs=1e-12)  # no bonus
+
+    def test_closest_of_multiple(self):
+        lines = ["x"] * 50
+        lines[10] = "PRIMARY"
+        lines[5] = "SEC"
+        lines[12] = "SEC"
+        pattern = make_pattern(
+            "p", regex="PRIMARY", confidence=1.0, severity="INFO",
+            secondaries=[("SEC", 0.5, 30)],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        chrono = 1.5 + (0.2 - 0.2) * (1.0 / 0.2)  # pos = 10/50 = 0.2 exactly
+        expected = chrono * (1.0 + 0.5 * math.exp(-2 / 10.0))
+        assert result.events[0].score == pytest.approx(expected, abs=1e-12)
+
+    def test_primary_line_excluded(self):
+        """A secondary that only matches the primary line itself is not found
+        (ScoringService.java:326-328)."""
+        lines = ["x"] * 10
+        lines[2] = "PRIMARY with SEC embedded"
+        pattern = make_pattern(
+            "p", regex="PRIMARY", confidence=1.0, severity="INFO",
+            secondaries=[("SEC", 1.0, 5)],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        chrono = 1.5 + (0.2 - 0.2) * (1.0 / 0.2)
+        assert result.events[0].score == pytest.approx(chrono * 1.0, abs=1e-12)
+
+
+class TestTemporal:
+    def test_sequence_matched_backward(self):
+        lines = ["x"] * 40
+        lines[5] = "connection lost"
+        lines[12] = "retry attempt"
+        lines[20] = "FAILURE final"
+        pattern = make_pattern(
+            "s", regex="FAILURE", confidence=1.0, severity="INFO",
+            sequences=[(0.5, ["connection lost", "retry attempt", "FAILURE"])],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        # pos 20/40 = 0.5 -> middle-zone boundary -> 1.0; temporal 1.5
+        assert result.events[0].score == pytest.approx(1.0 * 1.5, abs=1e-12)
+
+    def test_sequence_order_violated(self):
+        lines = ["x"] * 40
+        lines[12] = "connection lost"  # events out of order
+        lines[5] = "retry attempt"
+        lines[20] = "FAILURE final"
+        pattern = make_pattern(
+            "s", regex="FAILURE", confidence=1.0, severity="INFO",
+            sequences=[(0.5, ["connection lost", "retry attempt", "FAILURE"])],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        assert result.events[0].score == pytest.approx(1.0, abs=1e-12)
+
+    def test_last_event_must_be_near_primary(self):
+        lines = ["x"] * 40
+        lines[2] = "first thing"
+        lines[30] = "last thing"  # > 5 lines from primary at 20
+        lines[20] = "FAILURE"
+        pattern = make_pattern(
+            "s", regex="FAILURE", confidence=1.0, severity="INFO",
+            sequences=[(0.5, ["first thing", "last thing"])],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        assert result.events[0].score == pytest.approx(1.0, abs=1e-12)
+
+    def test_search_resets_to_primary_not_match_site(self):
+        """Quirk: after the near-window check, the backward search starts at
+        the *primary* line, not where the last event matched
+        (ScoringService.java:250). An earlier event between the last event's
+        match site and the primary still counts."""
+        lines = ["x"] * 40
+        lines[20] = "FAILURE"
+        lines[23] = "last thing"  # within +5 of primary
+        lines[19] = "first thing"  # before primary (the search start), after nothing
+        pattern = make_pattern(
+            "s", regex="FAILURE", confidence=1.0, severity="INFO",
+            sequences=[(0.5, ["first thing", "last thing"])],
+        )
+        result = analyze([pattern], "\n".join(lines))
+        assert result.events[0].score == pytest.approx(1.5, abs=1e-12)
+
+
+class TestContextFactor:
+    def test_else_if_warn_shadowed_by_error(self):
+        """A line matching ERROR and WARN counts only the error branch."""
+        lines = ["x"] * 10
+        lines[5] = "MATCHME"
+        lines[4] = "ERROR and WARN together"
+        pattern = make_pattern("c", regex="MATCHME", confidence=1.0, severity="INFO",
+                               context=(1, 0))
+        result = analyze([pattern], "\n".join(lines))
+        # pos 0.5 -> chrono 1.0; context: line4 -> error +0.4 only
+        assert result.events[0].score == pytest.approx(1.4, abs=1e-12)
+
+    def test_stack_trace_double_bonus_capped(self):
+        lines = ["x"] * 30
+        lines[15] = "MATCHME"
+        for i in range(16, 24):
+            lines[i] = "    at com.example.Foo$Bar.baz(Foo.java:42)"
+        pattern = make_pattern("c", regex="MATCHME", confidence=1.0, severity="INFO",
+                               context=(0, 8))
+        config = ScoringConfig(context_max_context_factor=10.0)  # uncap to see raw score
+        result = analyze([pattern], "\n".join(lines), config=config)
+        # 8 stack lines: 8*0.1 per-line + min(8*0.1, 0.5) bonus = 0.8 + 0.5
+        # pos 0.5 -> chrono 1.0; 9 context lines -> no density penalty (needs >10)
+        assert result.events[0].score == pytest.approx(1.0 + 1.3, abs=1e-9)
+
+    def test_density_penalty(self):
+        lines = ["x"] * 40
+        lines[20] = "MATCHME ERROR"
+        for i in range(10, 20):
+            lines[i] = "ERROR cascading failure"
+        pattern = make_pattern("c", regex="MATCHME", confidence=1.0, severity="INFO",
+                               context=(10, 0))
+        config = ScoringConfig(context_max_context_factor=100.0)
+        result = analyze([pattern], "\n".join(lines), config=config)
+        # 11 context lines, 11 error lines -> 11*0.4 = 4.4, dense -> *0.8 = 3.52
+        assert result.events[0].score == pytest.approx(1.0 * (1.0 + 3.52), abs=1e-9)
+
+    def test_cap(self):
+        lines = ["x"] * 40
+        lines[20] = "MATCHME ERROR"
+        for i in range(15, 20):
+            lines[i] = "ERROR bad"
+        pattern = make_pattern("c", regex="MATCHME", confidence=1.0, severity="INFO",
+                               context=(5, 0))
+        result = analyze([pattern], "\n".join(lines))
+        # raw context = 6*0.4 = 2.4 -> factor 3.4 capped at 2.5
+        assert result.events[0].score == pytest.approx(2.5, abs=1e-12)
+
+
+class TestFrequencyPenalty:
+    def test_read_before_record_within_request(self):
+        """With threshold 2/hour, the Nth match of a pattern sees N-1 prior
+        counts: matches 1-3 get no penalty (rates 0,1,2), match 4 sees rate 3
+        -> penalty min(0.8, (3-2)/2) = 0.5."""
+        config = ScoringConfig(frequency_threshold=2.0)
+        lines = ["REPEAT oops"] * 4 + ["x"] * 4
+        pattern = make_pattern("r", regex="REPEAT", confidence=1.0, severity="INFO")
+        result = analyze([pattern], "\n".join(lines), config=config)
+        scores = [e.score for e in result.events]
+
+        def chrono_at(pos):
+            if pos <= 0.2:
+                return 1.5 + (0.2 - pos) * (1.0 / 0.2)
+            return 1.0 + (0.5 - pos) * (0.5 / 0.3)
+
+        chrono = [chrono_at(i / 8) for i in range(4)]
+        penalties = [0.0, 0.0, 0.0, 0.5]
+        for s, c, p in zip(scores, chrono, penalties):
+            assert s == pytest.approx(c * (1.0 - p), abs=1e-12)
+
+    def test_state_persists_across_requests(self, fake_clock):
+        config = ScoringConfig(frequency_threshold=1.0, frequency_max_penalty=0.8)
+        pattern = make_pattern("r", regex="REPEAT", confidence=1.0, severity="INFO")
+        analyzer = GoldenAnalyzer([make_pattern_set([pattern])], config, clock=fake_clock)
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs="REPEAT\nfiller")
+        first = analyzer.analyze(data).events[0].score
+        second = analyzer.analyze(data).events[0].score
+        third = analyzer.analyze(data).events[0].score
+        # request 2 sees count 1 -> rate 1.0 <= threshold 1.0 -> penalty 0;
+        # request 3 sees count 2 -> rate 2.0 -> penalty min(0.8, 1.0) = 0.8
+        assert second == pytest.approx(first, abs=1e-12)
+        assert third == pytest.approx(first * (1.0 - 0.8), rel=1e-9)
+
+    def test_window_expiry(self, fake_clock):
+        config = ScoringConfig(frequency_threshold=1.0)
+        pattern = make_pattern("r", regex="REPEAT", confidence=1.0, severity="INFO")
+        analyzer = GoldenAnalyzer([make_pattern_set([pattern])], config, clock=fake_clock)
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs="REPEAT\nfiller")
+        for _ in range(5):
+            analyzer.analyze(data)
+        fake_clock.advance(3601.0)
+        result = analyzer.analyze(data)
+        # all prior timestamps expired -> same score as a fresh analyzer
+        fresh = GoldenAnalyzer([make_pattern_set([pattern])], config, clock=FakeClock())
+        assert result.events[0].score == pytest.approx(
+            fresh.analyze(data).events[0].score, abs=1e-12
+        )
+
+
+class TestJavaFloatCorners:
+    def test_zero_window_hours_is_max_penalty_not_crash(self):
+        """frequency_time_window_hours=0: Java computes count/0.0 = Infinity
+        -> rate > threshold -> penalty = min(maxPenalty, Inf) = maxPenalty.
+        Must not raise ZeroDivisionError."""
+        config = ScoringConfig(frequency_time_window_hours=0)
+        lines = ["REPEAT a", "REPEAT b", "filler", "filler"]
+        pattern = make_pattern("r", regex="REPEAT", confidence=1.0, severity="INFO")
+        result = analyze([pattern], "\n".join(lines), config=config)
+        # NOTE: with a zero window every timestamp expires instantly, so the
+        # second match sees count 0 -> 0/0.0 = NaN in Java -> NaN comparisons
+        # false -> penalty Math.min(maxPenalty, NaN) = NaN -> score NaN.
+        assert len(result.events) == 2
+        assert math.isnan(result.events[1].score)
+
+    def test_zero_threshold(self):
+        """threshold=0: rate > 0 -> excess/0.0 = Infinity -> penalty capped."""
+        config = ScoringConfig(frequency_threshold=0.0)
+        lines = ["REPEAT a", "REPEAT b", "filler", "filler"]
+        pattern = make_pattern("r", regex="REPEAT", confidence=1.0, severity="INFO")
+        result = analyze([pattern], "\n".join(lines), config=config)
+        first, second = (e.score for e in result.events)
+        # first match: no frequency entry yet -> penalty 0
+        assert first == pytest.approx(2.5, abs=1e-12)
+        # second match: rate 1 > 0 -> penalty min(0.8, inf) = 0.8;
+        # pos 1/4 -> middle zone chrono 1 + 0.25*(0.5/0.3)
+        assert second == pytest.approx((1.0 + (0.5 - 0.25) * (0.5 / 0.3)) * 0.2, rel=1e-9)
+
+
+class TestSummaryAndMetadata:
+    def test_discovery_order_not_sorted(self):
+        """Events come back line-major then pattern order — never score-sorted
+        (docs claim sorted, code does not: SURVEY.md §3.4)."""
+        lines = ["x"] * 10
+        lines[1] = "LOWSEV"   # early -> high chrono factor
+        lines[8] = "HIGHSEV"  # late -> low chrono factor
+        patterns = [
+            make_pattern("a", regex="HIGHSEV", confidence=1.0, severity="CRITICAL"),
+            make_pattern("b", regex="LOWSEV", confidence=0.1, severity="INFO"),
+        ]
+        result = analyze(patterns, "\n".join(lines))
+        assert [e.matched_pattern.id for e in result.events] == ["b", "a"]
+        assert result.events[0].score < result.events[1].score  # proves unsorted
+
+    def test_severity_distribution_and_highest(self):
+        lines = ["CRIT_A", "HIGH_B", "HIGH_B", "x"]
+        patterns = [
+            make_pattern("a", regex="CRIT_A", severity="critical"),
+            make_pattern("b", regex="HIGH_B", severity="High"),
+        ]
+        result = analyze(patterns, "\n".join(lines))
+        assert result.summary.severity_distribution == {"CRITICAL": 1, "HIGH": 2}
+        assert result.summary.highest_severity == "CRITICAL"
+        assert result.summary.significant_events == 3
+
+    def test_unknown_severity_ranks_below_info(self):
+        lines = ["WEIRD_X", "INFO_Y"]
+        patterns = [
+            make_pattern("w", regex="WEIRD_X", severity="BOGUS"),
+            make_pattern("i", regex="INFO_Y", severity="INFO"),
+        ]
+        result = analyze(patterns, "\n".join(lines))
+        assert result.summary.highest_severity == "INFO"
+
+    def test_empty_events(self):
+        result = analyze([make_pattern("a", regex="NOPE")], "nothing here")
+        assert result.summary.significant_events == 0
+        assert result.summary.highest_severity == "NONE"
+        assert result.summary.severity_distribution == {}
+
+    def test_metadata(self):
+        result = analyze([make_pattern("a", regex="NOPE")], "a\nb\nc\n",
+                         library_id="mylib")
+        assert result.metadata.total_lines == 3
+        assert result.metadata.patterns_used == ["mylib"]
+        assert result.analysis_id

@@ -1,0 +1,116 @@
+"""Java-compatibility primitives: split semantics and the regex dialect."""
+
+import pytest
+
+from log_parser_tpu.golden.javacompat import (
+    compile_java_regex,
+    java_split_lines,
+    translate_java_regex,
+)
+
+
+class TestJavaSplitLines:
+    """Java String.split("\\r?\\n") semantics — AnalysisService.java:53."""
+
+    def test_plain(self):
+        assert java_split_lines("a\nb\nc") == ["a", "b", "c"]
+
+    def test_crlf(self):
+        assert java_split_lines("a\r\nb\r\nc") == ["a", "b", "c"]
+
+    def test_trailing_newline_dropped(self):
+        # Java drops trailing empty strings
+        assert java_split_lines("a\nb\n") == ["a", "b"]
+        assert java_split_lines("a\n\n\n") == ["a"]
+
+    def test_interior_empty_kept(self):
+        assert java_split_lines("a\n\nb") == ["a", "", "b"]
+
+    def test_empty_string_is_one_line(self):
+        # "".split(regex) returns [""] in Java
+        assert java_split_lines("") == [""]
+
+    def test_only_newlines_is_empty(self):
+        # "\n\n".split returns an empty array in Java
+        assert java_split_lines("\n") == []
+        assert java_split_lines("\n\n") == []
+
+    def test_leading_empty_kept(self):
+        assert java_split_lines("\na") == ["", "a"]
+
+    def test_lone_cr_not_a_separator(self):
+        assert java_split_lines("a\rb") == ["a\rb"]
+
+
+class TestJavaRegex:
+    def test_find_semantics_is_substring_search(self):
+        # Matcher.find() (AnalysisService.java:95) == re.search
+        assert compile_java_regex("Error").search("an Error occurred")
+
+    def test_ascii_word_boundary(self):
+        # Java \b is ASCII by default; é must not count as a word char
+        pat = compile_java_regex(r"\bERROR\b")
+        assert pat.search("éERROR!")  # boundary exists before E in Java (é non-word)
+        assert not pat.search("xERRORy")
+
+    def test_case_insensitive(self):
+        pat = compile_java_regex(r"\b(WARN|WARNING)\b", case_insensitive=True)
+        assert pat.search("2024 warn: disk")
+        assert pat.search("warning-free")  # '-' is a boundary after WARNING
+        assert not pat.search("warned")  # no boundary after WARN, WARNING absent
+
+    def test_posix_class_translation(self):
+        assert translate_java_regex(r"\p{Digit}+") == "[0-9]+"
+        assert compile_java_regex(r"\p{Alpha}+").search("abc")
+
+    def test_possessive_quantifier_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"a*+b")
+
+    def test_atomic_group_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"(?>ab)c")
+
+    def test_unknown_posix_class_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"\p{IsGreek}")
+
+    def test_escaped_plus_not_possessive(self):
+        # C\++ is a literal '+' quantified — valid Java, not possessive
+        assert translate_java_regex(r"C\++") == r"C\++"
+        assert compile_java_regex(r"C\++").search("C++ rocks")
+
+    def test_quantifier_chars_in_class_are_literals(self):
+        assert translate_java_regex(r"[?+]") == r"[?+]"
+        assert compile_java_regex(r"[?+]").search("a+b")
+
+    def test_posix_class_inside_character_class(self):
+        # [\p{Alpha}_] must splice contents, not nest brackets
+        assert translate_java_regex(r"[\p{Alpha}_]+") == "[a-zA-Z_]+"
+        pat = compile_java_regex(r"[\p{Alpha}_]+")
+        assert pat.fullmatch("abc_DEF")
+
+    def test_named_group_translated(self):
+        pat = compile_java_regex(r"(?<code>\d+) error")
+        m = pat.search("status 404 error")
+        assert m and m.group("code") == "404"
+
+    def test_named_backref_translated(self):
+        pat = compile_java_regex(r"(?<w>\w+) \k<w>")
+        assert pat.search("again again")
+
+    def test_lookbehind_untouched(self):
+        pat = compile_java_regex(r"(?<=ERROR )\d+")
+        assert pat.search("ERROR 42").group(0) == "42"
+
+    def test_lazy_quantifier_untouched(self):
+        assert translate_java_regex(r"a.*?b") == r"a.*?b"
+
+    def test_brace_quantifier_possessive_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"a{2,3}+")
+
+    def test_literal_brace_plus_ok(self):
+        # '}' here is a literal, not a quantifier close — '}+' is fine
+        assert translate_java_regex(r"x}+") == r"x}+"
+        assert compile_java_regex(r"x}+").search("x}}}")

@@ -1,0 +1,62 @@
+"""Pattern-directory loading: recursion, filtering, skip-bad-files."""
+
+import os
+
+from log_parser_tpu.patterns import load_pattern_directory
+
+GOOD_YAML = """
+metadata:
+  library_id: core
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+"""
+
+OTHER_YAML = """
+metadata:
+  library_id: net
+patterns:
+  - id: conn
+    name: Connection refused
+    severity: HIGH
+    primary_pattern:
+      regex: "Connection refused"
+      confidence: 0.7
+"""
+
+
+def test_loads_recursively_and_skips_bad(tmp_path):
+    (tmp_path / "core.yaml").write_text(GOOD_YAML)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "net.yml").write_text(OTHER_YAML)
+    (tmp_path / "broken.yaml").write_text("patterns: [unclosed")  # invalid YAML
+    (tmp_path / "scalar.yml").write_text("just a string")  # not a mapping
+    (tmp_path / "notes.txt").write_text("ignored")  # wrong extension
+
+    sets = load_pattern_directory(str(tmp_path))
+    ids = sorted(ps.metadata.library_id for ps in sets)
+    assert ids == ["core", "net"]
+
+
+def test_missing_directory_yields_empty(tmp_path):
+    assert load_pattern_directory(str(tmp_path / "nope")) == []
+
+
+def test_file_path_yields_empty(tmp_path):
+    path = tmp_path / "f.yaml"
+    path.write_text(GOOD_YAML)
+    assert load_pattern_directory(str(path)) == []
+
+
+def test_deterministic_order(tmp_path):
+    for name in ["b.yaml", "a.yaml", "c.yml"]:
+        lib = name.split(".")[0]
+        (tmp_path / name).write_text(f"metadata:\n  library_id: {lib}\npatterns: []\n")
+    sets = load_pattern_directory(str(tmp_path))
+    assert [ps.metadata.library_id for ps in sets] == ["a", "b", "c"]
+    assert os.path.isdir(tmp_path)

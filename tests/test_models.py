@@ -1,0 +1,121 @@
+"""Model (de)serialization: snake/camel acceptance, round-tripping."""
+
+from log_parser_tpu.models import (
+    AnalysisResult,
+    EventContext,
+    MatchedEvent,
+    PatternFrequency,
+    PatternSet,
+    PodFailureData,
+)
+
+
+class TestPatternModels:
+    def test_yaml_shape_snake_case(self):
+        # the YAML schema from docs/SCORING_ALGORITHM.md:29-33
+        data = {
+            "metadata": {"library_id": "core", "name": "Core patterns"},
+            "patterns": [
+                {
+                    "id": "oom",
+                    "name": "Out of memory",
+                    "severity": "CRITICAL",
+                    "primary_pattern": {"regex": "OutOfMemoryError", "confidence": 0.9},
+                    "secondary_patterns": [
+                        {"regex": "memory pressure", "weight": 0.6, "proximity_window": 20}
+                    ],
+                    "sequence_patterns": [
+                        {
+                            "description": "gc thrash then oom",
+                            "bonus_multiplier": 0.3,
+                            "events": [{"regex": "Full GC"}, {"regex": "OutOfMemoryError"}],
+                        }
+                    ],
+                    "context_extraction": {
+                        "lines_before": 5,
+                        "lines_after": 10,
+                        "include_stack_trace": True,
+                    },
+                    "remediation": {"description": "raise memory limits"},
+                }
+            ],
+        }
+        ps = PatternSet.from_dict(data)
+        assert ps.metadata.library_id == "core"
+        p = ps.patterns[0]
+        assert p.primary_pattern.confidence == 0.9
+        assert p.secondary_patterns[0].proximity_window == 20
+        assert p.sequence_patterns[0].events[1].regex == "OutOfMemoryError"
+        assert p.context_extraction.include_stack_trace is True
+        assert p.remediation == {"description": "raise memory limits"}
+        # round trip preserves everything
+        assert PatternSet.from_dict(ps.to_dict()).to_dict() == ps.to_dict()
+
+    def test_camel_case_also_accepted(self):
+        ps = PatternSet.from_dict(
+            {
+                "metadata": {"libraryId": "x"},
+                "patterns": [
+                    {"id": "a", "primaryPattern": {"regex": "E", "confidence": 0.5}}
+                ],
+            }
+        )
+        assert ps.metadata.library_id == "x"
+        assert ps.patterns[0].primary_pattern.regex == "E"
+
+
+class TestAnalysisModels:
+    def test_event_serializes_camel_case(self):
+        event = MatchedEvent(
+            line_number=7,
+            context=EventContext(matched_line="boom", lines_before=["a"], lines_after=[]),
+            score=1.25,
+        )
+        d = event.to_dict()
+        assert d["lineNumber"] == 7
+        assert d["context"]["matchedLine"] == "boom"
+        assert d["context"]["linesBefore"] == ["a"]
+
+    def test_result_round_trip(self):
+        result = AnalysisResult.from_dict(
+            {
+                "events": [],
+                "analysisId": "abc",
+                "metadata": {"processingTimeMs": 3, "totalLines": 10},
+                "summary": {"significantEvents": 0, "highestSeverity": "NONE"},
+            }
+        )
+        assert result.metadata.total_lines == 10
+        assert result.to_dict()["summary"]["highestSeverity"] == "NONE"
+
+
+class TestPodFailureData:
+    def test_pod_name(self):
+        data = PodFailureData.from_dict(
+            {"pod": {"metadata": {"name": "web-1"}}, "logs": "a\nb"}
+        )
+        assert data.pod_name == "web-1"
+
+    def test_null_pod(self):
+        assert PodFailureData.from_dict({"logs": "x"}).pod_name is None
+
+
+class TestPatternFrequency:
+    def test_sliding_window(self):
+        clock = lambda: clock.now  # noqa: E731
+        clock.now = 0.0
+        freq = PatternFrequency(3600.0, clock=clock)
+        for _ in range(5):
+            freq.increment_count()
+        assert freq.get_current_count() == 5
+        assert freq.get_hourly_rate() == 5.0
+        clock.now = 3601.0
+        assert freq.get_current_count() == 0
+        freq.increment_count()
+        assert freq.get_hourly_rate() == 1.0
+
+    def test_reset(self):
+        freq = PatternFrequency(3600.0)
+        freq.increment_count()
+        freq.reset()
+        assert freq.get_current_count() == 0
