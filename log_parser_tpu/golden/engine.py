@@ -27,11 +27,14 @@ do not reproduce.
 from __future__ import annotations
 
 import datetime
+import logging
 import math
 import re
 import time
 import uuid
 from typing import Callable
+
+log = logging.getLogger(__name__)
 
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.golden.javacompat import compile_java_regex, java_split_lines
@@ -195,17 +198,28 @@ class GoldenAnalyzer:
         # then pattern order (AnalysisService.java:91-92) — hoisted out of the
         # per-line hot loop
         self._primaries: list[tuple[Pattern, re.Pattern[str]]] = []
+        # patterns whose regexes this engine cannot express (e.g. possessive
+        # quantifiers): logged and skipped per-pattern so one bad pattern
+        # never takes down the whole library — mirroring the loader's
+        # skip-bad-file resilience (PatternService.java:82-84). A documented
+        # divergence: the JVM reference would compile and match these.
+        self.skipped_patterns: list[tuple[str, str]] = []
         for ps in pattern_sets:
             for pattern in ps.patterns or []:
+                try:
+                    if pattern.primary_pattern is not None:
+                        compiled = self._compile(pattern.primary_pattern.regex)
+                    for sec in pattern.secondary_patterns or []:
+                        self._compile(sec.regex)
+                    for seq in pattern.sequence_patterns or []:
+                        for ev in seq.events or []:
+                            self._compile(ev.regex)
+                except (ValueError, re.error) as exc:
+                    log.error("Skipping pattern %r: %s", pattern.id, exc)
+                    self.skipped_patterns.append((pattern.id, str(exc)))
+                    continue
                 if pattern.primary_pattern is not None:
-                    self._primaries.append(
-                        (pattern, self._compile(pattern.primary_pattern.regex))
-                    )
-                for sec in pattern.secondary_patterns or []:
-                    self._compile(sec.regex)
-                for seq in pattern.sequence_patterns or []:
-                    for ev in seq.events or []:
-                        self._compile(ev.regex)
+                    self._primaries.append((pattern, compiled))
 
     def _compile(self, regex: str) -> re.Pattern[str]:
         pat = self._compiled.get(regex)
@@ -285,16 +299,18 @@ class GoldenAnalyzer:
         cfg = self.config
         idx = event.line_number - 1
         position = idx / len(lines)
+        # java_div: zero-valued thresholds divide by zero without throwing
+        # (Java double semantics), matching the reference's behavior exactly
         if position <= cfg.chronological_early_bonus_threshold:
             bonus_range = cfg.chronological_max_early_bonus - 1.5
-            return 1.5 + (cfg.chronological_early_bonus_threshold - position) * (
-                bonus_range / cfg.chronological_early_bonus_threshold
+            return 1.5 + (cfg.chronological_early_bonus_threshold - position) * java_div(
+                bonus_range, cfg.chronological_early_bonus_threshold
             )
         if position <= cfg.chronological_penalty_threshold:
             middle = (
                 cfg.chronological_penalty_threshold - cfg.chronological_early_bonus_threshold
             )
-            return 1.0 + (cfg.chronological_penalty_threshold - position) * (0.5 / middle)
+            return 1.0 + (cfg.chronological_penalty_threshold - position) * java_div(0.5, middle)
         return 0.5 + (1.0 - position)
 
     def _proximity_factor(self, event: MatchedEvent, lines: list[str]) -> float:

@@ -38,6 +38,7 @@ _POSIX_RE = re.compile(r"\\([pP])\{(\w+)\}")
 _NAMED_GROUP_RE = re.compile(r"\(\?<([A-Za-z][A-Za-z0-9]*)>")
 _NAMED_BACKREF_RE = re.compile(r"\\k<([A-Za-z][A-Za-z0-9]*)>")
 _BRACE_QUANT_RE = re.compile(r"\{\d+(?:,\d*)?\}")
+_INLINE_FLAGS_RE = re.compile(r"\(\?[a-zA-Z-]+\)")
 
 
 def java_split_lines(logs: str) -> list[str]:
@@ -56,12 +57,20 @@ def translate_java_regex(pattern: str) -> str:
     """Translate the Java-regex dialect subset used by pattern libraries into
     an equivalent Python ``re`` pattern. Raises ``ValueError`` on constructs
     whose semantics cannot be preserved (possessive quantifiers, atomic
-    groups, unknown ``\\p`` classes).
+    groups, class unions/intersections, mid-pattern inline flags, unknown
+    ``\\p`` classes).
 
     A character scanner — not regex-over-regex — so escapes (``C\\++`` is a
     literal ``+`` quantified, not possessive) and character-class context
     (``[?+]`` holds literals; ``[\\p{Alpha}_]`` splices class contents without
     nesting brackets) are handled correctly.
+
+    Line-terminator semantics (input here is always one log line, which may
+    contain a lone ``\\r`` but never ``\\n``): Java's default ``.`` excludes
+    all line terminators where Python's excludes only ``\\n``, so ``.`` maps
+    to ``[^\\n\\r\\x85\\u2028\\u2029]``; Java's ``$``/``\\Z`` match before a
+    *final* line terminator where Python's ``$`` handles only ``\\n``, so
+    both map to ``(?=\\r?\\Z)``; Java ``\\z`` is Python ``\\Z``.
     """
     out: list[str] = []
     i, n = 0, len(pattern)
@@ -92,12 +101,26 @@ def translate_java_regex(pattern: str) -> str:
                 out.append(f"(?P={m.group(1)})")
                 i = m.end()
                 continue
+            nxt = pattern[i + 1] if i + 1 < n else ""
+            if not in_class:
+                if nxt == "z":  # Java \z (absolute end) = Python \Z
+                    out.append(r"\Z")
+                    i += 2
+                    continue
+                if nxt == "Z":  # Java \Z (before final terminator)
+                    out.append(r"(?=\r?\Z)")
+                    i += 2
+                    continue
             out.append(pattern[i : i + 2])
             i += 2
             continue
         if in_class:
             if c == "]":
                 in_class = False
+            elif c == "[":
+                raise fail("nested character class")
+            elif c == "&" and pattern.startswith("&&", i):
+                raise fail("class intersection &&")
             out.append(c)
             i += 1
             continue
@@ -109,6 +132,16 @@ def translate_java_regex(pattern: str) -> str:
                 out.append("^")
                 i += 1
             continue
+        if c == ".":
+            # Java default '.' excludes all line terminators
+            out.append(r"[^\n\r\x85  ]")
+            i += 1
+            continue
+        if c == "$":
+            # Java $ (non-MULTILINE): end of input or before final terminator
+            out.append(r"(?=\r?\Z)")
+            i += 1
+            continue
         if c == "(":
             if pattern.startswith("(?>", i):
                 raise fail("atomic group")
@@ -117,6 +150,11 @@ def translate_java_regex(pattern: str) -> str:
                 out.append(f"(?P<{m.group(1)}>")
                 i = m.end()
                 continue
+            m = _INLINE_FLAGS_RE.match(pattern, i)
+            if m and i > 0:
+                # Python only allows global inline flags at position 0, and
+                # Java scopes them to the enclosing group — unpreservable
+                raise fail(f"mid-pattern inline flags {m.group(0)}")
             out.append(c)
             i += 1
             continue

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, ClassVar
+from typing import ClassVar
 
 from log_parser_tpu.javamath import java_div
 from log_parser_tpu.models._base import Model
@@ -136,7 +136,3 @@ class PatternFrequency:
 
     def reset(self) -> None:
         self._timestamps.clear()
-
-
-def _unused(*_: Any) -> None:  # pragma: no cover
-    pass

@@ -301,6 +301,17 @@ class TestJavaFloatCorners:
         assert len(result.events) == 2
         assert math.isnan(result.events[1].score)
 
+    def test_zero_early_bonus_threshold(self):
+        """chronological_early_bonus_threshold=0 with a match at position 0:
+        Java computes bonusRange/0.0 = Infinity -> 0*Inf = NaN score, and
+        keeps serving — must not raise ZeroDivisionError."""
+        config = ScoringConfig(chronological_early_bonus_threshold=0.0)
+        pattern = make_pattern("p", regex="X", confidence=1.0, severity="INFO")
+        result = analyze([pattern], "X\nfiller\nfiller\nfiller", config=config)
+        # position 0.0 <= 0.0 -> early branch -> 1.5 + (0-0)*Inf = 1.5 + NaN? No:
+        # (0.0 - 0.0) * Inf = NaN in Java -> score NaN
+        assert math.isnan(result.events[0].score)
+
     def test_zero_threshold(self):
         """threshold=0: rate > 0 -> excess/0.0 = Infinity -> penalty capped."""
         config = ScoringConfig(frequency_threshold=0.0)
@@ -362,3 +373,30 @@ class TestSummaryAndMetadata:
         assert result.metadata.total_lines == 3
         assert result.metadata.patterns_used == ["mylib"]
         assert result.analysis_id
+
+
+class TestPatternContainment:
+    def test_untranslatable_pattern_skipped_not_fatal(self):
+        """One possessive-quantifier pattern must not take down the library."""
+        patterns = [
+            make_pattern("bad", regex=r"a*+b", confidence=1.0, severity="HIGH"),
+            make_pattern("good", regex="ERROR", confidence=1.0, severity="INFO"),
+        ]
+        analyzer = GoldenAnalyzer([make_pattern_set(patterns)], ScoringConfig(),
+                                  clock=FakeClock())
+        assert [pid for pid, _ in analyzer.skipped_patterns] == ["bad"]
+        result = analyzer.analyze(
+            PodFailureData(pod={"metadata": {"name": "p"}}, logs="an ERROR here")
+        )
+        assert [e.matched_pattern.id for e in result.events] == ["good"]
+
+    def test_bad_secondary_skips_whole_pattern(self):
+        patterns = [
+            make_pattern("p", regex="ERROR", secondaries=[(r"(?>x)", 0.5, 10)]),
+        ]
+        analyzer = GoldenAnalyzer([make_pattern_set(patterns)], ScoringConfig())
+        assert len(analyzer.skipped_patterns) == 1
+        result = analyzer.analyze(
+            PodFailureData(pod={"metadata": {"name": "p"}}, logs="an ERROR here")
+        )
+        assert result.events == []

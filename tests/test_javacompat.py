@@ -103,8 +103,10 @@ class TestJavaRegex:
         pat = compile_java_regex(r"(?<=ERROR )\d+")
         assert pat.search("ERROR 42").group(0) == "42"
 
-    def test_lazy_quantifier_untouched(self):
-        assert translate_java_regex(r"a.*?b") == r"a.*?b"
+    def test_lazy_quantifier_kept(self):
+        # '.' is rewritten to Java's terminator-excluding class; laziness kept
+        translated = translate_java_regex(r"a.*?b")
+        assert translated.startswith("a[^") and translated.endswith("]*?b")
 
     def test_brace_quantifier_possessive_rejected(self):
         with pytest.raises(ValueError):
@@ -114,3 +116,34 @@ class TestJavaRegex:
         # '}' here is a literal, not a quantifier close — '}+' is fine
         assert translate_java_regex(r"x}+") == r"x}+"
         assert compile_java_regex(r"x}+").search("x}}}")
+
+    def test_class_intersection_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"[a-z&&[^aeiou]]")
+
+    def test_nested_class_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"[a[b]]")
+
+    def test_mid_pattern_inline_flags_rejected(self):
+        with pytest.raises(ValueError):
+            translate_java_regex(r"a(?i)b")
+        # at position 0 Python accepts global flags — passes through
+        assert compile_java_regex(r"(?i)warn").search("WARN")
+
+    def test_dot_excludes_carriage_return(self):
+        # Java '.' excludes \r; Python's does not — must be translated
+        assert not compile_java_regex(r"a.b").search("a\rb")
+        assert compile_java_regex(r"a.b").search("axb")
+
+    def test_dollar_before_trailing_cr(self):
+        # Java $ matches before a final line terminator (lone \r included)
+        assert compile_java_regex(r"c$").search("abc\r")
+        assert compile_java_regex(r"c$").search("abc")
+        assert not compile_java_regex(r"c$").search("abc\rx")
+        assert not compile_java_regex(r"c$").search("abc\r\r")
+
+    def test_java_z_escapes(self):
+        assert not compile_java_regex(r"c\z").search("abc\r")  # absolute end
+        assert compile_java_regex(r"c\z").search("abc")
+        assert compile_java_regex(r"c\Z").search("abc\r")  # before final term

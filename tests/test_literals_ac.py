@@ -1,0 +1,126 @@
+"""Literal-factor extraction soundness + Aho-Corasick correctness.
+
+The prefilter contract: for every factorable regex and every line it
+matches, at least one extracted literal must occur in the line (after case
+folding for ci literals). Violations would silently drop matches."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.patterns.regex import extract_literals, parse_java_regex
+from log_parser_tpu.patterns.regex.ac import AhoCorasick, fold_lines_u8
+from tests.test_regex_dfa import REGEXES, random_lines
+
+
+def get_literals(rx: str, ci: bool = False):
+    return extract_literals(parse_java_regex(rx, ci))
+
+
+class TestExtraction:
+    def test_plain_literal(self):
+        lits = get_literals(r"OutOfMemoryError")
+        assert {l.text for l in lits} == {b"OutOfMemoryError"}
+
+    def test_alternation_unions(self):
+        lits = get_literals(r"\b(ERROR|FATAL|CRITICAL|SEVERE)\b")
+        assert {l.text for l in lits} == {b"ERROR", b"FATAL", b"CRITICAL", b"SEVERE"}
+
+    def test_star_prefix_keeps_suffix(self):
+        lits = get_literals(r"\w*Exception")
+        assert {l.text for l in lits} == {b"Exception"}
+
+    def test_picks_longest_run(self):
+        lits = get_literals(r"\d+ Connection refused \d+")
+        assert {l.text for l in lits} == {b" Connection refused "}
+
+    def test_ci_literal_folded(self):
+        lits = get_literals(r"WARN", ci=True)
+        (lit,) = lits
+        assert lit.text == b"warn" and lit.ci
+
+    def test_unfactorable(self):
+        assert get_literals(r"\d+") is None
+        assert get_literals(r"[a-z]+") is None
+        assert get_literals(r".*") is None
+        assert get_literals(r"(\d+|x)") is None  # one branch unfactorable
+
+    def test_optional_contributes_nothing(self):
+        # x? can be absent: 'abc' must come from the mandatory part
+        lits = get_literals(r"x?abc")
+        assert {l.text for l in lits} == {b"abc"}
+
+    def test_soundness_on_corpus(self):
+        """Every line matched by the regex contains an extracted literal."""
+        for rx in REGEXES:
+            lits = get_literals(rx)
+            if lits is None:
+                continue
+            py = re.compile(rx, re.ASCII)
+            for line in random_lines(hash(rx) % 2**32):
+                if py.search(line):
+                    blob = line.encode()
+                    folded = blob.lower()
+                    assert any(
+                        (l.text in folded) if l.ci else (l.text in blob)
+                        for l in lits
+                    ), f"{rx!r} matched {line!r} but no literal present"
+
+
+class TestAhoCorasick:
+    def test_basic_hits(self):
+        ac = AhoCorasick([b"ERROR", b"WARN", b"Exception"])
+        assert ac.scan(b"an ERROR and an Exception") == {0, 2}
+        assert ac.scan(b"nothing") == set()
+
+    def test_overlapping_and_nested(self):
+        ac = AhoCorasick([b"he", b"she", b"hers", b"her"])
+        assert ac.scan(b"ushers") == {0, 1, 2, 3}
+
+    def test_substring_literal(self):
+        ac = AhoCorasick([b"abcd", b"bc"])
+        assert ac.scan(b"xabcdy") == {0, 1}
+
+    def test_vectorized_matches_scalar(self):
+        rng = random.Random(7)
+        lits = [b"err", b"warning", b"at ", b"OOM", b"refused", b"a"]
+        ac = AhoCorasick(lits)
+        lines = [
+            bytes(rng.choice(b"aerwOMt niofug") for _ in range(rng.randrange(30)))
+            for _ in range(100)
+        ]
+        T = max((len(l) for l in lines), default=1) or 1
+        mat = np.zeros((len(lines), T), dtype=np.uint8)
+        lengths = np.zeros(len(lines), dtype=np.int32)
+        for i, l in enumerate(lines):
+            mat[i, : len(l)] = np.frombuffer(l, dtype=np.uint8)
+            lengths[i] = len(l)
+        masks = ac.scan_lines(mat, lengths)
+        for i, l in enumerate(lines):
+            want = ac.scan(l)
+            got = {
+                w * 32 + b
+                for w in range(ac.n_words)
+                for b in range(32)
+                if int(masks[i, w]) >> b & 1
+            }
+            assert got == want, f"line {i}: {l!r}"
+
+    def test_padding_never_hits(self):
+        ac = AhoCorasick([b"\x00\x00"])  # pathological: NUL literal
+        mat = np.zeros((1, 8), dtype=np.uint8)
+        lengths = np.array([0], dtype=np.int32)
+        assert ac.scan_lines(mat, lengths)[0, 0] == 0
+
+    def test_fold_lines_u8(self):
+        raw = np.frombuffer(b"MiXeD 42!", dtype=np.uint8)[None, :]
+        folded = fold_lines_u8(raw)
+        assert bytes(folded[0]) == b"mixed 42!"
+
+    def test_many_literals_multiword_masks(self):
+        lits = [f"lit{i:04d}".encode() for i in range(100)]
+        ac = AhoCorasick(lits)
+        assert ac.n_words == 4
+        assert ac.scan(b"xx lit0042 yy lit0099") == {42, 99}
