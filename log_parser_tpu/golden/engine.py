@@ -124,6 +124,11 @@ class GoldenFrequencyTracker:
         """FrequencyTrackingService.java:110-115."""
         return {pid: f.get_current_count() for pid, f in self._frequencies.items()}
 
+    def get_windowed_count(self, pattern_id: str) -> int:
+        """Current in-window count for one pattern id (0 if never seen)."""
+        freq = self._frequencies.get(pattern_id)
+        return freq.get_current_count() if freq is not None else 0
+
     def reset_pattern_frequency(self, pattern_id: str) -> None:
         """FrequencyTrackingService.java:122-128."""
         freq = self._frequencies.get(pattern_id)
@@ -258,16 +263,7 @@ class GoldenAnalyzer:
     def _extract_context(
         self, lines: list[str], match_idx: int, pattern: Pattern
     ) -> EventContext:
-        """AnalysisService.java:132-156."""
-        context = EventContext(matched_line=lines[match_idx])
-        rules = pattern.context_extraction
-        if rules is None:
-            return context
-        before_start = max(0, match_idx - rules.lines_before)
-        context.lines_before = lines[before_start:match_idx]
-        after_end = min(len(lines), match_idx + 1 + rules.lines_after)
-        context.lines_after = lines[match_idx + 1 : after_end]
-        return context
+        return extract_context(lines, match_idx, pattern)
 
     # ---------------------------------------------------------------- scoring
 
@@ -404,36 +400,59 @@ class GoldenAnalyzer:
     # --------------------------------------------------------------- assembly
 
     def _build_metadata(self, start: float, lines: list[str]) -> AnalysisMetadata:
-        """AnalysisService.java:166-180 — patterns_used lists every loaded
-        library id, matched or not."""
-        return AnalysisMetadata(
-            processing_time_ms=int((time.monotonic() - start) * 1000),
-            total_lines=len(lines),
-            analyzed_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
-            patterns_used=[
-                ps.metadata.library_id if ps.metadata else None  # type: ignore[misc]
-                for ps in self.pattern_sets
-            ],
-        )
+        return build_metadata(start, len(lines), self.pattern_sets)
 
     def _build_summary(self, events: list[MatchedEvent]) -> AnalysisSummary:
-        """AnalysisService.java:188-215 — unknown severities rank below INFO
-        (indexOf == -1)."""
-        summary = AnalysisSummary(significant_events=len(events))
-        if not events:
-            summary.highest_severity = "NONE"
-            summary.severity_distribution = {}
-            return summary
-        severities = [
-            (e.matched_pattern.severity or "").upper() for e in events  # type: ignore[union-attr]
-        ]
-        distribution: dict[str, int] = {}
-        for sev in severities:
-            distribution[sev] = distribution.get(sev, 0) + 1
-        summary.severity_distribution = distribution
+        return build_summary(events)
 
-        def rank(sev: str) -> int:
-            return SEVERITY_ORDER.index(sev) if sev in SEVERITY_ORDER else -1
 
-        summary.highest_severity = max(severities, key=rank)
+def build_metadata(
+    start_monotonic: float, total_lines: int, pattern_sets: list[PatternSet]
+) -> AnalysisMetadata:
+    """AnalysisService.java:166-180 — patterns_used lists every loaded
+    library id, matched or not."""
+    return AnalysisMetadata(
+        processing_time_ms=int((time.monotonic() - start_monotonic) * 1000),
+        total_lines=total_lines,
+        analyzed_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        patterns_used=[
+            ps.metadata.library_id if ps.metadata else None  # type: ignore[misc]
+            for ps in pattern_sets
+        ],
+    )
+
+
+def build_summary(events: list[MatchedEvent]) -> AnalysisSummary:
+    """AnalysisService.java:188-215 — unknown severities rank below INFO
+    (indexOf == -1)."""
+    summary = AnalysisSummary(significant_events=len(events))
+    if not events:
+        summary.highest_severity = "NONE"
+        summary.severity_distribution = {}
         return summary
+    severities = [
+        (e.matched_pattern.severity or "").upper() for e in events  # type: ignore[union-attr]
+    ]
+    distribution: dict[str, int] = {}
+    for sev in severities:
+        distribution[sev] = distribution.get(sev, 0) + 1
+    summary.severity_distribution = distribution
+
+    def rank(sev: str) -> int:
+        return SEVERITY_ORDER.index(sev) if sev in SEVERITY_ORDER else -1
+
+    summary.highest_severity = max(severities, key=rank)
+    return summary
+
+
+def extract_context(lines: list[str], match_idx: int, pattern: Pattern) -> EventContext:
+    """AnalysisService.java:132-156 — shared by golden and TPU engines."""
+    context = EventContext(matched_line=lines[match_idx])
+    rules = pattern.context_extraction
+    if rules is None:
+        return context
+    before_start = max(0, match_idx - rules.lines_before)
+    context.lines_before = lines[before_start:match_idx]
+    after_end = min(len(lines), match_idx + 1 + rules.lines_after)
+    context.lines_after = lines[match_idx + 1 : after_end]
+    return context

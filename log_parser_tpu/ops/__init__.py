@@ -1,0 +1,18 @@
+"""JAX kernels: line encoding, automaton execution, vectorized scoring.
+
+float64 is enabled process-wide here: the reference computes every factor in
+Java ``double`` (ScoringService.java:102-109), and the ≤1e-6 parity target
+needs f64 for the factor arithmetic. The heavy work (automaton gathers over
+line bytes) is integer/int32 and unaffected; only the per-line factor math —
+a vanishingly small fraction of the FLOPs — pays the TPU f64 emulation cost.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from log_parser_tpu.ops.encode import encode_lines  # noqa: E402
+from log_parser_tpu.ops.match import DfaBank, AcRunner  # noqa: E402
+from log_parser_tpu.ops.scoring import ScoringKernel  # noqa: E402
+
+__all__ = ["AcRunner", "DfaBank", "ScoringKernel", "encode_lines"]

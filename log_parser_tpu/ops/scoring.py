@@ -1,0 +1,369 @@
+"""Vectorized scoring: the reference's seven-factor formula as one jitted
+JAX program over the whole line batch.
+
+The reference scores each match with nested sequential scans
+(ScoringService.java:63-112): proximity searches ±window lines per
+secondary (:315-347), temporal walks backward per sequence event
+(:230-305), context re-runs four regexes over each window
+(ContextAnalysisService.java:62-83), frequency reads mutable shared state
+(:84-88). Here every factor becomes a closed-form array computation over
+the match cube:
+
+- chronological: elementwise piecewise-linear on line position (:123-151);
+- proximity: nearest-neighbor distances via prefix/suffix cummax over each
+  secondary's match column — exact for any window because the closest hit
+  overall is the closest hit within the window (:161-190);
+- temporal: per-event inclusive prefix-cummax of "last line where the event
+  matched", then the backward chain becomes a static sequence of gathers
+  (:230-262), with the ±5 near-primary window as a prefix-sum range-any
+  (:272-286);
+- context: prefix sums of the four per-line context flags turn every window
+  sum into two gathers, with the else-if (error shadows warn), capped stack
+  bonus, density penalty, and cap applied exactly
+  (ContextAnalysisService.java:62-106);
+- frequency: the read-before-record order dependence (:84-88 — match N of a
+  pattern sees counts 1..N-1) is an exclusive prefix count over the batch,
+  composed with the persisted windowed count carried in from the engine.
+
+All factor math is float64, matching Java double arithmetic to well under
+the 1e-6 parity budget, including the inf/NaN corners of zero-valued
+tunables (IEEE division semantics match Java's).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    DENSITY_MIN_LINES,
+    DENSITY_PENALTY,
+    DENSITY_RATIO,
+    ERROR_WEIGHT,
+    EXCEPTION_WEIGHT,
+    SEQUENCE_NEAR_WINDOW,
+    STACK_BONUS_CAP,
+    STACK_WEIGHT,
+    WARN_WEIGHT,
+)
+from log_parser_tpu.javamath import java_div
+from log_parser_tpu.patterns.bank import (
+    CTX_ERROR,
+    CTX_EXCEPTION,
+    CTX_STACK,
+    CTX_WARN,
+    PatternBank,
+)
+
+f64 = jnp.float64
+
+
+@dataclasses.dataclass
+class ScoreBatch:
+    """Device outputs for one batch."""
+
+    scores: np.ndarray  # float64 [B, P] — 0 where no match
+    primary_match: np.ndarray  # bool [B, P]
+    slot_batch_counts: np.ndarray  # int64 [n_freq_slots] matches to record
+
+
+def _excl_cumsum(x: jax.Array, axis: int = 0) -> jax.Array:
+    c = jnp.cumsum(x, axis=axis)
+    return c - x
+
+
+def _prefix(x: jax.Array) -> jax.Array:
+    """[B] -> [B+1] with leading 0: window sums become two gathers."""
+    return jnp.concatenate([jnp.zeros((1,) + x.shape[1:], x.dtype), jnp.cumsum(x, axis=0)])
+
+
+class ScoringKernel:
+    """Jitted scoring program specialized to one PatternBank + config."""
+
+    def __init__(self, bank: PatternBank, config: ScoringConfig):
+        self.bank = bank
+        self.config = config
+
+        # ---- static structure lifted to numpy / python ---------------------
+        self.sec_cols = np.asarray([e.column for e in bank.secondaries], dtype=np.int32)
+        self.sec_owner = np.asarray([e.pattern_idx for e in bank.secondaries], dtype=np.int32)
+        self.sec_weight = np.asarray([e.weight for e in bank.secondaries], dtype=np.float64)
+        self.sec_window = np.asarray(
+            [min(config.proximity_max_window, e.window) for e in bank.secondaries],
+            dtype=np.int32,
+        )
+        self.sequences = bank.sequences
+        self.seq_event_cols = sorted(
+            {c for s in bank.sequences for c in s.event_columns}
+        )
+        self.seq_col_pos = {c: i for i, c in enumerate(self.seq_event_cols)}
+
+        # unique context window shapes: (has_rules, before, after)
+        shapes: list[tuple[bool, int, int]] = []
+        shape_idx: dict[tuple[bool, int, int], int] = {}
+        pattern_shape = []
+        for p_idx in range(bank.n_patterns):
+            key = (
+                bool(bank.has_context_rules[p_idx]),
+                int(bank.ctx_before[p_idx]),
+                int(bank.ctx_after[p_idx]),
+            )
+            if key not in shape_idx:
+                shape_idx[key] = len(shapes)
+                shapes.append(key)
+            pattern_shape.append(shape_idx[key])
+        self.ctx_shapes = shapes
+        self.pattern_ctx_shape = np.asarray(pattern_shape, dtype=np.int32)
+
+        # frequency: within-line ordering corrections only needed for slots
+        # shared by >1 pattern (same pattern id in several patterns)
+        slot_members: dict[int, list[int]] = {}
+        for p_idx, slot in enumerate(bank.freq_slot):
+            if slot >= 0:
+                slot_members.setdefault(int(slot), []).append(p_idx)
+        self.shared_slots = {s: m for s, m in slot_members.items() if len(m) > 1}
+
+        # config-derived constants with Java double semantics for zeros
+        self.chrono_early = float(config.chronological_early_bonus_threshold)
+        self.chrono_penalty = float(config.chronological_penalty_threshold)
+        self.chrono_bonus_quot = java_div(
+            config.chronological_max_early_bonus - 1.5,
+            config.chronological_early_bonus_threshold,
+        )
+        self.chrono_middle_quot = java_div(
+            0.5,
+            config.chronological_penalty_threshold
+            - config.chronological_early_bonus_threshold,
+        )
+        self.freq_hours = float(config.frequency_time_window_hours)
+
+        self._jit = jax.jit(self._score)
+
+    # ------------------------------------------------------------------ entry
+
+    def score_batch(
+        self,
+        match_cube: np.ndarray,
+        n_lines: int,
+        freq_base: np.ndarray,
+    ) -> ScoreBatch:
+        """``match_cube``: bool [B, n_columns] from the match kernels.
+        ``freq_base``: float64 [n_freq_slots] windowed counts at batch start.
+        """
+        scores, pm, counts = self._jit(
+            jnp.asarray(match_cube), jnp.asarray(n_lines), jnp.asarray(freq_base)
+        )
+        return ScoreBatch(
+            scores=np.asarray(scores),
+            primary_match=np.asarray(pm),
+            slot_batch_counts=np.asarray(counts),
+        )
+
+    # ------------------------------------------------------------------ jitted
+
+    def _score(self, cube: jax.Array, n_lines: jax.Array, freq_base: jax.Array):
+        bank, cfg = self.bank, self.config
+        B = cube.shape[0]
+        P = bank.n_patterns
+        idx = jnp.arange(B, dtype=jnp.int32)
+        valid = idx < n_lines
+        # padding rows must contribute nothing to ANY factor: an
+        # empty-matching regex (^$, \s*) accepts zero-length padding rows,
+        # which would otherwise produce phantom proximity/sequence hits
+        cube = cube & valid[:, None]
+
+        pm = cube[:, jnp.asarray(bank.primary_columns)] if P else jnp.zeros((B, 0), bool)
+
+        chrono = self._chronological(idx, n_lines)  # [B]
+        prox = self._proximity(cube, idx, B, P)  # [B, P]
+        temp = self._temporal(cube, idx, B, P, n_lines)  # [B, P]
+        ctx = self._context(cube, idx, B, n_lines)  # [B, P]
+        penalty, counts = self._frequency(pm, freq_base, B, P)  # [B, P]
+
+        conf = jnp.asarray(bank.confidence)[None, :]
+        sev = jnp.asarray(bank.severity_multiplier)[None, :]
+        scores = (
+            conf * sev * chrono[:, None].astype(f64) * prox * temp * ctx * (1.0 - penalty)
+        )
+        scores = jnp.where(pm, scores, 0.0)
+        return scores, pm, counts
+
+    def _chronological(self, idx: jax.Array, n_lines: jax.Array) -> jax.Array:
+        """ScoringService.java:123-151."""
+        pos = idx.astype(f64) / n_lines.astype(f64)
+        early = self.chrono_early
+        penalty = self.chrono_penalty
+        return jnp.where(
+            pos <= early,
+            1.5 + (early - pos) * self.chrono_bonus_quot,
+            jnp.where(
+                pos <= penalty,
+                1.0 + (penalty - pos) * self.chrono_middle_quot,
+                0.5 + (1.0 - pos),
+            ),
+        )
+
+    def _proximity(self, cube: jax.Array, idx: jax.Array, B: int, P: int) -> jax.Array:
+        """ScoringService.java:161-190,315-347 — nearest hit of each
+        secondary column on either side, primary line excluded by
+        construction (strict prev/next)."""
+        if len(self.sec_cols) == 0:
+            return jnp.ones((B, P), dtype=f64)
+        sm = cube[:, jnp.asarray(self.sec_cols)]  # [B, S]
+        col_idx = idx[:, None]
+        # "no hit" sentinel must exceed any configurable window, not just B:
+        # with window > B a sentinel of B+1 would pass the window test
+        big = jnp.int32(1 << 30)
+
+        prev_incl = jax.lax.cummax(jnp.where(sm, col_idx, -1), axis=0)
+        prev = jnp.concatenate(
+            [jnp.full((1, sm.shape[1]), -1, prev_incl.dtype), prev_incl[:-1]], axis=0
+        )
+        nxt_incl = jnp.flip(
+            jax.lax.cummin(jnp.flip(jnp.where(sm, col_idx, big), axis=0), axis=0), axis=0
+        )
+        nxt = jnp.concatenate(
+            [nxt_incl[1:], jnp.full((1, sm.shape[1]), big, nxt_incl.dtype)], axis=0
+        )
+
+        d_prev = jnp.where(prev >= 0, col_idx - prev, big)
+        d_next = jnp.where(nxt < big, nxt - col_idx, big)
+        dist = jnp.minimum(d_prev, d_next)  # [B, S]
+        window = jnp.asarray(self.sec_window)[None, :]
+        found = dist <= window
+        decay = jnp.exp(-dist.astype(f64) / self.config.proximity_decay_constant)
+        contrib = jnp.where(found, jnp.asarray(self.sec_weight)[None, :] * decay, 0.0)
+
+        prox = jnp.ones((B, P), dtype=f64)
+        return prox.at[:, jnp.asarray(self.sec_owner)].add(contrib)
+
+    def _temporal(
+        self, cube: jax.Array, idx: jax.Array, B: int, P: int, n_lines: jax.Array
+    ) -> jax.Array:
+        """ScoringService.java:199-305 — the backward chain becomes gathers
+        into per-event inclusive prefix-cummax arrays; the near-primary ±5
+        window check is a prefix-sum range-any (:272-286). Note the search
+        restarts at the *primary* line, not the near-window hit (:250)."""
+        temp = jnp.ones((B, P), dtype=f64)
+        if not self.sequences:
+            return temp
+        em = cube[:, jnp.asarray(self.seq_event_cols, dtype=np.int32)]  # [B, E]
+        col_idx = idx[:, None]
+        prev_incl = jax.lax.cummax(jnp.where(em, col_idx, -1), axis=0)  # [B, E]
+        prefix_counts = _prefix(em.astype(jnp.int32))  # [B+1, E]
+
+        w = SEQUENCE_NEAR_WINDOW
+        for seq in self.sequences:
+            if not seq.event_columns:
+                continue
+            last_e = self.seq_col_pos[seq.event_columns[-1]]
+            lo = jnp.clip(idx - w, 0, B)
+            hi = jnp.minimum(idx + w + 1, n_lines).astype(jnp.int32)
+            hi = jnp.clip(hi, 0, B)
+            near = (prefix_counts[hi, last_e] - prefix_counts[lo, last_e]) > 0
+
+            ok = near
+            cur = idx
+            for col in reversed(seq.event_columns[:-1]):
+                e = self.seq_col_pos[col]
+                g = jnp.where(
+                    cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1
+                )
+                ok = ok & (g >= 0)
+                cur = jnp.clip(g, 0, B - 1)
+            temp = temp.at[:, seq.pattern_idx].add(jnp.where(ok, seq.bonus, 0.0))
+        return temp
+
+    def _context(
+        self, cube: jax.Array, idx: jax.Array, B: int, n_lines: jax.Array
+    ) -> jax.Array:
+        """ContextAnalysisService.java:46-117 via prefix sums."""
+        if not self.ctx_shapes:
+            return jnp.ones((B, 0), dtype=f64)
+        err = cube[:, CTX_ERROR]
+        warn = cube[:, CTX_WARN] & ~err  # the else-if at :64-70
+        stack = cube[:, CTX_STACK]
+        exc = cube[:, CTX_EXCEPTION]
+        line_score = (
+            ERROR_WEIGHT * err.astype(f64)
+            + WARN_WEIGHT * warn.astype(f64)
+            + STACK_WEIGHT * stack.astype(f64)
+            + EXCEPTION_WEIGHT * exc.astype(f64)
+        )
+        ps_score = _prefix(line_score)
+        ps_stack = _prefix(stack.astype(jnp.int32))
+        ps_err = _prefix(err.astype(jnp.int32))
+
+        cols = []
+        for has_rules, before, after in self.ctx_shapes:
+            if not has_rules:
+                # context = matched line only (AnalysisService.java:135-139)
+                w_score = line_score
+                w_stack = stack.astype(jnp.int32)
+                w_err = err.astype(jnp.int32)
+                total = jnp.ones_like(idx)
+            else:
+                lo = jnp.clip(idx - before, 0, B)
+                hi = jnp.clip(jnp.minimum(idx + 1 + after, n_lines), 0, B).astype(
+                    jnp.int32
+                )
+                w_score = ps_score[hi] - ps_score[lo]
+                w_stack = ps_stack[hi] - ps_stack[lo]
+                w_err = ps_err[hi] - ps_err[lo]
+                total = hi - lo
+            score = w_score + jnp.where(
+                w_stack > 0,
+                jnp.minimum(STACK_WEIGHT * w_stack.astype(f64), STACK_BONUS_CAP),
+                0.0,
+            )
+            dense = (total > DENSITY_MIN_LINES) & (
+                (w_stack + w_err).astype(f64) > total.astype(f64) * DENSITY_RATIO
+            )
+            score = jnp.where(dense, score * DENSITY_PENALTY, score)
+            cols.append(
+                jnp.minimum(1.0 + score, self.config.context_max_context_factor)
+            )
+        ctx_u = jnp.stack(cols, axis=1)  # [B, U]
+        return ctx_u[:, jnp.asarray(self.pattern_ctx_shape)]
+
+    def _frequency(self, pm: jax.Array, freq_base: jax.Array, B: int, P: int):
+        """FrequencyTrackingService.java:64-93 with the read-before-record
+        order of ScoringService.java:84-88: match N sees N-1 prior counts."""
+        bank, cfg = self.bank, self.config
+        n_slots = max(1, bank.n_freq_slots)
+        pm_f = pm.astype(jnp.int64)
+
+        slot_ok = jnp.asarray(bank.freq_slot >= 0)
+        safe_slot = jnp.asarray(np.maximum(bank.freq_slot, 0))
+        # per-line per-slot match counts
+        line_slot = jnp.zeros((B, n_slots), dtype=jnp.int64)
+        line_slot = line_slot.at[:, safe_slot].add(
+            jnp.where(slot_ok[None, :], pm_f, 0)
+        )
+        before_line = _excl_cumsum(line_slot, axis=0)  # [B, n_slots]
+
+        prior = before_line[:, safe_slot]  # [B, P]
+        # within-line ordering for slots shared by multiple patterns:
+        # pattern-index order within a line (AnalysisService.java:91-92)
+        for slot, members in self.shared_slots.items():
+            sub = pm_f[:, jnp.asarray(members, dtype=np.int32)]
+            corr = _excl_cumsum(sub, axis=1)
+            for j, p_idx in enumerate(members):
+                prior = prior.at[:, p_idx].add(corr[:, j])
+
+        count_before = freq_base[safe_slot][None, :] + prior.astype(f64)
+        rate = count_before / self.freq_hours  # IEEE /0 → inf/nan, like Java
+        thr = float(cfg.frequency_threshold)
+        raw_penalty = jnp.minimum(
+            float(cfg.frequency_max_penalty), (rate - thr) / thr
+        )
+        penalty = jnp.where(rate <= thr, 0.0, raw_penalty)
+        penalty = jnp.where(slot_ok[None, :], penalty, 0.0)
+
+        counts = jnp.sum(line_slot, axis=0)  # [n_slots]
+        return penalty, counts

@@ -1,0 +1,135 @@
+"""AnalysisEngine — the TPU-backed replacement for the reference's
+``AnalysisService.analyze`` (AnalysisService.java:50-122).
+
+Pipeline per request:
+
+1. split logs with Java semantics (AnalysisService.java:53);
+2. encode lines into a padded uint8 batch (vectorized, host);
+3. evaluate every matcher column: DFA bank on device for automaton-backed
+   regexes, host ``re`` for the fallback set and for lines the device can't
+   be exact on (non-ASCII / over-long);
+4. one jitted scoring pass producing f64 scores for all (line, pattern)
+   pairs plus the frequency batch counts;
+5. assemble ``AnalysisResult`` in discovery order (line-major, then pattern
+   order — AnalysisService.java:89-113) with the same metadata/summary
+   quirks as the reference.
+
+Frequency state is the engine's only mutable state, mirrored from the
+reference's ConcurrentHashMap (FrequencyTrackingService.java:25) but read
+and advanced at batch granularity with exact per-match ordering recovered
+inside the kernel (read-before-record, ScoringService.java:84-88).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Callable
+
+import numpy as np
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    GoldenFrequencyTracker,
+    build_metadata,
+    build_summary,
+    extract_context,
+)
+from log_parser_tpu.golden.javacompat import java_split_lines
+from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import DfaBank
+from log_parser_tpu.ops.scoring import ScoringKernel
+from log_parser_tpu.patterns.bank import PatternBank
+
+
+class AnalysisEngine:
+    """Immutable compiled library + jitted kernels + frequency state."""
+
+    def __init__(
+        self,
+        pattern_sets: list[PatternSet],
+        config: ScoringConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or ScoringConfig()
+        self.bank = PatternBank(pattern_sets)
+        self.kernel = ScoringKernel(self.bank, self.config)
+        self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
+
+        self._dfa_cols = [
+            i for i, c in enumerate(self.bank.columns) if c.dfa is not None
+        ]
+        self._host_cols = [
+            i for i, c in enumerate(self.bank.columns) if c.dfa is None
+        ]
+        self.dfa_bank = DfaBank([self.bank.columns[i].dfa for i in self._dfa_cols])
+
+    @property
+    def skipped_patterns(self) -> list[tuple[str, str]]:
+        return self.bank.skipped_patterns
+
+    # ----------------------------------------------------------------- match
+
+    def _match_cube(self, lines: list[str]) -> np.ndarray:
+        """bool [B_padded, n_columns]; exact for every real line."""
+        enc = encode_lines(lines)
+        B = enc.u8.shape[0]
+        cube = np.zeros((B, self.bank.n_columns), dtype=bool)
+        if enc.n_lines == 0:
+            return cube
+        if self._dfa_cols:
+            cube[:, self._dfa_cols] = self.dfa_bank.match(enc.u8, enc.lengths)
+        # host passes: fallback columns on all lines; all columns on lines
+        # the device can't be exact on (non-ASCII bytes, over-long lines)
+        for col in self._host_cols:
+            host = self.bank.columns[col].host
+            for i in range(enc.n_lines):
+                cube[i, col] = bool(host.search(lines[i]))
+        host_lines = np.flatnonzero(enc.needs_host[: enc.n_lines])
+        for i in host_lines:
+            line = lines[i]
+            for col in self._dfa_cols:
+                cube[i, col] = bool(self.bank.columns[col].host.search(line))
+        return cube
+
+    # --------------------------------------------------------------- analyze
+
+    def analyze(self, data: PodFailureData) -> AnalysisResult:
+        start = time.monotonic()
+        lines = java_split_lines(data.logs or "")
+        cube = self._match_cube(lines)
+
+        # windowed frequency counts at batch start (pruned by the tracker)
+        freq_base = np.zeros(max(1, self.bank.n_freq_slots), dtype=np.float64)
+        for slot, pid in enumerate(self.bank.freq_ids):
+            freq_base[slot] = self.frequency.get_windowed_count(pid)
+
+        batch = self.kernel.score_batch(cube, len(lines), freq_base)
+
+        # record this batch's matches (after the read — ScoringService.java:84-88)
+        for slot, count in enumerate(batch.slot_batch_counts[: self.bank.n_freq_slots]):
+            for _ in range(int(count)):
+                self.frequency.record_pattern_match(self.bank.freq_ids[slot])
+
+        # discovery order: line-major then pattern order ⇔ row-major argwhere
+        events: list[MatchedEvent] = []
+        for line_idx, p_idx in np.argwhere(batch.primary_match):
+            pattern = self.bank.patterns[p_idx]
+            events.append(
+                MatchedEvent(
+                    line_number=int(line_idx) + 1,
+                    matched_pattern=pattern,
+                    context=extract_context(lines, int(line_idx), pattern),
+                    score=float(batch.scores[line_idx, p_idx]),
+                )
+            )
+
+        return AnalysisResult(
+            events=events,
+            analysis_id=str(uuid.uuid4()),
+            metadata=build_metadata(start, len(lines), self.bank.pattern_sets),
+            summary=build_summary(events),
+        )

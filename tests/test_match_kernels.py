@@ -1,0 +1,85 @@
+"""Device match kernels vs their host reference executors."""
+
+import random
+
+import numpy as np
+
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import AcRunner, DfaBank
+from log_parser_tpu.patterns.regex import AhoCorasick, compile_regex_to_dfa
+from tests.test_regex_dfa import REGEXES, random_lines
+
+
+class TestDfaBank:
+    def test_bank_matches_individual_dfas(self):
+        dfas = [compile_regex_to_dfa(rx) for rx in REGEXES[:10]]
+        bank = DfaBank(dfas)
+        lines = random_lines(12345, count=100)
+        enc = encode_lines(lines)
+        cube = bank.match(enc.u8, enc.lengths)
+        for i, line in enumerate(lines):
+            blob = line.encode()
+            for r, dfa in enumerate(dfas):
+                assert cube[i, r] == dfa.matches(blob), (line, dfa.regex)
+
+    def test_empty_bank(self):
+        bank = DfaBank([])
+        enc = encode_lines(["abc"])
+        assert bank.match(enc.u8, enc.lengths).shape == (enc.u8.shape[0], 0)
+
+    def test_padding_rows_inert(self):
+        dfas = [compile_regex_to_dfa(r".*")]  # matches everything incl empty
+        bank = DfaBank(dfas)
+        enc = encode_lines(["a"])  # padded to 8 rows
+        cube = bank.match(enc.u8, enc.lengths)
+        assert cube[0, 0]
+        # padded rows run length 0 -> accept_end[start] which for .* is True;
+        # the engine masks by n_lines, so values beyond row 0 are don't-care
+        assert cube.shape[0] >= 8
+
+
+class TestAcRunner:
+    def test_device_matches_host_scan(self):
+        rng = random.Random(3)
+        lits = [b"err", b"OOM", b"refused", b"at ", b"x509"]
+        ac = AhoCorasick(lits)
+        runner = AcRunner(ac)
+        lines = [
+            "".join(rng.choice("erOMx509atdzfu s") for _ in range(rng.randrange(40)))
+            for _ in range(64)
+        ]
+        enc = encode_lines(lines)
+        masks = runner.scan(enc.u8, enc.lengths)
+        for i, line in enumerate(lines):
+            want = ac.scan(line.encode())
+            got = {
+                w * 32 + b
+                for w in range(ac.n_words)
+                for b in range(32)
+                if int(masks[i, w]) >> b & 1
+            }
+            assert got == want, line
+
+
+class TestEncode:
+    def test_roundtrip(self):
+        lines = ["abc", "", "x" * 300, "naïve"]
+        enc = encode_lines(lines)
+        assert enc.n_lines == 4
+        assert bytes(enc.u8[0, :3]) == b"abc"
+        assert enc.lengths[1] == 0
+        assert enc.lengths[2] == 300
+        assert enc.needs_host[3]  # non-ASCII
+        assert not enc.needs_host[0]
+
+    def test_overlong_flagged(self):
+        enc = encode_lines(["y" * 5000], max_line_bytes=4096)
+        assert enc.needs_host[0]
+
+    def test_empty_input(self):
+        enc = encode_lines([])
+        assert enc.n_lines == 0 and enc.u8.shape[0] >= 8
+
+    def test_width_alignment(self):
+        enc = encode_lines(["abc"])
+        assert enc.u8.shape[1] % 128 == 0
