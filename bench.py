@@ -1,0 +1,78 @@
+"""Benchmark: end-to-end scored log-lines/sec on one chip.
+
+Implements BASELINE.md config 2 (synthetic pod log, full built-in pattern
+library, single device). The reference publishes no numbers (BASELINE.md);
+``vs_baseline`` is therefore reported against the north-star target of
+1M log-lines/sec/chip from BASELINE.json.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 200_000
+NORTH_STAR_LINES_PER_SEC = 1_000_000.0
+
+
+def build_corpus(n: int) -> str:
+    rows = []
+    for i in range(n):
+        m = i % 997
+        if m == 5:
+            rows.append("java.lang.OutOfMemoryError: Java heap space")
+        elif m == 3:
+            rows.append("[Full GC (Ergonomics) 255M->250M(256M), 0.41 secs]")
+        elif m == 250:
+            rows.append("dial tcp 10.0.0.7:5432: Connection refused")
+        elif m == 500:
+            rows.append("Warning: Liveness probe failed: HTTP 503")
+        elif m == 700:
+            rows.append("    at com.example.Service.handle(Service.java:42)")
+        elif m == 701:
+            rows.append("ERROR request failed with IllegalStateException")
+        else:
+            rows.append(
+                f"2026-07-29T07:{i % 60:02d}:{i % 60:02d}Z INFO reconcile tick {i} status=ok"
+            )
+    return "\n".join(rows)
+
+
+def main() -> None:
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    logs = build_corpus(N_LINES)
+    data = PodFailureData(pod={"metadata": {"name": "bench"}}, logs=logs)
+
+    engine.analyze(data)  # warmup: compile + caches
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = engine.analyze(data)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    lines_per_sec = N_LINES / best
+
+    assert result.summary.significant_events > 0
+    print(
+        json.dumps(
+            {
+                "metric": "log_lines_scored_per_sec_per_chip",
+                "value": round(lines_per_sec, 1),
+                "unit": "lines/s",
+                "vs_baseline": round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

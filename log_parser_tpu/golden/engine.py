@@ -129,6 +129,12 @@ class GoldenFrequencyTracker:
         freq = self._frequencies.get(pattern_id)
         return freq.get_current_count() if freq is not None else 0
 
+    def has_entry(self, pattern_id: str) -> bool:
+        """Whether the tracker has an entry at all — distinct from a zero
+        windowed count (FrequencyTrackingService.java:69-71 early-returns
+        0.0 only when no entry exists)."""
+        return pattern_id in self._frequencies
+
     def reset_pattern_frequency(self, pattern_id: str) -> None:
         """FrequencyTrackingService.java:122-128."""
         freq = self._frequencies.get(pattern_id)

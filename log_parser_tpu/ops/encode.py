@@ -27,7 +27,8 @@ class EncodedLines:
     """A padded batch: ``u8[B, T]`` with zeros beyond ``lengths``."""
 
     u8: np.ndarray  # uint8 [B, T]
-    lengths: np.ndarray  # int32 [B] true byte length (may exceed T)
+    lengths: np.ndarray  # int32 [B] byte length clipped to T; over-long
+    # lines are flagged needs_host and re-matched from the original string
     needs_host: np.ndarray  # bool [B] non-ASCII or over-long
     n_lines: int
 
@@ -68,11 +69,18 @@ def encode_lines(
     width = max(pad_to_multiple, _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple))
     rows = max(min_rows, _next_pow2(n))
 
-    take = starts[:, None] + np.arange(width)[None, :]
-    mask = np.arange(width)[None, :] < np.minimum(lengths, width)[:, None]
+    # fill in row chunks: a full [n, width] gather-index matrix would cost
+    # ~9x the output batch in temporaries (int64 indices + bool mask) and
+    # OOM on 1M-line corpora with a wide width
     u8 = np.zeros((rows, width), dtype=np.uint8)
     if len(flat):
-        u8[:n] = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
+        col = np.arange(width, dtype=np.int64)[None, :]
+        chunk = max(1, (64 << 20) // max(1, width))  # ~64MB of indices per chunk
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            take = starts[lo:hi, None] + col
+            mask = col < np.minimum(lengths[lo:hi], width)[:, None]
+            u8[lo:hi] = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
 
     non_ascii = np.zeros(rows, dtype=bool)
     non_ascii[:n] = np.bitwise_or.reduce(u8[:n] & 0x80, axis=1) != 0

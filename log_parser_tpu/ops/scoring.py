@@ -151,12 +151,19 @@ class ScoringKernel:
         match_cube: np.ndarray,
         n_lines: int,
         freq_base: np.ndarray,
+        freq_exists: np.ndarray,
     ) -> ScoreBatch:
         """``match_cube``: bool [B, n_columns] from the match kernels.
         ``freq_base``: float64 [n_freq_slots] windowed counts at batch start.
+        ``freq_exists``: bool [n_freq_slots] — tracker has an entry for the
+        slot (distinct from count 0: an expired window still has an entry,
+        FrequencyTrackingService.java:69-71 vs :74-83).
         """
         scores, pm, counts = self._jit(
-            jnp.asarray(match_cube), jnp.asarray(n_lines), jnp.asarray(freq_base)
+            jnp.asarray(match_cube),
+            jnp.asarray(n_lines),
+            jnp.asarray(freq_base),
+            jnp.asarray(freq_exists),
         )
         return ScoreBatch(
             scores=np.asarray(scores),
@@ -166,7 +173,13 @@ class ScoringKernel:
 
     # ------------------------------------------------------------------ jitted
 
-    def _score(self, cube: jax.Array, n_lines: jax.Array, freq_base: jax.Array):
+    def _score(
+        self,
+        cube: jax.Array,
+        n_lines: jax.Array,
+        freq_base: jax.Array,
+        freq_exists: jax.Array,
+    ):
         bank, cfg = self.bank, self.config
         B = cube.shape[0]
         P = bank.n_patterns
@@ -183,7 +196,7 @@ class ScoringKernel:
         prox = self._proximity(cube, idx, B, P)  # [B, P]
         temp = self._temporal(cube, idx, B, P, n_lines)  # [B, P]
         ctx = self._context(cube, idx, B, n_lines)  # [B, P]
-        penalty, counts = self._frequency(pm, freq_base, B, P)  # [B, P]
+        penalty, counts = self._frequency(pm, freq_base, freq_exists, B, P)  # [B, P]
 
         conf = jnp.asarray(bank.confidence)[None, :]
         sev = jnp.asarray(bank.severity_multiplier)[None, :]
@@ -331,9 +344,17 @@ class ScoringKernel:
         ctx_u = jnp.stack(cols, axis=1)  # [B, U]
         return ctx_u[:, jnp.asarray(self.pattern_ctx_shape)]
 
-    def _frequency(self, pm: jax.Array, freq_base: jax.Array, B: int, P: int):
+    def _frequency(
+        self, pm: jax.Array, freq_base: jax.Array, freq_exists: jax.Array, B: int, P: int
+    ):
         """FrequencyTrackingService.java:64-93 with the read-before-record
-        order of ScoringService.java:84-88: match N sees N-1 prior counts."""
+        order of ScoringService.java:84-88: match N sees N-1 prior counts.
+
+        A slot with no tracker entry AND no prior in-batch match returns 0.0
+        unconditionally (the ``frequency == null`` early return at :69-71) —
+        this matters for degenerate tunables (window 0 → NaN rate, negative
+        threshold → negative penalty) where the formula would otherwise
+        produce a different value than the reference's early return."""
         bank, cfg = self.bank, self.config
         n_slots = max(1, bank.n_freq_slots)
         pm_f = pm.astype(jnp.int64)
@@ -356,13 +377,21 @@ class ScoringKernel:
             for j, p_idx in enumerate(members):
                 prior = prior.at[:, p_idx].add(corr[:, j])
 
-        count_before = freq_base[safe_slot][None, :] + prior.astype(f64)
+        # a zero-length window expires every record instantly (the tracker
+        # prunes timestamps <= now - window), so the windowed count at read
+        # time is always 0 — the formula then yields 0/0 = NaN like Java
+        if self.freq_hours == 0.0:
+            count_before = jnp.zeros_like(prior, dtype=f64)
+        else:
+            count_before = freq_base[safe_slot][None, :] + prior.astype(f64)
         rate = count_before / self.freq_hours  # IEEE /0 → inf/nan, like Java
         thr = float(cfg.frequency_threshold)
         raw_penalty = jnp.minimum(
             float(cfg.frequency_max_penalty), (rate - thr) / thr
         )
         penalty = jnp.where(rate <= thr, 0.0, raw_penalty)
+        never_tracked = (~freq_exists[safe_slot])[None, :] & (prior == 0)
+        penalty = jnp.where(never_tracked, 0.0, penalty)
         penalty = jnp.where(slot_ok[None, :], penalty, 0.0)
 
         counts = jnp.sum(line_slot, axis=0)  # [n_slots]

@@ -1,0 +1,492 @@
+"""shard_map pipeline: fused match + scoring on a line-sharded batch.
+
+One jitted SPMD program per library: every shard scans its own lines
+through the DFA bank (zero communication — lines are independent for
+matching, AnalysisService.java:89-113), then computes all seven scoring
+factors with the narrowest collective each one needs:
+
+==================  =========================================================
+factor              communication
+==================  =========================================================
+chronological       none (global line index is shard offset + local index)
+proximity           ``ppermute`` halo of the secondary-match columns
+                    (window ≤ halo), or ``all_gather`` when shards are
+                    smaller than the halo
+context             same halo machinery over the four context-flag columns
+temporal            ``all_gather`` of the (few) sequence-event columns —
+                    the backward scan is unbounded (ScoringService.java:
+                    296-305), so each shard keeps the full column and the
+                    chain runs as local gathers
+frequency           ``all_gather`` of per-shard slot totals for the
+                    exclusive cross-shard prefix + ``psum`` for the batch
+                    totals recorded into tracker state
+==================  =========================================================
+
+Everything else is elementwise/local. Halo rows are masked-valid *before*
+exchange, so shard edges and batch padding contribute nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    DENSITY_MIN_LINES,
+    DENSITY_PENALTY,
+    DENSITY_RATIO,
+    SEQUENCE_NEAR_WINDOW,
+    STACK_BONUS_CAP,
+    STACK_WEIGHT,
+)
+from log_parser_tpu.ops.match import DfaBank
+from log_parser_tpu.ops.scoring import ScoringKernel, _excl_cumsum, f64
+from log_parser_tpu.parallel.mesh import DATA_AXIS
+from log_parser_tpu.patterns.bank import (
+    CTX_ERROR,
+    CTX_EXCEPTION,
+    CTX_STACK,
+    CTX_WARN,
+    PatternBank,
+)
+
+
+def _ring_halo(x: jax.Array, h: int) -> jax.Array:
+    """[Bl, K] -> [h + Bl + h, K]: h rows from each ring neighbor via
+    ppermute; edge shards receive zeros (ppermute's missing-source fill)."""
+    d = jax.lax.axis_size(DATA_AXIS)
+    from_left = jax.lax.ppermute(
+        x[-h:], DATA_AXIS, [(i, i + 1) for i in range(d - 1)]
+    )
+    from_right = jax.lax.ppermute(
+        x[:h], DATA_AXIS, [(i + 1, i) for i in range(d - 1)]
+    )
+    return jnp.concatenate([from_left, x, from_right], axis=0)
+
+
+class ShardedAnalysisStep:
+    """The full per-batch device program, shard_mapped over the mesh."""
+
+    def __init__(self, bank: PatternBank, config: ScoringConfig, mesh, dfa_bank: DfaBank):
+        self.bank = bank
+        self.config = config
+        self.mesh = mesh
+        self.dfa_bank = dfa_bank
+        # reuse the single-device kernel's precomputed static structure
+        self.k = ScoringKernel(bank, config)
+        self.n_shards = mesh.devices.size
+
+        # static halo requirement per factor family
+        self.h_prox = int(self.k.sec_window.max()) if len(self.k.sec_window) else 0
+        has_rules = bank.has_context_rules
+        self.h_ctx = int(
+            max(
+                bank.ctx_before[has_rules].max(initial=0),
+                bank.ctx_after[has_rules].max(initial=0),
+            )
+        ) if bank.n_patterns else 0
+
+        spec_rows = P(DATA_AXIS)
+        self._fn = jax.jit(
+            shard_map(
+                self._step,
+                mesh=mesh,
+                in_specs=(
+                    P(None, DATA_AXIS),  # lines [T, B]
+                    spec_rows,  # lengths [B]
+                    P(DATA_AXIS, None),  # override_mask [B, C]
+                    P(DATA_AXIS, None),  # override_val [B, C]
+                    P(),  # n_lines
+                    P(),  # freq_base
+                    P(),  # freq_exists
+                ),
+                out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
+                check_rep=False,
+            )
+        )
+
+    # ------------------------------------------------------------- host API
+
+    def __call__(
+        self,
+        lines_u8: np.ndarray,
+        lengths: np.ndarray,
+        override_mask: np.ndarray,
+        override_val: np.ndarray,
+        n_lines: int,
+        freq_base: np.ndarray,
+        freq_exists: np.ndarray,
+    ):
+        scores, pm, counts = self._fn(
+            jnp.asarray(lines_u8.T),
+            jnp.asarray(lengths),
+            jnp.asarray(override_mask),
+            jnp.asarray(override_val),
+            jnp.asarray(n_lines),
+            jnp.asarray(freq_base),
+            jnp.asarray(freq_exists),
+        )
+        return np.asarray(scores), np.asarray(pm), np.asarray(counts)
+
+    # ------------------------------------------------------------ the step
+
+    def _step(
+        self, lines_tb, lengths, override_mask, override_val, n_lines, freq_base, freq_exists
+    ):
+        bank, k = self.bank, self.k
+        Bl = lengths.shape[0]
+        P_ = bank.n_patterns
+        d = jax.lax.axis_index(DATA_AXIS)
+        lidx = jnp.arange(Bl, dtype=jnp.int32)
+        gidx = (d * Bl + lidx).astype(jnp.int32)
+        valid = gidx < n_lines
+
+        # ---- local match (no communication) -------------------------------
+        cube = self._local_match(lines_tb, lengths)
+        cube = jnp.where(override_mask, override_val, cube)
+        cube = cube & valid[:, None]
+
+        if P_ == 0:
+            scores = jnp.zeros((Bl, 0), dtype=f64)
+            pm = jnp.zeros((Bl, 0), dtype=bool)
+            counts = jnp.zeros((max(1, bank.n_freq_slots),), dtype=jnp.int64)
+            return scores, pm, counts
+
+        pm = cube[:, jnp.asarray(bank.primary_columns)]
+
+        chrono = self._chronological(gidx, n_lines)
+        prox = self._proximity(cube, lidx, Bl, P_)
+        temp = self._temporal(cube, gidx, n_lines, Bl, P_)
+        ctx = self._context(cube, gidx, lidx, n_lines, Bl)
+        penalty, counts = self._frequency(pm, freq_base, freq_exists, Bl)
+
+        conf = jnp.asarray(bank.confidence)[None, :]
+        sev = jnp.asarray(bank.severity_multiplier)[None, :]
+        scores = conf * sev * chrono[:, None] * prox * temp * ctx * (1.0 - penalty)
+        scores = jnp.where(pm, scores, 0.0)
+        return scores, pm, counts
+
+    # ----------------------------------------------------------- local match
+
+    def _local_match(self, lines_tb, lengths):
+        Bl = lengths.shape[0]
+        C = self.bank.n_columns
+        cube = jnp.zeros((Bl, C), dtype=bool)
+        if self.dfa_bank.n_regexes:
+            matched = self.dfa_bank._run(lines_tb, lengths)[:, : self.dfa_bank.n_regexes]
+            dfa_cols = jnp.asarray(
+                [i for i, c in enumerate(self.bank.columns) if c.dfa is not None],
+                dtype=np.int32,
+            )
+            cube = cube.at[:, dfa_cols].set(matched)
+        return cube
+
+    # -------------------------------------------------------------- factors
+
+    def _chronological(self, gidx, n_lines):
+        pos = gidx.astype(f64) / n_lines.astype(f64)
+        early, penalty = self.k.chrono_early, self.k.chrono_penalty
+        return jnp.where(
+            pos <= early,
+            1.5 + (early - pos) * self.k.chrono_bonus_quot,
+            jnp.where(
+                pos <= penalty,
+                1.0 + (penalty - pos) * self.k.chrono_middle_quot,
+                0.5 + (1.0 - pos),
+            ),
+        )
+
+    def _extend(self, cols: jax.Array, h: int, Bl: int):
+        """Neighborhood view of sharded columns: (extended array, offset of
+        local row 0). ppermute halo when shards are big enough; all_gather
+        when the halo would span multiple shards."""
+        if h < Bl:
+            return _ring_halo(cols, h), h  # offset is static
+        gathered = jax.lax.all_gather(cols, DATA_AXIS, axis=0, tiled=True)
+        d = jax.lax.axis_index(DATA_AXIS)
+        return gathered, d * Bl  # offset is traced
+
+    def _proximity(self, cube, lidx, Bl, P_):
+        k = self.k
+        if len(k.sec_cols) == 0:
+            return jnp.ones((Bl, P_), dtype=f64)
+        sm = cube[:, jnp.asarray(k.sec_cols)]
+        h = max(1, self.h_prox)
+        ext, off = self._extend(sm, h, Bl)
+        ext_len = ext.shape[0]
+        eidx = jnp.arange(ext_len, dtype=jnp.int32)[:, None]
+        big = jnp.int32(1 << 30)
+
+        prev_incl = jax.lax.cummax(jnp.where(ext, eidx, -1), axis=0)
+        prev = jnp.concatenate(
+            [jnp.full((1, ext.shape[1]), -1, prev_incl.dtype), prev_incl[:-1]], axis=0
+        )
+        nxt_incl = jnp.flip(
+            jax.lax.cummin(jnp.flip(jnp.where(ext, eidx, big), axis=0), axis=0), axis=0
+        )
+        nxt = jnp.concatenate(
+            [nxt_incl[1:], jnp.full((1, ext.shape[1]), big, nxt_incl.dtype)], axis=0
+        )
+        mine = off + lidx  # positions of my rows in ext coordinates
+        my_prev = prev[mine]
+        my_nxt = nxt[mine]
+        pos = mine[:, None]
+        d_prev = jnp.where(my_prev >= 0, pos - my_prev, big)
+        d_next = jnp.where(my_nxt < big, my_nxt - pos, big)
+        dist = jnp.minimum(d_prev, d_next)
+        window = jnp.asarray(k.sec_window)[None, :]
+        found = dist <= window
+        decay = jnp.exp(-dist.astype(f64) / self.config.proximity_decay_constant)
+        contrib = jnp.where(found, jnp.asarray(k.sec_weight)[None, :] * decay, 0.0)
+        prox = jnp.ones((Bl, P_), dtype=f64)
+        return prox.at[:, jnp.asarray(k.sec_owner)].add(contrib)
+
+    def _temporal(self, cube, gidx, n_lines, Bl, P_):
+        k = self.k
+        temp = jnp.ones((Bl, P_), dtype=f64)
+        if not k.sequences:
+            return temp
+        em_local = cube[:, jnp.asarray(k.seq_event_cols, dtype=np.int32)]
+        em = jax.lax.all_gather(em_local, DATA_AXIS, axis=0, tiled=True)  # [B, E]
+        B = em.shape[0]
+        eidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        prev_incl = jax.lax.cummax(jnp.where(em, eidx, -1), axis=0)
+        prefix = jnp.concatenate(
+            [jnp.zeros((1, em.shape[1]), jnp.int32), jnp.cumsum(em.astype(jnp.int32), axis=0)]
+        )
+        w = SEQUENCE_NEAR_WINDOW
+        for seq in k.sequences:
+            if not seq.event_columns:
+                continue
+            e_last = k.seq_col_pos[seq.event_columns[-1]]
+            lo = jnp.clip(gidx - w, 0, B)
+            hi = jnp.clip(jnp.minimum(gidx + w + 1, n_lines), 0, B).astype(jnp.int32)
+            near = (prefix[hi, e_last] - prefix[lo, e_last]) > 0
+            ok = near
+            cur = gidx
+            for col in reversed(seq.event_columns[:-1]):
+                e = k.seq_col_pos[col]
+                g = jnp.where(cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1)
+                ok = ok & (g >= 0)
+                cur = jnp.clip(g, 0, B - 1)
+            temp = temp.at[:, seq.pattern_idx].add(jnp.where(ok, seq.bonus, 0.0))
+        return temp
+
+    def _context(self, cube, gidx, lidx, n_lines, Bl):
+        k = self.k
+        if not k.ctx_shapes:
+            return jnp.ones((Bl, 0), dtype=f64)
+        err = cube[:, CTX_ERROR]
+        warn = cube[:, CTX_WARN] & ~err
+        stack = cube[:, CTX_STACK]
+        exc = cube[:, CTX_EXCEPTION]
+        from log_parser_tpu.golden.engine import (
+            ERROR_WEIGHT,
+            EXCEPTION_WEIGHT,
+            WARN_WEIGHT,
+        )
+
+        line_score = (
+            ERROR_WEIGHT * err.astype(f64)
+            + WARN_WEIGHT * warn.astype(f64)
+            + STACK_WEIGHT * stack.astype(f64)
+            + EXCEPTION_WEIGHT * exc.astype(f64)
+        )
+        h = max(1, self.h_ctx)
+        flags = jnp.stack(
+            [line_score, stack.astype(f64), err.astype(f64)], axis=1
+        )  # [Bl, 3]
+        ext, off = self._extend(flags, h, Bl)
+        prefix = jnp.concatenate(
+            [jnp.zeros((1, 3), dtype=f64), jnp.cumsum(ext, axis=0)], axis=0
+        )
+        ext_len = ext.shape[0]
+        mine = off + lidx
+
+        cols = []
+        for has_rules, before, after in k.ctx_shapes:
+            if not has_rules:
+                w_score = line_score
+                w_stack = stack.astype(jnp.int32)
+                w_err = err.astype(jnp.int32)
+                total = jnp.ones_like(lidx)
+            else:
+                # global clamps (AnalysisService.java:142,148) expressed on
+                # the global index; ext rows outside them are zero-masked
+                lo_g = jnp.maximum(gidx - before, 0)
+                hi_g = jnp.minimum(gidx + 1 + after, n_lines).astype(jnp.int32)
+                hi_g = jnp.maximum(hi_g, lo_g)
+                total = hi_g - lo_g
+                lo_e = jnp.clip(mine - (gidx - lo_g), 0, ext_len)
+                hi_e = jnp.clip(mine + (hi_g - gidx), 0, ext_len)
+                win = prefix[hi_e] - prefix[lo_e]  # [Bl, 3]
+                w_score = win[:, 0]
+                w_stack = win[:, 1].astype(jnp.int32)
+                w_err = win[:, 2].astype(jnp.int32)
+            score = w_score + jnp.where(
+                w_stack > 0,
+                jnp.minimum(STACK_WEIGHT * w_stack.astype(f64), STACK_BONUS_CAP),
+                0.0,
+            )
+            dense = (total > DENSITY_MIN_LINES) & (
+                (w_stack + w_err).astype(f64) > total.astype(f64) * DENSITY_RATIO
+            )
+            score = jnp.where(dense, score * DENSITY_PENALTY, score)
+            cols.append(jnp.minimum(1.0 + score, self.config.context_max_context_factor))
+        ctx_u = jnp.stack(cols, axis=1)
+        return ctx_u[:, jnp.asarray(k.pattern_ctx_shape)]
+
+    def _frequency(self, pm, freq_base, freq_exists, Bl):
+        bank, k = self.bank, self.k
+        n_slots = max(1, bank.n_freq_slots)
+        pm_i = pm.astype(jnp.int64)
+        slot_ok = jnp.asarray(bank.freq_slot >= 0)
+        safe_slot = jnp.asarray(np.maximum(bank.freq_slot, 0))
+
+        line_slot = jnp.zeros((Bl, n_slots), dtype=jnp.int64)
+        line_slot = line_slot.at[:, safe_slot].add(jnp.where(slot_ok[None, :], pm_i, 0))
+        local_before = _excl_cumsum(line_slot, axis=0)
+        local_total = jnp.sum(line_slot, axis=0)  # [n_slots]
+
+        # exclusive cross-shard prefix of slot totals
+        d = jax.lax.axis_index(DATA_AXIS)
+        all_totals = jax.lax.all_gather(local_total, DATA_AXIS, axis=0)  # [D, n_slots]
+        shard_mask = (jnp.arange(all_totals.shape[0]) < d)[:, None]
+        carry = jnp.sum(jnp.where(shard_mask, all_totals, 0), axis=0)  # [n_slots]
+
+        before_line = carry[None, :] + local_before
+        prior = before_line[:, safe_slot]
+        for slot, members in k.shared_slots.items():
+            sub = pm_i[:, jnp.asarray(members, dtype=np.int32)]
+            corr = _excl_cumsum(sub, axis=1)
+            for j, p_idx in enumerate(members):
+                prior = prior.at[:, p_idx].add(corr[:, j])
+
+        if k.freq_hours == 0.0:  # zero window: every record expires instantly
+            count_before = jnp.zeros_like(prior, dtype=f64)
+        else:
+            count_before = freq_base[safe_slot][None, :] + prior.astype(f64)
+        rate = count_before / k.freq_hours
+        thr = float(self.config.frequency_threshold)
+        raw = jnp.minimum(float(self.config.frequency_max_penalty), (rate - thr) / thr)
+        penalty = jnp.where(rate <= thr, 0.0, raw)
+        never_tracked = (~freq_exists[safe_slot])[None, :] & (prior == 0)
+        penalty = jnp.where(never_tracked, 0.0, penalty)
+        penalty = jnp.where(slot_ok[None, :], penalty, 0.0)
+
+        counts = jax.lax.psum(local_total, DATA_AXIS)
+        return penalty, counts
+
+
+class ShardedEngine:
+    """AnalysisEngine variant running the fused match+score step under
+    shard_map. Host-side responsibilities (split/encode, host verification,
+    frequency tracker, result assembly) are shared with the single-device
+    engine via delegation."""
+
+    def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
+        import time as _time
+
+        from log_parser_tpu.runtime.engine import AnalysisEngine
+
+        self._base = AnalysisEngine(
+            pattern_sets, config, clock=clock or _time.monotonic
+        )
+        if mesh is None:
+            from log_parser_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        self.mesh = mesh
+        self.step = ShardedAnalysisStep(
+            self._base.bank, self._base.config, mesh, self._base.dfa_bank
+        )
+
+    @property
+    def bank(self):
+        return self._base.bank
+
+    @property
+    def frequency(self):
+        return self._base.frequency
+
+    @property
+    def config(self):
+        return self._base.config
+
+    @property
+    def skipped_patterns(self):
+        return self._base.bank.skipped_patterns
+
+    def analyze(self, data):
+        import time as _time
+        import uuid as _uuid
+
+        import numpy as _np
+
+        from log_parser_tpu.golden.engine import (
+            build_metadata,
+            build_summary,
+            extract_context,
+        )
+        from log_parser_tpu.golden.javacompat import java_split_lines
+        from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
+        from log_parser_tpu.ops.encode import encode_lines
+
+        base = self._base
+        start = _time.monotonic()
+        lines = java_split_lines(data.logs or "")
+        enc = encode_lines(lines, min_rows=max(8, self.mesh.devices.size))
+        B = enc.u8.shape[0]
+        C = base.bank.n_columns
+
+        override_mask = _np.zeros((B, C), dtype=bool)
+        override_val = _np.zeros((B, C), dtype=bool)
+        for col in base._host_cols:
+            host = base.bank.columns[col].host
+            override_mask[:, col] = True
+            for i in range(enc.n_lines):
+                override_val[i, col] = bool(host.search(lines[i]))
+        for i in _np.flatnonzero(enc.needs_host[: enc.n_lines]):
+            line = lines[i]
+            for col in base._dfa_cols:
+                override_mask[i, col] = True
+                override_val[i, col] = bool(base.bank.columns[col].host.search(line))
+
+        freq_base = _np.zeros(max(1, base.bank.n_freq_slots), dtype=_np.float64)
+        freq_exists = _np.zeros(max(1, base.bank.n_freq_slots), dtype=bool)
+        for slot, pid in enumerate(base.bank.freq_ids):
+            freq_base[slot] = base.frequency.get_windowed_count(pid)
+            freq_exists[slot] = base.frequency.has_entry(pid)
+
+        scores, pm, counts = self.step(
+            enc.u8, enc.lengths, override_mask, override_val, len(lines),
+            freq_base, freq_exists,
+        )
+
+        for slot in range(base.bank.n_freq_slots):
+            for _ in range(int(counts[slot])):
+                base.frequency.record_pattern_match(base.bank.freq_ids[slot])
+
+        events: list[MatchedEvent] = []
+        for line_idx, p_idx in _np.argwhere(pm):
+            pattern = base.bank.patterns[p_idx]
+            events.append(
+                MatchedEvent(
+                    line_number=int(line_idx) + 1,
+                    matched_pattern=pattern,
+                    context=extract_context(lines, int(line_idx), pattern),
+                    score=float(scores[line_idx, p_idx]),
+                )
+            )
+        return AnalysisResult(
+            events=events,
+            analysis_id=str(_uuid.uuid4()),
+            metadata=build_metadata(start, len(lines), base.bank.pattern_sets),
+            summary=build_summary(events),
+        )

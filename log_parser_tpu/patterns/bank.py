@@ -141,16 +141,18 @@ class PatternBank:
         self.has_context_rules = np.asarray(
             [p.context_extraction is not None for p in self.patterns], dtype=bool
         )
+        # negative YAML window values behave as 0 in the golden semantics:
+        # Python slices like lines[max(0, idx-(-5)):idx] are simply empty
         self.ctx_before = np.asarray(
             [
-                p.context_extraction.lines_before if p.context_extraction else 0
+                max(0, p.context_extraction.lines_before) if p.context_extraction else 0
                 for p in self.patterns
             ],
             dtype=np.int32,
         )
         self.ctx_after = np.asarray(
             [
-                p.context_extraction.lines_after if p.context_extraction else 0
+                max(0, p.context_extraction.lines_after) if p.context_extraction else 0
                 for p in self.patterns
             ],
             dtype=np.int32,

@@ -102,12 +102,16 @@ class AnalysisEngine:
         lines = java_split_lines(data.logs or "")
         cube = self._match_cube(lines)
 
-        # windowed frequency counts at batch start (pruned by the tracker)
+        # windowed frequency counts at batch start (pruned by the tracker);
+        # "entry exists" is tracked separately — an expired window still has
+        # an entry and takes the formula path, not the null early-return
         freq_base = np.zeros(max(1, self.bank.n_freq_slots), dtype=np.float64)
+        freq_exists = np.zeros(max(1, self.bank.n_freq_slots), dtype=bool)
         for slot, pid in enumerate(self.bank.freq_ids):
             freq_base[slot] = self.frequency.get_windowed_count(pid)
+            freq_exists[slot] = self.frequency.has_entry(pid)
 
-        batch = self.kernel.score_batch(cube, len(lines), freq_base)
+        batch = self.kernel.score_batch(cube, len(lines), freq_base, freq_exists)
 
         # record this batch's matches (after the read — ScoringService.java:84-88)
         for slot, count in enumerate(batch.slot_batch_counts[: self.bank.n_freq_slots]):

@@ -237,6 +237,34 @@ class TestEngineEdgeCases:
         assert "fine" not in interned
         assert "ERROR" in interned
 
+    def test_zero_window_hours_first_match_has_no_penalty(self):
+        """window=0: the FIRST match of a pattern must take the 'no entry'
+        early return (penalty 0), not the NaN formula path — and later
+        matches go NaN, matching golden exactly."""
+        config = ScoringConfig(frequency_time_window_hours=0)
+        self.run_both(
+            [make_pattern("oom", regex="OOM", confidence=1.0, severity="INFO")],
+            "OOM here\nnothing\nOOM again\nx",
+            config,
+        )
+
+    def test_negative_threshold_never_matched(self):
+        """threshold<0 with no tracker entry: golden early-returns 0."""
+        config = ScoringConfig(frequency_threshold=-1.0)
+        self.run_both(
+            [make_pattern("e", regex="ERR", confidence=1.0, severity="INFO")],
+            "ERR one\nx\nERR two\nx",
+            config,
+        )
+
+    def test_negative_context_windows_are_empty_slices(self):
+        """lines_before/after < 0 behave as empty slices (golden Python
+        slicing), so the context window is the matched line only."""
+        from log_parser_tpu.models.pattern import ContextExtraction
+        pattern = make_pattern("c", regex="MATCH", confidence=1.0, severity="INFO")
+        pattern.context_extraction = ContextExtraction(lines_before=-5, lines_after=-2)
+        self.run_both([pattern], "ERROR a\nERROR b\nMATCH ERROR\nERROR c")
+
     def test_overlong_line_host_verified(self):
         long_line = "x" * 5000 + " OutOfMemoryError"
         r = self.run_both(

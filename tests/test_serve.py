@@ -1,0 +1,115 @@
+"""REST contract tests — the role rest-assured was meant to play in the
+reference (declared at pom.xml:73-77, never used; SURVEY.md §4)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.serve import make_server
+from tests.helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    patterns = [
+        make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                     severity="CRITICAL", context=(1, 1)),
+        make_pattern("err", regex=r"\bERROR\b", confidence=0.5, severity="LOW"),
+    ]
+    engine = AnalysisEngine([make_pattern_set(patterns, "lib")], ScoringConfig())
+    server = make_server(engine, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+def post(url: str, payload, raw: bytes | None = None):
+    body = raw if raw is not None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(url: str):
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestParseEndpoint:
+    def test_success_contract(self, server_url):
+        status, body = post(
+            server_url + "/parse",
+            {
+                "pod": {"metadata": {"name": "web-1"}},
+                "logs": "INFO boot\njava.lang.OutOfMemoryError: heap\nafter",
+            },
+        )
+        assert status == 200
+        assert body["summary"]["significantEvents"] == 1
+        assert body["summary"]["highestSeverity"] == "CRITICAL"
+        event = body["events"][0]
+        assert event["lineNumber"] == 2
+        assert event["matchedPattern"]["id"] == "oom"
+        assert event["context"]["matchedLine"].startswith("java.lang")
+        assert event["context"]["linesBefore"] == ["INFO boot"]
+        assert event["score"] > 0
+        assert body["metadata"]["totalLines"] == 3
+        assert body["metadata"]["patternsUsed"] == ["lib"]
+        assert body["analysisId"]
+
+    def test_null_pod_is_400(self, server_url):
+        status, body = post(server_url + "/parse", {"logs": "x"})
+        assert status == 400
+        assert body == {"error": "Invalid PodFailureData provided"}
+
+    def test_null_body_is_400(self, server_url):
+        status, body = post(server_url + "/parse", None, raw=b"")
+        assert status == 400
+
+    def test_malformed_json_is_400(self, server_url):
+        status, _ = post(server_url + "/parse", None, raw=b"{not json")
+        assert status == 400
+
+    def test_json_array_body_is_400(self, server_url):
+        status, _ = post(server_url + "/parse", [1, 2, 3])
+        assert status == 400
+
+    def test_unknown_route_404(self, server_url):
+        status, _ = post(server_url + "/nope", {})
+        assert status == 404
+
+
+class TestOperationalEndpoints:
+    def test_health(self, server_url):
+        for path in ("/health", "/health/live", "/health/ready", "/q/health"):
+            status, body = get(server_url + path)
+            assert status == 200 and body["status"] == "UP"
+
+    def test_frequency_stats_and_reset(self, server_url):
+        post(
+            server_url + "/parse",
+            {"pod": {"metadata": {"name": "p"}}, "logs": "an ERROR here"},
+        )
+        status, stats = get(server_url + "/frequency/stats")
+        assert status == 200 and stats.get("err", 0) >= 1
+        status, _ = post(server_url + "/frequency/reset/err", None, raw=b"")
+        assert status == 200
+        _, stats = get(server_url + "/frequency/stats")
+        assert stats.get("err") == 0
+        status, _ = post(server_url + "/frequency/reset", None, raw=b"")
+        assert status == 200

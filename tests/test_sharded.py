@@ -1,0 +1,100 @@
+"""Sharded (shard_map) pipeline vs golden: multi-device parity on the
+virtual 8-device CPU mesh — halo exchange, all_gather chains, cross-shard
+frequency prefix, and shard-boundary window correctness."""
+
+import random
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.parallel import ShardedEngine, make_mesh
+from tests.conftest import FakeClock
+from tests.helpers import make_pattern, make_pattern_set
+from tests.test_engine_parity import assert_results_match, random_library, random_logs
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_parity_small_batches(seed, mesh8):
+    """Small logs: shards smaller than halos -> the all_gather fallback."""
+    rng = random.Random(1000 + seed)
+    sets = random_library(rng, rng.randrange(2, 6))
+    config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
+    engine = ShardedEngine(sets, config, mesh=mesh8, clock=FakeClock())
+    golden = GoldenAnalyzer(sets, config, clock=FakeClock())
+    for _ in range(2):
+        logs = random_logs(rng, rng.randrange(5, 90))
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+        assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert (
+        engine.frequency.get_frequency_statistics()
+        == golden.frequency.get_frequency_statistics()
+    )
+
+
+def test_halo_path_large_batch(mesh8):
+    """~1200 lines over 8 shards (Bl=256 > halo) -> ppermute halo path, with
+    matches planted straddling every shard boundary."""
+    patterns = [
+        make_pattern(
+            "oom", regex="OutOfMemoryError", confidence=0.9, severity="CRITICAL",
+            secondaries=[("GC overhead", 0.6, 100)], context=(5, 5),
+        ),
+        make_pattern(
+            "seq", regex="FAILURE", confidence=0.8, severity="HIGH",
+            sequences=[(0.5, ["first thing", "second thing", "FAILURE"])],
+        ),
+    ]
+    lines = [f"line {i}" for i in range(1200)]
+    # matches exactly at and around the 8 x 256-row shard edges (256 rows
+    # because 1200 pads to 2048... compute: next pow2 of 1200 is 2048 -> Bl=256)
+    for edge in range(256, 2048, 256):
+        if edge - 1 < 1200:
+            lines[edge - 1] = "GC overhead spike"  # secondary on last row of shard
+        if edge + 2 < 1200:
+            lines[edge + 2] = "java.lang.OutOfMemoryError"  # primary 3 past edge
+    lines[10] = "first thing"
+    lines[400] = "second thing"
+    lines[403] = "FAILURE detected"
+    lines[500] = "ERROR context"
+    lines[501] = "java.lang.OutOfMemoryError"
+    logs = "\n".join(lines)
+    sets = [make_pattern_set(patterns)]
+    engine = ShardedEngine(sets, ScoringConfig(), mesh=make_mesh(8), clock=FakeClock())
+    golden = GoldenAnalyzer(sets, ScoringConfig(), clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    r1, r2 = engine.analyze(data), golden.analyze(data)
+    # 4 shard edges fall below line 1200 (256,512,768,1024) + oom@501 + seq
+    assert len(r1.events) == 6  # every planted boundary match fired
+    assert_results_match(r1, r2)
+
+
+def test_single_device_mesh():
+    patterns = [make_pattern("e", regex="ERROR", confidence=0.5, severity="LOW")]
+    sets = [make_pattern_set(patterns)]
+    engine = ShardedEngine(sets, ScoringConfig(), mesh=make_mesh(1), clock=FakeClock())
+    golden = GoldenAnalyzer(sets, ScoringConfig(), clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs="an ERROR\nok")
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+
+
+def test_cross_shard_frequency_order(mesh8):
+    """Matches of one pattern spread across shards must see a globally
+    consistent read-before-record count order."""
+    patterns = [make_pattern("rep", regex="REPEAT", confidence=1.0, severity="INFO")]
+    sets = [make_pattern_set(patterns)]
+    config = ScoringConfig(frequency_threshold=3.0)
+    lines = ["x"] * 640
+    for i in range(0, 640, 40):  # 16 matches spread over all shards
+        lines[i] = "REPEAT hit"
+    logs = "\n".join(lines)
+    engine = ShardedEngine(sets, config, mesh=mesh8, clock=FakeClock())
+    golden = GoldenAnalyzer(sets, config, clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
