@@ -181,9 +181,29 @@ def compile_regex_to_dfa(
 ) -> CompiledDfa:
     """Java regex → packed DFA with ``find()`` substring semantics.
 
-    Raises :class:`RegexUnsupportedError` (dialect) or
+    Uses the native (C++) subset construction when available — it also
+    minimizes, shrinking the packed device tables — with the Python builder
+    as fallback. Raises :class:`RegexUnsupportedError` (dialect) or
     :class:`DfaLimitError` (state blowup); both mean "host fallback".
     """
     node: Node = parse_java_regex(regex, case_insensitive)
     nfa = build_nfa(node, unanchored_prefix=True)
+
+    from log_parser_tpu.native.dfabuild import DfaLimitExceeded, build_dfa_native
+
+    try:
+        built = build_dfa_native(nfa, max_states=max_states)
+    except DfaLimitExceeded:
+        raise DfaLimitError(f"DFA for {regex!r} exceeded {max_states} states")
+    if built is not None:
+        trans, byte_class, accept, start = built
+        return CompiledDfa(
+            regex=regex,
+            trans=trans,
+            byte_class=byte_class,
+            accept_end=accept,
+            start=start,
+            n_states=trans.shape[0],
+            n_classes=trans.shape[1],
+        )
     return compile_nfa_to_dfa(nfa, regex=regex, max_states=max_states)

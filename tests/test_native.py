@@ -1,0 +1,185 @@
+"""Native library parity: ingest vs java_split_lines/encode_lines, and the
+C++ DFA builder vs the Python subset construction and Python ``re``."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.golden.javacompat import compile_java_regex, java_split_lines
+from log_parser_tpu.native import available
+from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.ops.encode import encode_lines
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library unavailable"
+)
+
+
+SPLIT_CASES = [
+    "",
+    "a",
+    "a\nb",
+    "a\r\nb",
+    "a\n",
+    "a\r\n",
+    "\n",
+    "\r\n",
+    "\n\n",
+    "a\n\nb\n\n",
+    "a\r\rb",          # lone \r is not a separator
+    "a\r\r\nb",        # only one \r consumed by the separator
+    "\r",
+    "x" * 5000 + "\nshort",
+    "héllo\nwörld\n",
+    "tail no newline",
+    "\nleading",
+]
+
+
+@pytest.mark.parametrize("logs", SPLIT_CASES)
+def test_corpus_split_matches_java(logs):
+    corpus = Corpus(logs)
+    expect = java_split_lines(logs)
+    assert len(corpus) == len(expect)
+    assert list(corpus) == expect
+
+
+@pytest.mark.parametrize("logs", SPLIT_CASES)
+def test_corpus_encode_matches_python(logs):
+    corpus = Corpus(logs)
+    expect = encode_lines(java_split_lines(logs))
+    enc = corpus.encoded
+    assert enc.n_lines == expect.n_lines
+    assert enc.u8.shape == expect.u8.shape
+    np.testing.assert_array_equal(enc.u8, expect.u8)
+    np.testing.assert_array_equal(enc.lengths, expect.lengths)
+    np.testing.assert_array_equal(enc.needs_host, expect.needs_host)
+
+
+def test_corpus_random_fuzz():
+    rng = random.Random(7)
+    alphabet = "ab\r\n \t€é"
+    for _ in range(200):
+        logs = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
+        corpus = Corpus(logs)
+        expect = java_split_lines(logs)
+        assert list(corpus) == expect, repr(logs)
+        enc = corpus.encoded
+        pe = encode_lines(expect)
+        np.testing.assert_array_equal(enc.u8, pe.u8, err_msg=repr(logs))
+        np.testing.assert_array_equal(enc.lengths, pe.lengths, err_msg=repr(logs))
+        np.testing.assert_array_equal(
+            enc.needs_host, pe.needs_host, err_msg=repr(logs)
+        )
+
+
+def test_corpus_slicing():
+    corpus = Corpus("a\nbb\nccc\ndddd")
+    assert corpus[1] == "bb"
+    assert corpus[-1] == "dddd"
+    assert corpus[1:3] == ["bb", "ccc"]
+    assert corpus[:] == ["a", "bb", "ccc", "dddd"]
+    assert corpus.materialize() == ["a", "bb", "ccc", "dddd"]
+
+
+# ---------------------------------------------------------------------------
+# DFA builder
+# ---------------------------------------------------------------------------
+
+REGEXES = [
+    "ERROR",
+    "(?i)out of memory",
+    r"\bOOM\b",
+    r"^\s*at\s+[\w.$]+",
+    r"(ERROR|FATAL|CRITICAL|SEVERE)",
+    r"\w+Exception",
+    r"Connection refused.*:\d+",
+    r"x{2,4}y",
+    r"[A-Za-z_][A-Za-z0-9_]*Error$",
+    r"a|b|c|abc",
+    r"probe (failed|timed out)",
+    r"GC \(.*\) \d+M->\d+M",
+]
+
+LINES = [
+    "",
+    "ERROR something broke",
+    "error lowercase",
+    "Out Of Memory detected",
+    "OOM",
+    "xOOMy",
+    "    at com.example.Main.run(Main.java:1)",
+    "java.lang.IllegalStateException: boom",
+    "Connection refused to host:5432",
+    "xxy xxxy xxxxy",
+    "MyError",
+    "MyError trailing",
+    "abc",
+    "probe failed",
+    "probe timed out",
+    "[Full GC (Ergonomics) 255M->250M(256M)]",
+    "benign INFO line",
+]
+
+
+@pytest.mark.parametrize("regex", REGEXES)
+def test_native_dfa_matches_python_builders(regex):
+    from log_parser_tpu.patterns.regex.dfa import compile_nfa_to_dfa
+    from log_parser_tpu.patterns.regex.nfa import build_nfa
+    from log_parser_tpu.patterns.regex.parser import parse_java_regex
+    from log_parser_tpu.native.dfabuild import build_dfa_native
+
+    ci = regex.startswith("(?i)")
+    body = regex[4:] if ci else regex
+    node = parse_java_regex(body, ci)
+    nfa = build_nfa(node, unanchored_prefix=True)
+    py = compile_nfa_to_dfa(nfa, regex=body)
+    built = build_dfa_native(nfa)
+    assert built is not None
+    trans, byte_class, accept, start = built
+    host = compile_java_regex(body, ci)
+
+    # native minimizes: state count must not exceed the Python builder's
+    assert trans.shape[0] <= py.n_states
+
+    def native_match(data: bytes) -> bool:
+        st = start
+        for b in data:
+            st = trans[st, byte_class[b]]
+        return bool(accept[st])
+
+    for line in LINES:
+        data = line.encode()
+        expect = bool(host.search(line))
+        assert py.matches(data) == expect, (regex, line)
+        assert native_match(data) == expect, (regex, line)
+
+
+def test_native_dfa_limit():
+    from log_parser_tpu.patterns.regex.nfa import build_nfa
+    from log_parser_tpu.patterns.regex.parser import parse_java_regex
+    from log_parser_tpu.native.dfabuild import DfaLimitExceeded, build_dfa_native
+
+    node = parse_java_regex(r"a.{10,20}b.{10,20}c", False)
+    nfa = build_nfa(node, unanchored_prefix=True)
+    with pytest.raises(DfaLimitExceeded):
+        build_dfa_native(nfa, max_states=8)
+
+
+def test_compile_regex_to_dfa_uses_native_and_matches():
+    from log_parser_tpu.patterns.regex.dfa import compile_regex_to_dfa
+
+    dfa = compile_regex_to_dfa(r"(ERROR|WARN)\s+\w+")
+    host = compile_java_regex(r"(ERROR|WARN)\s+\w+")
+    for line in LINES + ["ERROR x", "WARN  yz", "WARNx"]:
+        assert dfa.matches(line.encode()) == bool(host.search(line)), line
+
+
+def test_native_dfa_zero_state_cap():
+    from log_parser_tpu.patterns.regex.dfa import DfaLimitError, compile_regex_to_dfa
+
+    with pytest.raises(DfaLimitError):
+        compile_regex_to_dfa("a", max_states=0)
