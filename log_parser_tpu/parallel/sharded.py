@@ -434,29 +434,24 @@ class ShardedEngine:
             build_summary,
             extract_context,
         )
-        from log_parser_tpu.golden.javacompat import java_split_lines
         from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
-        from log_parser_tpu.ops.encode import encode_lines
+        from log_parser_tpu.native.ingest import Corpus
 
         base = self._base
         start = _time.monotonic()
-        lines = java_split_lines(data.logs or "")
-        enc = encode_lines(lines, min_rows=max(8, self.mesh.devices.size))
+        corpus = Corpus(data.logs or "", min_rows=max(8, self.mesh.devices.size))
+        lines = corpus
+        enc = corpus.encoded
         B = enc.u8.shape[0]
         C = base.bank.n_columns
 
-        override_mask = _np.zeros((B, C), dtype=bool)
-        override_val = _np.zeros((B, C), dtype=bool)
-        for col in base._host_cols:
-            host = base.bank.columns[col].host
-            override_mask[:, col] = True
-            for i in range(enc.n_lines):
-                override_val[i, col] = bool(host.search(lines[i]))
-        for i in _np.flatnonzero(enc.needs_host[: enc.n_lines]):
-            line = lines[i]
-            for col in base._dfa_cols:
-                override_mask[i, col] = True
-                override_val[i, col] = bool(base.bank.columns[col].host.search(line))
+        # shared override construction (host columns + device-inexact lines)
+        overrides = base._overrides(corpus)
+        if overrides is None:
+            override_mask = _np.zeros((B, C), dtype=bool)
+            override_val = _np.zeros((B, C), dtype=bool)
+        else:
+            override_mask, override_val = overrides
 
         freq_base = _np.zeros(max(1, base.bank.n_freq_slots), dtype=_np.float64)
         freq_exists = _np.zeros(max(1, base.bank.n_freq_slots), dtype=bool)

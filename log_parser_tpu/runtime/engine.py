@@ -3,21 +3,26 @@
 
 Pipeline per request:
 
-1. split logs with Java semantics (AnalysisService.java:53);
-2. encode lines into a padded uint8 batch (vectorized, host);
-3. evaluate every matcher column: DFA bank on device for automaton-backed
-   regexes, host ``re`` for the fallback set and for lines the device can't
-   be exact on (non-ASCII / over-long);
-4. one jitted scoring pass producing f64 scores for all (line, pattern)
-   pairs plus the frequency batch counts;
-5. assemble ``AnalysisResult`` in discovery order (line-major, then pattern
-   order — AnalysisService.java:89-113) with the same metadata/summary
-   quirks as the reference.
+1. ingest: fused Java-split + padded uint8 encode (native C++ scan when the
+   extension is built, vectorized numpy otherwise) with lazy line
+   materialization — AnalysisService.java:53 semantics without a million
+   host string objects;
+2. ONE fused device program: DFA-bank automaton execution over the line
+   batch + integer factor-component extraction, compacted to K-capped
+   match records (ops/fused.py). Host ``re`` verification only for
+   device-inexact lines (non-ASCII / over-long) and automaton-unsupported
+   regexes, injected as a cube override;
+3. host finalizer: exact f64 seven-factor scores from the integer records
+   (runtime/finalize.py) — better-than-device-f64 parity at O(matches)
+   cost;
+4. assemble ``AnalysisResult`` in discovery order (line-major, then
+   pattern order — AnalysisService.java:89-113) with the same
+   metadata/summary quirks as the reference.
 
 Frequency state is the engine's only mutable state, mirrored from the
 reference's ConcurrentHashMap (FrequencyTrackingService.java:25) but read
-and advanced at batch granularity with exact per-match ordering recovered
-inside the kernel (read-before-record, ScoringService.java:84-88).
+at batch granularity with exact per-match ordering recovered from the
+record stream (read-before-record, ScoringService.java:84-88).
 """
 
 from __future__ import annotations
@@ -35,18 +40,18 @@ from log_parser_tpu.golden.engine import (
     build_summary,
     extract_context,
 )
-from log_parser_tpu.golden.javacompat import java_split_lines
 from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
-from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.ops.fused import FusedMatchScore
 from log_parser_tpu.ops.match import DfaBank
-from log_parser_tpu.ops.scoring import ScoringKernel
 from log_parser_tpu.patterns.bank import PatternBank
+from log_parser_tpu.runtime.finalize import finalize_batch
 
 
 class AnalysisEngine:
-    """Immutable compiled library + jitted kernels + frequency state."""
+    """Immutable compiled library + one fused device program + frequency state."""
 
     def __init__(
         self,
@@ -56,7 +61,6 @@ class AnalysisEngine:
     ):
         self.config = config or ScoringConfig()
         self.bank = PatternBank(pattern_sets)
-        self.kernel = ScoringKernel(self.bank, self.config)
         self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
 
         self._dfa_cols = [
@@ -66,41 +70,55 @@ class AnalysisEngine:
             i for i, c in enumerate(self.bank.columns) if c.dfa is None
         ]
         self.dfa_bank = DfaBank([self.bank.columns[i].dfa for i in self._dfa_cols])
+        self.fused = FusedMatchScore(self.bank, self.config, self.dfa_bank)
+        self._k_hint = 0  # previous request's match count → starting K bucket
 
     @property
     def skipped_patterns(self) -> list[tuple[str, str]]:
         return self.bank.skipped_patterns
 
-    # ----------------------------------------------------------------- match
+    # -------------------------------------------------------------- overrides
 
-    def _match_cube(self, lines: list[str]) -> np.ndarray:
-        """bool [B_padded, n_columns]; exact for every real line."""
-        enc = encode_lines(lines)
+    def _overrides(self, corpus: Corpus) -> tuple[np.ndarray, np.ndarray] | None:
+        """Cube corrections the automaton path can't make itself: columns
+        with no DFA (host regex over every line) and lines flagged
+        device-inexact (non-ASCII bytes, over-long). None when the batch is
+        fully device-exact — the common case, which then skips the
+        override transfer entirely."""
+        enc = corpus.encoded
+        host_lines = np.flatnonzero(enc.needs_host[: corpus.n_lines])
+        if not self._host_cols and len(host_lines) == 0:
+            return None
         B = enc.u8.shape[0]
-        cube = np.zeros((B, self.bank.n_columns), dtype=bool)
-        if enc.n_lines == 0:
-            return cube
-        if self._dfa_cols:
-            cube[:, self._dfa_cols] = self.dfa_bank.match(enc.u8, enc.lengths)
-        # host passes: fallback columns on all lines; all columns on lines
-        # the device can't be exact on (non-ASCII bytes, over-long lines)
-        for col in self._host_cols:
-            host = self.bank.columns[col].host
-            for i in range(enc.n_lines):
-                cube[i, col] = bool(host.search(lines[i]))
-        host_lines = np.flatnonzero(enc.needs_host[: enc.n_lines])
+        mask = np.zeros((B, self.bank.n_columns), dtype=bool)
+        val = np.zeros((B, self.bank.n_columns), dtype=bool)
+        if self._host_cols:
+            # every line needs a host pass: decode each exactly once
+            hosts = [(col, self.bank.columns[col].host) for col in self._host_cols]
+            mask[:, [col for col, _ in hosts]] = True
+            for i, line in enumerate(corpus.materialize()):
+                for col, host in hosts:
+                    val[i, col] = bool(host.search(line))
         for i in host_lines:
-            line = lines[i]
+            line = corpus.line(int(i))
             for col in self._dfa_cols:
-                cube[i, col] = bool(self.bank.columns[col].host.search(line))
-        return cube
+                mask[i, col] = True
+                val[i, col] = bool(self.bank.columns[col].host.search(line))
+        return mask, val
 
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
         start = time.monotonic()
-        lines = java_split_lines(data.logs or "")
-        cube = self._match_cube(lines)
+        corpus = Corpus(data.logs or "")
+        enc = corpus.encoded
+
+        overrides = self._overrides(corpus)
+        om, ov = overrides if overrides is not None else (None, None)
+        recs = self.fused.run(
+            enc.u8, enc.lengths, corpus.n_lines, om, ov, k_hint=self._k_hint
+        )
+        self._k_hint = recs.n_matches
 
         # windowed frequency counts at batch start (pruned by the tracker);
         # "entry exists" is tracked separately — an expired window still has
@@ -111,29 +129,33 @@ class AnalysisEngine:
             freq_base[slot] = self.frequency.get_windowed_count(pid)
             freq_exists[slot] = self.frequency.has_entry(pid)
 
-        batch = self.kernel.score_batch(cube, len(lines), freq_base, freq_exists)
+        fin = finalize_batch(
+            self.bank, self.fused.t, self.config, recs, corpus.n_lines,
+            freq_base, freq_exists,
+        )
 
         # record this batch's matches (after the read — ScoringService.java:84-88)
-        for slot, count in enumerate(batch.slot_batch_counts[: self.bank.n_freq_slots]):
+        for slot, count in enumerate(fin.slot_batch_counts[: self.bank.n_freq_slots]):
             for _ in range(int(count)):
                 self.frequency.record_pattern_match(self.bank.freq_ids[slot])
 
-        # discovery order: line-major then pattern order ⇔ row-major argwhere
+        # records are already in discovery order (line-major, then pattern)
         events: list[MatchedEvent] = []
-        for line_idx, p_idx in np.argwhere(batch.primary_match):
-            pattern = self.bank.patterns[p_idx]
+        for i in range(len(fin.scores)):
+            line_idx = int(fin.line[i])
+            pattern = self.bank.patterns[int(fin.pattern[i])]
             events.append(
                 MatchedEvent(
-                    line_number=int(line_idx) + 1,
+                    line_number=line_idx + 1,
                     matched_pattern=pattern,
-                    context=extract_context(lines, int(line_idx), pattern),
-                    score=float(batch.scores[line_idx, p_idx]),
+                    context=extract_context(corpus, line_idx, pattern),
+                    score=float(fin.scores[i]),
                 )
             )
 
         return AnalysisResult(
             events=events,
             analysis_id=str(uuid.uuid4()),
-            metadata=build_metadata(start, len(lines), self.bank.pattern_sets),
+            metadata=build_metadata(start, corpus.n_lines, self.bank.pattern_sets),
             summary=build_summary(events),
         )

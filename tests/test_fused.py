@@ -1,0 +1,44 @@
+"""Fused match+factor pipeline: record compaction and overflow handling."""
+
+from __future__ import annotations
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine
+
+from helpers import make_pattern, make_pattern_set
+
+
+def test_k_ladder_overflow_grows_to_cap(monkeypatch):
+    """A batch with more matches than every ladder rung must still return
+    complete records (the final rung is B*P, the true cap)."""
+    import log_parser_tpu.ops.fused as fused
+
+    monkeypatch.setattr(fused, "K_LADDER", (4, 8))
+    ps = make_pattern_set(
+        [make_pattern("every", regex="line", confidence=0.5, severity="LOW")]
+    )
+    engine = AnalysisEngine([ps], ScoringConfig())
+    logs = "\n".join(f"line {i}" for i in range(32))
+    result = engine.analyze(
+        PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    )
+    assert len(result.events) == 32
+    assert [e.line_number for e in result.events] == list(range(1, 33))
+
+
+def test_records_in_discovery_order_multi_pattern():
+    """Line-major then pattern order (AnalysisService.java:89-113)."""
+    ps = make_pattern_set(
+        [
+            make_pattern("a", regex="both|only_a", confidence=0.5, severity="LOW"),
+            make_pattern("b", regex="both|only_b", confidence=0.5, severity="LOW"),
+        ]
+    )
+    engine = AnalysisEngine([ps], ScoringConfig())
+    logs = "only_b\nnothing\nboth\nonly_a"
+    result = engine.analyze(
+        PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    )
+    got = [(e.line_number, e.matched_pattern.id) for e in result.events]
+    assert got == [(1, "b"), (3, "a"), (3, "b"), (4, "a")]
