@@ -25,10 +25,24 @@ from log_parser_tpu.patterns.regex.ac import AhoCorasick
 from log_parser_tpu.patterns.regex.dfa import CompiledDfa
 
 
-class DfaBank:
-    """R packed DFAs executed in lockstep over a line batch."""
+# pair-stride transition tables beyond this many int32 entries fall back to
+# single-stride (the table must stay comfortably HBM/VMEM-resident)
+PAIR_TABLE_MAX_ENTRIES = 64 << 20
 
-    def __init__(self, dfas: list[CompiledDfa]):
+
+class DfaBank:
+    """R packed DFAs executed in lockstep over a line batch.
+
+    The scan is the serial axis of the whole framework, so by default two
+    bytes are consumed per step via precomposed pair transition tables
+    ``trans2[s, c1, c2] = trans[trans[s, c1], c2]`` over byte classes
+    extended with one identity "padding" class (consumed where a position
+    is at/past the line end). That halves the sequential scan length for a
+    table-size cost of ``(cmax+1)²/cmax`` — gated by
+    ``PAIR_TABLE_MAX_ENTRIES`` for very large banks.
+    """
+
+    def __init__(self, dfas: list[CompiledDfa], stride: int = 2):
         self.n_regexes = len(dfas)
         r = max(1, self.n_regexes)
         smax = max([d.n_states for d in dfas], default=1)
@@ -48,11 +62,33 @@ class DfaBank:
         self.byte_class = jnp.asarray(byte_class)
         self.flat_accept = jnp.asarray(accept.reshape(-1))
         self.start = jnp.asarray(start)
+
+        self.pair_stride = (
+            stride == 2
+            and r * smax * (cmax + 1) * (cmax + 1) <= PAIR_TABLE_MAX_ENTRIES
+        )
+        if self.pair_stride:
+            cpad = cmax + 1  # class cmax = identity padding class
+            ext = np.zeros((r, smax, cpad), dtype=np.int32)
+            ext[:, :, :cmax] = trans
+            ext[:, :, cmax] = np.arange(smax, dtype=np.int32)[None, :]
+            # trans2[r, s, c1, c2] = ext[r, ext[r, s, c1], c2]
+            trans2 = np.empty((r, smax, cpad, cpad), dtype=np.int32)
+            for i in range(r):
+                trans2[i] = ext[i][ext[i], :]
+            self.cpad = cpad
+            self.flat_trans2 = jnp.asarray(trans2.reshape(-1))
+
         self._jit = jax.jit(self._run)
 
     def _run(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
         """lines_tb: uint8 [T, B] (transposed); lengths: int32 [B].
         Returns bool [B, R]."""
+        if self.pair_stride:
+            return self._run_pair(lines_tb, lengths)
+        return self._run_single(lines_tb, lengths)
+
+    def _run_single(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
         T, B = lines_tb.shape
         R = self.byte_class.shape[0]
         smax, cmax = self.smax, self.cmax
@@ -69,6 +105,39 @@ class DfaBank:
 
         ts = jnp.arange(T, dtype=jnp.int32)
         states, _ = jax.lax.scan(step, states0, (lines_tb, ts))
+        return jnp.take(self.flat_accept, (r_off + states).reshape(-1)).reshape(B, R)
+
+    def _run_pair(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
+        """Two bytes per scan step through the precomposed pair tables;
+        positions at/past each line's end consume the identity class, so no
+        per-step boundary branch is needed."""
+        T, B = lines_tb.shape
+        if T % 2:  # pad to even so every step has a byte pair
+            lines_tb = jnp.concatenate(
+                [lines_tb, jnp.zeros((1, B), lines_tb.dtype)], axis=0
+            )
+            T += 1
+        R = self.byte_class.shape[0]
+        smax, cpad = self.smax, self.cpad
+        pad_cls = jnp.int32(self.cmax)
+        states0 = jnp.broadcast_to(self.start[None, :], (B, R)).astype(jnp.int32)
+        r_off = (jnp.arange(R, dtype=jnp.int32) * smax)[None, :]  # [1, R]
+
+        pairs = lines_tb.reshape(T // 2, 2, B)
+        ts = jnp.arange(T // 2, dtype=jnp.int32)
+
+        def step(states, xs):
+            pair_t, t = xs  # pair_t: [2, B]
+            p0 = 2 * t
+            c1 = jnp.take(self.byte_class, pair_t[0].astype(jnp.int32), axis=1)  # [R, B]
+            c2 = jnp.take(self.byte_class, pair_t[1].astype(jnp.int32), axis=1)
+            c1 = jnp.where((p0 < lengths)[None, :], c1, pad_cls)
+            c2 = jnp.where((p0 + 1 < lengths)[None, :], c2, pad_cls)
+            idx = ((r_off + states) * cpad + c1.T) * cpad + c2.T  # [B, R]
+            states = jnp.take(self.flat_trans2, idx.reshape(-1)).reshape(B, R)
+            return states, None
+
+        states, _ = jax.lax.scan(step, states0, (pairs, ts))
         return jnp.take(self.flat_accept, (r_off + states).reshape(-1)).reshape(B, R)
 
     def match(self, lines_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:

@@ -83,3 +83,30 @@ class TestEncode:
     def test_width_alignment(self):
         enc = encode_lines(["abc"])
         assert enc.u8.shape[1] % 128 == 0
+
+
+def test_pair_stride_equals_single_stride():
+    """The precomposed pair tables must be byte-for-byte equivalent to the
+    single-stride scan, including odd lengths and the T-padding step."""
+    import numpy as np
+
+    from log_parser_tpu.ops.encode import encode_lines
+    from log_parser_tpu.ops.match import DfaBank
+    from log_parser_tpu.patterns.regex import compile_regex_to_dfa
+
+    rng = np.random.default_rng(7)
+    regexes = ["ERROR", "time(out|r)+", "^\\s*at\\s", "[A-Z][a-z]+Exception", "x.?y"]
+    dfas = [compile_regex_to_dfa(r, False) for r in regexes]
+    single = DfaBank(dfas, stride=1)
+    pair = DfaBank(dfas, stride=2)
+    assert pair.pair_stride and not single.pair_stride
+
+    alphabet = list("aAtxyERORtimeou rs.() \t")
+    lines = [
+        "".join(rng.choice(alphabet, size=int(n)))
+        for n in rng.integers(0, 37, size=64)
+    ]
+    enc = encode_lines(lines)
+    np.testing.assert_array_equal(
+        single.match(enc.u8, enc.lengths), pair.match(enc.u8, enc.lengths)
+    )
