@@ -11,7 +11,7 @@ Severity multipliers and the per-line context weights are deliberately NOT
 configurable — they are hardcoded constants in the reference
 (ScoringService.java:30-36; ContextAnalysisService.java:62-88) and live as
 module constants in :mod:`log_parser_tpu.golden.engine` /
-:mod:`log_parser_tpu.ops.scoring` so they are baked statically into the
+:mod:`log_parser_tpu.runtime.finalize` so they are baked statically into the
 jitted kernels.
 """
 

@@ -1,18 +1,14 @@
-"""JAX kernels: line encoding, automaton execution, vectorized scoring.
+"""JAX kernels: line encoding, automaton execution, integer factor extraction.
 
-float64 is enabled process-wide here: the reference computes every factor in
-Java ``double`` (ScoringService.java:102-109), and the ≤1e-6 parity target
-needs f64 for the factor arithmetic. The heavy work (automaton gathers over
-line bytes) is integer/int32 and unaffected; only the per-line factor math —
-a vanishingly small fraction of the FLOPs — pays the TPU f64 emulation cost.
+No float64 — and no floating point at all — runs on the device: the match
+path is pure int32/bool (DFA gathers over line bytes, prefix sums, record
+compaction), and the seven-factor f64 arithmetic the ≤1e-6 parity target
+requires happens on the host over the integer match records
+(runtime/finalize.py), in the same IEEE doubles the JVM uses.
 """
 
-import jax
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.fused import FusedMatchScore
+from log_parser_tpu.ops.match import AcRunner, DfaBank
 
-jax.config.update("jax_enable_x64", True)
-
-from log_parser_tpu.ops.encode import encode_lines  # noqa: E402
-from log_parser_tpu.ops.match import DfaBank, AcRunner  # noqa: E402
-from log_parser_tpu.ops.scoring import ScoringKernel  # noqa: E402
-
-__all__ = ["AcRunner", "DfaBank", "ScoringKernel", "encode_lines"]
+__all__ = ["AcRunner", "DfaBank", "FusedMatchScore", "encode_lines"]

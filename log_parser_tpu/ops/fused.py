@@ -150,6 +150,92 @@ def _prefix(x: jax.Array) -> jax.Array:
     )
 
 
+def sequence_flags_from_events(
+    sequences, t: "FusedStaticTables", em: jax.Array, idx: jax.Array, n_lines
+) -> jax.Array:
+    """[len(idx), n_sequences] bool — sequence fully matched with the primary
+    at each ``idx`` row of the (global) event-match matrix ``em`` [B, E]
+    (ScoringService.java:230-305): last event within ±5 of the primary via a
+    prefix-count range-any (:272-286), earlier events chained strictly
+    backwards via inclusive prefix-cummax of last-hit line; the chain
+    restarts at the *primary* line, not the near-window hit (:250).
+
+    Shared by the single-device program (em local == global) and the
+    sharded program (em all_gathered, idx = the shard's global rows)."""
+    B = em.shape[0]
+    eidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    prev_incl = jax.lax.cummax(jnp.where(em, eidx, -1), axis=0)  # [B, E]
+    prefix_counts = _prefix(em.astype(jnp.int32))  # [B+1, E]
+
+    w = SEQUENCE_NEAR_WINDOW
+    outs = []
+    for seq in sequences:
+        if not seq.event_columns:
+            outs.append(jnp.zeros(idx.shape, dtype=bool))
+            continue
+        last_e = t.seq_col_pos[seq.event_columns[-1]]
+        lo = jnp.clip(idx - w, 0, B)
+        hi = jnp.clip(jnp.minimum(idx + w + 1, n_lines), 0, B).astype(jnp.int32)
+        ok = (prefix_counts[hi, last_e] - prefix_counts[lo, last_e]) > 0
+        cur = idx
+        for col in reversed(seq.event_columns[:-1]):
+            e = t.seq_col_pos[col]
+            g = jnp.where(cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1)
+            ok = ok & (g >= 0)
+            cur = jnp.clip(g, 0, B - 1)
+        outs.append(ok)
+    return jnp.stack(outs, axis=1)
+
+
+def compact_records(
+    K: int,
+    pm: jax.Array,
+    t: "FusedStaticTables",
+    emit_line: jax.Array,
+    gather_line: jax.Array,
+    sec_dist: jax.Array,
+    seq_ok: jax.Array,
+    ctx_counts: jax.Array,
+):
+    """K-capped record compaction in discovery order (line-major then
+    pattern order — AnalysisService.java:89-113), shared by the
+    single-device and sharded programs.
+
+    ``emit_line``: per-row line index written into the records (global);
+    ``gather_line``: per-row index into the dense factor tables (local).
+    rank = exclusive match count in flat order == the record's output slot;
+    slot K is the trash row for overflow (caller re-runs at a bigger K)."""
+    B, P = pm.shape
+    pm32 = pm.astype(jnp.int32)
+    flat = pm32.reshape(-1)
+    rank = (jnp.cumsum(flat) - flat).reshape(B, P)
+    n_matches = jnp.sum(flat)
+    out_pos = jnp.where(pm & (rank < K), rank, K).reshape(-1)
+
+    emit_bp = jnp.broadcast_to(emit_line[:, None], (B, P)).reshape(-1)
+    gather_bp = jnp.broadcast_to(gather_line[:, None], (B, P)).reshape(-1)
+    pats_bp = jnp.broadcast_to(
+        jnp.arange(P, dtype=jnp.int32)[None, :], (B, P)
+    ).reshape(-1)
+    rec_line = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(emit_bp)[:K]
+    rec_grow = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(gather_bp)[:K]
+    rec_pat = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(pats_bp)[:K]
+
+    sec_idx = jnp.asarray(t.pat_sec)[rec_pat]  # [K, S_max]
+    rec_dist = jnp.where(
+        sec_idx >= 0,
+        sec_dist[rec_grow[:, None], jnp.maximum(sec_idx, 0)],
+        NO_HIT,
+    )
+    q_idx = jnp.asarray(t.pat_seq)[rec_pat]  # [K, Q_max]
+    rec_seq = jnp.where(
+        q_idx >= 0, seq_ok[rec_grow[:, None], jnp.maximum(q_idx, 0)], False
+    )
+    rec_ctx = ctx_counts[rec_grow, jnp.asarray(t.pat_ctx_shape)[rec_pat]]  # [K, 5]
+
+    return n_matches.astype(jnp.int32), rec_line, rec_pat, rec_dist, rec_seq, rec_ctx
+
+
 class FusedMatchScore:
     """Single-device fused program: bytes → DFA cube → integer match records.
 
@@ -252,41 +338,22 @@ class FusedMatchScore:
 
         # ---- dense integer factor components ------------------------------
         sec_dist = self._secondary_distances(cube, row_idx)  # [B, Smax-safe]
-        seq_ok = self._sequence_flags(cube, row_idx, B, n_lines)  # [B, nQ]
+        em = (
+            cube[:, jnp.asarray(t.seq_event_cols, dtype=np.int32)]
+            if bank.sequences
+            else jnp.zeros((B, 1), dtype=bool)
+        )
+        seq_ok = (
+            sequence_flags_from_events(bank.sequences, t, em, row_idx, n_lines)
+            if bank.sequences
+            else jnp.zeros((B, 1), dtype=bool)
+        )
         ctx_counts = self._context_counts(cube, row_idx, B, n_lines)  # [B, U, 5]
 
-        # ---- compaction: K-capped record buffer in discovery order --------
-        # rank = exclusive count of matches before this (line, pattern) in
-        # line-major flat order == the record's output slot
-        pm32 = pm.astype(jnp.int32)
-        flat = pm32.reshape(-1)
-        rank = (jnp.cumsum(flat) - flat).reshape(B, P)
-        n_matches = jnp.sum(flat)
-        out_pos = jnp.where(pm & (rank < K), rank, K).reshape(-1)  # K = trash row
-
-        lines_bp = jnp.broadcast_to(row_idx[:, None], (B, P)).reshape(-1)
-        pats_bp = jnp.broadcast_to(
-            jnp.arange(P, dtype=jnp.int32)[None, :], (B, P)
-        ).reshape(-1)
-        rec_line = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(lines_bp)[:K]
-        rec_pat = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(pats_bp)[:K]
-
-        # ---- per-record gathers from the dense tables ---------------------
-        pat_sec = jnp.asarray(t.pat_sec)  # [P, S_max] entry idx or -1
-        sec_idx = pat_sec[rec_pat]  # [K, S_max]
-        rec_dist = jnp.where(
-            sec_idx >= 0,
-            sec_dist[rec_line[:, None], jnp.maximum(sec_idx, 0)],
-            NO_HIT,
+        # single-device: emit and gather coordinates coincide
+        return compact_records(
+            K, pm, t, row_idx, row_idx, sec_dist, seq_ok, ctx_counts
         )
-        pat_seq = jnp.asarray(t.pat_seq)
-        q_idx = pat_seq[rec_pat]  # [K, Q_max]
-        rec_seq = jnp.where(
-            q_idx >= 0, seq_ok[rec_line[:, None], jnp.maximum(q_idx, 0)], False
-        )
-        rec_ctx = ctx_counts[rec_line, jnp.asarray(t.pat_ctx_shape)[rec_pat]]  # [K, 5]
-
-        return n_matches.astype(jnp.int32), rec_line, rec_pat, rec_dist, rec_seq, rec_ctx
 
     # ------------------------------------------------------------ dense tables
 
@@ -299,40 +366,6 @@ class FusedMatchScore:
             return jnp.full((cube.shape[0], 1), NO_HIT, jnp.int32)
         hits = cube[:, jnp.asarray(t.sec_cols)]  # [B, S_entries]
         return _prev_next_dist(hits, row_idx)
-
-    def _sequence_flags(self, cube, row_idx, B, n_lines):
-        """[B, n_sequences] bool — sequence fully matched ending at each
-        line (ScoringService.java:230-305): last event within ±5 of the
-        primary, earlier events chained strictly backwards via inclusive
-        prefix-cummax of last-hit line; the chain restarts at the *primary*
-        line, not the near-window hit (:250)."""
-        t = self.t
-        n_seq = len(self.bank.sequences)
-        if n_seq == 0:
-            return jnp.zeros((B, 1), dtype=bool)
-        em = cube[:, jnp.asarray(t.seq_event_cols, dtype=np.int32)]  # [B, E]
-        col_idx = row_idx[:, None]
-        prev_incl = jax.lax.cummax(jnp.where(em, col_idx, -1), axis=0)  # [B, E]
-        prefix_counts = _prefix(em.astype(jnp.int32))  # [B+1, E]
-
-        w = SEQUENCE_NEAR_WINDOW
-        outs = []
-        for seq in self.bank.sequences:
-            if not seq.event_columns:
-                outs.append(jnp.zeros((B,), dtype=bool))
-                continue
-            last_e = t.seq_col_pos[seq.event_columns[-1]]
-            lo = jnp.clip(row_idx - w, 0, B)
-            hi = jnp.clip(jnp.minimum(row_idx + w + 1, n_lines), 0, B).astype(jnp.int32)
-            ok = (prefix_counts[hi, last_e] - prefix_counts[lo, last_e]) > 0
-            cur = row_idx
-            for col in reversed(seq.event_columns[:-1]):
-                e = t.seq_col_pos[col]
-                g = jnp.where(cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1)
-                ok = ok & (g >= 0)
-                cur = jnp.clip(g, 0, B - 1)
-            outs.append(ok)
-        return jnp.stack(outs, axis=1)  # [B, n_seq]
 
     def _context_counts(self, cube, row_idx, B, n_lines):
         """[B, U, 5] int32 — per unique context shape: error lines,

@@ -18,6 +18,6 @@ narrowest possible collective (SURVEY.md §5.7-5.8):
 """
 
 from log_parser_tpu.parallel.mesh import make_mesh
-from log_parser_tpu.parallel.sharded import ShardedAnalysisStep, ShardedEngine
+from log_parser_tpu.parallel.sharded import ShardedEngine, ShardedFusedStep
 
-__all__ = ["ShardedAnalysisStep", "ShardedEngine", "make_mesh"]
+__all__ = ["ShardedEngine", "ShardedFusedStep", "make_mesh"]

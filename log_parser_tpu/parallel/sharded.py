@@ -1,52 +1,59 @@
-"""shard_map pipeline: fused match + scoring on a line-sharded batch.
+"""shard_map pipeline: fused match + integer-factor extraction on a
+line-sharded batch.
 
 One jitted SPMD program per library: every shard scans its own lines
 through the DFA bank (zero communication — lines are independent for
-matching, AnalysisService.java:89-113), then computes all seven scoring
-factors with the narrowest collective each one needs:
+matching, AnalysisService.java:89-113), then extracts the integer factor
+components of ops/fused.py with the narrowest collective each one needs:
 
 ==================  =========================================================
-factor              communication
+factor component    communication
 ==================  =========================================================
 chronological       none (global line index is shard offset + local index)
-proximity           ``ppermute`` halo of the secondary-match columns
+secondary dists     ``ppermute`` halo of the secondary-match columns
                     (window ≤ halo), or ``all_gather`` when shards are
                     smaller than the halo
-context             same halo machinery over the four context-flag columns
-temporal            ``all_gather`` of the (few) sequence-event columns —
+context counts      same halo machinery over the four context-flag columns
+sequence flags      ``all_gather`` of the (few) sequence-event columns —
                     the backward scan is unbounded (ScoringService.java:
                     296-305), so each shard keeps the full column and the
                     chain runs as local gathers
-frequency           ``all_gather`` of per-shard slot totals for the
-                    exclusive cross-shard prefix + ``psum`` for the batch
-                    totals recorded into tracker state
+frequency           NONE — line-sharding is contiguous, so concatenating
+                    per-shard record blocks in shard order reproduces global
+                    discovery order, and the host finalizer recovers every
+                    read-before-record prior from the stream itself
 ==================  =========================================================
 
-Everything else is elementwise/local. Halo rows are masked-valid *before*
-exchange, so shard edges and batch padding contribute nothing.
+Each shard compacts its matches into a local K-capped record buffer;
+outputs are per-shard record blocks that the host concatenates (shard-major
+= line-major = discovery order) and feeds to the same exact-f64 finalizer
+as the single-device engine. No float64 — and no floating point at all —
+ever runs on the devices.
+
+Halo rows are masked-valid *before* exchange, so shard edges and batch
+padding contribute nothing.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental.shard_map import shard_map
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from log_parser_tpu.config import ScoringConfig
-from log_parser_tpu.golden.engine import (
-    DENSITY_MIN_LINES,
-    DENSITY_PENALTY,
-    DENSITY_RATIO,
-    SEQUENCE_NEAR_WINDOW,
-    STACK_BONUS_CAP,
-    STACK_WEIGHT,
+from log_parser_tpu.ops.fused import (
+    K_LADDER,
+    NO_HIT,
+    FusedStaticTables,
+    MatchRecords,
+    _prefix,
+    _prev_next_dist,
+    compact_records,
+    sequence_flags_from_events,
 )
 from log_parser_tpu.ops.match import DfaBank
-from log_parser_tpu.ops.scoring import ScoringKernel, _excl_cumsum, f64
 from log_parser_tpu.parallel.mesh import DATA_AXIS
 from log_parser_tpu.patterns.bank import (
     CTX_ERROR,
@@ -70,20 +77,22 @@ def _ring_halo(x: jax.Array, h: int) -> jax.Array:
     return jnp.concatenate([from_left, x, from_right], axis=0)
 
 
-class ShardedAnalysisStep:
-    """The full per-batch device program, shard_mapped over the mesh."""
+class ShardedFusedStep:
+    """The full per-batch SPMD program, shard_mapped over the mesh."""
 
     def __init__(self, bank: PatternBank, config: ScoringConfig, mesh, dfa_bank: DfaBank):
         self.bank = bank
         self.config = config
         self.mesh = mesh
         self.dfa_bank = dfa_bank
-        # reuse the single-device kernel's precomputed static structure
-        self.k = ScoringKernel(bank, config)
+        self.t = FusedStaticTables(bank, config)
         self.n_shards = mesh.devices.size
+        self._dfa_cols = np.asarray(
+            [i for i, c in enumerate(bank.columns) if c.dfa is not None], dtype=np.int32
+        )
 
         # static halo requirement per factor family
-        self.h_prox = int(self.k.sec_window.max()) if len(self.k.sec_window) else 0
+        self.h_prox = int(self.t.sec_window.max()) if len(self.t.sec_window) else 0
         has_rules = bank.has_context_rules
         self.h_ctx = int(
             max(
@@ -92,23 +101,31 @@ class ShardedAnalysisStep:
             )
         ) if bank.n_patterns else 0
 
-        spec_rows = P(DATA_AXIS)
-        self._fn = jax.jit(
-            shard_map(
-                self._step,
-                mesh=mesh,
-                in_specs=(
-                    P(None, DATA_AXIS),  # lines [T, B]
-                    spec_rows,  # lengths [B]
-                    P(DATA_AXIS, None),  # override_mask [B, C]
-                    P(DATA_AXIS, None),  # override_val [B, C]
-                    P(),  # n_lines
-                    P(),  # freq_base
-                    P(),  # freq_exists
-                ),
-                out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
-                check_rep=False,
-            )
+        self._jit = jax.jit(
+            lambda kl, lines, lens, om, ov, n: self._sharded(kl)(lines, lens, om, ov, n),
+            static_argnums=(0,),
+        )
+
+    def _sharded(self, k_local: int):
+        return shard_map(
+            lambda lines, lens, om, ov, n: self._step(k_local, lines, lens, om, ov, n),
+            mesh=self.mesh,
+            in_specs=(
+                P(None, DATA_AXIS),  # lines [T, B]
+                P(DATA_AXIS),  # lengths [B]
+                P(DATA_AXIS, None),  # override_mask [B, C]
+                P(DATA_AXIS, None),  # override_val [B, C]
+                P(),  # n_lines
+            ),
+            out_specs=(
+                P(DATA_AXIS),  # n_matches per shard [D]
+                P(DATA_AXIS),  # rec line (global) [D*K_l]
+                P(DATA_AXIS),  # rec pattern [D*K_l]
+                P(DATA_AXIS, None),  # rec sec dists [D*K_l, S_max]
+                P(DATA_AXIS, None),  # rec seq flags [D*K_l, Q_max]
+                P(DATA_AXIS, None),  # rec ctx counts [D*K_l, 5]
+            ),
+            check_rep=False,
         )
 
     # ------------------------------------------------------------- host API
@@ -120,26 +137,54 @@ class ShardedAnalysisStep:
         override_mask: np.ndarray,
         override_val: np.ndarray,
         n_lines: int,
-        freq_base: np.ndarray,
-        freq_exists: np.ndarray,
-    ):
-        scores, pm, counts = self._fn(
-            jnp.asarray(lines_u8.T),
-            jnp.asarray(lengths),
-            jnp.asarray(override_mask),
-            jnp.asarray(override_val),
-            jnp.asarray(n_lines),
-            jnp.asarray(freq_base),
-            jnp.asarray(freq_exists),
+        k_hint: int = 0,
+    ) -> MatchRecords:
+        """Runs the SPMD step, growing per-shard record buffers until every
+        shard's matches fit; returns globally-ordered match records."""
+        B = lines_u8.shape[0]
+        D = self.n_shards
+        cap_local = (B // D) * max(1, self.bank.n_patterns)
+        lines_tb = jnp.asarray(lines_u8.T)
+        lens = jnp.asarray(lengths)
+        om = jnp.asarray(override_mask)
+        ov = jnp.asarray(override_val)
+        n = jnp.asarray(n_lines, dtype=jnp.int32)
+
+        start = 0
+        per_shard_hint = -(-max(1, k_hint) // D)
+        while start < len(K_LADDER) - 1 and K_LADDER[start] < per_shard_hint:
+            start += 1
+        for k_bucket in (*K_LADDER[start:], cap_local):
+            k_l = min(k_bucket, cap_local)
+            out = self._jit(k_l, lines_tb, lens, om, ov, n)
+            n_per_shard = np.asarray(out[0])
+            if n_per_shard.max(initial=0) <= k_l or k_l >= cap_local:
+                return self._assemble(k_l, n_per_shard, out)
+        raise AssertionError("unreachable: ladder capped at per-shard B*P")
+
+    def _assemble(self, k_l: int, n_per_shard: np.ndarray, out) -> MatchRecords:
+        """Concatenate each shard's live records; shard-major order is
+        line-major order because line sharding is contiguous."""
+        D = self.n_shards
+        line = np.asarray(out[1]).reshape(D, k_l)
+        pat = np.asarray(out[2]).reshape(D, k_l)
+        dist = np.asarray(out[3]).reshape(D, k_l, -1)
+        seq = np.asarray(out[4]).reshape(D, k_l, -1)
+        ctx = np.asarray(out[5]).reshape(D, k_l, -1)
+        keep = [np.arange(min(int(n), k_l)) for n in n_per_shard]
+        return MatchRecords(
+            n_matches=int(sum(len(k) for k in keep)),
+            line=np.concatenate([line[d, k] for d, k in enumerate(keep)] or [line[0, :0]]),
+            pattern=np.concatenate([pat[d, k] for d, k in enumerate(keep)] or [pat[0, :0]]),
+            sec_dist=np.concatenate([dist[d, k] for d, k in enumerate(keep)] or [dist[0, :0]]),
+            seq_ok=np.concatenate([seq[d, k] for d, k in enumerate(keep)] or [seq[0, :0]]),
+            ctx_counts=np.concatenate([ctx[d, k] for d, k in enumerate(keep)] or [ctx[0, :0]]),
         )
-        return np.asarray(scores), np.asarray(pm), np.asarray(counts)
 
     # ------------------------------------------------------------ the step
 
-    def _step(
-        self, lines_tb, lengths, override_mask, override_val, n_lines, freq_base, freq_exists
-    ):
-        bank, k = self.bank, self.k
+    def _step(self, K, lines_tb, lengths, override_mask, override_val, n_lines):
+        bank, t = self.bank, self.t
         Bl = lengths.shape[0]
         P_ = bank.n_patterns
         d = jax.lax.axis_index(DATA_AXIS)
@@ -148,59 +193,37 @@ class ShardedAnalysisStep:
         valid = gidx < n_lines
 
         # ---- local match (no communication) -------------------------------
-        cube = self._local_match(lines_tb, lengths)
+        cube = jnp.zeros((Bl, bank.n_columns), dtype=bool)
+        if self.dfa_bank.n_regexes:
+            matched = self.dfa_bank._run(lines_tb, lengths)[:, : self.dfa_bank.n_regexes]
+            cube = cube.at[:, jnp.asarray(self._dfa_cols)].set(matched)
         cube = jnp.where(override_mask, override_val, cube)
         cube = cube & valid[:, None]
 
         if P_ == 0:
-            scores = jnp.zeros((Bl, 0), dtype=f64)
-            pm = jnp.zeros((Bl, 0), dtype=bool)
-            counts = jnp.zeros((max(1, bank.n_freq_slots),), dtype=jnp.int64)
-            return scores, pm, counts
-
-        pm = cube[:, jnp.asarray(bank.primary_columns)]
-
-        chrono = self._chronological(gidx, n_lines)
-        prox = self._proximity(cube, lidx, Bl, P_)
-        temp = self._temporal(cube, gidx, n_lines, Bl, P_)
-        ctx = self._context(cube, gidx, lidx, n_lines, Bl)
-        penalty, counts = self._frequency(pm, freq_base, freq_exists, Bl)
-
-        conf = jnp.asarray(bank.confidence)[None, :]
-        sev = jnp.asarray(bank.severity_multiplier)[None, :]
-        scores = conf * sev * chrono[:, None] * prox * temp * ctx * (1.0 - penalty)
-        scores = jnp.where(pm, scores, 0.0)
-        return scores, pm, counts
-
-    # ----------------------------------------------------------- local match
-
-    def _local_match(self, lines_tb, lengths):
-        Bl = lengths.shape[0]
-        C = self.bank.n_columns
-        cube = jnp.zeros((Bl, C), dtype=bool)
-        if self.dfa_bank.n_regexes:
-            matched = self.dfa_bank._run(lines_tb, lengths)[:, : self.dfa_bank.n_regexes]
-            dfa_cols = jnp.asarray(
-                [i for i, c in enumerate(self.bank.columns) if c.dfa is not None],
-                dtype=np.int32,
+            z32 = jnp.zeros((K,), jnp.int32)
+            return (
+                jnp.zeros((1,), jnp.int32),
+                z32,
+                z32,
+                jnp.full((K, max(1, t.s_max)), NO_HIT, jnp.int32),
+                jnp.zeros((K, max(1, t.q_max)), bool),
+                jnp.zeros((K, 5), jnp.int32),
             )
-            cube = cube.at[:, dfa_cols].set(matched)
-        return cube
 
-    # -------------------------------------------------------------- factors
+        pm = cube[:, jnp.asarray(bank.primary_columns)]  # [Bl, P]
 
-    def _chronological(self, gidx, n_lines):
-        pos = gidx.astype(f64) / n_lines.astype(f64)
-        early, penalty = self.k.chrono_early, self.k.chrono_penalty
-        return jnp.where(
-            pos <= early,
-            1.5 + (early - pos) * self.k.chrono_bonus_quot,
-            jnp.where(
-                pos <= penalty,
-                1.0 + (penalty - pos) * self.k.chrono_middle_quot,
-                0.5 + (1.0 - pos),
-            ),
+        sec_dist = self._secondary_distances(cube, lidx, Bl)
+        seq_ok = self._sequence_flags(cube, gidx, Bl, n_lines)
+        ctx_counts = self._context_counts(cube, gidx, lidx, Bl, n_lines)
+
+        # per-shard compaction: emit global line indexes, gather local rows
+        n_matches, rec_gline, rec_pat, rec_dist, rec_seq, rec_ctx = compact_records(
+            K, pm, t, gidx, lidx, sec_dist, seq_ok, ctx_counts
         )
+        return n_matches[None], rec_gline, rec_pat, rec_dist, rec_seq, rec_ctx
+
+    # ---------------------------------------------------------- factor parts
 
     def _extend(self, cols: jax.Array, h: int, Bl: int):
         """Neighborhood view of sharded columns: (extended array, offset of
@@ -212,183 +235,70 @@ class ShardedAnalysisStep:
         d = jax.lax.axis_index(DATA_AXIS)
         return gathered, d * Bl  # offset is traced
 
-    def _proximity(self, cube, lidx, Bl, P_):
-        k = self.k
-        if len(k.sec_cols) == 0:
-            return jnp.ones((Bl, P_), dtype=f64)
-        sm = cube[:, jnp.asarray(k.sec_cols)]
+    def _secondary_distances(self, cube, lidx, Bl):
+        """[Bl, n_sec_entries] int32 nearest-hit distance per local line.
+        Exact for every in-window hit: any hit within window ≤ h is inside
+        the extended view; farther hits report NO_HIT, which the finalizer
+        treats identically to out-of-window (ScoringService.java:315-347)."""
+        t = self.t
+        if len(t.sec_cols) == 0:
+            return jnp.full((Bl, 1), NO_HIT, jnp.int32)
+        sm = cube[:, jnp.asarray(t.sec_cols)]  # [Bl, S]
         h = max(1, self.h_prox)
         ext, off = self._extend(sm, h, Bl)
-        ext_len = ext.shape[0]
-        eidx = jnp.arange(ext_len, dtype=jnp.int32)[:, None]
-        big = jnp.int32(1 << 30)
+        mine = off + lidx  # my rows in ext coordinates
+        return _prev_next_dist(ext, jnp.arange(ext.shape[0], dtype=jnp.int32))[mine]
 
-        prev_incl = jax.lax.cummax(jnp.where(ext, eidx, -1), axis=0)
-        prev = jnp.concatenate(
-            [jnp.full((1, ext.shape[1]), -1, prev_incl.dtype), prev_incl[:-1]], axis=0
-        )
-        nxt_incl = jnp.flip(
-            jax.lax.cummin(jnp.flip(jnp.where(ext, eidx, big), axis=0), axis=0), axis=0
-        )
-        nxt = jnp.concatenate(
-            [nxt_incl[1:], jnp.full((1, ext.shape[1]), big, nxt_incl.dtype)], axis=0
-        )
-        mine = off + lidx  # positions of my rows in ext coordinates
-        my_prev = prev[mine]
-        my_nxt = nxt[mine]
-        pos = mine[:, None]
-        d_prev = jnp.where(my_prev >= 0, pos - my_prev, big)
-        d_next = jnp.where(my_nxt < big, my_nxt - pos, big)
-        dist = jnp.minimum(d_prev, d_next)
-        window = jnp.asarray(k.sec_window)[None, :]
-        found = dist <= window
-        decay = jnp.exp(-dist.astype(f64) / self.config.proximity_decay_constant)
-        contrib = jnp.where(found, jnp.asarray(k.sec_weight)[None, :] * decay, 0.0)
-        prox = jnp.ones((Bl, P_), dtype=f64)
-        return prox.at[:, jnp.asarray(k.sec_owner)].add(contrib)
-
-    def _temporal(self, cube, gidx, n_lines, Bl, P_):
-        k = self.k
-        temp = jnp.ones((Bl, P_), dtype=f64)
-        if not k.sequences:
-            return temp
-        em_local = cube[:, jnp.asarray(k.seq_event_cols, dtype=np.int32)]
+    def _sequence_flags(self, cube, gidx, Bl, n_lines):
+        """[Bl, n_sequences] — the backward chain reads arbitrarily far back
+        (ScoringService.java:296-305), so the event columns are all_gathered
+        and the shared chain logic runs in global coordinates for local rows."""
+        t = self.t
+        if not self.bank.sequences:
+            return jnp.zeros((Bl, 1), dtype=bool)
+        em_local = cube[:, jnp.asarray(t.seq_event_cols, dtype=np.int32)]  # [Bl, E]
         em = jax.lax.all_gather(em_local, DATA_AXIS, axis=0, tiled=True)  # [B, E]
-        B = em.shape[0]
-        eidx = jnp.arange(B, dtype=jnp.int32)[:, None]
-        prev_incl = jax.lax.cummax(jnp.where(em, eidx, -1), axis=0)
-        prefix = jnp.concatenate(
-            [jnp.zeros((1, em.shape[1]), jnp.int32), jnp.cumsum(em.astype(jnp.int32), axis=0)]
-        )
-        w = SEQUENCE_NEAR_WINDOW
-        for seq in k.sequences:
-            if not seq.event_columns:
-                continue
-            e_last = k.seq_col_pos[seq.event_columns[-1]]
-            lo = jnp.clip(gidx - w, 0, B)
-            hi = jnp.clip(jnp.minimum(gidx + w + 1, n_lines), 0, B).astype(jnp.int32)
-            near = (prefix[hi, e_last] - prefix[lo, e_last]) > 0
-            ok = near
-            cur = gidx
-            for col in reversed(seq.event_columns[:-1]):
-                e = k.seq_col_pos[col]
-                g = jnp.where(cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1)
-                ok = ok & (g >= 0)
-                cur = jnp.clip(g, 0, B - 1)
-            temp = temp.at[:, seq.pattern_idx].add(jnp.where(ok, seq.bonus, 0.0))
-        return temp
+        return sequence_flags_from_events(self.bank.sequences, t, em, gidx, n_lines)
 
-    def _context(self, cube, gidx, lidx, n_lines, Bl):
-        k = self.k
-        if not k.ctx_shapes:
-            return jnp.ones((Bl, 0), dtype=f64)
+    def _context_counts(self, cube, gidx, lidx, Bl, n_lines):
+        """[Bl, U, 5] int32 per unique context shape, window sums via
+        halo-extended prefix sums with the global clamps of
+        AnalysisService.java:142,148 expressed on the global index."""
+        t = self.t
         err = cube[:, CTX_ERROR]
         warn = cube[:, CTX_WARN] & ~err
         stack = cube[:, CTX_STACK]
         exc = cube[:, CTX_EXCEPTION]
-        from log_parser_tpu.golden.engine import (
-            ERROR_WEIGHT,
-            EXCEPTION_WEIGHT,
-            WARN_WEIGHT,
-        )
+        flags = jnp.stack([err, warn, stack, exc], axis=1).astype(jnp.int32)  # [Bl, 4]
 
-        line_score = (
-            ERROR_WEIGHT * err.astype(f64)
-            + WARN_WEIGHT * warn.astype(f64)
-            + STACK_WEIGHT * stack.astype(f64)
-            + EXCEPTION_WEIGHT * exc.astype(f64)
-        )
         h = max(1, self.h_ctx)
-        flags = jnp.stack(
-            [line_score, stack.astype(f64), err.astype(f64)], axis=1
-        )  # [Bl, 3]
         ext, off = self._extend(flags, h, Bl)
-        prefix = jnp.concatenate(
-            [jnp.zeros((1, 3), dtype=f64), jnp.cumsum(ext, axis=0)], axis=0
-        )
+        ps = _prefix(ext)  # [ext+1, 4]
         ext_len = ext.shape[0]
         mine = off + lidx
 
-        cols = []
-        for has_rules, before, after in k.ctx_shapes:
+        per_shape = []
+        for has_rules, before, after in t.ctx_shapes:
             if not has_rules:
-                w_score = line_score
-                w_stack = stack.astype(jnp.int32)
-                w_err = err.astype(jnp.int32)
-                total = jnp.ones_like(lidx)
+                counts = flags
+                total = jnp.ones((Bl,), jnp.int32)
             else:
-                # global clamps (AnalysisService.java:142,148) expressed on
-                # the global index; ext rows outside them are zero-masked
                 lo_g = jnp.maximum(gidx - before, 0)
                 hi_g = jnp.minimum(gidx + 1 + after, n_lines).astype(jnp.int32)
                 hi_g = jnp.maximum(hi_g, lo_g)
                 total = hi_g - lo_g
                 lo_e = jnp.clip(mine - (gidx - lo_g), 0, ext_len)
                 hi_e = jnp.clip(mine + (hi_g - gidx), 0, ext_len)
-                win = prefix[hi_e] - prefix[lo_e]  # [Bl, 3]
-                w_score = win[:, 0]
-                w_stack = win[:, 1].astype(jnp.int32)
-                w_err = win[:, 2].astype(jnp.int32)
-            score = w_score + jnp.where(
-                w_stack > 0,
-                jnp.minimum(STACK_WEIGHT * w_stack.astype(f64), STACK_BONUS_CAP),
-                0.0,
-            )
-            dense = (total > DENSITY_MIN_LINES) & (
-                (w_stack + w_err).astype(f64) > total.astype(f64) * DENSITY_RATIO
-            )
-            score = jnp.where(dense, score * DENSITY_PENALTY, score)
-            cols.append(jnp.minimum(1.0 + score, self.config.context_max_context_factor))
-        ctx_u = jnp.stack(cols, axis=1)
-        return ctx_u[:, jnp.asarray(k.pattern_ctx_shape)]
-
-    def _frequency(self, pm, freq_base, freq_exists, Bl):
-        bank, k = self.bank, self.k
-        n_slots = max(1, bank.n_freq_slots)
-        pm_i = pm.astype(jnp.int64)
-        slot_ok = jnp.asarray(bank.freq_slot >= 0)
-        safe_slot = jnp.asarray(np.maximum(bank.freq_slot, 0))
-
-        line_slot = jnp.zeros((Bl, n_slots), dtype=jnp.int64)
-        line_slot = line_slot.at[:, safe_slot].add(jnp.where(slot_ok[None, :], pm_i, 0))
-        local_before = _excl_cumsum(line_slot, axis=0)
-        local_total = jnp.sum(line_slot, axis=0)  # [n_slots]
-
-        # exclusive cross-shard prefix of slot totals
-        d = jax.lax.axis_index(DATA_AXIS)
-        all_totals = jax.lax.all_gather(local_total, DATA_AXIS, axis=0)  # [D, n_slots]
-        shard_mask = (jnp.arange(all_totals.shape[0]) < d)[:, None]
-        carry = jnp.sum(jnp.where(shard_mask, all_totals, 0), axis=0)  # [n_slots]
-
-        before_line = carry[None, :] + local_before
-        prior = before_line[:, safe_slot]
-        for slot, members in k.shared_slots.items():
-            sub = pm_i[:, jnp.asarray(members, dtype=np.int32)]
-            corr = _excl_cumsum(sub, axis=1)
-            for j, p_idx in enumerate(members):
-                prior = prior.at[:, p_idx].add(corr[:, j])
-
-        if k.freq_hours == 0.0:  # zero window: every record expires instantly
-            count_before = jnp.zeros_like(prior, dtype=f64)
-        else:
-            count_before = freq_base[safe_slot][None, :] + prior.astype(f64)
-        rate = count_before / k.freq_hours
-        thr = float(self.config.frequency_threshold)
-        raw = jnp.minimum(float(self.config.frequency_max_penalty), (rate - thr) / thr)
-        penalty = jnp.where(rate <= thr, 0.0, raw)
-        never_tracked = (~freq_exists[safe_slot])[None, :] & (prior == 0)
-        penalty = jnp.where(never_tracked, 0.0, penalty)
-        penalty = jnp.where(slot_ok[None, :], penalty, 0.0)
-
-        counts = jax.lax.psum(local_total, DATA_AXIS)
-        return penalty, counts
+                counts = ps[hi_e] - ps[lo_e]  # [Bl, 4]
+            per_shape.append(jnp.concatenate([counts, total[:, None]], axis=1))
+        return jnp.stack(per_shape, axis=1)  # [Bl, U, 5]
 
 
 class ShardedEngine:
-    """AnalysisEngine variant running the fused match+score step under
-    shard_map. Host-side responsibilities (split/encode, host verification,
-    frequency tracker, result assembly) are shared with the single-device
-    engine via delegation."""
+    """AnalysisEngine variant running the fused match+extract step under
+    shard_map. Host-side responsibilities (ingest, host verification,
+    frequency tracker, exact-f64 finalization, result assembly) are shared
+    with the single-device engine via delegation."""
 
     def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
         import time as _time
@@ -403,9 +313,10 @@ class ShardedEngine:
 
             mesh = make_mesh()
         self.mesh = mesh
-        self.step = ShardedAnalysisStep(
+        self.step = ShardedFusedStep(
             self._base.bank, self._base.config, mesh, self._base.dfa_bank
         )
+        self._k_hint = 0
 
     @property
     def bank(self):
@@ -436,11 +347,11 @@ class ShardedEngine:
         )
         from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
         from log_parser_tpu.native.ingest import Corpus
+        from log_parser_tpu.runtime.finalize import finalize_batch
 
         base = self._base
         start = _time.monotonic()
         corpus = Corpus(data.logs or "", min_rows=max(8, self.mesh.devices.size))
-        lines = corpus
         enc = corpus.encoded
         B = enc.u8.shape[0]
         C = base.bank.n_columns
@@ -453,35 +364,42 @@ class ShardedEngine:
         else:
             override_mask, override_val = overrides
 
+        recs = self.step(
+            enc.u8, enc.lengths, override_mask, override_val, corpus.n_lines,
+            k_hint=self._k_hint,
+        )
+        self._k_hint = recs.n_matches
+
         freq_base = _np.zeros(max(1, base.bank.n_freq_slots), dtype=_np.float64)
         freq_exists = _np.zeros(max(1, base.bank.n_freq_slots), dtype=bool)
         for slot, pid in enumerate(base.bank.freq_ids):
             freq_base[slot] = base.frequency.get_windowed_count(pid)
             freq_exists[slot] = base.frequency.has_entry(pid)
 
-        scores, pm, counts = self.step(
-            enc.u8, enc.lengths, override_mask, override_val, len(lines),
+        fin = finalize_batch(
+            base.bank, self.step.t, base.config, recs, corpus.n_lines,
             freq_base, freq_exists,
         )
 
-        for slot in range(base.bank.n_freq_slots):
-            for _ in range(int(counts[slot])):
+        for slot, count in enumerate(fin.slot_batch_counts[: base.bank.n_freq_slots]):
+            for _ in range(int(count)):
                 base.frequency.record_pattern_match(base.bank.freq_ids[slot])
 
         events: list[MatchedEvent] = []
-        for line_idx, p_idx in _np.argwhere(pm):
-            pattern = base.bank.patterns[p_idx]
+        for i in range(len(fin.scores)):
+            line_idx = int(fin.line[i])
+            pattern = base.bank.patterns[int(fin.pattern[i])]
             events.append(
                 MatchedEvent(
-                    line_number=int(line_idx) + 1,
+                    line_number=line_idx + 1,
                     matched_pattern=pattern,
-                    context=extract_context(lines, int(line_idx), pattern),
-                    score=float(scores[line_idx, p_idx]),
+                    context=extract_context(corpus, line_idx, pattern),
+                    score=float(fin.scores[i]),
                 )
             )
         return AnalysisResult(
             events=events,
             analysis_id=str(_uuid.uuid4()),
-            metadata=build_metadata(start, len(lines), base.bank.pattern_sets),
+            metadata=build_metadata(start, corpus.n_lines, base.bank.pattern_sets),
             summary=build_summary(events),
         )
