@@ -1,0 +1,106 @@
+"""Streaming benchmark: continuous ``/parse`` micro-batches, p50/p99 latency.
+
+Implements BASELINE.md config 5. The reference publishes no latency numbers
+(BASELINE.md — `README.md` and docs contain none), so the target is
+"establish". Default drives the engine directly; ``--http`` exercises the
+full REST stack on a local server for end-to-end request latency.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": p50_ms}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BATCH_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 512
+REQUESTS = int(sys.argv[sys.argv.index("--requests") + 1]) if "--requests" in sys.argv else 60
+USE_HTTP = "--http" in sys.argv
+
+
+def micro_batch(i: int, n: int) -> str:
+    rows = []
+    for j in range(n):
+        m = (i * 131 + j) % 97
+        if m == 11:
+            rows.append("java.lang.OutOfMemoryError: Java heap space")
+        elif m == 13:
+            rows.append("dial tcp 10.0.0.7:5432: Connection refused")
+        elif m == 17:
+            rows.append("ERROR request failed with IllegalStateException")
+        else:
+            rows.append(f"INFO tick {i}.{j} status=ok")
+    return "\n".join(rows)
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def main() -> None:
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+
+    if USE_HTTP:
+        import threading
+        import urllib.request
+
+        from log_parser_tpu.serve.http import make_server
+
+        server = make_server(engine, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+
+        def run_one(i: int) -> None:
+            body = json.dumps(
+                {"pod": {"metadata": {"name": "stream"}},
+                 "logs": micro_batch(i, BATCH_LINES)}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/parse", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                json.load(resp)
+    else:
+        def run_one(i: int) -> None:
+            engine.analyze(
+                PodFailureData(
+                    pod={"metadata": {"name": "stream"}},
+                    logs=micro_batch(i, BATCH_LINES),
+                )
+            )
+
+    for i in range(3):  # warmup: compile every shape bucket the stream hits
+        run_one(i)
+
+    lat = []
+    for i in range(REQUESTS):
+        t0 = time.perf_counter()
+        run_one(i)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
+                + ("_http" if USE_HTTP else ""),
+                "value": round(percentile(lat, 0.99), 3),
+                "unit": "ms",
+                "vs_baseline": round(percentile(lat, 0.50), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,7 @@ from log_parser_tpu.patterns.bank import (
     CTX_WARN,
     PatternBank,
 )
+from log_parser_tpu.runtime.engine import AnalysisEngine
 
 
 def _ring_halo(x: jax.Array, h: int) -> jax.Array:
@@ -294,112 +295,34 @@ class ShardedFusedStep:
         return jnp.stack(per_shape, axis=1)  # [Bl, U, 5]
 
 
-class ShardedEngine:
-    """AnalysisEngine variant running the fused match+extract step under
-    shard_map. Host-side responsibilities (ingest, host verification,
-    frequency tracker, exact-f64 finalization, result assembly) are shared
-    with the single-device engine via delegation."""
+class ShardedEngine(AnalysisEngine):
+    """AnalysisEngine whose device step is the shard_map program: the line
+    batch is sharded over the mesh, and every other responsibility (ingest,
+    host verification, frequency tracking, exact-f64 finalization, result
+    assembly, observability) is the inherited shared pipeline."""
 
     def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
         import time as _time
 
-        from log_parser_tpu.runtime.engine import AnalysisEngine
-
-        self._base = AnalysisEngine(
-            pattern_sets, config, clock=clock or _time.monotonic
-        )
+        super().__init__(pattern_sets, config, clock=clock or _time.monotonic)
         if mesh is None:
             from log_parser_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh()
         self.mesh = mesh
-        self.step = ShardedFusedStep(
-            self._base.bank, self._base.config, mesh, self._base.dfa_bank
-        )
-        self._k_hint = 0
+        self.step = ShardedFusedStep(self.bank, self.config, mesh, self.dfa_bank)
+        self.tables = self.step.t
 
-    @property
-    def bank(self):
-        return self._base.bank
+    def _corpus_min_rows(self) -> int:
+        # row padding must be divisible by the mesh size for shard_map
+        return max(8, self.mesh.devices.size)
 
-    @property
-    def frequency(self):
-        return self._base.frequency
-
-    @property
-    def config(self):
-        return self._base.config
-
-    @property
-    def skipped_patterns(self):
-        return self._base.bank.skipped_patterns
-
-    def analyze(self, data):
-        import time as _time
-        import uuid as _uuid
-
-        import numpy as _np
-
-        from log_parser_tpu.golden.engine import (
-            build_metadata,
-            build_summary,
-            extract_context,
-        )
-        from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
-        from log_parser_tpu.native.ingest import Corpus
-        from log_parser_tpu.runtime.finalize import finalize_batch
-
-        base = self._base
-        start = _time.monotonic()
-        corpus = Corpus(data.logs or "", min_rows=max(8, self.mesh.devices.size))
-        enc = corpus.encoded
+    def _run_device(self, enc, n_lines: int, om, ov):
         B = enc.u8.shape[0]
-        C = base.bank.n_columns
-
-        # shared override construction (host columns + device-inexact lines)
-        overrides = base._overrides(corpus)
-        if overrides is None:
-            override_mask = _np.zeros((B, C), dtype=bool)
-            override_val = _np.zeros((B, C), dtype=bool)
-        else:
-            override_mask, override_val = overrides
-
-        recs = self.step(
-            enc.u8, enc.lengths, override_mask, override_val, corpus.n_lines,
-            k_hint=self._k_hint,
-        )
-        self._k_hint = recs.n_matches
-
-        freq_base = _np.zeros(max(1, base.bank.n_freq_slots), dtype=_np.float64)
-        freq_exists = _np.zeros(max(1, base.bank.n_freq_slots), dtype=bool)
-        for slot, pid in enumerate(base.bank.freq_ids):
-            freq_base[slot] = base.frequency.get_windowed_count(pid)
-            freq_exists[slot] = base.frequency.has_entry(pid)
-
-        fin = finalize_batch(
-            base.bank, self.step.t, base.config, recs, corpus.n_lines,
-            freq_base, freq_exists,
-        )
-
-        for slot, count in enumerate(fin.slot_batch_counts[: base.bank.n_freq_slots]):
-            for _ in range(int(count)):
-                base.frequency.record_pattern_match(base.bank.freq_ids[slot])
-
-        events: list[MatchedEvent] = []
-        for i in range(len(fin.scores)):
-            line_idx = int(fin.line[i])
-            pattern = base.bank.patterns[int(fin.pattern[i])]
-            events.append(
-                MatchedEvent(
-                    line_number=line_idx + 1,
-                    matched_pattern=pattern,
-                    context=extract_context(corpus, line_idx, pattern),
-                    score=float(fin.scores[i]),
-                )
-            )
-        return AnalysisResult(
-            events=events,
-            analysis_id=str(_uuid.uuid4()),
-            metadata=build_metadata(start, corpus.n_lines, base.bank.pattern_sets),
-            summary=build_summary(events),
+        C = self.bank.n_columns
+        if om is None:  # the SPMD program's in_specs always take overrides
+            om = np.zeros((B, C), dtype=bool)
+            ov = np.zeros((B, C), dtype=bool)
+        return self.step(
+            enc.u8, enc.lengths, om, ov, n_lines, k_hint=self._k_hint
         )

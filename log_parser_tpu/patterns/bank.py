@@ -34,10 +34,10 @@ from log_parser_tpu.patterns.regex import (
     CompiledDfa,
     DfaLimitError,
     RegexUnsupportedError,
-    compile_regex_to_dfa,
     extract_literals,
     parse_java_regex,
 )
+from log_parser_tpu.patterns.regex.cache import compile_regex_to_dfa_cached
 from log_parser_tpu.patterns.regex.literals import Literal
 
 log = logging.getLogger(__name__)
@@ -190,7 +190,7 @@ class PatternBank:
         dfa: CompiledDfa | None = None
         literals: frozenset[Literal] | None = None
         try:
-            dfa = compile_regex_to_dfa(regex, case_insensitive)
+            dfa = compile_regex_to_dfa_cached(regex, case_insensitive)
             node = parse_java_regex(regex, case_insensitive)
             literals = extract_literals(node)
         except (RegexUnsupportedError, DfaLimitError) as exc:

@@ -47,7 +47,8 @@ from log_parser_tpu.native.ingest import Corpus
 from log_parser_tpu.ops.fused import FusedMatchScore
 from log_parser_tpu.ops.match import DfaBank
 from log_parser_tpu.patterns.bank import PatternBank
-from log_parser_tpu.runtime.finalize import finalize_batch
+from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
+from log_parser_tpu.utils.trace import PhaseTrace
 
 
 class AnalysisEngine:
@@ -71,7 +72,12 @@ class AnalysisEngine:
         ]
         self.dfa_bank = DfaBank([self.bank.columns[i].dfa for i in self._dfa_cols])
         self.fused = FusedMatchScore(self.bank, self.config, self.dfa_bank)
+        self.tables = self.fused.t  # static per-pattern index tables
         self._k_hint = 0  # previous request's match count → starting K bucket
+        # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
+        # factor breakdown of the most recent request
+        self.last_trace: PhaseTrace | None = None
+        self.last_finalized: FinalizedBatch | None = None
 
     @property
     def skipped_patterns(self) -> list[tuple[str, str]]:
@@ -106,18 +112,32 @@ class AnalysisEngine:
                 val[i, col] = bool(self.bank.columns[col].host.search(line))
         return mask, val
 
+    # ----------------------------------------------------- device-step hooks
+    # ShardedEngine overrides these two to swap in the shard_map program;
+    # everything else in analyze() is shared.
+
+    def _corpus_min_rows(self) -> int:
+        return 8
+
+    def _run_device(self, enc, n_lines: int, om, ov):
+        return self.fused.run(
+            enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
+        )
+
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
         start = time.monotonic()
-        corpus = Corpus(data.logs or "")
-        enc = corpus.encoded
+        trace = PhaseTrace()
+        with trace.phase("ingest"):
+            corpus = Corpus(data.logs or "", min_rows=self._corpus_min_rows())
+            enc = corpus.encoded
 
-        overrides = self._overrides(corpus)
+        with trace.phase("overrides"):
+            overrides = self._overrides(corpus)
         om, ov = overrides if overrides is not None else (None, None)
-        recs = self.fused.run(
-            enc.u8, enc.lengths, corpus.n_lines, om, ov, k_hint=self._k_hint
-        )
+        with trace.phase("device"):
+            recs = self._run_device(enc, corpus.n_lines, om, ov)
         self._k_hint = recs.n_matches
 
         # windowed frequency counts at batch start (pruned by the tracker);
@@ -129,10 +149,11 @@ class AnalysisEngine:
             freq_base[slot] = self.frequency.get_windowed_count(pid)
             freq_exists[slot] = self.frequency.has_entry(pid)
 
-        fin = finalize_batch(
-            self.bank, self.fused.t, self.config, recs, corpus.n_lines,
-            freq_base, freq_exists,
-        )
+        with trace.phase("finalize"):
+            fin = finalize_batch(
+                self.bank, self.tables, self.config, recs, corpus.n_lines,
+                freq_base, freq_exists,
+            )
 
         # record this batch's matches (after the read — ScoringService.java:84-88)
         for slot, count in enumerate(fin.slot_batch_counts[: self.bank.n_freq_slots]):
@@ -140,22 +161,26 @@ class AnalysisEngine:
                 self.frequency.record_pattern_match(self.bank.freq_ids[slot])
 
         # records are already in discovery order (line-major, then pattern)
-        events: list[MatchedEvent] = []
-        for i in range(len(fin.scores)):
-            line_idx = int(fin.line[i])
-            pattern = self.bank.patterns[int(fin.pattern[i])]
-            events.append(
-                MatchedEvent(
-                    line_number=line_idx + 1,
-                    matched_pattern=pattern,
-                    context=extract_context(corpus, line_idx, pattern),
-                    score=float(fin.scores[i]),
+        with trace.phase("assemble"):
+            events: list[MatchedEvent] = []
+            for i in range(len(fin.scores)):
+                line_idx = int(fin.line[i])
+                pattern = self.bank.patterns[int(fin.pattern[i])]
+                events.append(
+                    MatchedEvent(
+                        line_number=line_idx + 1,
+                        matched_pattern=pattern,
+                        context=extract_context(corpus, line_idx, pattern),
+                        score=float(fin.scores[i]),
+                    )
                 )
-            )
 
-        return AnalysisResult(
-            events=events,
-            analysis_id=str(uuid.uuid4()),
-            metadata=build_metadata(start, corpus.n_lines, self.bank.pattern_sets),
-            summary=build_summary(events),
-        )
+            result = AnalysisResult(
+                events=events,
+                analysis_id=str(uuid.uuid4()),
+                metadata=build_metadata(start, corpus.n_lines, self.bank.pattern_sets),
+                summary=build_summary(events),
+            )
+        self.last_trace = trace
+        self.last_finalized = fin
+        return result

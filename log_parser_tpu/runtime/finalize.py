@@ -40,12 +40,43 @@ from log_parser_tpu.patterns.bank import PatternBank
 
 @dataclasses.dataclass
 class FinalizedBatch:
-    """Scores per match record (discovery order) + frequency bookkeeping."""
+    """Scores per match record (discovery order) + frequency bookkeeping.
+
+    The per-factor arrays are the parity-debugging surface (SURVEY.md §5.5):
+    every component of every score, in the exact f64 values that were
+    multiplied — the structured replacement for the reference's per-factor
+    debug logs (ScoringService.java:90-99)."""
 
     scores: np.ndarray  # float64 [M]
     line: np.ndarray  # int32 [M] 0-based
     pattern: np.ndarray  # int32 [M]
     slot_batch_counts: np.ndarray  # int64 [n_freq_slots]
+    chronological: np.ndarray  # float64 [M]
+    proximity: np.ndarray  # float64 [M]
+    temporal: np.ndarray  # float64 [M]
+    context: np.ndarray  # float64 [M]
+    frequency_penalty: np.ndarray  # float64 [M]
+
+    def factor_rows(self, bank) -> list[dict]:
+        """One dict per match, JSON-ready; the product of the seven factor
+        fields reproduces ``score`` exactly."""
+        return [
+            {
+                "lineNumber": int(self.line[i]) + 1,
+                "patternId": bank.patterns[int(self.pattern[i])].id,
+                "confidence": float(bank.confidence[int(self.pattern[i])]),
+                "severityMultiplier": float(
+                    bank.severity_multiplier[int(self.pattern[i])]
+                ),
+                "chronological": float(self.chronological[i]),
+                "proximity": float(self.proximity[i]),
+                "temporal": float(self.temporal[i]),
+                "context": float(self.context[i]),
+                "frequencyPenalty": float(self.frequency_penalty[i]),
+                "score": float(self.scores[i]),
+            }
+            for i in range(len(self.scores))
+        ]
 
 
 def _slot_cumcount(slots: np.ndarray) -> np.ndarray:
@@ -83,11 +114,14 @@ def finalize_batch(
     pat = recs.pattern[:m].astype(np.int64)
 
     if m == 0:
+        z = np.zeros(0, dtype=np.float64)
         return FinalizedBatch(
-            scores=np.zeros(0, dtype=np.float64),
+            scores=z,
             line=recs.line[:0],
             pattern=recs.pattern[:0],
             slot_batch_counts=np.zeros(max(1, bank.n_freq_slots), dtype=np.int64),
+            chronological=z, proximity=z, temporal=z, context=z,
+            frequency_penalty=z,
         )
 
     conf = bank.confidence[pat]
@@ -190,4 +224,9 @@ def finalize_batch(
         line=recs.line[:m],
         pattern=recs.pattern[:m],
         slot_batch_counts=slot_batch_counts,
+        chronological=chrono,
+        proximity=prox,
+        temporal=temp,
+        context=ctx,
+        frequency_penalty=np.asarray(penalty, dtype=np.float64),
     )

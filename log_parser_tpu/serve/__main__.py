@@ -27,6 +27,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--sharded",
+        action="store_true",
+        help="shard the line batch over every visible device (jax mesh)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -47,7 +52,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     pattern_sets = load_pattern_directory(config.pattern_directory)
-    engine = AnalysisEngine(pattern_sets, config)
+    if args.sharded:
+        from log_parser_tpu.parallel import ShardedEngine, make_mesh
+
+        mesh = make_mesh()
+        engine = ShardedEngine(pattern_sets, config, mesh=mesh)
+        log.info("Sharding line batches over %d devices", mesh.devices.size)
+    else:
+        engine = AnalysisEngine(pattern_sets, config)
     if engine.skipped_patterns:
         for pid, reason in engine.skipped_patterns:
             log.warning("pattern %r disabled: %s", pid, reason)

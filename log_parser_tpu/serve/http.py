@@ -82,6 +82,17 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/frequency/stats":
             stats = self.server.engine.frequency.get_frequency_statistics()
             return self._send_json(200, json.dumps(stats).encode())
+        if self.path == "/trace/last":
+            trace = self.server.engine.last_trace
+            payload = {"phasesMs": {}, "totalMs": 0.0} if trace is None else {
+                "phasesMs": {k: v * 1e3 for k, v in trace.as_dict().items()},
+                "totalMs": trace.total * 1e3,
+            }
+            return self._send_json(200, json.dumps(payload).encode())
+        if self.path == "/debug/factors":
+            fin = self.server.engine.last_finalized
+            rows = [] if fin is None else fin.factor_rows(self.server.engine.bank)
+            return self._send_json(200, json.dumps(rows).encode())
         self._send_json(404, b'{"error":"not found"}')
 
     def _parse(self) -> None:

@@ -180,3 +180,27 @@ class TestLimitsAndRejection:
         dfa = compile_regex_to_dfa(r"\p{Digit}+\p{Alpha}")
         assert dfa.matches(b"123x")
         assert not dfa.matches(b"123 ")
+
+
+def test_dfa_disk_cache_roundtrip(tmp_path, monkeypatch):
+    """A cache hit must reproduce the compiled automaton exactly; corrupt
+    entries are ignored and rewritten."""
+    import numpy as np
+
+    from log_parser_tpu.patterns.regex.cache import compile_regex_to_dfa_cached
+
+    monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+    first = compile_regex_to_dfa_cached("time(out|r)+x", False)
+    files = list(tmp_path.glob("*.npz"))
+    assert len(files) == 1
+    second = compile_regex_to_dfa_cached("time(out|r)+x", False)  # hit
+    np.testing.assert_array_equal(first.trans, second.trans)
+    np.testing.assert_array_equal(first.byte_class, second.byte_class)
+    np.testing.assert_array_equal(first.accept_end, second.accept_end)
+    assert (first.start, first.n_states, first.n_classes) == (
+        second.start, second.n_states, second.n_classes
+    )
+    files[0].write_bytes(b"garbage")
+    third = compile_regex_to_dfa_cached("time(out|r)+x", False)  # corrupt -> rebuild
+    np.testing.assert_array_equal(first.trans, third.trans)
+    assert third.matches(b"timeoutx") and not third.matches(b"time")

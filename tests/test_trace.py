@@ -1,0 +1,65 @@
+"""Observability: per-phase timers and the factor-dump debug surface
+(SURVEY.md §5.1/§5.5 — absent in the reference, whose only timing is
+processingTimeMs, AnalysisService.java:169)."""
+
+from __future__ import annotations
+
+import json
+import math
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine
+
+from helpers import make_pattern, make_pattern_set
+
+
+def _engine():
+    ps = make_pattern_set(
+        [
+            make_pattern(
+                "oom", regex="OutOfMemoryError", confidence=0.8, severity="HIGH",
+                secondaries=[("GC overhead", 0.6, 10)], context=(2, 2),
+            )
+        ]
+    )
+    return AnalysisEngine([ps], ScoringConfig())
+
+
+def test_phase_trace_and_factor_dump():
+    engine = _engine()
+    logs = "boot\nGC overhead limit\nfiller\njava.lang.OutOfMemoryError: heap\ndone"
+    result = engine.analyze(PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs))
+    assert len(result.events) == 1
+
+    trace = engine.last_trace
+    assert trace is not None
+    assert set(trace.phases) >= {"ingest", "device", "finalize", "assemble"}
+    assert trace.total > 0
+
+    fin = engine.last_finalized
+    rows = fin.factor_rows(engine.bank)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["patternId"] == "oom"
+    assert row["lineNumber"] == 4
+    # product of the dumped factors must reproduce the score exactly
+    product = (
+        row["confidence"] * row["severityMultiplier"] * row["chronological"]
+        * row["proximity"] * row["temporal"] * row["context"]
+        * (1.0 - row["frequencyPenalty"])
+    )
+    assert math.isclose(product, row["score"], rel_tol=0, abs_tol=0)
+    assert json.dumps(rows)  # JSON-ready
+
+
+def test_factor_values_match_hand_computation():
+    engine = _engine()
+    logs = "boot\nGC overhead limit\nfiller\njava.lang.OutOfMemoryError: heap\ndone"
+    engine.analyze(PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs))
+    row = engine.last_finalized.factor_rows(engine.bank)[0]
+    assert row["proximity"] == 1.0 + 0.6 * math.exp(-2.0 / 10.0)
+    assert row["temporal"] == 1.0
+    # window lines 2-5: only the matched line hits \w*Error -> +0.3
+    assert row["context"] == 1.3
+    assert row["frequencyPenalty"] == 0.0
