@@ -1,0 +1,134 @@
+"""High-cardinality library benchmark: automaton compile + match throughput.
+
+Implements BASELINE.md config 4 (10k YAML regexes; target "establish").
+Generates a synthetic library of distinct failure-shaped regexes, then
+reports DFA-bank compile time (cold and warm disk cache) and end-to-end
+scored lines/sec with the pattern axis sharded over the visible devices.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": lines_per_sec, "unit": "lines/s",
+     "vs_baseline": warm_compile_seconds}
+
+Defaults are CPU-feasible (--patterns 2000 --lines 4096); on TPU run the
+full `--patterns 10000`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_PATTERNS = int(sys.argv[sys.argv.index("--patterns") + 1]) if "--patterns" in sys.argv else 2000
+N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 4096
+
+_SERVICES = ["auth", "billing", "cart", "search", "ingest", "gateway", "scheduler", "worker"]
+_ERRORS = ["Timeout", "Refused", "Unavailable", "Overflow", "Corrupt", "Denied", "Leak", "Panic"]
+
+
+def synth_library(n: int):
+    """n distinct patterns: literal-bearing regexes with varied structure."""
+    from log_parser_tpu.models.pattern import (
+        Pattern,
+        PatternSet,
+        PatternSetMetadata,
+        PrimaryPattern,
+        SecondaryPattern,
+    )
+
+    patterns = []
+    for i in range(n):
+        svc = _SERVICES[i % len(_SERVICES)]
+        err = _ERRORS[(i // len(_SERVICES)) % len(_ERRORS)]
+        body = f"{svc}-{i:05d}"
+        shape = i % 4
+        if shape == 0:
+            regex = f"{body}: {err}"
+        elif shape == 1:
+            regex = f"{body}\\s+(fatal|{err.lower()})"
+        elif shape == 2:
+            regex = f"^\\d+ {body} {err}"
+        else:
+            regex = f"{body} (code|status)=[45]\\d\\d"
+        patterns.append(
+            Pattern(
+                id=f"p{i:05d}",
+                name=f"synthetic {i}",
+                severity=["LOW", "MEDIUM", "HIGH", "CRITICAL"][i % 4],
+                primary_pattern=PrimaryPattern(regex=regex, confidence=0.5 + (i % 5) / 10),
+                secondary_patterns=(
+                    [SecondaryPattern(regex=f"{svc} degraded", weight=0.4, proximity_window=10)]
+                    if i % 7 == 0
+                    else None
+                ),
+            )
+        )
+    return [
+        PatternSet(
+            metadata=PatternSetMetadata(library_id="synthetic-10k", name="synthetic"),
+            patterns=patterns,
+        )
+    ]
+
+
+def synth_logs(n_lines: int, n_patterns: int) -> str:
+    rows = []
+    for j in range(n_lines):
+        if j % 19 == 4:  # ~5% of lines hit some pattern
+            i = (j * 37) % n_patterns
+            svc = _SERVICES[i % len(_SERVICES)]
+            err = _ERRORS[(i // len(_SERVICES)) % len(_ERRORS)]
+            rows.append(f"{svc}-{i:05d}: {err}")
+        else:
+            rows.append(f"2026-07-29T10:{j % 60:02d}:00Z INFO tick {j} ok")
+    return "\n".join(rows)
+
+
+def main() -> None:
+    import os
+    import shutil
+    import tempfile
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.parallel.pattern_sharded import PatternShardedEngine
+
+    sets = synth_library(N_PATTERNS)
+    cache_dir = tempfile.mkdtemp(prefix="lpt-bankbench-")
+    os.environ["LOG_PARSER_TPU_CACHE"] = cache_dir
+    try:
+        t0 = time.perf_counter()
+        engine = PatternShardedEngine(sets, ScoringConfig())
+        cold_compile = time.perf_counter() - t0
+        assert not engine.skipped_patterns, engine.skipped_patterns[:3]
+
+        t0 = time.perf_counter()
+        engine = PatternShardedEngine(sets, ScoringConfig())
+        warm_compile = time.perf_counter() - t0
+
+        data = PodFailureData(
+            pod={"metadata": {"name": "bank"}}, logs=synth_logs(N_LINES, N_PATTERNS)
+        )
+        engine.analyze(data)  # warmup compile of the device programs
+        t0 = time.perf_counter()
+        result = engine.analyze(data)
+        elapsed = time.perf_counter() - t0
+        assert result.summary.significant_events > 0
+
+        print(
+            json.dumps(
+                {
+                    "metric": f"match_lines_per_sec_{N_PATTERNS}regex_library",
+                    "value": round(N_LINES / elapsed, 1),
+                    "unit": "lines/s",
+                    "vs_baseline": round(warm_compile, 3),
+                    "cold_compile_s": round(cold_compile, 3),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

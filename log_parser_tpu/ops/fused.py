@@ -263,6 +263,53 @@ class FusedMatchScore:
 
     # ------------------------------------------------------------- host entry
 
+    def dispatch(
+        self,
+        k: int,
+        lines_u8: np.ndarray,
+        lengths: np.ndarray,
+        n_lines: int,
+        override_mask: np.ndarray | None = None,
+        override_val: np.ndarray | None = None,
+    ):
+        """Launch the fused program asynchronously at record capacity ``k``
+        and return the un-synchronized device outputs. Callers fan out
+        several dispatches (e.g. one pattern block per device) before the
+        first blocking read."""
+        lines_tb = jnp.asarray(lines_u8.T)
+        lens = jnp.asarray(lengths)
+        n = jnp.asarray(n_lines, dtype=jnp.int32)
+        if override_mask is not None:
+            return self._jit_ov(
+                k, lines_tb, lens, n,
+                jnp.asarray(override_mask), jnp.asarray(override_val),
+            )
+        return self._jit_plain(k, lines_tb, lens, n)
+
+    def k_ladder(self, lines_u8: np.ndarray, k_hint: int = 0):
+        """The record-capacity buckets to try, smallest viable first."""
+        cap = lines_u8.shape[0] * max(1, self.bank.n_patterns)
+        start = 0
+        while start < len(K_LADDER) - 1 and K_LADDER[start] < k_hint:
+            start += 1
+        return [min(k, cap) for k in (*K_LADDER[start:], cap)], cap
+
+    @staticmethod
+    def resolve(out) -> MatchRecords | None:
+        """Synchronize one dispatch; None signals K overflow (re-dispatch
+        at the next ladder rung)."""
+        n_matches = int(out[0])
+        if n_matches > out[1].shape[0]:
+            return None
+        return MatchRecords(
+            n_matches=n_matches,
+            line=np.asarray(out[1]),
+            pattern=np.asarray(out[2]),
+            sec_dist=np.asarray(out[3]),
+            seq_ok=np.asarray(out[4]),
+            ctx_counts=np.asarray(out[5]),
+        )
+
     def run(
         self,
         lines_u8: np.ndarray,
@@ -275,31 +322,14 @@ class FusedMatchScore:
         """Executes the fused program, growing the record buffer until the
         batch's matches fit. ``k_hint``: expected match count (e.g. the
         previous request's), used to pick the starting bucket."""
-        lines_tb = jnp.asarray(lines_u8.T)
-        lens = jnp.asarray(lengths)
-        n = jnp.asarray(n_lines, dtype=jnp.int32)
-        cap = lines_u8.shape[0] * max(1, self.bank.n_patterns)
-        start = 0
-        while start < len(K_LADDER) - 1 and K_LADDER[start] < k_hint:
-            start += 1
-        for k_bucket in (*K_LADDER[start:], cap):
-            k = min(k_bucket, cap)
-            if override_mask is not None:
-                out = self._jit_ov(
-                    k, lines_tb, lens, n, jnp.asarray(override_mask), jnp.asarray(override_val)
-                )
-            else:
-                out = self._jit_plain(k, lines_tb, lens, n)
-            n_matches = int(out[0])
-            if n_matches <= k or k >= cap:
-                return MatchRecords(
-                    n_matches=n_matches,
-                    line=np.asarray(out[1]),
-                    pattern=np.asarray(out[2]),
-                    sec_dist=np.asarray(out[3]),
-                    seq_ok=np.asarray(out[4]),
-                    ctx_counts=np.asarray(out[5]),
-                )
+        ladder, cap = self.k_ladder(lines_u8, k_hint)
+        for k in ladder:
+            out = self.dispatch(k, lines_u8, lengths, n_lines, override_mask, override_val)
+            recs = self.resolve(out)
+            if recs is not None or k >= cap:
+                if recs is None:  # cap rung can never truly overflow
+                    raise AssertionError("unreachable: K ladder capped at B*P")
+                return recs
         raise AssertionError("unreachable: K ladder capped at B*P")
 
     # ---------------------------------------------------------- device program

@@ -73,6 +73,7 @@ def compile_regex_to_dfa_cached(
             log.warning("Ignoring corrupt DFA cache entry %s: %s", path.name, exc)
 
     dfa = compile_regex_to_dfa(regex, case_insensitive, max_states)
+    tmp = None
     try:
         cache.mkdir(parents=True, exist_ok=True)
         # atomic publish so concurrent engines never read a torn file
@@ -88,6 +89,13 @@ def compile_regex_to_dfa_cached(
                 n_classes=np.int64(dfa.n_classes),
             )
         os.replace(tmp, path)
+        tmp = None
     except OSError as exc:
         log.warning("DFA cache write failed for %s: %s", path.name, exc)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     return dfa

@@ -44,7 +44,7 @@ from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus
-from log_parser_tpu.ops.fused import FusedMatchScore
+from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
 from log_parser_tpu.ops.match import DfaBank
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
@@ -70,9 +70,12 @@ class AnalysisEngine:
         self._host_cols = [
             i for i, c in enumerate(self.bank.columns) if c.dfa is None
         ]
-        self.dfa_bank = DfaBank([self.bank.columns[i].dfa for i in self._dfa_cols])
-        self.fused = FusedMatchScore(self.bank, self.config, self.dfa_bank)
-        self.tables = self.fused.t  # static per-pattern index tables
+        # static per-pattern index tables (numpy, cheap); the full-bank
+        # device programs below are built lazily — subclasses that override
+        # _run_device (pattern sharding) never pay for them
+        self.tables = FusedStaticTables(self.bank, self.config)
+        self._dfa_bank: DfaBank | None = None
+        self._fused: FusedMatchScore | None = None
         self._k_hint = 0  # previous request's match count → starting K bucket
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
@@ -82,6 +85,20 @@ class AnalysisEngine:
     @property
     def skipped_patterns(self) -> list[tuple[str, str]]:
         return self.bank.skipped_patterns
+
+    @property
+    def dfa_bank(self) -> DfaBank:
+        if self._dfa_bank is None:
+            self._dfa_bank = DfaBank(
+                [self.bank.columns[i].dfa for i in self._dfa_cols]
+            )
+        return self._dfa_bank
+
+    @property
+    def fused(self) -> FusedMatchScore:
+        if self._fused is None:
+            self._fused = FusedMatchScore(self.bank, self.config, self.dfa_bank)
+        return self._fused
 
     # -------------------------------------------------------------- overrides
 

@@ -58,8 +58,9 @@ class FinalizedBatch:
     frequency_penalty: np.ndarray  # float64 [M]
 
     def factor_rows(self, bank) -> list[dict]:
-        """One dict per match, JSON-ready; the product of the seven factor
-        fields reproduces ``score`` exactly."""
+        """One dict per match, JSON-ready. ``score`` = confidence ×
+        severityMultiplier × chronological × proximity × temporal × context
+        × (1 − frequencyPenalty), exactly (ScoringService.java:102-109)."""
         return [
             {
                 "lineNumber": int(self.line[i]) + 1,

@@ -68,7 +68,7 @@ def main(argv: list[str] | None = None) -> int:
         len(pattern_sets),
         engine.bank.n_patterns,
         engine.bank.n_columns,
-        engine.dfa_bank.n_regexes,
+        sum(1 for c in engine.bank.columns if c.dfa is not None),
     )
 
     server = make_server(engine, args.host, args.port)

@@ -1,0 +1,48 @@
+"""Pattern-axis sharding (SURVEY.md §2.2 TP analogue) vs golden: block
+partitioning, global index remap, and merge-order correctness."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.parallel.pattern_sharded import (
+    PatternShardedEngine,
+    partition_pattern_sets,
+)
+from tests.conftest import FakeClock
+from tests.test_engine_parity import assert_results_match, random_library, random_logs
+
+
+def test_partition_preserves_discovery_order():
+    rng = random.Random(3)
+    sets = random_library(rng, 5)
+    blocks = partition_pattern_sets(sets, 4)
+    flat = [p.id for ps in sets for p in ps.patterns or []]
+    flat_blocks = [p.id for block in blocks for ps in block for p in ps.patterns or []]
+    assert flat == flat_blocks
+    assert len(blocks) == min(4, max(1, len(flat)))
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("n_blocks", [1, 3, 4])
+def test_random_parity_vs_golden(seed, n_blocks):
+    rng = random.Random(9000 + seed)
+    sets = random_library(rng, rng.randrange(3, 7))
+    config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
+    engine = PatternShardedEngine(
+        sets, config, n_blocks=n_blocks, clock=FakeClock()
+    )
+    golden = GoldenAnalyzer(sets, config, clock=FakeClock())
+    for _ in range(2):
+        logs = random_logs(rng, rng.randrange(20, 200))
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+        assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert (
+        engine.frequency.get_frequency_statistics()
+        == golden.frequency.get_frequency_statistics()
+    )
