@@ -145,6 +145,50 @@ class GoldenFrequencyTracker:
         """FrequencyTrackingService.java:131-134."""
         self._frequencies.clear()
 
+    # ---- exact in-process state save/load (crash-containment rollback) ---
+
+    def _save_state(self) -> dict[str, list[float]]:
+        """Raw timestamp copy — exact, process-local (cf. :meth:`snapshot`,
+        which is portable but clock-relative)."""
+        return {pid: list(f._timestamps) for pid, f in self._frequencies.items()}
+
+    def _load_state(self, state: dict[str, list[float]]) -> None:
+        self._frequencies.clear()
+        for pid, timestamps in state.items():
+            freq = PatternFrequency(
+                self.config.frequency_time_window_hours * 3600.0, clock=self.clock
+            )
+            freq._timestamps = list(timestamps)
+            self._frequencies[pid] = freq
+
+    # ---- snapshot/restore (SURVEY.md §5.4 — the reference loses this state
+    # on restart; here it can round-trip across processes) -----------------
+
+    def snapshot(self) -> dict[str, list[float]]:
+        """Portable snapshot: per pattern id, the *age* in seconds of every
+        in-window match (ages, not raw clock values — the monotonic clock
+        is process-local)."""
+        now = self.clock()
+        out: dict[str, list[float]] = {}
+        for pid, freq in self._frequencies.items():
+            freq._prune(now)
+            out[pid] = [now - ts for ts in freq._timestamps]
+        return out
+
+    def restore(self, ages: dict[str, list[float]]) -> None:
+        """Rebuild tracker state from :meth:`snapshot` output. Existing
+        entries for the same ids are replaced; ages beyond the window are
+        dropped on the next prune."""
+        now = self.clock()
+        for pid, age_list in ages.items():
+            if not pid or not pid.strip():
+                continue
+            freq = PatternFrequency(
+                self.config.frequency_time_window_hours * 3600.0, clock=self.clock
+            )
+            freq._timestamps = sorted(now - float(a) for a in age_list)
+            self._frequencies[pid] = freq
+
 
 def calculate_context_factor(context: EventContext | None, config: ScoringConfig) -> float:
     """ContextAnalysisService.java:46-117 — context factor with the else-if,

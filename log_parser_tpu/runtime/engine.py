@@ -27,6 +27,7 @@ record stream (read-before-record, ScoringService.java:84-88).
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from typing import Callable
@@ -76,6 +77,13 @@ class AnalysisEngine:
         self.tables = FusedStaticTables(self.bank, self.config)
         self._dfa_bank: DfaBank | None = None
         self._fused: FusedMatchScore | None = None
+        self._golden = None
+        # cheap insurance: a request whose device batch dies is re-served
+        # from the golden host path (SURVEY.md §5.3). Disabled in the test
+        # suite so device bugs can never hide behind the fallback.
+        self.fallback_to_golden = (
+            os.environ.get("LOG_PARSER_TPU_NO_FALLBACK") != "1"
+        )
         self._k_hint = 0  # previous request's match count → starting K bucket
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
@@ -141,9 +149,45 @@ class AnalysisEngine:
             enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
         )
 
+    # ------------------------------------------------------- golden fallback
+
+    @property
+    def golden_fallback(self):
+        """Lazy golden (pure host) analyzer sharing this engine's frequency
+        state — the insurance path when a device batch fails (SURVEY.md
+        §5.3; the reference has no equivalent)."""
+        if self._golden is None:
+            from log_parser_tpu.golden.engine import GoldenAnalyzer
+
+            self._golden = GoldenAnalyzer(self.bank.pattern_sets, self.config)
+            self._golden.frequency = self.frequency
+        return self._golden
+
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
+        if not self.fallback_to_golden:
+            return self._analyze_device(data)
+        # roll frequency state back on failure: a partially-run device
+        # request (e.g. one that died after recording its matches) must not
+        # leave the tracker double-counted when golden re-serves it
+        saved_freq = self.frequency._save_state()
+        try:
+            return self._analyze_device(data)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "Device batch failed; serving this request from the golden "
+                "host path"
+            )
+            self.frequency._load_state(saved_freq)
+            # device-side observability does not describe this request
+            self.last_trace = None
+            self.last_finalized = None
+            return self.golden_fallback.analyze(data)
+
+    def _analyze_device(self, data: PodFailureData) -> AnalysisResult:
         start = time.monotonic()
         trace = PhaseTrace()
         with trace.phase("ingest"):

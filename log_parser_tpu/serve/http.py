@@ -67,12 +67,31 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if self.path == "/parse":
             return self._parse()
+        if self.path == "/frequency/restore":
+            bad = b'{"error":"expected {patternId: [ageSeconds]}"}'
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                ages = json.loads(self.rfile.read(length) if length else b"{}")
+            except ValueError:
+                return self._send_json(400, bad)
+            # validate the FULL shape before touching state: restore must be
+            # all-or-nothing, never partial
+            if not isinstance(ages, dict) or not all(
+                isinstance(v, list) and all(isinstance(a, (int, float)) for a in v)
+                for v in ages.values()
+            ):
+                return self._send_json(400, bad)
+            with self.server.analyze_lock:
+                self.server.engine.frequency.restore(ages)
+            return self._send_json(200, b'{"status":"restored"}')
         if self.path == "/frequency/reset":
-            self.server.engine.frequency.reset_all_frequencies()
+            with self.server.analyze_lock:
+                self.server.engine.frequency.reset_all_frequencies()
             return self._send_json(200, b'{"status":"reset"}')
         if self.path.startswith("/frequency/reset/"):
             pattern_id = self.path[len("/frequency/reset/") :]
-            self.server.engine.frequency.reset_pattern_frequency(pattern_id)
+            with self.server.analyze_lock:
+                self.server.engine.frequency.reset_pattern_frequency(pattern_id)
             return self._send_json(200, b'{"status":"reset"}')
         self._send_json(404, b'{"error":"not found"}')
 
@@ -80,8 +99,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
             return self._send_json(200, b'{"status":"UP"}')
         if self.path == "/frequency/stats":
-            stats = self.server.engine.frequency.get_frequency_statistics()
+            with self.server.analyze_lock:
+                stats = self.server.engine.frequency.get_frequency_statistics()
             return self._send_json(200, json.dumps(stats).encode())
+        if self.path == "/frequency/snapshot":
+            with self.server.analyze_lock:
+                snap = self.server.engine.frequency.snapshot()
+            return self._send_json(200, json.dumps(snap).encode())
         if self.path == "/trace/last":
             trace = self.server.engine.last_trace
             payload = {"phasesMs": {}, "totalMs": 0.0} if trace is None else {

@@ -1,0 +1,10 @@
+"""JVM↔TPU shim: framed-protobuf contract (proto/logparser.proto).
+
+``logparser_pb2`` is generated — regenerate after editing the proto:
+``protoc --python_out=log_parser_tpu/shim --proto_path=proto proto/logparser.proto``
+"""
+
+from log_parser_tpu.shim.client import ShimClient
+from log_parser_tpu.shim.server import ShimServer, make_shim_server
+
+__all__ = ["ShimClient", "ShimServer", "make_shim_server"]

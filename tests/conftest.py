@@ -21,6 +21,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# device bugs must never hide behind the golden-host insurance path; the
+# fallback itself is tested explicitly with it re-enabled (test_fallback.py)
+os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
+
 import pytest  # noqa: E402
 
 from log_parser_tpu.config import ScoringConfig  # noqa: E402

@@ -1,0 +1,89 @@
+"""Failure containment (SURVEY.md §5.3): a dead device batch falls back to
+the golden host path — same result, same frequency-state evolution."""
+
+from __future__ import annotations
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+from test_engine_parity import assert_results_match
+
+LOGS = "ok\nERROR boom\nok\nERROR again"
+
+
+def _sets():
+    return [make_pattern_set([make_pattern("e", regex="ERROR", confidence=0.7)])]
+
+
+def test_device_failure_served_by_golden(monkeypatch):
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+    engine.fallback_to_golden = True
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device loss")
+
+    monkeypatch.setattr(engine, "_run_device", boom)
+    golden = GoldenAnalyzer(_sets(), ScoringConfig(), clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+    # the fallback recorded into the SAME tracker the device path uses
+    assert engine.frequency.get_frequency_statistics() == {"e": 2}
+
+
+def test_late_failure_rolls_back_frequency_state(monkeypatch):
+    """A device request that dies AFTER recording its matches must not
+    leave the tracker double-counted when golden re-serves it."""
+    import log_parser_tpu.runtime.engine as engine_mod
+
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+    engine.fallback_to_golden = True
+
+    def boom(events):
+        raise RuntimeError("injected post-record failure")
+
+    monkeypatch.setattr(engine_mod, "build_summary", boom)
+    golden = GoldenAnalyzer(_sets(), ScoringConfig(), clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+    r1, r2 = engine.analyze(data), golden.analyze(data)
+    assert [e.score for e in r1.events] == [e.score for e in r2.events]
+    # exactly one batch recorded — not the device batch plus the golden one
+    assert engine.frequency.get_frequency_statistics() == {"e": 2}
+    assert engine.last_trace is None and engine.last_finalized is None
+
+
+def test_fallback_disabled_raises(monkeypatch):
+    engine = AnalysisEngine(_sets(), ScoringConfig())
+    engine.fallback_to_golden = False
+    monkeypatch.setattr(
+        engine, "_run_device", lambda *a, **k: (_ for _ in ()).throw(RuntimeError("x"))
+    )
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+    try:
+        engine.analyze(data)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+
+
+def test_frequency_snapshot_roundtrip():
+    clock = FakeClock()
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=clock)
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+    engine.analyze(data)
+    engine.analyze(data)
+    snap = engine.frequency.snapshot()
+    assert snap == {"e": [0.0, 0.0, 0.0, 0.0]}
+
+    # a fresh process (same clock model) restores to identical state
+    clock2 = FakeClock()
+    engine2 = AnalysisEngine(_sets(), ScoringConfig(), clock=clock2)
+    engine2.frequency.restore(snap)
+    assert engine2.frequency.get_frequency_statistics() == {"e": 4}
+    # scores after restore match continuing with the original engine
+    r1 = engine.analyze(data)
+    r2 = engine2.analyze(data)
+    assert [e.score for e in r1.events] == [e.score for e in r2.events]

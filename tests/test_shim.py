@@ -1,0 +1,100 @@
+"""JVM↔TPU shim: framed-protobuf contract over a socket (the north star's
+process boundary — the Quarkus front-end delegates the hot loop here)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.shim import ShimClient, make_shim_server
+from log_parser_tpu.shim import logparser_pb2 as pb
+
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(scope="module")
+def shim():
+    sets = [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom", regex="OutOfMemoryError", confidence=0.8, severity="HIGH",
+                    secondaries=[("GC overhead", 0.6, 10)], context=(1, 1),
+                )
+            ]
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    server = make_shim_server(engine, host="127.0.0.1", port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server
+    server.shutdown()
+
+
+def _client(shim) -> ShimClient:
+    return ShimClient("127.0.0.1", shim.server_address[1])
+
+
+def test_parse_roundtrip(shim):
+    with _client(shim) as c:
+        assert c.health() == "UP"
+        resp = c.parse(
+            {"metadata": {"name": "web-1"}},
+            "boot\nGC overhead limit\njava.lang.OutOfMemoryError: heap\ndone",
+        )
+        assert resp.analysis_id
+        assert resp.summary.highest_severity == "HIGH"
+        assert resp.summary.severity_distribution["HIGH"] == 1
+        [event] = resp.events
+        assert event.line_number == 3
+        assert event.context.matched_line.startswith("java.lang.OutOfMemoryError")
+        assert list(event.context.lines_before) == ["GC overhead limit"]
+        assert event.context.has_lines_before
+        pattern = json.loads(event.pattern_json)
+        assert pattern["id"] == "oom"
+        assert event.score > 0
+        assert resp.metadata.total_lines == 4
+
+
+def test_null_pod_is_client_error(shim):
+    with _client(shim) as c:
+        env = c.call("Parse", pb.ParseRequest(pod_json="", logs="x"))
+        assert env.error == "Invalid PodFailureData provided"
+
+
+def test_unknown_method(shim):
+    with _client(shim) as c:
+        env = c.call("Nope", pb.HealthRequest())
+        assert "unknown method" in env.error
+
+
+def test_frequency_surface_and_snapshot(shim):
+    with _client(shim) as c:
+        c.parse({"metadata": {"name": "w"}}, "java.lang.OutOfMemoryError")
+        env = c.call("FrequencyStats", pb.FrequencyStatsRequest())
+        stats = pb.FrequencyStatsResponse()
+        stats.ParseFromString(env.payload)
+        assert stats.windowed_counts["oom"] >= 1
+
+        env = c.call("FrequencySnapshot", pb.FrequencySnapshotRequest())
+        snap = pb.FrequencySnapshotResponse()
+        snap.ParseFromString(env.payload)
+        assert len(snap.ages["oom"].ages_seconds) >= 1
+
+        c.call("FrequencyReset", pb.FrequencyResetRequest())
+        env = c.call("FrequencyStats", pb.FrequencyStatsRequest())
+        stats = pb.FrequencyStatsResponse()
+        stats.ParseFromString(env.payload)
+        assert len(stats.windowed_counts) == 0
+
+        restore = pb.FrequencyRestoreRequest()
+        restore.ages["oom"].ages_seconds.extend(snap.ages["oom"].ages_seconds)
+        c.call("FrequencyRestore", restore)
+        env = c.call("FrequencyStats", pb.FrequencyStatsRequest())
+        stats = pb.FrequencyStatsResponse()
+        stats.ParseFromString(env.payload)
+        assert stats.windowed_counts["oom"] >= 1
