@@ -243,14 +243,11 @@ class FusedMatchScore:
     engine picks the K bucket adaptively and re-runs on overflow.
     """
 
-    def __init__(self, bank: PatternBank, config: ScoringConfig, dfa_bank: DfaBank):
+    def __init__(self, bank: PatternBank, config: ScoringConfig, matchers):
         self.bank = bank
         self.config = config
-        self.dfa_bank = dfa_bank
+        self.matchers = matchers  # MatcherBanks: tiered Shift-Or + DFA cube
         self.t = FusedStaticTables(bank, config)
-        self._dfa_cols = np.asarray(
-            [i for i, c in enumerate(bank.columns) if c.dfa is not None], dtype=np.int32
-        )
         # K is a static arg: each bucket size is its own cached executable
         self._jit_ov = jax.jit(
             lambda k, lines, lens, n, om, ov: self._step(k, lines, lens, n, (om, ov)),
@@ -341,11 +338,8 @@ class FusedMatchScore:
         row_idx = jnp.arange(B, dtype=jnp.int32)
         valid = row_idx < n_lines
 
-        # ---- match cube ---------------------------------------------------
-        cube = jnp.zeros((B, bank.n_columns), dtype=bool)
-        if self.dfa_bank.n_regexes:
-            matched = self.dfa_bank._run(lines_tb, lengths)[:, : self.dfa_bank.n_regexes]
-            cube = cube.at[:, jnp.asarray(self._dfa_cols)].set(matched)
+        # ---- match cube (tiered: Shift-Or + DFA banks) --------------------
+        cube = self.matchers.cube(lines_tb, lengths)
         if overrides is not None:
             om, ov = overrides
             cube = jnp.where(om, ov, cube)

@@ -30,6 +30,18 @@ from log_parser_tpu.patterns.regex.dfa import CompiledDfa
 PAIR_TABLE_MAX_ENTRIES = 64 << 20
 
 
+def pack_byte_pairs(lines_tb: jax.Array):
+    """uint8 [T, B] -> ([T2, 2, B] byte pairs, [T2] step indexes), padding
+    T to even so every scan step consumes exactly two bytes."""
+    T, B = lines_tb.shape
+    if T % 2:
+        lines_tb = jnp.concatenate(
+            [lines_tb, jnp.zeros((1, B), lines_tb.dtype)], axis=0
+        )
+        T += 1
+    return lines_tb.reshape(T // 2, 2, B), jnp.arange(T // 2, dtype=jnp.int32)
+
+
 class DfaBank:
     """R packed DFAs executed in lockstep over a line batch.
 
@@ -84,9 +96,7 @@ class DfaBank:
     def _run(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
         """lines_tb: uint8 [T, B] (transposed); lengths: int32 [B].
         Returns bool [B, R]."""
-        if self.pair_stride:
-            return self._run_pair(lines_tb, lengths)
-        return self._run_single(lines_tb, lengths)
+        return self._run_pair(lines_tb, lengths)
 
     def _run_single(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
         T, B = lines_tb.shape
@@ -112,33 +122,56 @@ class DfaBank:
         positions at/past each line's end consume the identity class, so no
         per-step boundary branch is needed."""
         T, B = lines_tb.shape
-        if T % 2:  # pad to even so every step has a byte pair
-            lines_tb = jnp.concatenate(
-                [lines_tb, jnp.zeros((1, B), lines_tb.dtype)], axis=0
-            )
-            T += 1
+        init, step, finish = self.pair_stepper(B, lengths)
+        pairs, ts = pack_byte_pairs(lines_tb)
+        states, _ = jax.lax.scan(
+            lambda s, xs: (step(s, xs[0][0], xs[0][1], xs[1]), None),
+            init,
+            (pairs, ts),
+        )
+        return finish(states)
+
+    def pair_stepper(self, B: int, lengths: jax.Array):
+        """(init, step(carry, b1, b2, t), finish) — one pair-consuming scan
+        stage, composable with other banks into a single fused scan."""
         R = self.byte_class.shape[0]
-        smax, cpad = self.smax, self.cpad
-        pad_cls = jnp.int32(self.cmax)
+        smax = self.smax
         states0 = jnp.broadcast_to(self.start[None, :], (B, R)).astype(jnp.int32)
         r_off = (jnp.arange(R, dtype=jnp.int32) * smax)[None, :]  # [1, R]
 
-        pairs = lines_tb.reshape(T // 2, 2, B)
-        ts = jnp.arange(T // 2, dtype=jnp.int32)
+        if self.pair_stride:
+            cpad = self.cpad
+            pad_cls = jnp.int32(self.cmax)
 
-        def step(states, xs):
-            pair_t, t = xs  # pair_t: [2, B]
-            p0 = 2 * t
-            c1 = jnp.take(self.byte_class, pair_t[0].astype(jnp.int32), axis=1)  # [R, B]
-            c2 = jnp.take(self.byte_class, pair_t[1].astype(jnp.int32), axis=1)
-            c1 = jnp.where((p0 < lengths)[None, :], c1, pad_cls)
-            c2 = jnp.where((p0 + 1 < lengths)[None, :], c2, pad_cls)
-            idx = ((r_off + states) * cpad + c1.T) * cpad + c2.T  # [B, R]
-            states = jnp.take(self.flat_trans2, idx.reshape(-1)).reshape(B, R)
-            return states, None
+            def step(states, b1, b2, t):
+                p0 = 2 * t
+                c1 = jnp.take(self.byte_class, b1.astype(jnp.int32), axis=1)  # [R, B]
+                c2 = jnp.take(self.byte_class, b2.astype(jnp.int32), axis=1)
+                c1 = jnp.where((p0 < lengths)[None, :], c1, pad_cls)
+                c2 = jnp.where((p0 + 1 < lengths)[None, :], c2, pad_cls)
+                idx = ((r_off + states) * cpad + c1.T) * cpad + c2.T  # [B, R]
+                return jnp.take(self.flat_trans2, idx.reshape(-1)).reshape(B, R)
 
-        states, _ = jax.lax.scan(step, states0, (pairs, ts))
-        return jnp.take(self.flat_accept, (r_off + states).reshape(-1)).reshape(B, R)
+        else:
+            cmax = self.cmax
+
+            def one(states, b, pos_ok):
+                cls = jnp.take(self.byte_class, b.astype(jnp.int32), axis=1)  # [R, B]
+                idx = (r_off + states) * cmax + cls.T
+                nxt = jnp.take(self.flat_trans, idx.reshape(-1)).reshape(B, R)
+                return jnp.where(pos_ok[:, None], nxt, states)
+
+            def step(states, b1, b2, t):
+                p0 = 2 * t
+                states = one(states, b1, p0 < lengths)
+                return one(states, b2, p0 + 1 < lengths)
+
+        def finish(states):
+            return jnp.take(
+                self.flat_accept, (r_off + states).reshape(-1)
+            ).reshape(B, R)
+
+        return states0, step, finish
 
     def match(self, lines_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         """Host entry: uint8 [B, T] padded batch → bool [B, R] match cube."""
@@ -184,3 +217,111 @@ class AcRunner:
         """Host entry: uint8 [B, T] → uint32 [B, n_words] literal-hit masks."""
         out = self._jit(jnp.asarray(lines_u8.T), jnp.asarray(lengths))
         return np.asarray(out)
+
+
+class MatcherBanks:
+    """Tiered device matchers for one PatternBank's columns.
+
+    Tier selection is static per column (patterns/bank.py): literal-shaped
+    regexes go to the bit-parallel Shift-Or bank (cost independent of bank
+    size), the rest to the packed DFA bank, and automaton-unsupported
+    regexes stay host-side (the engine injects them as cube overrides).
+    """
+
+    # below this many device columns, the whole bank rides the pair-stride
+    # DFA alone: the [B, R] transition gather is small, and adding the
+    # Shift-Or stage to the scan costs more than the width it removes.
+    # Wide banks (the 10k-regex configuration) move every literal-shaped
+    # column to Shift-Or, whose per-step cost is O(packed words), not O(R).
+    SHIFTOR_MIN_COLUMNS = 64
+
+    def __init__(self, bank, stride: int = 2, shiftor_min_columns: int | None = None):
+        import jax.numpy as jnp
+
+        from log_parser_tpu.ops.shiftor import ShiftOrBank
+
+        self.bank = bank
+        threshold = (
+            self.SHIFTOR_MIN_COLUMNS
+            if shiftor_min_columns is None
+            else shiftor_min_columns
+        )
+        n_device = sum(
+            1
+            for c in bank.columns
+            if c.dfa is not None or c.exact_seqs is not None
+        )
+        use_shiftor = n_device >= threshold
+        self.shiftor_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.exact_seqs is not None and (use_shiftor or c.dfa is None)
+        ]
+        shiftor_set = set(self.shiftor_cols)
+        self.dfa_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.dfa is not None and i not in shiftor_set
+        ]
+        self.host_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.dfa is None and c.exact_seqs is None
+        ]
+        self.dfa_bank = DfaBank(
+            [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
+        )
+        self.shiftor = (
+            ShiftOrBank(
+                [(i, bank.columns[i].exact_seqs) for i in self.shiftor_cols]
+            )
+            if self.shiftor_cols
+            else None
+        )
+        self._jnp = jnp
+
+    @property
+    def device_cols(self) -> list[int]:
+        return self.shiftor_cols + self.dfa_cols
+
+    def cube(self, lines_tb, lengths):
+        """uint8 [T, B] + lengths -> bool [B, n_columns] match cube
+        (device-computable columns only; host columns stay False for the
+        engine's override pass).
+
+        Both banks advance in ONE fused scan over byte pairs — the scan is
+        the serial axis, so composing steppers instead of running two scans
+        halves the sequential latency when both tiers are populated."""
+        jnp = self._jnp
+        B = lengths.shape[0]
+        cube = jnp.zeros((B, self.bank.n_columns), dtype=bool)
+        steppers = []
+        if self.dfa_cols:
+            steppers.append(
+                (self.dfa_bank.pair_stepper(B, lengths), self.dfa_cols, True)
+            )
+        if self.shiftor is not None:
+            steppers.append(
+                (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
+            )
+        if not steppers:
+            return cube
+
+        inits = tuple(s[0][0] for s in steppers)
+        pairs, ts = pack_byte_pairs(lines_tb)
+
+        def fused_step(carries, xs):
+            pair_t, t = xs
+            new = tuple(
+                s[0][1](c, pair_t[0], pair_t[1], t)
+                for s, c in zip(steppers, carries)
+            )
+            return new, None
+
+        finals, _ = jax.lax.scan(fused_step, inits, (pairs, ts))
+        for (stepper, cols, is_dfa), carry in zip(steppers, finals):
+            out = stepper[2](carry)
+            if is_dfa:
+                out = out[:, : len(cols)]
+            cube = cube.at[:, jnp.asarray(np.asarray(cols))].set(out)
+        return cube

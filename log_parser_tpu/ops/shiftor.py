@@ -1,0 +1,180 @@
+"""Bit-parallel Shift-Or matcher: the exact fast path for literal-shaped
+regexes.
+
+Most real failure patterns are literal-bearing ("OutOfMemoryError",
+"Connection refused", "status=[45]\\d\\d"): their regexes reduce to a few
+fixed-length byte-class sequences (literals.exact_sequences), and substring
+search for those needs no DFA at all. Shift-Or packs every sequence of all
+such matcher columns into 32-bit bit-planes and advances them with three
+vector ops per byte — shift, and, or — plus one 256-row table row-select.
+Per step the whole bank costs O(B × W) lane-local integer ops (W = packed
+words), independent of how many patterns share the bank: the per-regex
+axis of the DFA bank disappears, which is what makes the 10k-pattern
+configuration tractable on one chip.
+
+Bit layout per 32-bit word (first-fit packing): a sequence of length m at
+offset o uses bits [o, o+m). After each byte: ``D = ((D << 1) & start_clear)
+| mask[byte]`` where ``start_clear`` zeroes each sequence's start bit
+(fresh shift-in, isolating neighbors) and mask bit (o+j) = 1 iff the byte
+CANNOT be position j of the sequence (Shift-Or convention: 0 = still
+alive). A sequence has matched at this position iff bit (o+m-1) is 0; hits
+accumulate over positions ``t < length``.
+
+The row-select ``mask[byte]`` is expressed two ways: a small-table
+``jnp.take`` (default) and a one-hot [B,256] @ [256, planes] matmul
+(``onehot=True``) that maps onto the MXU for very wide banks — exact,
+because a one-hot row picks a single table row and the u32 words travel as
+four f32-exact byte planes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ByteSeq = tuple  # tuple[frozenset[int], ...]
+
+
+@dataclasses.dataclass
+class _PackedSeq:
+    column: int  # matcher-column this sequence belongs to
+    word: int
+    offset: int
+    length: int
+
+
+class ShiftOrBank:
+    """Packed Shift-Or program for a set of (column, sequences) entries."""
+
+    def __init__(self, column_seqs: list[tuple[int, tuple[ByteSeq, ...]]]):
+        self.columns = [c for c, _ in column_seqs]
+        packed: list[_PackedSeq] = []
+        word_fill: list[int] = []
+        for col, seqs in column_seqs:
+            for seq in seqs:
+                m = len(seq)
+                w = next(
+                    (i for i, used in enumerate(word_fill) if used + m <= 32), None
+                )
+                if w is None:
+                    w = len(word_fill)
+                    word_fill.append(0)
+                packed.append(_PackedSeq(col, w, word_fill[w], m))
+                word_fill[w] += m
+        self.n_words = max(1, len(word_fill))
+        self.n_seqs = len(packed)
+        self._packed = packed
+
+        # mask[c, w]: bit (o+j) = 1 iff byte c not allowed at position j;
+        # unused bits are always-1 (inert)
+        mask = np.full((256, self.n_words), 0xFFFFFFFF, dtype=np.uint32)
+        start_clear = np.full(self.n_words, 0xFFFFFFFF, dtype=np.uint32)
+        flat_seqs = [s for _, seqs in column_seqs for s in seqs]
+        assert len(flat_seqs) == len(packed)
+        for ps, seq in zip(packed, flat_seqs):
+            start_clear[ps.word] &= np.uint32(0xFFFFFFFF) ^ np.uint32(1 << ps.offset)
+            for j, byteset in enumerate(seq):
+                bit = np.uint32(1 << (ps.offset + j))
+                for c in byteset:
+                    mask[c, ps.word] &= ~bit
+        self.mask = jnp.asarray(mask)
+        self.start_clear = jnp.asarray(start_clear)
+
+        end_mask = np.zeros(self.n_words, dtype=np.uint32)
+        for ps in packed:
+            end_mask[ps.word] |= np.uint32(1 << (ps.offset + ps.length - 1))
+        self.end_mask = jnp.asarray(end_mask)
+
+        # per-sequence extraction: hits[:, word] >> bit & 1 -> column OR
+        self.seq_word = np.asarray([ps.word for ps in packed], dtype=np.int32)
+        self.seq_bit = np.asarray(
+            [ps.offset + ps.length - 1 for ps in packed], dtype=np.int32
+        )
+        # map sequences onto output slots (position of column in self.columns)
+        slot_of_col = {c: i for i, c in enumerate(self.columns)}
+        self.seq_slot = np.asarray(
+            [slot_of_col[ps.column] for ps in packed], dtype=np.int32
+        )
+
+        # one-hot matmul variant: u32 words as 4 exact f32 byte planes
+        planes = np.zeros((256, self.n_words * 4), dtype=np.float32)
+        for shift in range(4):
+            planes[:, shift::4] = ((mask >> (8 * shift)) & 0xFF).astype(np.float32)
+        self._planes = jnp.asarray(planes)
+
+    # --------------------------------------------------------------- device
+
+    def _row_select_take(self, bytes_t: jax.Array) -> jax.Array:
+        return jnp.take(self.mask, bytes_t.astype(jnp.int32), axis=0)  # [B, W]
+
+    def _row_select_onehot(self, bytes_t: jax.Array) -> jax.Array:
+        onehot = (
+            bytes_t[:, None] == jnp.arange(256, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)
+        planes = jnp.dot(
+            onehot, self._planes, preferred_element_type=jnp.float32
+        )  # [B, 4W] exact: one-hot row-select
+        chunks = planes.reshape(-1, self.n_words, 4).astype(jnp.uint32)
+        return (
+            chunks[:, :, 0]
+            | (chunks[:, :, 1] << 8)
+            | (chunks[:, :, 2] << 16)
+            | (chunks[:, :, 3] << 24)
+        )
+
+    def pair_stepper(self, B: int, lengths: jax.Array, onehot: bool = False):
+        """(init, step(carry, b1, b2, t), finish) — composable with the DFA
+        bank's stepper into one fused scan over byte pairs."""
+        select = self._row_select_onehot if onehot else self._row_select_take
+        d0 = jnp.full((B, self.n_words), 0xFFFFFFFF, dtype=jnp.uint32)
+        hits0 = jnp.zeros((B, self.n_words), dtype=jnp.uint32)
+
+        def one(carry, b, pos_ok):
+            d, hits = carry
+            m = select(b)
+            d_new = ((d << 1) & self.start_clear[None, :]) | m
+            active = pos_ok[:, None]
+            hits = jnp.where(
+                active, hits | ((~d_new) & self.end_mask[None, :]), hits
+            )
+            return jnp.where(active, d_new, d), hits
+
+        def step(carry, b1, b2, t):
+            p0 = 2 * t
+            carry = one(carry, b1, p0 < lengths)
+            return one(carry, b2, p0 + 1 < lengths)
+
+        def finish(carry):
+            _, hits = carry
+            seq_hit = (
+                jnp.take(hits, jnp.asarray(self.seq_word), axis=1)
+                >> jnp.asarray(self.seq_bit)[None, :]
+            ) & 1  # [B, n_seqs]
+            out = jnp.zeros((B, max(1, len(self.columns))), dtype=jnp.int32)
+            out = out.at[:, jnp.asarray(self.seq_slot)].max(
+                seq_hit.astype(jnp.int32)
+            )
+            return out.astype(bool)
+
+        return (d0, hits0), step, finish
+
+    def _run(
+        self, lines_tb: jax.Array, lengths: jax.Array, onehot: bool = False
+    ) -> jax.Array:
+        """lines_tb: uint8 [T, B]; returns bool [B, n_columns_in_bank]."""
+        from log_parser_tpu.ops.match import pack_byte_pairs
+
+        T, B = lines_tb.shape
+        init, step, finish = self.pair_stepper(B, lengths, onehot)
+        pairs, ts = pack_byte_pairs(lines_tb)
+        carry, _ = jax.lax.scan(
+            lambda c, xs: (step(c, xs[0][0], xs[0][1], xs[1]), None),
+            init,
+            (pairs, ts),
+        )
+        return finish(carry)
+
+

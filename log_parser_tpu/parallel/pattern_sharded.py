@@ -32,7 +32,7 @@ import numpy as np
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.models.pattern import PatternSet, PatternSetMetadata
 from log_parser_tpu.ops.fused import FusedMatchScore, MatchRecords
-from log_parser_tpu.ops.match import DfaBank
+from log_parser_tpu.ops.match import MatcherBanks
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.engine import AnalysisEngine
 
@@ -92,9 +92,7 @@ class PatternShardedEngine(AnalysisEngine):
         offset = 0
         for b, block_sets in enumerate(self.blocks):
             bank = PatternBank(block_sets)
-            dfa_cols = [i for i, c in enumerate(bank.columns) if c.dfa is not None]
-            dfa_bank = DfaBank([bank.columns[i].dfa for i in dfa_cols])
-            fused = FusedMatchScore(bank, self.config, dfa_bank)
+            fused = FusedMatchScore(bank, self.config, MatcherBanks(bank))
             # block-local pattern idx -> global pattern idx (discovery order
             # is preserved by contiguous partitioning)
             global_idx = np.arange(offset, offset + bank.n_patterns, dtype=np.int32)

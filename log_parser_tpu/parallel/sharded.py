@@ -53,7 +53,6 @@ from log_parser_tpu.ops.fused import (
     compact_records,
     sequence_flags_from_events,
 )
-from log_parser_tpu.ops.match import DfaBank
 from log_parser_tpu.parallel.mesh import DATA_AXIS
 from log_parser_tpu.patterns.bank import (
     CTX_ERROR,
@@ -81,16 +80,13 @@ def _ring_halo(x: jax.Array, h: int) -> jax.Array:
 class ShardedFusedStep:
     """The full per-batch SPMD program, shard_mapped over the mesh."""
 
-    def __init__(self, bank: PatternBank, config: ScoringConfig, mesh, dfa_bank: DfaBank):
+    def __init__(self, bank: PatternBank, config: ScoringConfig, mesh, matchers):
         self.bank = bank
         self.config = config
         self.mesh = mesh
-        self.dfa_bank = dfa_bank
+        self.matchers = matchers  # MatcherBanks: tiered Shift-Or + DFA cube
         self.t = FusedStaticTables(bank, config)
         self.n_shards = mesh.devices.size
-        self._dfa_cols = np.asarray(
-            [i for i, c in enumerate(bank.columns) if c.dfa is not None], dtype=np.int32
-        )
 
         # static halo requirement per factor family
         self.h_prox = int(self.t.sec_window.max()) if len(self.t.sec_window) else 0
@@ -193,11 +189,8 @@ class ShardedFusedStep:
         gidx = (d * Bl + lidx).astype(jnp.int32)
         valid = gidx < n_lines
 
-        # ---- local match (no communication) -------------------------------
-        cube = jnp.zeros((Bl, bank.n_columns), dtype=bool)
-        if self.dfa_bank.n_regexes:
-            matched = self.dfa_bank._run(lines_tb, lengths)[:, : self.dfa_bank.n_regexes]
-            cube = cube.at[:, jnp.asarray(self._dfa_cols)].set(matched)
+        # ---- local match (no communication; tiered Shift-Or + DFA) --------
+        cube = self.matchers.cube(lines_tb, lengths)
         cube = jnp.where(override_mask, override_val, cube)
         cube = cube & valid[:, None]
 
@@ -310,7 +303,7 @@ class ShardedEngine(AnalysisEngine):
 
             mesh = make_mesh()
         self.mesh = mesh
-        self.step = ShardedFusedStep(self.bank, self.config, mesh, self.dfa_bank)
+        self.step = ShardedFusedStep(self.bank, self.config, mesh, self.matchers)
         self.tables = self.step.t
 
     def _corpus_min_rows(self) -> int:

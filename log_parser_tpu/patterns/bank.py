@@ -38,6 +38,7 @@ from log_parser_tpu.patterns.regex import (
     parse_java_regex,
 )
 from log_parser_tpu.patterns.regex.cache import compile_regex_to_dfa_cached
+from log_parser_tpu.patterns.regex.literals import exact_sequences
 from log_parser_tpu.patterns.regex.literals import Literal
 
 log = logging.getLogger(__name__)
@@ -54,13 +55,18 @@ CTX_ERROR, CTX_WARN, CTX_STACK, CTX_EXCEPTION = range(4)
 
 @dataclasses.dataclass
 class MatcherColumn:
-    """One distinct regex to evaluate per line."""
+    """One distinct regex to evaluate per line.
+
+    Matcher tier (first that applies): ``exact_seqs`` → bit-parallel
+    Shift-Or (O(1) in bank size per line-byte); ``dfa`` → packed automaton
+    bank; neither → host ``re`` over every line."""
 
     regex: str
     case_insensitive: bool
     dfa: CompiledDfa | None  # None -> host fallback only
     host: re.Pattern[str]  # golden-compiled reference matcher
     literals: frozenset[Literal] | None  # None -> unfactorable
+    exact_seqs: tuple | None = None  # fixed byte-class sequences == regex
 
 
 @dataclasses.dataclass
@@ -189,12 +195,17 @@ class PatternBank:
         host = compile_java_regex(regex, case_insensitive)  # raises -> skip pattern
         dfa: CompiledDfa | None = None
         literals: frozenset[Literal] | None = None
+        exact_seqs = None
         try:
-            dfa = compile_regex_to_dfa_cached(regex, case_insensitive)
             node = parse_java_regex(regex, case_insensitive)
+            exact_seqs = exact_sequences(node)
             literals = extract_literals(node)
+            # DFA is compiled (cache-amortized) even for Shift-Or-capable
+            # columns: MatcherBanks picks the tier per bank size
+            dfa = compile_regex_to_dfa_cached(regex, case_insensitive)
         except (RegexUnsupportedError, DfaLimitError) as exc:
-            log.warning("Host-fallback matcher for %r: %s", regex, exc)
+            if exact_seqs is None:
+                log.warning("Host-fallback matcher for %r: %s", regex, exc)
         col = len(self.columns)
         self.columns.append(
             MatcherColumn(
@@ -203,6 +214,7 @@ class PatternBank:
                 dfa=dfa,
                 host=host,
                 literals=literals,
+                exact_seqs=exact_seqs,
             )
         )
         self._column_by_key[key] = col

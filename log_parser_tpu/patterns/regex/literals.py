@@ -161,3 +161,75 @@ def _extract_cat(node: Cat) -> frozenset[Literal] | None:
     if not candidates:
         return None
     return max(candidates, key=_score)
+
+
+# ---- exact fixed-length sequences (the Shift-Or fast path) ----------------
+
+MAX_EXACT_SEQS = 16  # alternative sequences per regex
+MAX_EXACT_LEN = 32  # one 32-bit Shift-Or word per sequence
+
+
+def exact_sequences(node: Node) -> tuple[tuple[frozenset[int], ...], ...] | None:
+    """When the regex is equivalent to "line contains a substring matching
+    one of these fixed-length byte-class sequences", return the sequences;
+    else None. Unlike :func:`extract_literals` (a *necessary* condition for
+    the prefilter), this is an exact characterization: bit-parallel
+    Shift-Or over these sequences IS the regex's find() answer, no DFA or
+    verification needed.
+
+    Handled: byte classes, concatenation, alternation, and counted
+    repetition with a fixed count. Rejected: assertions (``^`` ``$``
+    ``\\b``), variable repetition, empty-matchable parts, and anything
+    exceeding the sequence-count/length caps.
+    """
+    seqs = _exact(node)
+    if seqs is None or not seqs:
+        return None
+    if len(seqs) > MAX_EXACT_SEQS:
+        return None
+    if any(not 1 <= len(s) <= MAX_EXACT_LEN for s in seqs):
+        return None
+    return tuple(seqs)
+
+
+def _exact(node: Node) -> list[tuple[frozenset[int], ...]] | None:
+    if isinstance(node, Lit):
+        return [(node.byteset,)]
+    if isinstance(node, Alt):
+        out: list[tuple[frozenset[int], ...]] = []
+        for option in node.options:
+            sub = _exact(option)
+            if sub is None:
+                return None
+            out.extend(sub)
+            if len(out) > MAX_EXACT_SEQS:
+                return None
+        return out
+    if isinstance(node, Cat):
+        acc: list[tuple[frozenset[int], ...]] = [()]
+        for part in node.parts:
+            sub = _exact(part)
+            if sub is None:
+                return None
+            acc = [a + s for a in acc for s in sub]
+            if len(acc) > MAX_EXACT_SEQS or any(
+                len(a) > MAX_EXACT_LEN for a in acc
+            ):
+                return None
+        return acc
+    if isinstance(node, Rep):
+        if node.hi is None or node.lo != node.hi or node.lo < 1:
+            return None  # variable length breaks fixed-position bit packing
+        sub = _exact(node.child)
+        if sub is None:
+            return None
+        acc = [()]
+        for _ in range(node.lo):
+            acc = [a + s for a in acc for s in sub]
+            if len(acc) > MAX_EXACT_SEQS or any(
+                len(a) > MAX_EXACT_LEN for a in acc
+            ):
+                return None
+        return acc
+    # Assertion, Empty: position-dependent / empty-matchable -> not exact
+    return None

@@ -46,7 +46,7 @@ from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus
 from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
-from log_parser_tpu.ops.match import DfaBank
+from log_parser_tpu.ops.match import DfaBank, MatcherBanks
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
 from log_parser_tpu.utils.trace import PhaseTrace
@@ -65,17 +65,21 @@ class AnalysisEngine:
         self.bank = PatternBank(pattern_sets)
         self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
 
-        self._dfa_cols = [
-            i for i, c in enumerate(self.bank.columns) if c.dfa is not None
-        ]
         self._host_cols = [
-            i for i, c in enumerate(self.bank.columns) if c.dfa is None
+            i
+            for i, c in enumerate(self.bank.columns)
+            if c.dfa is None and c.exact_seqs is None
+        ]
+        self._device_cols = [
+            i
+            for i, c in enumerate(self.bank.columns)
+            if c.dfa is not None or c.exact_seqs is not None
         ]
         # static per-pattern index tables (numpy, cheap); the full-bank
         # device programs below are built lazily — subclasses that override
         # _run_device (pattern sharding) never pay for them
         self.tables = FusedStaticTables(self.bank, self.config)
-        self._dfa_bank: DfaBank | None = None
+        self._matchers: MatcherBanks | None = None
         self._fused: FusedMatchScore | None = None
         self._golden = None
         # cheap insurance: a request whose device batch dies is re-served
@@ -95,17 +99,19 @@ class AnalysisEngine:
         return self.bank.skipped_patterns
 
     @property
+    def matchers(self) -> MatcherBanks:
+        if self._matchers is None:
+            self._matchers = MatcherBanks(self.bank)
+        return self._matchers
+
+    @property
     def dfa_bank(self) -> DfaBank:
-        if self._dfa_bank is None:
-            self._dfa_bank = DfaBank(
-                [self.bank.columns[i].dfa for i in self._dfa_cols]
-            )
-        return self._dfa_bank
+        return self.matchers.dfa_bank
 
     @property
     def fused(self) -> FusedMatchScore:
         if self._fused is None:
-            self._fused = FusedMatchScore(self.bank, self.config, self.dfa_bank)
+            self._fused = FusedMatchScore(self.bank, self.config, self.matchers)
         return self._fused
 
     # -------------------------------------------------------------- overrides
@@ -132,7 +138,7 @@ class AnalysisEngine:
                     val[i, col] = bool(host.search(line))
         for i in host_lines:
             line = corpus.line(int(i))
-            for col in self._dfa_cols:
+            for col in self._device_cols:
                 mask[i, col] = True
                 val[i, col] = bool(self.bank.columns[col].host.search(line))
         return mask, val

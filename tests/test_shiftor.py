@@ -1,0 +1,99 @@
+"""Bit-parallel Shift-Or matcher: packing, exactness vs host re, and the
+adaptive tier split."""
+
+from __future__ import annotations
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.golden.javacompat import compile_java_regex
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import MatcherBanks
+from log_parser_tpu.ops.shiftor import ShiftOrBank
+from log_parser_tpu.patterns.regex import parse_java_regex
+from log_parser_tpu.patterns.regex.literals import exact_sequences
+
+
+REGEXES = [
+    "OutOfMemoryError",
+    "Connection refused",
+    "(GC|gc) overhead",
+    "x(code|status)=[45]\\d\\d",
+    "a{3}b",
+    "[Tt]imeout",
+]
+
+
+def _bank_for(regexes: list[str]) -> tuple[ShiftOrBank, list[re.Pattern]]:
+    entries = []
+    hosts = []
+    for i, rx in enumerate(regexes):
+        seqs = exact_sequences(parse_java_regex(rx, False))
+        assert seqs is not None, rx
+        entries.append((i, seqs))
+        hosts.append(compile_java_regex(rx))
+    return ShiftOrBank(entries), hosts
+
+
+@pytest.mark.parametrize("onehot", [False, True])
+def test_exactness_vs_host_re(onehot):
+    bank, hosts = _bank_for(REGEXES)
+    rng = random.Random(11)
+    alphabet = "aAbx45 GCgcOutfMemoryErrConnectionRefusedTimeoutcodestatus=d019"
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
+        for _ in range(256)
+    ]
+    # plant guaranteed positives
+    lines += [
+        "java.lang.OutOfMemoryError: heap",
+        "dial tcp: Connection refused",
+        "gc overhead limit",
+        "xstatus=503 from upstream",
+        "aaab here",
+        "Timeout after 3s",
+        "xcode=99",  # negative: [45] required
+        "aab",  # negative: needs aaa
+    ]
+    enc = encode_lines(lines)
+    got = np.asarray(
+        bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths), onehot=onehot)
+    )
+    for i, host in enumerate(hosts):
+        expect = np.zeros(len(lines), dtype=bool)
+        for j, line in enumerate(lines):
+            expect[j] = bool(host.search(line))
+        np.testing.assert_array_equal(
+            got[: len(lines), i], expect, err_msg=REGEXES[i]
+        )
+
+
+def test_word_packing_isolates_neighbors():
+    """Sequences packed into one word must not leak shift bits into each
+    other: 'ab' and 'ba' share a word; 'aba' contains both, 'aa' neither."""
+    bank, _ = _bank_for(["ab", "ba"])
+    assert bank.n_words == 1
+    enc = encode_lines(["aba", "aa", "ab", "ba", ""])
+    got = np.asarray(bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths)))
+    np.testing.assert_array_equal(
+        got[:5], [[True, True], [False, False], [True, False], [False, True], [False, False]]
+    )
+
+
+def test_adaptive_tier_split(monkeypatch):
+    from log_parser_tpu.patterns.bank import PatternBank
+    from helpers import make_pattern, make_pattern_set
+
+    patterns = [
+        make_pattern(f"p{i}", regex=f"literal-{i:03d}", confidence=0.5)
+        for i in range(8)
+    ]
+    bank = PatternBank([make_pattern_set(patterns)])
+    small = MatcherBanks(bank)  # under threshold: everything on the DFA
+    assert small.shiftor is None and len(small.dfa_cols) > 0
+    wide = MatcherBanks(bank, shiftor_min_columns=1)
+    assert wide.shiftor is not None
+    assert len(wide.shiftor_cols) == 8  # all literal-shaped primaries
