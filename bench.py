@@ -5,7 +5,14 @@ library, single device). The reference publishes no numbers (BASELINE.md);
 ``vs_baseline`` is therefore reported against the north-star target of
 1M log-lines/sec/chip from BASELINE.json.
 
-Prints exactly one JSON line:
+Fail-fast contract (VERDICT.md round-1 postmortem): the golden host
+fallback is DISABLED for the bench, and backend init is probed in a
+subprocess with a bounded timeout before any real work — a hung or broken
+device tunnel produces a clean non-zero exit with a diagnostic JSON line
+within ~2 minutes instead of burning the driver's whole time budget in
+pure-Python fallback (the round-1 rc=124 failure mode).
+
+Prints exactly one JSON line on success:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
@@ -14,6 +21,8 @@ from __future__ import annotations
 import json
 import sys
 import time
+
+import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
 
 N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 200_000
 NORTH_STAR_LINES_PER_SEC = 1_000_000.0
@@ -43,12 +52,15 @@ def build_corpus(n: int) -> str:
 
 
 def main() -> None:
+    bench_common.probe_backend_or_exit("log_lines_scored_per_sec_per_chip", "lines/s")
+
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
     from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
     from log_parser_tpu.runtime import AnalysisEngine
 
     engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    assert not engine.fallback_to_golden, "bench must never serve from golden"
     logs = build_corpus(N_LINES)
     data = PodFailureData(pod={"metadata": {"name": "bench"}}, logs=logs)
 

@@ -19,6 +19,8 @@ import json
 import sys
 import time
 
+import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
+
 N_PATTERNS = int(sys.argv[sys.argv.index("--patterns") + 1]) if "--patterns" in sys.argv else 2000
 N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 4096
 
@@ -88,6 +90,10 @@ def main() -> None:
     import os
     import shutil
     import tempfile
+
+    bench_common.probe_backend_or_exit(
+        f"match_lines_per_sec_{N_PATTERNS}regex_library", "lines/s"
+    )
 
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData

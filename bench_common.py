@@ -1,0 +1,102 @@
+"""Shared fail-fast harness for the bench scripts (bench.py, bench_bank.py,
+bench_latency.py).
+
+Round-1 postmortem (VERDICT.md): a hung device tunnel plus the engine's
+golden host fallback turned a benchmark into a silent multi-minute
+pure-Python crawl and an rc=124 timeout. Every bench therefore:
+
+- disables the golden fallback (a bench number from the host path would be
+  nonsense), and
+- probes backend init in a THROWAWAY subprocess under one total wall
+  budget before doing any real work, exiting non-zero with a diagnostic
+  JSON line if the device layer is down.
+
+Importing this module sets ``LOG_PARSER_TPU_NO_FALLBACK=1``; import it
+before constructing any engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
+
+# one real-compile warmup can take 20-40s on TPU; device *init* alone
+# should take far less, but the axon tunnel has been observed to hang
+# indefinitely — hence a hard TOTAL wall across all probe attempts
+PROBE_TIMEOUT_S = float(os.environ.get("LOG_PARSER_TPU_PROBE_TIMEOUT", "100"))
+
+_PROBE_SRC = """
+import os, jax
+# the axon plugin's sitecustomize pins jax_platforms="axon,cpu" at CONFIG
+# level, overriding the JAX_PLATFORMS env var — re-pin when an explicit
+# platform was requested (e.g. LOG_PARSER_TPU_PLATFORM=cpu for CPU runs)
+p = os.environ.get("LOG_PARSER_TPU_PLATFORM")
+if p:
+    jax.config.update("jax_platforms", p)
+import jax.numpy as jnp
+d = jax.devices()
+x = jnp.arange(64, dtype=jnp.int32)
+(x + 1).block_until_ready()
+print("PROBE_OK", d[0].platform, len(d), flush=True)
+"""
+
+
+def pin_platform() -> None:
+    """Apply LOG_PARSER_TPU_PLATFORM to the CURRENT process (the axon
+    sitecustomize overrides the JAX_PLATFORMS env var at config level)."""
+    if os.environ.get("LOG_PARSER_TPU_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["LOG_PARSER_TPU_PLATFORM"])
+
+
+def probe_backend_or_exit(metric: str, unit: str) -> str:
+    """Initialize the configured JAX backend in a throwaway subprocess under
+    one total wall budget (PROBE_TIMEOUT_S); returns the platform name, or
+    prints a diagnostic JSON line in the bench's schema and exits 3. Fast
+    deterministic init errors get one retry (the axon backend has been seen
+    to error once then recover); a hang consumes the whole budget exactly
+    once — no retry can help it."""
+    deadline = time.monotonic() + PROBE_TIMEOUT_S
+    last = ""
+    for attempt in (1, 2):
+        remaining = deadline - time.monotonic()
+        if remaining <= 1:
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=remaining,
+            )
+        except subprocess.TimeoutExpired:
+            last = (
+                f"backend init exceeded probe budget "
+                f"({PROBE_TIMEOUT_S:.0f}s total, attempt {attempt})"
+            )
+            break
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            platform = r.stdout.split("PROBE_OK", 1)[1].split()[0]
+            print(f"# backend ok: {platform}", file=sys.stderr)
+            pin_platform()
+            return platform
+        tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
+        last = f"probe rc={r.returncode}: {tail[0][:300]} (attempt {attempt})"
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": None,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": f"device backend unavailable: {last}",
+            }
+        )
+    )
+    sys.exit(3)

@@ -15,6 +15,8 @@ import json
 import sys
 import time
 
+import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
+
 BATCH_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 512
 REQUESTS = int(sys.argv[sys.argv.index("--requests") + 1]) if "--requests" in sys.argv else 60
 USE_HTTP = "--http" in sys.argv
@@ -41,6 +43,12 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 
 
 def main() -> None:
+    bench_common.probe_backend_or_exit(
+        f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
+        + ("_http" if USE_HTTP else ""),
+        "ms",
+    )
+
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
     from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets

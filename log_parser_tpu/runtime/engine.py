@@ -51,6 +51,35 @@ from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
 from log_parser_tpu.utils.trace import PhaseTrace
 
+# Substrings identifying plain RuntimeErrors raised by the device layer
+# *before* jit execution starts (jax raises these from xla_bridge /
+# PJRT client setup, not as JaxRuntimeError).
+_DEVICE_ERROR_MARKERS = (
+    "Unable to initialize backend",
+    "failed to initialize",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "RESOURCE_EXHAUSTED",
+    "Device or resource busy",
+)
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """True only for failures of the device/XLA layer itself — the class of
+    error the golden fallback exists for (SURVEY.md §5.3). Logic bugs
+    (TypeError in assembly, bad config, ...) must propagate: serving them
+    from the host path would hide the bug and, for large batches, convert a
+    fast failure into a multi-minute pure-Python crawl (the round-1
+    BENCH_r01 rc=124 failure mode)."""
+    import jax.errors
+
+    if isinstance(exc, jax.errors.JaxRuntimeError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(marker in msg for marker in _DEVICE_ERROR_MARKERS)
+    return False
+
 
 class AnalysisEngine:
     """Immutable compiled library + one fused device program + frequency state."""
@@ -93,6 +122,9 @@ class AnalysisEngine:
         # factor breakdown of the most recent request
         self.last_trace: PhaseTrace | None = None
         self.last_finalized: FinalizedBatch | None = None
+        # how many requests this engine served from the golden host path
+        # because the device layer failed (surfaced via GET /trace/last)
+        self.fallback_count = 0
 
     @property
     def skipped_patterns(self) -> list[tuple[str, str]]:
@@ -172,26 +204,37 @@ class AnalysisEngine:
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
-        if not self.fallback_to_golden:
-            return self._analyze_device(data)
-        # roll frequency state back on failure: a partially-run device
-        # request (e.g. one that died after recording its matches) must not
-        # leave the tracker double-counted when golden re-serves it
+        # roll frequency state back on ANY failure: a partially-run request
+        # (e.g. one that died after recording its matches) must not leave
+        # the tracker double-counted — whether golden re-serves it or the
+        # client retries after a 500
         saved_freq = self.frequency._save_state()
         try:
             return self._analyze_device(data)
-        except Exception:
+        except Exception as exc:
+            self.frequency._load_state(saved_freq)
+            if not self.fallback_to_golden or not is_device_error(exc):
+                # logic bugs always propagate; device failures degrade to
+                # the golden host path only when the fallback is enabled
+                raise
             import logging
 
+            self.fallback_count += 1
             logging.getLogger(__name__).exception(
-                "Device batch failed; serving this request from the golden "
-                "host path"
+                "Device batch failed (fallback #%d); serving this request "
+                "from the golden host path",
+                self.fallback_count,
             )
-            self.frequency._load_state(saved_freq)
             # device-side observability does not describe this request
             self.last_trace = None
             self.last_finalized = None
-            return self.golden_fallback.analyze(data)
+            try:
+                return self.golden_fallback.analyze(data)
+            except Exception:
+                # golden records matches as it runs — a failure partway
+                # through must not leak its partial counts either
+                self.frequency._load_state(saved_freq)
+                raise
 
     def _analyze_device(self, data: PodFailureData) -> AnalysisResult:
         start = time.monotonic()

@@ -112,6 +112,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "phasesMs": {k: v * 1e3 for k, v in trace.as_dict().items()},
                 "totalMs": trace.total * 1e3,
             }
+            payload["fallbackCount"] = self.server.engine.fallback_count
             return self._send_json(200, json.dumps(payload).encode())
         if self.path == "/debug/factors":
             fin = self.server.engine.last_finalized
@@ -133,8 +134,15 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(400, _INVALID)
 
         log.info("Received analysis request for pod: %s", data.pod_name)
-        with self.server.analyze_lock:
-            result = self.server.engine.analyze(data)
+        try:
+            with self.server.analyze_lock:
+                result = self.server.engine.analyze(data)
+        except Exception:
+            # non-device bugs propagate out of analyze() by design
+            # (runtime/engine.py is_device_error) — answer with a JSON 500
+            # instead of dropping the connection mid-request
+            log.exception("Analysis failed for pod: %s", data.pod_name)
+            return self._send_json(500, b'{"error":"Internal analysis failure"}')
         log.info(
             "Analysis complete for pod: %s. Found %d significant events.",
             data.pod_name,

@@ -113,3 +113,26 @@ class TestOperationalEndpoints:
         assert stats.get("err") == 0
         status, _ = post(server_url + "/frequency/reset", None, raw=b"")
         assert status == 200
+
+
+class TestAnalysisFailure:
+    def test_analysis_exception_is_json_500(self):
+        """A bug that propagates out of analyze() must answer with a JSON
+        500, not a dropped connection (round-2 review finding)."""
+        engine = AnalysisEngine(
+            [make_pattern_set([make_pattern("e", regex="ERROR")], "lib")],
+            ScoringConfig(),
+        )
+        engine.analyze = lambda data: (_ for _ in ()).throw(TypeError("bug"))
+        server = make_server(engine, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            status, body = post(
+                f"http://127.0.0.1:{port}/parse",
+                {"pod": {"metadata": {"name": "p"}}, "logs": "x"},
+            )
+            assert status == 500
+            assert body == {"error": "Internal analysis failure"}
+        finally:
+            server.shutdown()
