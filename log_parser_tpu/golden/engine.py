@@ -176,10 +176,18 @@ class GoldenFrequencyTracker:
         return out
 
     def restore(self, ages: dict[str, list[float]]) -> None:
-        """Rebuild tracker state from :meth:`snapshot` output. Existing
-        entries for the same ids are replaced; ages beyond the window are
-        dropped on the next prune."""
+        """Rebuild tracker state from :meth:`snapshot` output: the snapshot
+        REPLACES all existing state (ids absent from the payload are
+        cleared — restore-onto-warm-engine must not produce a hybrid).
+        Ages beyond the window are dropped on the next prune; negative ages
+        (timestamps in the future, which would never prune and would
+        inflate windowed counts forever) are rejected up front."""
+        for age_list in ages.values():
+            for a in age_list:
+                if not (float(a) >= 0.0):  # also rejects NaN
+                    raise ValueError(f"negative age in frequency snapshot: {a!r}")
         now = self.clock()
+        self._frequencies.clear()
         for pid, age_list in ages.items():
             if not pid or not pid.strip():
                 continue

@@ -23,6 +23,7 @@ from log_parser_tpu.ops.encode import (
     DEFAULT_MAX_LINE_BYTES,
     EncodedLines,
     _next_pow2,
+    _pad_rows,
     encode_lines,
 )
 
@@ -73,7 +74,7 @@ class Corpus:
             pad_to_multiple,
             _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple),
         )
-        rows = max(min_rows, _next_pow2(max(1, self.n_lines)))
+        rows = _pad_rows(self.n_lines, min_rows)
 
         u8 = np.zeros((rows, width), dtype=np.uint8)
         lengths = np.zeros(rows, dtype=np.int32)

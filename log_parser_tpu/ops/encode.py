@@ -22,6 +22,15 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def _pad_rows(n: int, min_rows: int) -> int:
+    """Row count for an ``n``-line batch: the next power of two (bounded
+    compile-shape set) rounded up to a multiple of ``min_rows`` (a sharded
+    engine passes the mesh size, which may not be a power of two — the
+    batch axis must stay divisible by it)."""
+    rows = _next_pow2(max(1, n))
+    return -(-rows // min_rows) * min_rows
+
+
 @dataclasses.dataclass
 class EncodedLines:
     """A padded batch: ``u8[B, T]`` with zeros beyond ``lengths``."""
@@ -67,7 +76,7 @@ def encode_lines(
     # bounded set of shapes (each distinct shape costs an XLA compile)
     width = int(min(lengths.max(initial=0), max_line_bytes))
     width = max(pad_to_multiple, _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple))
-    rows = max(min_rows, _next_pow2(n))
+    rows = _pad_rows(n, min_rows)
 
     # fill in row chunks: a full [n, width] gather-index matrix would cost
     # ~9x the output batch in temporaries (int64 indices + bool mask) and

@@ -113,7 +113,14 @@ class PatternShardedEngine(AnalysisEngine):
             self._col_index.get((c.regex, c.case_insensitive))
             for c in fused.bank.columns
         ]
-        take = np.asarray([c if c is not None else 0 for c in cols])
+        missing = [
+            fused.bank.columns[i].regex for i, c in enumerate(cols) if c is None
+        ]
+        # block patterns are by construction a subset of the full bank; a
+        # lookup miss means the intern table and the blocks diverged, and
+        # defaulting would silently apply the wrong column's overrides
+        assert not missing, f"block columns missing from full bank: {missing[:3]}"
+        take = np.asarray(cols)
         return np.ascontiguousarray(om[:, take]), np.ascontiguousarray(ov[:, take])
 
     def _run_device(self, enc, n_lines: int, om, ov):

@@ -68,16 +68,18 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/parse":
             return self._parse()
         if self.path == "/frequency/restore":
-            bad = b'{"error":"expected {patternId: [ageSeconds]}"}'
+            bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 ages = json.loads(self.rfile.read(length) if length else b"{}")
             except ValueError:
                 return self._send_json(400, bad)
             # validate the FULL shape before touching state: restore must be
-            # all-or-nothing, never partial
+            # all-or-nothing, never partial. Negative ages are future
+            # timestamps that never prune — rejected.
             if not isinstance(ages, dict) or not all(
-                isinstance(v, list) and all(isinstance(a, (int, float)) for a in v)
+                isinstance(v, list)
+                and all(isinstance(a, (int, float)) and a >= 0 for a in v)
                 for v in ages.values()
             ):
                 return self._send_json(400, bad)

@@ -5,6 +5,15 @@
 """
 
 from log_parser_tpu.shim.client import ShimClient
+from log_parser_tpu.shim.grpc_server import HAVE_GRPC, make_grpc_server
 from log_parser_tpu.shim.server import ShimServer, make_shim_server
+from log_parser_tpu.shim.service import LogParserService
 
-__all__ = ["ShimClient", "ShimServer", "make_shim_server"]
+__all__ = [
+    "HAVE_GRPC",
+    "LogParserService",
+    "ShimClient",
+    "ShimServer",
+    "make_grpc_server",
+    "make_shim_server",
+]

@@ -23,6 +23,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--config", help="Java .properties config file")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument(
+        "--grpc-port",
+        type=int,
+        default=None,
+        help="also serve standard gRPC (service LogParser) on this port",
+    )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -45,12 +51,25 @@ def main(argv: list[str] | None = None) -> int:
 
     engine = AnalysisEngine(load_pattern_directory(config.pattern_directory), config)
     server = make_shim_server(engine, args.host, args.port)
+    grpc_server = None
+    if args.grpc_port is not None:
+        from log_parser_tpu.shim.grpc_server import make_grpc_server
+
+        # share the framed server's service so both transports serialize
+        # engine + frequency access on the same lock
+        grpc_server, bound = make_grpc_server(
+            engine, args.host, args.grpc_port, service=server.service
+        )
+        grpc_server.start()
+        log.info("Shim serving gRPC (logparser.LogParser) on %s:%d", args.host, bound)
     log.info("Shim serving framed protobuf on %s:%d", args.host, args.port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         log.info("Shutting down")
     finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)
         server.server_close()
     return 0
 

@@ -1,23 +1,23 @@
-"""Shim server: the TPU backend behind the framed-protobuf contract.
+"""Framed-socket shim server: the TPU backend behind the Envelope contract.
 
-This is the process boundary of the north star's deployment shape: the
-reference's Quarkus/common-lib front-end stays intact and forwards
+This is the dependency-free transport of the north star's deployment shape:
+the reference's Quarkus/common-lib front-end stays intact and forwards
 ``PodFailureData`` here instead of running the JVM hot loop; this server
 answers with the full ``AnalysisResult`` (discovery-order events, exact
 scores) plus the frequency admin surface. See proto/logparser.proto for
-the contract and framing.py for the wire format.
+the contract and framing.py for the wire format; grpc_server.py exposes
+the same :class:`~log_parser_tpu.shim.service.LogParserService` over
+standard gRPC.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import socketserver
-import threading
 
-from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
+from log_parser_tpu.shim.service import RPCS, InvalidPodError, LogParserService
 
 log = logging.getLogger(__name__)
 
@@ -28,8 +28,20 @@ class ShimServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, address: tuple[str, int], engine):
         super().__init__(address, _Handler)
-        self.engine = engine
-        self.analyze_lock = threading.Lock()
+        self.service = LogParserService(engine)
+        # dispatch: method name -> (request ctor, bound service method)
+        self.dispatch = {
+            name: (req_t, getattr(self.service, attr))
+            for name, req_t, _resp_t, attr in RPCS
+        }
+
+    @property
+    def engine(self):
+        return self.service.engine
+
+    @property
+    def analyze_lock(self):
+        return self.service.lock
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -48,111 +60,28 @@ class _Handler(socketserver.BaseRequestHandler):
             envelope = pb.Envelope()
             try:
                 envelope.ParseFromString(frame)
-                response = self._dispatch(envelope)
+                entry = self.server.dispatch.get(envelope.method)
+                if entry is None:
+                    response = pb.Envelope(
+                        method=envelope.method,
+                        error=f"unknown method {envelope.method!r}",
+                    )
+                else:
+                    req_t, fn = entry
+                    req = req_t()
+                    req.ParseFromString(envelope.payload)
+                    response = pb.Envelope(
+                        method=envelope.method,
+                        payload=fn(req).SerializeToString(),
+                    )
+            except (InvalidPodError, ValueError) as exc:
+                # expected client errors: no traceback, keep the log quiet
+                log.info("shim client error on %s: %s", envelope.method, exc)
+                response = pb.Envelope(method=envelope.method, error=str(exc))
             except Exception as exc:  # contained per request
                 log.exception("shim call failed")
                 response = pb.Envelope(method=envelope.method, error=str(exc))
             write_frame(sock, response.SerializeToString())
-
-    # ------------------------------------------------------------- dispatch
-
-    def _dispatch(self, env: pb.Envelope) -> pb.Envelope:
-        engine = self.server.engine
-        method = env.method
-        if method == "Parse":
-            return self._parse(env)
-        if method == "Health":
-            return _reply(method, pb.HealthResponse(status="UP"))
-        # frequency state is shared with in-flight Parse calls on other
-        # connections — all admin access goes through the same lock
-        if method == "FrequencyStats":
-            with self.server.analyze_lock:
-                stats = engine.frequency.get_frequency_statistics()
-            return _reply(
-                method, pb.FrequencyStatsResponse(windowed_counts=stats)
-            )
-        if method == "FrequencyReset":
-            req = pb.FrequencyResetRequest()
-            req.ParseFromString(env.payload)
-            with self.server.analyze_lock:
-                if req.pattern_id:
-                    engine.frequency.reset_pattern_frequency(req.pattern_id)
-                else:
-                    engine.frequency.reset_all_frequencies()
-            return _reply(method, pb.FrequencyResetResponse())
-        if method == "FrequencySnapshot":
-            resp = pb.FrequencySnapshotResponse()
-            with self.server.analyze_lock:
-                snap = engine.frequency.snapshot()
-            for pid, ages in snap.items():
-                resp.ages[pid].ages_seconds.extend(ages)
-            return _reply(method, resp)
-        if method == "FrequencyRestore":
-            req = pb.FrequencyRestoreRequest()
-            req.ParseFromString(env.payload)
-            with self.server.analyze_lock:
-                engine.frequency.restore(
-                    {pid: list(al.ages_seconds) for pid, al in req.ages.items()}
-                )
-            return _reply(method, pb.FrequencyRestoreResponse())
-        return pb.Envelope(method=method, error=f"unknown method {method!r}")
-
-    def _parse(self, env: pb.Envelope) -> pb.Envelope:
-        req = pb.ParseRequest()
-        req.ParseFromString(env.payload)
-        # Parse.java:45-49 — a null pod is a client error
-        pod = json.loads(req.pod_json) if req.pod_json else None
-        if pod is None:
-            return pb.Envelope(
-                method="Parse", error="Invalid PodFailureData provided"
-            )
-        data = PodFailureData(pod=pod, logs=req.logs)
-        with self.server.analyze_lock:
-            result = self.server.engine.analyze(data)
-
-        resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
-        for event in result.events:
-            ctx = event.context
-            pb_ctx = pb.EventContext()
-            if ctx is not None:
-                pb_ctx.matched_line = ctx.matched_line or ""
-                if ctx.lines_before is not None:
-                    pb_ctx.has_lines_before = True
-                    pb_ctx.lines_before.extend(ctx.lines_before)
-                if ctx.lines_after is not None:
-                    pb_ctx.has_lines_after = True
-                    pb_ctx.lines_after.extend(ctx.lines_after)
-            resp.events.append(
-                pb.MatchedEvent(
-                    line_number=event.line_number,
-                    pattern_json=json.dumps(
-                        event.matched_pattern.to_dict(drop_none=True)
-                    )
-                    if event.matched_pattern is not None
-                    else "",
-                    context=pb_ctx,
-                    score=event.score,
-                )
-            )
-        md = result.metadata
-        if md is not None:
-            resp.metadata.processing_time_ms = md.processing_time_ms or 0
-            resp.metadata.total_lines = md.total_lines or 0
-            resp.metadata.analyzed_at = md.analyzed_at or ""
-            resp.metadata.patterns_used.extend(
-                x or "" for x in (md.patterns_used or [])
-            )
-        sm = result.summary
-        if sm is not None:
-            resp.summary.significant_events = sm.significant_events or 0
-            resp.summary.highest_severity = sm.highest_severity or ""
-            for sev, count in (sm.severity_distribution or {}).items():
-                resp.summary.severity_distribution[sev] = count
-        return _reply("Parse", resp)
-
-
-def _reply(method: str, message) -> pb.Envelope:
-    return pb.Envelope(method=method, payload=message.SerializeToString())
 
 
 def make_shim_server(engine, host: str = "127.0.0.1", port: int = 9090) -> ShimServer:

@@ -162,3 +162,28 @@ def test_no_fallback_late_failure_still_rolls_back(monkeypatch):
     with pytest.raises(TypeError):
         engine.analyze(data)
     assert engine.frequency.get_frequency_statistics() == {}
+
+
+def test_restore_replaces_all_state():
+    """restore() rebuilds from the snapshot — ids absent from the payload
+    are cleared, not merged (round-1 advisor finding)."""
+    clock = FakeClock()
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=clock)
+    engine.analyze(PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS))
+    assert engine.frequency.get_frequency_statistics() == {"e": 2}
+
+    engine.frequency.restore({"other": [1.0, 2.0]})
+    assert engine.frequency.get_frequency_statistics() == {"other": 2}
+    assert not engine.frequency.has_entry("e")
+
+
+def test_restore_rejects_negative_ages():
+    """Negative ages are future timestamps that never prune; the whole
+    payload is rejected before any state is touched (all-or-nothing)."""
+    clock = FakeClock()
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=clock)
+    engine.analyze(PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS))
+    with pytest.raises(ValueError):
+        engine.frequency.restore({"e": [1.0], "x": [-0.5]})
+    # prior state untouched
+    assert engine.frequency.get_frequency_statistics() == {"e": 2}

@@ -42,3 +42,15 @@ def test_records_in_discovery_order_multi_pattern():
     )
     got = [(e.line_number, e.matched_pattern.id) for e in result.events]
     assert got == [(1, "b"), (3, "a"), (3, "b"), (4, "a")]
+
+
+def test_encode_rows_divisible_by_non_pow2_min_rows():
+    """A sharded engine passes the mesh size as min_rows; on a 6-device
+    mesh the row count must stay divisible by 6 even though rows are
+    otherwise padded to powers of two (round-1 advisor finding)."""
+    from log_parser_tpu.ops.encode import encode_lines
+
+    for n in (1, 5, 6, 7, 48, 100):
+        enc = encode_lines([f"line {i}" for i in range(n)], min_rows=6)
+        assert enc.u8.shape[0] % 6 == 0, (n, enc.u8.shape)
+        assert enc.u8.shape[0] >= n

@@ -1,0 +1,138 @@
+"""Standard-gRPC transport of the LogParser service — the same contract
+test_shim.py runs over the framed socket (proto/logparser.proto
+``service LogParser``; VERDICT.md round-1 missing #5)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.shim import logparser_pb2 as pb
+from log_parser_tpu.shim.grpc_server import (
+    HAVE_GRPC,
+    make_channel_stubs,
+    make_grpc_server,
+)
+
+from helpers import make_pattern, make_pattern_set
+
+pytestmark = pytest.mark.skipif(not HAVE_GRPC, reason="grpcio not installed")
+
+
+@pytest.fixture(scope="module")
+def stubs():
+    import grpc
+
+    sets = [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom", regex="OutOfMemoryError", confidence=0.8, severity="HIGH",
+                    secondaries=[("GC overhead", 0.6, 10)], context=(1, 1),
+                )
+            ]
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    server, port = make_grpc_server(engine, host="127.0.0.1", port=0)
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield make_channel_stubs(channel)
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_health(stubs):
+    assert stubs["Health"](pb.HealthRequest()).status == "UP"
+
+
+def test_parse_roundtrip(stubs):
+    resp = stubs["Parse"](
+        pb.ParseRequest(
+            pod_json=json.dumps({"metadata": {"name": "web-1"}}),
+            logs="boot\nGC overhead limit\njava.lang.OutOfMemoryError: heap\ndone",
+        )
+    )
+    assert resp.analysis_id
+    assert resp.summary.highest_severity == "HIGH"
+    [event] = resp.events
+    assert event.line_number == 3
+    assert list(event.context.lines_before) == ["GC overhead limit"]
+    assert json.loads(event.pattern_json)["id"] == "oom"
+    assert event.score > 0
+    assert resp.metadata.total_lines == 4
+
+
+def test_null_pod_is_invalid_argument(stubs):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs["Parse"](pb.ParseRequest(pod_json="", logs="x"))
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "Invalid PodFailureData" in err.value.details()
+
+
+def test_frequency_surface(stubs):
+    stubs["Parse"](
+        pb.ParseRequest(
+            pod_json=json.dumps({"metadata": {"name": "w"}}),
+            logs="java.lang.OutOfMemoryError",
+        )
+    )
+    stats = stubs["FrequencyStats"](pb.FrequencyStatsRequest())
+    assert stats.windowed_counts["oom"] >= 1
+
+    snap = stubs["FrequencySnapshot"](pb.FrequencySnapshotRequest())
+    assert len(snap.ages["oom"].ages_seconds) >= 1
+
+    stubs["FrequencyReset"](pb.FrequencyResetRequest())
+    stats = stubs["FrequencyStats"](pb.FrequencyStatsRequest())
+    assert len(stats.windowed_counts) == 0
+
+    restore = pb.FrequencyRestoreRequest()
+    restore.ages["oom"].ages_seconds.extend(snap.ages["oom"].ages_seconds)
+    stubs["FrequencyRestore"](restore)
+    stats = stubs["FrequencyStats"](pb.FrequencyStatsRequest())
+    assert stats.windowed_counts["oom"] >= 1
+
+
+def test_shared_service_single_lock():
+    """--grpc-port shares the framed server's LogParserService so both
+    transports serialize on ONE lock (round-2 review finding)."""
+    import threading
+
+    from log_parser_tpu.shim import make_shim_server
+    from log_parser_tpu.shim.grpc_server import make_grpc_server
+
+    sets = [make_pattern_set([make_pattern("e", regex="ERROR")])]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    framed = make_shim_server(engine, host="127.0.0.1", port=0)
+    server, port = make_grpc_server(
+        engine, host="127.0.0.1", port=0, service=framed.service
+    )
+    try:
+        assert framed.analyze_lock is framed.service.lock
+        # both transports answer through the same service instance
+        threading.Thread(target=framed.serve_forever, daemon=True).start()
+        server.start()
+        import grpc
+
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stubs = make_channel_stubs(ch)
+            assert stubs["Health"](pb.HealthRequest()).status == "UP"
+    finally:
+        server.stop(grace=None)
+        framed.shutdown()
+
+
+def test_restore_nan_age_rejected(stubs):
+    import grpc
+
+    req = pb.FrequencyRestoreRequest()
+    req.ages["e"].ages_seconds.append(float("nan"))
+    with pytest.raises(grpc.RpcError) as err:
+        stubs["FrequencyRestore"](req)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
