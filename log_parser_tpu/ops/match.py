@@ -224,8 +224,11 @@ class MatcherBanks:
 
     Tier selection is static per column (patterns/bank.py): literal-shaped
     regexes go to the bit-parallel Shift-Or bank (cost independent of bank
-    size), the rest to the packed DFA bank, and automaton-unsupported
-    regexes stay host-side (the engine injects them as cube overrides).
+    size); in wide banks, regexes with required literals ride the AC
+    prefilter + per-record verify tier (ops/prefilter.py — cost per byte
+    independent of library width); the rest go to the packed dense DFA
+    bank; automaton-unsupported regexes stay host-side (the engine injects
+    them as cube overrides).
     """
 
     # below this many device columns, the whole bank rides the pair-stride
@@ -234,10 +237,20 @@ class MatcherBanks:
     # Wide banks (the 10k-regex configuration) move every literal-shaped
     # column to Shift-Or, whose per-step cost is O(packed words), not O(R).
     SHIFTOR_MIN_COLUMNS = 64
+    # below this many DENSE-DFA columns, the prefilter tier stays off: the
+    # dense gather is cheap and the extra scans aren't worth their latency
+    PREFILTER_MIN_COLUMNS = 64
 
-    def __init__(self, bank, stride: int = 2, shiftor_min_columns: int | None = None):
+    def __init__(
+        self,
+        bank,
+        stride: int = 2,
+        shiftor_min_columns: int | None = None,
+        prefilter_min_columns: int | None = None,
+    ):
         import jax.numpy as jnp
 
+        from log_parser_tpu.ops.prefilter import PrefilterBank
         from log_parser_tpu.ops.shiftor import ShiftOrBank
 
         self.bank = bank
@@ -245,6 +258,11 @@ class MatcherBanks:
             self.SHIFTOR_MIN_COLUMNS
             if shiftor_min_columns is None
             else shiftor_min_columns
+        )
+        pref_threshold = (
+            self.PREFILTER_MIN_COLUMNS
+            if prefilter_min_columns is None
+            else prefilter_min_columns
         )
         n_device = sum(
             1
@@ -258,7 +276,7 @@ class MatcherBanks:
             if c.exact_seqs is not None and (use_shiftor or c.dfa is None)
         ]
         shiftor_set = set(self.shiftor_cols)
-        self.dfa_cols = [
+        dense_cols = [
             i
             for i, c in enumerate(bank.columns)
             if c.dfa is not None and i not in shiftor_set
@@ -268,6 +286,23 @@ class MatcherBanks:
             for i, c in enumerate(bank.columns)
             if c.dfa is None and c.exact_seqs is None
         ]
+
+        # prefilter tier: DFA columns with a non-empty required-literal set,
+        # engaged only for wide banks and within the trie budget
+        self.prefilter: PrefilterBank | None = None
+        self.prefilter_cols: list[int] = []
+        if len(dense_cols) >= pref_threshold:
+            eligible = [
+                (i, bank.columns[i]) for i in dense_cols if bank.columns[i].literals
+            ]
+            selected, _rejected = PrefilterBank.select(eligible)
+            if len(selected) >= pref_threshold:
+                self.prefilter = PrefilterBank(selected)
+                self.prefilter_cols = [g for g, _ in selected]
+                pref_set = set(self.prefilter_cols)
+                dense_cols = [i for i in dense_cols if i not in pref_set]
+
+        self.dfa_cols = dense_cols
         self.dfa_bank = DfaBank(
             [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
         )
@@ -282,7 +317,7 @@ class MatcherBanks:
 
     @property
     def device_cols(self) -> list[int]:
-        return self.shiftor_cols + self.dfa_cols
+        return self.shiftor_cols + self.dfa_cols + self.prefilter_cols
 
     def cube(self, lines_tb, lengths):
         """uint8 [T, B] + lengths -> bool [B, n_columns] match cube
@@ -304,6 +339,10 @@ class MatcherBanks:
             steppers.append(
                 (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
             )
+        if self.prefilter is not None:
+            steppers.append(
+                (self.prefilter.anyhit_stepper(B, lengths), None, False)
+            )
         if not steppers:
             return cube
 
@@ -321,6 +360,12 @@ class MatcherBanks:
         finals, _ = jax.lax.scan(fused_step, inits, (pairs, ts))
         for (stepper, cols, is_dfa), carry in zip(steppers, finals):
             out = stepper[2](carry)
+            if cols is None:  # prefilter: any-hit bits -> stages 2+3
+                contrib = self.prefilter.contribution(lines_tb, lengths, out)
+                cube = cube.at[
+                    :, jnp.asarray(np.asarray(self.prefilter_cols))
+                ].set(contrib)
+                continue
             if is_dfa:
                 out = out[:, : len(cols)]
             cube = cube.at[:, jnp.asarray(np.asarray(cols))].set(out)

@@ -28,13 +28,23 @@ class AhoCorasick:
     ``literals``: the byte strings, id = list index. Matching is exact on
     bytes — for case-insensitive behavior, fold the literals before
     construction and fold the input before scanning.
+
+    ``groups``: optional group id per literal (e.g. the owning matcher
+    column); output bitmasks are then over groups, so several literals of
+    one column OR into a single bit and duplicated strings across columns
+    simply share trie nodes. Default: each literal is its own group.
     """
 
-    def __init__(self, literals: list[bytes]):
+    def __init__(self, literals: list[bytes], groups: list[int] | None = None):
         self.literals = literals
         n = len(literals)
         self.n_literals = n
-        self.n_words = max(1, (n + 31) // 32)
+        if groups is None:
+            groups = list(range(n))
+        assert len(groups) == n
+        self.groups = groups
+        self.n_groups = (max(groups) + 1) if groups else 0
+        self.n_words = max(1, (self.n_groups + 31) // 32)
 
         # --- trie -----------------------------------------------------------
         children: list[dict[int, int]] = [{}]
@@ -81,11 +91,12 @@ class AhoCorasick:
                 else:
                     goto[node, cls] = goto[fail[node], cls]
 
-        # --- packed outputs -------------------------------------------------
+        # --- packed outputs (bits are GROUP ids) ----------------------------
         out_words = np.zeros((n_nodes, self.n_words), dtype=np.uint32)
         for node in range(n_nodes):
             for lid in out[node]:
-                out_words[node, lid // 32] |= np.uint32(1 << (lid % 32))
+                gid = groups[lid]
+                out_words[node, gid // 32] |= np.uint32(1 << (gid % 32))
 
         self.n_nodes = n_nodes
         self.n_classes = n_classes

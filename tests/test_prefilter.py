@@ -1,0 +1,143 @@
+"""AC prefilter + per-record verify tier (ops/prefilter.py) vs the host
+reference and the dense DFA path it replaces — including the in-program
+dense fallback on capacity overflow (VERDICT.md round-1 next #3)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pattern import (
+    Pattern,
+    PatternSet,
+    PatternSetMetadata,
+    PrimaryPattern,
+)
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import MatcherBanks
+from log_parser_tpu.patterns.bank import PatternBank
+
+from helpers import make_pattern, make_pattern_set
+
+
+def _bank_of(regexes: list[str]) -> PatternBank:
+    patterns = [
+        Pattern(
+            id=f"p{i}",
+            name=f"p{i}",
+            severity="HIGH",
+            primary_pattern=PrimaryPattern(regex=rx, confidence=0.5),
+        )
+        for i, rx in enumerate(regexes)
+    ]
+    return PatternBank(
+        [PatternSet(metadata=PatternSetMetadata(library_id="t"), patterns=patterns)]
+    )
+
+
+# literal-bearing but not literal-shaped: these must land in the prefilter
+# tier when it is engaged
+PREF_REGEXES = [
+    "conn-%03d: (refused|reset)" % i for i in range(20)
+] + [
+    "svc-%03d\\s+(fatal|panic)" % i for i in range(20)
+] + [
+    "^\\d+ node-%03d down" % i for i in range(20)
+]
+
+
+def _host_cube(bank: PatternBank, lines: list[str]) -> np.ndarray:
+    out = np.zeros((len(lines), bank.n_columns), dtype=bool)
+    for i, line in enumerate(lines):
+        for c, col in enumerate(bank.columns):
+            out[i, c] = bool(col.host.search(line))
+    return out
+
+
+def _device_cube(mb: MatcherBanks, lines: list[str]) -> np.ndarray:
+    enc = encode_lines(lines)
+    cube = np.asarray(mb.cube(jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths)))
+    return cube[: len(lines)]
+
+
+def _lines_sparse(n: int = 200) -> list[str]:
+    rng = np.random.default_rng(11)
+    lines = []
+    for j in range(n):
+        r = j % 17
+        if r == 3:
+            i = int(rng.integers(0, 20))
+            lines.append(f"conn-{i:03d}: refused")
+        elif r == 5:
+            i = int(rng.integers(0, 20))
+            lines.append(f"svc-{i:03d}  fatal")
+        elif r == 7:
+            i = int(rng.integers(0, 20))
+            lines.append(f"77 node-{i:03d} down")
+        elif r == 9:  # literal present but regex does NOT match (verify must kill)
+            lines.append("conn-001: accepted")
+        elif r == 11:  # case-folded literal hit, regex is case-sensitive
+            lines.append("CONN-002: REFUSED")
+        else:
+            lines.append(f"INFO tick {j} all ok")
+    return lines
+
+
+class TestPrefilterTier:
+    def test_engaged_for_wide_banks(self):
+        bank = _bank_of(PREF_REGEXES)
+        mb = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
+        assert mb.prefilter is not None
+        assert len(mb.prefilter_cols) >= 32
+        # dense DFA bank shrank accordingly
+        assert set(mb.prefilter_cols).isdisjoint(mb.dfa_cols)
+
+    def test_not_engaged_below_threshold(self):
+        bank = _bank_of(PREF_REGEXES[:10])
+        mb = MatcherBanks(bank)
+        assert mb.prefilter is None
+
+    def test_sparse_path_parity_with_host(self):
+        bank = _bank_of(PREF_REGEXES)
+        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
+        dense = MatcherBanks(bank, prefilter_min_columns=10 ** 9, shiftor_min_columns=10 ** 9)
+        assert pref.prefilter is not None and dense.prefilter is None
+        lines = _lines_sparse()
+        want = _host_cube(bank, lines)
+        np.testing.assert_array_equal(_device_cube(pref, lines), want)
+        np.testing.assert_array_equal(_device_cube(dense, lines), want)
+
+    def test_overflow_falls_back_dense_and_stays_exact(self):
+        """Every line carries literals -> hit compaction overflows -> the
+        lax.cond dense branch must produce identical results."""
+        bank = _bank_of(PREF_REGEXES)
+        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
+        lines = [f"conn-{i % 20:03d}: refused and svc-{i % 20:03d}  fatal" for i in range(512)]
+        want = _host_cube(bank, lines)
+        np.testing.assert_array_equal(_device_cube(pref, lines), want)
+
+    def test_engine_parity_with_prefilter_engaged(self):
+        """Full engine vs golden on a library wide enough to engage the
+        prefilter via the default threshold."""
+        from log_parser_tpu.golden import GoldenAnalyzer
+        from log_parser_tpu.models import PodFailureData
+        from log_parser_tpu.runtime import AnalysisEngine
+
+        from test_engine_parity import assert_results_match
+
+        # \s+ keeps these out of the fixed-length Shift-Or tier so they
+        # exercise the prefilter through the default thresholds
+        regexes = ["conn-%03d:\\s+(refused|reset)" % i for i in range(70)]
+        patterns = [
+            make_pattern(f"p{i}", regex=rx, confidence=0.6, severity="MEDIUM")
+            for i, rx in enumerate(regexes)
+        ]
+        sets = [make_pattern_set(patterns)]
+        engine = AnalysisEngine(sets, ScoringConfig())
+        assert engine.matchers.prefilter is not None  # default threshold engaged
+        logs = "\n".join(_lines_sparse(150))
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+        golden = GoldenAnalyzer(sets, ScoringConfig())
+        assert_results_match(engine.analyze(data), golden.analyze(data))
