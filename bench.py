@@ -5,15 +5,17 @@ library, single device). The reference publishes no numbers (BASELINE.md);
 ``vs_baseline`` is therefore reported against the north-star target of
 1M log-lines/sec/chip from BASELINE.json.
 
-Fail-fast contract (VERDICT.md round-1 postmortem): the golden host
-fallback is DISABLED for the bench, and backend init is probed in a
-subprocess with a bounded timeout before any real work — a hung or broken
-device tunnel produces a clean non-zero exit with a diagnostic JSON line
-within ~2 minutes instead of burning the driver's whole time budget in
-pure-Python fallback (the round-1 rc=124 failure mode).
+Backend contract (VERDICT.md round-2 postmortem): the golden host
+fallback is DISABLED for the bench, and backend init runs as a staged
+campaign in throwaway subprocesses (bench_common.probe_backend).  If the
+device layer never comes up within the total probe budget the bench runs
+on the pinned JAX host (CPU) platform and records a clearly-labeled
+``{"platform": "cpu"}`` floor with the probe diagnostics embedded — the
+artifact is never null.
 
-Prints exactly one JSON line on success:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "platform": "tpu"|"cpu", ...}
 """
 
 from __future__ import annotations
@@ -52,7 +54,7 @@ def build_corpus(n: int) -> str:
 
 
 def main() -> None:
-    bench_common.probe_backend_or_exit("log_lines_scored_per_sec_per_chip", "lines/s")
+    platform = bench_common.probe_backend("log_lines_scored_per_sec_per_chip", "lines/s")
 
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
@@ -74,15 +76,13 @@ def main() -> None:
     lines_per_sec = N_LINES / best
 
     assert result.summary.significant_events > 0
-    print(
-        json.dumps(
-            {
-                "metric": "log_lines_scored_per_sec_per_chip",
-                "value": round(lines_per_sec, 1),
-                "unit": "lines/s",
-                "vs_baseline": round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),
-            }
-        )
+    bench_common.emit(
+        "log_lines_scored_per_sec_per_chip",
+        round(lines_per_sec, 1),
+        "lines/s",
+        round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),
+        platform,
+        n_lines=N_LINES,
     )
 
 

@@ -15,7 +15,6 @@ full `--patterns 10000`.
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 
@@ -91,7 +90,7 @@ def main() -> None:
     import shutil
     import tempfile
 
-    bench_common.probe_backend_or_exit(
+    platform = bench_common.probe_backend(
         f"match_lines_per_sec_{N_PATTERNS}regex_library", "lines/s"
     )
 
@@ -121,16 +120,14 @@ def main() -> None:
         elapsed = time.perf_counter() - t0
         assert result.summary.significant_events > 0
 
-        print(
-            json.dumps(
-                {
-                    "metric": f"match_lines_per_sec_{N_PATTERNS}regex_library",
-                    "value": round(N_LINES / elapsed, 1),
-                    "unit": "lines/s",
-                    "vs_baseline": round(warm_compile, 3),
-                    "cold_compile_s": round(cold_compile, 3),
-                }
-            )
+        bench_common.emit(
+            f"match_lines_per_sec_{N_PATTERNS}regex_library",
+            round(N_LINES / elapsed, 1),
+            "lines/s",
+            round(warm_compile, 3),
+            platform,
+            cold_compile_s=round(cold_compile, 3),
+            n_lines=N_LINES,
         )
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)

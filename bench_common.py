@@ -1,15 +1,25 @@
-"""Shared fail-fast harness for the bench scripts (bench.py, bench_bank.py,
+"""Shared harness for the bench scripts (bench.py, bench_bank.py,
 bench_latency.py).
 
-Round-1 postmortem (VERDICT.md): a hung device tunnel plus the engine's
+Round-1 postmortem (VERDICT.md r1): a hung device tunnel plus the engine's
 golden host fallback turned a benchmark into a silent multi-minute
-pure-Python crawl and an rc=124 timeout. Every bench therefore:
+pure-Python crawl and an rc=124 timeout.  Round-2 postmortem (VERDICT.md
+r2): the fail-fast fix over-corrected — one 100s probe window, no retry
+for slow inits, and a ``null`` artifact when it expired.  A clean failure
+is not a number.
 
-- disables the golden fallback (a bench number from the host path would be
-  nonsense), and
-- probes backend init in a THROWAWAY subprocess under one total wall
-  budget before doing any real work, exiting non-zero with a diagnostic
-  JSON line if the device layer is down.
+This version treats backend init as a campaign, not a probe:
+
+- the golden fallback stays disabled (a bench number silently served from
+  the pure-Python host path would be nonsense);
+- backend init runs in THROWAWAY subprocesses in staged attempts under a
+  total wall budget (default 600s — well past one cold TPU runtime start),
+  with the full stderr tail of every attempt kept;
+- if the device backend never comes up, the bench DOES NOT exit null: it
+  pins the JAX host (CPU) platform and records a clearly-labeled
+  ``{"platform": "cpu"}`` floor, with the device-probe diagnostics
+  embedded in the artifact.  Every artifact therefore carries a non-null
+  value and enough detail to debug the device layer.
 
 Importing this module sets ``LOG_PARSER_TPU_NO_FALLBACK=1``; import it
 before constructing any engine.
@@ -25,10 +35,21 @@ import time
 
 os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
 
-# one real-compile warmup can take 20-40s on TPU; device *init* alone
-# should take far less, but the axon tunnel has been observed to hang
-# indefinitely — hence a hard TOTAL wall across all probe attempts
-PROBE_TIMEOUT_S = float(os.environ.get("LOG_PARSER_TPU_PROBE_TIMEOUT", "100"))
+# Total wall budget for device-backend init attempts.  One real compile
+# warmup takes 20-40s on TPU; a *cold runtime* start through the axon
+# tunnel has been observed to exceed 100s, and the tunnel has also been
+# observed to hang indefinitely — so: a large total budget, staged into
+# attempts, then a labeled CPU floor instead of giving up.
+PROBE_TIMEOUT_S = float(os.environ.get("LOG_PARSER_TPU_PROBE_TIMEOUT", "600"))
+
+# Per-attempt ceilings.  Early attempts are short so a fast deterministic
+# error gets retried quickly; later attempts grow so a slow-but-live init
+# can finish.  The loop itself runs until the total deadline, not until
+# the ceilings run out — the last ceiling repeats.
+_ATTEMPT_CEILINGS_S = (90.0, 180.0, 300.0)
+# Pause between fast deterministic failures so a restarting runtime gets
+# time to come back instead of burning every attempt in the first seconds.
+_RETRY_PAUSE_S = 20.0
 
 _PROBE_SRC = """
 import os, jax
@@ -45,58 +66,131 @@ x = jnp.arange(64, dtype=jnp.int32)
 print("PROBE_OK", d[0].platform, len(d), flush=True)
 """
 
+#: Filled by probe_backend(); benches embed it in their artifact when the
+#: device layer failed and they fell back to the CPU floor.
+last_probe_diagnostics: list[dict] = []
 
-def pin_platform() -> None:
-    """Apply LOG_PARSER_TPU_PLATFORM to the CURRENT process (the axon
-    sitecustomize overrides the JAX_PLATFORMS env var at config level)."""
-    if os.environ.get("LOG_PARSER_TPU_PLATFORM"):
+
+def pin_platform(platform: str | None = None) -> None:
+    """Pin the CURRENT process's JAX platform (the axon sitecustomize
+    overrides the JAX_PLATFORMS env var at config level, so this must be
+    a config-level update)."""
+    p = platform or os.environ.get("LOG_PARSER_TPU_PLATFORM")
+    if p:
+        os.environ["LOG_PARSER_TPU_PLATFORM"] = p
         import jax
 
-        jax.config.update("jax_platforms", os.environ["LOG_PARSER_TPU_PLATFORM"])
+        jax.config.update("jax_platforms", p)
 
 
-def probe_backend_or_exit(metric: str, unit: str) -> str:
-    """Initialize the configured JAX backend in a throwaway subprocess under
-    one total wall budget (PROBE_TIMEOUT_S); returns the platform name, or
-    prints a diagnostic JSON line in the bench's schema and exits 3. Fast
-    deterministic init errors get one retry (the axon backend has been seen
-    to error once then recover); a hang consumes the whole budget exactly
-    once — no retry can help it."""
+def _one_attempt(timeout_s: float) -> tuple[str | None, dict]:
+    """Run the probe subprocess once.  Returns (platform or None, diag)."""
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        return None, {
+            "outcome": "timeout",
+            "timeout_s": round(timeout_s, 1),
+            "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace") if isinstance(e.stderr, bytes) else (e.stderr or ""))[-2000:],
+        }
+    elapsed = time.monotonic() - t0
+    if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        platform = r.stdout.split("PROBE_OK", 1)[1].split()[0]
+        return platform, {"outcome": "ok", "platform": platform, "elapsed_s": round(elapsed, 1)}
+    return None, {
+        "outcome": "error",
+        "rc": r.returncode,
+        "elapsed_s": round(elapsed, 1),
+        "stderr_tail": (r.stderr or r.stdout or "no output")[-2000:],
+    }
+
+
+def probe_backend(metric: str, unit: str) -> str:
+    """Bring up a JAX backend for this bench, preferring the device.
+
+    Staged subprocess attempts under PROBE_TIMEOUT_S total; on success the
+    current process is pinned to that platform and its name is returned.
+    If every device attempt fails, falls back to the JAX host (CPU)
+    platform — pinned in-process so a hung device plugin is never touched
+    — and returns ``"cpu"``.  Device-attempt diagnostics are left in
+    ``last_probe_diagnostics`` for the bench to embed in its artifact.
+
+    The bench never exits without a number: a CPU-floor run is a labeled
+    regression-checkable datapoint, not a substitute for the device run
+    (VERDICT.md r2 "Next round" item 1).
+    """
+    global last_probe_diagnostics
+    last_probe_diagnostics = []
+
+    explicit = os.environ.get("LOG_PARSER_TPU_PLATFORM")
     deadline = time.monotonic() + PROBE_TIMEOUT_S
-    last = ""
-    for attempt in (1, 2):
+    attempt = 0
+    while True:
         remaining = deadline - time.monotonic()
         if remaining <= 1:
             break
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True,
-                text=True,
-                timeout=remaining,
-            )
-        except subprocess.TimeoutExpired:
-            last = (
-                f"backend init exceeded probe budget "
-                f"({PROBE_TIMEOUT_S:.0f}s total, attempt {attempt})"
-            )
-            break
-        if r.returncode == 0 and "PROBE_OK" in r.stdout:
-            platform = r.stdout.split("PROBE_OK", 1)[1].split()[0]
-            print(f"# backend ok: {platform}", file=sys.stderr)
+        ceiling = _ATTEMPT_CEILINGS_S[min(attempt, len(_ATTEMPT_CEILINGS_S) - 1)]
+        attempt += 1
+        platform, diag = _one_attempt(min(ceiling, remaining))
+        diag["attempt"] = attempt
+        last_probe_diagnostics.append(diag)
+        if platform is not None:
+            print(f"# backend ok: {platform} (attempt {attempt})", file=sys.stderr)
             pin_platform()
+            last_probe_diagnostics = []
             return platform
-        tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
-        last = f"probe rc={r.returncode}: {tail[0][:300]} (attempt {attempt})"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": None,
-                "unit": unit,
-                "vs_baseline": None,
-                "error": f"device backend unavailable: {last}",
-            }
+        print(f"# backend attempt {attempt} failed: {diag['outcome']}", file=sys.stderr)
+        # a hang consumed its whole window; a fast deterministic error
+        # waits out a pause first so a restarting runtime can recover —
+        # either way the loop runs until the total budget is gone
+        if diag["outcome"] != "timeout":
+            time.sleep(min(_RETRY_PAUSE_S, max(0.0, deadline - time.monotonic())))
+
+    if explicit:
+        # an explicitly-requested platform that won't come up is a hard
+        # failure — there is no meaningful floor to substitute
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": None,
+                    "unit": unit,
+                    "vs_baseline": None,
+                    "platform": explicit,
+                    "error": f"requested platform {explicit!r} unavailable",
+                    "device_probe": last_probe_diagnostics,
+                }
+            )
         )
+        sys.exit(3)
+
+    print(
+        f"# device backend unavailable after {PROBE_TIMEOUT_S:.0f}s; "
+        "falling back to labeled CPU floor",
+        file=sys.stderr,
     )
-    sys.exit(3)
+    pin_platform("cpu")
+    return "cpu"
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float | None,
+         platform: str, **extra) -> None:
+    """Print the single artifact JSON line, embedding the platform label
+    and (when the device probe failed) the probe diagnostics."""
+    doc = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        "platform": platform,
+    }
+    doc.update(extra)
+    if last_probe_diagnostics:
+        doc["device_probe"] = last_probe_diagnostics
+    print(json.dumps(doc))

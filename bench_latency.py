@@ -43,7 +43,7 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 
 
 def main() -> None:
-    bench_common.probe_backend_or_exit(
+    platform = bench_common.probe_backend(
         f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
         + ("_http" if USE_HTTP else ""),
         "ms",
@@ -97,16 +97,14 @@ def main() -> None:
         lat.append((time.perf_counter() - t0) * 1e3)
     lat.sort()
 
-    print(
-        json.dumps(
-            {
-                "metric": f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
-                + ("_http" if USE_HTTP else ""),
-                "value": round(percentile(lat, 0.99), 3),
-                "unit": "ms",
-                "vs_baseline": round(percentile(lat, 0.50), 3),
-            }
-        )
+    bench_common.emit(
+        f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
+        + ("_http" if USE_HTTP else ""),
+        round(percentile(lat, 0.99), 3),
+        "ms",
+        round(percentile(lat, 0.50), 3),
+        platform,
+        n_requests=REQUESTS,
     )
 
 

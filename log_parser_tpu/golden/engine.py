@@ -82,6 +82,12 @@ DENSITY_RATIO = 0.7
 SEQUENCE_NEAR_WINDOW = 5
 
 
+class SnapshotValidationError(ValueError):
+    """Client-supplied frequency snapshot failed validation (restore is
+    all-or-nothing). A dedicated type so transports can classify it as a
+    client error without catching every ValueError (ADVICE.md r2)."""
+
+
 class GoldenFrequencyTracker:
     """FrequencyTrackingService.java:20-134 — cross-request sliding-window
     match counts keyed by pattern id."""
@@ -185,7 +191,9 @@ class GoldenFrequencyTracker:
         for age_list in ages.values():
             for a in age_list:
                 if not (float(a) >= 0.0):  # also rejects NaN
-                    raise ValueError(f"negative age in frequency snapshot: {a!r}")
+                    raise SnapshotValidationError(
+                        f"negative age in frequency snapshot: {a!r}"
+                    )
         now = self.clock()
         self._frequencies.clear()
         for pid, age_list in ages.items():

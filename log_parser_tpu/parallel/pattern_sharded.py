@@ -118,8 +118,14 @@ class PatternShardedEngine(AnalysisEngine):
         ]
         # block patterns are by construction a subset of the full bank; a
         # lookup miss means the intern table and the blocks diverged, and
-        # defaulting would silently apply the wrong column's overrides
-        assert not missing, f"block columns missing from full bank: {missing[:3]}"
+        # defaulting would silently apply the wrong column's overrides.
+        # RuntimeError, not assert: this invariant must hold under -O too
+        # (ADVICE.md r2) — an object array of Nones would otherwise fail
+        # obscurely downstream.
+        if missing:
+            raise RuntimeError(
+                f"block columns missing from full bank: {missing[:3]}"
+            )
         take = np.asarray(cols)
         return np.ascontiguousarray(om[:, take]), np.ascontiguousarray(ov[:, take])
 

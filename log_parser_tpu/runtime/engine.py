@@ -64,20 +64,53 @@ _DEVICE_ERROR_MARKERS = (
 )
 
 
+def _raised_in_device_layer(exc: BaseException) -> bool:
+    """True when any traceback frame of ``exc`` (or of an exception in its
+    cause/context chain) belongs to a jax/jaxlib module — i.e. the error
+    genuinely originated in the device stack, not in engine code that
+    happens to quote device-sounding text.
+
+    The cause/context chain matters: jax's default traceback filtering
+    (``jax_traceback_filtering='auto'``) strips jax-internal frames from
+    the primary traceback and re-parents the unfiltered exception via
+    ``__cause__``/``__context__`` — inspecting only ``__traceback__``
+    would misclassify genuine device errors as logic bugs."""
+    seen: set[int] = set()
+    current: BaseException | None = exc
+    while current is not None and id(current) not in seen:
+        seen.add(id(current))
+        tb = current.__traceback__
+        while tb is not None:
+            mod = tb.tb_frame.f_globals.get("__name__", "")
+            if mod == "jax" or mod.startswith(("jax.", "jaxlib")):
+                return True
+            tb = tb.tb_next
+        current = current.__cause__ or current.__context__
+    return False
+
+
 def is_device_error(exc: BaseException) -> bool:
     """True only for failures of the device/XLA layer itself — the class of
     error the golden fallback exists for (SURVEY.md §5.3). Logic bugs
     (TypeError in assembly, bad config, ...) must propagate: serving them
     from the host path would hide the bug and, for large batches, convert a
     fast failure into a multi-minute pure-Python crawl (the round-1
-    BENCH_r01 rc=124 failure mode)."""
+    BENCH_r01 rc=124 failure mode).
+
+    A plain RuntimeError counts only when BOTH a known device-layer marker
+    appears in its message AND the exception was raised from a jax/jaxlib
+    frame — a non-device RuntimeError that merely quotes such text (e.g. a
+    log line or downstream response embedded in the message) propagates
+    (ADVICE.md r2)."""
     import jax.errors
 
     if isinstance(exc, jax.errors.JaxRuntimeError):
         return True
     if isinstance(exc, RuntimeError):
         msg = str(exc)
-        return any(marker in msg for marker in _DEVICE_ERROR_MARKERS)
+        return any(marker in msg for marker in _DEVICE_ERROR_MARKERS) and (
+            _raised_in_device_layer(exc)
+        )
     return False
 
 

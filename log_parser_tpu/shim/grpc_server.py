@@ -15,7 +15,7 @@ so environments without grpcio still get the framed transport.
 
 from __future__ import annotations
 
-from log_parser_tpu.shim.service import RPCS, InvalidPodError, LogParserService
+from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
 SERVICE_NAME = "logparser.LogParser"
 
@@ -33,8 +33,11 @@ def _handlers(service: LogParserService):
         def unary(request, context):
             try:
                 return fn(request)
-            except (InvalidPodError, ValueError) as exc:
-                # client errors: null pod, malformed snapshot payloads
+            except CLIENT_ERRORS as exc:
+                # client errors only: null pod, malformed JSON, invalid
+                # snapshot payloads. Internal bugs that surface as plain
+                # ValueError must reach the INTERNAL branch with their
+                # traceback (ADVICE.md r2).
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
             except Exception as exc:  # contained per request
                 context.abort(grpc.StatusCode.INTERNAL, str(exc))

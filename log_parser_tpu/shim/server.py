@@ -17,7 +17,7 @@ import socketserver
 
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
-from log_parser_tpu.shim.service import RPCS, InvalidPodError, LogParserService
+from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
 log = logging.getLogger(__name__)
 
@@ -74,8 +74,11 @@ class _Handler(socketserver.BaseRequestHandler):
                         method=envelope.method,
                         payload=fn(req).SerializeToString(),
                     )
-            except (InvalidPodError, ValueError) as exc:
-                # expected client errors: no traceback, keep the log quiet
+            except CLIENT_ERRORS as exc:
+                # expected client errors only (null pod, malformed JSON,
+                # invalid snapshot payload): no traceback, keep the log
+                # quiet. Internal bugs that happen to raise ValueError hit
+                # the generic branch below with a full traceback.
                 log.info("shim client error on %s: %s", envelope.method, exc)
                 response = pb.Envelope(method=envelope.method, error=str(exc))
             except Exception as exc:  # contained per request

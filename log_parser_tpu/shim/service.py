@@ -23,6 +23,16 @@ class InvalidPodError(ValueError):
         super().__init__("Invalid PodFailureData provided")
 
 
+# The closed set of exception types transports classify as CLIENT errors
+# (gRPC INVALID_ARGUMENT / quiet shim error frames). Deliberately narrow:
+# a broad `except ValueError` here would misclassify internal bugs — e.g.
+# numpy shape mismatches in device assembly — as the caller's fault and
+# swallow their tracebacks (ADVICE.md r2).
+from log_parser_tpu.golden.engine import SnapshotValidationError  # noqa: E402
+
+CLIENT_ERRORS = (InvalidPodError, SnapshotValidationError, json.JSONDecodeError)
+
+
 class LogParserService:
     """The six RPC bodies, protobuf-in/protobuf-out."""
 

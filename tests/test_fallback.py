@@ -94,10 +94,36 @@ def test_logic_bug_propagates_despite_fallback(monkeypatch):
     assert engine.fallback_count == 0
 
 
+def _raised_from(module_name: str, msg: str) -> RuntimeError:
+    """Raise-and-catch a RuntimeError from a frame whose module is
+    ``module_name`` (simulates an error originating inside jax/jaxlib)."""
+    g = {"__name__": module_name, "__builtins__": __builtins__}
+    exec("def r(msg):\n    raise RuntimeError(msg)", g)
+    try:
+        g["r"](msg)
+    except RuntimeError as exc:
+        return exc
+    raise AssertionError("unreachable")
+
+
 def test_is_device_error_classification():
     assert is_device_error(jax.errors.JaxRuntimeError("boom"))
-    assert is_device_error(RuntimeError("Unable to initialize backend 'axon'"))
-    assert is_device_error(RuntimeError("DEADLINE_EXCEEDED: poll"))
+    # device-layer marker AND raised from a jax frame → device error
+    assert is_device_error(
+        _raised_from("jax._src.xla_bridge", "Unable to initialize backend 'axon'")
+    )
+    assert is_device_error(_raised_from("jaxlib.xla_client", "DEADLINE_EXCEEDED: poll"))
+    # marker text quoted by NON-jax code must propagate (ADVICE.md r2): a
+    # log line or downstream response embedding "UNAVAILABLE" is not a
+    # device failure
+    assert not is_device_error(
+        RuntimeError("downstream said: UNAVAILABLE, Unable to initialize backend")
+    )
+    assert not is_device_error(
+        _raised_from("log_parser_tpu.runtime.engine", "quoting UNAVAILABLE text")
+    )
+    # jax frame but no marker → still not classified as a device error
+    assert not is_device_error(_raised_from("jax._src.core", "some tracing bug"))
     assert not is_device_error(RuntimeError("some unrelated runtime issue"))
     assert not is_device_error(TypeError("bug"))
     assert not is_device_error(ValueError("bad value"))
@@ -187,3 +213,22 @@ def test_restore_rejects_negative_ages():
         engine.frequency.restore({"e": [1.0], "x": [-0.5]})
     # prior state untouched
     assert engine.frequency.get_frequency_statistics() == {"e": 2}
+
+
+def test_is_device_error_walks_cause_chain():
+    """jax's traceback filtering strips jax frames from the primary
+    traceback and re-parents the unfiltered exception via __cause__ —
+    classification must follow the chain."""
+    inner = _raised_from("jax._src.xla_bridge", "Unable to initialize backend 'axon'")
+    try:
+        raise RuntimeError("Unable to initialize backend 'axon'") from inner
+    except RuntimeError as outer:
+        assert is_device_error(outer)
+    # implicit chaining (__context__) counts too
+    try:
+        try:
+            raise _raised_from("jaxlib.xla_client", "UNAVAILABLE: socket closed")
+        except RuntimeError:
+            raise RuntimeError("UNAVAILABLE: socket closed")
+    except RuntimeError as outer:
+        assert is_device_error(outer)

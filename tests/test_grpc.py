@@ -136,3 +136,31 @@ def test_restore_nan_age_rejected(stubs):
     with pytest.raises(grpc.RpcError) as err:
         stubs["FrequencyRestore"](req)
     assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_internal_valueerror_is_internal_not_client_error():
+    """An internal bug surfacing as a plain ValueError must be INTERNAL,
+    not INVALID_ARGUMENT — the client-error clause is a closed set
+    (ADVICE.md r2)."""
+    import grpc
+
+    sets = [make_pattern_set([make_pattern("e", regex="ERROR")])]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    server, port = make_grpc_server(engine, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        engine.analyze = lambda data: (_ for _ in ()).throw(
+            ValueError("internal shape mismatch")
+        )
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            local = make_channel_stubs(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                local["Parse"](
+                    pb.ParseRequest(
+                        pod_json=json.dumps({"metadata": {"name": "w"}}), logs="x"
+                    )
+                )
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+            assert "internal shape mismatch" in err.value.details()
+    finally:
+        server.stop(grace=None)
