@@ -1,0 +1,47 @@
+# Container build for the TPU-native log parser — mirrors the 3-stage
+# shape of the reference image (/root/reference/src/main/docker/
+# Dockerfile.native:1-30: dependencies stage, build stage, slim runtime
+# serving :8080) with Python/JAX in place of Mandrel/GraalVM.
+#
+# Build:    docker build -t log-parser-tpu .
+# Run:      docker run -p 8080:8080 -v /shared/patterns:/patterns log-parser-tpu
+# TPU hosts: build with --build-arg JAX_EXTRA="jax[tpu]" on a machine with
+# the libtpu wheel source configured; default is the CPU wheel so the image
+# runs anywhere (the engine is platform-agnostic at import time).
+
+ARG PYTHON_IMAGE=python:3.12-slim
+
+# ---- stage 1: dependencies (cache-friendly, mirrors "dependencies") ----
+FROM ${PYTHON_IMAGE} AS dependencies
+ARG JAX_EXTRA="jax[cpu]"
+WORKDIR /build
+RUN python -m venv /opt/venv
+ENV PATH=/opt/venv/bin:$PATH
+COPY pyproject.toml .
+# resolve third-party deps before source is copied so edits to code don't
+# bust this layer (the reference does the same with mvn dependency:go-offline)
+RUN pip install --no-cache-dir "${JAX_EXTRA}" numpy pyyaml
+
+# ---- stage 2: build (wheel + native runtime library) -------------------
+FROM dependencies AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+COPY . /build
+# the native ingest/DFA library is an accelerator, never a requirement —
+# prebuild it here so the runtime stage needs no toolchain
+RUN g++ -O3 -std=c++17 -shared -fPIC native/log_parser_native.cpp \
+        -o native/build/log_parser_native.so \
+    && pip install --no-cache-dir --no-deps .
+
+# ---- stage 3: slim runtime serving :8080 (mirrors ubi-minimal stage) ---
+FROM ${PYTHON_IMAGE}
+WORKDIR /work
+COPY --from=dependencies /opt/venv /opt/venv
+COPY --from=build /opt/venv/lib/python*/site-packages/log_parser_tpu \
+     /opt/venv/lib/python3.12/site-packages/log_parser_tpu
+COPY --from=build /build/native/build/log_parser_native.so /work/native/build/
+COPY --from=build /build/native/log_parser_native.cpp /work/native/
+ENV PATH=/opt/venv/bin:$PATH \
+    PATTERN_DIRECTORY=/patterns
+EXPOSE 8080
+CMD ["python", "-m", "log_parser_tpu.serve", "--host", "0.0.0.0", "--port", "8080"]

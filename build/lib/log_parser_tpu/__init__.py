@@ -1,0 +1,34 @@
+"""log_parser_tpu — a TPU-native pod-failure log analysis framework.
+
+A ground-up JAX/XLA re-design of the capabilities of podmortem/log-parser
+(reference: a Java 21 / Quarkus REST microservice, see /root/reference):
+YAML-defined regex failure-pattern libraries, a seven-factor confidence
+scoring formula, and a ``POST /parse`` REST contract — with the hot loop
+(regex matching + scoring over every log line) executed as batched XLA ops
+on TPU instead of a single JVM thread.
+
+Architecture (TPU-first, not a translation):
+
+- ``models/``    — the data-model surface of the reference's external
+                   ``common-lib`` artifact, as plain dataclasses.
+- ``config``     — the 10 scoring tunables (reference:
+                   src/main/resources/application.properties:1-20).
+- ``patterns/``  — YAML pattern-set loader + regex→DFA compiler +
+                   literal-factor extraction + Aho-Corasick automaton bank.
+- ``golden/``    — pure-Python exact reference implementation of the JVM
+                   semantics; the parity anchor for every kernel.
+- ``ops/``       — JAX kernels: batched automaton execution and the
+                   vectorized scoring pipeline.
+- ``parallel/``  — ``shard_map`` data parallelism over the line axis with
+                   halo exchange and collective frequency reduction.
+- ``runtime/``   — the analysis engine orchestrating encode→match→score→
+                   assemble, plus cross-request frequency state.
+- ``serve/``     — HTTP ``POST /parse`` endpoint with the reference's
+                   request/response contract.
+"""
+
+__version__ = "0.1.0"
+
+from log_parser_tpu.config import ScoringConfig
+
+__all__ = ["ScoringConfig", "__version__"]

@@ -1,0 +1,110 @@
+"""Scoring configuration — the 10 tunables of the reference service.
+
+Mirrors the reference's MicroProfile Config surface
+(src/main/resources/application.properties:1-20) with the same keys and the
+same code-side defaults (ScoringService.java:38-51,
+ContextAnalysisService.java:24-25, FrequencyTrackingService.java:27-34).
+Every key is optional except ``pattern_directory``
+(PatternService.java:35-36 has no default).
+
+Severity multipliers and the per-line context weights are deliberately NOT
+configurable — they are hardcoded constants in the reference
+(ScoringService.java:30-36; ContextAnalysisService.java:62-88) and live as
+module constants in :mod:`log_parser_tpu.golden.engine` /
+:mod:`log_parser_tpu.runtime.finalize` so they are baked statically into the
+jitted kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping
+
+# application.properties key -> dataclass field name
+_PROPERTY_KEYS = {
+    "pattern.directory": "pattern_directory",
+    "scoring.proximity.decay-constant": "proximity_decay_constant",
+    "scoring.proximity.max-window": "proximity_max_window",
+    "scoring.chronological.early-bonus-threshold": "chronological_early_bonus_threshold",
+    "scoring.chronological.max-early-bonus": "chronological_max_early_bonus",
+    "scoring.chronological.penalty-threshold": "chronological_penalty_threshold",
+    "scoring.context.max-context-factor": "context_max_context_factor",
+    "scoring.frequency.threshold": "frequency_threshold",
+    "scoring.frequency.max-penalty": "frequency_max_penalty",
+    "scoring.frequency.time-window-hours": "frequency_time_window_hours",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringConfig:
+    """All tunables, with the reference's defaults.
+
+    Defaults cite the injection sites that carry them:
+
+    - ``proximity_decay_constant``: ScoringService.java:38-39
+    - ``proximity_max_window``: ScoringService.java:41-42
+    - ``chronological_early_bonus_threshold``: ScoringService.java:44-45
+    - ``chronological_max_early_bonus``: ScoringService.java:47-48
+    - ``chronological_penalty_threshold``: ScoringService.java:50-51
+    - ``context_max_context_factor``: ContextAnalysisService.java:24-25
+    - ``frequency_threshold``: FrequencyTrackingService.java:27-28
+    - ``frequency_max_penalty``: FrequencyTrackingService.java:30-31
+    - ``frequency_time_window_hours``: FrequencyTrackingService.java:33-34
+    """
+
+    pattern_directory: str | None = None
+    proximity_decay_constant: float = 10.0
+    proximity_max_window: int = 100
+    chronological_early_bonus_threshold: float = 0.2
+    chronological_max_early_bonus: float = 2.5
+    chronological_penalty_threshold: float = 0.5
+    context_max_context_factor: float = 2.5
+    frequency_threshold: float = 10.0
+    frequency_max_penalty: float = 0.8
+    frequency_time_window_hours: int = 1
+
+    @classmethod
+    def from_mapping(cls, props: Mapping[str, Any]) -> "ScoringConfig":
+        """Build from a mapping keyed either by the reference's property names
+        (``scoring.proximity.decay-constant``) or by field names."""
+        kwargs: dict[str, Any] = {}
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for key, value in props.items():
+            name = _PROPERTY_KEYS.get(key, key)
+            if name not in fields:
+                continue
+            typ = fields[name].type
+            if value is not None:
+                if typ == "int":
+                    value = int(value)
+                elif typ == "float":
+                    value = float(value)
+            kwargs[name] = value
+        return cls(**kwargs)
+
+    @classmethod
+    def from_properties_file(cls, path: str) -> "ScoringConfig":
+        """Parse a Java ``.properties`` file (the reference's config format)."""
+        props: dict[str, str] = {}
+        with open(path, "r", encoding="utf-8") as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith(("#", "!")):
+                    continue
+                if "=" in line:
+                    key, _, value = line.partition("=")
+                    props[key.strip()] = value.strip()
+        return cls.from_mapping(props)
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "ScoringConfig":
+        """Build from environment variables: each property key upper-cased with
+        ``.``/``-`` → ``_`` (the MicroProfile Config env-var convention)."""
+        env = os.environ if env is None else env
+        props = {}
+        for key in _PROPERTY_KEYS:
+            env_key = key.upper().replace(".", "_").replace("-", "_")
+            if env_key in env:
+                props[key] = env[env_key]
+        return cls.from_mapping(props)

@@ -1,0 +1,524 @@
+"""GoldenAnalyzer — exact pure-Python replication of the reference pipeline.
+
+This is the executable specification of the JVM semantics
+(AnalysisService.java / ScoringService.java / ContextAnalysisService.java /
+FrequencyTrackingService.java), including the quirks that matter for parity:
+
+- events are returned in *discovery order* — line-major, then pattern-set
+  order, then pattern order within the set (AnalysisService.java:89-113).
+  docs/SCORING_ALGORITHM.md:191 claims events are sorted by score; the code
+  never sorts.
+- for each match, the frequency penalty is read *before* the match is
+  recorded (ScoringService.java:84-88), and frequency state persists across
+  matches and requests — so the Nth match of a pattern sees counts 1..N-1.
+- context scoring's WARN branch is an ``else if`` after ERROR
+  (ContextAnalysisService.java:64-70): a line matching both counts only as
+  error.
+- an unknown severity string ranks *below* INFO in the highest-severity
+  computation (``indexOf == -1``, AnalysisService.java:206-211).
+
+One deliberate divergence: a pattern set whose ``patterns`` list is null is
+skipped. The reference NPEs in its match loop on such a set
+(AnalysisService.java:91-92 iterates ``getPatterns()`` without the null check
+the compile loop has at :57-59); crashing the request is a reference bug we
+do not reproduce.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import math
+import re
+import time
+import uuid
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.javacompat import compile_java_regex, java_split_lines
+from log_parser_tpu.javamath import java_div, java_min
+from log_parser_tpu.models.analysis import (
+    AnalysisMetadata,
+    AnalysisResult,
+    AnalysisSummary,
+    EventContext,
+    MatchedEvent,
+    PatternFrequency,
+)
+from log_parser_tpu.models.pattern import Pattern, PatternSet
+from log_parser_tpu.models.pod import PodFailureData
+
+# ScoringService.java:30-36 — hardcoded, deliberately not configurable.
+SEVERITY_MULTIPLIERS: dict[str, float] = {
+    "CRITICAL": 5.0,
+    "HIGH": 3.0,
+    "MEDIUM": 2.0,
+    "LOW": 1.5,
+    "INFO": 1.0,
+}
+
+# AnalysisService.java:206 — severity ranking for the summary.
+SEVERITY_ORDER = ["INFO", "LOW", "MEDIUM", "HIGH", "CRITICAL"]
+
+# ContextAnalysisService.java:27-34 — the four hardcoded context regexes.
+ERROR_PATTERN = compile_java_regex(r"\b(ERROR|FATAL|CRITICAL|SEVERE)\b", case_insensitive=True)
+WARN_PATTERN = compile_java_regex(r"\b(WARN|WARNING)\b", case_insensitive=True)
+STACK_TRACE_PATTERN = compile_java_regex(r"^\s*at\s+[\w\.\$]+\(.*\)\s*$")
+EXCEPTION_PATTERN = compile_java_regex(r"\b\w*Exception\b|\b\w*Error\b")
+
+# ContextAnalysisService.java:62-98 — per-line weights and penalty constants.
+ERROR_WEIGHT = 0.4
+WARN_WEIGHT = 0.2
+STACK_WEIGHT = 0.1
+EXCEPTION_WEIGHT = 0.3
+STACK_BONUS_CAP = 0.5
+DENSITY_PENALTY = 0.8
+DENSITY_MIN_LINES = 10
+DENSITY_RATIO = 0.7
+
+# ScoringService.java:274 — sequence near-primary window.
+SEQUENCE_NEAR_WINDOW = 5
+
+
+class SnapshotValidationError(ValueError):
+    """Client-supplied frequency snapshot failed validation (restore is
+    all-or-nothing). A dedicated type so transports can classify it as a
+    client error without catching every ValueError (ADVICE.md r2)."""
+
+
+class GoldenFrequencyTracker:
+    """FrequencyTrackingService.java:20-134 — cross-request sliding-window
+    match counts keyed by pattern id."""
+
+    def __init__(self, config: ScoringConfig, clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self.clock = clock
+        self._frequencies: dict[str, PatternFrequency] = {}
+
+    def record_pattern_match(self, pattern_id: str | None) -> None:
+        """FrequencyTrackingService.java:41-56."""
+        if pattern_id is None or pattern_id.strip() == "":
+            return
+        freq = self._frequencies.get(pattern_id)
+        if freq is None:
+            freq = PatternFrequency(
+                self.config.frequency_time_window_hours * 3600.0, clock=self.clock
+            )
+            self._frequencies[pattern_id] = freq
+        freq.increment_count()
+
+    def calculate_frequency_penalty(self, pattern_id: str | None) -> float:
+        """FrequencyTrackingService.java:64-93."""
+        if pattern_id is None or pattern_id.strip() == "":
+            return 0.0
+        freq = self._frequencies.get(pattern_id)
+        if freq is None:
+            return 0.0
+        rate = freq.get_hourly_rate()
+        if rate <= self.config.frequency_threshold:
+            return 0.0
+        excess = rate - self.config.frequency_threshold
+        # Java double semantics: threshold 0 or a NaN rate never throws
+        return java_min(
+            self.config.frequency_max_penalty,
+            java_div(excess, self.config.frequency_threshold),
+        )
+
+    def get_frequency_statistics(self) -> dict[str, int]:
+        """FrequencyTrackingService.java:110-115."""
+        return {pid: f.get_current_count() for pid, f in self._frequencies.items()}
+
+    def get_windowed_count(self, pattern_id: str) -> int:
+        """Current in-window count for one pattern id (0 if never seen)."""
+        freq = self._frequencies.get(pattern_id)
+        return freq.get_current_count() if freq is not None else 0
+
+    def has_entry(self, pattern_id: str) -> bool:
+        """Whether the tracker has an entry at all — distinct from a zero
+        windowed count (FrequencyTrackingService.java:69-71 early-returns
+        0.0 only when no entry exists)."""
+        return pattern_id in self._frequencies
+
+    def reset_pattern_frequency(self, pattern_id: str) -> None:
+        """FrequencyTrackingService.java:122-128."""
+        freq = self._frequencies.get(pattern_id)
+        if freq is not None:
+            freq.reset()
+
+    def reset_all_frequencies(self) -> None:
+        """FrequencyTrackingService.java:131-134."""
+        self._frequencies.clear()
+
+    # ---- exact in-process state save/load (crash-containment rollback) ---
+
+    def _save_state(self) -> dict[str, list[float]]:
+        """Raw timestamp copy — exact, process-local (cf. :meth:`snapshot`,
+        which is portable but clock-relative)."""
+        return {pid: list(f._timestamps) for pid, f in self._frequencies.items()}
+
+    def _load_state(self, state: dict[str, list[float]]) -> None:
+        self._frequencies.clear()
+        for pid, timestamps in state.items():
+            freq = PatternFrequency(
+                self.config.frequency_time_window_hours * 3600.0, clock=self.clock
+            )
+            freq._timestamps = list(timestamps)
+            self._frequencies[pid] = freq
+
+    # ---- snapshot/restore (SURVEY.md §5.4 — the reference loses this state
+    # on restart; here it can round-trip across processes) -----------------
+
+    def snapshot(self) -> dict[str, list[float]]:
+        """Portable snapshot: per pattern id, the *age* in seconds of every
+        in-window match (ages, not raw clock values — the monotonic clock
+        is process-local)."""
+        now = self.clock()
+        out: dict[str, list[float]] = {}
+        for pid, freq in self._frequencies.items():
+            freq._prune(now)
+            out[pid] = [now - ts for ts in freq._timestamps]
+        return out
+
+    def restore(self, ages: dict[str, list[float]]) -> None:
+        """Rebuild tracker state from :meth:`snapshot` output: the snapshot
+        REPLACES all existing state (ids absent from the payload are
+        cleared — restore-onto-warm-engine must not produce a hybrid).
+        Ages beyond the window are dropped on the next prune; negative ages
+        (timestamps in the future, which would never prune and would
+        inflate windowed counts forever) are rejected up front."""
+        for age_list in ages.values():
+            for a in age_list:
+                if not (float(a) >= 0.0):  # also rejects NaN
+                    raise SnapshotValidationError(
+                        f"negative age in frequency snapshot: {a!r}"
+                    )
+        now = self.clock()
+        self._frequencies.clear()
+        for pid, age_list in ages.items():
+            if not pid or not pid.strip():
+                continue
+            freq = PatternFrequency(
+                self.config.frequency_time_window_hours * 3600.0, clock=self.clock
+            )
+            freq._timestamps = sorted(now - float(a) for a in age_list)
+            self._frequencies[pid] = freq
+
+
+def calculate_context_factor(context: EventContext | None, config: ScoringConfig) -> float:
+    """ContextAnalysisService.java:46-117 — context factor with the else-if,
+    the capped stack bonus, the density penalty, and the cap."""
+    if context is None:
+        return 1.0
+    all_lines: list[str] = []
+    if context.lines_before is not None:
+        all_lines.extend(context.lines_before)
+    if context.matched_line is not None:
+        all_lines.append(context.matched_line)
+    if context.lines_after is not None:
+        all_lines.extend(context.lines_after)
+    if not all_lines:
+        return 1.0
+
+    context_score = 0.0
+    error_lines = warn_lines = stack_lines = exception_lines = 0
+    for line in all_lines:
+        if ERROR_PATTERN.search(line):
+            error_lines += 1
+            context_score += ERROR_WEIGHT
+        elif WARN_PATTERN.search(line):
+            warn_lines += 1
+            context_score += WARN_WEIGHT
+        if STACK_TRACE_PATTERN.search(line):
+            stack_lines += 1
+            context_score += STACK_WEIGHT
+        if EXCEPTION_PATTERN.search(line):
+            exception_lines += 1
+            context_score += EXCEPTION_WEIGHT
+
+    if stack_lines > 0:
+        context_score += min(stack_lines * STACK_WEIGHT, STACK_BONUS_CAP)
+
+    total = len(all_lines)
+    if total > DENSITY_MIN_LINES and (stack_lines + error_lines) > total * DENSITY_RATIO:
+        context_score *= DENSITY_PENALTY
+
+    return min(1.0 + context_score, config.context_max_context_factor)
+
+
+class GoldenAnalyzer:
+    """The full reference pipeline: compile → match → score → assemble.
+
+    Patterns are compiled exactly once, at construction (the documented intent
+    of the reference — docs/SCORING_ALGORITHM.md:186 — rather than its actual
+    per-request recompilation, AnalysisService.java:55-86).
+    """
+
+    def __init__(
+        self,
+        pattern_sets: list[PatternSet],
+        config: ScoringConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.pattern_sets = pattern_sets
+        self.config = config or ScoringConfig()
+        self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
+        self._compiled: dict[str, re.Pattern[str]] = {}
+        # flat (pattern, compiled primary) list in discovery order — set-major
+        # then pattern order (AnalysisService.java:91-92) — hoisted out of the
+        # per-line hot loop
+        self._primaries: list[tuple[Pattern, re.Pattern[str]]] = []
+        # patterns whose regexes this engine cannot express (e.g. possessive
+        # quantifiers): logged and skipped per-pattern so one bad pattern
+        # never takes down the whole library — mirroring the loader's
+        # skip-bad-file resilience (PatternService.java:82-84). A documented
+        # divergence: the JVM reference would compile and match these.
+        self.skipped_patterns: list[tuple[str, str]] = []
+        for ps in pattern_sets:
+            for pattern in ps.patterns or []:
+                try:
+                    if pattern.primary_pattern is not None:
+                        compiled = self._compile(pattern.primary_pattern.regex)
+                    for sec in pattern.secondary_patterns or []:
+                        self._compile(sec.regex)
+                    for seq in pattern.sequence_patterns or []:
+                        for ev in seq.events or []:
+                            self._compile(ev.regex)
+                except (ValueError, re.error) as exc:
+                    log.error("Skipping pattern %r: %s", pattern.id, exc)
+                    self.skipped_patterns.append((pattern.id, str(exc)))
+                    continue
+                if pattern.primary_pattern is not None:
+                    self._primaries.append((pattern, compiled))
+
+    def _compile(self, regex: str) -> re.Pattern[str]:
+        pat = self._compiled.get(regex)
+        if pat is None:
+            pat = compile_java_regex(regex)
+            self._compiled[regex] = pat
+        return pat
+
+    # ------------------------------------------------------------------ match
+
+    def analyze(self, data: PodFailureData) -> AnalysisResult:
+        """AnalysisService.java:50-122."""
+        start = time.monotonic()
+        lines = java_split_lines(data.logs or "")
+        events: list[MatchedEvent] = []
+
+        for line_idx, line in enumerate(lines):
+            for pattern, compiled in self._primaries:
+                if compiled.search(line):
+                    event = MatchedEvent(
+                        line_number=line_idx + 1,
+                        matched_pattern=pattern,
+                        context=self._extract_context(lines, line_idx, pattern),
+                    )
+                    event.score = self.calculate_score(event, lines)
+                    events.append(event)
+
+        result = AnalysisResult(
+            events=events,
+            analysis_id=str(uuid.uuid4()),
+            metadata=self._build_metadata(start, lines),
+            summary=self._build_summary(events),
+        )
+        return result
+
+    def _extract_context(
+        self, lines: list[str], match_idx: int, pattern: Pattern
+    ) -> EventContext:
+        return extract_context(lines, match_idx, pattern)
+
+    # ---------------------------------------------------------------- scoring
+
+    def calculate_score(self, event: MatchedEvent, lines: list[str]) -> float:
+        """ScoringService.java:63-112 — the seven-factor product, with the
+        frequency penalty read before the match is recorded (:84-88)."""
+        pattern = event.matched_pattern
+        assert pattern is not None and pattern.primary_pattern is not None
+        base_confidence = pattern.primary_pattern.confidence
+        severity_multiplier = SEVERITY_MULTIPLIERS.get((pattern.severity or "").upper(), 1.0)
+        chronological = self._chronological_factor(event, lines)
+        proximity = self._proximity_factor(event, lines)
+        temporal = self._temporal_factor(event, lines)
+        context = calculate_context_factor(event.context, self.config)
+        penalty = self.frequency.calculate_frequency_penalty(pattern.id)
+        self.frequency.record_pattern_match(pattern.id)
+        return (
+            base_confidence
+            * severity_multiplier
+            * chronological
+            * proximity
+            * temporal
+            * context
+            * (1.0 - penalty)
+        )
+
+    def _chronological_factor(self, event: MatchedEvent, lines: list[str]) -> float:
+        """ScoringService.java:123-151 — three-zone piecewise linear."""
+        cfg = self.config
+        idx = event.line_number - 1
+        position = idx / len(lines)
+        # java_div: zero-valued thresholds divide by zero without throwing
+        # (Java double semantics), matching the reference's behavior exactly
+        if position <= cfg.chronological_early_bonus_threshold:
+            bonus_range = cfg.chronological_max_early_bonus - 1.5
+            return 1.5 + (cfg.chronological_early_bonus_threshold - position) * java_div(
+                bonus_range, cfg.chronological_early_bonus_threshold
+            )
+        if position <= cfg.chronological_penalty_threshold:
+            middle = (
+                cfg.chronological_penalty_threshold - cfg.chronological_early_bonus_threshold
+            )
+            return 1.0 + (cfg.chronological_penalty_threshold - position) * java_div(0.5, middle)
+        return 0.5 + (1.0 - position)
+
+    def _proximity_factor(self, event: MatchedEvent, lines: list[str]) -> float:
+        """ScoringService.java:161-190 — weighted exponential decay over the
+        closest occurrence of each secondary pattern."""
+        pattern = event.matched_pattern
+        assert pattern is not None
+        secondaries = pattern.secondary_patterns
+        if not secondaries:
+            return 1.0
+        total = 0.0
+        primary_idx = event.line_number - 1
+        for sec in secondaries:
+            distance = self._closest_secondary_distance(sec.regex, sec.proximity_window,
+                                                        primary_idx, lines)
+            if distance >= 0:
+                total += sec.weight * math.exp(-distance / self.config.proximity_decay_constant)
+        return 1.0 + total
+
+    def _closest_secondary_distance(
+        self, regex: str, proximity_window: int, primary_idx: int, lines: list[str]
+    ) -> float:
+        """ScoringService.java:315-347 — window = min(max_window, pattern
+        window), primary line excluded."""
+        window = min(self.config.proximity_max_window, proximity_window)
+        start = max(0, primary_idx - window)
+        end = min(len(lines), primary_idx + window + 1)
+        compiled = self._compile(regex)
+        closest = -1.0
+        for i in range(start, end):
+            if i == primary_idx:
+                continue
+            if compiled.search(lines[i]):
+                distance = float(abs(i - primary_idx))
+                if closest < 0 or distance < closest:
+                    closest = distance
+        return closest
+
+    def _temporal_factor(self, event: MatchedEvent, lines: list[str]) -> float:
+        """ScoringService.java:199-220."""
+        pattern = event.matched_pattern
+        assert pattern is not None
+        sequences = pattern.sequence_patterns
+        if not sequences:
+            return 1.0
+        total = 0.0
+        for seq in sequences:
+            if self._is_sequence_matched(seq, event, lines):
+                total += seq.bonus_multiplier
+        return 1.0 + total
+
+    def _is_sequence_matched(self, sequence, event: MatchedEvent, lines: list[str]) -> bool:
+        """ScoringService.java:230-262 — work backwards from the primary:
+        the last event must sit within ±5 lines of the primary (:272-286);
+        each earlier event must occur strictly before the previously found
+        one, taking the nearest preceding occurrence (:296-305). Note the
+        search index resets to the *primary* line after the near-window check
+        (:250), not to where the last event actually matched."""
+        events = sequence.events
+        if not events:
+            return False
+        primary_idx = event.line_number - 1
+        current = 0
+        for i in range(len(events) - 1, -1, -1):
+            seq_event = events[i]
+            compiled = self._compile(seq_event.regex)
+            if i == len(events) - 1:
+                if not self._found_near(compiled, primary_idx, lines):
+                    return False
+                current = primary_idx
+            else:
+                found = self._find_before(compiled, current, lines)
+                if found < 0:
+                    return False
+                current = found
+        return True
+
+    def _found_near(self, compiled: re.Pattern[str], primary_idx: int, lines: list[str]) -> bool:
+        """ScoringService.java:272-286 — ±5-line window, clamped."""
+        start = max(0, primary_idx - SEQUENCE_NEAR_WINDOW)
+        end = min(len(lines), primary_idx + SEQUENCE_NEAR_WINDOW + 1)
+        return any(compiled.search(lines[i]) for i in range(start, end))
+
+    def _find_before(self, compiled: re.Pattern[str], before_idx: int, lines: list[str]) -> int:
+        """ScoringService.java:296-305 — backward scan, nearest hit wins."""
+        for i in range(before_idx - 1, -1, -1):
+            if compiled.search(lines[i]):
+                return i
+        return -1
+
+    # --------------------------------------------------------------- assembly
+
+    def _build_metadata(self, start: float, lines: list[str]) -> AnalysisMetadata:
+        return build_metadata(start, len(lines), self.pattern_sets)
+
+    def _build_summary(self, events: list[MatchedEvent]) -> AnalysisSummary:
+        return build_summary(events)
+
+
+def build_metadata(
+    start_monotonic: float, total_lines: int, pattern_sets: list[PatternSet]
+) -> AnalysisMetadata:
+    """AnalysisService.java:166-180 — patterns_used lists every loaded
+    library id, matched or not."""
+    return AnalysisMetadata(
+        processing_time_ms=int((time.monotonic() - start_monotonic) * 1000),
+        total_lines=total_lines,
+        analyzed_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        patterns_used=[
+            ps.metadata.library_id if ps.metadata else None  # type: ignore[misc]
+            for ps in pattern_sets
+        ],
+    )
+
+
+def build_summary(events: list[MatchedEvent]) -> AnalysisSummary:
+    """AnalysisService.java:188-215 — unknown severities rank below INFO
+    (indexOf == -1)."""
+    summary = AnalysisSummary(significant_events=len(events))
+    if not events:
+        summary.highest_severity = "NONE"
+        summary.severity_distribution = {}
+        return summary
+    severities = [
+        (e.matched_pattern.severity or "").upper() for e in events  # type: ignore[union-attr]
+    ]
+    distribution: dict[str, int] = {}
+    for sev in severities:
+        distribution[sev] = distribution.get(sev, 0) + 1
+    summary.severity_distribution = distribution
+
+    def rank(sev: str) -> int:
+        return SEVERITY_ORDER.index(sev) if sev in SEVERITY_ORDER else -1
+
+    summary.highest_severity = max(severities, key=rank)
+    return summary
+
+
+def extract_context(lines: list[str], match_idx: int, pattern: Pattern) -> EventContext:
+    """AnalysisService.java:132-156 — shared by golden and TPU engines."""
+    context = EventContext(matched_line=lines[match_idx])
+    rules = pattern.context_extraction
+    if rules is None:
+        return context
+    before_start = max(0, match_idx - rules.lines_before)
+    context.lines_before = lines[before_start:match_idx]
+    after_end = min(len(lines), match_idx + 1 + rules.lines_after)
+    context.lines_after = lines[match_idx + 1 : after_end]
+    return context

@@ -1,0 +1,192 @@
+"""Java-compatibility primitives: string splitting and the regex dialect.
+
+Two behaviors of the JVM leak into the reference's observable semantics and
+must be replicated bit-for-bit:
+
+1. ``String.split("\\r?\\n")`` (AnalysisService.java:53) removes *trailing*
+   empty strings from the result, and splitting the empty string yields
+   ``[""]`` (one empty element). ``"a\\n\\n".split`` → ``["a"]``;
+   ``"\\n\\n".split`` → ``[]``.
+
+2. ``java.util.regex`` (AnalysisService.java:64) treats ``\\w``/``\\b``/
+   ``\\d``/``\\s`` as ASCII classes by default, where Python 3's ``re`` is
+   Unicode-aware. Compiling with ``re.ASCII`` restores Java's default
+   semantics. ``Matcher.find()`` (AnalysisService.java:95) is substring
+   search — Python's ``re.search``.
+"""
+
+from __future__ import annotations
+
+import re
+
+_LINE_SEP = re.compile(r"\r?\n")
+
+# \p{Name} POSIX classes: stored as bare class *contents* so they can be
+# spliced both standalone (wrapped in [...]) and inside a character class.
+_POSIX_MAP = {
+    "Alpha": "a-zA-Z",
+    "Digit": "0-9",
+    "Alnum": "a-zA-Z0-9",
+    "Upper": "A-Z",
+    "Lower": "a-z",
+    "Space": r" \t\n\x0b\f\r",
+    "Punct": r"!-/:-@\[-`{-~",
+    "XDigit": "0-9a-fA-F",
+}
+
+_POSIX_RE = re.compile(r"\\([pP])\{(\w+)\}")
+_NAMED_GROUP_RE = re.compile(r"\(\?<([A-Za-z][A-Za-z0-9]*)>")
+_NAMED_BACKREF_RE = re.compile(r"\\k<([A-Za-z][A-Za-z0-9]*)>")
+_BRACE_QUANT_RE = re.compile(r"\{\d+(?:,\d*)?\}")
+_INLINE_FLAGS_RE = re.compile(r"\(\?[a-zA-Z-]+\)")
+
+
+def java_split_lines(logs: str) -> list[str]:
+    """``logs.split("\\r?\\n")`` with Java semantics (trailing empties dropped,
+    empty input → one empty line)."""
+    parts = _LINE_SEP.split(logs)
+    if len(parts) == 1:
+        # no separator found — Java returns the whole input, even if empty
+        return parts
+    while parts and parts[-1] == "":
+        parts.pop()
+    return parts
+
+
+def translate_java_regex(pattern: str) -> str:
+    """Translate the Java-regex dialect subset used by pattern libraries into
+    an equivalent Python ``re`` pattern. Raises ``ValueError`` on constructs
+    whose semantics cannot be preserved (possessive quantifiers, atomic
+    groups, class unions/intersections, mid-pattern inline flags, unknown
+    ``\\p`` classes).
+
+    A character scanner — not regex-over-regex — so escapes (``C\\++`` is a
+    literal ``+`` quantified, not possessive) and character-class context
+    (``[?+]`` holds literals; ``[\\p{Alpha}_]`` splices class contents without
+    nesting brackets) are handled correctly.
+
+    Line-terminator semantics (input here is always one log line, which may
+    contain a lone ``\\r`` but never ``\\n``): Java's default ``.`` excludes
+    all line terminators where Python's excludes only ``\\n``, so ``.`` maps
+    to ``[^\\n\\r\\x85\\u2028\\u2029]``; Java's ``$``/``\\Z`` match before a
+    *final* line terminator where Python's ``$`` handles only ``\\n``, so
+    both map to ``(?=\\r?\\Z)``; Java ``\\z`` is Python ``\\Z``.
+    """
+    out: list[str] = []
+    i, n = 0, len(pattern)
+    in_class = False
+
+    def fail(what: str) -> ValueError:
+        return ValueError(f"unsupported Java regex construct ({what}) in {pattern!r}")
+
+    while i < n:
+        c = pattern[i]
+        if c == "\\":
+            m = _POSIX_RE.match(pattern, i)
+            if m:
+                negated, name = m.group(1) == "P", m.group(2)
+                if name not in _POSIX_MAP:
+                    raise fail(f"\\p{{{name}}}")
+                content = _POSIX_MAP[name]
+                if in_class:
+                    if negated:
+                        raise fail("\\P inside character class")
+                    out.append(content)
+                else:
+                    out.append(("[^" if negated else "[") + content + "]")
+                i = m.end()
+                continue
+            m = _NAMED_BACKREF_RE.match(pattern, i)
+            if m:  # Java \k<name> -> Python (?P=name)
+                out.append(f"(?P={m.group(1)})")
+                i = m.end()
+                continue
+            nxt = pattern[i + 1] if i + 1 < n else ""
+            if not in_class:
+                if nxt == "z":  # Java \z (absolute end) = Python \Z
+                    out.append(r"\Z")
+                    i += 2
+                    continue
+                if nxt == "Z":  # Java \Z (before final terminator)
+                    out.append(r"(?=\r?\Z)")
+                    i += 2
+                    continue
+            out.append(pattern[i : i + 2])
+            i += 2
+            continue
+        if in_class:
+            if c == "]":
+                in_class = False
+            elif c == "[":
+                raise fail("nested character class")
+            elif c == "&" and pattern.startswith("&&", i):
+                raise fail("class intersection &&")
+            out.append(c)
+            i += 1
+            continue
+        if c == "[":
+            in_class = True
+            out.append(c)
+            i += 1
+            if i < n and pattern[i] == "^":
+                out.append("^")
+                i += 1
+            continue
+        if c == ".":
+            # Java default '.' excludes all line terminators
+            out.append(r"[^\n\r\x85  ]")
+            i += 1
+            continue
+        if c == "$":
+            # Java $ (non-MULTILINE): end of input or before final terminator
+            out.append(r"(?=\r?\Z)")
+            i += 1
+            continue
+        if c == "(":
+            if pattern.startswith("(?>", i):
+                raise fail("atomic group")
+            m = _NAMED_GROUP_RE.match(pattern, i)
+            if m:  # Java (?<name>...) -> Python (?P<name>...)
+                out.append(f"(?P<{m.group(1)}>")
+                i = m.end()
+                continue
+            m = _INLINE_FLAGS_RE.match(pattern, i)
+            if m and i > 0:
+                # Python only allows global inline flags at position 0, and
+                # Java scopes them to the enclosing group — unpreservable
+                raise fail(f"mid-pattern inline flags {m.group(0)}")
+            out.append(c)
+            i += 1
+            continue
+        if c in "*+?":
+            out.append(c)
+            i += 1
+            if i < n and pattern[i] == "+":
+                raise fail("possessive quantifier")
+            if i < n and pattern[i] == "?":  # lazy — same in Python
+                out.append("?")
+                i += 1
+            continue
+        if c == "{":
+            m = _BRACE_QUANT_RE.match(pattern, i)
+            if m:
+                out.append(m.group(0))
+                i = m.end()
+                if i < n and pattern[i] == "+":
+                    raise fail("possessive quantifier")
+                continue
+            out.append(c)
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def compile_java_regex(pattern: str, case_insensitive: bool = False) -> re.Pattern[str]:
+    """Compile a Java-dialect regex with Java's default semantics
+    (ASCII ``\\w``/``\\b``/``\\d``/``\\s``; Pattern.CASE_INSENSITIVE optional)."""
+    flags = re.ASCII
+    if case_insensitive:
+        flags |= re.IGNORECASE
+    return re.compile(translate_java_regex(pattern), flags)

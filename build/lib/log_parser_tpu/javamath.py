@@ -1,0 +1,31 @@
+"""Java floating-point edge-case semantics.
+
+Java double arithmetic never throws: ``n/0.0`` is ±Infinity, ``0.0/0.0`` is
+NaN, and ``Math.min`` propagates NaN. Python raises ``ZeroDivisionError`` and
+``min`` silently prefers its first argument on NaN. The scoring pipeline
+reaches these corners when tunables are set to 0 (e.g.
+``frequency_time_window_hours=0`` makes ``getHourlyRate`` divide by zero,
+FrequencyTrackingService.java:74), so parity requires Java's rules.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def java_div(a: float, b: float) -> float:
+    """``a / b`` with Java double semantics (no exception on b == 0)."""
+    try:
+        return a / b
+    except ZeroDivisionError:
+        if a == 0.0 or math.isnan(a):
+            return math.nan
+        sign = math.copysign(1.0, a) * math.copysign(1.0, b)
+        return math.inf if sign > 0 else -math.inf
+
+
+def java_min(a: float, b: float) -> float:
+    """``Math.min`` — NaN-propagating, unlike Python's ``min``."""
+    if math.isnan(a) or math.isnan(b):
+        return math.nan
+    return min(a, b)

@@ -1,0 +1,48 @@
+"""Data models — the surface of the reference's external ``common-lib`` jar.
+
+The reference imports these POJOs from
+``com.redhat.podmortem:common`` (reference pom.xml:55-59); the full surface
+used by the parser is reconstructed from its call sites (see SURVEY.md §2.3).
+Here they are plain dataclasses with JSON/YAML (de)serialization that accepts
+both snake_case (the YAML pattern-file schema,
+reference docs/SCORING_ALGORITHM.md:29-33) and camelCase (Jackson bean
+convention for the REST payloads).
+"""
+
+from log_parser_tpu.models.analysis import (
+    AnalysisMetadata,
+    AnalysisResult,
+    AnalysisSummary,
+    EventContext,
+    MatchedEvent,
+    PatternFrequency,
+)
+from log_parser_tpu.models.pattern import (
+    ContextExtraction,
+    Pattern,
+    PatternSet,
+    PatternSetMetadata,
+    PrimaryPattern,
+    SecondaryPattern,
+    SequenceEvent,
+    SequencePattern,
+)
+from log_parser_tpu.models.pod import PodFailureData
+
+__all__ = [
+    "AnalysisMetadata",
+    "AnalysisResult",
+    "AnalysisSummary",
+    "ContextExtraction",
+    "EventContext",
+    "MatchedEvent",
+    "Pattern",
+    "PatternFrequency",
+    "PatternSet",
+    "PatternSetMetadata",
+    "PodFailureData",
+    "PrimaryPattern",
+    "SecondaryPattern",
+    "SequenceEvent",
+    "SequencePattern",
+]

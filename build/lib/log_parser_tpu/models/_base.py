@@ -1,0 +1,98 @@
+"""Shared (de)serialization helpers for the dataclass models.
+
+The reference's data travels in two spellings: the YAML pattern files use
+snake_case (``primary_pattern`` — reference docs/SCORING_ALGORITHM.md:29-33)
+and the REST JSON uses Jackson's camelCase bean convention
+(``lineNumber`` from ``MatchedEvent.setLineNumber``,
+reference AnalysisService.java:101). Models here accept either spelling on
+input and emit a chosen canonical spelling on output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import types
+import typing
+from typing import Any
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+def snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part.title() for part in rest)
+
+
+def _strip_optional(typ: Any) -> Any:
+    origin = typing.get_origin(typ)
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return typ
+
+
+class Model:
+    """Mixin for dataclass models: dict/JSON round-tripping with key-spelling
+    normalization and recursive nested-model construction."""
+
+    # Subclasses set this to emit camelCase keys (REST JSON payloads).
+    _camel_output: typing.ClassVar[bool] = False
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any] | None):
+        if data is None:
+            return None
+        hints = typing.get_type_hints(cls)
+        fields = {f.name: f for f in dataclasses.fields(cls)}  # type: ignore[arg-type]
+        kwargs: dict[str, Any] = {}
+        for key, value in data.items():
+            name = camel_to_snake(key) if key not in fields else key
+            if name not in fields:
+                continue
+            kwargs[name] = _coerce(_strip_optional(hints[name]), value)
+        return cls(**kwargs)
+
+    def to_dict(self, drop_none: bool = False) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            value = getattr(self, f.name)
+            if value is None and drop_none:
+                continue
+            key = snake_to_camel(f.name) if self._camel_output else f.name
+            out[key] = _unparse(value, drop_none)
+        return out
+
+
+def _coerce(typ: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    typ = _strip_optional(typ)
+    origin = typing.get_origin(typ)
+    if origin in (list, typing.List):
+        (item_t,) = typing.get_args(typ)
+        return [_coerce(item_t, v) for v in value]
+    if origin in (dict, typing.Dict):
+        return dict(value)
+    if isinstance(typ, type) and issubclass(typ, Model):
+        return typ.from_dict(value)
+    if typ is float and isinstance(value, (int, float)):
+        return float(value)
+    if typ is int and isinstance(value, (int, float)):
+        return int(value)
+    return value
+
+
+def _unparse(value: Any, drop_none: bool) -> Any:
+    if isinstance(value, Model):
+        return value.to_dict(drop_none=drop_none)
+    if isinstance(value, list):
+        return [_unparse(v, drop_none) for v in value]
+    if isinstance(value, dict):
+        return {k: _unparse(v, drop_none) for k, v in value.items()}
+    return value

@@ -1,0 +1,138 @@
+"""Analysis-result models — the REST response surface of the reference.
+
+Surface reconstructed from call sites (SURVEY.md §2.3):
+``AnalysisResult`` at AnalysisService.java:115-120, ``MatchedEvent`` at
+AnalysisService.java:100-107, ``EventContext`` at AnalysisService.java:134-151,
+``AnalysisMetadata`` at AnalysisService.java:168-177, ``AnalysisSummary`` at
+AnalysisService.java:190-212, ``PatternFrequency`` at
+FrequencyTrackingService.java:48-55,74,113,125.
+
+These serialize with camelCase keys (Jackson bean convention for the REST
+JSON, e.g. ``lineNumber`` from ``setLineNumber`` at AnalysisService.java:101).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import ClassVar
+
+from log_parser_tpu.javamath import java_div
+from log_parser_tpu.models._base import Model
+from log_parser_tpu.models.pattern import Pattern
+
+
+@dataclasses.dataclass
+class EventContext(Model):
+    """Context window around a match — AnalysisService.java:132-156.
+
+    ``lines_before``/``lines_after`` stay ``None`` (not empty lists) when the
+    pattern has no ``context_extraction`` rules, matching the reference's
+    early return at AnalysisService.java:137-139.
+    """
+
+    _camel_output: ClassVar[bool] = True
+
+    matched_line: str | None = None
+    lines_before: list[str] | None = None
+    lines_after: list[str] | None = None
+
+
+@dataclasses.dataclass
+class MatchedEvent(Model):
+    """One scored primary-pattern match — AnalysisService.java:100-109.
+
+    ``line_number`` is 1-based (AnalysisService.java:101); ``matched_pattern``
+    embeds the full pattern object (AnalysisService.java:102).
+    """
+
+    _camel_output: ClassVar[bool] = True
+
+    line_number: int = 0
+    matched_pattern: Pattern | None = None
+    context: EventContext | None = None
+    score: float = 0.0
+
+
+@dataclasses.dataclass
+class AnalysisMetadata(Model):
+    """Result metadata — AnalysisService.java:166-180."""
+
+    _camel_output: ClassVar[bool] = True
+
+    processing_time_ms: int = 0
+    total_lines: int = 0
+    analyzed_at: str = ""
+    patterns_used: list[str] | None = None
+
+
+@dataclasses.dataclass
+class AnalysisSummary(Model):
+    """Result summary — AnalysisService.java:188-215."""
+
+    _camel_output: ClassVar[bool] = True
+
+    significant_events: int = 0
+    highest_severity: str = "NONE"
+    severity_distribution: dict[str, int] | None = None
+
+
+@dataclasses.dataclass
+class AnalysisResult(Model):
+    """The ``POST /parse`` response body — AnalysisService.java:115-121."""
+
+    _camel_output: ClassVar[bool] = True
+
+    events: list[MatchedEvent] | None = None
+    analysis_id: str = ""
+    metadata: AnalysisMetadata | None = None
+    summary: AnalysisSummary | None = None
+
+
+class PatternFrequency:
+    """Sliding-window match counter for one pattern id.
+
+    The reference's ``PatternFrequency`` lives in the external common-lib jar;
+    its behavior is inferred from the call sites
+    (FrequencyTrackingService.java:48-55,74,113,125): constructed with a time
+    window, ``increment_count()`` records a match, ``get_current_count()``
+    returns matches inside the sliding window, ``get_hourly_rate()`` is the
+    windowed count normalized to matches/hour, ``reset()`` clears.
+
+    ``clock`` is injectable so the golden reference and the device kernels can
+    agree on a deterministic time model in parity tests.
+    """
+
+    def __init__(self, window_seconds: float, clock=time.monotonic):
+        self.window_seconds = float(window_seconds)
+        self._clock = clock
+        self._timestamps: list[float] = []
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_seconds
+        # timestamps are appended in order; drop the expired prefix
+        i = 0
+        while i < len(self._timestamps) and self._timestamps[i] <= cutoff:
+            i += 1
+        if i:
+            del self._timestamps[:i]
+
+    def increment_count(self) -> None:
+        now = self._clock()
+        self._prune(now)
+        self._timestamps.append(now)
+
+    def get_current_count(self) -> int:
+        self._prune(self._clock())
+        return len(self._timestamps)
+
+    def get_hourly_rate(self) -> float:
+        """Windowed count normalized to matches per hour.
+
+        Java double semantics on a zero-length window (count/0.0):
+        Infinity when matches exist, NaN when the count is 0 — no exception.
+        """
+        return java_div(self.get_current_count(), self.window_seconds / 3600.0)
+
+    def reset(self) -> None:
+        self._timestamps.clear()

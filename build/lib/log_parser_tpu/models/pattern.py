@@ -1,0 +1,107 @@
+"""Pattern-library models — the YAML schema of the reference's pattern files.
+
+Surface reconstructed from call sites in the reference (SURVEY.md §2.3):
+``Pattern`` accessors at ScoringService.java:64-69,85 and
+AnalysisService.java:62,68,75,104,201; the YAML shape at
+docs/SCORING_ALGORITHM.md:29-33 (``primary_pattern: {regex, confidence}``)
+plus ``secondary_patterns``, ``sequence_patterns``, ``context_extraction``,
+and remediation info (PatternService.java:25-26).
+
+Unlike the reference — which mutates shared singleton pattern objects with
+``setCompiledRegex`` on every request (AnalysisService.java:62-83, a latent
+data race, SURVEY.md §5.2) — these models carry no compiled-regex slot.
+Compilation happens once at load time into an immutable matcher bank
+(:mod:`log_parser_tpu.patterns`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from log_parser_tpu.models._base import Model
+
+
+@dataclasses.dataclass
+class PrimaryPattern(Model):
+    """``primary_pattern {regex, confidence}`` — docs/SCORING_ALGORITHM.md:30-33;
+    accessors AnalysisService.java:62-65, ScoringService.java:65."""
+
+    regex: str = ""
+    confidence: float = 0.0
+
+
+@dataclasses.dataclass
+class SecondaryPattern(Model):
+    """``secondary_patterns [{regex, weight, proximity_window}]`` —
+    ScoringService.java:172-186,319,330."""
+
+    regex: str = ""
+    weight: float = 0.0
+    proximity_window: int = 0
+
+
+@dataclasses.dataclass
+class SequenceEvent(Model):
+    """One event regex inside a sequence — ScoringService.java:280-281,299-300."""
+
+    regex: str = ""
+
+
+@dataclasses.dataclass
+class SequencePattern(Model):
+    """``sequence_patterns [{description, bonus_multiplier, events}]`` —
+    ScoringService.java:208-215,232."""
+
+    description: str = ""
+    bonus_multiplier: float = 0.0
+    events: list[SequenceEvent] | None = None
+
+
+@dataclasses.dataclass
+class ContextExtraction(Model):
+    """``context_extraction {lines_before, lines_after, include_stack_trace}``
+    — AnalysisService.java:142,148,153 (``include_stack_trace`` is unused in
+    the reference, an open TODO at AnalysisService.java:153)."""
+
+    lines_before: int = 0
+    lines_after: int = 0
+    include_stack_trace: bool = False
+
+
+@dataclasses.dataclass
+class Pattern(Model):
+    """One failure pattern — accessors ScoringService.java:64-69,85,
+    AnalysisService.java:62,68,75,104,201.
+
+    ``remediation`` is carried opaquely (any YAML value): the parser never
+    reads it, but pattern files include remediation info
+    (PatternService.java:25-26) and it must survive round-tripping.
+    """
+
+    id: str = ""
+    name: str = ""
+    severity: str = ""
+    primary_pattern: PrimaryPattern | None = None
+    secondary_patterns: list[SecondaryPattern] | None = None
+    sequence_patterns: list[SequencePattern] | None = None
+    context_extraction: ContextExtraction | None = None
+    remediation: Any = None
+
+
+@dataclasses.dataclass
+class PatternSetMetadata(Model):
+    """Pattern-set metadata; ``library_id`` read at AnalysisService.java:175."""
+
+    library_id: str = ""
+    name: str = ""
+    version: str = ""
+    description: str = ""
+
+
+@dataclasses.dataclass
+class PatternSet(Model):
+    """One YAML pattern file — AnalysisService.java:57,60; PatternService.java:80."""
+
+    metadata: PatternSetMetadata | None = None
+    patterns: list[Pattern] | None = None

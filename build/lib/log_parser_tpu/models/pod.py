@@ -1,0 +1,34 @@
+"""``PodFailureData`` — the ``POST /parse`` request body.
+
+Reference surface: ``getPod()`` (a Kubernetes Pod object whose
+``metadata.name`` is logged, Parse.java:51), ``getLogs()`` (a single string
+later split on ``\\r?\\n``, AnalysisService.java:53), and ``getEvents()``
+(Kubernetes events, carried but unused by the parser — Parse.java:33-34
+documents "pod specification, logs, and events").
+
+The pod spec and events are opaque Kubernetes objects to the parser, so they
+are carried as plain dicts/lists here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar
+
+from log_parser_tpu.models._base import Model
+
+
+@dataclasses.dataclass
+class PodFailureData(Model):
+    _camel_output: ClassVar[bool] = True
+
+    pod: dict[str, Any] | None = None
+    logs: str = ""
+    events: list[Any] | None = None
+
+    @property
+    def pod_name(self) -> str | None:
+        """``data.getPod().getMetadata().getName()`` — Parse.java:51."""
+        if not self.pod:
+            return None
+        return (self.pod.get("metadata") or {}).get("name")

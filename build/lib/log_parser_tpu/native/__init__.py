@@ -1,0 +1,100 @@
+"""ctypes bindings for the native runtime library (native/log_parser_native.cpp).
+
+The shared object is compiled on demand with ``g++ -O3`` and cached next to
+the source, keyed by source mtime. Every caller must tolerate
+``get_lib() is None`` (no toolchain, compile failure) and fall back to the
+pure-Python path — the native layer is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parents[2] / "native" / "log_parser_native.cpp"
+_SO = _SRC.parent / "build" / "log_parser_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> bool:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(_SO),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native compile failed to launch: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native compile failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.lpn_split_scan.argtypes = [u8p, ctypes.c_int64, i64p]
+    lib.lpn_split_scan.restype = ctypes.c_int64
+    lib.lpn_split_fill.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, u8p, ctypes.c_int64,
+        i32p, u8p, i64p, i64p, ctypes.c_int64,
+    ]
+    lib.lpn_split_fill.restype = None
+
+    lib.lpn_dfa_build.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i64p, i8p, i32p,            # eps CSR
+        i64p, i32p, i32p,           # trans CSR
+        u8p, ctypes.c_int32, u8p,   # bytesets, n_bytesets, word mask
+        ctypes.c_int32, ctypes.c_int32,  # max_states, do_minimize
+        i32p, i32p, i32p, i32p,     # out n_states, n_classes, start, err
+    ]
+    lib.lpn_dfa_build.restype = ctypes.c_void_p
+    lib.lpn_dfa_read.argtypes = [ctypes.c_void_p, i32p, i32p, u8p]
+    lib.lpn_dfa_read.restype = None
+    lib.lpn_dfa_free.argtypes = [ctypes.c_void_p]
+    lib.lpn_dfa_free.restype = None
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The bound native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("LOG_PARSER_TPU_NO_NATIVE"):
+            return None
+        try:
+            if not _SRC.exists():
+                return None
+            stale = not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime
+            if stale and not _compile():
+                return None
+            _lib = _bind(ctypes.CDLL(str(_SO)))
+        except OSError as e:
+            log.warning("native library unavailable: %s", e)
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,119 @@
+"""Native NFA→DFA subset construction (ctypes front-end).
+
+Serializes the Python :class:`~log_parser_tpu.patterns.regex.nfa.Nfa` into
+flat CSR arrays, runs the C++ builder (same algorithm as
+patterns/regex/dfa.py — assertion-aware closure, sticky MATCHED sink), and
+adds what the Python builder doesn't do: Moore minimization + byte-class
+recompression, which shrink the packed device tables for large libraries.
+
+Returns None when the native library is unavailable or the state cap is
+exceeded (caller decides the fallback: Python builder or host regex).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from log_parser_tpu.native import get_lib
+from log_parser_tpu.patterns.regex.nfa import Nfa
+from log_parser_tpu.patterns.regex.parser import WORD_BYTES
+
+_COND_CODE = {None: 0, "^": 1, "$": 2, "b": 3, "B": 4}
+
+_WORD_MASK = np.zeros(32, dtype=np.uint8)
+for _b in WORD_BYTES:
+    _WORD_MASK[_b >> 3] |= 1 << (_b & 7)
+
+
+def _byteset_mask(bs: frozenset[int]) -> np.ndarray:
+    m = np.zeros(32, dtype=np.uint8)
+    for b in bs:
+        m[b >> 3] |= 1 << (b & 7)
+    return m
+
+
+class DfaLimitExceeded(Exception):
+    pass
+
+
+def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
+    """(trans, byte_class, accept_end, start) or None if lib unavailable.
+
+    Raises :class:`DfaLimitExceeded` on state blowup.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    n = nfa.n_states
+    # epsilon CSR
+    eps_off = np.zeros(n + 1, dtype=np.int64)
+    eps_cond, eps_dst = [], []
+    for s in range(n):
+        for cond, dst in nfa.eps[s]:
+            eps_cond.append(_COND_CODE[cond])
+            eps_dst.append(dst)
+        eps_off[s + 1] = len(eps_dst)
+    eps_cond_a = np.asarray(eps_cond or [0], dtype=np.int8)
+    eps_dst_a = np.asarray(eps_dst or [0], dtype=np.int32)
+
+    # transition CSR with interned bytesets
+    bs_ids: dict[frozenset[int], int] = {}
+    masks: list[np.ndarray] = []
+    t_off = np.zeros(n + 1, dtype=np.int64)
+    t_bs, t_dst = [], []
+    for s in range(n):
+        for bs, dst in nfa.trans[s]:
+            bid = bs_ids.get(bs)
+            if bid is None:
+                bid = len(masks)
+                bs_ids[bs] = bid
+                masks.append(_byteset_mask(bs))
+            t_bs.append(bid)
+            t_dst.append(dst)
+        t_off[s + 1] = len(t_dst)
+    t_bs_a = np.asarray(t_bs or [0], dtype=np.int32)
+    t_dst_a = np.asarray(t_dst or [0], dtype=np.int32)
+    bytesets = (
+        np.concatenate(masks) if masks else np.zeros(32, dtype=np.uint8)
+    ).astype(np.uint8)
+
+    def p(arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    out_ns = ctypes.c_int32(0)
+    out_nc = ctypes.c_int32(0)
+    out_start = ctypes.c_int32(0)
+    err = ctypes.c_int32(0)
+    handle = lib.lpn_dfa_build(
+        n, nfa.start, nfa.final,
+        p(eps_off, ctypes.c_int64), p(eps_cond_a, ctypes.c_int8),
+        p(eps_dst_a, ctypes.c_int32),
+        p(t_off, ctypes.c_int64), p(t_bs_a, ctypes.c_int32),
+        p(t_dst_a, ctypes.c_int32),
+        p(bytesets, ctypes.c_uint8), len(masks),
+        p(_WORD_MASK, ctypes.c_uint8),
+        max_states, int(minimize),
+        ctypes.byref(out_ns), ctypes.byref(out_nc), ctypes.byref(out_start),
+        ctypes.byref(err),
+    )
+    if not handle:
+        if err.value == 1:
+            raise DfaLimitExceeded(max_states)
+        return None
+    try:
+        ns, nc = out_ns.value, out_nc.value
+        trans = np.zeros((ns, nc), dtype=np.int32)
+        byte_class = np.zeros(256, dtype=np.int32)
+        accept = np.zeros(ns, dtype=np.uint8)
+        lib.lpn_dfa_read(
+            handle,
+            p(trans, ctypes.c_int32),
+            p(byte_class, ctypes.c_int32),
+            p(accept, ctypes.c_uint8),
+        )
+    finally:
+        lib.lpn_dfa_free(handle)
+    return trans, byte_class, accept.astype(bool), out_start.value

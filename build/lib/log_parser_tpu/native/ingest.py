@@ -1,0 +1,133 @@
+"""Corpus: fused Java-split + device encode with lazy line materialization.
+
+The reference splits the whole log into a String[] up front
+(AnalysisService.java:53). For a 1M-line corpus that is a million Python
+string objects on the host hot path — so here the native library scans the
+UTF-8 blob once, fills the padded uint8 device batch directly, and keeps
+only byte offsets. Per-line ``str`` objects are decoded lazily (context
+extraction touches a handful of window lines per matched event; host regex
+verification touches only flagged lines).
+
+Sequence semantics match ``java_split_lines`` exactly (trailing empty lines
+dropped; no separator → the whole input, even empty) — property-tested
+against the Python implementation in tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from log_parser_tpu.golden.javacompat import java_split_lines
+from log_parser_tpu.native import get_lib
+from log_parser_tpu.ops.encode import (
+    DEFAULT_MAX_LINE_BYTES,
+    EncodedLines,
+    _next_pow2,
+    _pad_rows,
+    encode_lines,
+)
+
+
+class Corpus:
+    """Sequence-of-lines view over a log blob + its encoded device batch.
+
+    Supports ``len``, integer indexing, and slicing (returns list[str]) so
+    golden helpers (extract_context) accept it in place of list[str].
+    """
+
+    def __init__(
+        self,
+        logs: str,
+        max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
+        pad_to_multiple: int = 128,
+        min_rows: int = 8,
+    ):
+        lib = get_lib()
+        if lib is None:
+            lines = java_split_lines(logs)
+            self._lines: list[str] | None = lines
+            self._blob = None
+            self._starts = self._ends = None
+            self.n_lines = len(lines)
+            self.encoded = encode_lines(
+                lines, max_line_bytes, pad_to_multiple, min_rows
+            )
+            return
+
+        import ctypes
+
+        self._lines = None
+        blob = logs.encode("utf-8")
+        self._blob = blob
+        # zero-copy view of the bytes object (blob outlives the calls via self)
+        bufp = ctypes.cast(
+            ctypes.c_char_p(blob if blob else b"\0"),
+            ctypes.POINTER(ctypes.c_uint8),
+        )
+
+        max_len = ctypes.c_int64(0)
+        n = lib.lpn_split_scan(bufp, len(blob), ctypes.byref(max_len))
+        self.n_lines = int(n)
+
+        width = int(min(max_len.value, max_line_bytes))
+        width = max(
+            pad_to_multiple,
+            _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple),
+        )
+        rows = _pad_rows(self.n_lines, min_rows)
+
+        u8 = np.zeros((rows, width), dtype=np.uint8)
+        lengths = np.zeros(rows, dtype=np.int32)
+        needs_host = np.zeros(rows, dtype=np.uint8)
+        starts = np.zeros(rows, dtype=np.int64)
+        ends = np.zeros(rows, dtype=np.int64)
+        lib.lpn_split_fill(
+            bufp,
+            len(blob),
+            self.n_lines,
+            u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            width,
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            needs_host.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_line_bytes,
+        )
+        self._starts = starts
+        self._ends = ends
+        self.encoded = EncodedLines(
+            u8=u8,
+            lengths=lengths,
+            needs_host=needs_host.astype(bool),
+            n_lines=self.n_lines,
+        )
+
+    # ------------------------------------------------------------- sequence
+
+    def __len__(self) -> int:
+        return self.n_lines
+
+    def line(self, i: int) -> str:
+        if self._lines is not None:
+            return self._lines[i]
+        if not 0 <= i < self.n_lines:
+            raise IndexError(i)
+        return self._blob[self._starts[i] : self._ends[i]].decode("utf-8")
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            lo, hi, step = key.indices(self.n_lines)
+            return [self.line(i) for i in range(lo, hi, step)]
+        if key < 0:
+            key += self.n_lines
+        return self.line(key)
+
+    def __iter__(self):
+        for i in range(self.n_lines):
+            yield self.line(i)
+
+    def materialize(self) -> list[str]:
+        """All lines as a list (only for paths that truly need every line)."""
+        if self._lines is None:
+            self._lines = [self.line(i) for i in range(self.n_lines)]
+        return self._lines

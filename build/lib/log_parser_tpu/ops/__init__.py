@@ -1,0 +1,14 @@
+"""JAX kernels: line encoding, automaton execution, integer factor extraction.
+
+No float64 — and no floating point at all — runs on the device: the match
+path is pure int32/bool (DFA gathers over line bytes, prefix sums, record
+compaction), and the seven-factor f64 arithmetic the ≤1e-6 parity target
+requires happens on the host over the integer match records
+(runtime/finalize.py), in the same IEEE doubles the JVM uses.
+"""
+
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.fused import FusedMatchScore
+from log_parser_tpu.ops.match import AcRunner, DfaBank
+
+__all__ = ["AcRunner", "DfaBank", "FusedMatchScore", "encode_lines"]

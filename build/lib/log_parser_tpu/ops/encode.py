@@ -1,0 +1,107 @@
+"""Host-side line encoding: str lines → padded uint8 device batch.
+
+Vectorized with numpy (one ``encode()`` of the whole corpus + fancy
+indexing, no per-line Python loop). Returns, per line, its byte length and
+whether it needs host-side verification (non-ASCII content — where UTF-8
+byte automata and Java UTF-16 semantics can diverge — or length beyond the
+device padding cap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Lines longer than this are matched on host; padding cost on device is
+# quadratic-ish in the tail, and multi-KB lines are rare in pod logs.
+DEFAULT_MAX_LINE_BYTES = 4096
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _pad_rows(n: int, min_rows: int) -> int:
+    """Row count for an ``n``-line batch: the next power of two (bounded
+    compile-shape set) rounded up to a multiple of ``min_rows`` (a sharded
+    engine passes the mesh size, which may not be a power of two — the
+    batch axis must stay divisible by it)."""
+    rows = _next_pow2(max(1, n))
+    return -(-rows // min_rows) * min_rows
+
+
+@dataclasses.dataclass
+class EncodedLines:
+    """A padded batch: ``u8[B, T]`` with zeros beyond ``lengths``."""
+
+    u8: np.ndarray  # uint8 [B, T]
+    lengths: np.ndarray  # int32 [B] byte length clipped to T; over-long
+    # lines are flagged needs_host and re-matched from the original string
+    needs_host: np.ndarray  # bool [B] non-ASCII or over-long
+    n_lines: int
+
+
+def encode_lines(
+    lines: list[str],
+    max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
+    pad_to_multiple: int = 128,
+    min_rows: int = 8,
+) -> EncodedLines:
+    """Pack ``lines`` into a padded uint8 matrix.
+
+    The row count is padded up to a multiple of ``min_rows`` (sharding needs
+    divisibility) and the width to a multiple of ``pad_to_multiple`` (TPU
+    lane alignment). Lines can't contain ``\\n`` (they come from the
+    reference's split, AnalysisService.java:53), so a newline join is a safe
+    single-pass encoding.
+    """
+    n = len(lines)
+    if n == 0:
+        return EncodedLines(
+            u8=np.zeros((min_rows, pad_to_multiple), dtype=np.uint8),
+            lengths=np.zeros(min_rows, dtype=np.int32),
+            needs_host=np.zeros(min_rows, dtype=bool),
+            n_lines=0,
+        )
+    blob = "\n".join(lines).encode("utf-8")
+    flat = np.frombuffer(blob, dtype=np.uint8)
+    # line boundaries: newline positions in the joined blob
+    seps = np.flatnonzero(flat == 0x0A)
+    starts = np.concatenate([[0], seps + 1]).astype(np.int64)
+    ends = np.concatenate([seps, [len(flat)]]).astype(np.int64)
+    lengths = (ends - starts).astype(np.int32)
+
+    # pad rows and width to powers of two so jitted kernels see a small,
+    # bounded set of shapes (each distinct shape costs an XLA compile)
+    width = int(min(lengths.max(initial=0), max_line_bytes))
+    width = max(pad_to_multiple, _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple))
+    rows = _pad_rows(n, min_rows)
+
+    # fill in row chunks: a full [n, width] gather-index matrix would cost
+    # ~9x the output batch in temporaries (int64 indices + bool mask) and
+    # OOM on 1M-line corpora with a wide width
+    u8 = np.zeros((rows, width), dtype=np.uint8)
+    if len(flat):
+        col = np.arange(width, dtype=np.int64)[None, :]
+        chunk = max(1, (64 << 20) // max(1, width))  # ~64MB of indices per chunk
+        for lo in range(0, n, chunk):
+            hi = min(n, lo + chunk)
+            take = starts[lo:hi, None] + col
+            mask = col < np.minimum(lengths[lo:hi], width)[:, None]
+            u8[lo:hi] = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
+
+    non_ascii = np.zeros(rows, dtype=bool)
+    non_ascii[:n] = np.bitwise_or.reduce(u8[:n] & 0x80, axis=1) != 0
+    over_long = np.zeros(rows, dtype=bool)
+    over_long[:n] = lengths > max_line_bytes
+
+    full_lengths = np.zeros(rows, dtype=np.int32)
+    full_lengths[:n] = np.minimum(lengths, width)
+
+    return EncodedLines(
+        u8=u8,
+        lengths=full_lengths,
+        needs_host=non_ascii | over_long,
+        n_lines=n,
+    )

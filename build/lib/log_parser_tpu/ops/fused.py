@@ -1,0 +1,422 @@
+"""Fused match + integer-factor extraction: ONE device program per batch.
+
+TPU has no native float64 — XLA emulates it at a large cost, and the
+seven-factor formula needs f64 for ≤1e-6 parity with the JVM's double
+arithmetic (SURVEY.md §7 hard part 2). The resolution here is that every
+scoring factor is a closed-form f64 function of a handful of *integers*:
+
+==============  ======================================================
+factor          integer components (exact)
+==============  ======================================================
+chronological   global line index, total line count
+proximity       per-secondary distance to the nearest hit (int lines)
+temporal        per-sequence matched flag (bool)
+context         window counts: error / shadowed-warn / stack /
+                exception lines + window total
+frequency       in-batch prior match count per slot (recovered on host
+                from the record stream itself) + persisted base count
+==============  ======================================================
+
+So the device program (this module) runs the DFA bank and extracts ONLY
+those integers, compacted to a K-capped record buffer in discovery order
+(line-major then pattern order — AnalysisService.java:89-113), and the
+host finalizer (runtime/finalize.py) evaluates the formula in true f64 on
+the M ≪ B·P matched records. No f64 ever touches the device, transfers
+shrink from O(B·P) score matrices to O(K) integer records, and parity is
+*better* than device-side f64 because the host math is the same IEEE
+doubles the JVM uses (ScoringService.java:102-109).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import SEQUENCE_NEAR_WINDOW
+from log_parser_tpu.ops.match import DfaBank
+from log_parser_tpu.patterns.bank import (
+    CTX_ERROR,
+    CTX_EXCEPTION,
+    CTX_STACK,
+    CTX_WARN,
+    PatternBank,
+)
+
+# "no hit" distance sentinel: larger than any window yet far from int32
+# overflow when compared/subtracted
+NO_HIT = np.int32(1 << 30)
+
+# K-capped record buffers: ladder of compiled bucket sizes; a batch whose
+# match count overflows the chosen bucket re-runs at the next rung
+K_LADDER = (4096, 32768, 262144, 2097152)
+
+
+@dataclasses.dataclass
+class MatchRecords:
+    """Device outputs for one batch: integer factor components per match,
+    in discovery order. Rows ≥ n_matches are garbage (unfilled buffer)."""
+
+    n_matches: int
+    line: np.ndarray  # int32 [K] 0-based global line index
+    pattern: np.ndarray  # int32 [K] pattern index into bank.patterns
+    sec_dist: np.ndarray  # int32 [K, S_max] distance per pattern secondary (NO_HIT pad)
+    seq_ok: np.ndarray  # bool [K, Q_max] per pattern sequence matched
+    ctx_counts: np.ndarray  # int32 [K, 5] err, warn-shadowed, stack, exc, total
+
+
+class FusedStaticTables:
+    """Per-bank static structure shared by the single-device and sharded
+    fused programs: per-pattern padded index tables mapping each match
+    record to its pattern's secondary entries / sequences / context shape."""
+
+    def __init__(self, bank: PatternBank, config: ScoringConfig):
+        self.bank = bank
+        self.config = config
+
+        # ---- secondaries: flat entry tables + per-pattern padded index ----
+        self.sec_cols = np.asarray([e.column for e in bank.secondaries], dtype=np.int32)
+        self.sec_weight = np.asarray([e.weight for e in bank.secondaries], dtype=np.float64)
+        self.sec_window = np.asarray(
+            [min(config.proximity_max_window, e.window) for e in bank.secondaries],
+            dtype=np.int64,
+        )
+        per_pat: list[list[int]] = [[] for _ in range(bank.n_patterns)]
+        for entry_idx, e in enumerate(bank.secondaries):
+            per_pat[e.pattern_idx].append(entry_idx)
+        self.s_max = max((len(v) for v in per_pat), default=0)
+        self.pat_sec = np.full((max(1, bank.n_patterns), max(1, self.s_max)), -1, np.int32)
+        for p, entries in enumerate(per_pat):
+            self.pat_sec[p, : len(entries)] = entries
+
+        # ---- sequences ----------------------------------------------------
+        self.seq_bonus = np.asarray([s.bonus for s in bank.sequences], dtype=np.float64)
+        self.seq_event_cols = sorted({c for s in bank.sequences for c in s.event_columns})
+        self.seq_col_pos = {c: i for i, c in enumerate(self.seq_event_cols)}
+        per_pat_q: list[list[int]] = [[] for _ in range(bank.n_patterns)]
+        for q_idx, s in enumerate(bank.sequences):
+            per_pat_q[s.pattern_idx].append(q_idx)
+        self.q_max = max((len(v) for v in per_pat_q), default=0)
+        self.pat_seq = np.full((max(1, bank.n_patterns), max(1, self.q_max)), -1, np.int32)
+        for p, qs in enumerate(per_pat_q):
+            self.pat_seq[p, : len(qs)] = qs
+
+        # ---- context: unique (has_rules, before, after) shapes -------------
+        shapes: list[tuple[bool, int, int]] = []
+        shape_idx: dict[tuple[bool, int, int], int] = {}
+        pattern_shape = []
+        for p_idx in range(bank.n_patterns):
+            key = (
+                bool(bank.has_context_rules[p_idx]),
+                int(bank.ctx_before[p_idx]),
+                int(bank.ctx_after[p_idx]),
+            )
+            if key not in shape_idx:
+                shape_idx[key] = len(shapes)
+                shapes.append(key)
+            pattern_shape.append(shape_idx[key])
+        self.ctx_shapes = shapes
+        self.pat_ctx_shape = np.asarray(pattern_shape, dtype=np.int32)
+
+
+def _prev_next_dist(hits: jax.Array, row_idx: jax.Array) -> jax.Array:
+    """[B, S] bool hit columns -> [B, S] int32 distance to the nearest hit
+    on either side, own row excluded (strict prev/next — the primary line
+    is skipped at ScoringService.java:326-328). NO_HIT where none."""
+    col_idx = row_idx[:, None]
+    prev_incl = jax.lax.cummax(jnp.where(hits, col_idx, -1), axis=0)
+    prev = jnp.concatenate(
+        [jnp.full((1, hits.shape[1]), -1, prev_incl.dtype), prev_incl[:-1]], axis=0
+    )
+    nxt_incl = jnp.flip(
+        jax.lax.cummin(jnp.flip(jnp.where(hits, col_idx, NO_HIT), axis=0), axis=0),
+        axis=0,
+    )
+    nxt = jnp.concatenate(
+        [nxt_incl[1:], jnp.full((1, hits.shape[1]), NO_HIT, nxt_incl.dtype)], axis=0
+    )
+    d_prev = jnp.where(prev >= 0, col_idx - prev, NO_HIT)
+    d_next = jnp.where(nxt < NO_HIT, nxt - col_idx, NO_HIT)
+    return jnp.minimum(d_prev, d_next)
+
+
+def _prefix(x: jax.Array) -> jax.Array:
+    """[B, ...] -> [B+1, ...] exclusive prefix sums (window sum = 2 gathers)."""
+    return jnp.concatenate(
+        [jnp.zeros((1,) + x.shape[1:], x.dtype), jnp.cumsum(x, axis=0)]
+    )
+
+
+def sequence_flags_from_events(
+    sequences, t: "FusedStaticTables", em: jax.Array, idx: jax.Array, n_lines
+) -> jax.Array:
+    """[len(idx), n_sequences] bool — sequence fully matched with the primary
+    at each ``idx`` row of the (global) event-match matrix ``em`` [B, E]
+    (ScoringService.java:230-305): last event within ±5 of the primary via a
+    prefix-count range-any (:272-286), earlier events chained strictly
+    backwards via inclusive prefix-cummax of last-hit line; the chain
+    restarts at the *primary* line, not the near-window hit (:250).
+
+    Shared by the single-device program (em local == global) and the
+    sharded program (em all_gathered, idx = the shard's global rows)."""
+    B = em.shape[0]
+    eidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    prev_incl = jax.lax.cummax(jnp.where(em, eidx, -1), axis=0)  # [B, E]
+    prefix_counts = _prefix(em.astype(jnp.int32))  # [B+1, E]
+
+    w = SEQUENCE_NEAR_WINDOW
+    outs = []
+    for seq in sequences:
+        if not seq.event_columns:
+            outs.append(jnp.zeros(idx.shape, dtype=bool))
+            continue
+        last_e = t.seq_col_pos[seq.event_columns[-1]]
+        lo = jnp.clip(idx - w, 0, B)
+        hi = jnp.clip(jnp.minimum(idx + w + 1, n_lines), 0, B).astype(jnp.int32)
+        ok = (prefix_counts[hi, last_e] - prefix_counts[lo, last_e]) > 0
+        cur = idx
+        for col in reversed(seq.event_columns[:-1]):
+            e = t.seq_col_pos[col]
+            g = jnp.where(cur >= 1, prev_incl[jnp.clip(cur - 1, 0, B - 1), e], -1)
+            ok = ok & (g >= 0)
+            cur = jnp.clip(g, 0, B - 1)
+        outs.append(ok)
+    return jnp.stack(outs, axis=1)
+
+
+def compact_records(
+    K: int,
+    pm: jax.Array,
+    t: "FusedStaticTables",
+    emit_line: jax.Array,
+    gather_line: jax.Array,
+    sec_dist: jax.Array,
+    seq_ok: jax.Array,
+    ctx_counts: jax.Array,
+):
+    """K-capped record compaction in discovery order (line-major then
+    pattern order — AnalysisService.java:89-113), shared by the
+    single-device and sharded programs.
+
+    ``emit_line``: per-row line index written into the records (global);
+    ``gather_line``: per-row index into the dense factor tables (local).
+    rank = exclusive match count in flat order == the record's output slot;
+    slot K is the trash row for overflow (caller re-runs at a bigger K)."""
+    B, P = pm.shape
+    pm32 = pm.astype(jnp.int32)
+    flat = pm32.reshape(-1)
+    rank = (jnp.cumsum(flat) - flat).reshape(B, P)
+    n_matches = jnp.sum(flat)
+    out_pos = jnp.where(pm & (rank < K), rank, K).reshape(-1)
+
+    emit_bp = jnp.broadcast_to(emit_line[:, None], (B, P)).reshape(-1)
+    gather_bp = jnp.broadcast_to(gather_line[:, None], (B, P)).reshape(-1)
+    pats_bp = jnp.broadcast_to(
+        jnp.arange(P, dtype=jnp.int32)[None, :], (B, P)
+    ).reshape(-1)
+    rec_line = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(emit_bp)[:K]
+    rec_grow = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(gather_bp)[:K]
+    rec_pat = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(pats_bp)[:K]
+
+    sec_idx = jnp.asarray(t.pat_sec)[rec_pat]  # [K, S_max]
+    rec_dist = jnp.where(
+        sec_idx >= 0,
+        sec_dist[rec_grow[:, None], jnp.maximum(sec_idx, 0)],
+        NO_HIT,
+    )
+    q_idx = jnp.asarray(t.pat_seq)[rec_pat]  # [K, Q_max]
+    rec_seq = jnp.where(
+        q_idx >= 0, seq_ok[rec_grow[:, None], jnp.maximum(q_idx, 0)], False
+    )
+    rec_ctx = ctx_counts[rec_grow, jnp.asarray(t.pat_ctx_shape)[rec_pat]]  # [K, 5]
+
+    return n_matches.astype(jnp.int32), rec_line, rec_pat, rec_dist, rec_seq, rec_ctx
+
+
+class FusedMatchScore:
+    """Single-device fused program: bytes → DFA cube → integer match records.
+
+    Compiled once per (batch rows, K bucket, overrides?) combination; the
+    engine picks the K bucket adaptively and re-runs on overflow.
+    """
+
+    def __init__(self, bank: PatternBank, config: ScoringConfig, matchers):
+        self.bank = bank
+        self.config = config
+        self.matchers = matchers  # MatcherBanks: tiered Shift-Or + DFA cube
+        self.t = FusedStaticTables(bank, config)
+        # K is a static arg: each bucket size is its own cached executable
+        self._jit_ov = jax.jit(
+            lambda k, lines, lens, n, om, ov: self._step(k, lines, lens, n, (om, ov)),
+            static_argnums=(0,),
+        )
+        self._jit_plain = jax.jit(
+            lambda k, lines, lens, n: self._step(k, lines, lens, n, None),
+            static_argnums=(0,),
+        )
+
+    # ------------------------------------------------------------- host entry
+
+    def dispatch(
+        self,
+        k: int,
+        lines_u8: np.ndarray,
+        lengths: np.ndarray,
+        n_lines: int,
+        override_mask: np.ndarray | None = None,
+        override_val: np.ndarray | None = None,
+    ):
+        """Launch the fused program asynchronously at record capacity ``k``
+        and return the un-synchronized device outputs. Callers fan out
+        several dispatches (e.g. one pattern block per device) before the
+        first blocking read."""
+        lines_tb = jnp.asarray(lines_u8.T)
+        lens = jnp.asarray(lengths)
+        n = jnp.asarray(n_lines, dtype=jnp.int32)
+        if override_mask is not None:
+            return self._jit_ov(
+                k, lines_tb, lens, n,
+                jnp.asarray(override_mask), jnp.asarray(override_val),
+            )
+        return self._jit_plain(k, lines_tb, lens, n)
+
+    def k_ladder(self, lines_u8: np.ndarray, k_hint: int = 0):
+        """The record-capacity buckets to try, smallest viable first."""
+        cap = lines_u8.shape[0] * max(1, self.bank.n_patterns)
+        start = 0
+        while start < len(K_LADDER) - 1 and K_LADDER[start] < k_hint:
+            start += 1
+        return [min(k, cap) for k in (*K_LADDER[start:], cap)], cap
+
+    @staticmethod
+    def resolve(out) -> MatchRecords | None:
+        """Synchronize one dispatch; None signals K overflow (re-dispatch
+        at the next ladder rung)."""
+        n_matches = int(out[0])
+        if n_matches > out[1].shape[0]:
+            return None
+        return MatchRecords(
+            n_matches=n_matches,
+            line=np.asarray(out[1]),
+            pattern=np.asarray(out[2]),
+            sec_dist=np.asarray(out[3]),
+            seq_ok=np.asarray(out[4]),
+            ctx_counts=np.asarray(out[5]),
+        )
+
+    def run(
+        self,
+        lines_u8: np.ndarray,
+        lengths: np.ndarray,
+        n_lines: int,
+        override_mask: np.ndarray | None = None,
+        override_val: np.ndarray | None = None,
+        k_hint: int = 0,
+    ) -> MatchRecords:
+        """Executes the fused program, growing the record buffer until the
+        batch's matches fit. ``k_hint``: expected match count (e.g. the
+        previous request's), used to pick the starting bucket."""
+        ladder, cap = self.k_ladder(lines_u8, k_hint)
+        for k in ladder:
+            out = self.dispatch(k, lines_u8, lengths, n_lines, override_mask, override_val)
+            recs = self.resolve(out)
+            if recs is not None or k >= cap:
+                if recs is None:  # cap rung can never truly overflow
+                    raise AssertionError("unreachable: K ladder capped at B*P")
+                return recs
+        raise AssertionError("unreachable: K ladder capped at B*P")
+
+    # ---------------------------------------------------------- device program
+
+    def _step(self, K, lines_tb, lengths, n_lines, overrides):
+        bank, t = self.bank, self.t
+        B = lengths.shape[0]
+        P = bank.n_patterns
+        row_idx = jnp.arange(B, dtype=jnp.int32)
+        valid = row_idx < n_lines
+
+        # ---- match cube (tiered: Shift-Or + DFA banks) --------------------
+        cube = self.matchers.cube(lines_tb, lengths)
+        if overrides is not None:
+            om, ov = overrides
+            cube = jnp.where(om, ov, cube)
+        # padding rows contribute nothing: empty-matching regexes (^$, \s*)
+        # would otherwise produce phantom hits on zero-length padding
+        cube = cube & valid[:, None]
+
+        if P == 0:
+            z32 = jnp.zeros((K,), jnp.int32)
+            return (
+                jnp.int32(0),
+                z32,
+                z32,
+                jnp.full((K, max(1, t.s_max)), NO_HIT, jnp.int32),
+                jnp.zeros((K, max(1, t.q_max)), bool),
+                jnp.zeros((K, 5), jnp.int32),
+            )
+
+        pm = cube[:, jnp.asarray(bank.primary_columns)]  # [B, P]
+
+        # ---- dense integer factor components ------------------------------
+        sec_dist = self._secondary_distances(cube, row_idx)  # [B, Smax-safe]
+        em = (
+            cube[:, jnp.asarray(t.seq_event_cols, dtype=np.int32)]
+            if bank.sequences
+            else jnp.zeros((B, 1), dtype=bool)
+        )
+        seq_ok = (
+            sequence_flags_from_events(bank.sequences, t, em, row_idx, n_lines)
+            if bank.sequences
+            else jnp.zeros((B, 1), dtype=bool)
+        )
+        ctx_counts = self._context_counts(cube, row_idx, B, n_lines)  # [B, U, 5]
+
+        # single-device: emit and gather coordinates coincide
+        return compact_records(
+            K, pm, t, row_idx, row_idx, sec_dist, seq_ok, ctx_counts
+        )
+
+    # ------------------------------------------------------------ dense tables
+
+    def _secondary_distances(self, cube, row_idx):
+        """[B, n_sec_entries] int32 nearest-hit distances (NO_HIT if none).
+        Exact for any window: the nearest hit overall is the nearest hit
+        within the window (ScoringService.java:315-347)."""
+        t = self.t
+        if len(t.sec_cols) == 0:
+            return jnp.full((cube.shape[0], 1), NO_HIT, jnp.int32)
+        hits = cube[:, jnp.asarray(t.sec_cols)]  # [B, S_entries]
+        return _prev_next_dist(hits, row_idx)
+
+    def _context_counts(self, cube, row_idx, B, n_lines):
+        """[B, U, 5] int32 — per unique context shape: error lines,
+        shadowed-warn lines (else-if at ContextAnalysisService.java:64-70),
+        stack lines, exception lines, window total."""
+        t = self.t
+        err = cube[:, CTX_ERROR]
+        warn = cube[:, CTX_WARN] & ~err
+        stack = cube[:, CTX_STACK]
+        exc = cube[:, CTX_EXCEPTION]
+        flags = jnp.stack(
+            [err, warn, stack, exc], axis=1
+        ).astype(jnp.int32)  # [B, 4]
+        ps = _prefix(flags)  # [B+1, 4]
+
+        per_shape = []
+        for has_rules, before, after in t.ctx_shapes:
+            if not has_rules:
+                # context = the matched line only (AnalysisService.java:135-139)
+                counts = flags
+                total = jnp.ones((B,), jnp.int32)
+            else:
+                lo = jnp.clip(row_idx - before, 0, B)
+                hi = jnp.clip(jnp.minimum(row_idx + 1 + after, n_lines), 0, B).astype(
+                    jnp.int32
+                )
+                counts = ps[hi] - ps[lo]  # [B, 4]
+                total = hi - lo
+            per_shape.append(jnp.concatenate([counts, total[:, None]], axis=1))
+        return jnp.stack(per_shape, axis=1)  # [B, U, 5]

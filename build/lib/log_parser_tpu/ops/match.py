@@ -1,0 +1,372 @@
+"""Batched automaton execution on device.
+
+Two kernels, both shaped as a ``lax.scan`` over byte columns with one gather
+per step — the TPU-native replacement for the reference's per-line
+``Matcher.find()`` hot loop (AnalysisService.java:89-113):
+
+- :class:`DfaBank` runs R independent per-regex DFAs over every line
+  simultaneously (state tensor ``[B, R]``), producing the full boolean
+  match cube the scoring kernel consumes.
+- :class:`AcRunner` runs the single combined Aho-Corasick automaton (state
+  tensor ``[B]``), producing literal-hit bitmask words per line — the cheap
+  prefilter for large pattern libraries.
+
+Scans carry int32 states only; byte columns are consumed in a transposed
+``[T, B]`` layout so each scan step is a contiguous slice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from log_parser_tpu.patterns.regex.ac import AhoCorasick
+from log_parser_tpu.patterns.regex.dfa import CompiledDfa
+
+
+# pair-stride transition tables beyond this many int32 entries fall back to
+# single-stride (the table must stay comfortably HBM/VMEM-resident)
+PAIR_TABLE_MAX_ENTRIES = 64 << 20
+
+
+def pack_byte_pairs(lines_tb: jax.Array):
+    """uint8 [T, B] -> ([T2, 2, B] byte pairs, [T2] step indexes), padding
+    T to even so every scan step consumes exactly two bytes."""
+    T, B = lines_tb.shape
+    if T % 2:
+        lines_tb = jnp.concatenate(
+            [lines_tb, jnp.zeros((1, B), lines_tb.dtype)], axis=0
+        )
+        T += 1
+    return lines_tb.reshape(T // 2, 2, B), jnp.arange(T // 2, dtype=jnp.int32)
+
+
+class DfaBank:
+    """R packed DFAs executed in lockstep over a line batch.
+
+    The scan is the serial axis of the whole framework, so by default two
+    bytes are consumed per step via precomposed pair transition tables
+    ``trans2[s, c1, c2] = trans[trans[s, c1], c2]`` over byte classes
+    extended with one identity "padding" class (consumed where a position
+    is at/past the line end). That halves the sequential scan length for a
+    table-size cost of ``(cmax+1)²/cmax`` — gated by
+    ``PAIR_TABLE_MAX_ENTRIES`` for very large banks.
+    """
+
+    def __init__(self, dfas: list[CompiledDfa], stride: int = 2):
+        self.n_regexes = len(dfas)
+        r = max(1, self.n_regexes)
+        smax = max([d.n_states for d in dfas], default=1)
+        cmax = max([d.n_classes for d in dfas], default=1)
+        trans = np.zeros((r, smax, cmax), dtype=np.int32)
+        byte_class = np.zeros((r, 256), dtype=np.int32)
+        accept = np.zeros((r, smax), dtype=bool)
+        start = np.zeros(r, dtype=np.int32)
+        for i, d in enumerate(dfas):
+            trans[i, : d.n_states, : d.n_classes] = d.trans
+            byte_class[i] = d.byte_class
+            accept[i, : d.n_states] = d.accept_end
+            start[i] = d.start
+        self.smax, self.cmax = smax, cmax
+        # flat layout for a single fused gather per scan step
+        self.flat_trans = jnp.asarray(trans.reshape(-1))
+        self.byte_class = jnp.asarray(byte_class)
+        self.flat_accept = jnp.asarray(accept.reshape(-1))
+        self.start = jnp.asarray(start)
+
+        self.pair_stride = (
+            stride == 2
+            and r * smax * (cmax + 1) * (cmax + 1) <= PAIR_TABLE_MAX_ENTRIES
+        )
+        if self.pair_stride:
+            cpad = cmax + 1  # class cmax = identity padding class
+            ext = np.zeros((r, smax, cpad), dtype=np.int32)
+            ext[:, :, :cmax] = trans
+            ext[:, :, cmax] = np.arange(smax, dtype=np.int32)[None, :]
+            # trans2[r, s, c1, c2] = ext[r, ext[r, s, c1], c2]
+            trans2 = np.empty((r, smax, cpad, cpad), dtype=np.int32)
+            for i in range(r):
+                trans2[i] = ext[i][ext[i], :]
+            self.cpad = cpad
+            self.flat_trans2 = jnp.asarray(trans2.reshape(-1))
+
+        self._jit = jax.jit(self._run)
+
+    def _run(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
+        """lines_tb: uint8 [T, B] (transposed); lengths: int32 [B].
+        Returns bool [B, R]."""
+        return self._run_pair(lines_tb, lengths)
+
+    def _run_single(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
+        T, B = lines_tb.shape
+        R = self.byte_class.shape[0]
+        smax, cmax = self.smax, self.cmax
+        states0 = jnp.broadcast_to(self.start[None, :], (B, R)).astype(jnp.int32)
+        r_off = (jnp.arange(R, dtype=jnp.int32) * smax)[None, :]  # [1, R]
+
+        def step(states, xs):
+            bytes_t, t = xs
+            cls = jnp.take(self.byte_class, bytes_t.astype(jnp.int32), axis=1)  # [R, B]
+            idx = (r_off + states) * cmax + cls.T  # [B, R]
+            nxt = jnp.take(self.flat_trans, idx.reshape(-1)).reshape(B, R)
+            active = (t < lengths)[:, None]
+            return jnp.where(active, nxt, states), None
+
+        ts = jnp.arange(T, dtype=jnp.int32)
+        states, _ = jax.lax.scan(step, states0, (lines_tb, ts))
+        return jnp.take(self.flat_accept, (r_off + states).reshape(-1)).reshape(B, R)
+
+    def _run_pair(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
+        """Two bytes per scan step through the precomposed pair tables;
+        positions at/past each line's end consume the identity class, so no
+        per-step boundary branch is needed."""
+        T, B = lines_tb.shape
+        init, step, finish = self.pair_stepper(B, lengths)
+        pairs, ts = pack_byte_pairs(lines_tb)
+        states, _ = jax.lax.scan(
+            lambda s, xs: (step(s, xs[0][0], xs[0][1], xs[1]), None),
+            init,
+            (pairs, ts),
+        )
+        return finish(states)
+
+    def pair_stepper(self, B: int, lengths: jax.Array):
+        """(init, step(carry, b1, b2, t), finish) — one pair-consuming scan
+        stage, composable with other banks into a single fused scan."""
+        R = self.byte_class.shape[0]
+        smax = self.smax
+        states0 = jnp.broadcast_to(self.start[None, :], (B, R)).astype(jnp.int32)
+        r_off = (jnp.arange(R, dtype=jnp.int32) * smax)[None, :]  # [1, R]
+
+        if self.pair_stride:
+            cpad = self.cpad
+            pad_cls = jnp.int32(self.cmax)
+
+            def step(states, b1, b2, t):
+                p0 = 2 * t
+                c1 = jnp.take(self.byte_class, b1.astype(jnp.int32), axis=1)  # [R, B]
+                c2 = jnp.take(self.byte_class, b2.astype(jnp.int32), axis=1)
+                c1 = jnp.where((p0 < lengths)[None, :], c1, pad_cls)
+                c2 = jnp.where((p0 + 1 < lengths)[None, :], c2, pad_cls)
+                idx = ((r_off + states) * cpad + c1.T) * cpad + c2.T  # [B, R]
+                return jnp.take(self.flat_trans2, idx.reshape(-1)).reshape(B, R)
+
+        else:
+            cmax = self.cmax
+
+            def one(states, b, pos_ok):
+                cls = jnp.take(self.byte_class, b.astype(jnp.int32), axis=1)  # [R, B]
+                idx = (r_off + states) * cmax + cls.T
+                nxt = jnp.take(self.flat_trans, idx.reshape(-1)).reshape(B, R)
+                return jnp.where(pos_ok[:, None], nxt, states)
+
+            def step(states, b1, b2, t):
+                p0 = 2 * t
+                states = one(states, b1, p0 < lengths)
+                return one(states, b2, p0 + 1 < lengths)
+
+        def finish(states):
+            return jnp.take(
+                self.flat_accept, (r_off + states).reshape(-1)
+            ).reshape(B, R)
+
+        return states0, step, finish
+
+    def match(self, lines_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Host entry: uint8 [B, T] padded batch → bool [B, R] match cube."""
+        if self.n_regexes == 0:
+            return np.zeros((lines_u8.shape[0], 0), dtype=bool)
+        out = self._jit(jnp.asarray(lines_u8.T), jnp.asarray(lengths))
+        return np.asarray(out)[:, : self.n_regexes]
+
+
+class AcRunner:
+    """Combined Aho-Corasick literal prefilter on device."""
+
+    def __init__(self, ac: AhoCorasick):
+        self.ac = ac
+        self.n_words = ac.n_words
+        self.goto = jnp.asarray(ac.goto)
+        self.byte_class = jnp.asarray(ac.byte_class)
+        self.out_words = jnp.asarray(ac.out_words.astype(np.uint32))
+        self._jit = jax.jit(self._run)
+
+    def _run(self, lines_tb: jax.Array, lengths: jax.Array) -> jax.Array:
+        T, B = lines_tb.shape
+
+        def step(carry, xs):
+            states, hits = carry
+            bytes_t, t = xs
+            cls = jnp.take(self.byte_class, bytes_t.astype(jnp.int32))  # [B]
+            nxt = self.goto[states, cls]  # [B]
+            active = t < lengths
+            states = jnp.where(active, nxt, states)
+            step_hits = jnp.where(
+                active[:, None], jnp.take(self.out_words, states, axis=0), jnp.uint32(0)
+            )
+            return (states, hits | step_hits), None
+
+        states0 = jnp.zeros(B, dtype=jnp.int32)
+        hits0 = jnp.zeros((B, self.n_words), dtype=jnp.uint32)
+        ts = jnp.arange(T, dtype=jnp.int32)
+        (_, hits), _ = jax.lax.scan(step, (states0, hits0), (lines_tb, ts))
+        return hits
+
+    def scan(self, lines_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Host entry: uint8 [B, T] → uint32 [B, n_words] literal-hit masks."""
+        out = self._jit(jnp.asarray(lines_u8.T), jnp.asarray(lengths))
+        return np.asarray(out)
+
+
+class MatcherBanks:
+    """Tiered device matchers for one PatternBank's columns.
+
+    Tier selection is static per column (patterns/bank.py): literal-shaped
+    regexes go to the bit-parallel Shift-Or bank (cost independent of bank
+    size); in wide banks, regexes with required literals ride the AC
+    prefilter + per-record verify tier (ops/prefilter.py — cost per byte
+    independent of library width); the rest go to the packed dense DFA
+    bank; automaton-unsupported regexes stay host-side (the engine injects
+    them as cube overrides).
+    """
+
+    # below this many device columns, the whole bank rides the pair-stride
+    # DFA alone: the [B, R] transition gather is small, and adding the
+    # Shift-Or stage to the scan costs more than the width it removes.
+    # Wide banks (the 10k-regex configuration) move every literal-shaped
+    # column to Shift-Or, whose per-step cost is O(packed words), not O(R).
+    SHIFTOR_MIN_COLUMNS = 64
+    # below this many DENSE-DFA columns, the prefilter tier stays off: the
+    # dense gather is cheap and the extra scans aren't worth their latency
+    PREFILTER_MIN_COLUMNS = 64
+
+    def __init__(
+        self,
+        bank,
+        stride: int = 2,
+        shiftor_min_columns: int | None = None,
+        prefilter_min_columns: int | None = None,
+    ):
+        import jax.numpy as jnp
+
+        from log_parser_tpu.ops.prefilter import PrefilterBank
+        from log_parser_tpu.ops.shiftor import ShiftOrBank
+
+        self.bank = bank
+        threshold = (
+            self.SHIFTOR_MIN_COLUMNS
+            if shiftor_min_columns is None
+            else shiftor_min_columns
+        )
+        pref_threshold = (
+            self.PREFILTER_MIN_COLUMNS
+            if prefilter_min_columns is None
+            else prefilter_min_columns
+        )
+        n_device = sum(
+            1
+            for c in bank.columns
+            if c.dfa is not None or c.exact_seqs is not None
+        )
+        use_shiftor = n_device >= threshold
+        self.shiftor_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.exact_seqs is not None and (use_shiftor or c.dfa is None)
+        ]
+        shiftor_set = set(self.shiftor_cols)
+        dense_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.dfa is not None and i not in shiftor_set
+        ]
+        self.host_cols = [
+            i
+            for i, c in enumerate(bank.columns)
+            if c.dfa is None and c.exact_seqs is None
+        ]
+
+        # prefilter tier: DFA columns with a non-empty required-literal set,
+        # engaged only for wide banks and within the trie budget
+        self.prefilter: PrefilterBank | None = None
+        self.prefilter_cols: list[int] = []
+        if len(dense_cols) >= pref_threshold:
+            eligible = [
+                (i, bank.columns[i]) for i in dense_cols if bank.columns[i].literals
+            ]
+            selected, _rejected = PrefilterBank.select(eligible)
+            if len(selected) >= pref_threshold:
+                self.prefilter = PrefilterBank(selected)
+                self.prefilter_cols = [g for g, _ in selected]
+                pref_set = set(self.prefilter_cols)
+                dense_cols = [i for i in dense_cols if i not in pref_set]
+
+        self.dfa_cols = dense_cols
+        self.dfa_bank = DfaBank(
+            [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
+        )
+        self.shiftor = (
+            ShiftOrBank(
+                [(i, bank.columns[i].exact_seqs) for i in self.shiftor_cols]
+            )
+            if self.shiftor_cols
+            else None
+        )
+        self._jnp = jnp
+
+    @property
+    def device_cols(self) -> list[int]:
+        return self.shiftor_cols + self.dfa_cols + self.prefilter_cols
+
+    def cube(self, lines_tb, lengths):
+        """uint8 [T, B] + lengths -> bool [B, n_columns] match cube
+        (device-computable columns only; host columns stay False for the
+        engine's override pass).
+
+        Both banks advance in ONE fused scan over byte pairs — the scan is
+        the serial axis, so composing steppers instead of running two scans
+        halves the sequential latency when both tiers are populated."""
+        jnp = self._jnp
+        B = lengths.shape[0]
+        cube = jnp.zeros((B, self.bank.n_columns), dtype=bool)
+        steppers = []
+        if self.dfa_cols:
+            steppers.append(
+                (self.dfa_bank.pair_stepper(B, lengths), self.dfa_cols, True)
+            )
+        if self.shiftor is not None:
+            steppers.append(
+                (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
+            )
+        if self.prefilter is not None:
+            steppers.append(
+                (self.prefilter.anyhit_stepper(B, lengths), None, False)
+            )
+        if not steppers:
+            return cube
+
+        inits = tuple(s[0][0] for s in steppers)
+        pairs, ts = pack_byte_pairs(lines_tb)
+
+        def fused_step(carries, xs):
+            pair_t, t = xs
+            new = tuple(
+                s[0][1](c, pair_t[0], pair_t[1], t)
+                for s, c in zip(steppers, carries)
+            )
+            return new, None
+
+        finals, _ = jax.lax.scan(fused_step, inits, (pairs, ts))
+        for (stepper, cols, is_dfa), carry in zip(steppers, finals):
+            out = stepper[2](carry)
+            if cols is None:  # prefilter: any-hit bits -> stages 2+3
+                contrib = self.prefilter.contribution(lines_tb, lengths, out)
+                cube = cube.at[
+                    :, jnp.asarray(np.asarray(self.prefilter_cols))
+                ].set(contrib)
+                continue
+            if is_dfa:
+                out = out[:, : len(cols)]
+            cube = cube.at[:, jnp.asarray(np.asarray(cols))].set(out)
+        return cube

@@ -1,0 +1,286 @@
+"""AC prefilter + per-record DFA verify for wide pattern banks.
+
+The Hyperscan decomposition (patterns/regex/literals.py:1-20) adapted to
+batched TPU execution — the third matcher tier next to Shift-Or and the
+dense DFA bank, replacing the reference's per-line `Matcher.find()` loop
+for large libraries (AnalysisService.java:89-113):
+
+1. the main fused byte scan carries ONE extra automaton: a combined
+   Aho-Corasick over every prefiltered column's required literals
+   (case-folded — folding only widens the filter, never drops a match),
+   accumulating only a per-line "hit anything" bit: O(1) gathers per byte
+   regardless of library size;
+2. hit lines — typically a few percent — are compacted and re-scanned
+   through the same automaton accumulating full per-COLUMN hit bitmasks
+   (group bits, ac.py), yielding candidate (line, column) pairs;
+3. candidate pairs are compacted into records and verified exactly: each
+   record advances ITS column's packed DFA over its line's bytes — one
+   gather per record per byte pair, independent of library width.
+
+Capacities (hit lines, candidate pairs) are static; a batch that overflows
+them — degenerate logs where most lines contain literals — falls back via
+``lax.cond`` to the dense DFA scan over all prefiltered columns inside the
+same compiled program, so the tier is sound for every input and never
+needs a host round-trip or retry ladder.
+
+Soundness: every true match of a prefiltered column contains at least one
+of its required literals (literals.py extraction invariant), so the AC
+candidate set is a superset of matching (line, column) pairs; verification
+is the column's exact DFA — identical to the dense path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from log_parser_tpu.ops.match import DfaBank, pack_byte_pairs
+from log_parser_tpu.patterns.regex.ac import AhoCorasick
+
+# prefilter participation cap: total literal bytes in the trie (a
+# pathological library with huge literal sets would blow automaton memory;
+# columns over budget just stay in the dense DFA bank)
+MAX_PREFILTER_LITERALS = 1 << 16
+
+_FOLD = np.arange(256, dtype=np.uint8)
+_FOLD[ord("A") : ord("Z") + 1] += 32  # ASCII lowercase
+
+
+def _compact(flags: jax.Array, K: int):
+    """[N] bool -> (n, idx[K] int32 flat positions in order, valid[K]).
+    Slot K is the trash slot; overflow detected via n > K."""
+    f32 = flags.astype(jnp.int32)
+    rank = jnp.cumsum(f32) - f32
+    n = jnp.sum(f32)
+    out_pos = jnp.where(flags & (rank < K), rank, K)
+    idx = (
+        jnp.zeros((K + 1,), jnp.int32)
+        .at[out_pos]
+        .set(jnp.arange(flags.shape[0], dtype=jnp.int32))[:K]
+    )
+    valid = jnp.arange(K, dtype=jnp.int32) < jnp.minimum(n, K)
+    return n, idx, valid
+
+
+class PrefilterBank:
+    """One AC automaton + one packed verify-DFA bank over the prefiltered
+    columns of a PatternBank.
+
+    ``entries``: (global column id, MatcherColumn) — every column must have
+    a compiled DFA and a non-empty required-literal set.
+    """
+
+    def __init__(self, entries):
+        self.global_cols = np.asarray([g for g, _ in entries], dtype=np.int32)
+        self.n_cols = len(entries)
+        # verify bank: the same packed-DFA layout the dense tier uses; also
+        # serves as the dense fallback scan on capacity overflow
+        self.verify = DfaBank([c.dfa for _, c in entries], stride=2)
+
+        lits: list[bytes] = []
+        groups: list[int] = []
+        for j, (_g, col) in enumerate(entries):
+            for lit in col.literals:
+                lits.append(lit.fold().text)
+                groups.append(j)
+        self.ac = AhoCorasick(lits, groups=groups)
+        self.n_words = self.ac.n_words
+        # scan RAW bytes against folded literals: compose ASCII folding into
+        # the byte-class table so folding costs nothing at runtime
+        self.byte_class = jnp.asarray(self.ac.byte_class[_FOLD])
+        self.goto = jnp.asarray(self.ac.goto)
+        self.out_words = jnp.asarray(self.ac.out_words)
+        self.has_out = jnp.asarray(self.ac.has_out)
+
+    @staticmethod
+    def select(entries, budget: int = MAX_PREFILTER_LITERALS):
+        """Greedy smallest-literal-set-first selection under the trie
+        budget; returns (selected, rejected) entry lists."""
+        order = sorted(
+            range(len(entries)), key=lambda i: len(entries[i][1].literals)
+        )
+        selected, rejected = [], []
+        used = 0
+        for i in order:
+            cost = sum(len(lit.text) for lit in entries[i][1].literals)
+            if used + cost <= budget:
+                used += cost
+                selected.append(entries[i])
+            else:
+                rejected.append(entries[i])
+        # preserve original (column) order for deterministic layouts
+        key = {id(e): i for i, e in enumerate(entries)}
+        selected.sort(key=lambda e: key[id(e)])
+        rejected.sort(key=lambda e: key[id(e)])
+        return selected, rejected
+
+    # --------------------------------------------------- stage 1: any-hit
+
+    def anyhit_stepper(self, B: int, lengths: jax.Array):
+        """Composable pair-stepper for the main fused scan. Carry:
+        (ac_state [B] int32, any_hit [B] bool)."""
+        init = (
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), bool),
+        )
+
+        def one(s, a, b, ok):
+            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
+            nxt = self.goto[s, cls]
+            s = jnp.where(ok, nxt, s)
+            a = a | (ok & jnp.take(self.has_out, s))
+            return s, a
+
+        def step(carry, b1, b2, t):
+            s, a = carry
+            p0 = 2 * t
+            s, a = one(s, a, b1, p0 < lengths)
+            s, a = one(s, a, b2, p0 + 1 < lengths)
+            return (s, a)
+
+        def finish(carry):
+            return carry[1]
+
+        return init, step, finish
+
+    # ------------------------------------------- stage 2: per-column words
+
+    def column_hits(self, lines_tb: jax.Array, rows: jax.Array, lens: jax.Array):
+        """Re-scan the ``rows`` (compacted hit lines) accumulating full
+        per-column hit words. Returns uint32 [K_hit, W]."""
+        Kh = rows.shape[0]
+        bytes2, ts = pack_byte_pairs(lines_tb[:, rows])  # [T2, 2, Kh]
+
+        def step(carry, xs):
+            s, hits = carry
+            pair, t = xs
+            p0 = 2 * t
+
+            def one(s, hits, b, ok):
+                cls = jnp.take(self.byte_class, b.astype(jnp.int32))
+                nxt = self.goto[s, cls]
+                s = jnp.where(ok, nxt, s)
+                hits = hits | jnp.where(
+                    ok[:, None], jnp.take(self.out_words, s, axis=0), jnp.uint32(0)
+                )
+                return s, hits
+
+            s, hits = one(s, hits, pair[0], p0 < lens)
+            s, hits = one(s, hits, pair[1], p0 + 1 < lens)
+            return (s, hits), None
+
+        init = (
+            jnp.zeros((Kh,), jnp.int32),
+            jnp.zeros((Kh, self.n_words), jnp.uint32),
+        )
+        (_, hits), _ = jax.lax.scan(step, init, (bytes2, ts))
+        return hits
+
+    def unpack_candidates(self, hits: jax.Array):
+        """uint32 [K_hit, W] -> bool [K_hit, n_cols] candidate matrix."""
+        cols = jnp.arange(self.n_cols, dtype=jnp.int32)
+        word = hits[:, cols // 32]  # [K_hit, n_cols]
+        return (word >> (cols % 32).astype(jnp.uint32)) & 1 > 0
+
+    # ----------------------------------------------- stage 3: record verify
+
+    def verify_records(
+        self,
+        lines_tb: jax.Array,
+        lengths: jax.Array,
+        rec_line: jax.Array,
+        rec_pcol: jax.Array,
+        rec_valid: jax.Array,
+    ) -> jax.Array:
+        """Advance each record's column DFA over its line; bool [K_rec]."""
+        vb = self.verify
+        Kr = rec_line.shape[0]
+        rec_len = jnp.where(rec_valid, lengths[rec_line], 0)
+        states = vb.start[rec_pcol].astype(jnp.int32)
+        pairs, ts = pack_byte_pairs(lines_tb)
+        smax = vb.smax
+
+        if vb.pair_stride:
+            cpad = vb.cpad
+            pad_cls = jnp.int32(vb.cmax)
+
+            def step(states, xs):
+                pair, t = xs
+                p0 = 2 * t
+                b1 = pair[0][rec_line].astype(jnp.int32)
+                b2 = pair[1][rec_line].astype(jnp.int32)
+                c1 = vb.byte_class[rec_pcol, b1]
+                c2 = vb.byte_class[rec_pcol, b2]
+                c1 = jnp.where(p0 < rec_len, c1, pad_cls)
+                c2 = jnp.where(p0 + 1 < rec_len, c2, pad_cls)
+                idx = ((rec_pcol * smax + states) * cpad + c1) * cpad + c2
+                return jnp.take(vb.flat_trans2, idx), None
+
+        else:
+            cmax = vb.cmax
+
+            def one(states, b, ok):
+                cls = vb.byte_class[rec_pcol, b]
+                idx = (rec_pcol * smax + states) * cmax + cls
+                nxt = jnp.take(vb.flat_trans, idx)
+                return jnp.where(ok, nxt, states)
+
+            def step(states, xs):
+                pair, t = xs
+                p0 = 2 * t
+                states = one(states, pair[0][rec_line].astype(jnp.int32), p0 < rec_len)
+                states = one(
+                    states, pair[1][rec_line].astype(jnp.int32), p0 + 1 < rec_len
+                )
+                return states, None
+
+        states, _ = jax.lax.scan(step, states, (pairs, ts))
+        ok = jnp.take(vb.flat_accept, rec_pcol * smax + states)
+        return ok & rec_valid
+
+    # --------------------------------------------------------- full pipeline
+
+    def contribution(
+        self,
+        lines_tb: jax.Array,
+        lengths: jax.Array,
+        any_hit: jax.Array,
+    ) -> jax.Array:
+        """Stages 2+3 (after the main scan produced ``any_hit``): returns
+        the bool [B, n_cols] cube slice for the prefiltered columns, via
+        the sparse path when capacities hold, else the dense DFA scan."""
+        T, B = lines_tb.shape
+        K_hit = min(B, max(128, B // 8))
+        K_rec = min(K_hit * self.n_cols, 4 * K_hit)
+
+        n_hit, hit_rows, hit_valid = _compact(any_hit, K_hit)
+        lens2 = jnp.where(hit_valid, lengths[hit_rows], 0)
+        hits = self.column_hits(lines_tb, hit_rows, lens2)
+        cand = self.unpack_candidates(hits)  # [K_hit, n_cols]
+
+        n_rec, rec_flat, rec_valid = _compact(cand.reshape(-1), K_rec)
+        rec_row = rec_flat // self.n_cols
+        rec_pcol = rec_flat % self.n_cols
+        rec_line = hit_rows[rec_row]
+
+        def sparse(_):
+            ver = self.verify_records(
+                lines_tb, lengths, rec_line, rec_pcol, rec_valid
+            )
+            safe_line = jnp.where(rec_valid, rec_line, B)
+            contrib = jnp.zeros((B + 1, self.n_cols), bool)
+            return contrib.at[safe_line, rec_pcol].max(ver)[:B]
+
+        def dense(_):
+            init, step, finish = self.verify.pair_stepper(B, lengths)
+            pairs, ts = pack_byte_pairs(lines_tb)
+            states, _ = jax.lax.scan(
+                lambda s, xs: (step(s, xs[0][0], xs[0][1], xs[1]), None),
+                init,
+                (pairs, ts),
+            )
+            return finish(states)[:, : self.n_cols]
+
+        ok = (n_hit <= K_hit) & (n_rec <= K_rec)
+        return jax.lax.cond(ok, sparse, dense, operand=None)

@@ -1,0 +1,23 @@
+"""Distributed execution: line-axis data parallelism over a TPU mesh.
+
+The reference is a single JVM thread (AnalysisService.java:89-113; SURVEY.md
+§2.2 records zero parallelism). The TPU-native design shards the *line axis*
+— the workload's one natural parallel axis — across the mesh with
+``shard_map``, and reconstructs every cross-line dependency with the
+narrowest possible collective (SURVEY.md §5.7-5.8):
+
+- proximity / context windows read ≤ max(window) neighboring lines →
+  ``ppermute`` halo exchange with the two ring neighbors (ICI traffic only);
+- the unbounded backward sequence scan reads any earlier line → the (few)
+  sequence-event columns are ``all_gather``-ed, then chains run locally;
+- the frequency penalty needs a cross-shard exclusive prefix of per-slot
+  match counts → ``all_gather`` of per-shard totals (+ ``psum`` for the
+  batch total recorded into tracker state — the one collective the scoring
+  *semantics* require, SURVEY.md §2.2);
+- the chronological factor needs only the global line index — scalar math.
+"""
+
+from log_parser_tpu.parallel.mesh import make_mesh
+from log_parser_tpu.parallel.sharded import ShardedEngine, ShardedFusedStep
+
+__all__ = ["ShardedEngine", "ShardedFusedStep", "make_mesh"]

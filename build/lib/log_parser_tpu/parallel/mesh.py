@@ -1,0 +1,23 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the line-batch ("data") axis.
+
+    The workload has exactly one natural parallel axis (independent lines);
+    pattern-axis sharding for very large libraries composes later as a
+    second mesh dimension.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[: n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))

@@ -1,0 +1,13 @@
+"""Pattern-library management: YAML loading and matcher compilation.
+
+Replaces the reference's ``PatternService`` (PatternService.java:28-95) and —
+by design, not accident — compiles every regex exactly once at load time
+into immutable automaton banks, matching the documented intent
+("compiled once at startup", docs/SCORING_ALGORITHM.md:186) rather than the
+reference's actual per-request recompilation race
+(AnalysisService.java:55-86; SURVEY.md §5.2).
+"""
+
+from log_parser_tpu.patterns.loader import load_pattern_directory, load_pattern_file
+
+__all__ = ["load_pattern_directory", "load_pattern_file"]

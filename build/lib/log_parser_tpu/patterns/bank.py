@@ -1,0 +1,258 @@
+"""PatternBank — the immutable compiled form of a pattern library.
+
+Where the reference re-compiles every regex on every request into mutable
+singleton objects (AnalysisService.java:55-86 — the latent race of SURVEY.md
+§5.2), this framework compiles the whole library exactly once into an
+immutable bank of automata plus the static index structure the scoring
+kernel needs:
+
+- every distinct regex (primary, secondary, sequence-event, plus the four
+  hardcoded context regexes of ContextAnalysisService.java:27-34) gets one
+  *matcher column*; match kernels produce a ``[lines, columns]`` boolean
+  cube, and all scoring factors are computed from column indexes;
+- per-pattern static arrays (confidence, severity multiplier, context
+  window sizes) are precomputed as numpy arrays ready to close over in the
+  jitted scoring kernel;
+- each matcher column carries its compiled DFA when the automaton path
+  supports the regex, or a host-side compiled ``re`` fallback when it does
+  not (and, when even the golden translation fails, the pattern is skipped
+  with the same per-pattern containment as the golden engine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+
+import numpy as np
+
+from log_parser_tpu.golden.engine import SEVERITY_MULTIPLIERS
+from log_parser_tpu.golden.javacompat import compile_java_regex
+from log_parser_tpu.models.pattern import Pattern, PatternSet
+from log_parser_tpu.patterns.regex import (
+    CompiledDfa,
+    DfaLimitError,
+    RegexUnsupportedError,
+    extract_literals,
+    parse_java_regex,
+)
+from log_parser_tpu.patterns.regex.cache import compile_regex_to_dfa_cached
+from log_parser_tpu.patterns.regex.literals import exact_sequences
+from log_parser_tpu.patterns.regex.literals import Literal
+
+log = logging.getLogger(__name__)
+
+# the four hardcoded context regexes — ContextAnalysisService.java:27-34
+CONTEXT_REGEXES: list[tuple[str, bool]] = [
+    (r"\b(ERROR|FATAL|CRITICAL|SEVERE)\b", True),
+    (r"\b(WARN|WARNING)\b", True),
+    (r"^\s*at\s+[\w\.\$]+\(.*\)\s*$", False),
+    (r"\b\w*Exception\b|\b\w*Error\b", False),
+]
+CTX_ERROR, CTX_WARN, CTX_STACK, CTX_EXCEPTION = range(4)
+
+
+@dataclasses.dataclass
+class MatcherColumn:
+    """One distinct regex to evaluate per line.
+
+    Matcher tier (first that applies): ``exact_seqs`` → bit-parallel
+    Shift-Or (O(1) in bank size per line-byte); ``dfa`` → packed automaton
+    bank; neither → host ``re`` over every line."""
+
+    regex: str
+    case_insensitive: bool
+    dfa: CompiledDfa | None  # None -> host fallback only
+    host: re.Pattern[str]  # golden-compiled reference matcher
+    literals: frozenset[Literal] | None  # None -> unfactorable
+    exact_seqs: tuple | None = None  # fixed byte-class sequences == regex
+
+
+@dataclasses.dataclass
+class SecondaryEntry:
+    pattern_idx: int
+    column: int
+    weight: float
+    window: int  # already min'd with config max_window by the kernel
+
+
+@dataclasses.dataclass
+class SequenceEntry:
+    pattern_idx: int
+    bonus: float
+    event_columns: list[int]  # in sequence order
+
+
+class PatternBank:
+    """Compiled, immutable library: matcher columns + static scoring arrays.
+
+    ``patterns`` holds the kept patterns in discovery order (set-major, then
+    pattern order within the set — AnalysisService.java:91-92), which is the
+    order events must be emitted in.
+    """
+
+    def __init__(self, pattern_sets: list[PatternSet]):
+        self.pattern_sets = pattern_sets
+        self.columns: list[MatcherColumn] = []
+        self._column_by_key: dict[tuple[str, bool], int] = {}
+
+        self.patterns: list[Pattern] = []
+        self.skipped_patterns: list[tuple[str, str]] = []
+        primary_cols: list[int] = []
+        self.secondaries: list[SecondaryEntry] = []
+        self.sequences: list[SequenceEntry] = []
+
+        # context columns first so their indexes are the CTX_* constants
+        for rx, ci in CONTEXT_REGEXES:
+            self._intern_column(rx, ci)
+
+        for ps in pattern_sets:
+            for pattern in ps.patterns or []:
+                mark = len(self.columns)
+                try:
+                    entry = self._compile_pattern(pattern, len(self.patterns))
+                except (ValueError, re.error) as exc:
+                    log.error("Skipping pattern %r: %s", pattern.id, exc)
+                    self.skipped_patterns.append((pattern.id, str(exc)))
+                    # roll back columns interned for the aborted pattern so
+                    # the match kernels never pay for orphan regexes
+                    for col in self.columns[mark:]:
+                        del self._column_by_key[(col.regex, col.case_insensitive)]
+                    del self.columns[mark:]
+                    continue
+                if entry is None:  # primary-less pattern: compiles, never matches
+                    continue
+                pcol, secs, seqs = entry
+                self.patterns.append(pattern)
+                primary_cols.append(pcol)
+                self.secondaries.extend(secs)
+                self.sequences.extend(seqs)
+
+        self.primary_columns = np.asarray(primary_cols, dtype=np.int32)
+        self.n_patterns = len(self.patterns)
+        self.n_columns = len(self.columns)
+
+        # ---- static per-pattern scoring arrays -----------------------------
+        self.confidence = np.asarray(
+            [p.primary_pattern.confidence for p in self.patterns], dtype=np.float64
+        )
+        self.severity_multiplier = np.asarray(
+            [
+                SEVERITY_MULTIPLIERS.get((p.severity or "").upper(), 1.0)
+                for p in self.patterns
+            ],
+            dtype=np.float64,
+        )
+        self.has_context_rules = np.asarray(
+            [p.context_extraction is not None for p in self.patterns], dtype=bool
+        )
+        # negative YAML window values behave as 0 in the golden semantics:
+        # Python slices like lines[max(0, idx-(-5)):idx] are simply empty
+        self.ctx_before = np.asarray(
+            [
+                max(0, p.context_extraction.lines_before) if p.context_extraction else 0
+                for p in self.patterns
+            ],
+            dtype=np.int32,
+        )
+        self.ctx_after = np.asarray(
+            [
+                max(0, p.context_extraction.lines_after) if p.context_extraction else 0
+                for p in self.patterns
+            ],
+            dtype=np.int32,
+        )
+        # empty-trimmed pattern id => frequency tracking applies
+        # (FrequencyTrackingService.java:42,65)
+        self.has_freq_id = np.asarray(
+            [bool((p.id or "").strip()) for p in self.patterns], dtype=bool
+        )
+        # patterns sharing an id share one frequency counter: map each
+        # pattern to a counter slot
+        self.freq_ids: list[str] = []
+        slot_by_id: dict[str, int] = {}
+        slots = []
+        for p in self.patterns:
+            pid = p.id or ""
+            if not pid.strip():
+                slots.append(-1)
+                continue
+            if pid not in slot_by_id:
+                slot_by_id[pid] = len(self.freq_ids)
+                self.freq_ids.append(pid)
+            slots.append(slot_by_id[pid])
+        self.freq_slot = np.asarray(slots, dtype=np.int32)
+        self.n_freq_slots = len(self.freq_ids)
+
+    # ------------------------------------------------------------------ build
+
+    def _intern_column(self, regex: str, case_insensitive: bool) -> int:
+        key = (regex, case_insensitive)
+        col = self._column_by_key.get(key)
+        if col is not None:
+            return col
+        host = compile_java_regex(regex, case_insensitive)  # raises -> skip pattern
+        dfa: CompiledDfa | None = None
+        literals: frozenset[Literal] | None = None
+        exact_seqs = None
+        try:
+            node = parse_java_regex(regex, case_insensitive)
+            exact_seqs = exact_sequences(node)
+            literals = extract_literals(node)
+            # DFA is compiled (cache-amortized) even for Shift-Or-capable
+            # columns: MatcherBanks picks the tier per bank size
+            dfa = compile_regex_to_dfa_cached(regex, case_insensitive)
+        except (RegexUnsupportedError, DfaLimitError) as exc:
+            if exact_seqs is None:
+                log.warning("Host-fallback matcher for %r: %s", regex, exc)
+        col = len(self.columns)
+        self.columns.append(
+            MatcherColumn(
+                regex=regex,
+                case_insensitive=case_insensitive,
+                dfa=dfa,
+                host=host,
+                literals=literals,
+                exact_seqs=exact_seqs,
+            )
+        )
+        self._column_by_key[key] = col
+        return col
+
+    def _compile_pattern(
+        self, pattern: Pattern, pattern_idx: int
+    ) -> tuple[int, list[SecondaryEntry], list[SequenceEntry]] | None:
+        """Returns None for a primary-less pattern (it can never match, but
+        its secondary/sequence regexes are still validated so bad ones land
+        in ``skipped_patterns`` exactly like the golden engine's)."""
+        if pattern.primary_pattern is None:
+            for sec in pattern.secondary_patterns or []:
+                compile_java_regex(sec.regex)
+            for seq in pattern.sequence_patterns or []:
+                for ev in seq.events or []:
+                    compile_java_regex(ev.regex)
+            return None
+        pcol = self._intern_column(pattern.primary_pattern.regex, False)
+        secs = [
+            SecondaryEntry(
+                pattern_idx=pattern_idx,
+                column=self._intern_column(sec.regex, False),
+                weight=sec.weight,
+                window=sec.proximity_window,
+            )
+            for sec in pattern.secondary_patterns or []
+        ]
+        seqs = []
+        for seq in pattern.sequence_patterns or []:
+            events = seq.events or []
+            seqs.append(
+                SequenceEntry(
+                    pattern_idx=pattern_idx,
+                    bonus=seq.bonus_multiplier,
+                    event_columns=[
+                        self._intern_column(ev.regex, False) for ev in events
+                    ],
+                )
+            )
+        return pcol, secs, seqs

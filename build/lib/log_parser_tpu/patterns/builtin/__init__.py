@@ -1,0 +1,11 @@
+"""Built-in starter pattern libraries (YAML, reference schema)."""
+
+import os
+
+from log_parser_tpu.patterns.loader import load_pattern_directory
+
+BUILTIN_DIR = os.path.dirname(__file__)
+
+
+def load_builtin_pattern_sets():
+    return load_pattern_directory(BUILTIN_DIR)

@@ -1,0 +1,67 @@
+"""YAML pattern-set loader.
+
+Reproduces the reference's loading semantics (PatternService.java:45-85):
+
+- recursively walk the pattern directory (Files.walk, :57);
+- consider only regular files ending in ``.yml`` or ``.yaml`` (:58-63);
+- parse each into a :class:`PatternSet`; files that fail to parse are logged
+  and skipped, never fatal (:82-84);
+- a missing/non-directory path logs an error and yields zero sets (:50-55).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable
+
+import yaml
+
+from log_parser_tpu.models.pattern import PatternSet
+
+log = logging.getLogger(__name__)
+
+
+def load_pattern_file(path: str) -> PatternSet:
+    """Parse one YAML file into a :class:`PatternSet`.
+
+    Raises on malformed YAML — the directory walker catches and skips,
+    mirroring PatternService.java:77-85.
+    """
+    with open(path, "r", encoding="utf-8") as fh:
+        data = yaml.safe_load(fh)
+    if not isinstance(data, dict):
+        raise ValueError(f"pattern file {path!r} is not a YAML mapping")
+    return PatternSet.from_dict(data)
+
+
+def _walk_yaml_files(directory: str) -> Iterable[str]:
+    for root, _dirs, files in sorted(
+        (r, d, f) for r, d, f in os.walk(directory)
+    ):
+        for name in sorted(files):
+            if name.endswith((".yml", ".yaml")):
+                path = os.path.join(root, name)
+                if os.path.isfile(path):
+                    yield path
+
+
+def load_pattern_directory(directory: str) -> list[PatternSet]:
+    """Load every ``*.yml``/``*.yaml`` under ``directory``, skipping bad files.
+
+    Walk order is sorted for determinism. (The reference's ``Files.walk``
+    order is filesystem-dependent; event discovery order depends on pattern-set
+    order, AnalysisService.java:91, so we pin a deterministic order.)
+    """
+    if not os.path.isdir(directory):
+        log.error("Pattern directory does not exist or is not a directory: %s", directory)
+        return []
+
+    sets: list[PatternSet] = []
+    for path in _walk_yaml_files(directory):
+        try:
+            sets.append(load_pattern_file(path))
+        except Exception:  # noqa: BLE001 — log-and-skip per PatternService.java:82-84
+            log.exception("Failed to parse pattern file: %s", path)
+    log.info("Successfully loaded %d pattern sets.", len(sets))
+    return sets

@@ -1,0 +1,38 @@
+"""Regex → automaton compilation for TPU execution.
+
+The reference matches Java regexes line-by-line with ``Matcher.find()``
+(AnalysisService.java:93-95) — substring semantics. To run that on TPU we
+compile each regex once at load time into a byte-level DFA
+(parser → Thompson NFA with assertion edges → subset construction with
+byte-class compression), pack pattern banks into int32 arrays XLA can gather
+from, and extract *required literal factors* so a single combined
+Aho-Corasick automaton can prefilter lines before exact verification —
+the Hyperscan architecture, re-built TPU-first.
+
+Correctness contract: the DFA is exact for ASCII lines (the automaton runs
+over UTF-8 bytes; Java regexes run over UTF-16 chars, which agree on ASCII).
+Lines containing non-ASCII bytes are flagged by the encoder and routed to
+host verification, so end-to-end results stay exact.
+"""
+
+from log_parser_tpu.patterns.regex.parser import (
+    RegexUnsupportedError,
+    parse_java_regex,
+)
+from log_parser_tpu.patterns.regex.dfa import (
+    DfaLimitError,
+    CompiledDfa,
+    compile_regex_to_dfa,
+)
+from log_parser_tpu.patterns.regex.literals import extract_literals
+from log_parser_tpu.patterns.regex.ac import AhoCorasick
+
+__all__ = [
+    "AhoCorasick",
+    "CompiledDfa",
+    "DfaLimitError",
+    "RegexUnsupportedError",
+    "compile_regex_to_dfa",
+    "extract_literals",
+    "parse_java_regex",
+]

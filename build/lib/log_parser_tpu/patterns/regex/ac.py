@@ -1,0 +1,152 @@
+"""Combined Aho-Corasick automaton over extracted literals.
+
+One goto-complete AC automaton (dense next-state table, fail links folded
+in) scans every log line once — a single gather per byte on TPU, regardless
+of how many patterns the library holds. Outputs are bitmasks over literal
+ids packed into uint32 words; each node's mask is pre-OR'd along its fail
+chain so the runtime never walks links.
+
+An automaton is *pure*: all its literals share one case mode. Case-sensitive
+literals scan raw bytes; case-insensitive ones are stored folded and scan a
+case-folded copy of the line (mixing modes in one trie conflates edges and
+can drop matches — the matcher bank builds one automaton per mode instead).
+
+Byte-class compression keeps the table narrow: only bytes that occur in
+some literal get a class; everything else shares one "other" column.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+class AhoCorasick:
+    """Multi-literal matcher over byte strings.
+
+    ``literals``: the byte strings, id = list index. Matching is exact on
+    bytes — for case-insensitive behavior, fold the literals before
+    construction and fold the input before scanning.
+
+    ``groups``: optional group id per literal (e.g. the owning matcher
+    column); output bitmasks are then over groups, so several literals of
+    one column OR into a single bit and duplicated strings across columns
+    simply share trie nodes. Default: each literal is its own group.
+    """
+
+    def __init__(self, literals: list[bytes], groups: list[int] | None = None):
+        self.literals = literals
+        n = len(literals)
+        self.n_literals = n
+        if groups is None:
+            groups = list(range(n))
+        assert len(groups) == n
+        self.groups = groups
+        self.n_groups = (max(groups) + 1) if groups else 0
+        self.n_words = max(1, (self.n_groups + 31) // 32)
+
+        # --- trie -----------------------------------------------------------
+        children: list[dict[int, int]] = [{}]
+        out: list[set[int]] = [set()]
+        for lid, text in enumerate(literals):
+            node = 0
+            for b in text:
+                nxt = children[node].get(b)
+                if nxt is None:
+                    children.append({})
+                    out.append(set())
+                    nxt = len(children) - 1
+                    children[node][b] = nxt
+                node = nxt
+            out[node].add(lid)
+        n_nodes = len(children)
+
+        # --- byte classes ---------------------------------------------------
+        used = sorted({b for ch in children for b in ch})
+        byte_class = np.zeros(256, dtype=np.int32)  # 0 = "other"
+        for cls, b in enumerate(used, start=1):
+            byte_class[b] = cls
+        n_classes = len(used) + 1
+        class_byte = [0] + used
+
+        # --- goto-complete automaton via BFS fail links ---------------------
+        goto = np.zeros((n_nodes, n_classes), dtype=np.int32)
+        fail = np.zeros(n_nodes, dtype=np.int32)
+        queue: deque[int] = deque()
+        for cls in range(1, n_classes):
+            child = children[0].get(class_byte[cls])
+            if child is not None:
+                goto[0, cls] = child
+                queue.append(child)
+        while queue:
+            node = queue.popleft()
+            out[node] |= out[fail[node]]
+            for cls in range(1, n_classes):
+                child = children[node].get(class_byte[cls])
+                if child is not None:
+                    fail[child] = goto[fail[node], cls]
+                    goto[node, cls] = child
+                    queue.append(child)
+                else:
+                    goto[node, cls] = goto[fail[node], cls]
+
+        # --- packed outputs (bits are GROUP ids) ----------------------------
+        out_words = np.zeros((n_nodes, self.n_words), dtype=np.uint32)
+        for node in range(n_nodes):
+            for lid in out[node]:
+                gid = groups[lid]
+                out_words[node, gid // 32] |= np.uint32(1 << (gid % 32))
+
+        self.n_nodes = n_nodes
+        self.n_classes = n_classes
+        self.goto = goto
+        self.byte_class = byte_class
+        self.out_words = out_words
+        self.has_out = out_words.any(axis=1)
+
+    # ---------------------------------------------------------------- scans
+
+    def scan(self, data: bytes) -> set[int]:
+        """Host reference: literal ids hit anywhere in ``data``."""
+        node = 0
+        hits: set[int] = set()
+        for b in data:
+            node = int(self.goto[node, self.byte_class[b]])
+            if self.has_out[node]:
+                words = self.out_words[node]
+                for w in range(self.n_words):
+                    bits = int(words[w])
+                    while bits:
+                        low = bits & -bits
+                        hits.add(w * 32 + low.bit_length() - 1)
+                        bits ^= low
+        return hits
+
+    def scan_lines(self, lines_u8: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Vectorized numpy scan of a padded [B, T] uint8 line matrix.
+
+        Returns hit masks uint32 [B, n_words]. Positions ≥ length are
+        masked out, so padding byte values never produce hits.
+        """
+        B, T = lines_u8.shape
+        states = np.zeros(B, dtype=np.int32)
+        hits = np.zeros((B, self.n_words), dtype=np.uint32)
+        for t in range(T):
+            cls = self.byte_class[lines_u8[:, t]]
+            nxt = self.goto[states, cls]
+            active = t < lengths
+            states = np.where(active, nxt, states)
+            hits |= np.where(active[:, None], self.out_words[states], np.uint32(0))
+        return hits
+
+
+def fold_bytes(data: bytes) -> bytes:
+    """ASCII case folding (matches Java's CASE_INSENSITIVE default)."""
+    return data.lower()
+
+
+def fold_lines_u8(lines_u8: np.ndarray) -> np.ndarray:
+    """Vectorized ASCII lowercase of a uint8 matrix."""
+    is_upper = (lines_u8 >= ord("A")) & (lines_u8 <= ord("Z"))
+    return np.where(is_upper, lines_u8 + 32, lines_u8).astype(np.uint8)

@@ -1,0 +1,101 @@
+"""On-disk DFA compile cache keyed by regex content hash.
+
+SURVEY.md §5.4: the reference has no persistence at all (patterns are
+re-read at boot, PatternService.java:45-69). For the high-cardinality
+10k-regex configuration, NFA→DFA subset construction + minimization
+dominates engine startup, so compiled automata are snapshotted to disk
+keyed by ``sha256(compiler_version, regex, flags)`` — per regex, not per
+library, so libraries that share patterns share cache entries. Corrupt or
+stale entries are ignored and recompiled (the same log-and-skip containment
+the loader applies to bad YAML files).
+
+Cache location: ``$LOG_PARSER_TPU_CACHE`` (used exactly as given) or the
+default ``~/.cache/log_parser_tpu/dfa``; set ``LOG_PARSER_TPU_CACHE=0`` to
+disable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pathlib
+import tempfile
+
+import numpy as np
+
+from log_parser_tpu.patterns.regex.dfa import CompiledDfa, compile_regex_to_dfa
+
+log = logging.getLogger(__name__)
+
+# bump to invalidate every entry when the compiler's output changes shape
+COMPILER_VERSION = 1
+
+
+def _cache_dir() -> pathlib.Path | None:
+    env = os.environ.get("LOG_PARSER_TPU_CACHE")
+    if env == "0":
+        return None
+    if env:
+        return pathlib.Path(env)
+    return pathlib.Path.home() / ".cache" / "log_parser_tpu" / "dfa"
+
+
+def _key(regex: str, case_insensitive: bool, max_states: int) -> str:
+    h = hashlib.sha256()
+    h.update(f"v{COMPILER_VERSION}|ci={int(case_insensitive)}|ms={max_states}|".encode())
+    h.update(regex.encode())
+    return h.hexdigest()
+
+
+def compile_regex_to_dfa_cached(
+    regex: str, case_insensitive: bool = False, max_states: int = 4096
+) -> CompiledDfa:
+    """``compile_regex_to_dfa`` with a transparent on-disk snapshot."""
+    cache = _cache_dir()
+    if cache is None:
+        return compile_regex_to_dfa(regex, case_insensitive, max_states)
+    path = cache / f"{_key(regex, case_insensitive, max_states)}.npz"
+
+    if path.exists():
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return CompiledDfa(
+                    regex=regex,
+                    trans=z["trans"],
+                    byte_class=z["byte_class"],
+                    accept_end=z["accept_end"],
+                    start=int(z["start"]),
+                    n_states=int(z["n_states"]),
+                    n_classes=int(z["n_classes"]),
+                )
+        except Exception as exc:  # corrupt entry: recompile, rewrite
+            log.warning("Ignoring corrupt DFA cache entry %s: %s", path.name, exc)
+
+    dfa = compile_regex_to_dfa(regex, case_insensitive, max_states)
+    tmp = None
+    try:
+        cache.mkdir(parents=True, exist_ok=True)
+        # atomic publish so concurrent engines never read a torn file
+        fd, tmp = tempfile.mkstemp(dir=cache, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                trans=dfa.trans,
+                byte_class=dfa.byte_class,
+                accept_end=dfa.accept_end,
+                start=np.int64(dfa.start),
+                n_states=np.int64(dfa.n_states),
+                n_classes=np.int64(dfa.n_classes),
+            )
+        os.replace(tmp, path)
+        tmp = None
+    except OSError as exc:
+        log.warning("DFA cache write failed for %s: %s", path.name, exc)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return dfa

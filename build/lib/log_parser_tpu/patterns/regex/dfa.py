@@ -1,0 +1,209 @@
+"""Subset construction: NFA → byte-class-compressed DFA.
+
+The DFA executes ``Matcher.find()`` boolean semantics in a single forward
+pass over a line's bytes: one table lookup per byte, acceptance read from a
+per-state bit at end-of-line. Zero-width assertions (``^`` ``$`` ``\\b``
+``\\B``) are resolved during construction by tracking the class of the
+previously consumed byte in the DFA state — no lookaround at runtime, which
+is what makes the automaton executable as a ``lax.scan`` of gathers on TPU.
+
+Matches become *sticky*: as soon as any substring match completes the DFA
+enters an absorbing MATCHED state, so "final state is accepting" ⇔ "the
+line contains a match" — the exact boolean the reference's hot loop needs
+(AnalysisService.java:95).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from log_parser_tpu.patterns.regex.nfa import Nfa, build_nfa
+from log_parser_tpu.patterns.regex.parser import (
+    WORD_BYTES,
+    Node,
+    parse_java_regex,
+)
+
+# left-context encoding inside a DFA state
+_BEGIN, _NONWORD, _WORD = 0, 1, 2
+
+
+class DfaLimitError(ValueError):
+    """State count exceeded the cap — caller must fall back to host regex."""
+
+
+@dataclasses.dataclass
+class CompiledDfa:
+    """A packed DFA: ``trans[state, byte_class[byte]] -> state``;
+    ``accept_end[final_state]`` decides the match."""
+
+    regex: str
+    trans: np.ndarray  # int32 [n_states, n_classes]
+    byte_class: np.ndarray  # int32 [256]
+    accept_end: np.ndarray  # bool [n_states]
+    start: int
+    n_states: int
+    n_classes: int
+
+    def matches(self, data: bytes) -> bool:
+        """Reference executor (used by tests and the host fallback)."""
+        state = self.start
+        trans = self.trans
+        classes = self.byte_class
+        for b in data:
+            state = trans[state, classes[b]]
+        return bool(self.accept_end[state])
+
+
+def _closure(
+    nfa: Nfa, states: frozenset[int], left: int, right_word: bool | None
+) -> frozenset[int]:
+    """Epsilon closure under assertion conditions.
+
+    ``left``: class of the previously consumed byte (_BEGIN before any).
+    ``right_word``: word-ness of the next byte, or None for end-of-input.
+    """
+    left_word = left == _WORD
+    at_start = left == _BEGIN
+    at_end = right_word is None
+    rw = bool(right_word)
+
+    out = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for cond, dst in nfa.eps[s]:
+            if dst in out:
+                continue
+            if cond is None:
+                ok = True
+            elif cond == "^":
+                ok = at_start
+            elif cond == "$":
+                ok = at_end
+            elif cond == "b":
+                ok = left_word != (False if at_end else rw)
+            elif cond == "B":
+                ok = left_word == (False if at_end else rw)
+            else:  # pragma: no cover
+                raise AssertionError(f"unknown assertion {cond}")
+            if ok:
+                out.add(dst)
+                stack.append(dst)
+    return frozenset(out)
+
+
+def _byte_classes(nfa: Nfa) -> tuple[np.ndarray, list[int]]:
+    """Partition 0..255 into equivalence classes that refine every byteset
+    in the NFA plus word-char membership (assertions depend on it).
+    Returns (byte→class map, one representative byte per class)."""
+    bytesets = {bs for row in nfa.trans for (bs, _) in row}
+    signatures: dict[tuple, int] = {}
+    byte_class = np.zeros(256, dtype=np.int32)
+    reps: list[int] = []
+    for b in range(256):
+        sig = tuple(b in bs for bs in bytesets) + (b in WORD_BYTES,)
+        cls = signatures.get(sig)
+        if cls is None:
+            cls = len(signatures)
+            signatures[sig] = cls
+            reps.append(b)
+        byte_class[b] = cls
+    return byte_class, reps
+
+
+def compile_nfa_to_dfa(nfa: Nfa, regex: str = "", max_states: int = 4096) -> CompiledDfa:
+    byte_class, reps = _byte_classes(nfa)
+    n_classes = len(reps)
+    rep_is_word = [b in WORD_BYTES for b in reps]
+
+    # state 0 = MATCHED sink (absorbing, accepting)
+    MATCHED = 0
+    states: dict[tuple[frozenset[int], int], int] = {}
+    trans_rows: list[list[int]] = [[MATCHED] * n_classes]
+    accept_end: list[bool] = [True]
+    core_of: list[tuple[frozenset[int], int] | None] = [None]
+
+    def intern(core: frozenset[int], left: int) -> int:
+        key = (core, left)
+        sid = states.get(key)
+        if sid is None:
+            sid = len(trans_rows)
+            if sid > max_states:
+                raise DfaLimitError(
+                    f"DFA for {regex!r} exceeded {max_states} states"
+                )
+            states[key] = sid
+            trans_rows.append([-1] * n_classes)
+            accept_end.append(False)
+            core_of.append(key)
+        return sid
+
+    start = intern(frozenset({nfa.start}), _BEGIN)
+    # intern() assigns ids sequentially, so a simple id-order sweep processes
+    # every state exactly once, including ones interned mid-sweep.
+    sid = start
+    while sid < len(trans_rows):
+        core, left = core_of[sid]  # type: ignore[misc]
+        # end-of-input acceptance
+        accept_end[sid] = nfa.final in _closure(nfa, core, left, None)
+        for cls in range(n_classes):
+            rep = reps[cls]
+            rw = rep_is_word[cls]
+            closed = _closure(nfa, core, left, rw)
+            if nfa.final in closed:
+                # a match completed just before this byte — sticky
+                trans_rows[sid][cls] = MATCHED
+            else:
+                moved = frozenset(
+                    dst for s in closed for (bs, dst) in nfa.trans[s] if rep in bs
+                )
+                trans_rows[sid][cls] = intern(moved, _WORD if rw else _NONWORD)
+        sid += 1
+
+    return CompiledDfa(
+        regex=regex,
+        trans=np.asarray(trans_rows, dtype=np.int32),
+        byte_class=byte_class,
+        accept_end=np.asarray(accept_end, dtype=bool),
+        start=start,
+        n_states=len(trans_rows),
+        n_classes=n_classes,
+    )
+
+
+def compile_regex_to_dfa(
+    regex: str,
+    case_insensitive: bool = False,
+    max_states: int = 4096,
+) -> CompiledDfa:
+    """Java regex → packed DFA with ``find()`` substring semantics.
+
+    Uses the native (C++) subset construction when available — it also
+    minimizes, shrinking the packed device tables — with the Python builder
+    as fallback. Raises :class:`RegexUnsupportedError` (dialect) or
+    :class:`DfaLimitError` (state blowup); both mean "host fallback".
+    """
+    node: Node = parse_java_regex(regex, case_insensitive)
+    nfa = build_nfa(node, unanchored_prefix=True)
+
+    from log_parser_tpu.native.dfabuild import DfaLimitExceeded, build_dfa_native
+
+    try:
+        built = build_dfa_native(nfa, max_states=max_states)
+    except DfaLimitExceeded:
+        raise DfaLimitError(f"DFA for {regex!r} exceeded {max_states} states")
+    if built is not None:
+        trans, byte_class, accept, start = built
+        return CompiledDfa(
+            regex=regex,
+            trans=trans,
+            byte_class=byte_class,
+            accept_end=accept,
+            start=start,
+            n_states=trans.shape[0],
+            n_classes=trans.shape[1],
+        )
+    return compile_nfa_to_dfa(nfa, regex=regex, max_states=max_states)

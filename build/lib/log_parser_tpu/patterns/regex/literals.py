@@ -1,0 +1,235 @@
+"""Required-literal factor extraction.
+
+For the AC-prefilter match path (Hyperscan architecture: prefilter +
+verify), each regex needs a *required literal set*: a set of literals such
+that **every** line matched by the regex contains at least one of them as a
+substring. A combined Aho-Corasick pass then cheaply finds candidate
+(line, pattern) pairs on device; only candidates are verified exactly.
+
+Soundness rules (no match may escape the prefilter):
+
+- a literal may be *case-folded* (matched insensitively) — that only widens
+  the prefilter;
+- a literal may be *truncated* — any substring of a required literal is
+  itself required;
+- alternation requires factors from **all** branches (union);
+- a ``Rep`` with ``lo == 0`` contributes nothing (it can match empty);
+- when in doubt, return ``None`` → the pattern is unfactorable and falls
+  back to the exact DFA / host path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from log_parser_tpu.patterns.regex.parser import (
+    Alt,
+    Assertion,
+    Cat,
+    Empty,
+    Lit,
+    Node,
+    Rep,
+)
+
+MAX_LITERALS = 64  # per pattern: larger sets filter poorly anyway
+MAX_LITERAL_LEN = 24  # truncation keeps the required property
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal:
+    """A concrete byte string; ``ci`` means match case-insensitively
+    (stored case-folded to lowercase)."""
+
+    text: bytes
+    ci: bool = False
+
+    def fold(self) -> "Literal":
+        return Literal(self.text.lower(), True)
+
+
+def _case_pair(bs: frozenset[int]) -> int | None:
+    """byteset == {lower, upper} of one ASCII letter → the lowercase byte."""
+    if len(bs) == 2:
+        a, b = sorted(bs)
+        if chr(b).isascii() and chr(b).islower() and ord(chr(b).upper()) == a:
+            return b
+    return None
+
+
+def _single(bs: frozenset[int]) -> int | None:
+    if len(bs) == 1:
+        return next(iter(bs))
+    return None
+
+
+def _score(lits: frozenset[Literal]) -> tuple[int, int]:
+    """Bigger is better: (shortest literal length, -set size)."""
+    return (min(len(l.text) for l in lits), -len(lits))
+
+
+def _truncate(lit: Literal) -> Literal:
+    if len(lit.text) <= MAX_LITERAL_LEN:
+        return lit
+    return Literal(lit.text[:MAX_LITERAL_LEN], lit.ci)
+
+
+def extract_literals(node: Node) -> frozenset[Literal] | None:
+    """Best required-literal set for ``node``, or None if unfactorable."""
+    result = _extract(node)
+    if result is None:
+        return None
+    return frozenset(_truncate(l) for l in result)
+
+
+def _extract(node: Node) -> frozenset[Literal] | None:
+    if isinstance(node, (Empty, Assertion)):
+        return None
+    if isinstance(node, Lit):
+        b = _single(node.byteset)
+        if b is not None:
+            return frozenset({Literal(bytes([b]))})
+        folded = _case_pair(node.byteset)
+        if folded is not None:
+            return frozenset({Literal(bytes([folded]), ci=True)})
+        return None  # wide class: useless single-byte factor
+    if isinstance(node, Rep):
+        if node.lo >= 1:
+            return _extract(node.child)
+        return None
+    if isinstance(node, Alt):
+        union: set[Literal] = set()
+        for option in node.options:
+            sub = _extract(option)
+            if sub is None:
+                return None
+            union.update(sub)
+            if len(union) > MAX_LITERALS:
+                return None
+        return frozenset(union)
+    if isinstance(node, Cat):
+        return _extract_cat(node)
+    raise TypeError(f"unknown AST node {node!r}")
+
+
+def _extract_cat(node: Cat) -> frozenset[Literal] | None:
+    """Concatenation: merge runs of fixed single-byte (or case-pair) parts
+    into long literals; otherwise fall back to the best single child factor."""
+    candidates: list[frozenset[Literal]] = []
+
+    run: list[tuple[int, bool]] = []  # (lowercased byte, ci)
+
+    def flush_run() -> None:
+        if run:
+            text = bytes(b for b, _ in run)
+            ci = any(ci for _, ci in run)
+            candidates.append(
+                frozenset({Literal(text.lower(), True) if ci else Literal(text)})
+            )
+            run.clear()
+
+    for part in node.parts:
+        if isinstance(part, Assertion):
+            continue  # zero-width: does not interrupt adjacency of bytes
+        piece = part
+        # a{n,m} with n>=1 contributes at least one child occurrence
+        if isinstance(piece, Rep) and piece.lo >= 1 and isinstance(piece.child, Lit):
+            piece = piece.child
+            appended_rep = True
+        else:
+            appended_rep = False
+        if isinstance(piece, Lit):
+            b = _single(piece.byteset)
+            if b is not None:
+                run.append((b, False))
+                if appended_rep:
+                    flush_run()  # repetition count unknown beyond 1 occurrence
+                continue
+            folded = _case_pair(piece.byteset)
+            if folded is not None:
+                run.append((folded, True))
+                if appended_rep:
+                    flush_run()
+                continue
+        # non-literal part: close the run, consider the child's own factor
+        flush_run()
+        sub = _extract(part)
+        if sub is not None:
+            candidates.append(sub)
+    flush_run()
+
+    if not candidates:
+        return None
+    return max(candidates, key=_score)
+
+
+# ---- exact fixed-length sequences (the Shift-Or fast path) ----------------
+
+MAX_EXACT_SEQS = 16  # alternative sequences per regex
+MAX_EXACT_LEN = 32  # one 32-bit Shift-Or word per sequence
+
+
+def exact_sequences(node: Node) -> tuple[tuple[frozenset[int], ...], ...] | None:
+    """When the regex is equivalent to "line contains a substring matching
+    one of these fixed-length byte-class sequences", return the sequences;
+    else None. Unlike :func:`extract_literals` (a *necessary* condition for
+    the prefilter), this is an exact characterization: bit-parallel
+    Shift-Or over these sequences IS the regex's find() answer, no DFA or
+    verification needed.
+
+    Handled: byte classes, concatenation, alternation, and counted
+    repetition with a fixed count. Rejected: assertions (``^`` ``$``
+    ``\\b``), variable repetition, empty-matchable parts, and anything
+    exceeding the sequence-count/length caps.
+    """
+    seqs = _exact(node)
+    if seqs is None or not seqs:
+        return None
+    if len(seqs) > MAX_EXACT_SEQS:
+        return None
+    if any(not 1 <= len(s) <= MAX_EXACT_LEN for s in seqs):
+        return None
+    return tuple(seqs)
+
+
+def _exact(node: Node) -> list[tuple[frozenset[int], ...]] | None:
+    if isinstance(node, Lit):
+        return [(node.byteset,)]
+    if isinstance(node, Alt):
+        out: list[tuple[frozenset[int], ...]] = []
+        for option in node.options:
+            sub = _exact(option)
+            if sub is None:
+                return None
+            out.extend(sub)
+            if len(out) > MAX_EXACT_SEQS:
+                return None
+        return out
+    if isinstance(node, Cat):
+        acc: list[tuple[frozenset[int], ...]] = [()]
+        for part in node.parts:
+            sub = _exact(part)
+            if sub is None:
+                return None
+            acc = [a + s for a in acc for s in sub]
+            if len(acc) > MAX_EXACT_SEQS or any(
+                len(a) > MAX_EXACT_LEN for a in acc
+            ):
+                return None
+        return acc
+    if isinstance(node, Rep):
+        if node.hi is None or node.lo != node.hi or node.lo < 1:
+            return None  # variable length breaks fixed-position bit packing
+        sub = _exact(node.child)
+        if sub is None:
+            return None
+        acc = [()]
+        for _ in range(node.lo):
+            acc = [a + s for a in acc for s in sub]
+            if len(acc) > MAX_EXACT_SEQS or any(
+                len(a) > MAX_EXACT_LEN for a in acc
+            ):
+                return None
+        return acc
+    # Assertion, Empty: position-dependent / empty-matchable -> not exact
+    return None

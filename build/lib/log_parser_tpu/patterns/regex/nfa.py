@@ -1,0 +1,143 @@
+"""Thompson NFA construction with zero-width assertion edges.
+
+Epsilon edges optionally carry an assertion condition (``^`` ``$`` ``b``
+``B``) that the subset construction resolves against the surrounding
+character context — the standard technique for compiling word boundaries
+into a DFA without lookaround.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from log_parser_tpu.patterns.regex.parser import (
+    Alt,
+    Assertion,
+    Cat,
+    Empty,
+    Lit,
+    Node,
+    Rep,
+)
+
+# An NFA fragment is (start, end); the builder owns the global state store.
+# eps[s] -> list of (cond, dst); cond None = unconditional.
+# trans[s] -> list of (byteset, dst).
+
+
+@dataclasses.dataclass
+class Nfa:
+    n_states: int
+    start: int
+    final: int
+    eps: list[list[tuple[str | None, int]]]
+    trans: list[list[tuple[frozenset[int], int]]]
+
+
+class _Builder:
+    # Repetition upper bound guard: {1,1000} would explode state count.
+    MAX_COUNTED = 64
+
+    def __init__(self) -> None:
+        self.eps: list[list[tuple[str | None, int]]] = []
+        self.trans: list[list[tuple[frozenset[int], int]]] = []
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+    def add_eps(self, src: int, dst: int, cond: str | None = None) -> None:
+        self.eps[src].append((cond, dst))
+
+    def add_trans(self, src: int, byteset: frozenset[int], dst: int) -> None:
+        self.trans[src].append((byteset, dst))
+
+    def build(self, node: Node) -> tuple[int, int]:
+        if isinstance(node, Empty):
+            s = self.new_state()
+            e = self.new_state()
+            self.add_eps(s, e)
+            return s, e
+        if isinstance(node, Lit):
+            s = self.new_state()
+            e = self.new_state()
+            self.add_trans(s, node.byteset, e)
+            return s, e
+        if isinstance(node, Assertion):
+            s = self.new_state()
+            e = self.new_state()
+            self.add_eps(s, e, node.kind)
+            return s, e
+        if isinstance(node, Cat):
+            first_s, prev_e = self.build(node.parts[0])
+            for part in node.parts[1:]:
+                s, e = self.build(part)
+                self.add_eps(prev_e, s)
+                prev_e = e
+            return first_s, prev_e
+        if isinstance(node, Alt):
+            s = self.new_state()
+            e = self.new_state()
+            for option in node.options:
+                os, oe = self.build(option)
+                self.add_eps(s, os)
+                self.add_eps(oe, e)
+            return s, e
+        if isinstance(node, Rep):
+            return self._build_rep(node)
+        raise TypeError(f"unknown AST node {node!r}")
+
+    def _build_rep(self, node: Rep) -> tuple[int, int]:
+        from log_parser_tpu.patterns.regex.parser import RegexUnsupportedError
+
+        lo, hi = node.lo, node.hi
+        if hi is not None and hi > self.MAX_COUNTED:
+            raise RegexUnsupportedError(f"counted repetition max {hi} too large")
+        if lo > self.MAX_COUNTED:
+            raise RegexUnsupportedError(f"counted repetition min {lo} too large")
+
+        s = self.new_state()
+        prev = s
+        # lo mandatory copies
+        for _ in range(lo):
+            cs, ce = self.build(node.child)
+            self.add_eps(prev, cs)
+            prev = ce
+        e = self.new_state()
+        if hi is None:
+            # Kleene tail: loop on one more copy
+            cs, ce = self.build(node.child)
+            self.add_eps(prev, cs)
+            self.add_eps(ce, cs)
+            self.add_eps(ce, e)
+            self.add_eps(prev, e)
+        else:
+            self.add_eps(prev, e)
+            for _ in range(hi - lo):
+                cs, ce = self.build(node.child)
+                self.add_eps(prev, cs)
+                self.add_eps(ce, e)
+                prev = ce
+        return s, e
+
+
+def build_nfa(node: Node, unanchored_prefix: bool = True) -> Nfa:
+    """Build the NFA for ``find()`` (substring) semantics: an any-byte
+    self-loop before the pattern lets a match start at every position
+    (AnalysisService.java:95 uses ``Matcher.find``)."""
+    from log_parser_tpu.patterns.regex.parser import ALL_BYTES
+
+    b = _Builder()
+    start = b.new_state()
+    ps, pe = b.build(node)
+    if unanchored_prefix:
+        b.add_trans(start, ALL_BYTES, start)
+    b.add_eps(start, ps)
+    return Nfa(
+        n_states=len(b.eps),
+        start=start,
+        final=pe,
+        eps=b.eps,
+        trans=b.trans,
+    )

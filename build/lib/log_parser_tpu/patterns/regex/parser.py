@@ -1,0 +1,477 @@
+"""Java-dialect regex parser → byte-level AST.
+
+Parses the subset of ``java.util.regex`` syntax that pattern libraries
+actually use (the dialect floor is set by the reference's own hardcoded
+regexes, ContextAnalysisService.java:27-34: alternation, groups, ``^`` ``$``
+``\\b`` anchors, ``\\w``-style classes, ``[...]`` classes, ``*``/``+``
+quantifiers, case-insensitive matching) into an AST over *bytes* so the
+downstream NFA/DFA run on uint8 log lines.
+
+Non-ASCII characters in a pattern are expanded to their UTF-8 byte
+sequences; ``.`` and negated classes include all non-ASCII bytes, which
+makes the automaton a faithful matcher on ASCII lines and a *superset*
+matcher on non-ASCII lines (a multi-byte char can satisfy two ``.``\\ s).
+The engine routes non-ASCII lines to host verification, so this never
+changes end-to-end results.
+
+Constructs with no finite-automaton equivalent (lookaround, backreferences)
+or with semantics we refuse to approximate (possessive quantifiers, atomic
+groups, class intersection ``&&``) raise :class:`RegexUnsupportedError`; the
+caller falls back to host-side matching for those patterns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Union
+
+MAX_BYTE = 0xFF
+
+WORD_BYTES = frozenset(
+    b for b in range(256)
+    if chr(b).isascii() and (chr(b).isalnum() or chr(b) == "_")
+)
+DIGIT_BYTES = frozenset(range(ord("0"), ord("9") + 1))
+SPACE_BYTES = frozenset(b" \t\n\x0b\f\r")
+ALL_BYTES = frozenset(range(256))
+# Java '.' default: any char but line terminators (\n \r; the Unicode ones
+# are non-ASCII and therefore already in the superset-on-non-ASCII caveat).
+DOT_BYTES = ALL_BYTES - frozenset(b"\n\r")
+
+_CLASS_SHORTHANDS = {
+    "d": DIGIT_BYTES,
+    "D": ALL_BYTES - DIGIT_BYTES,
+    "w": WORD_BYTES,
+    "W": ALL_BYTES - WORD_BYTES,
+    "s": SPACE_BYTES,
+    "S": ALL_BYTES - SPACE_BYTES,
+}
+
+_SIMPLE_ESCAPES = {
+    "n": ord("\n"),
+    "t": ord("\t"),
+    "r": ord("\r"),
+    "f": ord("\f"),
+    "a": 0x07,
+    "e": 0x1B,
+}
+
+_POSIX_CONTENTS = {
+    "Alpha": frozenset(b for b in range(256) if chr(b).isascii() and chr(b).isalpha()),
+    "Digit": DIGIT_BYTES,
+    "Alnum": frozenset(b for b in range(256) if chr(b).isascii() and chr(b).isalnum()),
+    "Upper": frozenset(range(ord("A"), ord("Z") + 1)),
+    "Lower": frozenset(range(ord("a"), ord("z") + 1)),
+    "Space": SPACE_BYTES,
+    "Punct": frozenset(b for b in range(33, 127) if not chr(b).isalnum()),
+    "XDigit": DIGIT_BYTES | frozenset(b"abcdefABCDEF"),
+}
+
+
+class RegexUnsupportedError(ValueError):
+    """Raised for Java regex constructs the automaton path cannot express."""
+
+
+# ----------------------------------------------------------------- AST nodes
+
+
+@dataclasses.dataclass(frozen=True)
+class Lit:
+    """Match exactly one byte from ``byteset``."""
+
+    byteset: frozenset[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cat:
+    parts: tuple["Node", ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Alt:
+    options: tuple["Node", ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rep:
+    """``child`` repeated between ``lo`` and ``hi`` times (``hi=None`` = ∞).
+    Laziness is irrelevant for boolean find() semantics and is discarded."""
+
+    child: "Node"
+    lo: int
+    hi: int | None
+
+
+@dataclasses.dataclass(frozen=True)
+class Assertion:
+    """Zero-width assertion: ``^`` ``$`` ``b`` (word boundary) ``B``."""
+
+    kind: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Empty:
+    pass
+
+
+Node = Union[Lit, Cat, Alt, Rep, Assertion, Empty]
+
+
+def _fold_byte(b: int) -> frozenset[int]:
+    """Case-insensitive byte set for an ASCII byte."""
+    ch = chr(b)
+    if ch.isascii() and ch.isalpha():
+        return frozenset({ord(ch.lower()), ord(ch.upper())})
+    return frozenset({b})
+
+
+def _char_to_bytesets(ch: str, ci: bool) -> list[frozenset[int]]:
+    """One char → a sequence of single-byte sets (UTF-8 expansion)."""
+    if ord(ch) < 128:
+        return [_fold_byte(ord(ch)) if ci else frozenset({ord(ch)})]
+    return [frozenset({b}) for b in ch.encode("utf-8")]
+
+
+class _Parser:
+    def __init__(self, pattern: str, case_insensitive: bool = False):
+        self.p = pattern
+        self.i = 0
+        self.n = len(pattern)
+        self.ci = case_insensitive
+
+    def fail(self, what: str) -> RegexUnsupportedError:
+        return RegexUnsupportedError(f"{what} at index {self.i} in {self.p!r}")
+
+    def peek(self) -> str | None:
+        return self.p[self.i] if self.i < self.n else None
+
+    def take(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    # grammar: alt := cat ('|' cat)* ; cat := rep* ; rep := atom quant?
+
+    def parse(self) -> Node:
+        node = self.parse_alt()
+        if self.i < self.n:
+            raise self.fail(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def parse_alt(self) -> Node:
+        options = [self.parse_cat()]
+        while self.peek() == "|":
+            self.take()
+            options.append(self.parse_cat())
+        return options[0] if len(options) == 1 else Alt(tuple(options))
+
+    def parse_cat(self) -> Node:
+        parts: list[Node] = []
+        while self.i < self.n and self.peek() not in ("|", ")"):
+            parts.append(self.parse_rep())
+        if not parts:
+            return Empty()
+        return parts[0] if len(parts) == 1 else Cat(tuple(parts))
+
+    def parse_rep(self) -> Node:
+        atom = self.parse_atom()
+        while True:
+            quant = self._parse_quantifier()
+            if quant is None:
+                return atom
+            lo, hi = quant
+            if isinstance(atom, Assertion):
+                # quantified assertions are meaningless; Java allows (\b)* etc.
+                atom = atom if lo > 0 else Empty()
+                continue
+            atom = Rep(atom, lo, hi)
+
+    def _parse_quantifier(self) -> tuple[int, int | None] | None:
+        ch = self.peek()
+        if ch == "*":
+            self.take()
+            lo, hi = 0, None
+        elif ch == "+":
+            self.take()
+            lo, hi = 1, None
+        elif ch == "?":
+            self.take()
+            lo, hi = 0, 1
+        elif ch == "{":
+            mark = self.i
+            self.take()
+            digits = ""
+            while self.peek() and self.peek().isdigit():
+                digits += self.take()
+            if not digits:
+                self.i = mark  # literal '{'
+                return None
+            lo = int(digits)
+            hi: int | None = lo
+            if self.peek() == ",":
+                self.take()
+                digits2 = ""
+                while self.peek() and self.peek().isdigit():
+                    digits2 += self.take()
+                hi = int(digits2) if digits2 else None
+            if self.peek() != "}":
+                self.i = mark
+                return None
+            self.take()
+            if hi is not None and hi < lo:
+                raise self.fail("quantifier max < min")
+        else:
+            return None
+        nxt = self.peek()
+        if nxt == "+":
+            raise self.fail("possessive quantifier")
+        if nxt == "?":
+            self.take()  # lazy — same language
+        return lo, hi
+
+    def parse_atom(self) -> Node:
+        ch = self.take()
+        if ch == "(":
+            return self._parse_group()
+        if ch == "[":
+            return Lit(self._parse_class())
+        if ch == ".":
+            return Lit(DOT_BYTES)
+        if ch == "^":
+            return Assertion("^")
+        if ch == "$":
+            return self._java_dollar()
+        if ch == "\\":
+            return self._parse_escape()
+        if ch in ("*", "+", "?"):
+            raise self.fail(f"dangling quantifier {ch!r}")
+        return self._literal(ch)
+
+    def _java_dollar(self) -> Node:
+        """Java ``$``/``\\Z`` (non-MULTILINE): end of input, or before a
+        *final* line terminator. Lines here never contain ``\\n`` (they come
+        from the split at AnalysisService.java:53) but may end in a lone
+        ``\\r``; for boolean find() semantics the zero-width lookahead
+        ``(?=\\r?\\z)`` is equivalent to consuming an optional final ``\\r``."""
+        return Alt((Assertion("$"), Cat((Lit(frozenset({0x0D})), Assertion("$")))))
+
+    def _literal(self, ch: str) -> Node:
+        sets = _char_to_bytesets(ch, self.ci)
+        if len(sets) == 1:
+            return Lit(sets[0])
+        return Cat(tuple(Lit(s) for s in sets))
+
+    def _parse_group(self) -> Node:
+        if self.peek() == "?":
+            self.take()
+            nxt = self.peek()
+            if nxt == ":":
+                self.take()
+            elif nxt == "<":
+                self.take()
+                if self.peek() in ("=", "!"):
+                    raise self.fail("lookbehind")
+                # named group (?<name>...)
+                while self.peek() not in (">", None):
+                    self.take()
+                if self.peek() != ">":
+                    raise self.fail("unterminated group name")
+                self.take()
+            elif nxt in ("=", "!"):
+                raise self.fail("lookahead")
+            elif nxt == ">":
+                raise self.fail("atomic group")
+            elif nxt is not None and nxt in "idmsuxU-":
+                # inline flags (?i) / (?i:...) — only 'i' is honored
+                flags = ""
+                while self.peek() is not None and self.peek() in "idmsuxU-":
+                    flags += self.take()
+                if any(f in flags for f in "dmsuxU"):
+                    raise self.fail(f"inline flags {flags!r}")
+                if self.peek() == ")":
+                    # (?i) applies to the rest of the pattern
+                    self.take()
+                    self.ci = True
+                    return Empty()
+                if self.peek() != ":":
+                    raise self.fail("bad inline flag group")
+                self.take()
+                saved = self.ci
+                self.ci = "i" in flags and "-" not in flags
+                node = self.parse_alt()
+                if self.peek() != ")":
+                    raise self.fail("unbalanced group")
+                self.take()
+                self.ci = saved
+                return node
+            else:
+                raise self.fail(f"group construct (?{nxt}")
+        # bracketing group body: Java scopes inline flags to the enclosing
+        # group, so a (?i) inside this body expires at the closing ')'
+        saved_ci = self.ci
+        node = self.parse_alt()
+        self.ci = saved_ci
+        if self.peek() != ")":
+            raise self.fail("unbalanced group")
+        self.take()
+        return node
+
+    def _parse_escape(self) -> Node:
+        if self.i >= self.n:
+            raise self.fail("trailing backslash")
+        ch = self.take()
+        if ch == "b":
+            return Assertion("b")
+        if ch == "B":
+            return Assertion("B")
+        if ch in ("A",):
+            return Assertion("^")
+        if ch == "z":  # absolute end of input
+            return Assertion("$")
+        if ch == "Z":  # before a final line terminator, like $
+            return self._java_dollar()
+        if ch == "G":
+            raise self.fail("\\G")
+        if ch.isdigit():
+            raise self.fail("backreference")
+        if ch == "k":
+            raise self.fail("named backreference")
+        if ch in _CLASS_SHORTHANDS:
+            return Lit(_CLASS_SHORTHANDS[ch])
+        if ch in ("p", "P"):
+            content = self._posix_contents()
+            return Lit(ALL_BYTES - content if ch == "P" else content)
+        if ch == "x":
+            return self._literal(chr(self._hex(2)))
+        if ch == "u":
+            return self._literal(chr(self._hex(4)))
+        if ch == "0":
+            raise self.fail("octal escape")
+        if ch == "Q":
+            return self._quoted()
+        if ch == "c":
+            raise self.fail("control escape")
+        if ch in _SIMPLE_ESCAPES:
+            return Lit(frozenset({_SIMPLE_ESCAPES[ch]}))
+        # escaped metachar or ordinary char: literal
+        return self._literal(ch)
+
+    def _posix_contents(self) -> frozenset[int]:
+        if self.peek() != "{":
+            raise self.fail("\\p without {")
+        self.take()
+        name = ""
+        while self.peek() not in ("}", None):
+            name += self.take()
+        if self.peek() != "}":
+            raise self.fail("unterminated \\p{")
+        self.take()
+        if name not in _POSIX_CONTENTS:
+            raise self.fail(f"\\p{{{name}}}")
+        return _POSIX_CONTENTS[name]
+
+    def _hex(self, digits: int) -> int:
+        value = self.p[self.i : self.i + digits]
+        if len(value) != digits:
+            raise self.fail("bad hex escape")
+        self.i += digits
+        return int(value, 16)
+
+    def _quoted(self) -> Node:
+        """\\Q ... \\E literal run."""
+        parts: list[Node] = []
+        while self.i < self.n:
+            if self.p.startswith("\\E", self.i):
+                self.i += 2
+                break
+            parts.append(self._literal(self.take()))
+        if not parts:
+            return Empty()
+        return parts[0] if len(parts) == 1 else Cat(tuple(parts))
+
+    # ----------------------------------------------------------- char class
+
+    def _parse_class(self) -> frozenset[int]:
+        negated = False
+        if self.peek() == "^":
+            self.take()
+            negated = True
+        members: set[int] = set()
+
+        def add_byteset(bs: frozenset[int]) -> None:
+            members.update(bs)
+
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                raise self.fail("unterminated character class")
+            if ch == "]" and not first:
+                self.take()
+                break
+            first = False
+            if ch == "[":
+                raise self.fail("nested character class")
+            if ch == "&" and self.p.startswith("&&", self.i):
+                raise self.fail("class intersection &&")
+            kind, value = self._class_member()
+            if kind == "set":  # shorthand like \w — cannot anchor a range
+                add_byteset(value)
+                continue
+            lo = value
+            if self.peek() == "-" and self.i + 1 < self.n and self.p[self.i + 1] != "]":
+                self.take()
+                kind2, hi = self._class_member()
+                if kind2 != "byte":
+                    raise self.fail("bad range endpoint")
+                if hi < lo:
+                    raise self.fail("reversed range")
+                for b in range(lo, hi + 1):
+                    add_byteset(_fold_byte(b) if self.ci else frozenset({b}))
+            else:
+                add_byteset(_fold_byte(lo) if self.ci else frozenset({lo}))
+        if negated:
+            return frozenset(ALL_BYTES - members)
+        return frozenset(members)
+
+    def _class_member(self) -> tuple[str, frozenset[int] | int]:
+        """One class member: ("byte", code) for a single char usable as a
+        range endpoint, or ("set", byteset) for a shorthand class."""
+        ch = self.take()
+        if ch != "\\":
+            code = ord(ch)
+            if code >= 128:
+                raise self.fail("non-ASCII in character class")
+            return "byte", code
+        esc = self.take() if self.i < self.n else None
+        if esc is None:
+            raise self.fail("trailing backslash in class")
+        if esc in _CLASS_SHORTHANDS:
+            return "set", _CLASS_SHORTHANDS[esc]
+        if esc in ("p", "P"):
+            content = self._posix_contents()
+            return "set", (ALL_BYTES - content if esc == "P" else content)
+        if esc == "x":
+            return "byte", self._hex(2)
+        if esc == "u":
+            code = self._hex(4)
+            if code >= 128:
+                raise self.fail("non-ASCII in character class")
+            return "byte", code
+        if esc in _SIMPLE_ESCAPES:
+            return "byte", _SIMPLE_ESCAPES[esc]
+        if esc == "b":
+            raise self.fail("\\b inside character class")
+        code = ord(esc)
+        if code >= 128:
+            raise self.fail("non-ASCII in character class")
+        return "byte", code
+
+
+def parse_java_regex(pattern: str, case_insensitive: bool = False) -> Node:
+    """Parse ``pattern`` (Java dialect) into a byte-level AST.
+
+    Raises :class:`RegexUnsupportedError` for constructs outside the automaton
+    subset; callers fall back to host-side matching.
+    """
+    return _Parser(pattern, case_insensitive).parse()

@@ -1,0 +1,6 @@
+"""Runtime: the TPU analysis engine orchestrating encode → match → score →
+assemble, plus cross-request frequency state."""
+
+from log_parser_tpu.runtime.engine import AnalysisEngine
+
+__all__ = ["AnalysisEngine"]

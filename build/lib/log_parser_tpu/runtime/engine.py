@@ -1,0 +1,329 @@
+"""AnalysisEngine — the TPU-backed replacement for the reference's
+``AnalysisService.analyze`` (AnalysisService.java:50-122).
+
+Pipeline per request:
+
+1. ingest: fused Java-split + padded uint8 encode (native C++ scan when the
+   extension is built, vectorized numpy otherwise) with lazy line
+   materialization — AnalysisService.java:53 semantics without a million
+   host string objects;
+2. ONE fused device program: DFA-bank automaton execution over the line
+   batch + integer factor-component extraction, compacted to K-capped
+   match records (ops/fused.py). Host ``re`` verification only for
+   device-inexact lines (non-ASCII / over-long) and automaton-unsupported
+   regexes, injected as a cube override;
+3. host finalizer: exact f64 seven-factor scores from the integer records
+   (runtime/finalize.py) — better-than-device-f64 parity at O(matches)
+   cost;
+4. assemble ``AnalysisResult`` in discovery order (line-major, then
+   pattern order — AnalysisService.java:89-113) with the same
+   metadata/summary quirks as the reference.
+
+Frequency state is the engine's only mutable state, mirrored from the
+reference's ConcurrentHashMap (FrequencyTrackingService.java:25) but read
+at batch granularity with exact per-match ordering recovered from the
+record stream (read-before-record, ScoringService.java:84-88).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Callable
+
+import numpy as np
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    GoldenFrequencyTracker,
+    build_metadata,
+    build_summary,
+    extract_context,
+)
+from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
+from log_parser_tpu.ops.match import DfaBank, MatcherBanks
+from log_parser_tpu.patterns.bank import PatternBank
+from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
+from log_parser_tpu.utils.trace import PhaseTrace
+
+# Substrings identifying plain RuntimeErrors raised by the device layer
+# *before* jit execution starts (jax raises these from xla_bridge /
+# PJRT client setup, not as JaxRuntimeError).
+_DEVICE_ERROR_MARKERS = (
+    "Unable to initialize backend",
+    "failed to initialize",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "RESOURCE_EXHAUSTED",
+    "Device or resource busy",
+)
+
+
+def _raised_in_device_layer(exc: BaseException) -> bool:
+    """True when any traceback frame of ``exc`` (or of an exception in its
+    cause/context chain) belongs to a jax/jaxlib module — i.e. the error
+    genuinely originated in the device stack, not in engine code that
+    happens to quote device-sounding text.
+
+    The cause/context chain matters: jax's default traceback filtering
+    (``jax_traceback_filtering='auto'``) strips jax-internal frames from
+    the primary traceback and re-parents the unfiltered exception via
+    ``__cause__``/``__context__`` — inspecting only ``__traceback__``
+    would misclassify genuine device errors as logic bugs."""
+    seen: set[int] = set()
+    current: BaseException | None = exc
+    while current is not None and id(current) not in seen:
+        seen.add(id(current))
+        tb = current.__traceback__
+        while tb is not None:
+            mod = tb.tb_frame.f_globals.get("__name__", "")
+            if mod == "jax" or mod.startswith(("jax.", "jaxlib")):
+                return True
+            tb = tb.tb_next
+        current = current.__cause__ or current.__context__
+    return False
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """True only for failures of the device/XLA layer itself — the class of
+    error the golden fallback exists for (SURVEY.md §5.3). Logic bugs
+    (TypeError in assembly, bad config, ...) must propagate: serving them
+    from the host path would hide the bug and, for large batches, convert a
+    fast failure into a multi-minute pure-Python crawl (the round-1
+    BENCH_r01 rc=124 failure mode).
+
+    A plain RuntimeError counts only when BOTH a known device-layer marker
+    appears in its message AND the exception was raised from a jax/jaxlib
+    frame — a non-device RuntimeError that merely quotes such text (e.g. a
+    log line or downstream response embedded in the message) propagates
+    (ADVICE.md r2)."""
+    import jax.errors
+
+    if isinstance(exc, jax.errors.JaxRuntimeError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(marker in msg for marker in _DEVICE_ERROR_MARKERS) and (
+            _raised_in_device_layer(exc)
+        )
+    return False
+
+
+class AnalysisEngine:
+    """Immutable compiled library + one fused device program + frequency state."""
+
+    def __init__(
+        self,
+        pattern_sets: list[PatternSet],
+        config: ScoringConfig | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or ScoringConfig()
+        self.bank = PatternBank(pattern_sets)
+        self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
+
+        self._host_cols = [
+            i
+            for i, c in enumerate(self.bank.columns)
+            if c.dfa is None and c.exact_seqs is None
+        ]
+        self._device_cols = [
+            i
+            for i, c in enumerate(self.bank.columns)
+            if c.dfa is not None or c.exact_seqs is not None
+        ]
+        # static per-pattern index tables (numpy, cheap); the full-bank
+        # device programs below are built lazily — subclasses that override
+        # _run_device (pattern sharding) never pay for them
+        self.tables = FusedStaticTables(self.bank, self.config)
+        self._matchers: MatcherBanks | None = None
+        self._fused: FusedMatchScore | None = None
+        self._golden = None
+        # cheap insurance: a request whose device batch dies is re-served
+        # from the golden host path (SURVEY.md §5.3). Disabled in the test
+        # suite so device bugs can never hide behind the fallback.
+        self.fallback_to_golden = (
+            os.environ.get("LOG_PARSER_TPU_NO_FALLBACK") != "1"
+        )
+        self._k_hint = 0  # previous request's match count → starting K bucket
+        # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
+        # factor breakdown of the most recent request
+        self.last_trace: PhaseTrace | None = None
+        self.last_finalized: FinalizedBatch | None = None
+        # how many requests this engine served from the golden host path
+        # because the device layer failed (surfaced via GET /trace/last)
+        self.fallback_count = 0
+
+    @property
+    def skipped_patterns(self) -> list[tuple[str, str]]:
+        return self.bank.skipped_patterns
+
+    @property
+    def matchers(self) -> MatcherBanks:
+        if self._matchers is None:
+            self._matchers = MatcherBanks(self.bank)
+        return self._matchers
+
+    @property
+    def dfa_bank(self) -> DfaBank:
+        return self.matchers.dfa_bank
+
+    @property
+    def fused(self) -> FusedMatchScore:
+        if self._fused is None:
+            self._fused = FusedMatchScore(self.bank, self.config, self.matchers)
+        return self._fused
+
+    # -------------------------------------------------------------- overrides
+
+    def _overrides(self, corpus: Corpus) -> tuple[np.ndarray, np.ndarray] | None:
+        """Cube corrections the automaton path can't make itself: columns
+        with no DFA (host regex over every line) and lines flagged
+        device-inexact (non-ASCII bytes, over-long). None when the batch is
+        fully device-exact — the common case, which then skips the
+        override transfer entirely."""
+        enc = corpus.encoded
+        host_lines = np.flatnonzero(enc.needs_host[: corpus.n_lines])
+        if not self._host_cols and len(host_lines) == 0:
+            return None
+        B = enc.u8.shape[0]
+        mask = np.zeros((B, self.bank.n_columns), dtype=bool)
+        val = np.zeros((B, self.bank.n_columns), dtype=bool)
+        if self._host_cols:
+            # every line needs a host pass: decode each exactly once
+            hosts = [(col, self.bank.columns[col].host) for col in self._host_cols]
+            mask[:, [col for col, _ in hosts]] = True
+            for i, line in enumerate(corpus.materialize()):
+                for col, host in hosts:
+                    val[i, col] = bool(host.search(line))
+        for i in host_lines:
+            line = corpus.line(int(i))
+            for col in self._device_cols:
+                mask[i, col] = True
+                val[i, col] = bool(self.bank.columns[col].host.search(line))
+        return mask, val
+
+    # ----------------------------------------------------- device-step hooks
+    # ShardedEngine overrides these two to swap in the shard_map program;
+    # everything else in analyze() is shared.
+
+    def _corpus_min_rows(self) -> int:
+        return 8
+
+    def _run_device(self, enc, n_lines: int, om, ov):
+        return self.fused.run(
+            enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
+        )
+
+    # ------------------------------------------------------- golden fallback
+
+    @property
+    def golden_fallback(self):
+        """Lazy golden (pure host) analyzer sharing this engine's frequency
+        state — the insurance path when a device batch fails (SURVEY.md
+        §5.3; the reference has no equivalent)."""
+        if self._golden is None:
+            from log_parser_tpu.golden.engine import GoldenAnalyzer
+
+            self._golden = GoldenAnalyzer(self.bank.pattern_sets, self.config)
+            self._golden.frequency = self.frequency
+        return self._golden
+
+    # --------------------------------------------------------------- analyze
+
+    def analyze(self, data: PodFailureData) -> AnalysisResult:
+        # roll frequency state back on ANY failure: a partially-run request
+        # (e.g. one that died after recording its matches) must not leave
+        # the tracker double-counted — whether golden re-serves it or the
+        # client retries after a 500
+        saved_freq = self.frequency._save_state()
+        try:
+            return self._analyze_device(data)
+        except Exception as exc:
+            self.frequency._load_state(saved_freq)
+            if not self.fallback_to_golden or not is_device_error(exc):
+                # logic bugs always propagate; device failures degrade to
+                # the golden host path only when the fallback is enabled
+                raise
+            import logging
+
+            self.fallback_count += 1
+            logging.getLogger(__name__).exception(
+                "Device batch failed (fallback #%d); serving this request "
+                "from the golden host path",
+                self.fallback_count,
+            )
+            # device-side observability does not describe this request
+            self.last_trace = None
+            self.last_finalized = None
+            try:
+                return self.golden_fallback.analyze(data)
+            except Exception:
+                # golden records matches as it runs — a failure partway
+                # through must not leak its partial counts either
+                self.frequency._load_state(saved_freq)
+                raise
+
+    def _analyze_device(self, data: PodFailureData) -> AnalysisResult:
+        start = time.monotonic()
+        trace = PhaseTrace()
+        with trace.phase("ingest"):
+            corpus = Corpus(data.logs or "", min_rows=self._corpus_min_rows())
+            enc = corpus.encoded
+
+        with trace.phase("overrides"):
+            overrides = self._overrides(corpus)
+        om, ov = overrides if overrides is not None else (None, None)
+        with trace.phase("device"):
+            recs = self._run_device(enc, corpus.n_lines, om, ov)
+        self._k_hint = recs.n_matches
+
+        # windowed frequency counts at batch start (pruned by the tracker);
+        # "entry exists" is tracked separately — an expired window still has
+        # an entry and takes the formula path, not the null early-return
+        freq_base = np.zeros(max(1, self.bank.n_freq_slots), dtype=np.float64)
+        freq_exists = np.zeros(max(1, self.bank.n_freq_slots), dtype=bool)
+        for slot, pid in enumerate(self.bank.freq_ids):
+            freq_base[slot] = self.frequency.get_windowed_count(pid)
+            freq_exists[slot] = self.frequency.has_entry(pid)
+
+        with trace.phase("finalize"):
+            fin = finalize_batch(
+                self.bank, self.tables, self.config, recs, corpus.n_lines,
+                freq_base, freq_exists,
+            )
+
+        # record this batch's matches (after the read — ScoringService.java:84-88)
+        for slot, count in enumerate(fin.slot_batch_counts[: self.bank.n_freq_slots]):
+            for _ in range(int(count)):
+                self.frequency.record_pattern_match(self.bank.freq_ids[slot])
+
+        # records are already in discovery order (line-major, then pattern)
+        with trace.phase("assemble"):
+            events: list[MatchedEvent] = []
+            for i in range(len(fin.scores)):
+                line_idx = int(fin.line[i])
+                pattern = self.bank.patterns[int(fin.pattern[i])]
+                events.append(
+                    MatchedEvent(
+                        line_number=line_idx + 1,
+                        matched_pattern=pattern,
+                        context=extract_context(corpus, line_idx, pattern),
+                        score=float(fin.scores[i]),
+                    )
+                )
+
+            result = AnalysisResult(
+                events=events,
+                analysis_id=str(uuid.uuid4()),
+                metadata=build_metadata(start, corpus.n_lines, self.bank.pattern_sets),
+                summary=build_summary(events),
+            )
+        self.last_trace = trace
+        self.last_finalized = fin
+        return result

@@ -1,0 +1,233 @@
+"""Host finalizer: exact f64 scores from integer match records.
+
+The device program (ops/fused.py) emits per-match integer factor
+components; this module evaluates the reference's seven-factor formula
+(ScoringService.java:102-109) over them in true IEEE-double arithmetic —
+the same number system the JVM uses — vectorized with numpy over the
+M ≪ B·P matched records. Summation loops whose order the reference fixes
+(secondaries in declaration order, ScoringService.java:172-186; sequences
+in declaration order, :208-215) run as short Python loops over the padded
+per-pattern axis so the accumulation order is preserved; everything else
+is elementwise.
+
+Also recovers the frequency read-before-record ordering
+(ScoringService.java:84-88) directly from the record stream: records
+arrive in discovery order, so the Nth record of a slot sees exactly N-1
+in-batch priors — a stable-sort cumcount, no device work at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    DENSITY_MIN_LINES,
+    DENSITY_PENALTY,
+    DENSITY_RATIO,
+    ERROR_WEIGHT,
+    EXCEPTION_WEIGHT,
+    STACK_BONUS_CAP,
+    STACK_WEIGHT,
+    WARN_WEIGHT,
+)
+from log_parser_tpu.javamath import java_div
+from log_parser_tpu.ops.fused import FusedStaticTables, MatchRecords, NO_HIT
+from log_parser_tpu.patterns.bank import PatternBank
+
+
+@dataclasses.dataclass
+class FinalizedBatch:
+    """Scores per match record (discovery order) + frequency bookkeeping.
+
+    The per-factor arrays are the parity-debugging surface (SURVEY.md §5.5):
+    every component of every score, in the exact f64 values that were
+    multiplied — the structured replacement for the reference's per-factor
+    debug logs (ScoringService.java:90-99)."""
+
+    scores: np.ndarray  # float64 [M]
+    line: np.ndarray  # int32 [M] 0-based
+    pattern: np.ndarray  # int32 [M]
+    slot_batch_counts: np.ndarray  # int64 [n_freq_slots]
+    chronological: np.ndarray  # float64 [M]
+    proximity: np.ndarray  # float64 [M]
+    temporal: np.ndarray  # float64 [M]
+    context: np.ndarray  # float64 [M]
+    frequency_penalty: np.ndarray  # float64 [M]
+
+    def factor_rows(self, bank) -> list[dict]:
+        """One dict per match, JSON-ready. ``score`` = confidence ×
+        severityMultiplier × chronological × proximity × temporal × context
+        × (1 − frequencyPenalty), exactly (ScoringService.java:102-109)."""
+        return [
+            {
+                "lineNumber": int(self.line[i]) + 1,
+                "patternId": bank.patterns[int(self.pattern[i])].id,
+                "confidence": float(bank.confidence[int(self.pattern[i])]),
+                "severityMultiplier": float(
+                    bank.severity_multiplier[int(self.pattern[i])]
+                ),
+                "chronological": float(self.chronological[i]),
+                "proximity": float(self.proximity[i]),
+                "temporal": float(self.temporal[i]),
+                "context": float(self.context[i]),
+                "frequencyPenalty": float(self.frequency_penalty[i]),
+                "score": float(self.scores[i]),
+            }
+            for i in range(len(self.scores))
+        ]
+
+
+def _slot_cumcount(slots: np.ndarray) -> np.ndarray:
+    """Exclusive per-value running count: out[i] = |{j < i : slots[j] ==
+    slots[i]}| — the in-batch prior each match sees."""
+    m = len(slots)
+    if m == 0:
+        return np.zeros(0, dtype=np.int64)
+    order = np.argsort(slots, kind="stable")
+    sorted_slots = slots[order]
+    new_group = np.empty(m, dtype=bool)
+    new_group[0] = True
+    new_group[1:] = sorted_slots[1:] != sorted_slots[:-1]
+    group_start = np.maximum.accumulate(np.where(new_group, np.arange(m), 0))
+    cum = np.arange(m) - group_start
+    out = np.empty(m, dtype=np.int64)
+    out[order] = cum
+    return out
+
+
+def finalize_batch(
+    bank: PatternBank,
+    tables: FusedStaticTables,
+    config: ScoringConfig,
+    recs: MatchRecords,
+    n_lines: int,
+    freq_base: np.ndarray,
+    freq_exists: np.ndarray,
+) -> FinalizedBatch:
+    """``freq_base``: float64 [n_freq_slots] windowed counts at batch start;
+    ``freq_exists``: tracker-has-entry flags (an expired window still has an
+    entry and takes the formula path, FrequencyTrackingService.java:69-83)."""
+    m = recs.n_matches
+    line = recs.line[:m].astype(np.int64)
+    pat = recs.pattern[:m].astype(np.int64)
+
+    if m == 0:
+        z = np.zeros(0, dtype=np.float64)
+        return FinalizedBatch(
+            scores=z,
+            line=recs.line[:0],
+            pattern=recs.pattern[:0],
+            slot_batch_counts=np.zeros(max(1, bank.n_freq_slots), dtype=np.int64),
+            chronological=z, proximity=z, temporal=z, context=z,
+            frequency_penalty=z,
+        )
+
+    conf = bank.confidence[pat]
+    sev = bank.severity_multiplier[pat]
+
+    # ---- chronological (ScoringService.java:123-151) ----------------------
+    pos = line.astype(np.float64) / float(n_lines)
+    early = float(config.chronological_early_bonus_threshold)
+    penalty_thr = float(config.chronological_penalty_threshold)
+    bonus_quot = java_div(config.chronological_max_early_bonus - 1.5, early)
+    middle_quot = java_div(0.5, penalty_thr - early)
+    with np.errstate(invalid="ignore"):
+        chrono = np.where(
+            pos <= early,
+            1.5 + (early - pos) * bonus_quot,
+            np.where(
+                pos <= penalty_thr,
+                1.0 + (penalty_thr - pos) * middle_quot,
+                0.5 + (1.0 - pos),
+            ),
+        )
+
+    # ---- proximity (ScoringService.java:161-190) --------------------------
+    # short loop over the padded secondary axis preserves declaration-order
+    # accumulation; distances are exact ints from the device
+    prox_total = np.zeros(m, dtype=np.float64)
+    if tables.s_max:
+        sec_idx = tables.pat_sec[pat]  # [M, S_max]
+        decay = np.float64(config.proximity_decay_constant)
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            for j in range(tables.s_max):
+                e = sec_idx[:, j]
+                es = np.maximum(e, 0)
+                d_int = recs.sec_dist[:m, j].astype(np.int64)
+                found = (e >= 0) & (d_int < NO_HIT) & (d_int <= tables.sec_window[es])
+                # Math.exp(-d / decay) in f64; decay 0 → -inf → exp → 0.0,
+                # exactly Java's double semantics
+                contrib = tables.sec_weight[es] * np.exp(
+                    -d_int.astype(np.float64) / decay
+                )
+                prox_total += np.where(found, contrib, 0.0)
+    prox = 1.0 + prox_total
+
+    # ---- temporal (ScoringService.java:199-220) ---------------------------
+    temp_total = np.zeros(m, dtype=np.float64)
+    if tables.q_max:
+        q_idx = tables.pat_seq[pat]  # [M, Q_max]
+        for j in range(tables.q_max):
+            q = q_idx[:, j]
+            live = q >= 0
+            bonus = tables.seq_bonus[np.maximum(q, 0)]
+            temp_total += np.where(live & recs.seq_ok[:m, j], bonus, 0.0)
+    temp = 1.0 + temp_total
+
+    # ---- context (ContextAnalysisService.java:46-117) ---------------------
+    err = recs.ctx_counts[:m, 0].astype(np.float64)
+    warn = recs.ctx_counts[:m, 1].astype(np.float64)  # already err-shadowed
+    stack = recs.ctx_counts[:m, 2].astype(np.float64)
+    exc = recs.ctx_counts[:m, 3].astype(np.float64)
+    total = recs.ctx_counts[:m, 4].astype(np.float64)
+    ctx_score = (
+        ERROR_WEIGHT * err + WARN_WEIGHT * warn + STACK_WEIGHT * stack
+        + EXCEPTION_WEIGHT * exc
+    )
+    ctx_score += np.where(
+        stack > 0, np.minimum(STACK_WEIGHT * stack, STACK_BONUS_CAP), 0.0
+    )
+    dense = (total > DENSITY_MIN_LINES) & ((stack + err) > total * DENSITY_RATIO)
+    ctx_score = np.where(dense, ctx_score * DENSITY_PENALTY, ctx_score)
+    ctx = np.minimum(1.0 + ctx_score, float(config.context_max_context_factor))
+
+    # ---- frequency (FrequencyTrackingService.java:64-93, read-before-record
+    # order of ScoringService.java:84-88) -----------------------------------
+    slots = bank.freq_slot[pat].astype(np.int64)  # -1 = untracked
+    prior = _slot_cumcount(slots)
+    safe = np.maximum(slots, 0)
+    hours = float(config.frequency_time_window_hours)
+    if hours == 0.0:
+        # zero window: every record expires instantly, windowed count is 0
+        count_before = np.zeros(m, dtype=np.float64)
+    else:
+        count_before = freq_base[safe] + prior.astype(np.float64)
+    thr = float(config.frequency_threshold)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rate = count_before / hours  # IEEE /0 → inf/nan, like Java
+        raw = np.minimum(float(config.frequency_max_penalty), (rate - thr) / thr)
+    penalty = np.where(rate <= thr, 0.0, raw)
+    never_tracked = ~freq_exists[safe] & (prior == 0)
+    penalty = np.where(never_tracked, 0.0, penalty)
+    penalty = np.where(slots >= 0, penalty, 0.0)
+
+    scores = conf * sev * chrono * prox * temp * ctx * (1.0 - penalty)
+
+    n_slots = max(1, bank.n_freq_slots)
+    tracked = slots >= 0
+    slot_batch_counts = np.bincount(slots[tracked], minlength=n_slots).astype(np.int64)
+
+    return FinalizedBatch(
+        scores=scores,
+        line=recs.line[:m],
+        pattern=recs.pattern[:m],
+        slot_batch_counts=slot_batch_counts,
+        chronological=chrono,
+        proximity=prox,
+        temporal=temp,
+        context=ctx,
+        frequency_penalty=np.asarray(penalty, dtype=np.float64),
+    )

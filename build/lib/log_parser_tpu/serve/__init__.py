@@ -1,0 +1,6 @@
+"""HTTP serving: the reference's ``POST /parse`` contract plus operational
+endpoints the reference lacked (health, frequency admin)."""
+
+from log_parser_tpu.serve.http import ParseServer, make_server
+
+__all__ = ["ParseServer", "make_server"]

@@ -1,0 +1,86 @@
+"""CLI entry: ``python -m log_parser_tpu.serve --pattern-dir /shared/patterns``.
+
+Mirrors the reference's boot sequence: load the pattern directory at startup
+(PatternService @PostConstruct, PatternService.java:45-69), then serve
+``POST /parse`` on :8080 (Dockerfile.native:28). Config comes from a Java
+``.properties`` file (``--config``), environment variables (MicroProfile
+convention), or flags — flags win.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.patterns import load_pattern_directory
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.serve.http import make_server
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="log_parser_tpu.serve")
+    parser.add_argument("--pattern-dir", help="pattern YAML directory (pattern.directory)")
+    parser.add_argument("--config", help="Java .properties config file")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--sharded",
+        action="store_true",
+        help="shard the line batch over every visible device (jax mesh)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
+    )
+    log = logging.getLogger("log_parser_tpu.serve")
+
+    config = (
+        ScoringConfig.from_properties_file(args.config)
+        if args.config
+        else ScoringConfig.from_env()
+    )
+    if args.pattern_dir:
+        config = dataclasses.replace(config, pattern_directory=args.pattern_dir)
+    if not config.pattern_directory:
+        log.error("pattern.directory is required (--pattern-dir / config / env)")
+        return 2
+
+    pattern_sets = load_pattern_directory(config.pattern_directory)
+    if args.sharded:
+        from log_parser_tpu.parallel import ShardedEngine, make_mesh
+
+        mesh = make_mesh()
+        engine = ShardedEngine(pattern_sets, config, mesh=mesh)
+        log.info("Sharding line batches over %d devices", mesh.devices.size)
+    else:
+        engine = AnalysisEngine(pattern_sets, config)
+    if engine.skipped_patterns:
+        for pid, reason in engine.skipped_patterns:
+            log.warning("pattern %r disabled: %s", pid, reason)
+    log.info(
+        "Loaded %d pattern sets (%d patterns, %d matcher columns; %d on-device DFAs)",
+        len(pattern_sets),
+        engine.bank.n_patterns,
+        engine.bank.n_columns,
+        sum(1 for c in engine.bank.columns if c.dfa is not None),
+    )
+
+    server = make_server(engine, args.host, args.port)
+    log.info("Serving POST /parse on %s:%d", args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("Shutting down")
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

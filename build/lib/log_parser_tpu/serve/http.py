@@ -1,0 +1,157 @@
+"""``POST /parse`` HTTP endpoint — the reference's REST contract.
+
+Contract parity with Parse.java:41-61:
+
+- ``POST /parse`` consumes/produces JSON;
+- a null body or null ``pod`` returns 400 with exactly
+  ``{"error":"Invalid PodFailureData provided"}`` (Parse.java:45-49);
+- success returns the full ``AnalysisResult`` (camelCase keys, Jackson bean
+  convention) with 200;
+- request/response logging mirrors Parse.java:51,55-58.
+
+Additions over the reference (SURVEY.md §5.3 — it has no health endpoints
+and no REST surface for the frequency admin API that exists only
+programmatically at FrequencyTrackingService.java:101-134):
+
+- ``GET /health`` (+ ``/health/live``, ``/health/ready``);
+- ``GET /frequency/stats`` — current windowed counts per pattern id;
+- ``POST /frequency/reset`` and ``POST /frequency/reset/{patternId}``.
+
+Analysis requests are serialized with a lock: device execution is serial
+anyway, and the reference's concurrency story was an unsynchronized data
+race on shared pattern objects (SURVEY.md §5.2) — not a behavior to
+reproduce.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime.engine import AnalysisEngine
+
+log = logging.getLogger(__name__)
+
+_INVALID = b'{"error":"Invalid PodFailureData provided"}'
+
+
+class ParseServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, address: tuple[str, int], engine: AnalysisEngine):
+        super().__init__(address, _Handler)
+        self.engine = engine
+        self.analyze_lock = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: ParseServer
+
+    # ------------------------------------------------------------- plumbing
+
+    def log_message(self, fmt: str, *args) -> None:  # route to logging, not stderr
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _send_json(self, status: int, payload: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    # --------------------------------------------------------------- routes
+
+    def do_POST(self) -> None:
+        if self.path == "/parse":
+            return self._parse()
+        if self.path == "/frequency/restore":
+            bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                ages = json.loads(self.rfile.read(length) if length else b"{}")
+            except ValueError:
+                return self._send_json(400, bad)
+            # validate the FULL shape before touching state: restore must be
+            # all-or-nothing, never partial. Negative ages are future
+            # timestamps that never prune — rejected.
+            if not isinstance(ages, dict) or not all(
+                isinstance(v, list)
+                and all(isinstance(a, (int, float)) and a >= 0 for a in v)
+                for v in ages.values()
+            ):
+                return self._send_json(400, bad)
+            with self.server.analyze_lock:
+                self.server.engine.frequency.restore(ages)
+            return self._send_json(200, b'{"status":"restored"}')
+        if self.path == "/frequency/reset":
+            with self.server.analyze_lock:
+                self.server.engine.frequency.reset_all_frequencies()
+            return self._send_json(200, b'{"status":"reset"}')
+        if self.path.startswith("/frequency/reset/"):
+            pattern_id = self.path[len("/frequency/reset/") :]
+            with self.server.analyze_lock:
+                self.server.engine.frequency.reset_pattern_frequency(pattern_id)
+            return self._send_json(200, b'{"status":"reset"}')
+        self._send_json(404, b'{"error":"not found"}')
+
+    def do_GET(self) -> None:
+        if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
+            return self._send_json(200, b'{"status":"UP"}')
+        if self.path == "/frequency/stats":
+            with self.server.analyze_lock:
+                stats = self.server.engine.frequency.get_frequency_statistics()
+            return self._send_json(200, json.dumps(stats).encode())
+        if self.path == "/frequency/snapshot":
+            with self.server.analyze_lock:
+                snap = self.server.engine.frequency.snapshot()
+            return self._send_json(200, json.dumps(snap).encode())
+        if self.path == "/trace/last":
+            trace = self.server.engine.last_trace
+            payload = {"phasesMs": {}, "totalMs": 0.0} if trace is None else {
+                "phasesMs": {k: v * 1e3 for k, v in trace.as_dict().items()},
+                "totalMs": trace.total * 1e3,
+            }
+            payload["fallbackCount"] = self.server.engine.fallback_count
+            return self._send_json(200, json.dumps(payload).encode())
+        if self.path == "/debug/factors":
+            fin = self.server.engine.last_finalized
+            rows = [] if fin is None else fin.factor_rows(self.server.engine.bank)
+            return self._send_json(200, json.dumps(rows).encode())
+        self._send_json(404, b'{"error":"not found"}')
+
+    def _parse(self) -> None:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            payload = json.loads(body) if body else None
+        except (ValueError, json.JSONDecodeError):
+            return self._send_json(400, _INVALID)
+
+        data = PodFailureData.from_dict(payload) if isinstance(payload, dict) else None
+        # Parse.java:45-49 — null data or null pod is a 400
+        if data is None or data.pod is None:
+            return self._send_json(400, _INVALID)
+
+        log.info("Received analysis request for pod: %s", data.pod_name)
+        try:
+            with self.server.analyze_lock:
+                result = self.server.engine.analyze(data)
+        except Exception:
+            # non-device bugs propagate out of analyze() by design
+            # (runtime/engine.py is_device_error) — answer with a JSON 500
+            # instead of dropping the connection mid-request
+            log.exception("Analysis failed for pod: %s", data.pod_name)
+            return self._send_json(500, b'{"error":"Internal analysis failure"}')
+        log.info(
+            "Analysis complete for pod: %s. Found %d significant events.",
+            data.pod_name,
+            result.summary.significant_events if result.summary else 0,
+        )
+        self._send_json(200, json.dumps(result.to_dict(drop_none=True)).encode())
+
+
+def make_server(engine: AnalysisEngine, host: str = "0.0.0.0", port: int = 8080) -> ParseServer:
+    return ParseServer((host, port), engine)

@@ -1,0 +1,19 @@
+"""JVM↔TPU shim: framed-protobuf contract (proto/logparser.proto).
+
+``logparser_pb2`` is generated — regenerate after editing the proto:
+``protoc --python_out=log_parser_tpu/shim --proto_path=proto proto/logparser.proto``
+"""
+
+from log_parser_tpu.shim.client import ShimClient
+from log_parser_tpu.shim.grpc_server import HAVE_GRPC, make_grpc_server
+from log_parser_tpu.shim.server import ShimServer, make_shim_server
+from log_parser_tpu.shim.service import LogParserService
+
+__all__ = [
+    "HAVE_GRPC",
+    "LogParserService",
+    "ShimClient",
+    "ShimServer",
+    "make_grpc_server",
+    "make_shim_server",
+]

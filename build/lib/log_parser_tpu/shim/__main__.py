@@ -1,0 +1,78 @@
+"""CLI: ``python -m log_parser_tpu.shim --pattern-dir /shared/patterns``.
+
+Runs the TPU backend behind the framed-protobuf shim contract on :9090 —
+the process the reference's JVM front-end delegates its hot loop to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.patterns import load_pattern_directory
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.shim.server import make_shim_server
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="log_parser_tpu.shim")
+    parser.add_argument("--pattern-dir", help="pattern YAML directory (pattern.directory)")
+    parser.add_argument("--config", help="Java .properties config file")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument(
+        "--grpc-port",
+        type=int,
+        default=None,
+        help="also serve standard gRPC (service LogParser) on this port",
+    )
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
+    )
+    log = logging.getLogger("log_parser_tpu.shim")
+
+    config = (
+        ScoringConfig.from_properties_file(args.config)
+        if args.config
+        else ScoringConfig.from_env()
+    )
+    if args.pattern_dir:
+        config = dataclasses.replace(config, pattern_directory=args.pattern_dir)
+    if not config.pattern_directory:
+        log.error("pattern.directory is required (--pattern-dir / config / env)")
+        return 2
+
+    engine = AnalysisEngine(load_pattern_directory(config.pattern_directory), config)
+    server = make_shim_server(engine, args.host, args.port)
+    grpc_server = None
+    if args.grpc_port is not None:
+        from log_parser_tpu.shim.grpc_server import make_grpc_server
+
+        # share the framed server's service so both transports serialize
+        # engine + frequency access on the same lock
+        grpc_server, bound = make_grpc_server(
+            engine, args.host, args.grpc_port, service=server.service
+        )
+        grpc_server.start()
+        log.info("Shim serving gRPC (logparser.LogParser) on %s:%d", args.host, bound)
+    log.info("Shim serving framed protobuf on %s:%d", args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("Shutting down")
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

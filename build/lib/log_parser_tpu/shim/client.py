@@ -1,0 +1,63 @@
+"""Reference shim client — the executable documentation of the wire
+protocol for the JVM implementer (protobuf-java + a Socket is all the
+front-end needs)."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from log_parser_tpu.shim import logparser_pb2 as pb
+from log_parser_tpu.shim.framing import read_frame, write_frame
+
+
+class ShimClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9090):
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def call(self, method: str, message) -> pb.Envelope:
+        write_frame(
+            self.sock,
+            pb.Envelope(
+                method=method, payload=message.SerializeToString()
+            ).SerializeToString(),
+        )
+        frame = read_frame(self.sock)
+        if frame is None:
+            raise ConnectionError("shim server closed the connection")
+        env = pb.Envelope()
+        env.ParseFromString(frame)
+        return env
+
+    # ---------------------------------------------------------- convenience
+
+    def parse(self, pod: dict | None, logs: str) -> pb.ParseResponse:
+        env = self.call(
+            "Parse",
+            pb.ParseRequest(
+                pod_json=json.dumps(pod) if pod is not None else "", logs=logs
+            ),
+        )
+        if env.error:
+            raise ValueError(env.error)
+        resp = pb.ParseResponse()
+        resp.ParseFromString(env.payload)
+        return resp
+
+    def health(self) -> str:
+        env = self.call("Health", pb.HealthRequest())
+        if env.error:
+            raise ValueError(env.error)
+        resp = pb.HealthResponse()
+        resp.ParseFromString(env.payload)
+        return resp.status

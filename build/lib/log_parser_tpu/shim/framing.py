@@ -1,0 +1,55 @@
+"""Wire framing for the JVM↔TPU shim: gRPC's message frame on a bare socket.
+
+Each direction carries a stream of frames, every frame being
+``0x00 (uncompressed flag) + uint32 big-endian length + Envelope bytes`` —
+exactly gRPC's length-prefixed message encoding minus the HTTP/2 layer
+(grpcio is not a dependency of either side; the JVM front-end needs only
+protobuf-java and a socket). One request frame yields exactly one response
+frame; requests on one connection are served in order.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+_HDR = struct.Struct(">BI")
+MAX_FRAME = 1 << 30  # 1 GiB: generous bound for a 1M-line corpus request
+
+
+class FramingError(ConnectionError):
+    pass
+
+
+def read_exact(sock: socket.socket, n: int) -> bytes | None:
+    """None on clean EOF at a frame boundary; raises mid-frame."""
+    chunks = []
+    got = 0
+    while got < n:
+        buf = sock.recv(min(n - got, 1 << 20))
+        if not buf:
+            if got == 0:
+                return None
+            raise FramingError(f"connection closed mid-frame ({got}/{n} bytes)")
+        chunks.append(buf)
+        got += len(buf)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket) -> bytes | None:
+    head = read_exact(sock, _HDR.size)
+    if head is None:
+        return None
+    flag, length = _HDR.unpack(head)
+    if flag != 0:
+        raise FramingError(f"compressed frames unsupported (flag={flag})")
+    if length > MAX_FRAME:
+        raise FramingError(f"frame of {length} bytes exceeds MAX_FRAME")
+    body = read_exact(sock, length)
+    if body is None:
+        raise FramingError("connection closed before frame body")
+    return body
+
+
+def write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(0, len(payload)) + payload)

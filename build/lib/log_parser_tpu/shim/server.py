@@ -1,0 +1,91 @@
+"""Framed-socket shim server: the TPU backend behind the Envelope contract.
+
+This is the dependency-free transport of the north star's deployment shape:
+the reference's Quarkus/common-lib front-end stays intact and forwards
+``PodFailureData`` here instead of running the JVM hot loop; this server
+answers with the full ``AnalysisResult`` (discovery-order events, exact
+scores) plus the frequency admin surface. See proto/logparser.proto for
+the contract and framing.py for the wire format; grpc_server.py exposes
+the same :class:`~log_parser_tpu.shim.service.LogParserService` over
+standard gRPC.
+"""
+
+from __future__ import annotations
+
+import logging
+import socketserver
+
+from log_parser_tpu.shim import logparser_pb2 as pb
+from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
+from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
+
+log = logging.getLogger(__name__)
+
+
+class ShimServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: tuple[str, int], engine):
+        super().__init__(address, _Handler)
+        self.service = LogParserService(engine)
+        # dispatch: method name -> (request ctor, bound service method)
+        self.dispatch = {
+            name: (req_t, getattr(self.service, attr))
+            for name, req_t, _resp_t, attr in RPCS
+        }
+
+    @property
+    def engine(self):
+        return self.service.engine
+
+    @property
+    def analyze_lock(self):
+        return self.service.lock
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    server: ShimServer
+
+    def handle(self) -> None:
+        sock = self.request
+        while True:
+            try:
+                frame = read_frame(sock)
+            except FramingError as exc:
+                log.warning("shim connection dropped: %s", exc)
+                return
+            if frame is None:
+                return
+            envelope = pb.Envelope()
+            try:
+                envelope.ParseFromString(frame)
+                entry = self.server.dispatch.get(envelope.method)
+                if entry is None:
+                    response = pb.Envelope(
+                        method=envelope.method,
+                        error=f"unknown method {envelope.method!r}",
+                    )
+                else:
+                    req_t, fn = entry
+                    req = req_t()
+                    req.ParseFromString(envelope.payload)
+                    response = pb.Envelope(
+                        method=envelope.method,
+                        payload=fn(req).SerializeToString(),
+                    )
+            except CLIENT_ERRORS as exc:
+                # expected client errors only (null pod, malformed JSON,
+                # invalid snapshot payload): no traceback, keep the log
+                # quiet. Internal bugs that happen to raise ValueError hit
+                # the generic branch below with a full traceback.
+                log.info("shim client error on %s: %s", envelope.method, exc)
+                response = pb.Envelope(method=envelope.method, error=str(exc))
+            except Exception as exc:  # contained per request
+                log.exception("shim call failed")
+                response = pb.Envelope(method=envelope.method, error=str(exc))
+            write_frame(sock, response.SerializeToString())
+
+
+def make_shim_server(engine, host: str = "127.0.0.1", port: int = 9090) -> ShimServer:
+    return ShimServer((host, port), engine)

@@ -1,0 +1,148 @@
+"""Transport-neutral implementation of the ``LogParser`` contract
+(proto/logparser.proto) — shared by the framed-socket shim (server.py) and
+the gRPC server (grpc_server.py).
+
+One instance wraps one engine; all state-touching calls (Parse + the
+frequency admin surface mirroring FrequencyTrackingService.java:101-134)
+serialize on one lock, exactly like the HTTP front-end.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.shim import logparser_pb2 as pb
+
+
+class InvalidPodError(ValueError):
+    """Null/absent pod — the client error of Parse.java:45-49."""
+
+    def __init__(self) -> None:
+        super().__init__("Invalid PodFailureData provided")
+
+
+# The closed set of exception types transports classify as CLIENT errors
+# (gRPC INVALID_ARGUMENT / quiet shim error frames). Deliberately narrow:
+# a broad `except ValueError` here would misclassify internal bugs — e.g.
+# numpy shape mismatches in device assembly — as the caller's fault and
+# swallow their tracebacks (ADVICE.md r2).
+from log_parser_tpu.golden.engine import SnapshotValidationError  # noqa: E402
+
+CLIENT_ERRORS = (InvalidPodError, SnapshotValidationError, json.JSONDecodeError)
+
+
+class LogParserService:
+    """The six RPC bodies, protobuf-in/protobuf-out."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.lock = threading.Lock()
+
+    # ----------------------------------------------------------------- parse
+
+    def parse(self, req: pb.ParseRequest) -> pb.ParseResponse:
+        pod = json.loads(req.pod_json) if req.pod_json else None
+        if pod is None:
+            raise InvalidPodError()
+        data = PodFailureData(pod=pod, logs=req.logs)
+        with self.lock:
+            result = self.engine.analyze(data)
+
+        resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
+        for event in result.events:
+            ctx = event.context
+            pb_ctx = pb.EventContext()
+            if ctx is not None:
+                pb_ctx.matched_line = ctx.matched_line or ""
+                if ctx.lines_before is not None:
+                    pb_ctx.has_lines_before = True
+                    pb_ctx.lines_before.extend(ctx.lines_before)
+                if ctx.lines_after is not None:
+                    pb_ctx.has_lines_after = True
+                    pb_ctx.lines_after.extend(ctx.lines_after)
+            resp.events.append(
+                pb.MatchedEvent(
+                    line_number=event.line_number,
+                    pattern_json=json.dumps(
+                        event.matched_pattern.to_dict(drop_none=True)
+                    )
+                    if event.matched_pattern is not None
+                    else "",
+                    context=pb_ctx,
+                    score=event.score,
+                )
+            )
+        md = result.metadata
+        if md is not None:
+            resp.metadata.processing_time_ms = md.processing_time_ms or 0
+            resp.metadata.total_lines = md.total_lines or 0
+            resp.metadata.analyzed_at = md.analyzed_at or ""
+            resp.metadata.patterns_used.extend(
+                x or "" for x in (md.patterns_used or [])
+            )
+        sm = result.summary
+        if sm is not None:
+            resp.summary.significant_events = sm.significant_events or 0
+            resp.summary.highest_severity = sm.highest_severity or ""
+            for sev, count in (sm.severity_distribution or {}).items():
+                resp.summary.severity_distribution[sev] = count
+        return resp
+
+    # ---------------------------------------------------- health + frequency
+
+    def health(self, req: pb.HealthRequest) -> pb.HealthResponse:
+        return pb.HealthResponse(status="UP")
+
+    def frequency_stats(
+        self, req: pb.FrequencyStatsRequest
+    ) -> pb.FrequencyStatsResponse:
+        with self.lock:
+            stats = self.engine.frequency.get_frequency_statistics()
+        return pb.FrequencyStatsResponse(windowed_counts=stats)
+
+    def frequency_reset(
+        self, req: pb.FrequencyResetRequest
+    ) -> pb.FrequencyResetResponse:
+        with self.lock:
+            if req.pattern_id:
+                self.engine.frequency.reset_pattern_frequency(req.pattern_id)
+            else:
+                self.engine.frequency.reset_all_frequencies()
+        return pb.FrequencyResetResponse()
+
+    def frequency_snapshot(
+        self, req: pb.FrequencySnapshotRequest
+    ) -> pb.FrequencySnapshotResponse:
+        resp = pb.FrequencySnapshotResponse()
+        with self.lock:
+            snap = self.engine.frequency.snapshot()
+        for pid, ages in snap.items():
+            resp.ages[pid].ages_seconds.extend(ages)
+        return resp
+
+    def frequency_restore(
+        self, req: pb.FrequencyRestoreRequest
+    ) -> pb.FrequencyRestoreResponse:
+        with self.lock:
+            self.engine.frequency.restore(
+                {pid: list(al.ages_seconds) for pid, al in req.ages.items()}
+            )
+        return pb.FrequencyRestoreResponse()
+
+
+# (method name, request type, response type) — the service surface, used by
+# both transports to build their dispatch tables
+RPCS = (
+    ("Parse", pb.ParseRequest, pb.ParseResponse, "parse"),
+    ("Health", pb.HealthRequest, pb.HealthResponse, "health"),
+    ("FrequencyStats", pb.FrequencyStatsRequest, pb.FrequencyStatsResponse,
+     "frequency_stats"),
+    ("FrequencyReset", pb.FrequencyResetRequest, pb.FrequencyResetResponse,
+     "frequency_reset"),
+    ("FrequencySnapshot", pb.FrequencySnapshotRequest,
+     pb.FrequencySnapshotResponse, "frequency_snapshot"),
+    ("FrequencyRestore", pb.FrequencyRestoreRequest,
+     pb.FrequencyRestoreResponse, "frequency_restore"),
+)

@@ -1,0 +1,3 @@
+from log_parser_tpu.utils.trace import PhaseTrace, profiler_trace
+
+__all__ = ["PhaseTrace", "profiler_trace"]

@@ -1,0 +1,60 @@
+"""Per-phase timing + device profiler hooks.
+
+The reference's only timing surface is the wall-clock ``processingTimeMs``
+stamped into result metadata (AnalysisService.java:51,169); it has no
+tracing or profiling subsystem (SURVEY.md §5.1). This framework keeps the
+metadata field for API parity and adds:
+
+- :class:`PhaseTrace` — cheap named-phase wall timers (ingest / overrides /
+  device / finalize / assemble) collected per request; the engine exposes
+  its latest as ``engine.last_trace``.
+- :func:`profiler_trace` — context manager wrapping ``jax.profiler.trace``
+  (TensorBoard-viewable device traces) gated by an output directory, so the
+  hot path carries zero overhead when profiling is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class PhaseTrace:
+    """Named wall-clock phase timers for one request."""
+
+    def __init__(self) -> None:
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    @property
+    def total(self) -> float:
+        return sum(self.phases.values())
+
+    def as_dict(self) -> dict[str, float]:
+        """Seconds per phase, insertion-ordered."""
+        return dict(self.phases)
+
+    def __repr__(self) -> str:
+        parts = ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in self.phases.items())
+        return f"PhaseTrace({parts})"
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str | None):
+    """``jax.profiler.trace`` when ``log_dir`` is set, else a no-op."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

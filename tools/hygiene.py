@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Repo hygiene gate — the Spotless analogue (reference: pom.xml:82-105
+enforces AOSP format at verify; .pre-commit-config.yaml runs
+whitespace/EOF/YAML hooks). This repo's gate is implemented with the
+stdlib + pyyaml only, so it runs identically in pre-commit, CI, and a
+bare container with zero network access.
+
+Checks (all files tracked by git, minus excluded dirs):
+  1. no trailing whitespace;
+  2. text files end with exactly one newline;
+  3. YAML files parse;
+  4. no file larger than 1 MiB enters the repo;
+  5. every Python file compiles (syntax gate);
+  6. Python files use 4-space indentation, never tabs.
+
+``--fix`` rewrites what is mechanically fixable (1 and 2).
+Exit 0 = clean, 1 = violations (listed on stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import py_compile
+import subprocess
+import sys
+from pathlib import Path
+
+MAX_BYTES = 1 << 20
+TEXT_SUFFIXES = {
+    ".py", ".md", ".yml", ".yaml", ".toml", ".json", ".proto", ".cpp",
+    ".h", ".cfg", ".ini", ".txt", ".sh",
+}
+EXCLUDE_PARTS = {".git", "build", "__pycache__", ".pytest_cache"}
+# round artifacts written by the build driver, not authored in this repo
+EXCLUDE_NAMES = {"ADVICE.md", "VERDICT.md", "COPYCHECK.json", "PROGRESS.jsonl"}
+EXCLUDE_PREFIXES = ("BENCH_r", "MULTICHIP_r")
+
+
+def tracked_files(root: Path) -> list[Path]:
+    out = subprocess.run(
+        ["git", "ls-files"], cwd=root, capture_output=True, text=True, check=True
+    )
+    files = []
+    for rel in out.stdout.splitlines():
+        p = root / rel
+        if not p.is_file() or EXCLUDE_PARTS.intersection(p.parts):
+            continue
+        if p.name in EXCLUDE_NAMES or p.name.startswith(EXCLUDE_PREFIXES):
+            continue
+        files.append(p)
+    return files
+
+
+def check_file(path: Path, fix: bool) -> list[str]:
+    problems: list[str] = []
+    size = path.stat().st_size
+    if size > MAX_BYTES:
+        problems.append(f"{path}: {size} bytes exceeds {MAX_BYTES} limit")
+        return problems
+    if path.suffix not in TEXT_SUFFIXES:
+        return problems
+
+    raw = path.read_bytes()
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError:
+        problems.append(f"{path}: not valid UTF-8")
+        return problems
+
+    lines = text.split("\n")
+    stripped = [ln.rstrip() for ln in lines]
+    fixed = "\n".join(stripped).rstrip("\n") + "\n" if text.strip() else ""
+    if any(ln != s for ln, s in zip(lines, stripped)):
+        problems.append(f"{path}: trailing whitespace")
+    if text and text != fixed and fixed == "\n".join(stripped).rstrip("\n") + "\n":
+        if not text.endswith("\n") or text.endswith("\n\n"):
+            problems.append(f"{path}: must end with exactly one newline")
+    if fix and problems and fixed:
+        path.write_text(fixed, encoding="utf-8")
+        return []  # mechanically fixed
+
+    if path.suffix in (".yml", ".yaml"):
+        import yaml
+
+        try:
+            list(yaml.safe_load_all(text))
+        except yaml.YAMLError as exc:
+            problems.append(f"{path}: invalid YAML: {exc}")
+
+    if path.suffix == ".py":
+        if "\t" in text:
+            problems.append(f"{path}: tab character in Python source")
+        try:
+            py_compile.compile(str(path), doraise=True, cfile=None)
+        except py_compile.PyCompileError as exc:
+            problems.append(f"{path}: does not compile: {exc.msg}")
+
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
+    ap.add_argument("paths", nargs="*", help="restrict to these files (pre-commit)")
+    args = ap.parse_args()
+
+    root = Path(__file__).resolve().parents[1]
+    files = [Path(p).resolve() for p in args.paths] if args.paths else tracked_files(root)
+
+    problems: list[str] = []
+    for path in files:
+        problems.extend(check_file(path, args.fix))
+
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\nhygiene: {len(problems)} problem(s) in {len(files)} files", file=sys.stderr)
+        return 1
+    print(f"hygiene: clean ({len(files)} files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
