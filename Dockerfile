@@ -39,8 +39,12 @@ WORKDIR /work
 COPY --from=dependencies /opt/venv /opt/venv
 COPY --from=build /opt/venv/lib/python*/site-packages/log_parser_tpu \
      /opt/venv/lib/python3.12/site-packages/log_parser_tpu
-COPY --from=build /build/native/build/log_parser_native.so /work/native/build/
-COPY --from=build /build/native/log_parser_native.cpp /work/native/
+# the loader resolves native/build/ relative to the installed package root
+# (log_parser_tpu/native/__init__.py), two levels above the package — i.e.
+# site-packages/native/build/. Ship only the prebuilt .so: with no source
+# alongside, the loader uses it as-is and never needs a toolchain.
+COPY --from=build /build/native/build/log_parser_native.so \
+     /opt/venv/lib/python3.12/site-packages/native/build/
 ENV PATH=/opt/venv/bin:$PATH \
     PATTERN_DIRECTORY=/patterns
 EXPOSE 8080

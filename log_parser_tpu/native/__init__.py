@@ -84,10 +84,16 @@ def get_lib() -> ctypes.CDLL | None:
         if os.environ.get("LOG_PARSER_TPU_NO_NATIVE"):
             return None
         try:
-            if not _SRC.exists():
-                return None
-            stale = not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime
-            if stale and not _compile():
+            # a prebuilt .so without source alongside (container runtime
+            # stage, no toolchain) is loaded as-is; staleness only applies
+            # when the source is present to rebuild from
+            if _SRC.exists():
+                stale = (
+                    not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime
+                )
+                if stale and not _compile():
+                    return None
+            elif not _SO.exists():
                 return None
             _lib = _bind(ctypes.CDLL(str(_SO)))
         except OSError as e:

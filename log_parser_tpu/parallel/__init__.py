@@ -15,9 +15,20 @@ narrowest possible collective (SURVEY.md §5.7-5.8):
   batch total recorded into tracker state — the one collective the scoring
   *semantics* require, SURVEY.md §2.2);
 - the chronological factor needs only the global line index — scalar math.
+
+Multi-process (DCN) scale-out lives in ``parallel.distributed``: the same
+mesh and shard_map program spanning processes via ``jax.distributed``,
+with the coordinator broadcasting requests (imported lazily — it pulls in
+``jax.experimental.multihost_utils``).
 """
 
 from log_parser_tpu.parallel.mesh import make_mesh
+from log_parser_tpu.parallel.pattern_sharded import PatternShardedEngine
 from log_parser_tpu.parallel.sharded import ShardedEngine, ShardedFusedStep
 
-__all__ = ["ShardedEngine", "ShardedFusedStep", "make_mesh"]
+__all__ = [
+    "PatternShardedEngine",
+    "ShardedEngine",
+    "ShardedFusedStep",
+    "make_mesh",
+]

@@ -1,0 +1,153 @@
+"""Multi-process (DCN) scale-out: one `jax.sharding.Mesh` spanning
+processes, coordinated by `jax.distributed` (SURVEY.md §2.2/§5.8).
+
+The reference is a single JVM with no inter-process communication at all;
+its only network surface is HTTP :8080 (Dockerfile.native:28). The
+TPU-native equivalent of "scale beyond one host" is NOT a message bus but
+a bigger mesh: `jax.distributed.initialize` connects N processes (each
+owning its local chips) into one runtime, `jax.devices()` becomes the
+global device list, and the existing `shard_map` program from
+parallel/sharded.py runs unchanged — XLA routes `ppermute`/`all_gather`
+over ICI within a host and DCN between hosts.
+
+Serving model: process 0 (the coordinator) owns the HTTP/gRPC surface.
+Every process must participate in every SPMD dispatch, so the coordinator
+broadcasts each request's raw payload to the followers
+(`broadcast_one_to_all` rides the same distributed runtime), and every
+process runs the identical analyze() pipeline in lockstep. Followers
+discard their (identical) results; the coordinator answers the client.
+
+Frequency note: each process evolves its own host-side frequency tracker
+from the same deterministic request stream, so trackers agree except for
+sub-second wall-clock skew at window boundaries. Device dispatches take no
+frequency input (finalization is host-side, runtime/finalize.py), so skew
+can never desynchronize the collectives; the coordinator's scores are the
+canonical response. Admin mutations (reset/restore) apply on the
+coordinator only — snapshot/restore across a restart re-seeds followers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.parallel.sharded import ShardedEngine
+
+log = logging.getLogger(__name__)
+
+_SHUTDOWN = b"\x00shutdown"
+
+
+def init_distributed(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    initialization_timeout: int = 120,
+) -> None:
+    """Join this process into the distributed runtime. After this call
+    `jax.devices()` is the GLOBAL device list across all processes and
+    `make_mesh()` builds a mesh spanning them."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=initialization_timeout,
+    )
+    log.info(
+        "distributed runtime up: process %d/%d, %d local + %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def broadcast_bytes(payload: bytes | None) -> bytes:
+    """Broadcast a byte string from process 0 to every process (two
+    fixed-shape collectives: an int64 length header, then the buffer).
+    Non-coordinators pass ``None`` and receive the coordinator's bytes."""
+    from jax.experimental import multihost_utils as mh
+
+    header = np.array(
+        [len(payload) if payload is not None else 0], dtype=np.int64
+    )
+    n = int(np.asarray(mh.broadcast_one_to_all(header))[0])
+    if n == 0:
+        return b""
+    buf = (
+        np.frombuffer(payload, dtype=np.uint8)
+        if payload is not None
+        else np.zeros((n,), dtype=np.uint8)
+    )
+    out = np.asarray(mh.broadcast_one_to_all(buf))
+    return out.tobytes()
+
+
+class DistributedShardedEngine(ShardedEngine):
+    """ShardedEngine over a process-spanning mesh with request fan-out.
+
+    On the coordinator, :meth:`analyze` first replicates the request to
+    every follower, then runs the inherited pipeline (whose device step
+    all processes enter together). Followers sit in :meth:`follower_loop`
+    replaying broadcast requests until :meth:`shutdown_followers`.
+    """
+
+    def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
+        super().__init__(pattern_sets, config, mesh=mesh, clock=clock)
+        if self._is_multiprocess():
+            # the golden host fallback is UNSAFE here: a device error on
+            # one process would abandon an in-flight collective while the
+            # other processes stay blocked inside it, desynchronizing (or
+            # deadlocking) the mesh. All processes must fail the same
+            # request symmetrically; the server answers with a 500 and the
+            # group stays in lockstep for the next broadcast.
+            self.fallback_to_golden = False
+
+    def _is_multiprocess(self) -> bool:
+        import jax
+
+        return jax.process_count() > 1
+
+    def _is_coordinator(self) -> bool:
+        import jax
+
+        return jax.process_index() == 0
+
+    def analyze(self, data: PodFailureData):
+        if self._is_multiprocess() and self._is_coordinator():
+            payload = json.dumps(
+                {"pod": data.pod, "logs": data.logs, "events": data.events}
+            ).encode("utf-8")
+            broadcast_bytes(payload)
+        return super().analyze(data)
+
+    def follower_loop(self) -> None:
+        """Run on processes > 0: participate in every broadcast request's
+        SPMD dispatches until the coordinator shuts the group down."""
+        if self._is_coordinator():
+            raise RuntimeError("follower_loop must not run on the coordinator")
+        while True:
+            payload = broadcast_bytes(None)
+            if payload == _SHUTDOWN or payload == b"":
+                log.info("follower shutting down")
+                return
+            d = json.loads(payload.decode("utf-8"))
+            data = PodFailureData(
+                pod=d.get("pod"), logs=d.get("logs") or "", events=d.get("events")
+            )
+            try:
+                super().analyze(data)
+            except Exception:
+                # containment: the coordinator saw the same failure on the
+                # same deterministic input and answered the client with a
+                # 500; the follower stays alive for the next request
+                log.exception("follower analyze failed")
+
+    def shutdown_followers(self) -> None:
+        if self._is_multiprocess() and self._is_coordinator():
+            broadcast_bytes(_SHUTDOWN)

@@ -102,6 +102,34 @@ class ShardedFusedStep:
             lambda kl, lines, lens, om, ov, n: self._sharded(kl)(lines, lens, om, ov, n),
             static_argnums=(0,),
         )
+        # one mesh may span multiple processes (parallel/distributed.py);
+        # then inputs must be assembled as global arrays (each process
+        # donating its addressable shards) and outputs gathered across
+        # processes before host assembly
+        self.multiprocess = jax.process_count() > 1
+
+    # ------------------------------------------------- host<->device helpers
+
+    def _put(self, x, spec) -> jax.Array:
+        """Device-put respecting the multi-process mesh: every process holds
+        the full host value (requests are replicated by broadcast), so each
+        donates the shards it addresses."""
+        if not self.multiprocess:
+            return jnp.asarray(x)
+        from jax.sharding import NamedSharding
+
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, NamedSharding(self.mesh, spec), lambda idx: arr[idx]
+        )
+
+    def _host(self, x) -> np.ndarray:
+        """Fetch a (possibly process-spanning) device array to every host."""
+        if not self.multiprocess:
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
     def _sharded(self, k_local: int):
         return shard_map(
@@ -141,11 +169,11 @@ class ShardedFusedStep:
         B = lines_u8.shape[0]
         D = self.n_shards
         cap_local = (B // D) * max(1, self.bank.n_patterns)
-        lines_tb = jnp.asarray(lines_u8.T)
-        lens = jnp.asarray(lengths)
-        om = jnp.asarray(override_mask)
-        ov = jnp.asarray(override_val)
-        n = jnp.asarray(n_lines, dtype=jnp.int32)
+        lines_tb = self._put(np.ascontiguousarray(lines_u8.T), P(None, DATA_AXIS))
+        lens = self._put(lengths, P(DATA_AXIS))
+        om = self._put(override_mask, P(DATA_AXIS, None))
+        ov = self._put(override_val, P(DATA_AXIS, None))
+        n = self._put(np.asarray(n_lines, dtype=np.int32), P())
 
         start = 0
         per_shard_hint = -(-max(1, k_hint) // D)
@@ -154,7 +182,7 @@ class ShardedFusedStep:
         for k_bucket in (*K_LADDER[start:], cap_local):
             k_l = min(k_bucket, cap_local)
             out = self._jit(k_l, lines_tb, lens, om, ov, n)
-            n_per_shard = np.asarray(out[0])
+            n_per_shard = self._host(out[0])
             if n_per_shard.max(initial=0) <= k_l or k_l >= cap_local:
                 return self._assemble(k_l, n_per_shard, out)
         raise AssertionError("unreachable: ladder capped at per-shard B*P")
@@ -163,11 +191,11 @@ class ShardedFusedStep:
         """Concatenate each shard's live records; shard-major order is
         line-major order because line sharding is contiguous."""
         D = self.n_shards
-        line = np.asarray(out[1]).reshape(D, k_l)
-        pat = np.asarray(out[2]).reshape(D, k_l)
-        dist = np.asarray(out[3]).reshape(D, k_l, -1)
-        seq = np.asarray(out[4]).reshape(D, k_l, -1)
-        ctx = np.asarray(out[5]).reshape(D, k_l, -1)
+        line = self._host(out[1]).reshape(D, k_l)
+        pat = self._host(out[2]).reshape(D, k_l)
+        dist = self._host(out[3]).reshape(D, k_l, -1)
+        seq = self._host(out[4]).reshape(D, k_l, -1)
+        ctx = self._host(out[5]).reshape(D, k_l, -1)
         keep = [np.arange(min(int(n), k_l)) for n in n_per_shard]
         return MatchRecords(
             n_matches=int(sum(len(k) for k in keep)),

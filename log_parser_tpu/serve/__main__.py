@@ -32,6 +32,16 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="shard the line batch over every visible device (jax mesh)",
     )
+    # multi-process (DCN) scale-out: one mesh spanning processes. Process 0
+    # serves HTTP and broadcasts each request; the rest follow
+    # (parallel/distributed.py; SURVEY.md §5.8).
+    parser.add_argument(
+        "--coordinator",
+        help="host:port of the jax.distributed coordinator (enables "
+        "multi-process mode; implies --sharded)",
+    )
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -51,8 +61,27 @@ def main(argv: list[str] | None = None) -> int:
         log.error("pattern.directory is required (--pattern-dir / config / env)")
         return 2
 
+    if args.coordinator:
+        if args.num_processes is None or args.process_id is None:
+            log.error("--coordinator requires --num-processes and --process-id")
+            return 2
+        from log_parser_tpu.parallel.distributed import init_distributed
+
+        init_distributed(args.coordinator, args.num_processes, args.process_id)
+
     pattern_sets = load_pattern_directory(config.pattern_directory)
-    if args.sharded:
+    if args.coordinator:
+        from log_parser_tpu.parallel import make_mesh
+        from log_parser_tpu.parallel.distributed import DistributedShardedEngine
+
+        mesh = make_mesh()
+        engine = DistributedShardedEngine(pattern_sets, config, mesh=mesh)
+        log.info(
+            "Multi-process mesh: %d devices across %d processes",
+            mesh.devices.size,
+            args.num_processes,
+        )
+    elif args.sharded:
         from log_parser_tpu.parallel import ShardedEngine, make_mesh
 
         mesh = make_mesh()
@@ -71,7 +100,22 @@ def main(argv: list[str] | None = None) -> int:
         sum(1 for c in engine.bank.columns if c.dfa is not None),
     )
 
-    server = make_server(engine, args.host, args.port)
+    if args.coordinator and args.process_id != 0:
+        # followers own no network surface: they replay the coordinator's
+        # broadcast requests so every process enters each SPMD dispatch
+        log.info("Follower %d ready", args.process_id)
+        engine.follower_loop()
+        return 0
+
+    try:
+        server = make_server(engine, args.host, args.port)
+    except OSError:
+        # followers are already blocked waiting for a broadcast; a
+        # coordinator that dies without the shutdown sentinel would hang
+        # the whole group
+        if args.coordinator:
+            engine.shutdown_followers()
+        raise
     log.info("Serving POST /parse on %s:%d", args.host, args.port)
     try:
         server.serve_forever()
@@ -79,6 +123,12 @@ def main(argv: list[str] | None = None) -> int:
         log.info("Shutting down")
     finally:
         server.server_close()
+        if args.coordinator:
+            # under the analyze lock: a daemon handler thread may still be
+            # mid-broadcast inside analyze(); interleaving the shutdown
+            # sentinel with a request broadcast would desync the followers
+            with server.analyze_lock:
+                engine.shutdown_followers()
     return 0
 
 

@@ -36,19 +36,23 @@ EXCLUDE_NAMES = {"ADVICE.md", "VERDICT.md", "COPYCHECK.json", "PROGRESS.jsonl"}
 EXCLUDE_PREFIXES = ("BENCH_r", "MULTICHIP_r")
 
 
+def excluded(p: Path) -> bool:
+    return (
+        bool(EXCLUDE_PARTS.intersection(p.parts))
+        or p.name in EXCLUDE_NAMES
+        or p.name.startswith(EXCLUDE_PREFIXES)
+    )
+
+
 def tracked_files(root: Path) -> list[Path]:
     out = subprocess.run(
         ["git", "ls-files"], cwd=root, capture_output=True, text=True, check=True
     )
-    files = []
-    for rel in out.stdout.splitlines():
-        p = root / rel
-        if not p.is_file() or EXCLUDE_PARTS.intersection(p.parts):
-            continue
-        if p.name in EXCLUDE_NAMES or p.name.startswith(EXCLUDE_PREFIXES):
-            continue
-        files.append(p)
-    return files
+    return [
+        p
+        for rel in out.stdout.splitlines()
+        if (p := root / rel).is_file() and not excluded(p)
+    ]
 
 
 def check_file(path: Path, fix: bool) -> list[str]:
@@ -76,8 +80,11 @@ def check_file(path: Path, fix: bool) -> list[str]:
         if not text.endswith("\n") or text.endswith("\n\n"):
             problems.append(f"{path}: must end with exactly one newline")
     if fix and problems and fixed:
+        # mechanical rewrite; the content checks below still run on the
+        # fixed text (a --fix pass must not mask YAML/syntax violations)
         path.write_text(fixed, encoding="utf-8")
-        return []  # mechanically fixed
+        problems = []
+        text = fixed
 
     if path.suffix in (".yml", ".yaml"):
         import yaml
@@ -105,7 +112,13 @@ def main() -> int:
     args = ap.parse_args()
 
     root = Path(__file__).resolve().parents[1]
-    files = [Path(p).resolve() for p in args.paths] if args.paths else tracked_files(root)
+    # explicit paths (pre-commit's pass_filenames) honor the same
+    # exclusions as the full scan — the two gates must agree on one tree
+    files = (
+        [q for p in args.paths if not excluded(q := Path(p).resolve())]
+        if args.paths
+        else tracked_files(root)
+    )
 
     problems: list[str] = []
     for path in files:
