@@ -1,0 +1,89 @@
+"""Anchor the reference docs' worked scoring example.
+
+``/root/reference/docs/SCORING_ALGORITHM.md`` ("Example Calculation",
+lines 193-208) walks one event through the seven-factor formula:
+
+    Final Score = 0.8 x 3.0 x 2.1 x 1.4 x 1.0 x 1.5 x (1.0 - 0.0) = 21.17
+
+Two things are pinned here:
+
+1. The product of the doc's own stated factors is 10.584 — the printed
+   21.17 is exactly ``2 x 10.584 = 21.168`` rounded to two places, an
+   arithmetic slip in the reference doc.  Both facts are asserted so the
+   discrepancy is on record rather than silently "fixed" either way.
+
+2. An end-to-end scenario engineered so every factor is analytically
+   exact under the reference formulas (ScoringService.java:100-150,
+   ContextAnalysisService.java:56-116) — chronological exactly 2.1
+   (position 8% through a 100-line log), proximity ``1 + 0.6*e^{-3/10}``
+   (one secondary at distance 3, weight 0.6, decay constant 10), temporal
+   1.0 (no sequences), context 2.0 (two ERROR lines + one stack-trace
+   line -> score 0.4+0.4+0.1+min(0.1,0.5)=1.0), frequency penalty 0.0
+   (first sighting, threshold 10).  The device engine and the golden
+   analyzer must both reproduce the hand-computed IEEE-double product.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine
+from tests.conftest import FakeClock
+from tests.helpers import make_pattern, make_pattern_set
+
+
+def test_doc_example_factor_product():
+    # SCORING_ALGORITHM.md:193-208 — the stated factors...
+    product = 0.8 * 3.0 * 2.1 * 1.4 * 1.0 * 1.5 * (1.0 - 0.0)
+    assert product == pytest.approx(10.584, abs=1e-12)
+    # ...and the doc's printed total, which is exactly twice their product.
+    assert round(2 * product, 2) == 21.17
+
+
+def _example_fixture():
+    pattern = make_pattern(
+        pattern_id="doc-example",
+        regex="OOMKILL detected",
+        confidence=0.8,
+        severity="HIGH",
+        secondaries=[("HEAPDUMP written", 0.6, 10)],
+        context=(3, 3),
+    )
+    lines = [f"reconcile tick {i} status=ok" for i in range(100)]
+    lines[5] = "first ERROR in context"
+    lines[6] = "second ERROR in context"
+    lines[7] = "  at com.example.Foo.bar(Foo.java:17)"
+    lines[8] = "OOMKILL detected"  # 1-based line 9 -> position 8/100 = 0.08
+    lines[11] = "HEAPDUMP written"  # distance 3 from the primary
+    return [make_pattern_set([pattern])], "\n".join(lines)
+
+
+def _expected_score() -> float:
+    # Hand-computed in the reference's own double-op order
+    # (ScoringService.java:100-109).
+    chrono = 1.5 + (0.2 - 0.08) * ((2.5 - 1.5) / 0.2)  # = 2.1
+    proximity = 1.0 + 0.6 * math.exp(-3.0 / 10.0)  # ~1.4445
+    context = 1.0 + (0.4 + 0.4 + 0.1 + 0.1)  # = 2.0
+    return 0.8 * 3.0 * chrono * proximity * 1.0 * context * (1.0 - 0.0)
+
+
+@pytest.mark.parametrize("engine_cls", [AnalysisEngine, GoldenAnalyzer])
+def test_doc_example_end_to_end(engine_cls):
+    sets, log_text = _example_fixture()
+    engine = engine_cls(sets, ScoringConfig(), clock=FakeClock())
+    result = engine.analyze(
+        PodFailureData(pod={"metadata": {"name": "doc-example"}}, logs=log_text)
+    )
+    events = result.events
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.line_number == 9
+    assert ev.score == pytest.approx(_expected_score(), abs=1e-12)
+    # With the doc's loose "~" factor values replaced by the exact formula
+    # outputs, the example's true final score:
+    assert ev.score == pytest.approx(14.56046860, abs=1e-6)
