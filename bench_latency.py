@@ -20,6 +20,14 @@ import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
 BATCH_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 512
 REQUESTS = int(sys.argv[sys.argv.index("--requests") + 1]) if "--requests" in sys.argv else 60
 USE_HTTP = "--http" in sys.argv
+# N concurrent clients: measures how well the pipelined serving path
+# (engine.analyze_pipelined) overlaps ingest/device work across requests;
+# 1 = the sequential stream
+CONCURRENCY = (
+    int(sys.argv[sys.argv.index("--concurrency") + 1])
+    if "--concurrency" in sys.argv
+    else 1
+)
 
 
 def micro_batch(i: int, n: int) -> str:
@@ -43,11 +51,11 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 
 
 def main() -> None:
-    platform = bench_common.probe_backend(
-        f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
-        + ("_http" if USE_HTTP else ""),
-        "ms",
-    )
+    suffix = "_http" if USE_HTTP else ""
+    if CONCURRENCY > 1:
+        suffix += f"_c{CONCURRENCY}"
+    metric = f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch" + suffix
+    platform = bench_common.probe_backend(metric, "ms")
 
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
@@ -80,26 +88,52 @@ def main() -> None:
                 json.load(resp)
     else:
         def run_one(i: int) -> None:
-            engine.analyze(
-                PodFailureData(
-                    pod={"metadata": {"name": "stream"}},
-                    logs=micro_batch(i, BATCH_LINES),
-                )
+            data = PodFailureData(
+                pod={"metadata": {"name": "stream"}},
+                logs=micro_batch(i, BATCH_LINES),
             )
+            # the direct path must also go through the thread-safe entry
+            # point when clients are concurrent: bare analyze() has no
+            # internal locking and would race frequency state
+            if CONCURRENCY > 1:
+                engine.analyze_pipelined(data)
+            else:
+                engine.analyze(data)
 
     for i in range(3):  # warmup: compile every shape bucket the stream hits
         run_one(i)
 
-    lat = []
-    for i in range(REQUESTS):
-        t0 = time.perf_counter()
-        run_one(i)
-        lat.append((time.perf_counter() - t0) * 1e3)
+    lat: list[float] = []
+    if CONCURRENCY > 1:
+        import threading
+
+        chunks = [list(range(c, REQUESTS, CONCURRENCY)) for c in range(CONCURRENCY)]
+        per_thread: list[list[float]] = [[] for _ in range(CONCURRENCY)]
+
+        def client(c: int) -> None:
+            for i in chunks[c]:
+                t0 = time.perf_counter()
+                run_one(i)
+                per_thread[c].append((time.perf_counter() - t0) * 1e3)
+
+        threads = [
+            threading.Thread(target=client, args=(c,)) for c in range(CONCURRENCY)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for vals in per_thread:
+            lat.extend(vals)
+    else:
+        for i in range(REQUESTS):
+            t0 = time.perf_counter()
+            run_one(i)
+            lat.append((time.perf_counter() - t0) * 1e3)
     lat.sort()
 
     bench_common.emit(
-        f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
-        + ("_http" if USE_HTTP else ""),
+        metric,
         round(percentile(lat, 0.99), 3),
         "ms",
         round(percentile(lat, 0.50), 3),

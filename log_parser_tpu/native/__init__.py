@@ -69,6 +69,24 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lpn_dfa_read.restype = None
     lib.lpn_dfa_free.argtypes = [ctypes.c_void_p]
     lib.lpn_dfa_free.restype = None
+
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.lpn_multi_dfa_build.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        i64p, i8p, i32p,            # eps CSR
+        i64p, i32p, i32p,           # trans CSR
+        u8p, ctypes.c_int32, u8p,   # bytesets, n_bytesets, word mask
+        i32p, ctypes.c_int32,       # finals, n_patterns
+        ctypes.c_int32, ctypes.c_int32,  # max_states, do_minimize
+        i32p, i32p, i32p, i32p, i32p,  # out n_states/n_classes/n_words/start/err
+    ]
+    lib.lpn_multi_dfa_build.restype = ctypes.c_void_p
+    lib.lpn_multi_dfa_read.argtypes = [
+        ctypes.c_void_p, i32p, i32p, i32p, u32p, u32p,
+    ]
+    lib.lpn_multi_dfa_read.restype = None
+    lib.lpn_multi_dfa_free.argtypes = [ctypes.c_void_p]
+    lib.lpn_multi_dfa_free.restype = None
     return lib
 
 

@@ -38,15 +38,8 @@ class DfaLimitExceeded(Exception):
     pass
 
 
-def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
-    """(trans, byte_class, accept_end, start) or None if lib unavailable.
-
-    Raises :class:`DfaLimitExceeded` on state blowup.
-    """
-    lib = get_lib()
-    if lib is None:
-        return None
-
+def _serialize_nfa(nfa: Nfa):
+    """Flatten an Nfa into the CSR arrays the C ABI consumes."""
     n = nfa.n_states
     # epsilon CSR
     eps_off = np.zeros(n + 1, dtype=np.int64)
@@ -79,9 +72,28 @@ def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
     bytesets = (
         np.concatenate(masks) if masks else np.zeros(32, dtype=np.uint8)
     ).astype(np.uint8)
+    return eps_off, eps_cond_a, eps_dst_a, t_off, t_bs_a, t_dst_a, bytesets, len(masks)
 
-    def p(arr, ctype):
-        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+def _p(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
+    """(trans, byte_class, accept_end, start) or None if lib unavailable.
+
+    Raises :class:`DfaLimitExceeded` on state blowup.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    n = nfa.n_states
+    (
+        eps_off, eps_cond_a, eps_dst_a, t_off, t_bs_a, t_dst_a, bytesets, n_bs
+    ) = _serialize_nfa(nfa)
+
+    p = _p
 
     out_ns = ctypes.c_int32(0)
     out_nc = ctypes.c_int32(0)
@@ -93,7 +105,7 @@ def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
         p(eps_dst_a, ctypes.c_int32),
         p(t_off, ctypes.c_int64), p(t_bs_a, ctypes.c_int32),
         p(t_dst_a, ctypes.c_int32),
-        p(bytesets, ctypes.c_uint8), len(masks),
+        p(bytesets, ctypes.c_uint8), n_bs,
         p(_WORD_MASK, ctypes.c_uint8),
         max_states, int(minimize),
         ctypes.byref(out_ns), ctypes.byref(out_nc), ctypes.byref(out_start),
@@ -117,3 +129,66 @@ def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
     finally:
         lib.lpn_dfa_free(handle)
     return trans, byte_class, accept.astype(bool), out_start.value
+
+
+def build_multi_dfa_native(
+    nfa: Nfa, finals: list[int], max_states: int = 8192, minimize: bool = True
+):
+    """Union multi-pattern subset construction (multidfa.py, native path).
+
+    ``nfa`` is the MERGED union arena (multidfa._merge_nfas); ``finals[i]``
+    is pattern i's final state. Returns (trans, byte_class, cls_word, out2,
+    accept_words, start) or None if the lib is unavailable; raises
+    :class:`DfaLimitExceeded` on state blowup.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    (
+        eps_off, eps_cond_a, eps_dst_a, t_off, t_bs_a, t_dst_a, bytesets, n_bs
+    ) = _serialize_nfa(nfa)
+    finals_a = np.asarray(finals, dtype=np.int32)
+    n_patterns = len(finals)
+
+    p = _p
+    out_ns = ctypes.c_int32(0)
+    out_nc = ctypes.c_int32(0)
+    out_nw = ctypes.c_int32(0)
+    out_start = ctypes.c_int32(0)
+    err = ctypes.c_int32(0)
+    handle = lib.lpn_multi_dfa_build(
+        nfa.n_states, nfa.start,
+        p(eps_off, ctypes.c_int64), p(eps_cond_a, ctypes.c_int8),
+        p(eps_dst_a, ctypes.c_int32),
+        p(t_off, ctypes.c_int64), p(t_bs_a, ctypes.c_int32),
+        p(t_dst_a, ctypes.c_int32),
+        p(bytesets, ctypes.c_uint8), n_bs,
+        p(_WORD_MASK, ctypes.c_uint8),
+        p(finals_a, ctypes.c_int32), n_patterns,
+        max_states, int(minimize),
+        ctypes.byref(out_ns), ctypes.byref(out_nc), ctypes.byref(out_nw),
+        ctypes.byref(out_start), ctypes.byref(err),
+    )
+    if not handle:
+        if err.value == 1:
+            raise DfaLimitExceeded(max_states)
+        return None
+    try:
+        ns, nc, nw = out_ns.value, out_nc.value, out_nw.value
+        trans = np.zeros((ns, nc), dtype=np.int32)
+        byte_class = np.zeros(256, dtype=np.int32)
+        cls_word = np.zeros(nc, dtype=np.int32)
+        out2 = np.zeros((ns * 2, nw), dtype=np.uint32)
+        accept_words = np.zeros((ns, nw), dtype=np.uint32)
+        lib.lpn_multi_dfa_read(
+            handle,
+            p(trans, ctypes.c_int32),
+            p(byte_class, ctypes.c_int32),
+            p(cls_word, ctypes.c_int32),
+            p(out2, ctypes.c_uint32),
+            p(accept_words, ctypes.c_uint32),
+        )
+    finally:
+        lib.lpn_multi_dfa_free(handle)
+    return trans, byte_class, cls_word, out2, accept_words, out_start.value

@@ -236,6 +236,53 @@ def compact_records(
     return n_matches.astype(jnp.int32), rec_line, rec_pat, rec_dist, rec_seq, rec_ctx
 
 
+def pack_records(n_matches, rec_line, rec_pat, rec_dist, rec_seq, rec_ctx):
+    """Concatenate one batch's record buffers into a single flat int32
+    array: [n, line(K), pattern(K), sec_dist(K*S), seq_ok(K*Q), ctx(K*5)].
+
+    One array == ONE device-to-host copy at resolve time. Through the
+    tunneled single-chip backend every transfer is a network round-trip,
+    and the 6-array layout made each request pay ~6 RTTs — the dominant
+    term of the measured 489ms p99 (bench_results/config5_direct_tpu)."""
+    return jnp.concatenate(
+        [
+            n_matches.reshape(1),
+            rec_line,
+            rec_pat,
+            rec_dist.reshape(-1),
+            rec_seq.astype(jnp.int32).reshape(-1),
+            rec_ctx.reshape(-1),
+        ]
+    )
+
+
+def unpack_records(arr: np.ndarray, s_w: int, q_w: int) -> MatchRecords | None:
+    """Host-side inverse of :func:`pack_records`; None signals K overflow."""
+    width = 2 + s_w + q_w + 5
+    K = (arr.shape[0] - 1) // width
+    n_matches = int(arr[0])
+    if n_matches > K:
+        return None
+    off = 1
+    line = arr[off : off + K]
+    off += K
+    pattern = arr[off : off + K]
+    off += K
+    sec_dist = arr[off : off + K * s_w].reshape(K, s_w)
+    off += K * s_w
+    seq_ok = arr[off : off + K * q_w].reshape(K, q_w).astype(bool)
+    off += K * q_w
+    ctx_counts = arr[off : off + K * 5].reshape(K, 5)
+    return MatchRecords(
+        n_matches=n_matches,
+        line=line,
+        pattern=pattern,
+        sec_dist=sec_dist,
+        seq_ok=seq_ok,
+        ctx_counts=ctx_counts,
+    )
+
+
 class FusedMatchScore:
     """Single-device fused program: bytes → DFA cube → integer match records.
 
@@ -291,20 +338,12 @@ class FusedMatchScore:
             start += 1
         return [min(k, cap) for k in (*K_LADDER[start:], cap)], cap
 
-    @staticmethod
-    def resolve(out) -> MatchRecords | None:
-        """Synchronize one dispatch; None signals K overflow (re-dispatch
-        at the next ladder rung)."""
-        n_matches = int(out[0])
-        if n_matches > out[1].shape[0]:
-            return None
-        return MatchRecords(
-            n_matches=n_matches,
-            line=np.asarray(out[1]),
-            pattern=np.asarray(out[2]),
-            sec_dist=np.asarray(out[3]),
-            seq_ok=np.asarray(out[4]),
-            ctx_counts=np.asarray(out[5]),
+    def resolve(self, out) -> MatchRecords | None:
+        """Synchronize one dispatch — a single packed-array transfer —
+        and unpack; None signals K overflow (re-dispatch at the next
+        ladder rung)."""
+        return unpack_records(
+            np.asarray(out), max(1, self.t.s_max), max(1, self.t.q_max)
         )
 
     def run(
@@ -349,7 +388,7 @@ class FusedMatchScore:
 
         if P == 0:
             z32 = jnp.zeros((K,), jnp.int32)
-            return (
+            return pack_records(
                 jnp.int32(0),
                 z32,
                 z32,
@@ -375,8 +414,8 @@ class FusedMatchScore:
         ctx_counts = self._context_counts(cube, row_idx, B, n_lines)  # [B, U, 5]
 
         # single-device: emit and gather coordinates coincide
-        return compact_records(
-            K, pm, t, row_idx, row_idx, sec_dist, seq_ok, ctx_counts
+        return pack_records(
+            *compact_records(K, pm, t, row_idx, row_idx, sec_dist, seq_ok, ctx_counts)
         )
 
     # ------------------------------------------------------------ dense tables

@@ -30,6 +30,14 @@ from log_parser_tpu.patterns.regex.dfa import CompiledDfa
 PAIR_TABLE_MAX_ENTRIES = 64 << 20
 
 
+def unpack_hit_words(h: jax.Array, n_cols: int) -> jax.Array:
+    """uint32 [N, W] per-column hit words -> bool [N, n_cols] (shared by
+    the union multi-DFA and AC prefilter tiers)."""
+    cols = jnp.arange(n_cols, dtype=jnp.int32)
+    word = h[:, cols // 32]
+    return (word >> (cols % 32).astype(jnp.uint32)) & 1 > 0
+
+
 def pack_byte_pairs(lines_tb: jax.Array):
     """uint8 [T, B] -> ([T2, 2, B] byte pairs, [T2] step indexes), padding
     T to even so every scan step consumes exactly two bytes."""
@@ -181,6 +189,125 @@ class DfaBank:
         return np.asarray(out)[:, : self.n_regexes]
 
 
+class MultiDfaBank:
+    """One union multi-pattern DFA group on device (multidfa.py).
+
+    R patterns ride ONE automaton. The hot scan is TWO ``[B]`` gathers per
+    byte — byte class, then a packed transition word carrying a "this
+    state can report a match" flag in bit 30 — cost independent of R, vs
+    the dense tier's ``[B, R]`` gather (measured ~150ms/regex/200k lines
+    on TPU v5e, PERF.md). Exact per-pattern hit words are recovered after
+    the scan by re-scanning ONLY the flagged rows (matching log lines are
+    rare) through the full output-word tables, with an in-program
+    ``lax.cond`` dense re-scan when the flagged-row capacity overflows —
+    the same robustness shape as the prefilter tier.
+
+    Steps one byte at a time: a pair-precomposed table would be S·C² and
+    the union automaton's C is large.
+    """
+
+    _REPORT_BIT = 1 << 30
+    _STATE_MASK = _REPORT_BIT - 1
+
+    def __init__(self, md, cols: list[int]):
+        self.cols = cols  # global column ids, bit order
+        self.n_cols = len(cols)
+        self.n_words = md.n_words
+        S, C = md.trans.shape
+        self.n_states, self.n_classes = S, C
+        self.byte_class = jnp.asarray(md.byte_class)
+        # word-ness per BYTE (precomposed through the class map): the out2
+        # row index is state*2 + word-ness of the incoming byte
+        self.byte_rw = jnp.asarray(md.cls_is_word[md.byte_class])
+        self.out2 = jnp.asarray(md.out2)  # [S*2, W] uint32
+        self.accept_words = jnp.asarray(md.accept_words)  # [S, W] uint32
+        self.start = int(md.start)
+
+        # reporting flags: state may emit out bits under either word-ness,
+        # or accept at end-of-input — conservative OR so the flag alone
+        # decides whether a row needs the exact second pass
+        reports = (
+            md.out2.reshape(S, 2, md.n_words).any(axis=(1, 2))
+            | md.accept_words.any(axis=1)
+        )
+        packed = md.trans.astype(np.int64) | (
+            reports.astype(np.int64)[md.trans] << 30
+        )
+        self.flat_packed = jnp.asarray(packed.reshape(-1).astype(np.int32))
+        self.start_reports = bool(reports[md.start])
+
+    # ------------------------------------------------------- hot scan stage
+
+    def pair_stepper(self, B: int, lengths: jax.Array):
+        """(init, step(carry, b1, b2, t), finish_carry) — carry is
+        (state [B] int32, reported [B] bool). The cube slice is produced
+        by :meth:`contribution` from the finished carry."""
+        C = self.n_classes
+        init = (
+            jnp.full((B,), self.start, jnp.int32),
+            jnp.full((B,), self.start_reports, bool),
+        )
+
+        def one(s, rep, b, ok):
+            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
+            v = jnp.take(self.flat_packed, s * C + cls)
+            nxt = v & self._STATE_MASK
+            flag = v >= self._REPORT_BIT
+            s = jnp.where(ok, nxt, s)
+            rep = rep | (ok & flag)
+            return s, rep
+
+        def step(carry, b1, b2, t):
+            s, rep = carry
+            p0 = 2 * t
+            s, rep = one(s, rep, b1, p0 < lengths)
+            s, rep = one(s, rep, b2, p0 + 1 < lengths)
+            return (s, rep)
+
+        def finish(carry):
+            return carry
+
+        return init, step, finish
+
+    # ------------------------------------------------- exact recovery stage
+
+    def word_stepper(self, N: int, lengths: jax.Array):
+        """Composable pair-stepper for the exact out-word pass. Carry:
+        (state [N] int32, hit_words [N, W] uint32)."""
+        C = self.n_classes
+        init = (
+            jnp.full((N,), self.start, jnp.int32),
+            jnp.zeros((N, self.n_words), jnp.uint32),
+        )
+
+        def one(s, h, b, ok):
+            b32 = b.astype(jnp.int32)
+            cls = jnp.take(self.byte_class, b32)
+            rw = jnp.take(self.byte_rw, b32)
+            ow = jnp.take(self.out2, s * 2 + rw, axis=0)  # [N, W]
+            h = h | jnp.where(ok[:, None], ow, jnp.uint32(0))
+            v = jnp.take(self.flat_packed, s * C + cls)
+            s = jnp.where(ok, v & self._STATE_MASK, s)
+            return s, h
+
+        def step(carry, b1, b2, t):
+            s, h = carry
+            p0 = 2 * t
+            s, h = one(s, h, b1, p0 < lengths)
+            s, h = one(s, h, b2, p0 + 1 < lengths)
+            return (s, h)
+
+        def finish(carry):
+            s, h = carry
+            return h | jnp.take(self.accept_words, s, axis=0)
+
+        return init, step, finish
+
+    def unpack(self, h: jax.Array) -> jax.Array:
+        """uint32 [N, W] hit words -> bool [N, n_cols]."""
+        return unpack_hit_words(h, self.n_cols)
+
+
 class AcRunner:
     """Combined Aho-Corasick literal prefilter on device."""
 
@@ -231,15 +358,35 @@ class MatcherBanks:
     them as cube overrides).
     """
 
-    # below this many device columns, the whole bank rides the pair-stride
-    # DFA alone: the [B, R] transition gather is small, and adding the
-    # Shift-Or stage to the scan costs more than the width it removes.
-    # Wide banks (the 10k-regex configuration) move every literal-shaped
-    # column to Shift-Or, whose per-step cost is O(packed words), not O(R).
+    # CPU thresholds. Below this many device columns, the whole bank rides
+    # the pair-stride DFA alone: the [B, R] transition gather is small, and
+    # adding the Shift-Or stage to the scan costs more than the width it
+    # removes. Wide banks (the 10k-regex configuration) move every
+    # literal-shaped column to Shift-Or, whose per-step cost is O(packed
+    # words), not O(R).
     SHIFTOR_MIN_COLUMNS = 64
     # below this many DENSE-DFA columns, the prefilter tier stays off: the
     # dense gather is cheap and the extra scans aren't worth their latency
     PREFILTER_MIN_COLUMNS = 64
+
+    # TPU thresholds. Measured on v5e (tools/profile_fused.py, 229k-row
+    # batch, PERF.md): a dense-DFA column costs ~150ms per 200k lines —
+    # the [B, R] transition gather is scalar-unit bound — while a Shift-Or
+    # column costs ~3ms and the AC words tier has a fixed cost of roughly
+    # eight dense columns. Literal-shaped columns therefore ALWAYS ride
+    # Shift-Or, and the prefilter engages at 8 eligible columns.
+    SHIFTOR_MIN_COLUMNS_TPU = 1
+    PREFILTER_MIN_COLUMNS_TPU = 8
+
+    # Union multi-DFA tier (platform-independent: one [B] gather per byte
+    # beats a [B, R] gather for R >= 2 everywhere; the native builder makes
+    # group packing cheap). MULTI_MAX_TOTAL_COLS bounds packing time on
+    # degenerate many-thousand-regex banks — the overflow keeps its
+    # prefilter/dense tier.
+    MULTI_MIN_COLUMNS = 2
+    MULTI_STATE_BUDGET = 8192
+    MULTI_MAX_GROUP = 64
+    MULTI_MAX_TOTAL_COLS = 512
 
     def __init__(
         self,
@@ -247,23 +394,28 @@ class MatcherBanks:
         stride: int = 2,
         shiftor_min_columns: int | None = None,
         prefilter_min_columns: int | None = None,
+        multi_min_columns: int | None = None,
     ):
         import jax.numpy as jnp
 
+        from log_parser_tpu.native import get_lib
         from log_parser_tpu.ops.prefilter import PrefilterBank
         from log_parser_tpu.ops.shiftor import ShiftOrBank
 
         self.bank = bank
-        threshold = (
-            self.SHIFTOR_MIN_COLUMNS
-            if shiftor_min_columns is None
-            else shiftor_min_columns
-        )
-        pref_threshold = (
-            self.PREFILTER_MIN_COLUMNS
-            if prefilter_min_columns is None
-            else prefilter_min_columns
-        )
+        on_tpu = jax.default_backend() == "tpu"
+        threshold = shiftor_min_columns
+        if threshold is None:
+            threshold = (
+                self.SHIFTOR_MIN_COLUMNS_TPU if on_tpu else self.SHIFTOR_MIN_COLUMNS
+            )
+        pref_threshold = prefilter_min_columns
+        if pref_threshold is None:
+            pref_threshold = (
+                self.PREFILTER_MIN_COLUMNS_TPU
+                if on_tpu
+                else self.PREFILTER_MIN_COLUMNS
+            )
         n_device = sum(
             1
             for c in bank.columns
@@ -286,6 +438,39 @@ class MatcherBanks:
             for i, c in enumerate(bank.columns)
             if c.dfa is None and c.exact_seqs is None
         ]
+
+        # union multi-DFA tier: pack remaining DFA columns into as few
+        # union automata as the state budget allows — each group matches
+        # its R patterns with ONE [B] gather per byte (multidfa.py). The
+        # construction is native C++; without the lib the packing probes
+        # would run the Python subset builder at O(seconds) per probe, so
+        # the tier stays off and columns keep their prior tiers.
+        multi_threshold = (
+            self.MULTI_MIN_COLUMNS
+            if multi_min_columns is None
+            else multi_min_columns
+        )
+        self.multi_groups: list[MultiDfaBank] = []
+        if len(dense_cols) >= multi_threshold and get_lib() is not None:
+            from log_parser_tpu.patterns.regex.multidfa import pack_union_groups
+
+            take = dense_cols[: self.MULTI_MAX_TOTAL_COLS]
+            entries = [
+                (i, bank.columns[i].regex, bank.columns[i].case_insensitive)
+                for i in take
+            ]
+            groups, rejected_entries = pack_union_groups(
+                entries,
+                max_states=self.MULTI_STATE_BUDGET,
+                max_group=self.MULTI_MAX_GROUP,
+            )
+            self.multi_groups = [
+                MultiDfaBank(md, keys) for keys, md in groups
+            ]
+            taken = set(take)
+            dense_cols = [k for k, _, _ in rejected_entries] + [
+                i for i in dense_cols if i not in taken
+            ]
 
         # prefilter tier: DFA columns with a non-empty required-literal set,
         # engaged only for wide banks and within the trie budget
@@ -316,8 +501,17 @@ class MatcherBanks:
         self._jnp = jnp
 
     @property
+    def multi_cols(self) -> list[int]:
+        return [c for g in self.multi_groups for c in g.cols]
+
+    @property
     def device_cols(self) -> list[int]:
-        return self.shiftor_cols + self.dfa_cols + self.prefilter_cols
+        return (
+            self.shiftor_cols
+            + self.dfa_cols
+            + self.multi_cols
+            + self.prefilter_cols
+        )
 
     def cube(self, lines_tb, lengths):
         """uint8 [T, B] + lengths -> bool [B, n_columns] match cube
@@ -339,9 +533,13 @@ class MatcherBanks:
             steppers.append(
                 (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
             )
+        for group in self.multi_groups:
+            steppers.append(
+                (group.pair_stepper(B, lengths), group, False)
+            )
         if self.prefilter is not None:
             steppers.append(
-                (self.prefilter.anyhit_stepper(B, lengths), None, False)
+                (self.prefilter.words_stepper(B, lengths), None, False)
             )
         if not steppers:
             return cube
@@ -358,15 +556,81 @@ class MatcherBanks:
             return new, None
 
         finals, _ = jax.lax.scan(fused_step, inits, (pairs, ts))
+        multi_reps: list[jax.Array] = []
         for (stepper, cols, is_dfa), carry in zip(steppers, finals):
             out = stepper[2](carry)
-            if cols is None:  # prefilter: any-hit bits -> stages 2+3
+            if cols is None:  # prefilter: hit words -> verify stage
                 contrib = self.prefilter.contribution(lines_tb, lengths, out)
                 cube = cube.at[
                     :, jnp.asarray(np.asarray(self.prefilter_cols))
                 ].set(contrib)
                 continue
+            if isinstance(cols, MultiDfaBank):  # (state, reported) carry
+                multi_reps.append(out[1])
+                continue
             if is_dfa:
                 out = out[:, : len(cols)]
             cube = cube.at[:, jnp.asarray(np.asarray(cols))].set(out)
+        if multi_reps:
+            cube = self._multi_contribution(cube, lines_tb, lengths, multi_reps)
         return cube
+
+    def _multi_word_pass(self, lines_tb, lengths, N: int):
+        """ONE fused scan advancing every union group's exact out-word
+        machinery over ``lines_tb``; returns the per-group hit words."""
+        jnp = self._jnp
+        steppers = [g.word_stepper(N, lengths) for g in self.multi_groups]
+        pairs, ts = pack_byte_pairs(lines_tb)
+
+        def step(carries, xs):
+            pair, t = xs
+            return tuple(
+                st[1](c, pair[0], pair[1], t)
+                for st, c in zip(steppers, carries)
+            ), None
+
+        finals, _ = jax.lax.scan(
+            step, tuple(st[0] for st in steppers), (pairs, ts)
+        )
+        return [st[2](c) for st, c in zip(steppers, finals)]
+
+    def _multi_contribution(self, cube, lines_tb, lengths, multi_reps):
+        """Exact per-pattern bits for every union group via ONE shared
+        second pass over the union of flagged rows (matching lines are
+        rare), falling back in-program to a full-batch word pass when the
+        flagged-row capacity overflows. Sharing one compaction across
+        groups keeps the compiled program at two extra scans total,
+        whatever the group count."""
+        from log_parser_tpu.ops.prefilter import _compact
+
+        jnp = self._jnp
+        T, B = lines_tb.shape
+        rep_any = multi_reps[0]
+        for r in multi_reps[1:]:
+            rep_any = rep_any | r
+        K = min(B, max(1024, B // 64))
+        n_rep, rows, valid = _compact(rep_any, K)
+
+        def scatter(cube, bits_per_group, row_idx, valid_mask):
+            safe = jnp.where(valid_mask, row_idx, B)
+            for g, bits in zip(self.multi_groups, bits_per_group):
+                out = jnp.zeros((B + 1, g.n_cols), bool)
+                out = out.at[safe].set(bits & valid_mask[:, None])[:B]
+                cube = cube.at[:, jnp.asarray(np.asarray(g.cols))].set(out)
+            return cube
+
+        def sparse(cube):
+            sub_len = jnp.where(valid, lengths[rows], 0)
+            words = self._multi_word_pass(lines_tb[:, rows], sub_len, K)
+            bits = [g.unpack(h) for g, h in zip(self.multi_groups, words)]
+            return scatter(cube, bits, rows, valid)
+
+        def dense(cube):
+            words = self._multi_word_pass(lines_tb, lengths, B)
+            for g, h in zip(self.multi_groups, words):
+                cube = cube.at[:, jnp.asarray(np.asarray(g.cols))].set(
+                    g.unpack(h)
+                )
+            return cube
+
+        return jax.lax.cond(n_rep <= K, sparse, dense, cube)

@@ -126,6 +126,16 @@ class DistributedShardedEngine(ShardedEngine):
             broadcast_bytes(payload)
         return super().analyze(data)
 
+    def analyze_pipelined(self, data: PodFailureData):
+        """Multi-process requests cannot pipeline: each request is a
+        broadcast + lockstep SPMD dispatch on every process, so two
+        concurrent prepare phases would interleave their broadcasts and
+        desync the mesh. Serialize the whole request instead."""
+        if self._is_multiprocess():
+            with self.state_lock:
+                return self.analyze(data)
+        return super().analyze_pipelined(data)
+
     def follower_loop(self) -> None:
         """Run on processes > 0: participate in every broadcast request's
         SPMD dispatches until the coordinator shuts the group down."""

@@ -27,7 +27,9 @@ record stream (read-before-record, ScoringService.java:84-88).
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import time
 import uuid
 from typing import Callable
@@ -114,6 +116,21 @@ def is_device_error(exc: BaseException) -> bool:
     return False
 
 
+_NULL_LOCK = contextlib.nullcontext()
+
+
+class _Prepared:
+    """One request's prepare-phase outputs, handed to the finish phase."""
+
+    __slots__ = ("start", "trace", "corpus", "recs")
+
+    def __init__(self, start, trace, corpus, recs):
+        self.start = start
+        self.trace = trace
+        self.corpus = corpus
+        self.recs = recs
+
+
 class AnalysisEngine:
     """Immutable compiled library + one fused device program + frequency state."""
 
@@ -143,6 +160,11 @@ class AnalysisEngine:
         self.tables = FusedStaticTables(self.bank, self.config)
         self._matchers: MatcherBanks | None = None
         self._fused: FusedMatchScore | None = None
+        # two concurrent _prepare calls (analyze_pipelined) must not both
+        # build the lazy device programs — one multi-second compile each.
+        # RLock: building `fused` takes the lock and then touches the
+        # `matchers` property, which takes it again on the same thread
+        self._init_lock = threading.RLock()
         self._golden = None
         # cheap insurance: a request whose device batch dies is re-served
         # from the golden host path (SURVEY.md §5.3). Disabled in the test
@@ -151,6 +173,10 @@ class AnalysisEngine:
             os.environ.get("LOG_PARSER_TPU_NO_FALLBACK") != "1"
         )
         self._k_hint = 0  # previous request's match count → starting K bucket
+        # serializes frequency-coupled state (finish phase, admin routes,
+        # golden fallback) across transports; the prepare phase (ingest +
+        # device) deliberately runs OUTSIDE it — see analyze_pipelined
+        self.state_lock = threading.Lock()
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
         self.last_trace: PhaseTrace | None = None
@@ -166,7 +192,9 @@ class AnalysisEngine:
     @property
     def matchers(self) -> MatcherBanks:
         if self._matchers is None:
-            self._matchers = MatcherBanks(self.bank)
+            with self._init_lock:
+                if self._matchers is None:
+                    self._matchers = MatcherBanks(self.bank)
         return self._matchers
 
     @property
@@ -176,7 +204,11 @@ class AnalysisEngine:
     @property
     def fused(self) -> FusedMatchScore:
         if self._fused is None:
-            self._fused = FusedMatchScore(self.bank, self.config, self.matchers)
+            with self._init_lock:
+                if self._fused is None:
+                    self._fused = FusedMatchScore(
+                        self.bank, self.config, self.matchers
+                    )
         return self._fused
 
     # -------------------------------------------------------------- overrides
@@ -237,39 +269,71 @@ class AnalysisEngine:
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
-        # roll frequency state back on ANY failure: a partially-run request
-        # (e.g. one that died after recording its matches) must not leave
-        # the tracker double-counted — whether golden re-serves it or the
-        # client retries after a 500
+        """Sequential analyze — the single-caller entry point (tests,
+        benches, the golden-parity harness). Transport front-ends that
+        serve concurrent requests use :meth:`analyze_pipelined`."""
+        return self._analyze(data, _NULL_LOCK)
+
+    def analyze_pipelined(self, data: PodFailureData) -> AnalysisResult:
+        """Thread-safe analyze: ingest + device execution (the prepare
+        phase, which touches no shared mutable state) runs OUTSIDE
+        ``state_lock``, so request N+1's ingest/device work overlaps
+        request N's host finalize — the frequency read-before-record
+        boundary is the only true serialization point (SURVEY.md §5.2;
+        the reference serializes nothing and data-races instead)."""
+        return self._analyze(data, self.state_lock)
+
+    def _analyze(self, data: PodFailureData, lock) -> AnalysisResult:
+        try:
+            prepared = self._prepare(data)
+        except Exception as exc:
+            with lock:
+                return self._serve_fallback(data, exc)
+        with lock:
+            # roll frequency state back on ANY failure: a partially-run
+            # request (e.g. one that died after recording its matches)
+            # must not leave the tracker double-counted — whether golden
+            # re-serves it or the client retries after a 500
+            saved_freq = self.frequency._save_state()
+            try:
+                return self._finish(prepared)
+            except Exception as exc:
+                self.frequency._load_state(saved_freq)
+                return self._serve_fallback(data, exc)
+
+    def _serve_fallback(self, data: PodFailureData, exc: Exception) -> AnalysisResult:
+        """Serve ``data`` from the golden host path if ``exc`` is a device
+        failure and the fallback is enabled; re-raise otherwise. Caller
+        holds the lock (frequency state is read and mutated here)."""
+        if not self.fallback_to_golden or not is_device_error(exc):
+            # logic bugs always propagate; device failures degrade to
+            # the golden host path only when the fallback is enabled
+            raise exc
+        import logging
+
+        self.fallback_count += 1
+        logging.getLogger(__name__).exception(
+            "Device batch failed (fallback #%d); serving this request "
+            "from the golden host path",
+            self.fallback_count,
+        )
+        # device-side observability does not describe this request
+        self.last_trace = None
+        self.last_finalized = None
         saved_freq = self.frequency._save_state()
         try:
-            return self._analyze_device(data)
-        except Exception as exc:
+            return self.golden_fallback.analyze(data)
+        except Exception:
+            # golden records matches as it runs — a failure partway
+            # through must not leak its partial counts either
             self.frequency._load_state(saved_freq)
-            if not self.fallback_to_golden or not is_device_error(exc):
-                # logic bugs always propagate; device failures degrade to
-                # the golden host path only when the fallback is enabled
-                raise
-            import logging
+            raise
 
-            self.fallback_count += 1
-            logging.getLogger(__name__).exception(
-                "Device batch failed (fallback #%d); serving this request "
-                "from the golden host path",
-                self.fallback_count,
-            )
-            # device-side observability does not describe this request
-            self.last_trace = None
-            self.last_finalized = None
-            try:
-                return self.golden_fallback.analyze(data)
-            except Exception:
-                # golden records matches as it runs — a failure partway
-                # through must not leak its partial counts either
-                self.frequency._load_state(saved_freq)
-                raise
-
-    def _analyze_device(self, data: PodFailureData) -> AnalysisResult:
+    def _prepare(self, data: PodFailureData) -> "_Prepared":
+        """Ingest + overrides + the device batch: everything before the
+        frequency read. Touches no shared mutable state beyond the
+        ``_k_hint`` perf hint — safe to run concurrently with another
+        request's :meth:`_finish`."""
         start = time.monotonic()
         trace = PhaseTrace()
         with trace.phase("ingest"):
@@ -281,6 +345,19 @@ class AnalysisEngine:
         om, ov = overrides if overrides is not None else (None, None)
         with trace.phase("device"):
             recs = self._run_device(enc, corpus.n_lines, om, ov)
+        return _Prepared(start, trace, corpus, recs)
+
+    def _finish(self, prepared: "_Prepared") -> AnalysisResult:
+        """Frequency read → exact-f64 finalize → frequency record →
+        assemble. Serialized under ``state_lock`` by concurrent callers:
+        the read-before-record ordering (ScoringService.java:84-88) is
+        only meaningful per-request-atomically."""
+        start, trace, corpus, recs = (
+            prepared.start,
+            prepared.trace,
+            prepared.corpus,
+            prepared.recs,
+        )
         self._k_hint = recs.n_matches
 
         # windowed frequency counts at batch start (pruned by the tracker);

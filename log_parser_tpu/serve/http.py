@@ -17,17 +17,18 @@ programmatically at FrequencyTrackingService.java:101-134):
 - ``GET /frequency/stats`` — current windowed counts per pattern id;
 - ``POST /frequency/reset`` and ``POST /frequency/reset/{patternId}``.
 
-Analysis requests are serialized with a lock: device execution is serial
-anyway, and the reference's concurrency story was an unsynchronized data
-race on shared pattern objects (SURVEY.md §5.2) — not a behavior to
-reproduce.
+Concurrency: requests run PIPELINED — ingest and device execution of one
+request overlap the host finalize of another; only the frequency-coupled
+finish phase serializes, on the engine's own ``state_lock`` (shared with
+the shim transports and the admin routes). The reference's concurrency
+story was an unsynchronized data race on shared pattern objects
+(SURVEY.md §5.2) — not a behavior to reproduce.
 """
 
 from __future__ import annotations
 
 import json
 import logging
-import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu.models.pod import PodFailureData
@@ -44,7 +45,9 @@ class ParseServer(ThreadingHTTPServer):
     def __init__(self, address: tuple[str, int], engine: AnalysisEngine):
         super().__init__(address, _Handler)
         self.engine = engine
-        self.analyze_lock = threading.Lock()
+        # the engine's own state lock: admin routes and the analyze finish
+        # phase serialize on ONE lock across every transport (HTTP + shim)
+        self.analyze_lock = engine.state_lock
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -137,8 +140,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         log.info("Received analysis request for pod: %s", data.pod_name)
         try:
-            with self.server.analyze_lock:
-                result = self.server.engine.analyze(data)
+            # pipelined: ingest + device work of this request overlaps the
+            # host finalize of in-flight ones; only the frequency-coupled
+            # finish phase serializes (on engine.state_lock)
+            result = self.server.engine.analyze_pipelined(data)
         except Exception:
             # non-device bugs propagate out of analyze() by design
             # (runtime/engine.py is_device_error) — answer with a JSON 500

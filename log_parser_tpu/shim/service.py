@@ -2,15 +2,16 @@
 (proto/logparser.proto) — shared by the framed-socket shim (server.py) and
 the gRPC server (grpc_server.py).
 
-One instance wraps one engine; all state-touching calls (Parse + the
-frequency admin surface mirroring FrequencyTrackingService.java:101-134)
-serialize on one lock, exactly like the HTTP front-end.
+One instance wraps one engine. Parse runs PIPELINED (ingest + device work
+outside the engine's ``state_lock``; only the frequency-coupled finish
+phase serializes — serve/http.py documents the scheme). The frequency
+admin surface (mirroring FrequencyTrackingService.java:101-134) serializes
+on the same engine-wide lock, shared with the HTTP front-end.
 """
 
 from __future__ import annotations
 
 import json
-import threading
 
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.shim import logparser_pb2 as pb
@@ -38,7 +39,8 @@ class LogParserService:
 
     def __init__(self, engine):
         self.engine = engine
-        self.lock = threading.Lock()
+        # the engine's own state lock — one lock across every transport
+        self.lock = engine.state_lock
 
     # ----------------------------------------------------------------- parse
 
@@ -47,8 +49,8 @@ class LogParserService:
         if pod is None:
             raise InvalidPodError()
         data = PodFailureData(pod=pod, logs=req.logs)
-        with self.lock:
-            result = self.engine.analyze(data)
+        # pipelined: only the finish phase takes self.lock (inside)
+        result = self.engine.analyze_pipelined(data)
 
         resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
         for event in result.events:

@@ -376,4 +376,270 @@ void lpn_dfa_read(void* handle, int32_t* trans, int32_t* byte_class,
 
 void lpn_dfa_free(void* handle) { delete static_cast<DfaResult*>(handle); }
 
+// ---------------------------------------------------------------------------
+// 3. Union multi-pattern DFA builder
+// ---------------------------------------------------------------------------
+//
+// Determinizes the UNION of R pattern NFAs (merged by the Python side into
+// one arena with a shared unanchored start) into one DFA whose states carry
+// sticky per-pattern output bitmask words — the device then runs R patterns
+// with ONE [B] state gather per byte instead of a [B, R] gather
+// (patterns/regex/multidfa.py documents the design and the TPU measurement
+// that motivates it). Same assertion-aware closure as the single builder;
+// no MATCHED sink (each pattern latches independently via output bits read
+// from the pre-transition state under the incoming byte's word-ness).
+
+namespace {
+
+struct MultiDfaResult {
+    std::vector<int32_t> trans;        // [n_states * n_classes]
+    std::vector<int32_t> byte_class;   // [256]
+    std::vector<int32_t> cls_word;     // [n_classes] 0/1
+    std::vector<uint32_t> out2;        // [n_states * 2 * n_words]
+    std::vector<uint32_t> accept_w;    // [n_states * n_words]
+    int32_t n_states = 0;
+    int32_t n_classes = 0;
+    int32_t n_words = 0;
+    int32_t start = 0;
+};
+
+// Moore minimization for the multi-DFA: initial partition by the full
+// output signature (out2 nonword/word rows + end-accept words), refinement
+// on transitions, then byte-class recompression with word-ness kept in the
+// column signature so cls_word stays well-defined.
+void minimize_multi(MultiDfaResult& d) {
+    int32_t n = d.n_states, c = d.n_classes, w = d.n_words;
+    std::vector<int32_t> part(n);
+    {
+        std::unordered_map<std::vector<int32_t>, int32_t, VecHash> sigs;
+        std::vector<int32_t> sig(3 * w);
+        for (int32_t s = 0; s < n; ++s) {
+            for (int32_t k = 0; k < w; ++k) {
+                sig[k] = static_cast<int32_t>(d.out2[(s * 2) * w + k]);
+                sig[w + k] = static_cast<int32_t>(d.out2[(s * 2 + 1) * w + k]);
+                sig[2 * w + k] = static_cast<int32_t>(d.accept_w[s * w + k]);
+            }
+            auto it = sigs.find(sig);
+            if (it == sigs.end()) {
+                int32_t id = static_cast<int32_t>(sigs.size());
+                sigs.emplace(sig, id);
+                part[s] = id;
+            } else {
+                part[s] = it->second;
+            }
+        }
+    }
+    int32_t n_parts = -1;
+    std::vector<int32_t> key(c + 1);
+    for (;;) {
+        std::unordered_map<std::vector<int32_t>, int32_t, VecHash> sig;
+        std::vector<int32_t> next(n);
+        for (int32_t s = 0; s < n; ++s) {
+            key[0] = part[s];
+            for (int32_t k = 0; k < c; ++k) key[k + 1] = part[d.trans[s * c + k]];
+            auto it = sig.find(key);
+            if (it == sig.end()) {
+                int32_t id = static_cast<int32_t>(sig.size());
+                sig.emplace(key, id);
+                next[s] = id;
+            } else {
+                next[s] = it->second;
+            }
+        }
+        int32_t m = static_cast<int32_t>(sig.size());
+        part.swap(next);
+        if (m == n_parts) break;
+        n_parts = m;
+    }
+    std::vector<int32_t> rep(n_parts, -1);
+    for (int32_t s = 0; s < n; ++s) if (rep[part[s]] < 0) rep[part[s]] = s;
+    std::vector<int32_t> mtrans(static_cast<size_t>(n_parts) * c);
+    std::vector<uint32_t> mout(static_cast<size_t>(n_parts) * 2 * w);
+    std::vector<uint32_t> macc(static_cast<size_t>(n_parts) * w);
+    for (int32_t p = 0; p < n_parts; ++p) {
+        int32_t s = rep[p];
+        for (int32_t k = 0; k < c; ++k) mtrans[p * c + k] = part[d.trans[s * c + k]];
+        for (int32_t k = 0; k < w; ++k) {
+            mout[(p * 2) * w + k] = d.out2[(s * 2) * w + k];
+            mout[(p * 2 + 1) * w + k] = d.out2[(s * 2 + 1) * w + k];
+            macc[p * w + k] = d.accept_w[s * w + k];
+        }
+    }
+    int32_t mstart = part[d.start];
+    // byte-class recompression; word-ness is part of the column signature
+    std::unordered_map<std::vector<int32_t>, int32_t, VecHash> colsig;
+    std::vector<int32_t> colmap(c);
+    std::vector<int32_t> new_word;
+    std::vector<int32_t> col(n_parts + 1);
+    for (int32_t k = 0; k < c; ++k) {
+        col[0] = d.cls_word[k];
+        for (int32_t p = 0; p < n_parts; ++p) col[p + 1] = mtrans[p * c + k];
+        auto it = colsig.find(col);
+        if (it == colsig.end()) {
+            int32_t id = static_cast<int32_t>(colsig.size());
+            colsig.emplace(col, id);
+            colmap[k] = id;
+            new_word.push_back(d.cls_word[k]);
+        } else {
+            colmap[k] = it->second;
+        }
+    }
+    int32_t nc = static_cast<int32_t>(colsig.size());
+    std::vector<int32_t> ftrans(static_cast<size_t>(n_parts) * nc);
+    for (int32_t k = 0; k < c; ++k)
+        for (int32_t p = 0; p < n_parts; ++p)
+            ftrans[p * nc + colmap[k]] = mtrans[p * c + k];
+    for (int b = 0; b < 256; ++b) d.byte_class[b] = colmap[d.byte_class[b]];
+    d.trans.swap(ftrans);
+    d.out2.swap(mout);
+    d.accept_w.swap(macc);
+    d.cls_word.swap(new_word);
+    d.n_states = n_parts;
+    d.n_classes = nc;
+    d.start = mstart;
+}
+
+} // namespace
+
+// Build the union multi-DFA. `finals[i]` is pattern i's final NFA state in
+// the merged arena. Returns an opaque handle (read with lpn_multi_dfa_read,
+// free with lpn_multi_dfa_free) or nullptr with *err = 1 on state blowup.
+void* lpn_multi_dfa_build(
+    int32_t n_nfa_states, int32_t start, const int64_t* eps_off,
+    const int8_t* eps_cond, const int32_t* eps_dst, const int64_t* t_off,
+    const int32_t* t_bs, const int32_t* t_dst, const uint8_t* bytesets,
+    int32_t n_bytesets, const uint8_t* word_mask, const int32_t* finals,
+    int32_t n_patterns, int32_t max_states, int32_t do_minimize,
+    int32_t* out_n_states, int32_t* out_n_classes, int32_t* out_n_words,
+    int32_t* out_start, int32_t* err) {
+    *err = 0;
+    if (max_states < 1) { *err = 1; return nullptr; }
+    Nfa nfa{n_nfa_states, start, -1, eps_off, eps_cond, eps_dst,
+            t_off, t_bs, t_dst, bytesets, word_mask};
+    int32_t n_words = (n_patterns + 31) / 32;
+    if (n_words < 1) n_words = 1;
+
+    std::vector<int32_t> byte_class(256);
+    std::vector<int> reps;
+    {
+        std::unordered_map<std::vector<int32_t>, int32_t, VecHash> sigs;
+        std::vector<int32_t> sig(n_bytesets + 1);
+        for (int b = 0; b < 256; ++b) {
+            for (int32_t i = 0; i < n_bytesets; ++i)
+                sig[i] = bs_has(bytesets + static_cast<size_t>(i) * 32, b);
+            sig[n_bytesets] = bs_has(word_mask, b);
+            auto it = sigs.find(sig);
+            if (it == sigs.end()) {
+                int32_t cls = static_cast<int32_t>(sigs.size());
+                sigs.emplace(sig, cls);
+                reps.push_back(b);
+                byte_class[b] = cls;
+            } else {
+                byte_class[b] = it->second;
+            }
+        }
+    }
+    int32_t n_classes = static_cast<int32_t>(reps.size());
+
+    // final NFA state -> pattern bit (finals are distinct by construction)
+    std::unordered_map<int32_t, int32_t> final_bit;
+    for (int32_t i = 0; i < n_patterns; ++i) final_bit.emplace(finals[i], i);
+
+    auto* d = new MultiDfaResult();
+    d->byte_class = byte_class;
+    d->n_classes = n_classes;
+    d->n_words = n_words;
+    d->cls_word.resize(n_classes);
+    for (int32_t k = 0; k < n_classes; ++k)
+        d->cls_word[k] = bs_has(word_mask, reps[k]) ? 1 : 0;
+
+    std::unordered_map<std::vector<int32_t>, int32_t, VecHash> intern;
+    std::vector<std::vector<int32_t>> cores;
+    std::vector<uint8_t> in_set(n_nfa_states, 0);
+    std::vector<int32_t> cl_nw, cl_w, cl_end, stack, moved;
+
+    auto intern_state = [&](std::vector<int32_t>&& key) -> int32_t {
+        auto it = intern.find(key);
+        if (it != intern.end()) return it->second;
+        int32_t sid = static_cast<int32_t>(cores.size());
+        if (sid >= max_states) return -1;
+        intern.emplace(key, sid);
+        cores.push_back(std::move(key));
+        d->trans.resize(static_cast<size_t>(sid + 1) * n_classes, -1);
+        d->out2.resize(static_cast<size_t>(sid + 1) * 2 * n_words, 0);
+        d->accept_w.resize(static_cast<size_t>(sid + 1) * n_words, 0);
+        return sid;
+    };
+    auto set_bits = [&](const std::vector<int32_t>& closed, uint32_t* words) {
+        for (int32_t s : closed) {
+            auto it = final_bit.find(s);
+            if (it != final_bit.end())
+                words[it->second / 32] |=
+                    (uint32_t{1} << (it->second % 32));
+        }
+    };
+
+    std::vector<int32_t> start_key{start, L_BEGIN};
+    d->start = intern_state(std::move(start_key));
+
+    for (int32_t sid = d->start; sid < static_cast<int32_t>(cores.size()); ++sid) {
+        // copy: `cores` reallocates as intern_state appends mid-loop
+        std::vector<int32_t> key = cores[sid];
+        std::vector<int32_t> core(key.begin(), key.end() - 1);
+        int32_t left = key.back();
+        closure(nfa, core, left, 0, cl_nw, in_set, stack);
+        closure(nfa, core, left, 1, cl_w, in_set, stack);
+        closure(nfa, core, left, -1, cl_end, in_set, stack);
+        set_bits(cl_nw, d->out2.data() + static_cast<size_t>(sid) * 2 * n_words);
+        set_bits(cl_w,
+                 d->out2.data() + (static_cast<size_t>(sid) * 2 + 1) * n_words);
+        set_bits(cl_end, d->accept_w.data() + static_cast<size_t>(sid) * n_words);
+        for (int32_t k = 0; k < n_classes; ++k) {
+            int rep = reps[k];
+            bool rw = bs_has(word_mask, rep);
+            const std::vector<int32_t>& cl = rw ? cl_w : cl_nw;
+            moved.clear();
+            for (int32_t s : cl) {
+                for (int64_t e = t_off[s]; e < t_off[s + 1]; ++e) {
+                    if (bs_has(bytesets + static_cast<size_t>(t_bs[e]) * 32, rep))
+                        moved.push_back(t_dst[e]);
+                }
+            }
+            std::sort(moved.begin(), moved.end());
+            moved.erase(std::unique(moved.begin(), moved.end()), moved.end());
+            std::vector<int32_t> mkey(moved);
+            mkey.push_back(rw ? L_WORD : L_NONWORD);
+            int32_t dst = intern_state(std::move(mkey));
+            if (dst < 0) { *err = 1; delete d; return nullptr; }
+            d->trans[static_cast<size_t>(sid) * n_classes + k] = dst;
+        }
+    }
+    d->n_states = static_cast<int32_t>(cores.size());
+
+    if (do_minimize) minimize_multi(*d);
+
+    *out_n_states = d->n_states;
+    *out_n_classes = d->n_classes;
+    *out_n_words = d->n_words;
+    *out_start = d->start;
+    return d;
+}
+
+void lpn_multi_dfa_read(void* handle, int32_t* trans, int32_t* byte_class,
+                        int32_t* cls_word, uint32_t* out2,
+                        uint32_t* accept_words) {
+    auto* d = static_cast<MultiDfaResult*>(handle);
+    std::memcpy(trans, d->trans.data(), d->trans.size() * sizeof(int32_t));
+    std::memcpy(byte_class, d->byte_class.data(), 256 * sizeof(int32_t));
+    std::memcpy(cls_word, d->cls_word.data(),
+                d->cls_word.size() * sizeof(int32_t));
+    std::memcpy(out2, d->out2.data(), d->out2.size() * sizeof(uint32_t));
+    std::memcpy(accept_words, d->accept_w.data(),
+                d->accept_w.size() * sizeof(uint32_t));
+}
+
+void lpn_multi_dfa_free(void* handle) {
+    delete static_cast<MultiDfaResult*>(handle);
+}
+
 } // extern "C"

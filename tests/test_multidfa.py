@@ -1,0 +1,186 @@
+"""Union multi-pattern DFA (patterns/regex/multidfa.py + MultiDfaBank).
+
+The union automaton must be bit-for-bit equivalent to running each regex
+alone — per pattern, per line — including anchors, word boundaries,
+case-insensitive branches, counted repetitions, end-of-line completions,
+and empty-match regexes. The packing must respect the state budget and
+keep every entry accounted for (grouped or rejected).
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import MatcherBanks
+from log_parser_tpu.patterns.bank import PatternBank
+from log_parser_tpu.patterns.regex.multidfa import (
+    MultiDfaLimitError,
+    _compile_union_python,
+    _merge_nfas,
+    compile_union_regexes,
+    pack_union_groups,
+)
+from log_parser_tpu.patterns.regex.nfa import build_nfa
+from log_parser_tpu.patterns.regex.parser import parse_java_regex
+from tests.helpers import make_pattern, make_pattern_set
+
+REGEXES: list[tuple[str, bool]] = [
+    ("OutOfMemoryError", False),
+    ("(Liveness|Readiness) probe failed", False),
+    ("exit code 137|Exit Code:\\s*137", False),
+    ("segfault at [0-9a-f]+|Segmentation fault", False),
+    ("\\bFull GC\\b", False),
+    ("panic: ", False),
+    ("foo$", False),
+    ("^start", False),
+    ("a{2,4}b", False),
+    ("status.*red", False),
+    ("no such host|could not resolve|NXDOMAIN", True),
+    ("ERROR|FATAL", False),
+    ("x?", False),  # matches the empty string on every line
+]
+
+LINES = [
+    "",
+    "foo",
+    "xfoo",
+    "foox",
+    "start here",
+    "restart",
+    "aab",
+    "aaaab",
+    "ab",
+    "aaaaab",
+    "java.lang.OutOfMemoryError: heap",
+    "Liveness probe failed",
+    "probe failed",
+    "exit code 137",
+    "Exit Code:   137",
+    "segfault at deadbeef",
+    "Segmentation fault",
+    "a Full GC pause",
+    "FullGC",
+    "panic: oops",
+    "status is red",
+    "red before status",
+    "NO SUCH HOST",
+    "nxdomain lookup",
+    "Could Not Resolve",
+    "ERROR and FATAL",
+]
+
+
+def _want(lines: list[str]) -> np.ndarray:
+    out = np.zeros((len(lines), len(REGEXES)), dtype=bool)
+    for j, (rx, ci) in enumerate(REGEXES):
+        pat = re.compile(rx, re.IGNORECASE if ci else 0)
+        for i, ln in enumerate(lines):
+            out[i, j] = bool(pat.search(ln))
+    return out
+
+
+def test_union_matches_re_native_and_python():
+    md_native = compile_union_regexes(REGEXES)
+    nfas = [
+        build_nfa(parse_java_regex(rx, ci), unanchored_prefix=False)
+        for rx, ci in REGEXES
+    ]
+    merged, finals = _merge_nfas(nfas)
+    md_py = _compile_union_python(merged, finals, len(REGEXES), 8192)
+
+    want = _want(LINES)
+    for i, ln in enumerate(LINES):
+        data = ln.encode()
+        np.testing.assert_array_equal(md_native.matches(data), want[i], err_msg=ln)
+        np.testing.assert_array_equal(md_py.matches(data), want[i], err_msg=ln)
+
+
+def test_union_random_fuzz_vs_re():
+    rng = random.Random(7)
+    alphabet = "abE R:137fostdx"
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 40)))
+        for _ in range(200)
+    ]
+    md = compile_union_regexes(REGEXES)
+    want = _want(lines)
+    for i, ln in enumerate(lines):
+        np.testing.assert_array_equal(md.matches(ln.encode()), want[i], err_msg=ln)
+
+
+def test_budget_raises():
+    with pytest.raises(MultiDfaLimitError):
+        compile_union_regexes(REGEXES, max_states=8)
+
+
+def test_pack_union_groups_accounts_for_every_entry():
+    entries = [(f"k{j}", rx, ci) for j, (rx, ci) in enumerate(REGEXES)]
+    groups, rejected = pack_union_groups(entries, max_states=300, max_group=8)
+    keys = [k for ks, _ in groups for k in ks] + [k for k, _, _ in rejected]
+    assert sorted(keys) == sorted(k for k, _, _ in entries)
+    for ks, md in groups:
+        assert md.n_patterns == len(ks)
+        assert md.n_states <= 300
+
+
+def test_matcher_bank_multi_tier_cube_parity():
+    """MatcherBanks with the multi tier vs pure dense — identical cubes."""
+    patterns = [
+        make_pattern(f"p{j}", regex=rx, confidence=0.5, severity="LOW")
+        for j, (rx, ci) in enumerate(REGEXES)
+        if not ci and rx != "x?"  # bank-level: keep deterministic columns
+    ]
+    bank = PatternBank([make_pattern_set(patterns)])
+    multi = MatcherBanks(
+        bank, shiftor_min_columns=10**9, prefilter_min_columns=10**9,
+        multi_min_columns=2,
+    )
+    dense = MatcherBanks(
+        bank, shiftor_min_columns=10**9, prefilter_min_columns=10**9,
+        multi_min_columns=10**9,
+    )
+    assert multi.multi_groups, "multi tier must engage"
+    assert not multi.dfa_cols, "every dense column should ride the union"
+
+    import jax.numpy as jnp
+
+    enc = encode_lines(LINES, 4096, 128, 8)
+    lt = jnp.asarray(enc.u8.T)
+    ln = jnp.asarray(enc.lengths)
+    np.testing.assert_array_equal(
+        np.asarray(multi.cube(lt, ln))[: len(LINES)],
+        np.asarray(dense.cube(lt, ln))[: len(LINES)],
+    )
+
+
+def test_engine_parity_with_multi_tier():
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden import GoldenAnalyzer
+    from log_parser_tpu.models import PodFailureData
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    from tests.test_engine_parity import assert_results_match
+
+    patterns = [
+        make_pattern(
+            f"p{j}",
+            regex=rx,
+            confidence=0.6,
+            severity="MEDIUM",
+            secondaries=[("panic: ", 0.5, 10)],
+        )
+        for j, (rx, ci) in enumerate(REGEXES[:6])
+    ]
+    sets = [make_pattern_set(patterns)]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    assert engine.matchers.multi_groups
+    logs = "\n".join(LINES)
+    data = PodFailureData(pod={"metadata": {"name": "m"}}, logs=logs)
+    assert_results_match(
+        engine.analyze(data), GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+    )

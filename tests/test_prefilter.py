@@ -88,7 +88,8 @@ def _lines_sparse(n: int = 200) -> list[str]:
 class TestPrefilterTier:
     def test_engaged_for_wide_banks(self):
         bank = _bank_of(PREF_REGEXES)
-        mb = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
+        mb = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
+                          multi_min_columns=10 ** 9)
         assert mb.prefilter is not None
         assert len(mb.prefilter_cols) >= 32
         # dense DFA bank shrank accordingly
@@ -101,8 +102,10 @@ class TestPrefilterTier:
 
     def test_sparse_path_parity_with_host(self):
         bank = _bank_of(PREF_REGEXES)
-        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
-        dense = MatcherBanks(bank, prefilter_min_columns=10 ** 9, shiftor_min_columns=10 ** 9)
+        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
+                            multi_min_columns=10 ** 9)
+        dense = MatcherBanks(bank, prefilter_min_columns=10 ** 9, shiftor_min_columns=10 ** 9,
+                             multi_min_columns=10 ** 9)
         assert pref.prefilter is not None and dense.prefilter is None
         lines = _lines_sparse()
         want = _host_cube(bank, lines)
@@ -113,7 +116,8 @@ class TestPrefilterTier:
         """Every line carries literals -> hit compaction overflows -> the
         lax.cond dense branch must produce identical results."""
         bank = _bank_of(PREF_REGEXES)
-        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9)
+        pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
+                            multi_min_columns=10 ** 9)
         lines = [f"conn-{i % 20:03d}: refused and svc-{i % 20:03d}  fatal" for i in range(512)]
         want = _host_cube(bank, lines)
         np.testing.assert_array_equal(_device_cube(pref, lines), want)
@@ -136,7 +140,8 @@ class TestPrefilterTier:
         ]
         sets = [make_pattern_set(patterns)]
         engine = AnalysisEngine(sets, ScoringConfig())
-        assert engine.matchers.prefilter is not None  # default threshold engaged
+        # the union multi-DFA tier absorbs these columns at default thresholds
+        assert engine.matchers.multi_groups
         logs = "\n".join(_lines_sparse(150))
         data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
         golden = GoldenAnalyzer(sets, ScoringConfig())

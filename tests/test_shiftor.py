@@ -92,8 +92,14 @@ def test_adaptive_tier_split(monkeypatch):
         for i in range(8)
     ]
     bank = PatternBank([make_pattern_set(patterns)])
-    small = MatcherBanks(bank)  # under threshold: everything on the DFA
+    # under the Shift-Or threshold: nothing on the Shift-Or tier; the
+    # columns ride the union multi-DFA (or the dense bank without it)
+    small = MatcherBanks(bank, multi_min_columns=10**9)
     assert small.shiftor is None and len(small.dfa_cols) > 0
+    multi = MatcherBanks(bank)
+    assert multi.shiftor is None
+    # every column the no-multi config kept dense rides the union instead
+    assert sorted(multi.multi_cols + multi.dfa_cols) == sorted(small.dfa_cols)
     wide = MatcherBanks(bank, shiftor_min_columns=1)
     assert wide.shiftor is not None
     assert len(wide.shiftor_cols) == 8  # all literal-shaped primaries
