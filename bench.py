@@ -61,7 +61,9 @@ def main() -> None:
     from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
     from log_parser_tpu.runtime import AnalysisEngine
 
-    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    sets = load_builtin_pattern_sets()
+    n_patterns = sum(len(s.patterns or []) for s in sets)
+    engine = AnalysisEngine(sets, ScoringConfig())
     assert not engine.fallback_to_golden, "bench must never serve from golden"
     logs = build_corpus(N_LINES)
     data = PodFailureData(pod={"metadata": {"name": "bench"}}, logs=logs)
@@ -83,6 +85,7 @@ def main() -> None:
         round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),
         platform,
         n_lines=N_LINES,
+        n_patterns=n_patterns,
     )
 
 

@@ -380,13 +380,16 @@ class MatcherBanks:
 
     # Union multi-DFA tier (platform-independent: one [B] gather per byte
     # beats a [B, R] gather for R >= 2 everywhere; the native builder makes
-    # group packing cheap). MULTI_MAX_TOTAL_COLS bounds packing time on
-    # degenerate many-thousand-regex banks — the overflow keeps its
-    # prefilter/dense tier.
+    # group packing cheap). Above MULTI_PREFERRED_MAX dense columns the
+    # union would need many groups (each ~2 gathers/byte) — wide
+    # literal-BEARING sets ride the AC prefilter instead, whose any-hit
+    # stage is O(1)/byte in width; literal-free columns stay on the union
+    # whatever the width (their only alternative is the dense bank at
+    # ~150ms/column/200k lines on TPU).
     MULTI_MIN_COLUMNS = 2
     MULTI_STATE_BUDGET = 8192
     MULTI_MAX_GROUP = 64
-    MULTI_MAX_TOTAL_COLS = 512
+    MULTI_PREFERRED_MAX = 128
 
     def __init__(
         self,
@@ -450,42 +453,74 @@ class MatcherBanks:
             if multi_min_columns is None
             else multi_min_columns
         )
+        use_multi = (
+            len(dense_cols) >= multi_threshold and get_lib() is not None
+        )
+
+        # WIDE banks select the prefilter set FIRST (any-hit is O(1)/byte
+        # in width), so that the union tier can absorb everything the
+        # selection leaves behind — literal-free columns AND trie-budget
+        # rejects — instead of stranding rejects on the dense bank.
+        pref_selected: list = []
+        if len(dense_cols) > self.MULTI_PREFERRED_MAX or not use_multi:
+            if len(dense_cols) >= pref_threshold:
+                eligible = [
+                    (i, bank.columns[i])
+                    for i in dense_cols
+                    if bank.columns[i].literals
+                ]
+                selected, _rejected = PrefilterBank.select(eligible)
+                if len(selected) >= pref_threshold:
+                    pref_selected = selected
+        pref_set = {g for g, _ in pref_selected}
+
         self.multi_groups: list[MultiDfaBank] = []
-        if len(dense_cols) >= multi_threshold and get_lib() is not None:
+        if use_multi:
             from log_parser_tpu.patterns.regex.multidfa import pack_union_groups
 
-            take = dense_cols[: self.MULTI_MAX_TOTAL_COLS]
-            entries = [
-                (i, bank.columns[i].regex, bank.columns[i].case_insensitive)
-                for i in take
-            ]
-            groups, rejected_entries = pack_union_groups(
-                entries,
-                max_states=self.MULTI_STATE_BUDGET,
-                max_group=self.MULTI_MAX_GROUP,
-            )
-            self.multi_groups = [
-                MultiDfaBank(md, keys) for keys, md in groups
-            ]
-            taken = set(take)
-            dense_cols = [k for k, _, _ in rejected_entries] + [
-                i for i in dense_cols if i not in taken
-            ]
+            take = [i for i in dense_cols if i not in pref_set]
+            if take:
+                entries = [
+                    (i, bank.columns[i].regex, bank.columns[i].case_insensitive)
+                    for i in take
+                ]
+                groups, rejected_entries = pack_union_groups(
+                    entries,
+                    max_states=self.MULTI_STATE_BUDGET,
+                    max_group=self.MULTI_MAX_GROUP,
+                )
+                self.multi_groups = [
+                    MultiDfaBank(md, keys) for keys, md in groups
+                ]
+                taken = set(take)
+                dense_cols = [k for k, _, _ in rejected_entries] + [
+                    i for i in dense_cols if i not in taken and i not in pref_set
+                ]
+            else:
+                dense_cols = [i for i in dense_cols if i not in pref_set]
+        else:
+            dense_cols = [i for i in dense_cols if i not in pref_set]
 
-        # prefilter tier: DFA columns with a non-empty required-literal set,
-        # engaged only for wide banks and within the trie budget
-        self.prefilter: PrefilterBank | None = None
-        self.prefilter_cols: list[int] = []
-        if len(dense_cols) >= pref_threshold:
+        # NARROW banks: the union already took everything; offer its
+        # rejects (union-hostile regexes) to the prefilter if enough of
+        # them carry literals
+        if not pref_selected and len(dense_cols) >= pref_threshold:
             eligible = [
-                (i, bank.columns[i]) for i in dense_cols if bank.columns[i].literals
+                (i, bank.columns[i])
+                for i in dense_cols
+                if bank.columns[i].literals
             ]
             selected, _rejected = PrefilterBank.select(eligible)
             if len(selected) >= pref_threshold:
-                self.prefilter = PrefilterBank(selected)
-                self.prefilter_cols = [g for g, _ in selected]
-                pref_set = set(self.prefilter_cols)
-                dense_cols = [i for i in dense_cols if i not in pref_set]
+                pref_selected = selected
+                sel_set = {g for g, _ in pref_selected}
+                dense_cols = [i for i in dense_cols if i not in sel_set]
+
+        self.prefilter: PrefilterBank | None = None
+        self.prefilter_cols: list[int] = []
+        if pref_selected:
+            self.prefilter = PrefilterBank(pref_selected)
+            self.prefilter_cols = [g for g, _ in pref_selected]
 
         self.dfa_cols = dense_cols
         self.dfa_bank = DfaBank(
@@ -539,7 +574,7 @@ class MatcherBanks:
             )
         if self.prefilter is not None:
             steppers.append(
-                (self.prefilter.words_stepper(B, lengths), None, False)
+                (self.prefilter.anyhit_stepper(B, lengths), None, False)
             )
         if not steppers:
             return cube

@@ -8,19 +8,23 @@ for large libraries (AnalysisService.java:89-113):
 1. the main fused byte scan carries ONE extra automaton: a combined
    Aho-Corasick over every prefiltered column's required literals
    (case-folded — folding only widens the filter, never drops a match),
-   accumulating per-line per-COLUMN hit bitmask words (group bits,
-   ac.py): O(1 + W) gathers per byte regardless of library size
-   (W = ceil(n_cols/32) words);
-2. candidate (line, column) pairs are compacted into records and
+   accumulating only a per-line any-hit bit: 3 ``[B]`` gathers per byte
+   REGARDLESS of library width (the per-column hit words are W =
+   ceil(n_cols/32) words wide — inlining them into the hot scan is
+   O(W·B) per byte, which at 10k columns costs more than the dense bank);
+2. hit lines are compacted and re-scanned through the same automaton
+   accumulating the full per-column words; when the hit compaction
+   overflows (real libraries contain common literals like "status" that
+   fire on most lines — measured 200k/200k on the builtin bank), a
+   ``lax.cond`` runs the words pass over the whole batch instead —
+   graceful O(W·B) degradation, NOT the dense-bank cliff;
+3. candidate (line, column) pairs are compacted into records and
    verified exactly: each record advances ITS column's packed DFA over
    its line's bytes — one gather per record per byte pair, independent
-   of library width.
+   of library width. Candidate capacity 2B; overflow falls back to the
+   dense DFA scan inside the same compiled program.
 
-The candidate capacity (B pairs) is static; a batch that overflows it —
-degenerate logs where most lines contain literals of several columns —
-falls back via ``lax.cond`` to the dense DFA scan over all prefiltered
-columns inside the same compiled program, so the tier is sound for every
-input and never needs a host round-trip or retry ladder.
+Every path is closed on-device: no host round-trips, no retry ladder.
 
 Soundness: every true match of a prefiltered column contains at least one
 of its required literals (literals.py extraction invariant), so the AC
@@ -90,6 +94,7 @@ class PrefilterBank:
         self.byte_class = jnp.asarray(self.ac.byte_class[_FOLD])
         self.goto = jnp.asarray(self.ac.goto)
         self.out_words = jnp.asarray(self.ac.out_words)
+        self.has_out = jnp.asarray(self.ac.has_out)
 
     @staticmethod
     def select(entries, budget: int = MAX_PREFILTER_LITERALS):
@@ -113,24 +118,44 @@ class PrefilterBank:
         rejected.sort(key=lambda e: key[id(e)])
         return selected, rejected
 
-    # ------------------------------- stage 1: per-column words, in-scan
+    # ------------------------------------ stage 1: any-hit bit, in-scan
 
-    def words_stepper(self, B: int, lengths: jax.Array):
+    def anyhit_stepper(self, B: int, lengths: jax.Array):
         """Composable pair-stepper for the main fused scan. Carry:
-        (ac_state [B] int32, hit_words [B, W] uint32).
-
-        Accumulates the FULL per-column hit words inline rather than the
-        earlier two-phase any-hit + re-scan design: an any-hit bit over
-        real libraries fires on most log lines (common tokens like
-        "error"/"status" are required literals of some pattern), so the
-        hit compaction overflowed and the whole batch took the dense
-        fallback — an 8%-slower-than-dense cliff measured on TPU at 83
-        builtin patterns. Inline words cost one extra [B, W] gather per
-        byte (W = ceil(n_cols/32)) and make the tier's cost a smooth
-        function of candidate count with no cliff."""
+        (ac_state [B] int32, any_hit [B] bool) — 3 [B] gathers per byte,
+        independent of library width."""
         init = (
             jnp.zeros((B,), jnp.int32),
-            jnp.zeros((B, self.n_words), jnp.uint32),
+            jnp.zeros((B,), bool),
+        )
+
+        def one(s, a, b, ok):
+            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
+            nxt = self.goto[s, cls]
+            s = jnp.where(ok, nxt, s)
+            a = a | (ok & jnp.take(self.has_out, s))
+            return s, a
+
+        def step(carry, b1, b2, t):
+            s, a = carry
+            p0 = 2 * t
+            s, a = one(s, a, b1, p0 < lengths)
+            s, a = one(s, a, b2, p0 + 1 < lengths)
+            return (s, a)
+
+        def finish(carry):
+            return carry[1]
+
+        return init, step, finish
+
+    # ----------------------------------- stage 2: per-column hit words
+
+    def word_stepper(self, N: int, lengths: jax.Array):
+        """Composable pair-stepper accumulating full per-column words.
+        Carry: (ac_state [N] int32, hit_words [N, W] uint32)."""
+        init = (
+            jnp.zeros((N,), jnp.int32),
+            jnp.zeros((N, self.n_words), jnp.uint32),
         )
 
         def one(s, w, b, ok):
@@ -153,6 +178,18 @@ class PrefilterBank:
             return carry[1]
 
         return init, step, finish
+
+    def _word_scan(self, lines_tb: jax.Array, lengths: jax.Array):
+        """Run the word stepper over ``lines_tb``: uint32 [N, W]."""
+        N = lines_tb.shape[1]
+        init, step, finish = self.word_stepper(N, lengths)
+        pairs, ts = pack_byte_pairs(lines_tb)
+        carry, _ = jax.lax.scan(
+            lambda c, xs: (step(c, xs[0][0], xs[0][1], xs[1]), None),
+            init,
+            (pairs, ts),
+        )
+        return finish(carry)
 
     def unpack_candidates(self, hits: jax.Array):
         """uint32 [N, W] -> bool [N, n_cols] candidate matrix."""
@@ -222,27 +259,54 @@ class PrefilterBank:
         self,
         lines_tb: jax.Array,
         lengths: jax.Array,
-        hit_words: jax.Array,
+        any_hit: jax.Array,
     ) -> jax.Array:
-        """Verify stage (after the main scan accumulated ``hit_words``
-        [B, W]): returns the bool [B, n_cols] cube slice for the
-        prefiltered columns, via per-record verification when the
-        candidate capacity holds, else the dense DFA scan.
+        """Stages 2+3 (after the main scan produced ``any_hit`` [B]):
+        returns the bool [B, n_cols] cube slice for the prefiltered
+        columns.
 
-        Candidate capacity is ``2B`` (line, column) pairs — two candidate
-        columns per line on average (the 83-pattern builtin library over a
-        status-heavy corpus measures ~1.7/line: common tokens like
-        "status" are required literals of some column). Verification cost
-        is one dense-regex scan over K_rec rows, independent of library
-        width."""
+        Stage 2 accumulates per-column hit words over the COMPACTED hit
+        rows (capacity B//8); when the compaction overflows — common
+        literals firing on most lines — a ``lax.cond`` runs the words
+        pass over the whole batch instead. Stage 3 compacts candidate
+        (line, column) pairs (capacity 2B — the builtin library over a
+        status-heavy corpus measures ~1.7/line) and verifies each record
+        against its column's exact DFA, falling back to the dense scan
+        over all prefiltered columns on overflow."""
         T, B = lines_tb.shape
+        # Capacities are STATIC shapes, so they tax every batch: K_hit stays
+        # proportional (a 1024-row floor measured -40% on the 4096-row
+        # config-4 bench), but K_rec must hold the measured real-world
+        # candidate density (~1.7 (line, col) pairs per line on the builtin
+        # bank: common literals fire on most lines) — shrinking it to
+        # 4*K_hit would send hit-heavy corpora to the dense fallback every
+        # batch, the exact cliff this tier exists to avoid.
+        K_hit = min(B, max(128, B // 8))
         K_rec = 2 * B
 
-        cand = self.unpack_candidates(hit_words)  # [B, n_cols]
-        n_rec, rec_flat, rec_valid = _compact(cand.reshape(-1), K_rec)
-        rec_line = rec_flat // self.n_cols
-        rec_pcol = rec_flat % self.n_cols
+        n_hit, hit_rows, hit_valid = _compact(any_hit, K_hit)
 
+        # ---- stage 2: candidate records, fixed [K_rec] shapes ------------
+        def words_sparse(_):
+            sub_len = jnp.where(hit_valid, lengths[hit_rows], 0)
+            h = self._word_scan(lines_tb[:, hit_rows], sub_len)  # [K_hit, W]
+            cand = self.unpack_candidates(h) & hit_valid[:, None]
+            n_rec, rec_flat, rec_valid = _compact(cand.reshape(-1), K_rec)
+            rec_line = hit_rows[rec_flat // self.n_cols]
+            rec_pcol = rec_flat % self.n_cols
+            return n_rec, rec_line, rec_pcol, rec_valid
+
+        def words_full(_):
+            h = self._word_scan(lines_tb, lengths)  # [B, W]
+            cand = self.unpack_candidates(h)
+            n_rec, rec_flat, rec_valid = _compact(cand.reshape(-1), K_rec)
+            return n_rec, rec_flat // self.n_cols, rec_flat % self.n_cols, rec_valid
+
+        n_rec, rec_line, rec_pcol, rec_valid = jax.lax.cond(
+            n_hit <= K_hit, words_sparse, words_full, operand=None
+        )
+
+        # ---- stage 3: exact verification ---------------------------------
         def sparse(_):
             ver = self.verify_records(
                 lines_tb, lengths, rec_line, rec_pcol, rec_valid
