@@ -55,6 +55,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, u8p, i64p, i64p, ctypes.c_int64,
     ]
     lib.lpn_split_fill.restype = None
+    lib.lpn_split_lengths.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64, i32p]
+    lib.lpn_split_lengths.restype = None
 
     lib.lpn_dfa_build.argtypes = [
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
@@ -116,6 +118,11 @@ def get_lib() -> ctypes.CDLL | None:
             _lib = _bind(ctypes.CDLL(str(_SO)))
         except OSError as e:
             log.warning("native library unavailable: %s", e)
+            _lib = None
+        except AttributeError as e:
+            # a prebuilt .so from an older source revision lacks newly
+            # added symbols — fall back to pure Python, never crash
+            log.warning("native library is stale (missing symbol): %s", e)
             _lib = None
     return _lib
 

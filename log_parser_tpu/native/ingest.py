@@ -21,9 +21,10 @@ from log_parser_tpu.golden.javacompat import java_split_lines
 from log_parser_tpu.native import get_lib
 from log_parser_tpu.ops.encode import (
     DEFAULT_MAX_LINE_BYTES,
+    DEFAULT_WIDTH_MULTIPLE,
     EncodedLines,
-    _next_pow2,
     _pad_rows,
+    device_width,
     encode_lines,
 )
 
@@ -39,7 +40,7 @@ class Corpus:
         self,
         logs: str,
         max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
-        pad_to_multiple: int = 128,
+        pad_to_multiple: int = DEFAULT_WIDTH_MULTIPLE,
         min_rows: int = 8,
     ):
         lib = get_lib()
@@ -69,10 +70,14 @@ class Corpus:
         n = lib.lpn_split_scan(bufp, len(blob), ctypes.byref(max_len))
         self.n_lines = int(n)
 
-        width = int(min(max_len.value, max_line_bytes))
-        width = max(
-            pad_to_multiple,
-            _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple),
+        true_lengths = np.zeros(max(1, self.n_lines), dtype=np.int32)
+        if self.n_lines:
+            lib.lpn_split_lengths(
+                bufp, len(blob), self.n_lines,
+                true_lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+        width = device_width(
+            true_lengths[: self.n_lines], max_line_bytes, pad_to_multiple
         )
         rows = _pad_rows(self.n_lines, min_rows)
 
@@ -93,6 +98,13 @@ class Corpus:
             ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             max_line_bytes,
         )
+        # the capped-width tail (width < len <= max_line_bytes) re-matches
+        # on the host, exactly like non-ASCII lines (the C fill only flags
+        # len > max_line_bytes)
+        if self.n_lines:
+            needs_host[: self.n_lines] |= (
+                true_lengths[: self.n_lines] > width
+            ).astype(np.uint8)
         self._starts = starts
         self._ends = ends
         self.encoded = EncodedLines(

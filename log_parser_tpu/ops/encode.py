@@ -55,19 +55,59 @@ class EncodedLines:
     n_lines: int
 
 
+# Device scan cost is linear in the padded width T (the SCAN axis — the
+# batch axis B carries the TPU's 128-lane alignment, so T only needs to be
+# even for the pair scan; 32 keeps the compile-shape set small). A handful
+# of over-long lines (stack frames with JSON payloads, ...) must not
+# double every line's scan steps: T is capped at the rung covering this
+# quantile of line lengths when that at least HALVES the full-width rung,
+# and the tail is re-matched on the host via the needs_host override path
+# — the same mechanism non-ASCII lines already use.
+WIDTH_COVERAGE = 0.995
+# capping must not buy device time with an unbounded host bill: every
+# tail line re-matches through Python `re` across all device columns, so
+# beyond this many tail lines the batch keeps the full width
+WIDTH_MAX_HOST_TAIL = 256
+DEFAULT_WIDTH_MULTIPLE = 32
+
+
+def device_width(
+    lengths: np.ndarray, max_line_bytes: int, pad_to_multiple: int
+) -> int:
+    """The padded scan width for a batch with these (true) line lengths."""
+
+    def rung(w: int) -> int:
+        return max(
+            pad_to_multiple,
+            _next_pow2(-(-w // pad_to_multiple) * pad_to_multiple),
+        )
+
+    full = rung(int(min(lengths.max(initial=0), max_line_bytes)))
+    if len(lengths) == 0:
+        return full
+    cover = rung(
+        int(min(np.quantile(lengths, WIDTH_COVERAGE), max_line_bytes))
+    )
+    if cover * 2 > full:
+        return full
+    if int(np.count_nonzero(lengths > cover)) > WIDTH_MAX_HOST_TAIL:
+        return full
+    return cover
+
+
 def encode_lines(
     lines: list[str],
     max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
-    pad_to_multiple: int = 128,
+    pad_to_multiple: int = DEFAULT_WIDTH_MULTIPLE,
     min_rows: int = 8,
 ) -> EncodedLines:
     """Pack ``lines`` into a padded uint8 matrix.
 
-    The row count is padded up to a multiple of ``min_rows`` (sharding needs
-    divisibility) and the width to a multiple of ``pad_to_multiple`` (TPU
-    lane alignment). Lines can't contain ``\\n`` (they come from the
-    reference's split, AnalysisService.java:53), so a newline join is a safe
-    single-pass encoding.
+    The row count is padded up to a multiple of ``min_rows`` (sharding
+    needs divisibility) and the width per :func:`device_width`. Lines
+    can't contain ``\\n`` (they come from the reference's split,
+    AnalysisService.java:53), so a newline join is a safe single-pass
+    encoding.
     """
     n = len(lines)
     if n == 0:
@@ -85,10 +125,9 @@ def encode_lines(
     ends = np.concatenate([seps, [len(flat)]]).astype(np.int64)
     lengths = (ends - starts).astype(np.int32)
 
-    # pad rows and width to powers of two so jitted kernels see a small,
-    # bounded set of shapes (each distinct shape costs an XLA compile)
-    width = int(min(lengths.max(initial=0), max_line_bytes))
-    width = max(pad_to_multiple, _next_pow2(-(-width // pad_to_multiple) * pad_to_multiple))
+    # pad rows and width to rungs so jitted kernels see a small, bounded
+    # set of shapes (each distinct shape costs an XLA compile)
+    width = device_width(lengths, max_line_bytes, pad_to_multiple)
     rows = _pad_rows(n, min_rows)
 
     # fill in row chunks: a full [n, width] gather-index matrix would cost
@@ -107,7 +146,10 @@ def encode_lines(
     non_ascii = np.zeros(rows, dtype=bool)
     non_ascii[:n] = np.bitwise_or.reduce(u8[:n] & 0x80, axis=1) != 0
     over_long = np.zeros(rows, dtype=bool)
-    over_long[:n] = lengths > max_line_bytes
+    # host re-match when the device row can't hold the full line: the
+    # capped-width tail OR max_line_bytes overflow (same rule as the
+    # native Corpus path: C fill flags the latter, ingest.py the former)
+    over_long[:n] = (lengths > width) | (lengths > max_line_bytes)
 
     full_lengths = np.zeros(rows, dtype=np.int32)
     full_lengths[:n] = np.minimum(lengths, width)

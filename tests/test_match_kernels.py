@@ -81,8 +81,18 @@ class TestEncode:
         assert enc.n_lines == 0 and enc.u8.shape[0] >= 8
 
     def test_width_alignment(self):
+        # T is the scan axis (B carries the 128-lane alignment); it pads
+        # to the width multiple and stays even for the pair scan
         enc = encode_lines(["abc"])
-        assert enc.u8.shape[1] % 128 == 0
+        assert enc.u8.shape[1] % 32 == 0
+
+    def test_width_capped_tail_reflagged(self):
+        # one pathological long line must not widen every row's scan:
+        # width rides the 99.5% quantile and the tail re-matches on host
+        lines = ["short line"] * 999 + ["x" * 2000]
+        enc = encode_lines(lines)
+        assert enc.u8.shape[1] <= 64
+        assert enc.needs_host[999] and not enc.needs_host[0]
 
 
 def test_pair_stride_equals_single_stride():
