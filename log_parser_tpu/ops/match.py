@@ -378,6 +378,18 @@ class MatcherBanks:
     SHIFTOR_MIN_COLUMNS_TPU = 1
     PREFILTER_MIN_COLUMNS_TPU = 8
 
+    # Shift-Or's per-byte cost is a [B, n_words] mask gather — linear in
+    # the packed WORD count (≈ total literal bytes / 32), not the column
+    # count. A 1008-literal-column synthetic bank packs 1488 words and its
+    # mask gather alone cost 4.5x the whole prefilter-routed cube (PERF.md
+    # §6); beyond this word budget, DFA-backed literal columns join the
+    # dense-eligible pool and ride the width policy (union / prefilter)
+    # instead. Columns with no DFA stay on Shift-Or regardless — it is
+    # their only device tier. 128 words keeps the builtin bank (66 words,
+    # Shift-Or measured at 0.17s/59 columns on TPU) while rerouting the
+    # 1000-word synthetic banks.
+    SHIFTOR_MAX_WORDS = 128
+
     # Union multi-DFA tier (platform-independent: one [B] gather per byte
     # beats a [B, R] gather for R >= 2 everywhere; the native builder makes
     # group packing cheap). Above MULTI_PREFERRED_MAX dense columns the
@@ -398,6 +410,7 @@ class MatcherBanks:
         shiftor_min_columns: int | None = None,
         prefilter_min_columns: int | None = None,
         multi_min_columns: int | None = None,
+        shiftor_max_words: int | None = None,
     ):
         import jax.numpy as jnp
 
@@ -425,6 +438,33 @@ class MatcherBanks:
             if c.dfa is not None or c.exact_seqs is not None
         )
         use_shiftor = n_device >= threshold
+        # Word-budget gate (see SHIFTOR_MAX_WORDS): DFA-backed literal
+        # columns only ride Shift-Or while the packed word count stays
+        # small. Count with the SAME first-fit fill ShiftOrBank uses (a
+        # bits/32 estimate undercounts fragmentation ~2x), and over the
+        # REROUTABLE columns only — no-DFA columns stay on Shift-Or either
+        # way, so their words are a floor the reroute can't remove.
+        word_budget = (
+            self.SHIFTOR_MAX_WORDS
+            if shiftor_max_words is None
+            else shiftor_max_words
+        )
+        word_fill: list[int] = []
+        for c in bank.columns:
+            if c.exact_seqs is None or c.dfa is None:
+                continue
+            for seq in c.exact_seqs:
+                m = len(seq)
+                w = next(
+                    (i for i, used in enumerate(word_fill) if used + m <= 32),
+                    None,
+                )
+                if w is None:
+                    word_fill.append(0)
+                    w = len(word_fill) - 1
+                word_fill[w] += m
+        if len(word_fill) > word_budget:
+            use_shiftor = False
         self.shiftor_cols = [
             i
             for i, c in enumerate(bank.columns)
