@@ -440,31 +440,27 @@ class MatcherBanks:
         use_shiftor = n_device >= threshold
         # Word-budget gate (see SHIFTOR_MAX_WORDS): DFA-backed literal
         # columns only ride Shift-Or while the packed word count stays
-        # small. Count with the SAME first-fit fill ShiftOrBank uses (a
-        # bits/32 estimate undercounts fragmentation ~2x), and over the
-        # REROUTABLE columns only — no-DFA columns stay on Shift-Or either
-        # way, so their words are a floor the reroute can't remove.
+        # small. Counted with ShiftOrBank's own first-fit fill (a bits/32
+        # estimate undercounts fragmentation ~2x), over the REROUTABLE
+        # columns only — no-DFA columns stay on Shift-Or either way, so
+        # their words are a floor the reroute can't remove.
         word_budget = (
             self.SHIFTOR_MAX_WORDS
             if shiftor_max_words is None
             else shiftor_max_words
         )
-        word_fill: list[int] = []
-        for c in bank.columns:
-            if c.exact_seqs is None or c.dfa is None:
-                continue
-            for seq in c.exact_seqs:
-                m = len(seq)
-                w = next(
-                    (i for i, used in enumerate(word_fill) if used + m <= 32),
-                    None,
-                )
-                if w is None:
-                    word_fill.append(0)
-                    w = len(word_fill) - 1
-                word_fill[w] += m
-        if len(word_fill) > word_budget:
-            use_shiftor = False
+        if use_shiftor:
+            n_words = ShiftOrBank.count_packed_words(
+                (
+                    len(seq)
+                    for c in bank.columns
+                    if c.exact_seqs is not None and c.dfa is not None
+                    for seq in c.exact_seqs
+                ),
+                budget=word_budget,
+            )
+            if n_words > word_budget:
+                use_shiftor = False
         self.shiftor_cols = [
             i
             for i, c in enumerate(bank.columns)

@@ -20,11 +20,15 @@ CANNOT be position j of the sequence (Shift-Or convention: 0 = still
 alive). A sequence has matched at this position iff bit (o+m-1) is 0; hits
 accumulate over positions ``t < length``.
 
-The row-select ``mask[byte]`` is expressed two ways: a small-table
-``jnp.take`` (default) and a one-hot [B,256] @ [256, planes] matmul
-(``onehot=True``) that maps onto the MXU for very wide banks — exact,
-because a one-hot row picks a single table row and the u32 words travel as
-four f32-exact byte planes.
+The row-select ``mask[byte]`` is a small-table ``jnp.take`` ([256, W]
+rows, contiguous — measured 0.17s for the 59-column builtin bank over
+200k lines on TPU v5e). A one-hot MXU matmul variant was prototyped and
+DELETED (VERDICT r2 #6): with the SHIFTOR_MAX_WORDS gate this tier only
+ever runs at <=128 words where the take is already cheap, the matmul
+would materialize a [B, 256] f32 one-hot (~235 MB per scan step at the
+229k-row config-2 batch — pure HBM traffic), and the very wide banks an
+MXU formulation could serve no longer reach Shift-Or at all (they route
+to the any-hit prefilter, PERF.md §6).
 """
 
 from __future__ import annotations
@@ -48,6 +52,27 @@ class _PackedSeq:
 
 class ShiftOrBank:
     """Packed Shift-Or program for a set of (column, sequences) entries."""
+
+    @staticmethod
+    def count_packed_words(
+        seq_lengths, budget: int | None = None
+    ) -> int:
+        """First-fit word count for sequences of these lengths — THE
+        packing rule of ``__init__`` (single source: tier gates that
+        estimate the word cost must agree with the real packer). With a
+        ``budget``, returns early once the count exceeds it."""
+        word_fill: list[int] = []
+        for m in seq_lengths:
+            w = next(
+                (i for i, used in enumerate(word_fill) if used + m <= 32),
+                None,
+            )
+            if w is None:
+                word_fill.append(0)
+                if budget is not None and len(word_fill) > budget:
+                    return len(word_fill)
+            word_fill[w if w is not None else -1] += m
+        return len(word_fill)
 
     def __init__(self, column_seqs: list[tuple[int, tuple[ByteSeq, ...]]]):
         self.columns = [c for c, _ in column_seqs]
@@ -99,36 +124,15 @@ class ShiftOrBank:
             [slot_of_col[ps.column] for ps in packed], dtype=np.int32
         )
 
-        # one-hot matmul variant: u32 words as 4 exact f32 byte planes
-        planes = np.zeros((256, self.n_words * 4), dtype=np.float32)
-        for shift in range(4):
-            planes[:, shift::4] = ((mask >> (8 * shift)) & 0xFF).astype(np.float32)
-        self._planes = jnp.asarray(planes)
-
     # --------------------------------------------------------------- device
 
-    def _row_select_take(self, bytes_t: jax.Array) -> jax.Array:
+    def _row_select(self, bytes_t: jax.Array) -> jax.Array:
         return jnp.take(self.mask, bytes_t.astype(jnp.int32), axis=0)  # [B, W]
 
-    def _row_select_onehot(self, bytes_t: jax.Array) -> jax.Array:
-        onehot = (
-            bytes_t[:, None] == jnp.arange(256, dtype=jnp.int32)[None, :]
-        ).astype(jnp.float32)
-        planes = jnp.dot(
-            onehot, self._planes, preferred_element_type=jnp.float32
-        )  # [B, 4W] exact: one-hot row-select
-        chunks = planes.reshape(-1, self.n_words, 4).astype(jnp.uint32)
-        return (
-            chunks[:, :, 0]
-            | (chunks[:, :, 1] << 8)
-            | (chunks[:, :, 2] << 16)
-            | (chunks[:, :, 3] << 24)
-        )
-
-    def pair_stepper(self, B: int, lengths: jax.Array, onehot: bool = False):
+    def pair_stepper(self, B: int, lengths: jax.Array):
         """(init, step(carry, b1, b2, t), finish) — composable with the DFA
         bank's stepper into one fused scan over byte pairs."""
-        select = self._row_select_onehot if onehot else self._row_select_take
+        select = self._row_select
         d0 = jnp.full((B, self.n_words), 0xFFFFFFFF, dtype=jnp.uint32)
         hits0 = jnp.zeros((B, self.n_words), dtype=jnp.uint32)
 
@@ -162,13 +166,13 @@ class ShiftOrBank:
         return (d0, hits0), step, finish
 
     def _run(
-        self, lines_tb: jax.Array, lengths: jax.Array, onehot: bool = False
+        self, lines_tb: jax.Array, lengths: jax.Array
     ) -> jax.Array:
         """lines_tb: uint8 [T, B]; returns bool [B, n_columns_in_bank]."""
         from log_parser_tpu.ops.match import pack_byte_pairs
 
         T, B = lines_tb.shape
-        init, step, finish = self.pair_stepper(B, lengths, onehot)
+        init, step, finish = self.pair_stepper(B, lengths)
         pairs, ts = pack_byte_pairs(lines_tb)
         carry, _ = jax.lax.scan(
             lambda c, xs: (step(c, xs[0][0], xs[0][1], xs[1]), None),

@@ -38,8 +38,7 @@ def _bank_for(regexes: list[str]) -> tuple[ShiftOrBank, list[re.Pattern]]:
     return ShiftOrBank(entries), hosts
 
 
-@pytest.mark.parametrize("onehot", [False, True])
-def test_exactness_vs_host_re(onehot):
+def test_exactness_vs_host_re():
     bank, hosts = _bank_for(REGEXES)
     rng = random.Random(11)
     alphabet = "aAbx45 GCgcOutfMemoryErrConnectionRefusedTimeoutcodestatus=d019"
@@ -60,7 +59,7 @@ def test_exactness_vs_host_re(onehot):
     ]
     enc = encode_lines(lines)
     got = np.asarray(
-        bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths), onehot=onehot)
+        bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths))
     )
     for i, host in enumerate(hosts):
         expect = np.zeros(len(lines), dtype=bool)
@@ -103,3 +102,38 @@ def test_adaptive_tier_split(monkeypatch):
     wide = MatcherBanks(bank, shiftor_min_columns=1)
     assert wide.shiftor is not None
     assert len(wide.shiftor_cols) == 8  # all literal-shaped primaries
+
+
+def test_word_budget_gate_reroutes_and_stays_exact():
+    """A small shiftor_max_words reroutes DFA-backed literal columns off
+    Shift-Or (no-DFA columns stay — it is their only device tier) and the
+    rerouted bank produces an identical match cube."""
+    import jax.numpy as jnp
+
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    patterns = [
+        make_pattern(f"p{i}", regex=f"needle-{i:04d}", confidence=0.5)
+        for i in range(80)  # ~80 x 11 bytes -> ~28 packed words
+    ]
+    bank = PatternBank([make_pattern_set(patterns)])
+
+    wide = MatcherBanks(bank, shiftor_min_columns=1)
+    assert wide.shiftor is not None and len(wide.shiftor_cols) == 80
+
+    gated = MatcherBanks(bank, shiftor_min_columns=1, shiftor_max_words=4)
+    assert gated.shiftor is None
+    assert len(gated.multi_cols) + len(gated.prefilter_cols) + len(
+        gated.dfa_cols
+    ) >= 80  # every literal column found another tier
+
+    lines = [f"x needle-{i:04d} y" for i in range(0, 80, 7)] + ["no match here"]
+    enc = encode_lines(lines)
+    lt = jnp.asarray(enc.u8.T)
+    ln = jnp.asarray(enc.lengths)
+    np.testing.assert_array_equal(
+        np.asarray(wide.cube(lt, ln))[: len(lines)],
+        np.asarray(gated.cube(lt, ln))[: len(lines)],
+    )
