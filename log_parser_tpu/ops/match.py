@@ -192,18 +192,22 @@ class DfaBank:
 class MultiDfaBank:
     """One union multi-pattern DFA group on device (multidfa.py).
 
-    R patterns ride ONE automaton. The hot scan is TWO ``[B]`` gathers per
-    byte — byte class, then a packed transition word carrying a "this
-    state can report a match" flag in bit 30 — cost independent of R, vs
-    the dense tier's ``[B, R]`` gather (measured ~150ms/regex/200k lines
-    on TPU v5e, PERF.md). Exact per-pattern hit words are recovered after
-    the scan by re-scanning ONLY the flagged rows (matching log lines are
-    rare) through the full output-word tables, with an in-program
-    ``lax.cond`` dense re-scan when the flagged-row capacity overflows —
-    the same robustness shape as the prefilter tier.
+    R patterns ride ONE automaton. The hot scan is ONE ``[B]`` gather per
+    byte: the byte-class map is precomposed into the transition table
+    (``[S, 256]`` — at most 8 MB under the 8192-state budget), whose
+    packed words carry a "this state can report a match" flag in bit 30 —
+    cost independent of R, vs the dense tier's ``[B, R]`` gather (measured
+    ~150ms/regex/200k lines on TPU v5e, PERF.md; per-element random
+    gathers run on the scalar unit, so eliminating the separate
+    byte→class gather halves the tier's hot cost). Exact per-pattern hit
+    words are recovered after the scan by re-scanning ONLY the flagged
+    rows (matching log lines are rare) through the full output-word
+    tables, with an in-program ``lax.cond`` dense re-scan when the
+    flagged-row capacity overflows — the same robustness shape as the
+    prefilter tier.
 
-    Steps one byte at a time: a pair-precomposed table would be S·C² and
-    the union automaton's C is large.
+    Steps one byte at a time: a pair-precomposed table would be S·256²
+    per step and the union automaton's S is large.
     """
 
     _REPORT_BIT = 1 << 30
@@ -215,7 +219,6 @@ class MultiDfaBank:
         self.n_words = md.n_words
         S, C = md.trans.shape
         self.n_states, self.n_classes = S, C
-        self.byte_class = jnp.asarray(md.byte_class)
         # word-ness per BYTE (precomposed through the class map): the out2
         # row index is state*2 + word-ness of the incoming byte
         self.byte_rw = jnp.asarray(md.cls_is_word[md.byte_class])
@@ -233,8 +236,29 @@ class MultiDfaBank:
         packed = md.trans.astype(np.int64) | (
             reports.astype(np.int64)[md.trans] << 30
         )
-        self.flat_packed = jnp.asarray(packed.reshape(-1).astype(np.int32))
+        # byte-precomposed: trans_byte[s, b] = packed[s, byte_class[b]].
+        # Host-side until first use: when the group joins a
+        # MultiDfaCluster, the cluster's concatenated device buffer is
+        # shared back (via _adopt_table) so the table exists on device
+        # exactly once however it is reached.
+        self._packed_byte_np = packed[:, md.byte_class].reshape(-1).astype(np.int32)
+        self._flat: jax.Array | None = None
+        self._flat_base = 0
         self.start_reports = bool(reports[md.start])
+
+    def _table(self) -> tuple[jax.Array, int]:
+        """(device buffer, base offset) of this group's byte-precomposed
+        transition table, uploading it standalone on first use."""
+        if self._flat is None:
+            self._flat = jnp.asarray(self._packed_byte_np)
+        return self._flat, self._flat_base
+
+    def _adopt_table(self, flat: jax.Array, base: int) -> None:
+        # the host copy is kept (host RAM, not HBM): a later cluster over
+        # the same groups — re-tiering, probe tools — must be able to
+        # rebuild the concatenated buffer
+        self._flat = flat
+        self._flat_base = int(base)
 
     # ------------------------------------------------------- hot scan stage
 
@@ -242,15 +266,14 @@ class MultiDfaBank:
         """(init, step(carry, b1, b2, t), finish_carry) — carry is
         (state [B] int32, reported [B] bool). The cube slice is produced
         by :meth:`contribution` from the finished carry."""
-        C = self.n_classes
+        flat, base = self._table()
         init = (
             jnp.full((B,), self.start, jnp.int32),
             jnp.full((B,), self.start_reports, bool),
         )
 
         def one(s, rep, b, ok):
-            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
-            v = jnp.take(self.flat_packed, s * C + cls)
+            v = jnp.take(flat, base + s * 256 + b.astype(jnp.int32))
             nxt = v & self._STATE_MASK
             flag = v >= self._REPORT_BIT
             s = jnp.where(ok, nxt, s)
@@ -274,7 +297,7 @@ class MultiDfaBank:
     def word_stepper(self, N: int, lengths: jax.Array):
         """Composable pair-stepper for the exact out-word pass. Carry:
         (state [N] int32, hit_words [N, W] uint32)."""
-        C = self.n_classes
+        flat, base = self._table()
         init = (
             jnp.full((N,), self.start, jnp.int32),
             jnp.zeros((N, self.n_words), jnp.uint32),
@@ -282,11 +305,10 @@ class MultiDfaBank:
 
         def one(s, h, b, ok):
             b32 = b.astype(jnp.int32)
-            cls = jnp.take(self.byte_class, b32)
             rw = jnp.take(self.byte_rw, b32)
             ow = jnp.take(self.out2, s * 2 + rw, axis=0)  # [N, W]
             h = h | jnp.where(ok[:, None], ow, jnp.uint32(0))
-            v = jnp.take(self.flat_packed, s * C + cls)
+            v = jnp.take(flat, base + s * 256 + b32)
             s = jnp.where(ok, v & self._STATE_MASK, s)
             return s, h
 
@@ -306,6 +328,69 @@ class MultiDfaBank:
     def unpack(self, h: jax.Array) -> jax.Array:
         """uint32 [N, W] hit words -> bool [N, n_cols]."""
         return unpack_hit_words(h, self.n_cols)
+
+
+class MultiDfaCluster:
+    """All union groups advanced by ONE ``[B, G]`` gather per byte.
+
+    Running each group as its own stepper inside the fused scan measured
+    ~2x the sum of the groups run alone (tools/probe_tiers.py: 4 groups at
+    0.13-0.15s each alone, 1.03s fused — the scalar-unit gather code
+    XLA emits for several independent gathers in one loop body schedules
+    worse than one wider gather). Concatenating the groups'
+    byte-precomposed tables and carrying states as ``[B, G]`` makes the
+    whole tier one take per byte, restoring per-element throughput."""
+
+    def __init__(self, groups: list[MultiDfaBank]):
+        self.groups = groups
+        sizes = [g.n_states * 256 for g in groups]
+        base = np.zeros(len(groups), dtype=np.int64)
+        base[1:] = np.cumsum(sizes[:-1])
+        assert base[-1] + sizes[-1] < (1 << 31), "cluster table exceeds int32"
+        self._base = jnp.asarray(base.astype(np.int32))[None, :]  # [1, G]
+        self._flat = jnp.asarray(
+            np.concatenate([g._packed_byte_np for g in groups])
+        )
+        # share the concatenated buffer back so each group's word_stepper
+        # reads the same device memory — the table lives on device once
+        for g, b in zip(groups, base):
+            g._adopt_table(self._flat, b)
+        self._start = jnp.asarray(
+            np.asarray([g.start for g in groups], np.int32)
+        )
+        self._start_reports = jnp.asarray(
+            np.asarray([g.start_reports for g in groups], bool)
+        )
+
+    def pair_stepper(self, B: int, lengths: jax.Array):
+        """Carry: (states [B, G] int32, reported [B, G] bool); finish
+        returns the per-group reported columns in group order."""
+        G = len(self.groups)
+        mask = jnp.int32(MultiDfaBank._STATE_MASK)
+        init = (
+            jnp.broadcast_to(self._start[None, :], (B, G)).astype(jnp.int32),
+            jnp.broadcast_to(self._start_reports[None, :], (B, G)),
+        )
+
+        def one(s, rep, b, ok):
+            idx = self._base + s * 256 + b.astype(jnp.int32)[:, None]
+            v = jnp.take(self._flat, idx)  # [B, G]
+            s = jnp.where(ok[:, None], v & mask, s)
+            rep = rep | (ok[:, None] & (v >= MultiDfaBank._REPORT_BIT))
+            return s, rep
+
+        def step(carry, b1, b2, t):
+            s, rep = carry
+            p0 = 2 * t
+            s, rep = one(s, rep, b1, p0 < lengths)
+            s, rep = one(s, rep, b2, p0 + 1 < lengths)
+            return (s, rep)
+
+        def finish(carry):
+            _, rep = carry
+            return [rep[:, i] for i in range(G)]
+
+        return init, step, finish
 
 
 class AcRunner:
@@ -559,6 +644,12 @@ class MatcherBanks:
             self.prefilter_cols = [g for g, _ in pref_selected]
 
         self.dfa_cols = dense_cols
+        # built once: cube() runs under jit, and constructing the cluster
+        # there would re-run the table concatenation and bake a duplicate
+        # copy of the fused table into every compiled executable
+        self.multi_cluster = (
+            MultiDfaCluster(self.multi_groups) if self.multi_groups else None
+        )
         self.dfa_bank = DfaBank(
             [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
         )
@@ -604,9 +695,10 @@ class MatcherBanks:
             steppers.append(
                 (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
             )
-        for group in self.multi_groups:
+        if self.multi_cluster is not None:
+            cluster = self.multi_cluster
             steppers.append(
-                (group.pair_stepper(B, lengths), group, False)
+                (cluster.pair_stepper(B, lengths), cluster, False)
             )
         if self.prefilter is not None:
             steppers.append(
@@ -636,8 +728,8 @@ class MatcherBanks:
                     :, jnp.asarray(np.asarray(self.prefilter_cols))
                 ].set(contrib)
                 continue
-            if isinstance(cols, MultiDfaBank):  # (state, reported) carry
-                multi_reps.append(out[1])
+            if isinstance(cols, MultiDfaCluster):  # per-group reported cols
+                multi_reps.extend(out)
                 continue
             if is_dfa:
                 out = out[:, : len(cols)]

@@ -91,10 +91,18 @@ class PrefilterBank:
         self.n_words = self.ac.n_words
         # scan RAW bytes against folded literals: compose ASCII folding into
         # the byte-class table so folding costs nothing at runtime
-        self.byte_class = jnp.asarray(self.ac.byte_class[_FOLD])
-        self.goto = jnp.asarray(self.ac.goto)
+        byte_class = self.ac.byte_class[_FOLD]
         self.out_words = jnp.asarray(self.ac.out_words)
-        self.has_out = jnp.asarray(self.ac.has_out)
+        # Byte-precomposed goto with the DESTINATION's has-output flag in
+        # bit 30: goto_byte[s, b] = nxt | (has_out[nxt] << 30). Turns the
+        # any-hit stage's three per-element random gathers per byte (class,
+        # goto, has_out) into ONE — per-element random gathers are
+        # scalar-unit bound on TPU (PERF.md §1), so this triples the
+        # stage's throughput. The trie is capped at MAX_PREFILTER_LITERALS
+        # total literal bytes, so states ≤ ~65k → table ≤ ~67 MB int32.
+        goto_b = self.ac.goto[:, byte_class]  # [S, 256] int32
+        packed = goto_b | (self.ac.has_out[goto_b].astype(np.int32) << 30)
+        self.flat_goto_byte = jnp.asarray(packed.reshape(-1))
 
     @staticmethod
     def select(entries, budget: int = MAX_PREFILTER_LITERALS):
@@ -122,18 +130,19 @@ class PrefilterBank:
 
     def anyhit_stepper(self, B: int, lengths: jax.Array):
         """Composable pair-stepper for the main fused scan. Carry:
-        (ac_state [B] int32, any_hit [B] bool) — 3 [B] gathers per byte,
-        independent of library width."""
+        (ac_state [B] int32, any_hit [B] bool) — ONE [B] gather per byte
+        through the byte-precomposed flagged goto table, independent of
+        library width."""
+        mask = jnp.int32((1 << 30) - 1)
         init = (
             jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), bool),
         )
 
         def one(s, a, b, ok):
-            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
-            nxt = self.goto[s, cls]
-            s = jnp.where(ok, nxt, s)
-            a = a | (ok & jnp.take(self.has_out, s))
+            v = jnp.take(self.flat_goto_byte, s * 256 + b.astype(jnp.int32))
+            s = jnp.where(ok, v & mask, s)
+            a = a | (ok & (v >= (1 << 30)))
             return s, a
 
         def step(carry, b1, b2, t):
@@ -159,9 +168,8 @@ class PrefilterBank:
         )
 
         def one(s, w, b, ok):
-            cls = jnp.take(self.byte_class, b.astype(jnp.int32))
-            nxt = self.goto[s, cls]
-            s = jnp.where(ok, nxt, s)
+            v = jnp.take(self.flat_goto_byte, s * 256 + b.astype(jnp.int32))
+            s = jnp.where(ok, v & jnp.int32((1 << 30) - 1), s)
             w = w | jnp.where(
                 ok[:, None], jnp.take(self.out_words, s, axis=0), jnp.uint32(0)
             )
