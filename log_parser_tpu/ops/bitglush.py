@@ -1,0 +1,226 @@
+"""Gather-free bit-parallel regex engine (extended Shift-And).
+
+Executes :class:`~log_parser_tpu.patterns.regex.bitprog.BitProgram`
+columns — classes, ``+``/``*``/``?`` repeats, ``.*`` gaps, alternations,
+``^``/``$``/``\\b``/``\\B`` — with NO per-element random gathers: per byte
+the whole bank costs one contiguous ``[256, W]`` mask-row take plus
+elementwise vector ops on ``[B, W]`` words. This replaces the union
+multi-DFA tier's ``[B, G]`` transition gather (scalar-unit bound at ~9ns
+per element, PERF.md §1) for every column whose regex fits the
+bit-parallel fragment, turning the match cube's dominant cost into pure
+VPU work.
+
+Execution model (Glushkov positions, Shift-And active-high): bit ``g`` of
+the state word means "some containment attempt has consumed exactly the
+items up to and including position ``g``, ending at the current byte".
+Per consumed byte:
+
+1. candidates ``C`` = state shifted one position (cross-word carry; entry
+   into ``^``-anchored start positions blocked) | start positions (find()
+   restart at every byte — AnalysisService.java:93-95's substring
+   semantics) | ``^`` starts at t=0 only;
+2. ε-closure: a candidate at a skippable (``*``/``?``) position also
+   makes the next position a candidate — unrolled ``max_skip_run`` times;
+3. gate by the per-position assertion mask selected from the previous /
+   current byte word-ness (``\\b``/``\\B``), AND with the byte's class
+   mask row; OR with the self-loop survivors (``+``/``*`` positions whose
+   class admits the byte);
+4. accept: plain finals accumulate into ``hits``; ``$`` finals only at
+   each row's last byte; trailing-``\\b`` finals when the NEXT byte
+   breaks word-ness (checked one step later from the pre-update state,
+   and at end-of-line against the final byte's word-ness).
+
+Positions are packed sequentially across 32-bit words (alternatives may
+span words — unlike Shift-Or there is no 32-position limit); stray
+cross-alternative shifts are harmless because every non-anchored start
+position is re-injected each step anyway, and anchored starts are
+explicitly blocked.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from log_parser_tpu.patterns.regex.bitprog import BitProgram
+
+
+def _is_word(b32: jax.Array) -> jax.Array:
+    """Elementwise [0-9A-Za-z_] test — no table lookup needed."""
+    return (
+        ((b32 >= 48) & (b32 <= 57))
+        | ((b32 >= 65) & (b32 <= 90))
+        | ((b32 >= 97) & (b32 <= 122))
+        | (b32 == 95)
+    )
+
+
+class BitGlushBank:
+    """Packed bit programs for a set of (column, BitProgram) entries."""
+
+    @staticmethod
+    def count_packed_words(programs) -> int:
+        """Sequential packing: positions sum / 32, rounded up."""
+        total = sum(p.n_positions for p in programs)
+        return max(1, -(-total // 32))
+
+    def __init__(self, column_programs: list[tuple[int, BitProgram]]):
+        self.columns = [c for c, _ in column_programs]
+        total = sum(p.n_positions for _, p in column_programs)
+        self.n_words = W = self.count_packed_words(
+            [p for _, p in column_programs]
+        )
+        self.n_positions = total
+        self.max_skip_run = max(
+            (p.max_skip_run for _, p in column_programs), default=0
+        )
+
+        bmask = np.zeros((256, W), dtype=np.uint32)
+        s_static = np.zeros(W, dtype=np.uint32)
+        k_skip = np.zeros(W, dtype=np.uint32)
+        start = np.zeros(W, dtype=np.uint32)
+        caret_start = np.zeros(W, dtype=np.uint32)
+        # allow4[pw*2+cw]: positions whose pre-assertion passes
+        allow4 = np.zeros((4, W), dtype=np.uint32)
+        f_plain = np.zeros(W, dtype=np.uint32)
+        f_dollar = np.zeros(W, dtype=np.uint32)
+        f_tb = np.zeros(W, dtype=np.uint32)
+        f_tB = np.zeros(W, dtype=np.uint32)
+
+        fin_word: list[int] = []
+        fin_bit: list[int] = []
+        fin_slot: list[int] = []
+
+        def setbit(arr, g):
+            arr[g // 32] |= np.uint32(1) << np.uint32(g % 32)
+
+        g = 0
+        for slot, (_col, prog) in enumerate(column_programs):
+            for alt in prog.alternatives:
+                base = g
+                for j, item in enumerate(alt.items):
+                    for byte in item.byteset:
+                        setbit(bmask[byte], g)
+                    if item.self_loop:
+                        setbit(s_static, g)
+                    if item.skippable:
+                        setbit(k_skip, g)
+                    if j == 0:
+                        setbit(caret_start if alt.caret else start, g)
+                    for combo in range(4):
+                        pw, cw = combo >> 1, combo & 1
+                        a = item.pre_assert
+                        okc = (
+                            a is None
+                            or (a == "b" and pw != cw)
+                            or (a == "B" and pw == cw)
+                        )
+                        if okc:
+                            setbit(allow4[combo], g)
+                    g += 1
+                ftab = {None: f_plain, "$": f_dollar, "b": f_tb, "B": f_tB}[
+                    alt.post_assert
+                ]
+                for j in alt.final_positions():
+                    setbit(ftab, base + j)
+                    fin_word.append((base + j) // 32)
+                    fin_bit.append((base + j) % 32)
+                    fin_slot.append(slot)
+
+        self.bmask = jnp.asarray(bmask)
+        self.s_static = jnp.asarray(s_static)
+        self.k_skip = jnp.asarray(k_skip)
+        self.start = jnp.asarray(start)
+        self.caret_start = jnp.asarray(caret_start)
+        self.not_caret = jnp.asarray(~caret_start)
+        self.allow4 = jnp.asarray(allow4)
+        self.f_plain = jnp.asarray(f_plain)
+        self.f_dollar = jnp.asarray(f_dollar)
+        self.f_tb = jnp.asarray(f_tb)
+        self.f_tB = jnp.asarray(f_tB)
+        self.has_tb = bool(f_tb.any() or f_tB.any())
+        self.has_dollar = bool(f_dollar.any())
+        self.fin_word = np.asarray(fin_word, dtype=np.int32)
+        self.fin_bit = np.asarray(fin_bit, dtype=np.int32)
+        self.fin_slot = np.asarray(fin_slot, dtype=np.int32)
+
+    # --------------------------------------------------------------- device
+
+    def _shift1(self, d: jax.Array) -> jax.Array:
+        """One-position shift across the packed word stream: bit 31 of
+        word w carries into bit 0 of word w+1."""
+        sh = d << 1
+        if self.n_words > 1:
+            carry = jnp.concatenate(
+                [jnp.zeros_like(d[:, :1]), d[:, :-1] >> 31], axis=1
+            )
+            sh = sh | carry
+        return sh
+
+    def pair_stepper(self, B: int, lengths: jax.Array):
+        """(init, step(carry, b1, b2, t), finish) — composable with the
+        other banks into the single fused scan. Carry: (state [B, W]
+        uint32, hits [B, W] uint32, prev_wordness [B] bool)."""
+        W = self.n_words
+        init = (
+            jnp.zeros((B, W), jnp.uint32),
+            jnp.zeros((B, W), jnp.uint32),
+            jnp.zeros((B,), bool),
+        )
+        zero = jnp.uint32(0)
+
+        def one(d, hits, pw, b, pos):
+            ok = pos < lengths
+            b32 = b.astype(jnp.int32)
+            cw = _is_word(b32)
+            okc = ok[:, None]
+
+            if self.has_tb:
+                bc = (pw != cw)[:, None]
+                hits = hits | jnp.where(okc & bc, d & self.f_tb, zero)
+                hits = hits | jnp.where(okc & ~bc, d & self.f_tB, zero)
+
+            c = (self._shift1(d) & self.not_caret) | self.start
+            # ^-anchored starts inject only at each line's first byte
+            c = c | jnp.where(pos == 0, self.caret_start, zero)
+            for _ in range(self.max_skip_run):
+                c = c | (self._shift1(c & self.k_skip) & self.not_caret)
+
+            sel = pw.astype(jnp.int32) * 2 + cw.astype(jnp.int32)
+            allow = jnp.take(self.allow4, sel, axis=0)  # [B, W]
+            brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
+            d_new = (c & allow & brow) | (d & brow & self.s_static)
+            d = jnp.where(okc, d_new, d)
+
+            hits = hits | jnp.where(okc, d & self.f_plain, zero)
+            eol = (pos == lengths - 1)[:, None]
+            if self.has_dollar:
+                hits = hits | jnp.where(eol, d & self.f_dollar, zero)
+            if self.has_tb:
+                cwc = cw[:, None]
+                hits = hits | jnp.where(eol & cwc, d & self.f_tb, zero)
+                hits = hits | jnp.where(eol & ~cwc, d & self.f_tB, zero)
+            pw = jnp.where(ok, cw, pw)
+            return d, hits, pw
+
+        def step(carry, b1, b2, t):
+            d, hits, pw = carry
+            p0 = 2 * t
+            d, hits, pw = one(d, hits, pw, b1, p0)
+            d, hits, pw = one(d, hits, pw, b2, p0 + 1)
+            return (d, hits, pw)
+
+        def finish(carry):
+            _, hits, _ = carry
+            fin = (
+                jnp.take(hits, jnp.asarray(self.fin_word), axis=1)
+                >> jnp.asarray(self.fin_bit)[None, :]
+            ) & 1  # [B, n_fins]
+            out = jnp.zeros((B, max(1, len(self.columns))), dtype=jnp.int32)
+            out = out.at[:, jnp.asarray(self.fin_slot)].max(
+                fin.astype(jnp.int32)
+            )
+            return out.astype(bool)
+
+        return init, step, finish

@@ -204,18 +204,37 @@ def compact_records(
     ``emit_line``: per-row line index written into the records (global);
     ``gather_line``: per-row index into the dense factor tables (local).
     rank = exclusive match count in flat order == the record's output slot;
-    slot K is the trash row for overflow (caller re-runs at a bigger K)."""
-    B, P = pm.shape
-    pm32 = pm.astype(jnp.int32)
-    flat = pm32.reshape(-1)
-    rank = (jnp.cumsum(flat) - flat).reshape(B, P)
-    n_matches = jnp.sum(flat)
-    out_pos = jnp.where(pm & (rank < K), rank, K).reshape(-1)
+    slot K is the trash row for overflow (caller re-runs at a bigger K).
 
-    emit_bp = jnp.broadcast_to(emit_line[:, None], (B, P)).reshape(-1)
-    gather_bp = jnp.broadcast_to(gather_line[:, None], (B, P)).reshape(-1)
+    Two-level: matching ROWS are compacted first (one [B]-sized pass),
+    then (row, pattern) pairs rank/scatter over only ``K_rows x P``
+    elements — the naive flat [B*P] cumsum + three scatters are
+    per-element scalar-unit work on TPU (like the match-cube gathers,
+    PERF.md §1) and dominated the extraction phase at 19M elements on
+    config-2 shapes. ``K_rows = min(B, K)`` loses nothing: every
+    compacted-out row holds >= 1 match, so row overflow implies
+    ``n_matches > K`` — and ``n_matches`` is summed over the FULL cube,
+    so the caller's ladder re-run triggers exactly as before."""
+    from log_parser_tpu.ops.prefilter import _compact
+
+    B, P = pm.shape
+    n_matches = jnp.sum(pm.astype(jnp.int32))
+
+    K_rows = min(B, K)
+    _n_rows, rows, rows_valid = _compact(pm.any(axis=1), K_rows)
+    sub_pm = pm[rows] & rows_valid[:, None]  # [K_rows, P]
+
+    sub32 = sub_pm.astype(jnp.int32)
+    flat = sub32.reshape(-1)
+    rank = jnp.cumsum(flat) - flat
+    out_pos = jnp.where(flat > 0, jnp.minimum(rank, K), K)
+
+    emit_bp = jnp.broadcast_to(emit_line[rows][:, None], (K_rows, P)).reshape(-1)
+    gather_bp = jnp.broadcast_to(
+        gather_line[rows][:, None], (K_rows, P)
+    ).reshape(-1)
     pats_bp = jnp.broadcast_to(
-        jnp.arange(P, dtype=jnp.int32)[None, :], (B, P)
+        jnp.arange(P, dtype=jnp.int32)[None, :], (K_rows, P)
     ).reshape(-1)
     rec_line = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(emit_bp)[:K]
     rec_grow = jnp.zeros((K + 1,), jnp.int32).at[out_pos].set(gather_bp)[:K]

@@ -1,0 +1,284 @@
+"""Regex → bit-parallel extended Shift-And program.
+
+Compiles the Java-dialect AST (parser.py) into linear *item* alternatives
+executable by the gather-free bit engine (ops/bitglush.py) — the
+Navarro-Raffinot extended Shift-And shaped for the TPU cost model: the
+union multi-DFA tier's per-byte cost is a per-element random gather
+(scalar-unit bound, PERF.md §1), while a bit program advances every
+pattern with one contiguous ``[256, W]`` mask-row take plus elementwise
+vector ops — no random gathers at all.
+
+An *item* consumes bytes from one byte class with a repetition kind:
+
+==========  ===========================  ==========================
+kind        regex shape                  bit mechanics
+==========  ===========================  ==========================
+ONE         ``X``                        plain shift position
+PLUS        ``X+``                       shift position + self-loop
+STAR        ``X*`` (incl. ``.*`` gaps)   self-loop + ε-skippable
+OPT         ``X?``                       ε-skippable
+==========  ===========================  ==========================
+
+Alternations, bounded repeats, and optional groups are expanded into
+independent alternatives (each a linear item list) under caps; ``^``/``$``
+anchor per alternative; ``\\b``/``\\B`` gate a specific item's shift-in
+(``pre_assert``) or the alternative's acceptance (``post_assert``).
+
+Anything that does not reduce to this shape — unbounded repeats of
+multi-position groups, assertions adjacent to skippable items (beyond the
+rewrite below), oversized expansions — raises :class:`BitUnsupportedError`
+and the column stays on its automaton tier. Nothing is ever lost, only
+routed.
+
+Rewrite rule (containment soundness): a leading ``\\b\\w*`` before a
+word-leading tail is dropped — any containment match of ``tail`` whose
+first byte is a word char extends left through word chars to a word start,
+which supplies both the boundary and the ``\\w*`` bytes. This is exactly
+the ``\\b\\w*Exception\\b`` shape of the reference's context regex
+(ContextAnalysisService.java:33).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+from log_parser_tpu.patterns.regex.nfa import Nfa  # noqa: F401 (re-export convenience)
+from log_parser_tpu.patterns.regex.parser import (
+    Alt,
+    Assertion,
+    Cat,
+    Empty,
+    Lit,
+    Node,
+    Rep,
+    WORD_BYTES,
+    parse_java_regex,
+)
+
+ONE, PLUS, STAR, OPT = "one", "plus", "star", "opt"
+
+
+class BitUnsupportedError(ValueError):
+    """Regex shape outside the bit-parallel fragment."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    byteset: frozenset[int]
+    kind: str  # ONE | PLUS | STAR | OPT
+    pre_assert: str | None = None  # None | 'b' | 'B'
+
+    @property
+    def skippable(self) -> bool:
+        return self.kind in (STAR, OPT)
+
+    @property
+    def self_loop(self) -> bool:
+        return self.kind in (STAR, PLUS)
+
+
+@dataclasses.dataclass(frozen=True)
+class BitAlternative:
+    items: tuple[Item, ...]
+    caret: bool = False  # anchored at line start
+    post_assert: str | None = None  # None | '$' | 'b' | 'B'
+
+    @property
+    def n_positions(self) -> int:
+        return len(self.items)
+
+    def final_positions(self) -> list[int]:
+        """Indices that accept: the last item, cascading back through a
+        skippable suffix (``\\)\\s*$`` accepts at ``)`` too)."""
+        out = []
+        i = len(self.items) - 1
+        while i >= 0:
+            out.append(i)
+            if not self.items[i].skippable:
+                break
+            i -= 1
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BitProgram:
+    alternatives: tuple[BitAlternative, ...]
+
+    @property
+    def n_positions(self) -> int:
+        return sum(a.n_positions for a in self.alternatives)
+
+    @property
+    def max_skip_run(self) -> int:
+        """Longest run of consecutive ε-skippable positions — the number
+        of closure applications the engine must unroll."""
+        best = 0
+        for a in self.alternatives:
+            run = 0
+            for it in a.items:
+                run = run + 1 if it.skippable else 0
+                best = max(best, run)
+        return best
+
+
+# ------------------------------------------------------------- expansion
+
+# caps keep the alternative product and the packed width bounded; a column
+# that exceeds them simply stays on the union-DFA tier
+MAX_ALTERNATIVES = 64
+MAX_POSITIONS_PER_ALT = 96
+MAX_BOUNDED_REPEAT = 16
+
+_ASSERT = object()  # marker type tag for assertion elements
+
+
+def _expand(node: Node) -> list[list]:
+    """Node → list of alternatives, each a flat list of Item / ('assert',
+    kind) elements. Raises BitUnsupportedError beyond the fragment/caps."""
+    if isinstance(node, Empty):
+        return [[]]
+    if isinstance(node, Lit):
+        return [[Item(node.byteset, ONE)]]
+    if isinstance(node, Assertion):
+        return [[(_ASSERT, node.kind)]]
+    if isinstance(node, Alt):
+        out: list[list] = []
+        for opt in node.options:
+            out.extend(_expand(opt))
+            if len(out) > MAX_ALTERNATIVES:
+                raise BitUnsupportedError("alternative expansion too large")
+        return out
+    if isinstance(node, Cat):
+        outs: list[list] = [[]]
+        for part in node.parts:
+            exp = _expand(part)
+            if len(outs) * len(exp) > MAX_ALTERNATIVES:
+                raise BitUnsupportedError("alternative expansion too large")
+            outs = [a + b for a, b in itertools.product(outs, exp)]
+        return outs
+    if isinstance(node, Rep):
+        lo, hi = node.lo, node.hi
+        if isinstance(node.child, Lit):
+            bs = node.child.byteset
+            if (lo, hi) == (0, None):
+                return [[Item(bs, STAR)]]
+            if (lo, hi) == (1, None):
+                return [[Item(bs, PLUS)]]
+            if hi is None:  # {m,}: m-1 fixed + PLUS
+                if lo > MAX_BOUNDED_REPEAT:
+                    raise BitUnsupportedError("repeat bound too large")
+                return [[Item(bs, ONE)] * (lo - 1) + [Item(bs, PLUS)]]
+            if hi > MAX_BOUNDED_REPEAT:
+                raise BitUnsupportedError("repeat bound too large")
+            return [[Item(bs, ONE)] * lo + [Item(bs, OPT)] * (hi - lo)]
+        # multi-position child: expand bounded repeats as products
+        if hi is None:
+            raise BitUnsupportedError("unbounded repeat of a group")
+        if hi > 4:
+            raise BitUnsupportedError("group repeat bound too large")
+        child = _expand(node.child)
+        out = []
+        for n in range(lo, hi + 1):
+            pieces: list[list] = [[]]
+            for _ in range(n):
+                pieces = [a + b for a, b in itertools.product(pieces, child)]
+                if len(pieces) > MAX_ALTERNATIVES:
+                    raise BitUnsupportedError("alternative expansion too large")
+            out.extend(pieces)
+            if len(out) > MAX_ALTERNATIVES:
+                raise BitUnsupportedError("alternative expansion too large")
+        return out
+    raise BitUnsupportedError(f"unsupported node {type(node).__name__}")
+
+
+def _attach(elements: list) -> BitAlternative:
+    """Flat element list → BitAlternative with assertions attached to
+    positions; raises on shapes the engine cannot gate exactly."""
+    caret = False
+    items: list[Item] = []
+    pending: str | None = None  # assertion awaiting the next consuming item
+
+    i = 0
+    # leading assertions
+    while i < len(elements) and isinstance(elements[i], tuple):
+        kind = elements[i][1]
+        if kind == "^":
+            caret = True
+        elif pending is None or pending == kind:
+            pending = kind
+        else:
+            raise BitUnsupportedError("conflicting adjacent assertions")
+        i += 1
+
+    post: str | None = None
+    while i < len(elements):
+        el = elements[i]
+        if isinstance(el, tuple):
+            kind = el[1]
+            if kind == "$":
+                # must be trailing (possibly followed by more assertions)
+                rest = elements[i + 1 :]
+                if any(not isinstance(r, tuple) for r in rest):
+                    raise BitUnsupportedError("mid-pattern $")
+                post = "$"
+                i += 1
+                continue
+            if pending is not None and pending != kind:
+                raise BitUnsupportedError("conflicting adjacent assertions")
+            pending = kind
+            i += 1
+            continue
+        item: Item = el
+        if pending is not None:
+            # rewrite: \b + \w* + word-leading next item → drop both
+            nxt = elements[i + 1] if i + 1 < len(elements) else None
+            if (
+                pending == "b"
+                and item.kind == STAR
+                and item.byteset == WORD_BYTES
+                and isinstance(nxt, Item)
+                and nxt.byteset <= WORD_BYTES
+                and nxt.kind in (ONE, PLUS)  # a skippable next could match
+                # empty, leaving a non-word byte as the first consumed one
+            ):
+                pending = None
+                i += 1  # drop the \w* item; nxt keeps no assertion
+                continue
+            if item.skippable:
+                raise BitUnsupportedError("assertion before optional item")
+            item = dataclasses.replace(item, pre_assert=pending)
+            pending = None
+        items.append(item)
+        i += 1
+
+    if pending is not None:
+        if post == "$":
+            raise BitUnsupportedError("assertion combined with $")
+        if pending not in ("b", "B"):
+            raise BitUnsupportedError("trailing anchor assertion")
+        post = pending  # trailing \b / \B
+    if not items:
+        raise BitUnsupportedError("empty (assertion-only) alternative")
+    if len(items) > MAX_POSITIONS_PER_ALT:
+        raise BitUnsupportedError("alternative too long")
+    if all(it.skippable for it in items):
+        raise BitUnsupportedError("alternative matches the empty string")
+    if post in ("b", "B"):
+        # acceptance cascades back through a skippable suffix; the gate is
+        # exact only when every accepting position consumed the byte whose
+        # wordness the engine tests — guaranteed for all cascade members
+        pass
+    return BitAlternative(items=tuple(items), caret=caret, post_assert=post)
+
+
+def compile_bitprog(node: Node) -> BitProgram:
+    """AST → BitProgram, or raise :class:`BitUnsupportedError`."""
+    alts = [_attach(el) for el in _expand(node)]
+    if not alts:
+        raise BitUnsupportedError("no alternatives")
+    return BitProgram(alternatives=tuple(alts))
+
+
+def compile_bitprog_regex(regex: str, case_insensitive: bool) -> BitProgram:
+    return compile_bitprog(parse_java_regex(regex, case_insensitive))

@@ -1,0 +1,274 @@
+"""Bit-parallel extended Shift-And engine: compile coverage, exactness vs
+host ``re`` per feature, fuzz over random lines, and the tier wiring."""
+
+from __future__ import annotations
+
+import random
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from log_parser_tpu.golden.javacompat import compile_java_regex
+from log_parser_tpu.ops.bitglush import BitGlushBank
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import pack_byte_pairs
+from log_parser_tpu.patterns.regex.bitprog import (
+    BitUnsupportedError,
+    compile_bitprog_regex,
+)
+
+
+def run_bank(regexes: list[tuple[str, bool]], lines: list[str]) -> np.ndarray:
+    entries = [
+        (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
+    ]
+    bank = BitGlushBank(entries)
+    enc = encode_lines(lines)
+    lines_tb = jnp.asarray(enc.u8.T)
+    lens = jnp.asarray(enc.lengths)
+    B = enc.u8.shape[0]
+    init, step, finish = bank.pair_stepper(B, lens)
+    pairs, ts = pack_byte_pairs(lines_tb)
+    carry, _ = jax.lax.scan(
+        lambda c, xs: (step(c, xs[0][0], xs[0][1], xs[1]), None),
+        init,
+        (pairs, ts),
+    )
+    return np.asarray(finish(carry))[: len(lines)]
+
+
+def check_exact(regexes: list[tuple[str, bool]], lines: list[str]):
+    got = run_bank(regexes, lines)
+    for j, (rx, ci) in enumerate(regexes):
+        host = compile_java_regex(rx, ci)
+        for i, line in enumerate(lines):
+            want = host.search(line) is not None
+            assert got[i, j] == want, (
+                f"regex {rx!r} ci={ci} line {line!r}: got {got[i, j]}, want {want}"
+            )
+
+
+FEATURES = [
+    # plain literals, incl. one spanning >32 positions (cross-word shift)
+    ("OutOfMemoryError", False),
+    ("A fatal error has been detected by the Java Runtime Environment", False),
+    # classes and bounded repeats
+    ("x[45]\\d\\d", False),
+    ("a{3}b", False),
+    ("ab{2,4}c", False),
+    # plus / star / optional
+    ("Port \\d+ in use", False),
+    ("Exit Code:\\s*137", False),
+    ("colou?r", False),
+    # gaps
+    ("status.*red", False),
+    ("node .* not ready", False),
+    # alternation incl. nested group expansion
+    ("foo|ba[rz]", False),
+    ("liquibase.* (failed|error)", False),
+    ("(sorry, )?too many (connections|clients)", False),
+    # anchors and boundaries
+    ("^startline", False),
+    ("endline$", False),
+    ("^whole line$", False),
+    ("\\btimeout\\b", False),
+    ("\\bdial tcp\\b", False),
+    ("\\b(WARN|WARNING)\\b", True),
+    ("\\b\\w*Exception\\b|\\b\\w*Error\\b", False),
+    ("^\\s*at\\s+[\\w\\.\\$]+\\(.*\\)\\s*$", False),
+    # case-insensitive
+    ("deadlock", True),
+    # non-word boundary
+    ("\\Bood", False),
+]
+
+FEATURE_LINES = [
+    "",
+    "x",
+    "java.lang.OutOfMemoryError: heap",
+    "A fatal error has been detected by the Java Runtime Environment:",
+    "the Java Runtime Environment",
+    "x503 status",
+    "x403",
+    "x903",
+    "aaab",
+    "aab",
+    "abbc abbbbc",
+    "abc",
+    "Port 8080 in use",
+    "Port  in use",
+    "Exit Code:137",
+    "Exit Code: 137",
+    "Exit Code :137",
+    "color colour colouur",
+    "status is red",
+    "statusred",
+    "red status",
+    "node web-1 not ready",
+    "foo bar baz",
+    "liquibase migration error",
+    "liquibase ok",
+    "too many connections",
+    "sorry, too many clients",
+    "sorry too many clients",
+    "startline here",
+    "not startline",
+    "an endline",
+    "endline not",
+    "whole line",
+    " whole line",
+    "timeout after",
+    "timeouts after",
+    "xtimeout",
+    "dial tcp 10.0.0.7",
+    "dials tcp",
+    "warn: warning things",
+    "WARNED",
+    "threw FooException here",
+    "Exceptional",
+    "plain Error",
+    "  at com.example.Service.handle(Service.java:42)",
+    "at com.example.run(X.java:1) extra",
+    "  at  spaced(Y.scala:2)  ",
+    "DEADLOCK found",
+    "good wood",
+    "oodles",
+    "ood start",
+]
+
+
+def test_feature_exactness():
+    check_exact(FEATURES, FEATURE_LINES)
+
+
+def test_builtin_union_columns_exact_on_corpus():
+    """Every builtin dense-eligible regex that compiles to a bit program
+    matches the host `re` exactly over a mixed corpus."""
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    bank = PatternBank(load_builtin_pattern_sets())
+    regexes = []
+    for col in bank.columns:
+        if col.dfa is None or col.exact_seqs is not None:
+            continue
+        try:
+            compile_bitprog_regex(col.regex, col.case_insensitive)
+        except BitUnsupportedError:
+            continue
+        regexes.append((col.regex, col.case_insensitive))
+    assert len(regexes) >= 40  # expect near-total coverage of the 49
+
+    rng = random.Random(7)
+    words = [
+        "ERROR", "error", "timeout", "dial", "tcp", "OOMKilled", "status",
+        "red", "node", "not", "ready", "at", "failed", "Migration", "x",
+        "Exception", "Error", "deadlock", "FATAL:", "too", "many",
+        "connections", "goroutine", "137", "Exit", "Code:", "segfault",
+        "0af3", "(", ")", "running", "[running]", "upstream", "Full", "GC",
+    ]
+    lines = [
+        " ".join(rng.choice(words) for _ in range(rng.randrange(0, 12)))
+        for _ in range(300)
+    ]
+    lines += [
+        "java.lang.OutOfMemoryError: Java heap space",
+        "  at com.example.Service.handle(Service.java:42)",
+        "goroutine 42 [running]",
+        "FATAL:  too many connections",
+        "Exit Code:  137",
+        "segfault at deadbeef",
+        "upstream connect error or disconnect",
+        "node web-1 not ready",
+        "liquibase update failed",
+    ]
+    check_exact(regexes, lines)
+
+
+def test_fuzz_random_ascii():
+    regexes = FEATURES
+    rng = random.Random(1234)
+    alphabet = (
+        "abcdefgxyz XYZ0123459_().:-\t"
+        "ABCDE"
+    )
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 70)))
+        for _ in range(400)
+    ]
+    check_exact(regexes, lines)
+
+
+def test_unsupported_shapes_rejected():
+    for rx in [
+        "(ab)+c",  # unbounded group repeat
+        "a{40}",  # oversized bound
+        "\\bx?y",  # assertion before optional item
+        "^$",  # assertion-only
+        "(a|b)(c|d)(e|f)(g|h)(i|j)(k|l)(m|n)",  # 128 alts > 64 cap
+        "abc^",  # trailing anchor (legal regex, never matches)
+    ]:
+        with pytest.raises(BitUnsupportedError):
+            compile_bitprog_regex(rx, False)
+
+
+def test_boundary_rewrite_requires_consuming_next_item():
+    """'\\b\\w*x?-' must NOT take the \\b\\w* drop rewrite: x? can match
+    empty, leaving the non-word '-' as the first consumed byte, so the
+    boundary requirement survives. The shape is rejected (it routes to
+    the union tier) instead of compiling to a false-positive program;
+    the consuming-next variant still compiles and stays exact."""
+    with pytest.raises(BitUnsupportedError):
+        compile_bitprog_regex("\\b\\w*x?-", False)
+    check_exact(
+        [("\\b\\w*x-", False)],
+        ["a -", "a x-", "ax-", "a-", " -", "x-", "-", "yx-", " yx-"],
+    )
+
+
+def test_matcher_banks_bit_tier_cube_parity():
+    """MatcherBanks with the bit tier forced on (it is TPU-only by
+    default) produces the identical cube to the default CPU tiering over
+    the builtin library."""
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    bank = PatternBank(load_builtin_pattern_sets())
+    bit = MatcherBanks(bank, bitglush_max_words=192)
+    base = MatcherBanks(bank, bitglush_max_words=0)
+    assert len(bit.bitglush_cols) >= 40
+    assert not base.bitglush_cols
+
+    lines = [
+        "java.lang.OutOfMemoryError: Java heap space",
+        "[Full GC (Ergonomics) 255M->250M(256M), 0.41 secs]",
+        "dial tcp 10.0.0.7:5432: Connection refused",
+        "  at com.example.Service.handle(Service.java:42)",
+        "ERROR request failed with IllegalStateException",
+        "goroutine 42 [running]",
+        "FATAL:  too many connections",
+        "liquibase update failed",
+        "node web-1 not ready",
+        "2026-07-29T07:00:00Z INFO reconcile tick 1 status=ok",
+        "",
+    ]
+    enc = encode_lines(lines)
+    lt = jnp.asarray(enc.u8.T)
+    ln = jnp.asarray(enc.lengths)
+    np.testing.assert_array_equal(
+        np.asarray(bit.cube(lt, ln))[: len(lines)],
+        np.asarray(base.cube(lt, ln))[: len(lines)],
+    )
+
+
+def test_word_count():
+    progs = [
+        compile_bitprog_regex(rx, ci) for rx, ci in FEATURES
+    ]
+    assert BitGlushBank.count_packed_words(progs) == BitGlushBank(
+        list(enumerate(progs))
+    ).n_words

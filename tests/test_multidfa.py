@@ -138,11 +138,11 @@ def test_matcher_bank_multi_tier_cube_parity():
     bank = PatternBank([make_pattern_set(patterns)])
     multi = MatcherBanks(
         bank, shiftor_min_columns=10**9, prefilter_min_columns=10**9,
-        multi_min_columns=2,
+        multi_min_columns=2, bitglush_max_words=0,
     )
     dense = MatcherBanks(
         bank, shiftor_min_columns=10**9, prefilter_min_columns=10**9,
-        multi_min_columns=10**9,
+        multi_min_columns=10**9, bitglush_max_words=0,
     )
     assert multi.multi_groups, "multi tier must engage"
     assert not multi.dfa_cols, "every dense column should ride the union"
@@ -178,7 +178,9 @@ def test_engine_parity_with_multi_tier():
     ]
     sets = [make_pattern_set(patterns)]
     engine = AnalysisEngine(sets, ScoringConfig())
-    assert engine.matchers.multi_groups
+    # the bit-parallel tier may absorb compilable columns first; the union
+    # must hold whatever is left on an automaton tier
+    assert engine.matchers.multi_groups or engine.matchers.bitglush_cols
     logs = "\n".join(LINES)
     data = PodFailureData(pod={"metadata": {"name": "m"}}, logs=logs)
     assert_results_match(

@@ -89,7 +89,7 @@ class TestPrefilterTier:
     def test_engaged_for_wide_banks(self):
         bank = _bank_of(PREF_REGEXES)
         mb = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
-                          multi_min_columns=10 ** 9)
+                          multi_min_columns=10 ** 9, bitglush_max_words=0)
         assert mb.prefilter is not None
         assert len(mb.prefilter_cols) >= 32
         # dense DFA bank shrank accordingly
@@ -103,9 +103,9 @@ class TestPrefilterTier:
     def test_sparse_path_parity_with_host(self):
         bank = _bank_of(PREF_REGEXES)
         pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
-                            multi_min_columns=10 ** 9)
+                            multi_min_columns=10 ** 9, bitglush_max_words=0)
         dense = MatcherBanks(bank, prefilter_min_columns=10 ** 9, shiftor_min_columns=10 ** 9,
-                             multi_min_columns=10 ** 9)
+                             multi_min_columns=10 ** 9, bitglush_max_words=0)
         assert pref.prefilter is not None and dense.prefilter is None
         lines = _lines_sparse()
         want = _host_cube(bank, lines)
@@ -117,7 +117,7 @@ class TestPrefilterTier:
         lax.cond dense branch must produce identical results."""
         bank = _bank_of(PREF_REGEXES)
         pref = MatcherBanks(bank, prefilter_min_columns=32, shiftor_min_columns=10 ** 9,
-                            multi_min_columns=10 ** 9)
+                            multi_min_columns=10 ** 9, bitglush_max_words=0)
         lines = [f"conn-{i % 20:03d}: refused and svc-{i % 20:03d}  fatal" for i in range(512)]
         want = _host_cube(bank, lines)
         np.testing.assert_array_equal(_device_cube(pref, lines), want)
@@ -140,8 +140,9 @@ class TestPrefilterTier:
         ]
         sets = [make_pattern_set(patterns)]
         engine = AnalysisEngine(sets, ScoringConfig())
-        # the union multi-DFA tier absorbs these columns at default thresholds
-        assert engine.matchers.multi_groups
+        # a gather-free tier absorbs these columns at default thresholds
+        # (bit-parallel first, union multi-DFA for what it rejects)
+        assert engine.matchers.multi_groups or engine.matchers.bitglush_cols
         logs = "\n".join(_lines_sparse(150))
         data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
         golden = GoldenAnalyzer(sets, ScoringConfig())

@@ -93,9 +93,9 @@ def test_adaptive_tier_split(monkeypatch):
     bank = PatternBank([make_pattern_set(patterns)])
     # under the Shift-Or threshold: nothing on the Shift-Or tier; the
     # columns ride the union multi-DFA (or the dense bank without it)
-    small = MatcherBanks(bank, multi_min_columns=10**9)
+    small = MatcherBanks(bank, multi_min_columns=10**9, bitglush_max_words=0)
     assert small.shiftor is None and len(small.dfa_cols) > 0
-    multi = MatcherBanks(bank)
+    multi = MatcherBanks(bank, bitglush_max_words=0)
     assert multi.shiftor is None
     # every column the no-multi config kept dense rides the union instead
     assert sorted(multi.multi_cols + multi.dfa_cols) == sorted(small.dfa_cols)
@@ -127,7 +127,7 @@ def test_word_budget_gate_reroutes_and_stays_exact():
     assert gated.shiftor is None
     assert len(gated.multi_cols) + len(gated.prefilter_cols) + len(
         gated.dfa_cols
-    ) >= 80  # every literal column found another tier
+    ) + len(gated.bitglush_cols) >= 80  # every literal column found another tier
 
     lines = [f"x needle-{i:04d} y" for i in range(0, 80, 7)] + ["no match here"]
     enc = encode_lines(lines)

@@ -109,6 +109,8 @@ def main() -> None:
     report["tiers"] = {
         "dfa_cols": len(matchers.dfa_cols),
         "shiftor_cols": len(matchers.shiftor_cols),
+        "bitglush_cols": len(matchers.bitglush_cols),
+        "bitglush_words": matchers.bitglush.n_words if matchers.bitglush else 0,
         "multi_groups": len(matchers.multi_groups),
         "multi_cols": len(matchers.multi_cols),
         "prefilter_cols": len(matchers.prefilter_cols),
