@@ -224,6 +224,11 @@ def _attach(elements: list) -> BitAlternative:
                 post = "$"
                 i += 1
                 continue
+            if kind == "^":
+                # a mid-pattern line anchor can still be satisfiable when
+                # the prefix matches empty (e.g. "x*^ab"); the allow-mask
+                # machinery cannot express it, so route to an exact tier
+                raise BitUnsupportedError("mid-pattern ^")
             if pending is not None and pending != kind:
                 raise BitUnsupportedError("conflicting adjacent assertions")
             pending = kind

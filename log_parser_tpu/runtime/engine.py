@@ -141,6 +141,10 @@ class AnalysisEngine:
         clock: Callable[[], float] = time.monotonic,
     ):
         self.config = config or ScoringConfig()
+        # warm restarts must not re-pay multi-second XLA compiles
+        from log_parser_tpu.utils.xlacache import enable_persistent_cache
+
+        enable_persistent_cache()
         self.bank = PatternBank(pattern_sets)
         self.frequency = GoldenFrequencyTracker(self.config, clock=clock)
 

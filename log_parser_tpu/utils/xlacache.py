@@ -1,0 +1,56 @@
+"""Persistent XLA compilation cache wiring.
+
+The fused device program costs seconds to tens of seconds to compile
+(config-4's 10k-regex bank measured ~36-50s cold on the tunneled v5e,
+bench_results/config4_10k_tpu.json) and is recompiled from scratch on
+every process start — a server restart or cron-driven batch job pays it
+again although neither the bank nor the program changed. JAX's
+persistent compilation cache keys serialized executables by HLO +
+platform, so enabling it turns every warm restart's compile into a disk
+read. The reference has no analogue (the JVM starts interpreted and JITs
+as it goes); this is the TPU-native equivalent of that "no compile at
+boot" property.
+
+Enabled by default; ``LOG_PARSER_TPU_XLA_CACHE=0`` disables, any other
+value overrides the cache directory (default
+``~/.cache/log_parser_tpu/xla-cache``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_configured = False
+
+
+def enable_persistent_cache() -> None:
+    """Idempotently point JAX at the persistent compilation cache."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    setting = os.environ.get("LOG_PARSER_TPU_XLA_CACHE", "")
+    if setting in ("0", "false", "off"):
+        return
+    # "1"/"true"/"on" mean "enabled at the default path", not a directory
+    path = (
+        setting
+        if setting not in ("", "1", "true", "on")
+        else os.path.join(
+            os.path.expanduser("~"), ".cache", "log_parser_tpu", "xla-cache"
+        )
+    )
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache small-but-slow entries too: the fused program is one big
+        # executable, but tier probes and admin paths compile small ones
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # pragma: no cover - cache is best-effort
+        log.info("persistent XLA cache unavailable: %s", exc)

@@ -210,6 +210,7 @@ def test_unsupported_shapes_rejected():
         "^$",  # assertion-only
         "(a|b)(c|d)(e|f)(g|h)(i|j)(k|l)(m|n)",  # 128 alts > 64 cap
         "abc^",  # trailing anchor (legal regex, never matches)
+        "x*^ab",  # mid-pattern anchor, satisfiable via empty prefix
     ]:
         with pytest.raises(BitUnsupportedError):
             compile_bitprog_regex(rx, False)
