@@ -41,10 +41,15 @@ import numpy as np
 from log_parser_tpu.ops.match import DfaBank, pack_byte_pairs
 from log_parser_tpu.patterns.regex.ac import AhoCorasick
 
-# prefilter participation cap: total literal bytes in the trie (a
-# pathological library with huge literal sets would blow automaton memory;
-# columns over budget just stay in the dense DFA bank)
-MAX_PREFILTER_LITERALS = 1 << 16
+# Prefilter participation cap: total literal bytes in the trie (columns
+# over budget fall to the union/dense tiers). Trie states ~= literal
+# bytes, so the cap bounds device memory: the byte-precomposed goto is
+# S x 1 KB and the out-words table S x W x 4 B — at the 256 KB cap and a
+# 10k-column library (W=313) that is ~0.6 GB, well inside v5e HBM. The
+# old 64 KB cap stranded 5,894 of a 10k-regex library's columns on ~92
+# union groups (92 [B]-gathers per byte) when the whole point of the
+# any-hit stage is width-independence.
+MAX_PREFILTER_LITERALS = 1 << 18
 
 _FOLD = np.arange(256, dtype=np.uint8)
 _FOLD[ord("A") : ord("Z") + 1] += 32  # ASCII lowercase
@@ -99,7 +104,7 @@ class PrefilterBank:
         # goto, has_out) into ONE — per-element random gathers are
         # scalar-unit bound on TPU (PERF.md §1), so this triples the
         # stage's throughput. The trie is capped at MAX_PREFILTER_LITERALS
-        # total literal bytes, so states ≤ ~65k → table ≤ ~67 MB int32.
+        # total literal bytes, so states ≤ ~262k → table ≤ ~268 MB int32.
         goto_b = self.ac.goto[:, byte_class]  # [S, 256] int32
         packed = goto_b | (self.ac.has_out[goto_b].astype(np.int32) << 30)
         self.flat_goto_byte = jnp.asarray(packed.reshape(-1))

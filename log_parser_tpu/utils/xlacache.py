@@ -33,12 +33,12 @@ def enable_persistent_cache() -> None:
         return
     _configured = True
     setting = os.environ.get("LOG_PARSER_TPU_XLA_CACHE", "")
-    if setting in ("0", "false", "off"):
+    if setting.lower() in ("0", "false", "off", "no", "disabled", "none"):
         return
-    # "1"/"true"/"on" mean "enabled at the default path", not a directory
+    # enable-spellings mean "enabled at the default path", not a directory
     path = (
         setting
-        if setting not in ("", "1", "true", "on")
+        if setting.lower() not in ("", "1", "true", "on", "yes", "enabled")
         else os.path.join(
             os.path.expanduser("~"), ".cache", "log_parser_tpu", "xla-cache"
         )
@@ -48,9 +48,10 @@ def enable_persistent_cache() -> None:
 
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        # cache small-but-slow entries too: the fused program is one big
-        # executable, but tier probes and admin paths compile small ones
+        # cache everything, however small or quick: warm restarts should
+        # replay the whole compile set, including tier probes and admin
+        # paths (JAX's defaults skip sub-second compiles)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as exc:  # pragma: no cover - cache is best-effort
         log.info("persistent XLA cache unavailable: %s", exc)
