@@ -25,6 +25,11 @@ jax.config.update("jax_platforms", "cpu")
 # fallback itself is tested explicitly with it re-enabled (test_fallback.py)
 os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
 
+# keep the suite hermetic: never read or write the user-level persistent
+# XLA executable cache (entries written under different XLA_FLAGS emit
+# machine-feature mismatch warnings on load)
+os.environ["LOG_PARSER_TPU_XLA_CACHE"] = "0"
+
 import pytest  # noqa: E402
 
 from log_parser_tpu.config import ScoringConfig  # noqa: E402

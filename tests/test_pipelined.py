@@ -1,0 +1,77 @@
+"""Concurrency correctness of the pipelined serving path.
+
+``analyze_pipelined`` runs ingest + device execution outside the engine
+``state_lock`` so consecutive requests overlap; only the
+frequency-coupled finish phase serializes. These tests pin the two
+invariants that split makes fragile: no lost frequency updates under
+concurrent clients, and per-request results identical to the serial
+path (the reference instead data-races its shared frequency map —
+FrequencyTrackingService.java:25 — and mutates shared compiled-pattern
+slots per request, SURVEY.md §5.2)."""
+
+from __future__ import annotations
+
+import threading
+
+from helpers import make_pattern, make_pattern_set
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine
+
+
+def _engine() -> AnalysisEngine:
+    patterns = [
+        make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                     severity="CRITICAL"),
+        make_pattern("conn", regex="Connection refused", confidence=0.7,
+                     severity="HIGH"),
+    ]
+    return AnalysisEngine([make_pattern_set(patterns)], ScoringConfig())
+
+
+def _req(i: int) -> PodFailureData:
+    logs = "\n".join(
+        ["INFO tick ok"] * 3
+        + ["java.lang.OutOfMemoryError: heap", "dial: Connection refused"]
+    )
+    return PodFailureData(pod={"metadata": {"name": f"p{i}"}}, logs=logs)
+
+
+def test_no_lost_frequency_updates_under_concurrency():
+    engine = _engine()
+    n_threads, per_thread = 8, 6
+    errors: list[BaseException] = []
+
+    def client(t: int) -> None:
+        try:
+            for j in range(per_thread):
+                r = engine.analyze_pipelined(_req(t * per_thread + j))
+                assert r.summary.significant_events == 2
+        except BaseException as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+
+    # every request recorded exactly one match per pattern: any lost
+    # update (torn read-modify-write across the lock split) shows here
+    total = n_threads * per_thread
+    counts = engine.frequency.get_frequency_statistics()
+    assert counts == {"oom": total, "conn": total}
+
+
+def test_pipelined_result_matches_serial_engine():
+    """A pipelined request stream produces the same per-request events
+    and scores as the plain serial path on a fresh engine."""
+    pipelined, serial = _engine(), _engine()
+    for i in range(5):
+        a = pipelined.analyze_pipelined(_req(i))
+        b = serial.analyze(_req(i))
+        assert [
+            (e.line_number, e.matched_pattern.id, e.score) for e in a.events
+        ] == [(e.line_number, e.matched_pattern.id, e.score) for e in b.events]
