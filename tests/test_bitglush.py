@@ -230,6 +230,81 @@ def test_boundary_rewrite_requires_consuming_next_item():
     )
 
 
+def _gen_regex(rng: random.Random) -> str:
+    """Random regex over (a superset of) the bit-parallel fragment."""
+    def atom() -> str:
+        r = rng.random()
+        if r < 0.35:
+            return rng.choice("abcxyz05 _-:")  # literal (incl. specials-free)
+        if r < 0.5:
+            return rng.choice(["[abc]", "[0-9]", "[a-cx-z]", "[^a-y]"])
+        if r < 0.65:
+            return rng.choice(["\\d", "\\w", "\\s", "."])
+        return rng.choice(["foo", "bar:", "x0 "])  # short literal run
+
+    def item() -> str:
+        a = atom()
+        r = rng.random()
+        if r < 0.55:
+            return a
+        if r < 0.7:
+            return a + "+"
+        if r < 0.8:
+            return a + "*"
+        if r < 0.9:
+            return a + "?"
+        lo = rng.randrange(0, 3)
+        return a + "{%d,%d}" % (lo, lo + rng.randrange(0, 3))
+
+    def branch() -> str:
+        n = rng.randrange(1, 7)
+        s = "".join(item() for _ in range(n))
+        if rng.random() < 0.15:
+            s = "\\b" + s
+        if rng.random() < 0.1:
+            s = "^" + s
+        if rng.random() < 0.15:
+            s = s + "\\b"
+        if rng.random() < 0.1:
+            s = s + "$"
+        return s
+
+    return "|".join(branch() for _ in range(rng.randrange(1, 4)))
+
+
+def test_generative_fuzz_vs_host_re():
+    """Generate random regexes across the whole supported fragment, keep
+    those the compiler accepts, and check device-vs-host exactness over
+    random and adversarial lines — one shared bank so the scan compiles
+    once."""
+    rng = random.Random(20260730)
+    regexes: list[tuple[str, bool]] = []
+    attempts = 0
+    while len(regexes) < 120 and attempts < 1200:
+        attempts += 1
+        rx = _gen_regex(rng)
+        ci = rng.random() < 0.2
+        try:
+            compile_bitprog_regex(rx, ci)
+        except BitUnsupportedError:
+            continue
+        try:  # the golden compiler must accept it too
+            compile_java_regex(rx, ci)
+        except Exception:
+            continue
+        regexes.append((rx, ci))
+    assert len(regexes) >= 80, f"generator too restrictive: {len(regexes)}"
+
+    alphabet = "abcxyz05 _-:AB9\t."
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 50)))
+        for _ in range(250)
+    ]
+    lines += ["", "a", " ", "foo", "bar:", "x0 x0 x0", "foofoofoo",
+              "abc05xyz", ":::", "a" * 120]
+    check_exact(regexes, lines)
+
+
 def test_matcher_banks_bit_tier_cube_parity():
     """MatcherBanks with the bit tier forced on (it is TPU-only by
     default) produces the identical cube to the default CPU tiering over
