@@ -213,14 +213,17 @@ class BitGlushBank:
 
         def finish(carry):
             _, hits, _ = carry
-            fin = (
-                jnp.take(hits, jnp.asarray(self.fin_word), axis=1)
-                >> jnp.asarray(self.fin_bit)[None, :]
-            ) & 1  # [B, n_fins]
-            out = jnp.zeros((B, max(1, len(self.columns))), dtype=jnp.int32)
-            out = out.at[:, jnp.asarray(self.fin_slot)].max(
-                fin.astype(jnp.int32)
-            )
-            return out.astype(bool)
+            return self.columns_from_hits(hits)
 
         return init, step, finish
+
+    def columns_from_hits(self, hits: jax.Array) -> jax.Array:
+        """uint32 [N, W] accumulated hit words -> bool [N, n_columns]."""
+        N = hits.shape[0]
+        fin = (
+            jnp.take(hits, jnp.asarray(self.fin_word), axis=1)
+            >> jnp.asarray(self.fin_bit)[None, :]
+        ) & 1  # [N, n_fins]
+        out = jnp.zeros((N, max(1, len(self.columns))), dtype=jnp.int32)
+        out = out.at[:, jnp.asarray(self.fin_slot)].max(fin.astype(jnp.int32))
+        return out.astype(bool)

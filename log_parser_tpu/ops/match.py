@@ -17,6 +17,8 @@ Scans carry int32 states only; byte columns are consumed in a transposed
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -646,6 +648,14 @@ class MatcherBanks:
         self.bitglush_cols = [i for i, _ in bit_entries]
         bit_set = set(self.bitglush_cols)
         dense_cols = [i for i in dense_cols if i not in bit_set]
+        # experimental whole-tier Pallas kernel (bitglush_pallas.py):
+        # measured at parity with the scan path on v5e (PERF.md §9), kept
+        # opt-in. Read once here — cube() runs under jit, so an env read
+        # there would be frozen at first-trace time anyway.
+        self.bitglush_use_pallas = (
+            self.bitglush is not None
+            and os.environ.get("LOG_PARSER_TPU_PALLAS") == "1"
+        )
 
         self.multi_groups: list[MultiDfaBank] = []
         if use_multi:
@@ -749,9 +759,29 @@ class MatcherBanks:
                 (self.shiftor.pair_stepper(B, lengths), self.shiftor_cols, False)
             )
         if self.bitglush is not None:
-            steppers.append(
-                (self.bitglush.pair_stepper(B, lengths), self.bitglush_cols, False)
-            )
+            use_pallas = False
+            if self.bitglush_use_pallas:
+                # import only on the opt-in path: the default scan path
+                # must not depend on the experimental pallas module
+                from log_parser_tpu.ops.bitglush_pallas import (
+                    bitglush_hits_pallas,
+                    pick_tile,
+                )
+
+                use_pallas = pick_tile(B) is not None
+            if use_pallas:
+                hits = bitglush_hits_pallas(self.bitglush, lines_tb, lengths)
+                cube = cube.at[
+                    :, jnp.asarray(np.asarray(self.bitglush_cols))
+                ].set(self.bitglush.columns_from_hits(hits))
+            else:
+                steppers.append(
+                    (
+                        self.bitglush.pair_stepper(B, lengths),
+                        self.bitglush_cols,
+                        False,
+                    )
+                )
         if self.multi_cluster is not None:
             cluster = self.multi_cluster
             steppers.append(

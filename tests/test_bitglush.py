@@ -341,6 +341,75 @@ def test_matcher_banks_bit_tier_cube_parity():
     )
 
 
+def test_pallas_kernel_parity_interpret():
+    """The Pallas kernel (interpreter mode — no TPU needed) produces the
+    identical hit words / columns as the scan-path stepper. Small bank +
+    short lines keep the interpreted loop fast."""
+    from log_parser_tpu.ops.bitglush_pallas import bitglush_hits_pallas
+
+    regexes = [
+        ("OutOfMemoryError", False),
+        ("Exit Code:\\s*137", False),
+        ("status.*red", False),
+        ("\\btimeout\\b", True),
+        ("^\\s*at .*\\)$", False),
+        ("colou?r|Port \\d+", False),
+    ]
+    entries = [
+        (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
+    ]
+    bank = BitGlushBank(entries)
+    lines = [
+        "java OutOfMemoryError x",
+        "Exit Code: 137",
+        "status went red",
+        "TIMEOUT after",
+        "xtimeout",
+        "  at com.x(Y.java:1)",
+        "color Port 80",
+        "",
+    ]
+    enc = encode_lines(lines)
+    hits = bitglush_hits_pallas(
+        bank, jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths), interpret=True
+    )
+    got = np.asarray(bank.columns_from_hits(hits))[: len(lines)]
+    want = run_bank(regexes, lines)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_engine_integration(monkeypatch):
+    """LOG_PARSER_TPU_PALLAS=1 routes the bit tier through the kernel in
+    MatcherBanks.cube (interpreter mode off-TPU) — including when the bit
+    tier is the only populated tier — and the cube matches the default
+    path."""
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    monkeypatch.setenv("LOG_PARSER_TPU_PALLAS", "1")
+    bank = PatternBank(load_builtin_pattern_sets())
+    pal = MatcherBanks(bank, bitglush_max_words=192)
+    assert pal.bitglush_use_pallas and pal.bitglush_cols
+    monkeypatch.delenv("LOG_PARSER_TPU_PALLAS")
+    base = MatcherBanks(bank, bitglush_max_words=192)
+    assert not base.bitglush_use_pallas
+
+    lines = [
+        "java.lang.OutOfMemoryError: Java heap space",
+        "goroutine 42 [running]",
+        "  at com.example.Service.handle(Service.java:42)",
+        "plain INFO line",
+        "",
+    ]
+    enc = encode_lines(lines)
+    lt, ln = jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths)
+    np.testing.assert_array_equal(
+        np.asarray(pal.cube(lt, ln))[: len(lines)],
+        np.asarray(base.cube(lt, ln))[: len(lines)],
+    )
+
+
 def test_word_count():
     progs = [
         compile_bitprog_regex(rx, ci) for rx, ci in FEATURES
