@@ -141,6 +141,17 @@ class BitGlushBank:
         self.f_tB = jnp.asarray(f_tB)
         self.has_tb = bool(f_tb.any() or f_tB.any())
         self.has_dollar = bool(f_dollar.any())
+        # capability flags: the stepper drops whole op groups when no
+        # program in the bank uses them (MatcherBanks splits assert-free
+        # columns into their own bank so most columns take the light path)
+        self.has_caret = bool(caret_start.any())
+        self.has_preassert = any(
+            it.pre_assert is not None
+            for _c, p in column_programs
+            for a in p.alternatives
+            for it in a.items
+        )
+        self.needs_wordness = self.has_preassert or self.has_tb
         self.fin_word = np.asarray(fin_word, dtype=np.int32)
         self.fin_bit = np.asarray(fin_bit, dtype=np.int32)
         self.fin_slot = np.asarray(fin_slot, dtype=np.int32)
@@ -173,7 +184,7 @@ class BitGlushBank:
         def one(d, hits, pw, b, pos):
             ok = pos < lengths
             b32 = b.astype(jnp.int32)
-            cw = _is_word(b32)
+            cw = _is_word(b32) if self.needs_wordness else None
             okc = ok[:, None]
 
             if self.has_tb:
@@ -181,27 +192,39 @@ class BitGlushBank:
                 hits = hits | jnp.where(okc & bc, d & self.f_tb, zero)
                 hits = hits | jnp.where(okc & ~bc, d & self.f_tB, zero)
 
-            c = (self._shift1(d) & self.not_caret) | self.start
-            # ^-anchored starts inject only at each line's first byte
-            c = c | jnp.where(pos == 0, self.caret_start, zero)
+            c = self._shift1(d)
+            if self.has_caret:
+                c = c & self.not_caret
+            c = c | self.start
+            if self.has_caret:
+                # ^-anchored starts inject only at each line's first byte
+                c = c | jnp.where(pos == 0, self.caret_start, zero)
             for _ in range(self.max_skip_run):
-                c = c | (self._shift1(c & self.k_skip) & self.not_caret)
+                sk = self._shift1(c & self.k_skip)
+                if self.has_caret:
+                    sk = sk & self.not_caret
+                c = c | sk
 
-            sel = pw.astype(jnp.int32) * 2 + cw.astype(jnp.int32)
-            allow = jnp.take(self.allow4, sel, axis=0)  # [B, W]
             brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
-            d_new = (c & allow & brow) | (d & brow & self.s_static)
+            if self.has_preassert:
+                sel = pw.astype(jnp.int32) * 2 + cw.astype(jnp.int32)
+                allow = jnp.take(self.allow4, sel, axis=0)  # [B, W]
+                d_new = (c & allow & brow) | (d & brow & self.s_static)
+            else:
+                d_new = (c & brow) | (d & brow & self.s_static)
             d = jnp.where(okc, d_new, d)
 
             hits = hits | jnp.where(okc, d & self.f_plain, zero)
-            eol = (pos == lengths - 1)[:, None]
+            if self.has_dollar or self.has_tb:
+                eol = (pos == lengths - 1)[:, None]
             if self.has_dollar:
                 hits = hits | jnp.where(eol, d & self.f_dollar, zero)
             if self.has_tb:
                 cwc = cw[:, None]
                 hits = hits | jnp.where(eol & cwc, d & self.f_tb, zero)
                 hits = hits | jnp.where(eol & ~cwc, d & self.f_tB, zero)
-            pw = jnp.where(ok, cw, pw)
+            if self.needs_wordness:
+                pw = jnp.where(ok, cw, pw)
             return d, hits, pw
 
         def step(carry, b1, b2, t):

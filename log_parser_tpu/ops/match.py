@@ -644,6 +644,14 @@ class MatcherBanks:
                 continue
             bit_positions += prog.n_positions
             bit_entries.append((i, prog))
+        # ONE bank for all bit programs. A measured A/B split the
+        # assert-free programs into their own light bank (no word-ness /
+        # allow / caret work): cube 0.31 → 0.39s on v5e — the asserted
+        # remainder packs only ~5 words, so the extra stepper's scan
+        # overhead outweighed the ops saved (same lesson as the union
+        # groups: more carries in one fused scan schedule worse). The
+        # capability flags still pay off whenever a whole bank is
+        # assert-free (BitGlushBank skips those op groups bank-wide).
         self.bitglush = BitGlushBank(bit_entries) if bit_entries else None
         self.bitglush_cols = [i for i, _ in bit_entries]
         bit_set = set(self.bitglush_cols)

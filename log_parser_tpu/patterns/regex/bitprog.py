@@ -109,6 +109,7 @@ class BitProgram:
     def n_positions(self) -> int:
         return sum(a.n_positions for a in self.alternatives)
 
+
     @property
     def max_skip_run(self) -> int:
         """Longest run of consecutive ε-skippable positions — the number
