@@ -142,8 +142,10 @@ class BitGlushBank:
         self.has_tb = bool(f_tb.any() or f_tB.any())
         self.has_dollar = bool(f_dollar.any())
         # capability flags: the stepper drops whole op groups when no
-        # program in the bank uses them (MatcherBanks splits assert-free
-        # columns into their own bank so most columns take the light path)
+        # program in the bank uses them. A bank mixing asserted and
+        # assert-free programs pays the full path (a measured split into
+        # two banks was slower — see the tier-assignment comment in
+        # ops/match.py); a fully assert-free bank gets the light stepper
         self.has_caret = bool(caret_start.any())
         self.has_preassert = any(
             it.pre_assert is not None
