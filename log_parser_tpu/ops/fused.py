@@ -338,16 +338,21 @@ class FusedMatchScore:
         """Launch the fused program asynchronously at record capacity ``k``
         and return the un-synchronized device outputs. Callers fan out
         several dispatches (e.g. one pattern block per device) before the
-        first blocking read."""
-        lines_tb = jnp.asarray(lines_u8.T)
+        first blocking read.
+
+        The batch uploads in its contiguous [B, T] layout and transposes
+        ON DEVICE (a free layout op inside the compiled program): a
+        host-side ``.T`` copy before upload measured 82 ms vs 9 ms for
+        the contiguous config-2 batch — ~10% of a serial request."""
+        lines_bt = jnp.asarray(lines_u8)
         lens = jnp.asarray(lengths)
         n = jnp.asarray(n_lines, dtype=jnp.int32)
         if override_mask is not None:
             return self._jit_ov(
-                k, lines_tb, lens, n,
+                k, lines_bt, lens, n,
                 jnp.asarray(override_mask), jnp.asarray(override_val),
             )
-        return self._jit_plain(k, lines_tb, lens, n)
+        return self._jit_plain(k, lines_bt, lens, n)
 
     def k_ladder(self, lines_u8: np.ndarray, k_hint: int = 0):
         """The record-capacity buckets to try, smallest viable first."""
@@ -389,7 +394,8 @@ class FusedMatchScore:
 
     # ---------------------------------------------------------- device program
 
-    def _step(self, K, lines_tb, lengths, n_lines, overrides):
+    def _step(self, K, lines_bt, lengths, n_lines, overrides):
+        lines_tb = lines_bt.T  # device-side layout change (see dispatch)
         bank, t = self.bank, self.t
         B = lengths.shape[0]
         P = bank.n_patterns

@@ -136,7 +136,7 @@ class ShardedFusedStep:
             lambda lines, lens, om, ov, n: self._step(k_local, lines, lens, om, ov, n),
             mesh=self.mesh,
             in_specs=(
-                P(None, DATA_AXIS),  # lines [T, B]
+                P(DATA_AXIS, None),  # lines [B, T] (transposed on device)
                 P(DATA_AXIS),  # lengths [B]
                 P(DATA_AXIS, None),  # override_mask [B, C]
                 P(DATA_AXIS, None),  # override_val [B, C]
@@ -169,7 +169,9 @@ class ShardedFusedStep:
         B = lines_u8.shape[0]
         D = self.n_shards
         cap_local = (B // D) * max(1, self.bank.n_patterns)
-        lines_tb = self._put(np.ascontiguousarray(lines_u8.T), P(None, DATA_AXIS))
+        # contiguous [B, T] upload; the step transposes on device (a host
+        # .T copy measured ~9x the contiguous upload — ops/fused.py)
+        lines_bt = self._put(lines_u8, P(DATA_AXIS, None))
         lens = self._put(lengths, P(DATA_AXIS))
         om = self._put(override_mask, P(DATA_AXIS, None))
         ov = self._put(override_val, P(DATA_AXIS, None))
@@ -181,7 +183,7 @@ class ShardedFusedStep:
             start += 1
         for k_bucket in (*K_LADDER[start:], cap_local):
             k_l = min(k_bucket, cap_local)
-            out = self._jit(k_l, lines_tb, lens, om, ov, n)
+            out = self._jit(k_l, lines_bt, lens, om, ov, n)
             n_per_shard = self._host(out[0])
             if n_per_shard.max(initial=0) <= k_l or k_l >= cap_local:
                 return self._assemble(k_l, n_per_shard, out)
@@ -208,7 +210,8 @@ class ShardedFusedStep:
 
     # ------------------------------------------------------------ the step
 
-    def _step(self, K, lines_tb, lengths, override_mask, override_val, n_lines):
+    def _step(self, K, lines_bt, lengths, override_mask, override_val, n_lines):
+        lines_tb = lines_bt.T  # device-side layout change (see run())
         bank, t = self.bank, self.t
         Bl = lengths.shape[0]
         P_ = bank.n_patterns
@@ -218,7 +221,11 @@ class ShardedFusedStep:
         valid = gidx < n_lines
 
         # ---- local match (no communication; tiered Shift-Or + DFA) --------
-        cube = self.matchers.cube(lines_tb, lengths)
+        # barrier as in ops/fused.py: keep XLA from fusing factor
+        # extraction back into the scan loops
+        cube = jax.lax.optimization_barrier(
+            self.matchers.cube(lines_tb, lengths)
+        )
         cube = jnp.where(override_mask, override_val, cube)
         cube = cube & valid[:, None]
 
