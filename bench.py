@@ -125,6 +125,10 @@ def main() -> None:
         n_patterns=n_patterns,
         serial_lines_per_sec=round(serial_rate, 1),
         pipeline_concurrency=concurrency,
+        # the headline key predates the pipelined methodology; this field
+        # disambiguates artifacts across versions (r1-r2: serial best-of,
+        # r3+: pipelined serving throughput at the stated concurrency)
+        methodology="pipelined-v2",
     )
 
 

@@ -17,11 +17,18 @@ FrequencyTrackingService.java), including the quirks that matter for parity:
 - an unknown severity string ranks *below* INFO in the highest-severity
   computation (``indexOf == -1``, AnalysisService.java:206-211).
 
-One deliberate divergence: a pattern set whose ``patterns`` list is null is
-skipped. The reference NPEs in its match loop on such a set
-(AnalysisService.java:91-92 iterates ``getPatterns()`` without the null check
-the compile loop has at :57-59); crashing the request is a reference bug we
-do not reproduce.
+Two deliberate divergences, both NPE-shaped reference bugs we do not
+reproduce:
+
+- a pattern set whose ``patterns`` list is null is skipped. The reference
+  NPEs in its match loop on such a set (AnalysisService.java:91-92 iterates
+  ``getPatterns()`` without the null check the compile loop has at :57-59);
+  crashing the request is a reference bug we do not reproduce.
+- a null/absent ``severity`` is treated as ``""``: it takes the default
+  severity multiplier 1.0 in scoring and ranks below INFO in the
+  highest-severity computation (the ``indexOf == -1`` path). The reference
+  calls ``.toUpperCase()`` on it unguarded (ScoringService.java:69,
+  AnalysisService.java:201) and NPEs the whole request.
 """
 
 from __future__ import annotations

@@ -348,7 +348,14 @@ class MultiDfaCluster:
         sizes = [g.n_states * 256 for g in groups]
         base = np.zeros(len(groups), dtype=np.int64)
         base[1:] = np.cumsum(sizes[:-1])
-        assert base[-1] + sizes[-1] < (1 << 31), "cluster table exceeds int32"
+        if base[-1] + sizes[-1] >= (1 << 31):
+            # int32 gather indices would wrap into wrong transitions; this
+            # must survive `python -O`, so no bare assert (group_dfa_states
+            # caps keep real banks far below this)
+            raise ValueError(
+                "multi-DFA cluster table exceeds int32 index range: "
+                f"{int(base[-1] + sizes[-1])} entries"
+            )
         self._base = jnp.asarray(base.astype(np.int32))[None, :]  # [1, G]
         self._flat = jnp.asarray(
             np.concatenate([g._packed_byte_np for g in groups])

@@ -30,8 +30,8 @@ rewrite below), oversized expansions — raises :class:`BitUnsupportedError`
 and the column stays on its automaton tier. Nothing is ever lost, only
 routed.
 
-Rewrite rule (containment soundness): a leading ``\\b\\w*`` before a
-word-leading tail is dropped — any containment match of ``tail`` whose
+Rewrite rule (containment soundness): a *leading, unanchored* ``\\b\\w*``
+before a word-leading tail is dropped — any containment match of ``tail`` whose
 first byte is a word char extends left through word chars to a word start,
 which supplies both the boundary and the ``\\w*`` bytes. This is exactly
 the ``\\b\\w*Exception\\b`` shape of the reference's context regex
@@ -237,10 +237,20 @@ def _attach(elements: list) -> BitAlternative:
             continue
         item: Item = el
         if pending is not None:
-            # rewrite: \b + \w* + word-leading next item → drop both
+            # rewrite: \b + \w* + word-leading next item → drop both.
+            # Sound ONLY leading + unanchored (`not items and not caret`):
+            # the containment argument extends the match left through word
+            # chars to a word start, which a preceding consumed item or a
+            # line anchor would pin in place ('=\b\w*Exception' must see
+            # '=' adjacent to the tail; '^\b\w*Exception' must accept the
+            # extension from column 0). Elsewhere, fall through to the
+            # assertion-before-optional rejection so the column stays on
+            # an exact automaton tier.
             nxt = elements[i + 1] if i + 1 < len(elements) else None
             if (
                 pending == "b"
+                and not items
+                and not caret
                 and item.kind == STAR
                 and item.byteset == WORD_BYTES
                 and isinstance(nxt, Item)

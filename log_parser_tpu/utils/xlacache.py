@@ -14,6 +14,12 @@ boot" property.
 Enabled by default; ``LOG_PARSER_TPU_XLA_CACHE=0`` disables, any other
 value overrides the cache directory (default
 ``~/.cache/log_parser_tpu/xla-cache``).
+
+The thresholds below cache *every* compile, however small, and JAX's
+persistent cache has no eviction — the directory grows without bound
+across bank/shape changes. Entries are content-addressed and individually
+deletable, so periodic cleanup is safe: ``find <dir> -atime +30 -delete``
+(or wipe the directory; the only cost is one cold compile set).
 """
 
 from __future__ import annotations

@@ -230,6 +230,24 @@ def test_boundary_rewrite_requires_consuming_next_item():
     )
 
 
+def test_boundary_rewrite_leading_unanchored_only():
+    """The \\b\\w* drop rewrite is sound only when \\b\\w* is the leading
+    consuming element of an unanchored alternative — a preceding consumed
+    item or a ^ pins the left edge the containment argument needs free.
+    The advisor's counterexamples: '=\\b\\w*Exception' would miss
+    '=FooException', 'a\\b\\w*Exception' would falsely match 'aException',
+    '^\\b\\w*Exception' would miss 'FooException'. All three must be
+    rejected (routing the column to an exact automaton tier); the leading
+    unanchored shape still compiles and stays exact."""
+    for rx in ["=\\b\\w*Exception", "a\\b\\w*Exception", "^\\b\\w*Exception"]:
+        with pytest.raises(BitUnsupportedError):
+            compile_bitprog_regex(rx, False)
+    check_exact(
+        [("\\b\\w*Exception\\b", False)],
+        ["=FooException", "aException", "FooException", "threw FooException x"],
+    )
+
+
 def _gen_regex(rng: random.Random) -> str:
     """Random regex over (a superset of) the bit-parallel fragment."""
     def atom() -> str:
