@@ -158,6 +158,18 @@ class BitGlushBank:
         self.fin_bit = np.asarray(fin_bit, dtype=np.int32)
         self.fin_slot = np.asarray(fin_slot, dtype=np.int32)
 
+        # Assert-partition constants: the per-byte allow mask is the
+        # TAKELESS combine ``where(pw != cw, allow_bc, allow_nb)`` —
+        # replacing the allow4 row gather with one select between two
+        # [W] constants. They ARE rows of allow4: combo pw*2+cw = 1 is a
+        # boundary (no-assert ∪ \b positions), combo 0 is not (no-assert
+        # ∪ \B); combos 2/1 and 3/0 are the same sets mirrored.
+        # (Pair-composed and byte-class table variants of this stepper
+        # were measured SLOWER and deleted — ops/shiftor.py docstring,
+        # tools/probe_paircompose.py.)
+        self.allow_bc = jnp.asarray(allow4[1])  # boundary present
+        self.allow_nb = jnp.asarray(allow4[0])  # no boundary
+
     # --------------------------------------------------------------- device
 
     def _shift1(self, d: jax.Array) -> jax.Array:
@@ -174,7 +186,12 @@ class BitGlushBank:
     def pair_stepper(self, B: int, lengths: jax.Array):
         """(init, step(carry, b1, b2, t), finish) — composable with the
         other banks into the single fused scan. Carry: (state [B, W]
-        uint32, hits [B, W] uint32, prev_wordness [B] bool)."""
+        uint32, hits [B, W] uint32, prev_wordness [B] bool). One
+        ``bmask`` row take per byte; the \\b/\\B allow mask is the
+        takeless two-constant select built in ``__init__``. The
+        post-line-end state freeze is dropped — every hit term is gated
+        by its byte's ``pos < length`` and positions only grow, so a
+        polluted ``d`` past end-of-line can never contribute a hit."""
         W = self.n_words
         init = (
             jnp.zeros((B, W), jnp.uint32),
@@ -188,11 +205,13 @@ class BitGlushBank:
             b32 = b.astype(jnp.int32)
             cw = _is_word(b32) if self.needs_wordness else None
             okc = ok[:, None]
+            if self.has_tb or self.has_preassert:
+                bc = (pw != cw)[:, None]
 
             if self.has_tb:
-                bc = (pw != cw)[:, None]
-                hits = hits | jnp.where(okc & bc, d & self.f_tb, zero)
-                hits = hits | jnp.where(okc & ~bc, d & self.f_tB, zero)
+                hits = hits | jnp.where(
+                    okc, d & jnp.where(bc, self.f_tb, self.f_tB), zero
+                )
 
             c = self._shift1(d)
             if self.has_caret:
@@ -209,12 +228,10 @@ class BitGlushBank:
 
             brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
             if self.has_preassert:
-                sel = pw.astype(jnp.int32) * 2 + cw.astype(jnp.int32)
-                allow = jnp.take(self.allow4, sel, axis=0)  # [B, W]
-                d_new = (c & allow & brow) | (d & brow & self.s_static)
+                allow = jnp.where(bc, self.allow_bc, self.allow_nb)
+                d = (c & allow & brow) | (d & brow & self.s_static)
             else:
-                d_new = (c & brow) | (d & brow & self.s_static)
-            d = jnp.where(okc, d_new, d)
+                d = (c & brow) | (d & brow & self.s_static)
 
             hits = hits | jnp.where(okc, d & self.f_plain, zero)
             if self.has_dollar or self.has_tb:
@@ -223,8 +240,9 @@ class BitGlushBank:
                 hits = hits | jnp.where(eol, d & self.f_dollar, zero)
             if self.has_tb:
                 cwc = cw[:, None]
-                hits = hits | jnp.where(eol & cwc, d & self.f_tb, zero)
-                hits = hits | jnp.where(eol & ~cwc, d & self.f_tB, zero)
+                hits = hits | jnp.where(
+                    eol, d & jnp.where(cwc, self.f_tb, self.f_tB), zero
+                )
             if self.needs_wordness:
                 pw = jnp.where(ok, cw, pw)
             return d, hits, pw

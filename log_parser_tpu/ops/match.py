@@ -547,6 +547,19 @@ class MatcherBanks:
             for c in bank.columns
             if c.dfa is not None or c.exact_seqs is not None
         )
+        bit_budget = (
+            (self.BITGLUSH_MAX_WORDS_TPU if on_tpu else self.BITGLUSH_MAX_WORDS_CPU)
+            if bitglush_max_words is None
+            else bitglush_max_words
+        )
+        # Keep literal columns on Shift-Or even when the bit tier is on:
+        # [B, W] arrays pad to 128 LANES, so per-scan-step cost is
+        # ceil(W/128) x the stepper's op-chain length. Absorbing the
+        # literal columns into bitglush (their regexes are trivially in
+        # the bit fragment) was measured: the merged bank needs 140 words
+        # — the second lane-tile doubles the heavy ~18-op bitglush chain
+        # (cube 0.44s vs 0.27s split, config-2, v5e). Two banks, each one
+        # tile, pay 18 + 8 op-tiles; that is the cheap shape (PERF.md §9).
         use_shiftor = n_device >= threshold
         # Word-budget gate (see SHIFTOR_MAX_WORDS): DFA-backed literal
         # columns only ride Shift-Or while the packed word count stays
@@ -559,12 +572,27 @@ class MatcherBanks:
             if shiftor_max_words is None
             else shiftor_max_words
         )
+        # DFA-backed columns with any sequence over 32 positions go to the
+        # dense pool instead of Shift-Or: chains would widen every
+        # Shift-Or take row (take cost ∝ row width — 81→114 words
+        # measured 0.088→0.154 s), while inside bitglush's lane-padded
+        # chain the extra positions are ~free. Chains still serve
+        # DFA-less literal columns, whose only device tier this is.
+        def _short_seqs(c) -> bool:
+            return all(len(s) <= 32 for s in c.exact_seqs)
+
         if use_shiftor:
+            # count the whole candidate bank, INCLUDING the DFA-less
+            # floor (those columns stay on Shift-Or either way, and with
+            # chains they can be wide): rerouting the DFA-backed columns
+            # must keep the combined bank under the budget, not just
+            # their own share
             n_words = ShiftOrBank.count_packed_words(
                 (
                     len(seq)
                     for c in bank.columns
-                    if c.exact_seqs is not None and c.dfa is not None
+                    if c.exact_seqs is not None
+                    and (c.dfa is None or _short_seqs(c))
                     for seq in c.exact_seqs
                 ),
                 budget=word_budget,
@@ -574,7 +602,8 @@ class MatcherBanks:
         self.shiftor_cols = [
             i
             for i, c in enumerate(bank.columns)
-            if c.exact_seqs is not None and (use_shiftor or c.dfa is None)
+            if c.exact_seqs is not None
+            and ((use_shiftor and _short_seqs(c)) or c.dfa is None)
         ]
         shiftor_set = set(self.shiftor_cols)
         dense_cols = [
@@ -630,11 +659,6 @@ class MatcherBanks:
             compile_bitprog_regex,
         )
 
-        bit_budget = (
-            (self.BITGLUSH_MAX_WORDS_TPU if on_tpu else self.BITGLUSH_MAX_WORDS_CPU)
-            if bitglush_max_words is None
-            else bitglush_max_words
-        )
         bit_entries: list[tuple[int, object]] = []
         bit_positions = 0
         for i in dense_cols if bit_budget > 0 else []:
@@ -835,7 +859,11 @@ class MatcherBanks:
                 continue
             if is_dfa:
                 out = out[:, : len(cols)]
-            cube = cube.at[:, jnp.asarray(np.asarray(cols))].set(out)
+            # tier column sets are disjoint today, so .max equals .set;
+            # .max keeps the scatter an OR if a column ever lands in two
+            # tiers (a round-4 alternative-split experiment did exactly
+            # that and was silently masked by .set — PERF.md §9b)
+            cube = cube.at[:, jnp.asarray(np.asarray(cols))].max(out)
         if multi_reps:
             cube = self._multi_contribution(cube, lines_tb, lengths, multi_reps)
         return cube

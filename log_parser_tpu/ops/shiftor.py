@@ -20,20 +20,40 @@ CANNOT be position j of the sequence (Shift-Or convention: 0 = still
 alive). A sequence has matched at this position iff bit (o+m-1) is 0; hits
 accumulate over positions ``t < length``.
 
+Sequences longer than 32 positions ride *cross-word chains*: they take a
+word-aligned run of whole words, and the shift propagates bit 31 of each
+chain word into bit 0 of the next (``cont_mask`` marks receiving words —
+only there is the shift's incoming 0 replaced by the carry, everywhere
+else bit 0 is either a start bit, restarted by ``start_clear``, or inert).
+The tail word's remaining bits stay open to first-fit short sequences.
+Chains let DFA-less literal columns with >32-position sequences (this
+tier is their only device path) stay exact instead of falling to host.
+
 The row-select ``mask[byte]`` is a small-table ``jnp.take`` ([256, W]
-rows, contiguous — measured 0.17s for the 59-column builtin bank over
-200k lines on TPU v5e). A one-hot MXU matmul variant was prototyped and
-DELETED (VERDICT r2 #6): with the SHIFTOR_MAX_WORDS gate this tier only
-ever runs at <=128 words where the take is already cheap, the matmul
-would materialize a [B, 256] f32 one-hot (~235 MB per scan step at the
+rows, contiguous). This exact shape — one-level takes from a 256-row
+table indexed by the raw byte, minimal row width — is a measured local
+optimum. Two families of "structural" improvements were built, measured
+SLOWER on TPU v5e, and deleted (tools/probe_paircompose.py, PERF.md §9):
+
+- *pair-composed recurrences* (``D2 = (D<<2) & SC2 | M2[b1,b2]``,
+  halving the serial per-byte chain): every variant lost because take
+  cost scales with gathered row width, not take count — the composed
+  tables need 1.5-2x the row words (0.130-0.242s vs 0.089s for the
+  builtin bank, 229k lines);
+- *byte-class indirection* (``[C², 2W]`` tables behind a ``[256]``
+  class map, C=62): any dependent two-level gather inside the scan adds
+  ~3ms/step at this batch — 0.24-0.29s even with 40KB tables.
+
+A one-hot MXU matmul variant was likewise prototyped and DELETED
+(VERDICT r2 #6): with the SHIFTOR_MAX_WORDS gate this tier only ever
+runs at <=128 words where the take is already cheap, the matmul would
+materialize a [B, 256] f32 one-hot (~235 MB per scan step at the
 229k-row config-2 batch — pure HBM traffic), and the very wide banks an
 MXU formulation could serve no longer reach Shift-Or at all (they route
 to the any-hit prefilter, PERF.md §6).
 """
 
 from __future__ import annotations
-
-import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -42,86 +62,86 @@ import numpy as np
 ByteSeq = tuple  # tuple[frozenset[int], ...]
 
 
-@dataclasses.dataclass
-class _PackedSeq:
-    column: int  # matcher-column this sequence belongs to
-    word: int
-    offset: int
-    length: int
-
-
 class ShiftOrBank:
     """Packed Shift-Or program for a set of (column, sequences) entries."""
 
     @staticmethod
-    def count_packed_words(
-        seq_lengths, budget: int | None = None
-    ) -> int:
-        """First-fit word count for sequences of these lengths — THE
-        packing rule of ``__init__`` (single source: tier gates that
-        estimate the word cost must agree with the real packer). With a
-        ``budget``, returns early once the count exceeds it."""
+    def _plan(seq_lengths, budget: int | None = None):
+        """Packing plan — THE single source of the packing rule (tier
+        gates that estimate word cost must agree with ``__init__``).
+        Sequences >32 take fresh word-aligned runs (cross-word chains)
+        whose tail remainder stays open to first-fit; sequences <=32
+        first-fit within any word. Returns (global start bits, n_words);
+        with a ``budget``, bails early once the count exceeds it."""
+        starts: list[int] = []
         word_fill: list[int] = []
         for m in seq_lengths:
-            w = next(
-                (i for i, used in enumerate(word_fill) if used + m <= 32),
-                None,
-            )
-            if w is None:
-                word_fill.append(0)
-                if budget is not None and len(word_fill) > budget:
-                    return len(word_fill)
-            word_fill[w if w is not None else -1] += m
-        return len(word_fill)
-
-    def __init__(self, column_seqs: list[tuple[int, tuple[ByteSeq, ...]]]):
-        self.columns = [c for c, _ in column_seqs]
-        packed: list[_PackedSeq] = []
-        word_fill: list[int] = []
-        for col, seqs in column_seqs:
-            for seq in seqs:
-                m = len(seq)
+            if m > 32:
+                w0 = len(word_fill)
+                nw = (m + 31) // 32
+                starts.append(w0 * 32)
+                word_fill.extend([32] * (nw - 1))
+                word_fill.append(m - 32 * (nw - 1))
+            else:
                 w = next(
-                    (i for i, used in enumerate(word_fill) if used + m <= 32), None
+                    (i for i, used in enumerate(word_fill) if used + m <= 32),
+                    None,
                 )
                 if w is None:
                     w = len(word_fill)
                     word_fill.append(0)
-                packed.append(_PackedSeq(col, w, word_fill[w], m))
+                starts.append(w * 32 + word_fill[w])
                 word_fill[w] += m
-        self.n_words = max(1, len(word_fill))
-        self.n_seqs = len(packed)
-        self._packed = packed
+            if budget is not None and len(word_fill) > budget:
+                return starts, len(word_fill)
+        return starts, max(1, len(word_fill))
+
+    @classmethod
+    def count_packed_words(cls, seq_lengths, budget: int | None = None) -> int:
+        return cls._plan(seq_lengths, budget)[1]
+
+    def __init__(self, column_seqs: list[tuple[int, tuple[ByteSeq, ...]]]):
+        self.columns = [c for c, _ in column_seqs]
+        flat = [(col, seq) for col, seqs in column_seqs for seq in seqs]
+        starts, self.n_words = self._plan([len(seq) for _, seq in flat])
+        self.n_seqs = len(flat)
 
         # mask[c, w]: bit (o+j) = 1 iff byte c not allowed at position j;
         # unused bits are always-1 (inert)
         mask = np.full((256, self.n_words), 0xFFFFFFFF, dtype=np.uint32)
         start_clear = np.full(self.n_words, 0xFFFFFFFF, dtype=np.uint32)
-        flat_seqs = [s for _, seqs in column_seqs for s in seqs]
-        assert len(flat_seqs) == len(packed)
-        for ps, seq in zip(packed, flat_seqs):
-            start_clear[ps.word] &= np.uint32(0xFFFFFFFF) ^ np.uint32(1 << ps.offset)
+        cont_mask = np.zeros(self.n_words, dtype=np.uint32)
+        end_mask = np.zeros(self.n_words, dtype=np.uint32)
+        for (col, seq), g in zip(flat, starts):
+            start_clear[g // 32] &= ~np.uint32(1 << (g % 32))
             for j, byteset in enumerate(seq):
-                bit = np.uint32(1 << (ps.offset + j))
+                p = g + j
+                bit = np.uint32(1 << (p % 32))
                 for c in byteset:
-                    mask[c, ps.word] &= ~bit
+                    mask[c, p // 32] &= ~bit
+            # chain continuation words receive bit 31 of their predecessor
+            for w in range(g // 32 + 1, (g + len(seq) - 1) // 32 + 1):
+                cont_mask[w] |= np.uint32(1)
+            e = g + len(seq) - 1
+            end_mask[e // 32] |= np.uint32(1 << (e % 32))
         self.mask = jnp.asarray(mask)
         self.start_clear = jnp.asarray(start_clear)
-
-        end_mask = np.zeros(self.n_words, dtype=np.uint32)
-        for ps in packed:
-            end_mask[ps.word] |= np.uint32(1 << (ps.offset + ps.length - 1))
         self.end_mask = jnp.asarray(end_mask)
+        self.has_chains = bool(cont_mask.any())
+        self.cont_mask = jnp.asarray(cont_mask)
+
+        # host copies for probes/serialization (tools/probe_paircompose.py)
+        self._np = {"mask": mask, "start_clear": start_clear,
+                    "end_mask": end_mask, "cont_mask": cont_mask}
 
         # per-sequence extraction: hits[:, word] >> bit & 1 -> column OR
-        self.seq_word = np.asarray([ps.word for ps in packed], dtype=np.int32)
-        self.seq_bit = np.asarray(
-            [ps.offset + ps.length - 1 for ps in packed], dtype=np.int32
-        )
+        ends = [g + len(seq) - 1 for (_, seq), g in zip(flat, starts)]
+        self.seq_word = np.asarray([e // 32 for e in ends], dtype=np.int32)
+        self.seq_bit = np.asarray([e % 32 for e in ends], dtype=np.int32)
         # map sequences onto output slots (position of column in self.columns)
         slot_of_col = {c: i for i, c in enumerate(self.columns)}
         self.seq_slot = np.asarray(
-            [slot_of_col[ps.column] for ps in packed], dtype=np.int32
+            [slot_of_col[col] for col, _ in flat], dtype=np.int32
         )
 
     # --------------------------------------------------------------- device
@@ -139,7 +159,14 @@ class ShiftOrBank:
         def one(carry, b, pos_ok):
             d, hits = carry
             m = select(b)
-            d_new = ((d << 1) & self.start_clear[None, :]) | m
+            sh = (d << 1) & self.start_clear[None, :]
+            if self.has_chains:
+                # bit 31 of each chain word flows into bit 0 of the next
+                cr = jnp.concatenate(
+                    [jnp.zeros_like(d[:, :1]), d[:, :-1] >> 31], axis=1
+                )
+                sh = sh | (cr & self.cont_mask[None, :])
+            d_new = sh | m
             active = pos_ok[:, None]
             hits = jnp.where(
                 active, hits | ((~d_new) & self.end_mask[None, :]), hits

@@ -166,7 +166,9 @@ def _extract_cat(node: Cat) -> frozenset[Literal] | None:
 # ---- exact fixed-length sequences (the Shift-Or fast path) ----------------
 
 MAX_EXACT_SEQS = 16  # alternative sequences per regex
-MAX_EXACT_LEN = 32  # one 32-bit Shift-Or word per sequence
+# sequences over 32 positions ride Shift-Or's cross-word carry chains
+# (ops/shiftor.py); 64 bounds a chain to two words
+MAX_EXACT_LEN = 64
 
 
 def exact_sequences(node: Node) -> tuple[tuple[frozenset[int], ...], ...] | None:

@@ -160,7 +160,9 @@ def test_builtin_union_columns_exact_on_corpus():
         except BitUnsupportedError:
             continue
         regexes.append((col.regex, col.case_insensitive))
-    assert len(regexes) >= 40  # expect near-total coverage of the 49
+    # MAX_EXACT_LEN=64 routes long literal alternations to Shift-Or
+    # chains, so ~32 dense-eligible columns remain for the bit tier
+    assert len(regexes) >= 25
 
     rng = random.Random(7)
     words = [
@@ -334,7 +336,9 @@ def test_matcher_banks_bit_tier_cube_parity():
     bank = PatternBank(load_builtin_pattern_sets())
     bit = MatcherBanks(bank, bitglush_max_words=192)
     base = MatcherBanks(bank, bitglush_max_words=0)
-    assert len(bit.bitglush_cols) >= 40
+    # long literal alternations ride Shift-Or chains (MAX_EXACT_LEN=64);
+    # the bit tier keeps the ~32 genuinely non-literal columns
+    assert len(bit.bitglush_cols) >= 25
     assert not base.bitglush_cols
 
     lines = [

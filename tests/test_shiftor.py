@@ -82,6 +82,77 @@ def test_word_packing_isolates_neighbors():
     )
 
 
+def test_cross_word_chain_sequences():
+    """Sequences longer than 32 positions span words via the carry chain
+    (cont_mask): exactness at every boundary-straddling offset, no leak
+    into co-packed short sequences, correct restart mid-line."""
+    long_a = "A fatal error has been detected by the Java Runtime Environ"
+    long_b = "b" * 33
+    entries = [
+        (0, (tuple(frozenset([ord(c)]) for c in long_a),)),
+        (1, (tuple(frozenset([ord("b")]) for _ in range(33)),)),
+        (2, (tuple(frozenset([ord(c)]) for c in "xy"),)),
+    ]
+    bank = ShiftOrBank(entries)
+    assert bank.has_chains and bank.n_words >= 3
+    lines = [
+        long_a,                       # exact
+        "zz" + long_a + " tail",      # offset start (chain restarts)
+        long_a[:-1],                  # one byte short: no match
+        long_a[:30] + "X" + long_a[30:],  # broken at a word boundary
+        long_b,                       # 33 b's
+        "b" * 32,                     # one short
+        "b" * 40,                     # long run: matches
+        "xy " + "b" * 33,             # co-packed short + chain in one line
+        "",
+    ]
+    enc = encode_lines(lines)
+    got = np.asarray(bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths)))
+    hosts = [re.compile(re.escape(long_a)), re.compile("b{33}"), re.compile("xy")]
+    for i, host in enumerate(hosts):
+        expect = [bool(host.search(ln)) for ln in lines]
+        np.testing.assert_array_equal(
+            got[: len(lines), i], expect, err_msg=f"col {i}"
+        )
+
+
+def test_mixed_literal_alternation_column_exact():
+    """A column mixing long pure-literal alternatives with a \\d+
+    alternative is not exact-sequence eligible, so with the bit tier on
+    it rides bitglush whole; the cube must equal host re on every
+    alternative, including the >32-char literal."""
+    from log_parser_tpu.patterns.bank import PatternBank
+    from helpers import make_pattern, make_pattern_set
+
+    rx = (
+        "Connection is not available, request timed out after"
+        "|HikariPool-\\d+ - Connection marked as broken"
+        "|short one"
+    )
+    bank = PatternBank(
+        [make_pattern_set([make_pattern("p0", regex=rx, confidence=0.5)])]
+    )
+    mb = MatcherBanks(bank, bitglush_max_words=192)
+    assert mb.shiftor is None  # no exact-sequence columns in this bank
+    lines = [
+        "Connection is not available, request timed out after 30000ms",
+        "HikariPool-1 - Connection marked as broken",
+        "a short one here",
+        "Connection is not available, request timed out",  # prefix only
+        "HikariPool- - Connection marked as broken",  # \d+ unmet
+        "nothing",
+    ]
+    col = next(i for i, c in enumerate(bank.columns) if c.regex == rx)
+    enc = encode_lines(lines)
+    got = np.asarray(
+        mb.cube(np.asarray(enc.u8.T), np.asarray(enc.lengths))
+    )[: len(lines), col]
+    host = compile_java_regex(rx)
+    np.testing.assert_array_equal(
+        got, [bool(host.search(ln)) for ln in lines]
+    )
+
+
 def test_adaptive_tier_split(monkeypatch):
     from log_parser_tpu.patterns.bank import PatternBank
     from helpers import make_pattern, make_pattern_set

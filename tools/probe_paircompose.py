@@ -1,0 +1,220 @@
+"""A/B the Shift-Or scan-step formulations in isolation on the live
+backend: per-byte takes (round-3 shipping form), byte-pair table,
+class-pair table, and ablations (no intermediate-hit half, no class
+indirection). Each variant is its own jitted scan over the config-2
+corpus; prints one JSON line. PERF.md §9 methodology.
+
+Usage: python tools/probe_paircompose.py [--lines 200000] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lines", type=int, default=200_000)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.native.ingest import Corpus
+    from log_parser_tpu.ops.match import pack_byte_pairs
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    s = engine.matchers.shiftor
+    mask_np = s._np["mask"]
+    sc_np = s._np["start_clear"]
+    e_np = s._np["end_mask"]
+    W = s.n_words
+
+    corpus = Corpus(bench.build_corpus(args.lines))
+    enc = corpus.encoded
+    lines_tb = jnp.asarray(enc.u8.T)
+    lens = jnp.asarray(enc.lengths)
+    jax.block_until_ready((lines_tb, lens))
+    B = int(lens.shape[0])
+    report = {
+        "platform": jax.devices()[0].platform,
+        "rows": B,
+        "T": int(lines_tb.shape[0]),
+        "W": W,
+    }
+
+    mask = jnp.asarray(mask_np)
+    sc = jnp.asarray(sc_np)
+    e = jnp.asarray(e_np)
+    zero = jnp.uint32(0)
+
+    def scan_of(step, init):
+        @jax.jit
+        def run(lines_tb, lens):
+            pairs, ts = pack_byte_pairs(lines_tb)
+            out, _ = jax.lax.scan(
+                lambda c, xs: (step(c, xs[0][0], xs[0][1], xs[1]), None),
+                init,
+                (pairs, ts),
+            )
+            return out
+
+        return lambda: jax.block_until_ready(run(lines_tb, lens))
+
+    d0 = jnp.full((B, W), 0xFFFFFFFF, dtype=jnp.uint32)
+    h0 = jnp.zeros((B, W), dtype=jnp.uint32)
+
+    # -- v_byte: round-3 shipping form (2 per-byte [256, W] takes) -------
+    def one_old(carry, b, pos_ok):
+        d, hits = carry
+        m = jnp.take(mask, b.astype(jnp.int32), axis=0)
+        d_new = ((d << 1) & sc) | m
+        active = pos_ok[:, None]
+        hits = jnp.where(active, hits | ((~d_new) & e), hits)
+        return jnp.where(active, d_new, d), hits
+
+    def step_old(carry, b1, b2, t):
+        p0 = 2 * t
+        carry = one_old(carry, b1, p0 < lens)
+        return one_old(carry, b2, p0 + 1 < lens)
+
+    report["v_byte_s"] = round(timeit(scan_of(step_old, (d0, h0)), args.repeats), 4)
+
+    # -- shared pair-composed ingredients -------------------------------
+    sc2 = jnp.asarray((sc_np << np.uint32(1)) & sc_np)
+    k = jnp.asarray(~sc_np)
+    uniq, cls_np = np.unique(mask_np, axis=0, return_inverse=True)
+    C = int(uniq.shape[0])
+    report["C"] = C
+    m2_u = ((uniq << np.uint32(1)) & sc_np)[:, None, :] | uniq[None, :, :]
+    t1_u = np.broadcast_to(((~uniq) & e_np)[:, None, :], m2_u.shape)
+    cls = jnp.asarray(cls_np.astype(np.int32))
+
+    def pair_step_from(table, widx):
+        """widx(b1, b2, d-carry-aux) -> row index; table rows [2W]."""
+
+        def step(carry, b1, b2, t):
+            d, hits = carry
+            p0 = 2 * t
+            row = jnp.take(table, widx(b1, b2), axis=0)
+            m2r, t1r = row[:, :W], row[:, W:]
+            hit1 = (~(d << 1) | k) & t1r
+            d = ((d << 2) & sc2) | m2r
+            hit2 = (~d) & e
+            hits = (
+                hits
+                | jnp.where((p0 < lens)[:, None], hit1, zero)
+                | jnp.where((p0 + 1 < lens)[:, None], hit2, zero)
+            )
+            return d, hits
+
+        return step
+
+    # -- v_clspair: [C^2, 2W] table + class map (measured-slower) -------
+    tab_cls = jnp.asarray(
+        np.concatenate([m2_u, t1_u], axis=-1).reshape(C * C, 2 * W)
+    )
+    widx_cls = lambda b1, b2: (
+        jnp.take(cls, b1.astype(jnp.int32)) * C
+        + jnp.take(cls, b2.astype(jnp.int32))
+    )
+    report["v_clspair_s"] = round(
+        timeit(scan_of(pair_step_from(tab_cls, widx_cls), (d0, h0)), args.repeats), 4
+    )
+
+    # -- v_clspair_noT1: same but W-wide rows, final-byte hits only -----
+    tab_m2 = jnp.asarray(m2_u.reshape(C * C, W))
+
+    def step_not1(carry, b1, b2, t):
+        d, hits = carry
+        p0 = 2 * t
+        m2r = jnp.take(tab_m2, widx_cls(b1, b2), axis=0)
+        d = ((d << 2) & sc2) | m2r
+        hits = hits | jnp.where((p0 + 1 < lens)[:, None], (~d) & e, zero)
+        return d, hits
+
+    report["v_clspair_noT1_s"] = round(
+        timeit(scan_of(step_not1, (d0, h0)), args.repeats), 4
+    )
+
+    # -- v_2take_precls: two independent [C, 2W] takes, compose on device
+    tab_1 = jnp.asarray(
+        np.concatenate([((uniq << np.uint32(1)) & sc_np), (~uniq) & e_np], axis=-1)
+    )  # [C, 2W] : shifted mask | T1
+    tab_2 = jnp.asarray(uniq)  # [C, W]
+
+    def step_2take(carry, b1, b2, t):
+        d, hits = carry
+        p0 = 2 * t
+        r1 = jnp.take(tab_1, jnp.take(cls, b1.astype(jnp.int32)), axis=0)
+        m1s, t1r = r1[:, :W], r1[:, W:]
+        m2r = jnp.take(tab_2, jnp.take(cls, b2.astype(jnp.int32)), axis=0)
+        hit1 = (~(d << 1) | k) & t1r
+        d = ((d << 2) & sc2) | m1s | m2r
+        hit2 = (~d) & e
+        hits = (
+            hits
+            | jnp.where((p0 < lens)[:, None], hit1, zero)
+            | jnp.where((p0 + 1 < lens)[:, None], hit2, zero)
+        )
+        return d, hits
+
+    report["v_2take_precls_s"] = round(
+        timeit(scan_of(step_2take, (d0, h0)), args.repeats), 4
+    )
+
+    # -- v_2take_byte: same composition, [256, 2W] tables, no class map
+    tab_1b = jnp.asarray(
+        np.concatenate(
+            [((mask_np << np.uint32(1)) & sc_np), (~mask_np) & e_np], axis=-1
+        )
+    )
+
+    def step_2tb(carry, b1, b2, t):
+        d, hits = carry
+        p0 = 2 * t
+        r1 = jnp.take(tab_1b, b1.astype(jnp.int32), axis=0)
+        m1s, t1r = r1[:, :W], r1[:, W:]
+        m2r = jnp.take(mask, b2.astype(jnp.int32), axis=0)
+        hit1 = (~(d << 1) | k) & t1r
+        d = ((d << 2) & sc2) | m1s | m2r
+        hit2 = (~d) & e
+        hits = (
+            hits
+            | jnp.where((p0 < lens)[:, None], hit1, zero)
+            | jnp.where((p0 + 1 < lens)[:, None], hit2, zero)
+        )
+        return d, hits
+
+    report["v_2take_byte_s"] = round(
+        timeit(scan_of(step_2tb, (d0, h0)), args.repeats), 4
+    )
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,10 @@ def main() -> None:
         fn = scan_only([m.shiftor.pair_stepper(B, lens)])
         report["shiftor_s"] = round(timeit(fn, n=args.repeats), 4)
         report["shiftor_words"] = m.shiftor.n_words
+    if m.bitglush is not None:
+        fn = scan_only([m.bitglush.pair_stepper(B, lens)])
+        report["bitglush_s"] = round(timeit(fn, n=args.repeats), 4)
+        report["bitglush_words"] = m.bitglush.n_words
 
     cube_jit = jax.jit(m.cube)
     full = lambda: jax.block_until_ready(cube_jit(lines_tb, lens))
