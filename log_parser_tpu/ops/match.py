@@ -657,6 +657,8 @@ class MatcherBanks:
         from log_parser_tpu.patterns.regex.bitprog import (
             BitUnsupportedError,
             compile_bitprog_regex,
+            expand_asserts,
+            has_asserts,
         )
 
         bit_entries: list[tuple[int, object]] = []
@@ -675,6 +677,20 @@ class MatcherBanks:
                 continue
             bit_positions += prog.n_positions
             bit_entries.append((i, prog))
+        # De-assert rewrite, all-or-nothing: the op-group savings are
+        # BANK-wide capability flags, so expansion only pays if every
+        # asserted program expands (and the expanded bank stays within
+        # budget); one unexpandable column keeps the gated originals.
+        if any(has_asserts(p) for _, p in bit_entries):
+            try:
+                expanded = [(i, expand_asserts(p)) for i, p in bit_entries]
+            except BitUnsupportedError:
+                expanded = None
+            if expanded is not None and all(
+                p.n_positions <= self.BITGLUSH_MAX_COLUMN_POSITIONS
+                for _, p in expanded
+            ) and sum(p.n_positions for _, p in expanded) <= 32 * bit_budget:
+                bit_entries = expanded
         # ONE bank for all bit programs. A measured A/B split the
         # assert-free programs into their own light bank (no word-ness /
         # allow / caret work): cube 0.31 → 0.39s on v5e — the asserted

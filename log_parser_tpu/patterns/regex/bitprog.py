@@ -296,5 +296,138 @@ def compile_bitprog(node: Node) -> BitProgram:
     return BitProgram(alternatives=tuple(alts))
 
 
+# -------------------------------------------------- assert expansion
+
+NONWORD_BYTES = frozenset(range(256)) - WORD_BYTES
+
+
+def _leading_variants(alt: BitAlternative) -> list[tuple[tuple, bool]]:
+    """Rewrite a first-item ``\\b``/``\\B`` pre-assert into explicit
+    variants: a ``^`` variant when the virtual line-start predecessor
+    (non-word) satisfies the assert, and a predecessor-byte-prefixed
+    variant otherwise/additionally. The first byteset is split by
+    word-ness so each variant's boundary answer is fixed."""
+    first = alt.items[0]
+    pa = first.pre_assert
+    if pa is None:
+        return [(alt.items, alt.caret)]
+    outs: list[tuple[tuple, bool]] = []
+    for part in (first.byteset & WORD_BYTES, first.byteset & NONWORD_BYTES):
+        if not part:
+            continue
+        wp = part <= WORD_BYTES
+        if first.kind == ONE:
+            head: tuple = (Item(part, ONE),)
+        elif first.kind == PLUS:  # \bx+ : boundary gates the first x only
+            head = (
+                Item(part, ONE),
+                dataclasses.replace(first, kind=STAR, pre_assert=None),
+            )
+        else:  # skippable first items never carry pre_asserts (_attach)
+            raise BitUnsupportedError("leading assert on optional item")
+        body = head + alt.items[1:]
+        start_ok = (pa == "b") == wp  # virtual predecessor is non-word
+        if start_ok:
+            outs.append((body, True))
+        if not alt.caret:
+            pred = NONWORD_BYTES if (pa == "b") == wp else WORD_BYTES
+            outs.append(((Item(pred, ONE),) + body, False))
+    if not outs:
+        # e.g. ^\B<word>: the assert is unsatisfiable at position 0 —
+        # still a legal (never-matching) regex; keep it on a gated tier
+        raise BitUnsupportedError("unsatisfiable leading assert")
+    return outs
+
+
+def _trailing_variants(
+    items: tuple, post: str | None
+) -> list[tuple[tuple, str | None]]:
+    """Rewrite a trailing ``\\b``/``\\B`` into an appended follow-byte
+    item (reachable from every accepting cascade position via the
+    ε-skip chain) plus a ``$`` variant when end-of-line satisfies the
+    assert. Needs every accepting position's byteset word-ness to be
+    pure; a single accepting position may be split to make it so."""
+    if post not in ("b", "B"):
+        return [(items, post)]
+    fins = BitAlternative(items=items).final_positions()
+    casc = [items[f] for f in fins]
+    pure_w = all(it.byteset <= WORD_BYTES for it in casc)
+    pure_n = all(it.byteset <= NONWORD_BYTES for it in casc)
+    splits: list[tuple[tuple, bool]] = []  # (items, last-consumed-is-word)
+    if pure_w or pure_n:
+        splits.append((items, pure_w))
+    elif len(fins) == 1:
+        last = items[-1]
+        for part in (last.byteset & WORD_BYTES, last.byteset & NONWORD_BYTES):
+            if not part:
+                continue
+            if last.kind == ONE:
+                base = items[:-1] + (Item(part, ONE),)
+            elif last.kind == PLUS:  # x+\b : only the last x faces the \b
+                base = items[:-1] + (
+                    dataclasses.replace(last, kind=STAR, pre_assert=None),
+                    Item(part, ONE),
+                )
+            else:
+                raise BitUnsupportedError("trailing assert after optional")
+            splits.append((base, part <= WORD_BYTES))
+    else:
+        raise BitUnsupportedError("word-ness-impure trailing cascade")
+    outs: list[tuple[tuple, str | None]] = []
+    for base, wl in splits:
+        follow = (NONWORD_BYTES if wl else WORD_BYTES) if post == "b" else (
+            WORD_BYTES if wl else NONWORD_BYTES
+        )
+        outs.append((base + (Item(follow, ONE),), None))
+        if (post == "b") == wl:  # virtual end-of-line byte is non-word
+            outs.append((base, "$"))
+    if not outs:
+        raise BitUnsupportedError("unsatisfiable trailing assert")
+    return outs
+
+
+def has_asserts(prog: BitProgram) -> bool:
+    return any(
+        alt.post_assert in ("b", "B")
+        or any(it.pre_assert is not None for it in alt.items)
+        for alt in prog.alternatives
+    )
+
+
+def expand_asserts(prog: BitProgram) -> BitProgram:
+    """Program-level de-assert rewrite: eliminate every ``\\b``/``\\B``
+    by expanding into ``^``/``$`` variants and explicit neighbor-byte
+    items. The payoff is bank-wide: BitGlushBank's capability flags drop
+    the word-ness tracking, allow select, and boundary-hit op groups
+    from the scan body for a fully assert-free bank (~8 of ~18 ops/byte
+    on the builtin library — PERF.md §9b). Raises
+    :class:`BitUnsupportedError` on shapes outside the rewrite
+    (mid-pattern asserts, impure multi-position cascades, cap blowups);
+    the caller then keeps the exact gated original."""
+    new_alts: list[BitAlternative] = []
+    for alt in prog.alternatives:
+        if alt.post_assert not in ("b", "B") and not any(
+            it.pre_assert is not None for it in alt.items
+        ):
+            new_alts.append(alt)
+            continue
+        if any(it.pre_assert is not None for it in alt.items[1:]):
+            raise BitUnsupportedError("mid-pattern assert")
+        for body, caret in _leading_variants(alt):
+            for t_items, t_post in _trailing_variants(body, alt.post_assert):
+                if len(t_items) > MAX_POSITIONS_PER_ALT:
+                    raise BitUnsupportedError("expanded alternative too long")
+                new_alts.append(
+                    BitAlternative(
+                        items=tuple(t_items), caret=caret, post_assert=t_post
+                    )
+                )
+                if len(new_alts) > MAX_ALTERNATIVES:
+                    raise BitUnsupportedError("assert expansion too large")
+    out = BitProgram(alternatives=tuple(new_alts))
+    assert not has_asserts(out)
+    return out
+
+
 def compile_bitprog_regex(regex: str, case_insensitive: bool) -> BitProgram:
     return compile_bitprog(parse_java_regex(regex, case_insensitive))

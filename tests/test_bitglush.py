@@ -18,13 +18,20 @@ from log_parser_tpu.ops.match import pack_byte_pairs
 from log_parser_tpu.patterns.regex.bitprog import (
     BitUnsupportedError,
     compile_bitprog_regex,
+    expand_asserts,
+    has_asserts,
 )
 
 
-def run_bank(regexes: list[tuple[str, bool]], lines: list[str]) -> np.ndarray:
+def run_bank(
+    regexes: list[tuple[str, bool]], lines: list[str], deassert: bool = False
+) -> np.ndarray:
     entries = [
         (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
     ]
+    if deassert:
+        entries = [(i, expand_asserts(p)) for i, p in entries]
+        assert not any(has_asserts(p) for _, p in entries)
     bank = BitGlushBank(entries)
     enc = encode_lines(lines)
     lines_tb = jnp.asarray(enc.u8.T)
@@ -40,8 +47,10 @@ def run_bank(regexes: list[tuple[str, bool]], lines: list[str]) -> np.ndarray:
     return np.asarray(finish(carry))[: len(lines)]
 
 
-def check_exact(regexes: list[tuple[str, bool]], lines: list[str]):
-    got = run_bank(regexes, lines)
+def check_exact(
+    regexes: list[tuple[str, bool]], lines: list[str], deassert: bool = False
+):
+    got = run_bank(regexes, lines, deassert=deassert)
     for j, (rx, ci) in enumerate(regexes):
         host = compile_java_regex(rx, ci)
         for i, line in enumerate(lines):
@@ -142,6 +151,90 @@ FEATURE_LINES = [
 
 def test_feature_exactness():
     check_exact(FEATURES, FEATURE_LINES)
+
+
+def test_feature_exactness_deasserted():
+    """The de-assert rewrite (expand_asserts) stays exact on every
+    feature, including leading/trailing \\b, \\B, and their ^/$/case
+    interactions."""
+    check_exact(FEATURES, FEATURE_LINES, deassert=True)
+
+
+def test_deassert_shapes():
+    """Shapes at the edges of the rewrite: single-item \\b\\w+\\b (PLUS
+    split both ends), pre-assert on a PLUS, impure trailing byteset
+    (split), cascade trailing (uniform), and \\B both ways."""
+    regexes = [
+        ("\\b\\w+\\b", False),
+        ("\\bx+y\\b", False),
+        ("x[=a]\\b", False),  # impure final byteset: split
+        # cascade [\s*, b] mixes word-ness across accepting positions ->
+        # rejected ("word-ness-impure trailing cascade"); asserted below
+        ("ab\\s*\\b", False),
+        ("\\Bood\\b", False),
+        ("\\btag\\B", False),
+    ]
+    lines = [
+        "", "x", "word", " word ", "=word=", "xxy", "xy z", "axy.",
+        "x= y", "xa b", "ab  c", "ab", "abc", "good food", "oodles",
+        "tag", "tags", "tag s", "a tag", "atag b", "x=", "x=,", "=x",
+    ]
+    with pytest.raises(BitUnsupportedError):
+        expand_asserts(compile_bitprog_regex("ab\\s*\\b", False))
+    for rx, ci in regexes:
+        try:
+            prog = expand_asserts(compile_bitprog_regex(rx, ci))
+        except BitUnsupportedError:
+            continue  # rejected shapes stay on gated tiers — fine
+        assert not has_asserts(prog)
+        check_exact([(rx, ci)], lines, deassert=True)
+
+
+def test_generative_fuzz_deasserted():
+    """Random regexes over the assert-bearing fragment, run through
+    expand_asserts, must match host re exactly."""
+    rng = random.Random(424242)
+    regexes: list[tuple[str, bool]] = []
+    attempts = 0
+    while len(regexes) < 60 and attempts < 1500:
+        attempts += 1
+        rx = _gen_regex(rng)
+        if "\\b" not in rx and rng.random() < 0.8:
+            continue  # bias toward assert-bearing shapes
+        ci = rng.random() < 0.2
+        try:
+            prog = expand_asserts(compile_bitprog_regex(rx, ci))
+        except BitUnsupportedError:
+            continue
+        assert not has_asserts(prog)
+        try:
+            compile_java_regex(rx, ci)
+        except Exception:
+            continue
+        regexes.append((rx, ci))
+    assert len(regexes) >= 40, f"generator too restrictive: {len(regexes)}"
+    alphabet = "abcxyz05 _-:AB9\t."
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 50)))
+        for _ in range(250)
+    ]
+    lines += ["", "a", " ", "foo", "bar:", "x0 x0 x0", "abc05xyz", "a" * 120]
+    check_exact(regexes, lines, deassert=True)
+
+
+def test_builtin_bank_fully_deasserted():
+    """The builtin library's bit bank must come out of the de-assert
+    rewrite with every word-ness op group off (that is the point: ~8 of
+    ~18 ops leave the scan body)."""
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    bank = PatternBank(load_builtin_pattern_sets())
+    mb = MatcherBanks(bank, bitglush_max_words=192)
+    g = mb.bitglush
+    assert g is not None
+    assert not g.has_preassert and not g.has_tb and not g.needs_wordness
 
 
 def test_builtin_union_columns_exact_on_corpus():
