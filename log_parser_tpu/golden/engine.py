@@ -106,7 +106,13 @@ class GoldenFrequencyTracker:
 
     def record_pattern_match(self, pattern_id: str | None) -> None:
         """FrequencyTrackingService.java:41-56."""
-        if pattern_id is None or pattern_id.strip() == "":
+        self.record_pattern_matches(pattern_id, 1)
+
+    def record_pattern_matches(self, pattern_id: str | None, n: int) -> None:
+        """Batched recording — one lock-held list extend instead of n
+        Python calls (the engine's finish phase holds the request-serial
+        state lock; a hit-heavy batch records millions of matches)."""
+        if n <= 0 or pattern_id is None or pattern_id.strip() == "":
             return
         freq = self._frequencies.get(pattern_id)
         if freq is None:
@@ -114,7 +120,7 @@ class GoldenFrequencyTracker:
                 self.config.frequency_time_window_hours * 3600.0, clock=self.clock
             )
             self._frequencies[pattern_id] = freq
-        freq.increment_count()
+        freq.increment_count_bulk(n)
 
     def calculate_frequency_penalty(self, pattern_id: str | None) -> float:
         """FrequencyTrackingService.java:64-93."""

@@ -122,6 +122,19 @@ class PatternFrequency:
         self._prune(now)
         self._timestamps.append(now)
 
+    def increment_count_bulk(self, n: int) -> None:
+        """Record ``n`` matches in one call: one clock read, one prune,
+        one list extend. A device batch's matches land at one timestamp
+        (the per-match loop's stamps differed only by the microseconds
+        between appends — never observable through the hours-scale
+        window semantics, and identical under the deterministic test
+        clocks, which return a fixed value until advanced)."""
+        if n <= 0:
+            return
+        now = self._clock()
+        self._prune(now)
+        self._timestamps.extend([now] * n)
+
     def get_current_count(self) -> int:
         self._prune(self._clock())
         return len(self._timestamps)

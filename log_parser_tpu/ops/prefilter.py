@@ -92,7 +92,7 @@ class PrefilterBank:
             for lit in col.literals:
                 lits.append(lit.fold().text)
                 groups.append(j)
-        self.ac = AhoCorasick(lits, groups=groups)
+        self.ac = AhoCorasick.build_cached(lits, groups=groups)
         self.n_words = self.ac.n_words
         # scan RAW bytes against folded literals: compose ASCII folding into
         # the byte-class table so folding costs nothing at runtime

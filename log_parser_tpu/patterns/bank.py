@@ -53,20 +53,52 @@ CONTEXT_REGEXES: list[tuple[str, bool]] = [
 CTX_ERROR, CTX_WARN, CTX_STACK, CTX_EXCEPTION = range(4)
 
 
-@dataclasses.dataclass
 class MatcherColumn:
     """One distinct regex to evaluate per line.
 
     Matcher tier (first that applies): ``exact_seqs`` → bit-parallel
     Shift-Or (O(1) in bank size per line-byte); ``dfa`` → packed automaton
-    bank; neither → host ``re`` over every line."""
+    bank; neither → host ``re`` over every line.
 
-    regex: str
-    case_insensitive: bool
-    dfa: CompiledDfa | None  # None -> host fallback only
-    host: re.Pattern[str]  # golden-compiled reference matcher
-    literals: frozenset[Literal] | None  # None -> unfactorable
-    exact_seqs: tuple | None = None  # fixed byte-class sequences == regex
+    ``host`` (the golden-compiled reference matcher) is LAZY: eagerly
+    compiling it for every column cost ~5 s/10k patterns at boot, while
+    only host-tier columns and override lines ever use it. The snapshot
+    path (libcache.py) relies on this — validation already happened when
+    the snapshot was built."""
+
+    __slots__ = ("regex", "case_insensitive", "dfa", "literals",
+                 "exact_seqs", "_host")
+
+    def __init__(
+        self,
+        regex: str,
+        case_insensitive: bool,
+        dfa: CompiledDfa | None,  # None -> host fallback only
+        literals: frozenset[Literal] | None,  # None -> unfactorable
+        exact_seqs: tuple | None = None,  # fixed byte-class seqs == regex
+        host: re.Pattern[str] | None = None,
+    ):
+        self.regex = regex
+        self.case_insensitive = case_insensitive
+        self.dfa = dfa
+        self.literals = literals
+        self.exact_seqs = exact_seqs
+        self._host = host
+
+    @property
+    def host(self) -> re.Pattern[str]:
+        if self._host is None:
+            self._host = compile_java_regex(self.regex, self.case_insensitive)
+        return self._host
+
+    def __getstate__(self):
+        return (self.regex, self.case_insensitive, self.dfa, self.literals,
+                self.exact_seqs)
+
+    def __setstate__(self, state):
+        (self.regex, self.case_insensitive, self.dfa, self.literals,
+         self.exact_seqs) = state
+        self._host = None
 
 
 @dataclasses.dataclass
@@ -93,6 +125,8 @@ class PatternBank:
     """
 
     def __init__(self, pattern_sets: list[PatternSet]):
+        from log_parser_tpu.patterns import libcache
+
         self.pattern_sets = pattern_sets
         self.columns: list[MatcherColumn] = []
         self._column_by_key: dict[tuple[str, bool], int] = {}
@@ -103,31 +137,92 @@ class PatternBank:
         self.secondaries: list[SecondaryEntry] = []
         self.sequences: list[SequenceEntry] = []
 
-        # context columns first so their indexes are the CTX_* constants
-        for rx, ci in CONTEXT_REGEXES:
-            self._intern_column(rx, ci)
+        key = libcache.library_key(pattern_sets, CONTEXT_REGEXES)
+        snap = libcache.load(key)
+        if snap is not None:
+            try:
+                # whole-library warm path: one read replaces every
+                # per-column parse/DFA/literal build and every eager
+                # golden re compile
+                columns = snap["columns"]
+                by_index = [
+                    (ps.patterns or [])[pi]
+                    for ps, kept in zip(
+                        pattern_sets, snap["kept"], strict=True
+                    )
+                    for pi in kept
+                ]
+                self.columns = columns
+                self._column_by_key = {
+                    (c.regex, c.case_insensitive): i
+                    for i, c in enumerate(columns)
+                }
+                self.patterns = by_index
+                self.skipped_patterns = list(snap["skipped"])
+                primary_cols = list(snap["primary_cols"])
+                self.secondaries = list(snap["secondaries"])
+                self.sequences = list(snap["sequences"])
+                if self.skipped_patterns:
+                    # the cold build logged each skip with its reason;
+                    # keep the fact visible on every warm boot too
+                    log.warning(
+                        "Bank snapshot restored %d skipped pattern(s): %s",
+                        len(self.skipped_patterns),
+                        [pid for pid, _ in self.skipped_patterns[:10]],
+                    )
+            except Exception as exc:  # malformed snapshot: rebuild cold
+                log.warning("Bank snapshot restore failed, rebuilding: %s", exc)
+                self.columns = []
+                self._column_by_key = {}
+                self.patterns = []
+                self.skipped_patterns = []
+                primary_cols = []
+                self.secondaries = []
+                self.sequences = []
+                snap = None
+        if snap is None:
+            # context columns first so their indexes are the CTX_* consts
+            for rx, ci in CONTEXT_REGEXES:
+                self._intern_column(rx, ci)
 
-        for ps in pattern_sets:
-            for pattern in ps.patterns or []:
-                mark = len(self.columns)
-                try:
-                    entry = self._compile_pattern(pattern, len(self.patterns))
-                except (ValueError, re.error) as exc:
-                    log.error("Skipping pattern %r: %s", pattern.id, exc)
-                    self.skipped_patterns.append((pattern.id, str(exc)))
-                    # roll back columns interned for the aborted pattern so
-                    # the match kernels never pay for orphan regexes
-                    for col in self.columns[mark:]:
-                        del self._column_by_key[(col.regex, col.case_insensitive)]
-                    del self.columns[mark:]
-                    continue
-                if entry is None:  # primary-less pattern: compiles, never matches
-                    continue
-                pcol, secs, seqs = entry
-                self.patterns.append(pattern)
-                primary_cols.append(pcol)
-                self.secondaries.extend(secs)
-                self.sequences.extend(seqs)
+            kept: list[list[int]] = []
+            for ps in pattern_sets:
+                kept.append([])
+                for pi, pattern in enumerate(ps.patterns or []):
+                    mark = len(self.columns)
+                    try:
+                        entry = self._compile_pattern(pattern, len(self.patterns))
+                    except (ValueError, re.error) as exc:
+                        log.error("Skipping pattern %r: %s", pattern.id, exc)
+                        self.skipped_patterns.append((pattern.id, str(exc)))
+                        # roll back columns interned for the aborted
+                        # pattern so the match kernels never pay for
+                        # orphan regexes
+                        for col in self.columns[mark:]:
+                            del self._column_by_key[
+                                (col.regex, col.case_insensitive)
+                            ]
+                        del self.columns[mark:]
+                        continue
+                    if entry is None:  # primary-less: compiles, never matches
+                        continue
+                    pcol, secs, seqs = entry
+                    self.patterns.append(pattern)
+                    kept[-1].append(pi)
+                    primary_cols.append(pcol)
+                    self.secondaries.extend(secs)
+                    self.sequences.extend(seqs)
+            libcache.save(
+                key,
+                {
+                    "columns": self.columns,
+                    "kept": kept,
+                    "skipped": self.skipped_patterns,
+                    "primary_cols": primary_cols,
+                    "secondaries": self.secondaries,
+                    "sequences": self.sequences,
+                },
+            )
 
         self.primary_columns = np.asarray(primary_cols, dtype=np.int32)
         self.n_patterns = len(self.patterns)
@@ -187,6 +282,9 @@ class PatternBank:
 
     # ------------------------------------------------------------------ build
 
+    # NOTE: changing what _intern_column/_compile_pattern build or how
+    # skip decisions are made requires bumping libcache.SNAPSHOT_VERSION —
+    # warm boots restore their outputs from the content-keyed snapshot.
     def _intern_column(self, regex: str, case_insensitive: bool) -> int:
         key = (regex, case_insensitive)
         col = self._column_by_key.get(key)

@@ -1,0 +1,122 @@
+"""Whole-library compile snapshot keyed by pattern-set content hash.
+
+The per-regex DFA cache (regex/cache.py) already amortizes NFA→DFA
+construction, but a warm 10k-library boot still paid ~20 s: ~1 ms of
+npz/zipfile overhead per cached regex read, times every interned column,
+times every bank the engine builds (the full bank plus one per pattern
+shard), plus eager golden ``re`` compilation and literal extraction for
+every column. The reference reloads its library in milliseconds
+(PatternService.java:45-69 — it just parses YAML; compilation happens
+per request); boot-time parity needs the whole *compiled bank* to load
+in one read.
+
+This module snapshots the expensive half of ``PatternBank.__init__`` —
+interned columns (DFA tables, exact sequences, literal factors), kept /
+skipped pattern decisions, secondary and sequence index entries — into
+ONE pickle file keyed by ``sha256`` of the full serialized pattern sets
+plus every compiler version that shapes the output. Golden ``re``
+patterns are NOT stored: columns recompile them lazily on first use
+(``MatcherColumn.host``), and the snapshot records that validation
+already succeeded (the build is deterministic, so the same library
+makes the same skip decisions).
+
+Trust model: the cache directory (``$LOG_PARSER_TPU_CACHE`` or
+``~/.cache/log_parser_tpu``) is user-private (created 0700) and written
+only by this process — the same trust boundary as JAX's persistent
+executable cache, which deserializes compiled binaries from the same
+tree. Entries are pickles; do not point the cache at untrusted storage.
+Corrupt or version-skewed entries are ignored and rebuilt.
+
+Disable with ``LOG_PARSER_TPU_CACHE=0`` (shared switch with the DFA
+cache); ``LOG_PARSER_TPU_LIBCACHE=0`` disables just this layer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pathlib
+import pickle
+import tempfile
+from typing import Any
+
+from log_parser_tpu.patterns.regex.cache import COMPILER_VERSION, cache_subdir
+from log_parser_tpu.patterns.regex.literals import LITERALS_VERSION
+
+log = logging.getLogger(__name__)
+
+# BUMP when the bank-build logic changes what a snapshot stores or how
+# kept/skipped decisions are made (PatternBank._compile_pattern /
+# _intern_column) — the content hash cannot see code edits
+SNAPSHOT_VERSION = 1
+
+
+def _dir() -> pathlib.Path | None:
+    if os.environ.get("LOG_PARSER_TPU_LIBCACHE") == "0":
+        return None
+    return cache_subdir("bank")
+
+
+def library_key(pattern_sets, context_regexes) -> str | None:
+    """Deterministic content hash, or None when the sets don't serialize
+    (unhashable custom objects — then the cache is skipped)."""
+    try:
+        payload = json.dumps(
+            [ps.to_dict() for ps in pattern_sets],
+            sort_keys=True,
+            ensure_ascii=False,
+            default=repr,
+        )
+    except Exception:
+        return None
+    h = hashlib.sha256()
+    h.update(
+        f"bank-v{SNAPSHOT_VERSION}|dfa-v{COMPILER_VERSION}"
+        f"|lit-v{LITERALS_VERSION}|ctx={context_regexes!r}|".encode()
+    )
+    h.update(payload.encode())
+    return h.hexdigest()
+
+
+def load(key: str | None) -> dict[str, Any] | None:
+    d = _dir()
+    if d is None or key is None:
+        return None
+    path = d / f"{key}.pkl"
+    if not path.exists():
+        return None
+    try:
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        if snap.get("version") != SNAPSHOT_VERSION:
+            return None
+        return snap
+    except Exception as exc:
+        log.warning("Ignoring corrupt bank snapshot %s: %s", path.name, exc)
+        return None
+
+
+def save(key: str | None, snap: dict[str, Any]) -> None:
+    d = _dir()
+    if d is None or key is None:
+        return
+    tmp = None
+    try:
+        d.mkdir(parents=True, exist_ok=True)
+        os.chmod(d, 0o700)
+        snap = dict(snap, version=SNAPSHOT_VERSION)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, d / f"{key}.pkl")  # atomic publish
+        tmp = None
+    except OSError as exc:
+        log.warning("Bank snapshot write failed: %s", exc)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

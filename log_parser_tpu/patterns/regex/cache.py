@@ -41,6 +41,19 @@ def _cache_dir() -> pathlib.Path | None:
     return pathlib.Path.home() / ".cache" / "log_parser_tpu" / "dfa"
 
 
+def cache_subdir(name: str) -> pathlib.Path | None:
+    """Directory for another cache layer (``bank``, ``ac``, …), honoring
+    the same ``LOG_PARSER_TPU_CACHE`` switch: an explicit dir hosts the
+    layers as subdirectories beside the dfa entries; the default tree is
+    ``~/.cache/log_parser_tpu/<name>``."""
+    env = os.environ.get("LOG_PARSER_TPU_CACHE")
+    if env == "0":
+        return None
+    if env:
+        return pathlib.Path(env) / name
+    return pathlib.Path.home() / ".cache" / "log_parser_tpu" / name
+
+
 def _key(regex: str, case_insensitive: bool, max_states: int) -> str:
     h = hashlib.sha256()
     h.update(f"v{COMPILER_VERSION}|ci={int(case_insensitive)}|ms={max_states}|".encode())

@@ -32,6 +32,11 @@ from log_parser_tpu.patterns.regex.parser import (
     Rep,
 )
 
+# BUMP when extraction output changes shape or content: the whole-library
+# bank snapshot (patterns/libcache.py) stores extracted literals and
+# exact sequences, and invalidates on this constant
+LITERALS_VERSION = 1
+
 MAX_LITERALS = 64  # per pattern: larger sets filter poorly anyway
 MAX_LITERAL_LEN = 24  # truncation keeps the required property
 

@@ -379,10 +379,13 @@ class AnalysisEngine:
                 freq_base, freq_exists,
             )
 
-        # record this batch's matches (after the read — ScoringService.java:84-88)
+        # record this batch's matches (after the read — ScoringService.java:84-88);
+        # bulk per slot: one list extend instead of count Python calls
+        # inside the only lock every concurrent request shares
         for slot, count in enumerate(fin.slot_batch_counts[: self.bank.n_freq_slots]):
-            for _ in range(int(count)):
-                self.frequency.record_pattern_match(self.bank.freq_ids[slot])
+            self.frequency.record_pattern_matches(
+                self.bank.freq_ids[slot], int(count)
+            )
 
         # records are already in discovery order (line-major, then pattern)
         with trace.phase("assemble"):

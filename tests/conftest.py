@@ -30,6 +30,19 @@ os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
 # machine-feature mismatch warnings on load)
 os.environ["LOG_PARSER_TPU_XLA_CACHE"] = "0"
 
+# ... and never the user-level DFA/bank/AC caches either: a warm bank
+# snapshot would silently bypass the bank-construction code a test run is
+# meant to exercise. One shared per-run directory keeps repeat builds
+# within the run fast (tests that need cold/warm control, like
+# test_libcache.py, monkeypatch LOG_PARSER_TPU_CACHE themselves).
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+_cache_root = tempfile.mkdtemp(prefix="lpt-test-cache-")
+os.environ["LOG_PARSER_TPU_CACHE"] = _cache_root
+atexit.register(shutil.rmtree, _cache_root, ignore_errors=True)
+
 import pytest  # noqa: E402
 
 from log_parser_tpu.config import ScoringConfig  # noqa: E402

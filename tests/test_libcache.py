@@ -1,0 +1,136 @@
+"""Whole-library bank snapshot (patterns/libcache.py): warm restore
+equivalence, skip-decision preservation, lazy host compilation, corrupt
+entry containment, and content-keyed invalidation."""
+
+from __future__ import annotations
+
+import pickle
+
+import pytest
+
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+    return tmp_path
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern("ok-1", regex="OutOfMemoryError", confidence=0.9),
+                make_pattern("ok-2", regex="x(code|status)=[45]\\d\\d",
+                             confidence=0.5),
+                make_pattern("bad-1", regex="broken(", confidence=0.5),
+                make_pattern("ok-3", regex="\\btimeout\\b", confidence=0.7),
+            ]
+        )
+    ]
+
+
+def _bank_fingerprint(bank):
+    return (
+        [(c.regex, c.case_insensitive, c.dfa is None, c.exact_seqs,
+          c.literals) for c in bank.columns],
+        [p.id for p in bank.patterns],
+        bank.skipped_patterns,
+        bank.primary_columns.tolist(),
+        [(s.pattern_idx, s.column, s.weight, s.window)
+         for s in bank.secondaries],
+        bank.freq_ids,
+    )
+
+
+def test_warm_restore_is_equivalent_and_lazy(cache_dir):
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    cold = PatternBank(_sets())
+    snaps = list((cache_dir / "bank").glob("*.pkl"))
+    assert snaps, "snapshot not written"
+
+    warm = PatternBank(_sets())
+    assert _bank_fingerprint(warm) == _bank_fingerprint(cold)
+    # warm columns have NOT compiled their golden host patterns yet
+    assert all(c._host is None for c in warm.columns)
+    # the property compiles on demand and matches
+    assert warm.columns[-1].host.search("a timeout b")
+    # bad regex skipped identically without any compile on the warm path
+    assert warm.skipped_patterns and warm.skipped_patterns[0][0] == "bad-1"
+
+
+def test_corrupt_snapshot_rebuilds(cache_dir):
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    PatternBank(_sets())
+    (snap,) = (cache_dir / "bank").glob("*.pkl")
+    snap.write_bytes(b"not a pickle")
+    bank = PatternBank(_sets())  # must not raise
+    assert bank.n_patterns == 3
+
+
+def test_malformed_snapshot_contents_rebuild(cache_dir):
+    from log_parser_tpu.patterns import libcache
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    PatternBank(_sets())
+    (path,) = (cache_dir / "bank").glob("*.pkl")
+    with open(path, "rb") as f:
+        snap = pickle.load(f)
+    snap["kept"] = [[0]] * 7  # wrong shape: restore must fall back
+    with open(path, "wb") as f:
+        pickle.dump(snap, f)
+    bank = PatternBank(_sets())
+    assert bank.n_patterns == 3 and len(bank.columns) >= 7
+
+
+def test_content_keyed_invalidation(cache_dir):
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    PatternBank(_sets())
+    changed = _sets()
+    changed[0].patterns[0].primary_pattern.regex = "SomethingElse"
+    bank = PatternBank(changed)
+    assert any(c.regex == "SomethingElse" for c in bank.columns)
+    assert len(list((cache_dir / "bank").glob("*.pkl"))) == 2
+
+
+def test_ac_build_cached_roundtrip(cache_dir):
+    import numpy as np
+
+    from log_parser_tpu.patterns.regex.ac import AhoCorasick
+
+    lits = [b"error", b"warn", b"exception in", b"err"]
+    groups = [0, 1, 2, 0]
+    cold = AhoCorasick.build_cached(lits, groups)
+    assert list((cache_dir / "ac").glob("*.npz"))
+    warm = AhoCorasick.build_cached(lits, groups)
+    for f in ("goto", "byte_class", "out_words", "has_out"):
+        np.testing.assert_array_equal(getattr(cold, f), getattr(warm, f))
+    assert warm.scan(b"an exception in warnings") == cold.scan(
+        b"an exception in warnings"
+    )
+    # corrupt entry: rebuilt, not crashed
+    (entry,) = (cache_dir / "ac").glob("*.npz")
+    entry.write_bytes(b"junk")
+    again = AhoCorasick.build_cached(lits, groups)
+    np.testing.assert_array_equal(again.goto, cold.goto)
+
+
+def test_warm_engine_end_to_end(cache_dir):
+    """A warm-restored bank drives the full engine identically."""
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    logs = "ok\njava.lang.OutOfMemoryError: heap\nxstatus=503 now\ntimeout x"
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    r_cold = AnalysisEngine(_sets(), ScoringConfig()).analyze(data)
+    r_warm = AnalysisEngine(_sets(), ScoringConfig()).analyze(data)
+    cold_ev = [(e.matched_pattern.id, e.line_number, e.score)
+               for e in r_cold.events]
+    warm_ev = [(e.matched_pattern.id, e.line_number, e.score)
+               for e in r_warm.events]
+    assert cold_ev == warm_ev and len(cold_ev) == 3

@@ -119,3 +119,24 @@ class TestPatternFrequency:
         freq.increment_count()
         freq.reset()
         assert freq.get_current_count() == 0
+
+    def test_bulk_increment_window_semantics(self):
+        """Bulk recording is count- and window-equivalent to the loop:
+        same windowed counts, same expiry, same interleaving with
+        singles; n<=0 is a no-op."""
+        clock = lambda: clock.now  # noqa: E731
+        clock.now = 0.0
+        freq = PatternFrequency(3600.0, clock=clock)
+        freq.increment_count_bulk(1000)
+        assert freq.get_current_count() == 1000
+        clock.now = 1800.0
+        freq.increment_count()
+        freq.increment_count_bulk(4)
+        assert freq.get_current_count() == 1005
+        clock.now = 3601.0  # first bulk expired, the t=1800 five remain
+        assert freq.get_current_count() == 5
+        freq.increment_count_bulk(0)
+        freq.increment_count_bulk(-3)
+        assert freq.get_current_count() == 5
+        clock.now = 5401.0
+        assert freq.get_current_count() == 0
