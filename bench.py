@@ -29,6 +29,11 @@ import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
 
 N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 200_000
 NORTH_STAR_LINES_PER_SEC = 1_000_000.0
+# --host-col: config-2 variant with one injected lookbehind pattern (a
+# host-only column). Guards the VERDICT r3 #3 cliff: with the literal
+# prefilter this must stay within ~2x of the clean number instead of
+# collapsing to a full host-re scan per request.
+HOST_COL = "--host-col" in sys.argv
 
 
 def build_corpus(n: int) -> str:
@@ -55,7 +60,12 @@ def build_corpus(n: int) -> str:
 
 
 def main() -> None:
-    platform = bench_common.probe_backend("log_lines_scored_per_sec_per_chip", "lines/s")
+    metric = (
+        "log_lines_scored_per_sec_per_chip_hostcol"
+        if HOST_COL
+        else "log_lines_scored_per_sec_per_chip"
+    )
+    platform = bench_common.probe_backend(metric, "lines/s")
 
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
@@ -63,6 +73,32 @@ def main() -> None:
     from log_parser_tpu.runtime import AnalysisEngine
 
     sets = load_builtin_pattern_sets()
+    if HOST_COL:
+        from log_parser_tpu.models.pattern import (
+            Pattern,
+            PatternSet,
+            PatternSetMetadata,
+            PrimaryPattern,
+        )
+
+        sets = sets + [
+            PatternSet(
+                metadata=PatternSetMetadata(
+                    library_id="hostcol", name="hostcol"
+                ),
+                patterns=[
+                    Pattern(
+                        id="hostcol-lb",
+                        name="lookbehind host column",
+                        severity="HIGH",
+                        primary_pattern=PrimaryPattern(
+                            regex=r"(?<=dial tcp )10\.0\.0\.\d+",
+                            confidence=0.8,
+                        ),
+                    )
+                ],
+            )
+        ]
     n_patterns = sum(len(s.patterns or []) for s in sets)
     engine = AnalysisEngine(sets, ScoringConfig())
     assert not engine.fallback_to_golden, "bench must never serve from golden"
@@ -116,7 +152,7 @@ def main() -> None:
     # between runs); the serial single-stream rate rides alongside
     lines_per_sec = pipe_rate
     bench_common.emit(
-        "log_lines_scored_per_sec_per_chip",
+        metric,
         round(lines_per_sec, 1),
         "lines/s",
         round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),

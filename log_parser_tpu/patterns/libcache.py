@@ -39,17 +39,24 @@ import logging
 import os
 import pathlib
 import pickle
-import tempfile
+import sys
 from typing import Any
 
-from log_parser_tpu.patterns.regex.cache import COMPILER_VERSION, cache_subdir
+from log_parser_tpu.patterns.regex.cache import (
+    COMPILER_VERSION,
+    atomic_publish,
+    cache_subdir,
+)
 from log_parser_tpu.patterns.regex.literals import LITERALS_VERSION
 
 log = logging.getLogger(__name__)
 
 # BUMP when the bank-build logic changes what a snapshot stores or how
 # kept/skipped decisions are made (PatternBank._compile_pattern /
-# _intern_column) — the content hash cannot see code edits
+# _intern_column) — the content hash cannot see code edits. The Python
+# minor version is also folded into the key: skip decisions encode
+# ``re``-module acceptance, which changes across interpreter versions,
+# and warm boots trust them without revalidating.
 SNAPSHOT_VERSION = 1
 
 
@@ -74,7 +81,8 @@ def library_key(pattern_sets, context_regexes) -> str | None:
     h = hashlib.sha256()
     h.update(
         f"bank-v{SNAPSHOT_VERSION}|dfa-v{COMPILER_VERSION}"
-        f"|lit-v{LITERALS_VERSION}|ctx={context_regexes!r}|".encode()
+        f"|lit-v{LITERALS_VERSION}|py-{sys.version_info[0]}.{sys.version_info[1]}"
+        f"|ctx={context_regexes!r}|".encode()
     )
     h.update(payload.encode())
     return h.hexdigest()
@@ -102,21 +110,15 @@ def save(key: str | None, snap: dict[str, Any]) -> None:
     d = _dir()
     if d is None or key is None:
         return
-    tmp = None
+    snap = dict(snap, version=SNAPSHOT_VERSION)
     try:
         d.mkdir(parents=True, exist_ok=True)
         os.chmod(d, 0o700)
-        snap = dict(snap, version=SNAPSHOT_VERSION)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, d / f"{key}.pkl")  # atomic publish
-        tmp = None
     except OSError as exc:
-        log.warning("Bank snapshot write failed: %s", exc)
-    finally:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        log.warning("Bank snapshot dir unavailable: %s", exc)
+        return
+    atomic_publish(
+        d,
+        f"{key}.pkl",
+        lambda f: pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL),
+    )

@@ -19,8 +19,6 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import os
-import tempfile
 from collections import deque
 
 import numpy as np
@@ -161,32 +159,23 @@ class AhoCorasick:
                             path.name, exc)
 
         ac = cls(literals, groups)
-        tmp = None
-        try:
-            d.mkdir(parents=True, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-            with os.fdopen(fd, "wb") as f:
-                np.savez(
-                    f,
-                    n_groups=np.int64(ac.n_groups),
-                    n_words=np.int64(ac.n_words),
-                    n_nodes=np.int64(ac.n_nodes),
-                    n_classes=np.int64(ac.n_classes),
-                    goto=ac.goto,
-                    byte_class=ac.byte_class,
-                    out_words=ac.out_words,
-                    has_out=ac.has_out,
-                )
-            os.replace(tmp, path)
-            tmp = None
-        except OSError as exc:
-            log.warning("AC cache write failed: %s", exc)
-        finally:
-            if tmp is not None:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+        from log_parser_tpu.patterns.regex.cache import atomic_publish
+
+        atomic_publish(
+            d,
+            path.name,
+            lambda f: np.savez(
+                f,
+                n_groups=np.int64(ac.n_groups),
+                n_words=np.int64(ac.n_words),
+                n_nodes=np.int64(ac.n_nodes),
+                n_classes=np.int64(ac.n_classes),
+                goto=ac.goto,
+                byte_class=ac.byte_class,
+                out_words=ac.out_words,
+                has_out=ac.has_out,
+            ),
+        )
         return ac
 
     # ---------------------------------------------------------------- scans

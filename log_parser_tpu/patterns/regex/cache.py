@@ -54,6 +54,31 @@ def cache_subdir(name: str) -> pathlib.Path | None:
     return pathlib.Path.home() / ".cache" / "log_parser_tpu" / name
 
 
+def atomic_publish(directory: pathlib.Path, name: str, writer) -> None:
+    """Best-effort atomic cache write shared by every cache layer (dfa /
+    bank / ac): ``writer(file)`` fills a tempfile that is then renamed
+    into place, so concurrent readers never see a torn entry. ANY
+    failure is logged and swallowed — a cache write must never break
+    the build it is caching (the read sides contain corrupt entries the
+    same way)."""
+    tmp = None
+    try:
+        directory.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+        os.replace(tmp, directory / name)
+        tmp = None
+    except Exception as exc:
+        log.warning("cache write failed for %s: %s", name, exc)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def _key(regex: str, case_insensitive: bool, max_states: int) -> str:
     h = hashlib.sha256()
     h.update(f"v{COMPILER_VERSION}|ci={int(case_insensitive)}|ms={max_states}|".encode())
@@ -86,29 +111,17 @@ def compile_regex_to_dfa_cached(
             log.warning("Ignoring corrupt DFA cache entry %s: %s", path.name, exc)
 
     dfa = compile_regex_to_dfa(regex, case_insensitive, max_states)
-    tmp = None
-    try:
-        cache.mkdir(parents=True, exist_ok=True)
-        # atomic publish so concurrent engines never read a torn file
-        fd, tmp = tempfile.mkstemp(dir=cache, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            np.savez(
-                f,
-                trans=dfa.trans,
-                byte_class=dfa.byte_class,
-                accept_end=dfa.accept_end,
-                start=np.int64(dfa.start),
-                n_states=np.int64(dfa.n_states),
-                n_classes=np.int64(dfa.n_classes),
-            )
-        os.replace(tmp, path)
-        tmp = None
-    except OSError as exc:
-        log.warning("DFA cache write failed for %s: %s", path.name, exc)
-    finally:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+    atomic_publish(
+        cache,
+        path.name,
+        lambda f: np.savez(
+            f,
+            trans=dfa.trans,
+            byte_class=dfa.byte_class,
+            accept_end=dfa.accept_end,
+            start=np.int64(dfa.start),
+            n_states=np.int64(dfa.n_states),
+            n_classes=np.int64(dfa.n_classes),
+        ),
+    )
     return dfa

@@ -134,8 +134,11 @@ def _extract_cat(node: Cat) -> frozenset[Literal] | None:
             run.clear()
 
     for part in node.parts:
-        if isinstance(part, Assertion):
-            continue  # zero-width: does not interrupt adjacency of bytes
+        if isinstance(part, (Assertion, Empty)):
+            # zero-width: does not interrupt adjacency of bytes (Empty
+            # appears where the lenient parser dropped a lookaround/\G —
+            # both sides stay contiguous in every true match)
+            continue
         piece = part
         # a{n,m} with n>=1 contributes at least one child occurrence
         if isinstance(piece, Rep) and piece.lo >= 1 and isinstance(piece.child, Lit):

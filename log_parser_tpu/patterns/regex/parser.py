@@ -133,11 +133,17 @@ def _char_to_bytesets(ch: str, ci: bool) -> list[frozenset[int]]:
 
 
 class _Parser:
-    def __init__(self, pattern: str, case_insensitive: bool = False):
+    def __init__(
+        self,
+        pattern: str,
+        case_insensitive: bool = False,
+        lenient: bool = False,
+    ):
         self.p = pattern
         self.i = 0
         self.n = len(pattern)
         self.ci = case_insensitive
+        self.lenient = lenient
 
     def fail(self, what: str) -> RegexUnsupportedError:
         return RegexUnsupportedError(f"{what} at index {self.i} in {self.p!r}")
@@ -224,8 +230,10 @@ class _Parser:
             return None
         nxt = self.peek()
         if nxt == "+":
-            raise self.fail("possessive quantifier")
-        if nxt == "?":
+            if not self.lenient:
+                raise self.fail("possessive quantifier")
+            self.take()  # lenient: read as greedy (a language superset)
+        elif nxt == "?":
             self.take()  # lazy — same language
         return lo, hi
 
@@ -270,7 +278,9 @@ class _Parser:
             elif nxt == "<":
                 self.take()
                 if self.peek() in ("=", "!"):
-                    raise self.fail("lookbehind")
+                    if not self.lenient:
+                        raise self.fail("lookbehind")
+                    return self._lenient_zero_width()
                 # named group (?<name>...)
                 while self.peek() not in (">", None):
                     self.take()
@@ -278,15 +288,28 @@ class _Parser:
                     raise self.fail("unterminated group name")
                 self.take()
             elif nxt in ("=", "!"):
-                raise self.fail("lookahead")
+                if not self.lenient:
+                    raise self.fail("lookahead")
+                return self._lenient_zero_width()
             elif nxt == ">":
-                raise self.fail("atomic group")
+                if not self.lenient:
+                    raise self.fail("atomic group")
+                # lenient: plain group (atomic language ⊆ greedy language)
+                self.take()
+                node = self.parse_alt()
+                if self.peek() != ")":
+                    raise self.fail("unbalanced group")
+                self.take()
+                return node
             elif nxt is not None and nxt in "idmsuxU-":
                 # inline flags (?i) / (?i:...) — only 'i' is honored
                 flags = ""
                 while self.peek() is not None and self.peek() in "idmsuxU-":
                     flags += self.take()
-                if any(f in flags for f in "dmsuxU"):
+                # x (free-spacing retokenizes), u/U (Unicode case folding)
+                # reshape the language even for widening purposes
+                bad = "xuU" if self.lenient else "dmsuxU"
+                if any(f in flags for f in bad):
                     raise self.fail(f"inline flags {flags!r}")
                 if self.peek() == ")":
                     # (?i) applies to the rest of the pattern
@@ -331,11 +354,24 @@ class _Parser:
         if ch == "Z":  # before a final line terminator, like $
             return self._java_dollar()
         if ch == "G":
-            raise self.fail("\\G")
+            if not self.lenient:
+                raise self.fail("\\G")
+            return Empty()  # anchor dropped: widens
         if ch.isdigit():
-            raise self.fail("backreference")
+            if not self.lenient:
+                raise self.fail("backreference")
+            while self.peek() is not None and self.peek().isdigit():
+                self.take()
+            return self._lenient_any_run()
         if ch == "k":
-            raise self.fail("named backreference")
+            if not self.lenient:
+                raise self.fail("named backreference")
+            if self.peek() == "<":
+                while self.peek() not in (">", None):
+                    self.take()
+                if self.peek() == ">":
+                    self.take()
+            return self._lenient_any_run()
         if ch in _CLASS_SHORTHANDS:
             return Lit(_CLASS_SHORTHANDS[ch])
         if ch in ("p", "P"):
@@ -346,15 +382,40 @@ class _Parser:
         if ch == "u":
             return self._literal(chr(self._hex(4)))
         if ch == "0":
-            raise self.fail("octal escape")
+            if not self.lenient:
+                raise self.fail("octal escape")
+            digits = 0
+            while digits < 3 and self.peek() is not None and self.peek() in "01234567":
+                self.take()
+                digits += 1
+            return Lit(ALL_BYTES)  # some byte: widens
         if ch == "Q":
             return self._quoted()
         if ch == "c":
-            raise self.fail("control escape")
+            if not self.lenient:
+                raise self.fail("control escape")
+            if self.peek() is not None:
+                self.take()
+            return Lit(ALL_BYTES)
         if ch in _SIMPLE_ESCAPES:
             return Lit(frozenset({_SIMPLE_ESCAPES[ch]}))
         # escaped metachar or ordinary char: literal
         return self._literal(ch)
+
+    def _lenient_zero_width(self) -> Node:
+        """Lenient lookaround: consume ``=``/``!`` + body + ``)`` and
+        drop the constraint (zero-width → ε widens the language)."""
+        self.take()  # the = or !
+        self.parse_alt()  # body parses (recursively lenient), discarded
+        if self.peek() != ")":
+            raise self.fail("unbalanced lookaround")
+        self.take()
+        return Empty()
+
+    def _lenient_any_run(self) -> Node:
+        """Lenient backreference: any byte run incl. empty — the widest
+        thing the captured text could be."""
+        return Rep(Lit(ALL_BYTES), 0, None)
 
     def _posix_contents(self) -> frozenset[int]:
         if self.peek() != "{":
@@ -468,10 +529,23 @@ class _Parser:
         return "byte", code
 
 
-def parse_java_regex(pattern: str, case_insensitive: bool = False) -> Node:
+def parse_java_regex(
+    pattern: str, case_insensitive: bool = False, lenient: bool = False
+) -> Node:
     """Parse ``pattern`` (Java dialect) into a byte-level AST.
 
     Raises :class:`RegexUnsupportedError` for constructs outside the automaton
     subset; callers fall back to host-side matching.
+
+    ``lenient=True`` produces a *language-widening approximation* instead
+    of raising for most host-only constructs (lookaround → ε, backreference
+    → ``.*``-of-any-bytes, atomic → plain group, possessive → greedy, octal
+    and control escapes → any byte, ``\\G`` → ε, inline m/s/d flags →
+    accepted). The result must NEVER be used for matching — only for
+    analyses that are sound under widening, like required-literal
+    extraction (literals.py): a literal required by a superset language is
+    required by the true one. Constructs whose lenient reading could
+    NARROW or reshape the language (x/u/U flags, class intersection,
+    nested or non-ASCII classes) still raise.
     """
-    return _Parser(pattern, case_insensitive).parse()
+    return _Parser(pattern, case_insensitive, lenient=lenient).parse()

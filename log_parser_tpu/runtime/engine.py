@@ -158,6 +158,31 @@ class AnalysisEngine:
             for i, c in enumerate(self.bank.columns)
             if c.dfa is not None or c.exact_seqs is not None
         ]
+        # Host-column literal prefilter (VERDICT r3 #3): host-only
+        # columns with required literals (lenient extraction,
+        # bank._intern_column) get an AC pass over the device-encoded
+        # bytes; only candidate lines pay host re. Literal-free host
+        # columns keep the full per-request scan (warned at load).
+        self._host_pref_cols: list[int] = []
+        self._host_slow_cols: list[int] = []
+        self._host_prefilter = None
+        if self._host_cols:
+            from log_parser_tpu.patterns.regex.ac import AhoCorasick
+
+            lits: list[bytes] = []
+            groups: list[int] = []
+            for ci in self._host_cols:
+                col = self.bank.columns[ci]
+                if col.literals:
+                    gi = len(self._host_pref_cols)
+                    self._host_pref_cols.append(ci)
+                    for lit in col.literals:
+                        lits.append(lit.fold().text)
+                        groups.append(gi)
+                else:
+                    self._host_slow_cols.append(ci)
+            if self._host_pref_cols:
+                self._host_prefilter = AhoCorasick.build_cached(lits, groups)
         # static per-pattern index tables (numpy, cheap); the full-bank
         # device programs below are built lazily — subclasses that override
         # _run_device (pattern sharding) never pay for them
@@ -228,15 +253,43 @@ class AnalysisEngine:
         if not self._host_cols and len(host_lines) == 0:
             return None
         B = enc.u8.shape[0]
+        n = corpus.n_lines
         mask = np.zeros((B, self.bank.n_columns), dtype=bool)
         val = np.zeros((B, self.bank.n_columns), dtype=bool)
         if self._host_cols:
-            # every line needs a host pass: decode each exactly once
-            hosts = [(col, self.bank.columns[col].host) for col in self._host_cols]
-            mask[:, [col for col, _ in hosts]] = True
-            for i, line in enumerate(corpus.materialize()):
-                for col, host in hosts:
-                    val[i, col] = bool(host.search(line))
+            mask[:, self._host_cols] = True
+            if self._host_slow_cols:
+                # literal-free host columns: every line pays host re
+                hosts = [
+                    (c, self.bank.columns[c].host)
+                    for c in self._host_slow_cols
+                ]
+                for i, line in enumerate(corpus.materialize()):
+                    for col, host in hosts:
+                        val[i, col] = bool(host.search(line))
+            if self._host_pref_cols:
+                # candidate lines only: AC over the folded device bytes
+                # (required literals, so no true match escapes), plus
+                # every needs_host line — truncated/non-ASCII encodings
+                # can hide a literal from the device-side scan
+                from log_parser_tpu.patterns.regex.ac import fold_lines_u8
+
+                hits = self._host_prefilter.scan_lines(
+                    fold_lines_u8(enc.u8[:n]), enc.lengths[:n]
+                )
+                cand_cols: list[np.ndarray] = []
+                for gi in range(len(self._host_pref_cols)):
+                    cand = ((hits[:, gi // 32] >> np.uint32(gi % 32)) & 1).astype(bool)
+                    cand[host_lines] = True
+                    cand_cols.append(np.flatnonzero(cand))
+                needed = set()
+                for cand in cand_cols:
+                    needed.update(cand.tolist())
+                text = {i: corpus.line(int(i)) for i in needed}
+                for ci, cand in zip(self._host_pref_cols, cand_cols):
+                    host = self.bank.columns[ci].host
+                    for i in cand:
+                        val[i, ci] = bool(host.search(text[int(i)]))
         for i in host_lines:
             line = corpus.line(int(i))
             for col in self._device_cols:

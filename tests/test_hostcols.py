@@ -1,0 +1,149 @@
+"""Host-only column handling: the lenient literal prefilter (engine
+runs host re only over AC-candidate lines), the literal-free slow path,
+and lenient-parse widening semantics."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from helpers import make_pattern, make_pattern_set
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden import GoldenAnalyzer
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.patterns.regex.literals import extract_literals
+from log_parser_tpu.patterns.regex.parser import (
+    RegexUnsupportedError,
+    parse_java_regex,
+)
+from log_parser_tpu.runtime import AnalysisEngine
+from tests.test_engine_parity import assert_results_match
+
+
+def _pair(patterns):
+    from conftest import FakeClock
+
+    sets = [make_pattern_set(patterns)]
+    return (
+        AnalysisEngine(sets, ScoringConfig(), clock=FakeClock()),
+        GoldenAnalyzer(sets, ScoringConfig(), clock=FakeClock()),
+    )
+
+
+def test_lookbehind_column_prefiltered_and_exact():
+    engine, golden = _pair(
+        [
+            make_pattern("lb", regex=r"(?<=refused )connection",
+                         confidence=0.8, severity="HIGH"),
+            make_pattern("ok", regex="OutOfMemoryError", confidence=0.9),
+        ]
+    )
+    # the lookbehind column is host-only but literal-prefiltered
+    assert engine._host_cols and engine._host_prefilter is not None
+    assert engine._host_pref_cols and not engine._host_slow_cols
+    logs = "\n".join(
+        [
+            "x refused connection now",   # matches
+            "connection only",            # literal hit, lookbehind fails
+            "refused connection",         # matches
+            "nothing here",
+            "java.lang.OutOfMemoryError",
+        ]
+        + ["filler %d ok" % i for i in range(40)]
+    )
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert engine.fallback_count == 0
+
+
+def test_backreference_column_prefiltered_and_exact():
+    engine, golden = _pair(
+        [make_pattern("br", regex=r"fatal (\w+) \1 loop", confidence=0.7)]
+    )
+    assert engine._host_pref_cols
+    logs = "\n".join(
+        [
+            "fatal spin spin loop",   # matches
+            "fatal spin whirl loop",  # literal hits, backref fails
+            "benign line",
+        ]
+        + ["pad %d" % i for i in range(20)]
+    )
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+
+
+def test_literal_free_host_column_slow_path_exact():
+    engine, golden = _pair(
+        [make_pattern("dup", regex=r"(.)\1\1\1", confidence=0.6)]
+    )
+    assert engine._host_slow_cols and not engine._host_pref_cols
+    logs = "aaaa here\nabab abab\nzzzz\nplain"
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+
+
+def test_prefiltered_column_with_non_ascii_line():
+    """needs_host lines are always candidates: a non-ASCII line whose
+    device encoding could hide the literal still gets host-verified."""
+    engine, golden = _pair(
+        [make_pattern("lb", regex=r"(?<=é )connection", confidence=0.8)]
+    )
+    logs = "é connection\nplain connection\nx é connection y"
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+
+
+def test_mixed_prefiltered_and_slow_host_columns():
+    engine, golden = _pair(
+        [
+            make_pattern("lb", regex=r"(?<=at )FooService", confidence=0.8),
+            make_pattern("dup", regex=r"(.)\1\1\1\1\1", confidence=0.6),
+        ]
+    )
+    assert engine._host_pref_cols and engine._host_slow_cols
+    logs = "\n".join(
+        ["at FooService.run", "FooService alone", "xxxxxx run", "ok"]
+    )
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+
+
+# ---------------------------------------------------------- lenient parse
+
+
+def test_lenient_parse_widens_and_extracts_literals():
+    cases = {
+        r"(?<=refused )connection": b"connection",
+        r"(?=.*fatal)error": b"error",
+        r"fatal (\w+) \1 loop": b"fatal ",
+        r"a*+bcde": b"bcde",
+        r"(?>abc)def": b"abcdef",
+        r"\GFooBar": b"foobar",  # folded form
+    }
+    for rx, expected in cases.items():
+        with pytest.raises(RegexUnsupportedError):
+            parse_java_regex(rx, False)
+        lits = extract_literals(parse_java_regex(rx, False, lenient=True))
+        assert lits, rx
+        folded = {lit.fold().text for lit in lits}
+        assert any(expected in t or t in expected for t in folded), (rx, folded)
+
+
+def test_lenient_parse_still_rejects_language_reshaping():
+    for rx in [
+        "(?x)a b  # comment",  # free-spacing retokenizes
+        "(?iu)straße",         # unicode case folding
+        "[a&&[b]]",            # class intersection
+    ]:
+        with pytest.raises(RegexUnsupportedError):
+            parse_java_regex(rx, False, lenient=True)
+
+
+def test_lenient_backref_is_widest():
+    """The backref approximation must not constrain length or content."""
+    node = parse_java_regex(r"x(\d+)y\1z", False, lenient=True)
+    lits = extract_literals(node)
+    texts = {lit.text for lit in lits} if lits else set()
+    # x/y/z single-char runs; none may claim the backref's content
+    assert texts and all(len(t) <= 2 for t in texts)
