@@ -21,6 +21,7 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -34,6 +35,8 @@ NORTH_STAR_LINES_PER_SEC = 1_000_000.0
 # prefilter this must stay within ~2x of the clean number instead of
 # collapsing to a full host-re scan per request.
 HOST_COL = "--host-col" in sys.argv
+# steady-state dwell per concurrency level of the serving campaign
+CAMPAIGN_SECONDS = float(os.environ.get("LOG_PARSER_TPU_CAMPAIGN_S", "30"))
 
 
 def build_corpus(n: int) -> str:
@@ -120,51 +123,83 @@ def main() -> None:
     # sync/finalize (only the frequency-coupled finish serializes), so
     # concurrent streams measure what the chip actually sustains — the
     # serial loop leaves it idle during every host round-trip (through
-    # the tunneled backend that idle is ~30% of the request). 4 streams
-    # x 2 requests, best of 2 rounds; the serial rate stays in the
-    # artifact for comparability.
-    concurrency, per_thread = 4, 2
-    pipe_rate = 0.0
-    for _ in range(2):
+    # the tunneled backend that idle is ~30% of the request). The
+    # campaign holds each concurrency level at steady state for
+    # >= CAMPAIGN_SECONDS of wall clock (VERDICT r3 weak #5: the old
+    # 4x2-request burst under a best-of selector was too thin a basis
+    # for the headline); the serial rate stays in the artifact for
+    # comparability.
+    curve = []
+    for concurrency in (1, 2, 4, 8):
+        stop = threading.Event()
         errors: list[BaseException] = []
+        lat: list[float] = []
+        lock = threading.Lock()
 
         def client() -> None:
             try:
-                for _ in range(per_thread):
+                while not stop.is_set():
+                    r0 = time.perf_counter()
                     r = engine.analyze_pipelined(data)
+                    rd = time.perf_counter() - r0
                     assert r.summary.significant_events > 0
+                    with lock:
+                        lat.append(rd)
             except BaseException as exc:
                 errors.append(exc)
+                stop.set()
 
         threads = [threading.Thread(target=client) for _ in range(concurrency)]
         t0 = time.perf_counter()
         for th in threads:
             th.start()
+        time.sleep(CAMPAIGN_SECONDS)
+        stop.set()
         for th in threads:
             th.join()
         dt = time.perf_counter() - t0
-        if errors:  # a partial round must never inflate the artifact
+        if errors:  # a partial level must never inflate the artifact
             raise errors[0]
-        pipe_rate = max(pipe_rate, concurrency * per_thread * N_LINES / dt)
+        lat.sort()
+        n = len(lat)
+        curve.append(
+            {
+                "concurrency": concurrency,
+                "requests": n,
+                "wall_s": round(dt, 2),
+                "lines_per_sec": round(n * N_LINES / dt, 1),
+                # nearest-rank percentiles: rank ceil(q*n), 1-based
+                "p50_ms": round(1e3 * lat[max(0, -(-50 * n // 100) - 1)], 1)
+                if n
+                else None,
+                "p99_ms": round(1e3 * lat[max(0, -(-99 * n // 100) - 1)], 1)
+                if n
+                else None,
+            }
+        )
 
-    # headline methodology is PINNED to the pipelined serving throughput
+    # headline methodology is PINNED to the sustained serving throughput
+    # at the curve's best point, with that point named in the artifact
     # (not max(serial, pipelined) — that would silently flip methodology
     # between runs); the serial single-stream rate rides alongside
-    lines_per_sec = pipe_rate
+    headline = max(curve, key=lambda p: p["lines_per_sec"])
     bench_common.emit(
         metric,
-        round(lines_per_sec, 1),
+        headline["lines_per_sec"],
         "lines/s",
-        round(lines_per_sec / NORTH_STAR_LINES_PER_SEC, 4),
+        round(headline["lines_per_sec"] / NORTH_STAR_LINES_PER_SEC, 4),
         platform,
         n_lines=N_LINES,
         n_patterns=n_patterns,
         serial_lines_per_sec=round(serial_rate, 1),
-        pipeline_concurrency=concurrency,
+        pipeline_concurrency=headline["concurrency"],
+        throughput_curve=curve,
+        campaign_seconds=CAMPAIGN_SECONDS,
         # the headline key predates the pipelined methodology; this field
         # disambiguates artifacts across versions (r1-r2: serial best-of,
-        # r3+: pipelined serving throughput at the stated concurrency)
-        methodology="pipelined-v2",
+        # r3: 4x2-burst best-of-2, r4+: steady-state curve, headline at
+        # the named best concurrency)
+        methodology="pipelined-sustained-v3",
     )
 
 

@@ -57,7 +57,7 @@ log = logging.getLogger(__name__)
 # minor version is also folded into the key: skip decisions encode
 # ``re``-module acceptance, which changes across interpreter versions,
 # and warm boots trust them without revalidating.
-SNAPSHOT_VERSION = 1
+SNAPSHOT_VERSION = 2
 
 
 def _dir() -> pathlib.Path | None:

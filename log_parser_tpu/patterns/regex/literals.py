@@ -35,7 +35,7 @@ from log_parser_tpu.patterns.regex.parser import (
 # BUMP when extraction output changes shape or content: the whole-library
 # bank snapshot (patterns/libcache.py) stores extracted literals and
 # exact sequences, and invalidates on this constant
-LITERALS_VERSION = 1
+LITERALS_VERSION = 2
 
 MAX_LITERALS = 64  # per pattern: larger sets filter poorly anyway
 MAX_LITERAL_LEN = 24  # truncation keeps the required property
