@@ -71,6 +71,20 @@ print("PROBE_OK", d[0].platform, len(d), flush=True)
 last_probe_diagnostics: list[dict] = []
 
 
+def timeit(fn, n: int = 3, warmup: int = 1) -> float:
+    """Best-of-n wall time after warmup — THE timing rule shared by every
+    probe script (tools/probe_*.py), so methodology changes land in one
+    place."""
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
 def pin_platform(platform: str | None = None) -> None:
     """Pin the CURRENT process's JAX platform (the axon sitecustomize
     overrides the JAX_PLATFORMS env var at config level, so this must be

@@ -1,0 +1,89 @@
+"""Config-4 tier A/B (VERDICT r3 #7): does routing the synthetic
+library's bitglush-eligible columns to the bit tier move the wide-bank
+cube, versus the shipping prefilter+union routing?
+
+Builds the 2k/10k synthetic banks (bench_bank.synth_library), times the
+MatcherBanks cube over a 65536-line corpus for bit budgets 0 (tier off)
+/ 192 (shipping TPU default) / 512 (wider: 4 lane-tiles), and prints one
+JSON line per (patterns, budget) combination plus the tier populations,
+so the decision lands in PERF.md §6 with numbers attached.
+
+Usage: python tools/probe_config4_tiers.py [--patterns 2000] [--lines 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_common import timeit  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--patterns", type=int, default=2000)
+    ap.add_argument("--lines", type=int, default=65536)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument(
+        "--budgets", type=str, default="0,192,512",
+        help="comma-separated bitglush word budgets to A/B",
+    )
+    ap.add_argument(
+        "--no-prefilter", action="store_true",
+        help="disable the AC prefilter tier so eligible columns flow to "
+        "the bit tier (wide banks otherwise route everything literal-"
+        "bearing to the prefilter first)",
+    )
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench_bank
+    from log_parser_tpu.native.ingest import Corpus
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    bank = PatternBank(bench_bank.synth_library(args.patterns))
+    corpus = Corpus(bench_bank.synth_logs(args.lines, args.patterns))
+    enc = corpus.encoded
+    lines_tb = jnp.asarray(enc.u8.T)
+    lens = jnp.asarray(enc.lengths)
+    jax.block_until_ready((lines_tb, lens))
+
+    extra = (
+        {"prefilter_min_columns": 10**9} if args.no_prefilter else {}
+    )
+    for budget in (int(b) for b in args.budgets.split(",")):
+        mb = MatcherBanks(bank, bitglush_max_words=budget, **extra)
+        cube_jit = jax.jit(mb.cube)
+        fn = lambda: jax.block_until_ready(cube_jit(lines_tb, lens))
+        secs = timeit(fn, n=args.repeats)
+        print(
+            json.dumps(
+                {
+                    "platform": jax.devices()[0].platform,
+                    "patterns": args.patterns,
+                    "lines": int(lens.shape[0]),
+                    "bit_budget": budget,
+                    "cube_s": round(secs, 4),
+                    "tiers": {
+                        "shiftor": len(mb.shiftor_cols),
+                        "bitglush": len(mb.bitglush_cols),
+                        "bitglush_words": mb.bitglush.n_words if mb.bitglush else 0,
+                        "prefilter": len(mb.prefilter_cols),
+                        "multi": len(mb.multi_cols),
+                        "dfa": len(mb.dfa_cols),
+                    },
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
