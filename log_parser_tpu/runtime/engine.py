@@ -106,6 +106,8 @@ def is_device_error(exc: BaseException) -> bool:
     (ADVICE.md r2)."""
     import jax.errors
 
+    if isinstance(exc, DeviceHungError):
+        return True
     if isinstance(exc, jax.errors.JaxRuntimeError):
         return True
     if isinstance(exc, RuntimeError):
@@ -114,6 +116,113 @@ def is_device_error(exc: BaseException) -> bool:
             _raised_in_device_layer(exc)
         )
     return False
+
+
+class DeviceHungError(RuntimeError):
+    """The device step exceeded the watchdog timeout (or the breaker is
+    open from a previous hang). Classified as a device error so the
+    golden fallback serves the request."""
+
+
+class DeviceWatchdog:
+    """Hang protection for the device step (SURVEY.md §5.3).
+
+    A *crashing* backend raises and the golden fallback already serves
+    the request; a *wedged* backend (dead tunnel, stuck runtime — e.g.
+    the axon relay dying mid-session) just never returns, hanging every
+    request. With a timeout configured
+    (``LOG_PARSER_TPU_DEVICE_TIMEOUT_S`` or ``--device-timeout``), the
+    device step runs in a worker thread: on timeout the request raises
+    :class:`DeviceHungError` (→ golden fallback) and the circuit opens,
+    so subsequent requests fall back IMMEDIATELY instead of entering
+    the wedged backend. Abandoned workers keep waiting; the circuit
+    closes when the LAST outstanding worker responds (a smaller
+    request completing while another is still stuck must not re-open
+    the front door), and any late error is logged so the root cause of
+    the wedge reaches the operator.
+
+    Default OFF (0): a first request legitimately spends tens of
+    seconds in XLA compilation, and only an operator knows a deadline
+    that separates that from a wedge. Hung worker threads cannot be
+    cancelled (XLA holds the wait with the GIL released) — they leak
+    until the backend responds, bounded by the number of requests
+    already in flight when the wedge began; once the circuit is open
+    no new ones are created.
+    """
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._open = False
+        self._inflight = 0
+
+    @property
+    def circuit_open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    def run(self, fn):
+        if self.timeout_s <= 0:
+            return fn()
+        with self._lock:
+            if self._open:
+                raise DeviceHungError(
+                    "device backend still hung from a previous timeout "
+                    "(circuit open); serving from the host path"
+                )
+            self._inflight += 1
+        result: list = []
+        error: list = []
+        done = threading.Event()
+        finished = [False]  # worker bookkeeping ran (under self._lock)
+        abandoned = [False]  # caller gave up on this worker
+
+        def worker() -> None:
+            try:
+                result.append(fn())
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                error.append(exc)
+            finally:
+                with self._lock:
+                    finished[0] = True
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        # every outstanding worker has been answered:
+                        # the backend is responsive again
+                        self._open = False
+                    late = abandoned[0]
+                done.set()
+                if late and error:
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "Abandoned device step eventually failed "
+                        "(the wedge's root cause): %r",
+                        error[0],
+                        exc_info=error[0],
+                    )
+
+        threading.Thread(
+            target=worker, name="device-watchdog", daemon=True
+        ).start()
+        if not done.wait(self.timeout_s):
+            with self._lock:
+                if not finished[0]:
+                    # genuinely still stuck: trip the breaker. A worker
+                    # that completed in the wait/lock gap falls through
+                    # and is harvested below instead (its finally can no
+                    # longer be un-done by this set).
+                    abandoned[0] = True
+                    self._open = True
+                    raise DeviceHungError(
+                        f"device step exceeded {self.timeout_s:g}s; "
+                        "serving from the host path until the backend "
+                        "responds"
+                    )
+            done.wait()  # finished[0] is True: done.set() is imminent
+        if error:
+            raise error[0]
+        return result[0]
 
 
 _NULL_LOCK = contextlib.nullcontext()
@@ -200,6 +309,12 @@ class AnalysisEngine:
         # suite so device bugs can never hide behind the fallback.
         self.fallback_to_golden = (
             os.environ.get("LOG_PARSER_TPU_NO_FALLBACK") != "1"
+        )
+        # hang protection for a wedged (not crashing) backend — §5.3;
+        # 0 disables (default: first-request XLA compiles are legitimate
+        # long waits only the operator can bound)
+        self.watchdog = DeviceWatchdog(
+            float(os.environ.get("LOG_PARSER_TPU_DEVICE_TIMEOUT_S", "0"))
         )
         self._k_hint = 0  # previous request's match count → starting K bucket
         # serializes frequency-coupled state (finish phase, admin routes,
@@ -401,7 +516,9 @@ class AnalysisEngine:
             overrides = self._overrides(corpus)
         om, ov = overrides if overrides is not None else (None, None)
         with trace.phase("device"):
-            recs = self._run_device(enc, corpus.n_lines, om, ov)
+            recs = self.watchdog.run(
+                lambda: self._run_device(enc, corpus.n_lines, om, ov)
+            )
         return _Prepared(start, trace, corpus, recs)
 
     def _finish(self, prepared: "_Prepared") -> AnalysisResult:

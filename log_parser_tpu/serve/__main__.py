@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
+import os
 import sys
 
 from log_parser_tpu.config import ScoringConfig
@@ -42,7 +43,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument(
+        "--device-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="watchdog deadline for the device step: a wedged backend "
+        "trips the circuit and requests serve from the host path until "
+        "it responds (default: off; also LOG_PARSER_TPU_DEVICE_TIMEOUT_S)",
+    )
     args = parser.parse_args(argv)
+    if args.device_timeout is not None:
+        os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
 
     logging.basicConfig(
         level=args.log_level.upper(),

@@ -4,6 +4,8 @@ device/XLA-layer errors may degrade; logic bugs propagate."""
 
 from __future__ import annotations
 
+import time
+
 import jax.errors
 import pytest
 
@@ -232,3 +234,73 @@ def test_is_device_error_walks_cause_chain():
             raise RuntimeError("UNAVAILABLE: socket closed")
     except RuntimeError as outer:
         assert is_device_error(outer)
+
+
+def test_watchdog_hang_trips_circuit_and_recovers(monkeypatch):
+    """A wedged device step (never returns) times out, serves from
+    golden, opens the circuit (immediate fallback, no thread stacking),
+    and the circuit closes when the hung worker finally responds."""
+    import threading
+
+    from log_parser_tpu.runtime.engine import DeviceWatchdog
+
+    engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+    engine.fallback_to_golden = True
+    engine.watchdog = DeviceWatchdog(timeout_s=0.2)
+    release = threading.Event()
+    real_run = engine._run_device
+    hang = {"on": True}
+    started = []
+
+    def wedged(*a, **k):
+        if hang["on"]:
+            started.append(1)
+            release.wait(10)
+        return real_run(*a, **k)
+
+    monkeypatch.setattr(engine, "_run_device", wedged)
+    golden = GoldenAnalyzer(_sets(), ScoringConfig(), clock=FakeClock())
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+
+    # 1) hang -> timeout -> golden serves; circuit opens
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert engine.fallback_count == 1 and engine.watchdog.circuit_open
+
+    # 2) circuit open: immediate fallback, the wedged fn is NOT re-entered
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert engine.fallback_count == 2 and len(started) == 1
+
+    # 3) backend recovers: hung worker completes, circuit closes,
+    #    the next request runs on the device again
+    hang["on"] = False
+    release.set()
+    deadline = time.time() + 5
+    while engine.watchdog.circuit_open and time.time() < deadline:
+        time.sleep(0.01)
+    assert not engine.watchdog.circuit_open
+    assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert engine.fallback_count == 2  # served by the device this time
+
+
+def test_watchdog_disabled_runs_inline():
+    from log_parser_tpu.runtime.engine import DeviceWatchdog
+
+    wd = DeviceWatchdog(timeout_s=0)
+    calls = []
+    assert wd.run(lambda: calls.append(1) or 42) == 42
+    assert calls == [1] and not wd.circuit_open
+
+
+def test_watchdog_propagates_worker_errors():
+    """Errors from the device step pass through the watchdog unchanged
+    (device errors keep their class for is_device_error)."""
+    from log_parser_tpu.runtime.engine import DeviceWatchdog
+
+    wd = DeviceWatchdog(timeout_s=5.0)
+
+    def boom():
+        raise jax.errors.JaxRuntimeError("injected")
+
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        wd.run(boom)
+    assert not wd.circuit_open
