@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
+import os
 import sys
 
 from log_parser_tpu.config import ScoringConfig
@@ -30,7 +31,16 @@ def main(argv: list[str] | None = None) -> int:
         help="also serve standard gRPC (service LogParser) on this port",
     )
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--device-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="watchdog deadline for the device step (see serve --help)",
+    )
     args = parser.parse_args(argv)
+    if args.device_timeout is not None:
+        os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
 
     logging.basicConfig(
         level=args.log_level.upper(),

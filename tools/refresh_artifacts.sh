@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Refresh every bench_results/ artifact on one platform, serially (TPU
+# tunnels degrade under concurrent clients — PERF.md §10). Usage:
+#
+#   tools/refresh_artifacts.sh tpu    # on a machine with the device
+#   tools/refresh_artifacts.sh cpu    # labeled CPU floor
+#
+# Each bench prints one JSON line on stdout; stderr (probe diagnostics)
+# is captured beside the artifact. A failed bench leaves the previous
+# artifact in place.
+set -u
+cd "$(dirname "$0")/.."
+platform="${1:?usage: refresh_artifacts.sh tpu|cpu}"
+export LOG_PARSER_TPU_PLATFORM="$platform"
+
+run() { # run <artifact-stem> <cmd...>
+  local stem="$1"; shift
+  echo "== $stem: $*" >&2
+  local out
+  if out=$("$@" 2>"bench_results/${stem}.stderr" | tail -1) && [ -n "$out" ]; then
+    printf '%s\n' "$out" > "bench_results/${stem}.json"
+    echo "   -> $out" >&2
+  else
+    echo "   FAILED (artifact kept); see bench_results/${stem}.stderr" >&2
+  fi
+}
+
+run "config2_${platform}"          python bench.py
+run "config2_hostcol_${platform}"  python bench.py --host-col
+run "config4_2k_${platform}"       python bench_bank.py --patterns 2000 --lines 65536
+run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines 65536
+run "config5_direct_${platform}"   python bench_latency.py
+run "config5_http_${platform}"     python bench_latency.py --http
+run "config5_http_c4_${platform}"  python bench_latency.py --http --concurrency 4
