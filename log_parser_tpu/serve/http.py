@@ -102,6 +102,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
+            # still UP with the circuit open — requests serve from the
+            # host path — but the degradation is visible to probes
+            if self.server.engine.watchdog.circuit_open:
+                return self._send_json(
+                    200,
+                    b'{"status":"UP","checks":[{"name":"device",'
+                    b'"status":"DEGRADED"}]}',
+                )
             return self._send_json(200, b'{"status":"UP"}')
         if self.path == "/frequency/stats":
             with self.server.analyze_lock:
@@ -118,6 +126,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "totalMs": trace.total * 1e3,
             }
             payload["fallbackCount"] = self.server.engine.fallback_count
+            payload["deviceCircuitOpen"] = (
+                self.server.engine.watchdog.circuit_open
+            )
             return self._send_json(200, json.dumps(payload).encode())
         if self.path == "/debug/factors":
             fin = self.server.engine.last_finalized

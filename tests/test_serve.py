@@ -136,3 +136,34 @@ class TestAnalysisFailure:
             assert body == {"error": "Internal analysis failure"}
         finally:
             server.shutdown()
+
+
+class TestDegradedHealth:
+    def test_health_reports_device_circuit(self):
+        """Health stays UP with the watchdog circuit open (requests serve
+        from the host path) but surfaces the degradation; /trace/last
+        carries deviceCircuitOpen."""
+        engine = AnalysisEngine(
+            [make_pattern_set([make_pattern("e", regex="E", confidence=0.5)])],
+            ScoringConfig(),
+        )
+        server = make_server(engine, host="127.0.0.1", port=0)
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            status, body = get(url + "/health")
+            assert status == 200 and body == {"status": "UP"}
+            with engine.watchdog._lock:
+                engine.watchdog._open = True  # simulate a tripped breaker
+            status, body = get(url + "/health")
+            assert status == 200 and body["status"] == "UP"
+            assert body["checks"] == [{"name": "device", "status": "DEGRADED"}]
+            _, tr = get(url + "/trace/last")
+            assert tr["deviceCircuitOpen"] is True
+            with engine.watchdog._lock:
+                engine.watchdog._open = False
+            _, body = get(url + "/health")
+            assert body == {"status": "UP"}
+        finally:
+            server.shutdown()
