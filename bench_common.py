@@ -31,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
@@ -55,12 +56,21 @@ _PROBE_SRC = """
 import os, jax
 # the axon plugin's sitecustomize pins jax_platforms="axon,cpu" at CONFIG
 # level, overriding the JAX_PLATFORMS env var — re-pin when an explicit
-# platform was requested (e.g. LOG_PARSER_TPU_PLATFORM=cpu for CPU runs)
+# platform was requested (e.g. LOG_PARSER_TPU_PLATFORM=cpu for CPU runs).
+# "tpu" is special: device plugins register under their own plugin name
+# (the axon tunnel's devices live on platform "axon" yet report
+# d[0].platform == "tpu"), so pinning jax_platforms="tpu" fails even
+# with a live chip — auto-select instead and VERIFY the device platform.
 p = os.environ.get("LOG_PARSER_TPU_PLATFORM")
-if p:
+if p and p != "tpu":
     jax.config.update("jax_platforms", p)
 import jax.numpy as jnp
 d = jax.devices()
+if p == "tpu" and d[0].platform != p:
+    # only the unpinned auto-select path verifies: a successfully PINNED
+    # plugin platform (e.g. "axon") legitimately reports its devices
+    # under a different name ("tpu")
+    raise SystemExit(f"auto-select landed on {d[0].platform!r}, wanted {p!r}")
 x = jnp.arange(64, dtype=jnp.int32)
 (x + 1).block_until_ready()
 print("PROBE_OK", d[0].platform, len(d), flush=True)
@@ -88,13 +98,84 @@ def timeit(fn, n: int = 3, warmup: int = 1) -> float:
 def pin_platform(platform: str | None = None) -> None:
     """Pin the CURRENT process's JAX platform (the axon sitecustomize
     overrides the JAX_PLATFORMS env var at config level, so this must be
-    a config-level update)."""
+    a config-level update).
+
+    ``tpu`` is never pinned directly: device plugins register under their
+    own plugin name (the axon tunnel registers "axon" whose devices report
+    ``platform == "tpu"``), so ``jax_platforms="tpu"`` would fail on a
+    live tunneled chip.  The probe already verified auto-select lands on
+    a TPU device; leave the default platform list in place.
+    """
     p = platform or os.environ.get("LOG_PARSER_TPU_PLATFORM")
     if p:
         os.environ["LOG_PARSER_TPU_PLATFORM"] = p
         import jax
 
-        jax.config.update("jax_platforms", p)
+        if p != "tpu":
+            jax.config.update("jax_platforms", p)
+        else:
+            # re-establish the probe's device check IN THIS PROCESS: with
+            # auto-select still in effect a tunnel that died between the
+            # probe and here would silently hand the bench a CPU backend
+            # under a "tpu" artifact label (the r1 mislabel failure)
+            actual = _device_platform()
+            if actual != "tpu":
+                raise RuntimeError(
+                    f"bench process auto-selected {actual!r} after the "
+                    "probe verified a TPU device; refusing to record a "
+                    "mislabeled artifact"
+                )
+
+
+def _device_platform() -> str:
+    """The ONE way in-process device identity is read for labeling —
+    every mislabel guard (pin_platform's tpu branch, the floor check)
+    goes through here so a methodology change can't drift between
+    sites.  (_PROBE_SRC carries its own copy by necessity: it is a
+    standalone subprocess source string.)"""
+    import jax
+
+    return jax.devices()[0].platform
+
+
+class _PinWedged(RuntimeError):
+    """In-process verification never returned: the backend is wedged and
+    any later JAX use in this process (including a CPU floor) would hang
+    behind the stuck xla_bridge init."""
+
+
+def _pin_and_verify(platform: str, timeout_s: float) -> None:
+    """Pin the CURRENT process to the probed platform and re-check its
+    device layer, bounded by ``timeout_s``.
+
+    The probe subprocess proves the backend *can* come up; this proves it
+    is still up *here*, so a tunnel that died in between can never yield
+    a CPU-speed number in a device-labeled artifact (the r1 mislabel
+    failure).  The check runs in a daemon worker thread: a cleanly-dying
+    backend raises RuntimeError; a *wedged* one trips the timeout and
+    raises :class:`_PinWedged` so the caller can emit a diagnostics
+    artifact and exit — a CPU-floor attempt would hang behind the stuck
+    init, which is worse than an honest null.
+    """
+    outcome: list[BaseException | None] = []
+
+    def check() -> None:
+        try:
+            pin_platform(platform)
+            outcome.append(None)
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            outcome.append(exc)
+
+    t = threading.Thread(target=check, name="pin-verify", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not outcome:
+        raise _PinWedged(
+            f"device layer wedged: in-process verification of {platform!r} "
+            f"exceeded {timeout_s:.0f}s after a successful probe"
+        )
+    if outcome[0] is not None:
+        raise RuntimeError(str(outcome[0]))
 
 
 def _one_attempt(timeout_s: float) -> tuple[str | None, dict]:
@@ -155,8 +236,36 @@ def probe_backend(metric: str, unit: str) -> str:
         diag["attempt"] = attempt
         last_probe_diagnostics.append(diag)
         if platform is not None:
+            try:
+                # a successful probe earns a fair in-process dial window
+                # even when staged probing consumed most of the budget:
+                # a relay dial under bad tunnel weather has been observed
+                # past 100s and is slow-but-live, not wedged
+                _pin_and_verify(platform, max(120.0, deadline - time.monotonic()))
+            except _PinWedged as exc:
+                # no number can come out of this process any more (any
+                # JAX use would hang behind the stuck init) — emit the
+                # diagnostics artifact and stop, instead of the rc=124
+                # silence a hung floor attempt would produce
+                last_probe_diagnostics.append(
+                    {"outcome": "pin-wedged", "attempt": attempt, "error": str(exc)}
+                )
+                print(f"# backend pin wedged: {exc}", file=sys.stderr)
+                _exit_null(metric, unit, explicit or platform, str(exc))
+            except RuntimeError as exc:
+                # the device layer died (or wedged) between the probe
+                # subprocess and this process. Retrying is FUTILE: this
+                # process's jax has already initialized and cached its
+                # backend, so every later probe-then-pin cycle would
+                # re-read the same cached devices and fail — stop the
+                # campaign now (floor or hard exit below) instead of
+                # burning the remaining budget on doomed attempts.
+                last_probe_diagnostics.append(
+                    {"outcome": "pin-failed", "attempt": attempt, "error": str(exc)}
+                )
+                print(f"# backend pin failed: {exc}", file=sys.stderr)
+                break
             print(f"# backend ok: {platform} (attempt {attempt})", file=sys.stderr)
-            pin_platform()
             last_probe_diagnostics = []
             return platform
         print(f"# backend attempt {attempt} failed: {diag['outcome']}", file=sys.stderr)
@@ -169,28 +278,46 @@ def probe_backend(metric: str, unit: str) -> str:
     if explicit:
         # an explicitly-requested platform that won't come up is a hard
         # failure — there is no meaningful floor to substitute
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": None,
-                    "unit": unit,
-                    "vs_baseline": None,
-                    "platform": explicit,
-                    "error": f"requested platform {explicit!r} unavailable",
-                    "device_probe": last_probe_diagnostics,
-                }
-            )
-        )
-        sys.exit(3)
+        _exit_null(metric, unit, explicit, f"requested platform {explicit!r} unavailable")
 
     print(
-        f"# device backend unavailable after {PROBE_TIMEOUT_S:.0f}s; "
-        "falling back to labeled CPU floor",
+        "# device backend unavailable; falling back to labeled CPU floor",
         file=sys.stderr,
     )
     pin_platform("cpu")
+    # on the pin-failed break path JAX is already initialized, so the
+    # config update above is a no-op — trust the DEVICES, not the config,
+    # before stamping "cpu" on the artifact (the inverse-mislabel guard)
+    actual = _device_platform()
+    if actual != "cpu":
+        _exit_null(
+            metric,
+            unit,
+            actual,
+            f"floor fallback landed on already-initialized {actual!r} "
+            "backend; refusing to record it under a 'cpu' label",
+        )
     return "cpu"
+
+
+def _exit_null(metric: str, unit: str, platform: str, error: str) -> None:
+    """Emit the null-value diagnostics artifact and hard-exit: used when
+    no honest number can be produced (explicit platform unavailable,
+    wedged in-process backend, mislabel refusal)."""
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": None,
+                "unit": unit,
+                "vs_baseline": None,
+                "platform": platform,
+                "error": error,
+                "device_probe": last_probe_diagnostics,
+            }
+        )
+    )
+    sys.exit(3)
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float | None,

@@ -16,12 +16,17 @@ export LOG_PARSER_TPU_PLATFORM="$platform"
 run() { # run <artifact-stem> <cmd...>
   local stem="$1"; shift
   echo "== $stem: $*" >&2
-  local out
-  if out=$("$@" 2>"bench_results/${stem}.stderr" | tail -1) && [ -n "$out" ]; then
+  local out rc
+  # no pipe here: a pipe would mask the bench's exit code with tail's,
+  # and a bench that exits 3 with a {"value": null} diagnostics line
+  # (bench_common._exit_null) must NOT overwrite the previous artifact
+  out=$("$@" 2>"bench_results/${stem}.stderr"); rc=$?
+  out=$(printf '%s\n' "$out" | tail -n 1)
+  if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
     printf '%s\n' "$out" > "bench_results/${stem}.json"
     echo "   -> $out" >&2
   else
-    echo "   FAILED (artifact kept); see bench_results/${stem}.stderr" >&2
+    echo "   FAILED rc=$rc (artifact kept); see bench_results/${stem}.stderr" >&2
   fi
 }
 
