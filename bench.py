@@ -10,8 +10,13 @@ fallback is DISABLED for the bench, and backend init runs as a staged
 campaign in throwaway subprocesses (bench_common.probe_backend).  If the
 device layer never comes up within the total probe budget the bench runs
 on the pinned JAX host (CPU) platform and records a clearly-labeled
-``{"platform": "cpu"}`` floor with the probe diagnostics embedded — the
-artifact is never null.
+``{"platform": "cpu"}`` floor with the probe diagnostics embedded.  A
+number is never *silently* wrong, and failure is never silent: paths
+where no honest number exists (explicitly-requested platform
+unavailable, backend wedged mid-process, a would-be mislabel) emit a
+``{"value": null}`` diagnostics line and exit 3
+(bench_common._exit_null); if no campaign level completes, the bench
+raises.  Consumers must check the exit code, not just parse stdout.
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
@@ -20,10 +25,8 @@ Prints exactly one JSON line:
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import threading
 import time
 
 import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
@@ -118,20 +121,18 @@ def main() -> None:
     serial_rate = N_LINES / best
     assert result.summary.significant_events > 0
 
-    # On the labeled CPU *fallback* floor the campaign is a regression
-    # datapoint, not the headline — a short dwell keeps the whole
-    # fallback run (600s dead-backend probe + bench) inside any
-    # reasonable driver budget. A deliberate explicit-CPU run
-    # (LOG_PARSER_TPU_PLATFORM=cpu: probe succeeds instantly, no budget
-    # spent, diagnostics empty) keeps the full dwell so its percentiles
-    # are comparable to every other artifact. An explicit
+    # Dwell policy: the short dwell exists ONLY to keep a dead-backend
+    # fallback run (600s exhausted probe budget + bench) inside any
+    # reasonable driver budget — bench_common.last_fell_back is the
+    # explicit signal for exactly that case. Every run whose probe
+    # succeeded promptly keeps the full dwell so its percentiles are
+    # comparable across artifacts; that deliberately includes both the
+    # explicit-CPU run (LOG_PARSER_TPU_PLATFORM=cpu) and a deviceless
+    # host whose auto-select probe lands on cpu on attempt 1 (no probe
+    # time was burned, so there is no budget to protect). An explicit
     # LOG_PARSER_TPU_CAMPAIGN_S always wins.
     campaign_s = CAMPAIGN_SECONDS
-    if (
-        platform == "cpu"
-        and bench_common.last_probe_diagnostics
-        and "LOG_PARSER_TPU_CAMPAIGN_S" not in os.environ
-    ):
+    if bench_common.last_fell_back and "LOG_PARSER_TPU_CAMPAIGN_S" not in os.environ:
         campaign_s = 8.0
 
     # Chip throughput under serving load: ``analyze_pipelined`` overlaps
@@ -145,60 +146,22 @@ def main() -> None:
     # 4x2-request burst under a best-of selector was too thin a basis
     # for the headline); the serial rate stays in the artifact for
     # comparability.
-    curve = []
-    for concurrency in (1, 2, 4, 8):
-        stop = threading.Event()
-        errors: list[BaseException] = []
-        lat: list[float] = []
-        lock = threading.Lock()
+    def analyze_once() -> None:
+        r = engine.analyze_pipelined(data)
+        assert r.summary.significant_events > 0
 
-        def client() -> None:
-            try:
-                while not stop.is_set():
-                    r0 = time.perf_counter()
-                    r = engine.analyze_pipelined(data)
-                    rd = time.perf_counter() - r0
-                    assert r.summary.significant_events > 0
-                    with lock:
-                        lat.append(rd)
-            except BaseException as exc:
-                errors.append(exc)
-                stop.set()
-
-        threads = [threading.Thread(target=client) for _ in range(concurrency)]
-        t0 = time.perf_counter()
-        for th in threads:
-            th.start()
-        time.sleep(campaign_s)
-        stop.set()
-        for th in threads:
-            th.join()
-        dt = time.perf_counter() - t0
-        if errors:  # a partial level must never inflate the artifact
-            raise errors[0]
-        lat.sort()
-        n = len(lat)
-        curve.append(
-            {
-                "concurrency": concurrency,
-                "requests": n,
-                "wall_s": round(dt, 2),
-                "lines_per_sec": round(n * N_LINES / dt, 1),
-                # nearest-rank percentiles: rank ceil(q*n), 1-based
-                "p50_ms": round(1e3 * lat[max(0, -(-50 * n // 100) - 1)], 1)
-                if n
-                else None,
-                "p99_ms": round(1e3 * lat[max(0, -(-99 * n // 100) - 1)], 1)
-                if n
-                else None,
-            }
-        )
-
+    curve, campaign_error = bench_common.run_campaign(analyze_once, N_LINES, campaign_s)
+    measured = [p for p in curve if "error" not in p]
+    if not measured:  # nothing steady-state survived — a number here would be a lie
+        raise RuntimeError(f"campaign produced no complete level: {campaign_error}")
     # headline methodology is PINNED to the sustained serving throughput
     # at the curve's best point, with that point named in the artifact
     # (not max(serial, pipelined) — that would silently flip methodology
     # between runs); the serial single-stream rate rides alongside
-    headline = max(curve, key=lambda p: p["lines_per_sec"])
+    headline = max(measured, key=lambda p: p["lines_per_sec"])
+    extra = {}
+    if campaign_error is not None:
+        extra["campaign_error"] = campaign_error
     bench_common.emit(
         metric,
         headline["lines_per_sec"],
@@ -216,6 +179,7 @@ def main() -> None:
         # r3: 4x2-burst best-of-2, r4+: steady-state curve, headline at
         # the named best concurrency)
         methodology="pipelined-sustained-v3",
+        **extra,
     )
 
 

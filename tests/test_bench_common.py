@@ -13,6 +13,8 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
+import time
 
 import bench_common
 
@@ -72,6 +74,72 @@ def test_pin_platform_tpu_never_pins_and_verifies(monkeypatch):
     else:  # pragma: no cover - only on a real TPU host without the pin
         assert jax.devices()[0].platform == "tpu"
     assert jax.config.jax_platforms == before
+
+
+def test_last_fell_back_set_on_floor_fallback(monkeypatch):
+    """The fallback-floor signal is the explicit flag, not diagnostics
+    truthiness — bench.py's short-dwell policy keys on it."""
+    monkeypatch.delenv("LOG_PARSER_TPU_PLATFORM", raising=False)
+    monkeypatch.setattr(bench_common, "PROBE_TIMEOUT_S", 2.0)
+    # small but NONZERO pause: a 0.0 pause turns the retry loop into a
+    # hot loop (~13k no-op attempts/second into the diagnostics list)
+    monkeypatch.setattr(bench_common, "_RETRY_PAUSE_S", 0.2)
+    monkeypatch.setattr(
+        bench_common,
+        "_one_attempt",
+        lambda timeout_s: (None, {"outcome": "error", "rc": 1}),
+    )
+    assert bench_common.probe_backend("m", "u") == "cpu"
+    assert bench_common.last_fell_back is True
+    assert bench_common.last_probe_diagnostics  # embedded in the artifact
+
+
+def test_last_fell_back_cleared_on_success(monkeypatch):
+    monkeypatch.setenv("LOG_PARSER_TPU_PLATFORM", "cpu")
+    bench_common.last_fell_back = True  # stale state from a prior call
+    assert bench_common.probe_backend("m", "u") == "cpu"
+    assert bench_common.last_fell_back is False
+    assert bench_common.last_probe_diagnostics == []
+
+
+def test_run_campaign_measures_levels():
+    curve, err = bench_common.run_campaign(
+        lambda: time.sleep(0.001), n_lines=100, campaign_s=0.2, levels=(2, 1)
+    )
+    assert err is None
+    assert [p["concurrency"] for p in curve] == [1, 2]  # sorted output
+    assert all(p["requests"] > 0 and p["lines_per_sec"] > 0 for p in curve)
+
+
+def test_run_campaign_degrades_on_error():
+    """A failing level is recorded and ends the campaign instead of
+    destroying it (the pre-round-4 behavior was raise-on-first-error)."""
+
+    def analyze():
+        raise ValueError("backend died")
+
+    curve, err = bench_common.run_campaign(analyze, 100, campaign_s=0.2, levels=(2, 1))
+    assert err is not None and err.startswith("concurrency 2:")
+    assert "backend died" in err
+    assert [p["concurrency"] for p in curve] == [2]
+    assert "backend died" in curve[0]["error"]
+    assert len(curve[0]["error"]) <= 300
+
+
+def test_run_campaign_detects_wedged_level(monkeypatch):
+    """Requests that never return must trip the bounded drain and degrade
+    the level, not hang the bench forever."""
+    monkeypatch.setattr(bench_common, "DRAIN_FLOOR_S", 0.3)
+    release = threading.Event()
+    try:
+        curve, err = bench_common.run_campaign(
+            release.wait, 100, campaign_s=0.1, levels=(1, 2)
+        )
+        assert err is not None and "wedged" in err
+        assert curve[0]["concurrency"] == 1 and "wedged" in curve[0]["error"]
+        assert len(curve) == 1  # nothing after the wedged level ran
+    finally:
+        release.set()  # let the leaked daemon client threads exit
 
 
 def test_pin_platform_cpu_pins(monkeypatch):

@@ -19,14 +19,28 @@ run() { # run <artifact-stem> <cmd...>
   local out rc
   # no pipe here: a pipe would mask the bench's exit code with tail's,
   # and a bench that exits 3 with a {"value": null} diagnostics line
-  # (bench_common._exit_null) must NOT overwrite the previous artifact
-  out=$("$@" 2>"bench_results/${stem}.stderr"); rc=$?
+  # (bench_common._exit_null) must NOT overwrite the previous artifact.
+  # stderr goes to a temp first for the same reason: the kept .json and
+  # its committed .stderr provenance must stay a matched pair
+  out=$("$@" 2>"bench_results/${stem}.stderr.tmp"); rc=$?
   out=$(printf '%s\n' "$out" | tail -n 1)
   if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
     printf '%s\n' "$out" > "bench_results/${stem}.json"
+    mv -f "bench_results/${stem}.stderr.tmp" "bench_results/${stem}.stderr"
+    rm -f "bench_results/${stem}.failed.json" "bench_results/${stem}.failed.stderr"
     echo "   -> $out" >&2
   else
-    echo "   FAILED rc=$rc (artifact kept); see bench_results/${stem}.stderr" >&2
+    mv -f "bench_results/${stem}.stderr.tmp" "bench_results/${stem}.failed.stderr"
+    # a failed bench may still have printed the {"value": null}
+    # diagnostics line (bench_common._exit_null) carrying every probe
+    # attempt's stderr tail — keep it beside the intact artifact. Remove
+    # any previous failure's copy first: the failed.json/.failed.stderr
+    # pair must come from the SAME run
+    rm -f "bench_results/${stem}.failed.json"
+    if [ -n "$out" ]; then
+      printf '%s\n' "$out" > "bench_results/${stem}.failed.json"
+    fi
+    echo "   FAILED rc=$rc (artifact kept); see bench_results/${stem}.failed.*" >&2
   fi
 }
 
