@@ -15,7 +15,7 @@ number is never *silently* wrong, and failure is never silent: paths
 where no honest number exists (explicitly-requested platform
 unavailable, backend wedged mid-process, a would-be mislabel) emit a
 ``{"value": null}`` diagnostics line and exit 3
-(bench_common._exit_null); if no campaign level completes, the bench
+(bench_common.exit_null); if no campaign level completes, the bench
 raises.  Consumers must check the exit code, not just parse stdout.
 
 Prints exactly one JSON line:

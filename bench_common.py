@@ -22,7 +22,7 @@ This version treats backend init as a campaign, not a probe:
   is unavailable, the backend wedges inside this process after a
   successful probe, or the only label available would be a lie — the
   bench emits a ``{"value": null}`` diagnostics line and exits 3
-  (:func:`_exit_null`) rather than hanging or mislabeling.  Consumers
+  (:func:`exit_null`) rather than hanging or mislabeling.  Consumers
   must check the exit code (tools/refresh_artifacts.sh keeps the
   previous artifact on rc != 0).
 
@@ -170,6 +170,29 @@ DRAIN_FLOOR_S = 240.0
 CAMPAIGN_LEVELS = (2, 1, 4, 8)
 
 
+def wedge_failure(prefix: str, errors: list) -> str:
+    """One shared format for a wedged fan-out's failure text: a sibling
+    worker's error is the likely root cause, so it rides along (repr
+    truncated to 300 chars — backend errors carry multi-KB tracebacks
+    and artifacts are one JSON line)."""
+    if errors:
+        prefix += f"; first worker error: {repr(errors[0])[:300]}"
+    return prefix
+
+
+def join_bounded(threads, budget_s: float) -> bool:
+    """Join daemon ``threads`` under one shared wall budget; True iff any
+    is still alive afterwards (a wedged backend — callers degrade or
+    exit_null instead of hanging).  THE wedge-detection rule shared by
+    every bench fan-out, so drain-policy changes land in one place.
+    Threads must be daemons: a wedged one is abandoned, not waited out.
+    """
+    deadline = time.monotonic() + budget_s
+    for th in threads:
+        th.join(max(0.0, deadline - time.monotonic()))
+    return any(th.is_alive() for th in threads)
+
+
 def run_campaign(
     analyze_once,
     n_lines: int,
@@ -223,13 +246,14 @@ def run_campaign(
         stop.wait(campaign_s)  # a failing client ends the dwell early
         stop.set()
         drain_s = max(DRAIN_FLOOR_S, 4.0 * campaign_s)
-        drain_deadline = time.monotonic() + drain_s
-        for th in threads:
-            th.join(max(0.0, drain_deadline - time.monotonic()))
+        wedged = join_bounded(threads, drain_s)
         dt = time.perf_counter() - t0
         failure = None
-        if any(th.is_alive() for th in threads):
-            failure = f"wedged: requests still in flight after {drain_s:.0f}s drain"
+        if wedged:
+            failure = wedge_failure(
+                f"wedged: requests still in flight after {drain_s:.0f}s drain",
+                errors,
+            )
         elif errors:
             # 300-char truncation: backend errors carry multi-KB
             # tracebacks and the artifact is one JSON line
@@ -349,7 +373,7 @@ def probe_backend(metric: str, unit: str) -> str:
 
     Does not return on the no-honest-number paths (explicit platform
     unavailable, in-process wedge, mislabel refusal): those emit the
-    null diagnostics artifact and exit 3 (:func:`_exit_null` — see the
+    null diagnostics artifact and exit 3 (:func:`exit_null` — see the
     module docstring's contract).
     """
     global last_probe_diagnostics, last_fell_back
@@ -392,7 +416,7 @@ def probe_backend(metric: str, unit: str) -> str:
                     {"outcome": "pin-wedged", "attempt": attempt, "error": str(exc)}
                 )
                 print(f"# backend pin wedged: {exc}", file=sys.stderr)
-                _exit_null(metric, unit, explicit or platform, str(exc))
+                exit_null(metric, unit, explicit or platform, str(exc))
             except RuntimeError as exc:
                 # the device layer died (or wedged) between the probe
                 # subprocess and this process. Retrying is FUTILE: this
@@ -419,7 +443,7 @@ def probe_backend(metric: str, unit: str) -> str:
     if explicit:
         # an explicitly-requested platform that won't come up is a hard
         # failure — there is no meaningful floor to substitute
-        _exit_null(metric, unit, explicit, f"requested platform {explicit!r} unavailable")
+        exit_null(metric, unit, explicit, f"requested platform {explicit!r} unavailable")
 
     print(
         "# device backend unavailable; falling back to labeled CPU floor",
@@ -432,7 +456,7 @@ def probe_backend(metric: str, unit: str) -> str:
     # before stamping "cpu" on the artifact (the inverse-mislabel guard)
     actual = _device_platform()
     if actual != "cpu":
-        _exit_null(
+        exit_null(
             metric,
             unit,
             actual,
@@ -442,7 +466,7 @@ def probe_backend(metric: str, unit: str) -> str:
     return "cpu"
 
 
-def _exit_null(metric: str, unit: str, platform: str, error: str) -> None:
+def exit_null(metric: str, unit: str, platform: str, error: str) -> None:
     """Emit the null-value diagnostics artifact and hard-exit: used when
     no honest number can be produced (explicit platform unavailable,
     wedged in-process backend, mislabel refusal)."""

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 
 import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
@@ -65,7 +66,6 @@ def main() -> None:
     engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
 
     if USE_HTTP:
-        import threading
         import urllib.request
 
         from log_parser_tpu.serve.http import make_server
@@ -100,36 +100,80 @@ def main() -> None:
             else:
                 engine.analyze(data)
 
-    for i in range(3):  # warmup: compile every shape bucket the stream hits
-        run_one(i)
+    # EVERY phase — warmup, serial stream, concurrent fan-out — runs in
+    # daemon worker threads under bench_common.join_bounded (the shared
+    # wedge-detection rule): a backend that stops returning mid-request
+    # must yield a {"value": null} diagnostics exit, not an rc=124 hang
+    # with no artifact. Worker errors propagate; only a thread still
+    # alive after the budget is a wedge.
+    def run_bounded(workers: list, budget_s: float, what: str) -> None:
+        errors: list[BaseException] = []
 
-    lat: list[float] = []
-    if CONCURRENCY > 1:
-        import threading
+        def wrap(fn):
+            def inner() -> None:
+                try:
+                    fn()
+                except BaseException as exc:  # noqa: BLE001 - re-raised below
+                    errors.append(exc)
 
-        chunks = [list(range(c, REQUESTS, CONCURRENCY)) for c in range(CONCURRENCY)]
-        per_thread: list[list[float]] = [[] for _ in range(CONCURRENCY)]
-
-        def client(c: int) -> None:
-            for i in chunks[c]:
-                t0 = time.perf_counter()
-                run_one(i)
-                per_thread[c].append((time.perf_counter() - t0) * 1e3)
+            return inner
 
         threads = [
-            threading.Thread(target=client, args=(c,)) for c in range(CONCURRENCY)
+            threading.Thread(target=wrap(fn), daemon=True) for fn in workers
         ]
         for th in threads:
             th.start()
-        for th in threads:
-            th.join()
+        if bench_common.join_bounded(threads, budget_s):
+            bench_common.exit_null(
+                metric, "ms", platform,
+                bench_common.wedge_failure(
+                    f"wedged: requests still in flight after {budget_s:.0f}s "
+                    f"({what})",
+                    errors,
+                ),
+            )
+        if errors:
+            raise errors[0]
+
+    def warmup() -> None:
+        for i in range(3):  # compile every shape bucket the stream hits
+            run_one(i)
+
+    # warmup budget: first-compile on TPU is 20-40s; through a cold
+    # tunneled runtime it has been observed past 100s — match the probe
+    # harness's total budget before calling it a wedge
+    run_bounded([warmup], bench_common.PROBE_TIMEOUT_S, "warmup")
+
+    lat: list[float] = []
+    # measurement budget: a generous per-request ceiling times the whole
+    # run — observed p99 is ~0.2 s/request, so 10 s/request only trips on
+    # a genuinely wedged backend, never a slow-but-live one
+    budget_s = max(bench_common.DRAIN_FLOOR_S, 10.0 * REQUESTS)
+    if CONCURRENCY > 1:
+        chunks = [list(range(c, REQUESTS, CONCURRENCY)) for c in range(CONCURRENCY)]
+        per_thread: list[list[float]] = [[] for _ in range(CONCURRENCY)]
+
+        def client(c: int):
+            def inner() -> None:
+                for i in chunks[c]:
+                    t0 = time.perf_counter()
+                    run_one(i)
+                    per_thread[c].append((time.perf_counter() - t0) * 1e3)
+
+            return inner
+
+        run_bounded([client(c) for c in range(CONCURRENCY)], budget_s, "stream")
         for vals in per_thread:
             lat.extend(vals)
     else:
-        for i in range(REQUESTS):
-            t0 = time.perf_counter()
-            run_one(i)
-            lat.append((time.perf_counter() - t0) * 1e3)
+
+        def serial() -> None:
+            for i in range(REQUESTS):
+                t0 = time.perf_counter()
+                run_one(i)
+                lat.append((time.perf_counter() - t0) * 1e3)
+
+        run_bounded([serial], budget_s, "stream")
     lat.sort()
 
     bench_common.emit(

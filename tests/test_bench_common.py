@@ -79,7 +79,12 @@ def test_pin_platform_tpu_never_pins_and_verifies(monkeypatch):
 def test_last_fell_back_set_on_floor_fallback(monkeypatch):
     """The fallback-floor signal is the explicit flag, not diagnostics
     truthiness — bench.py's short-dwell policy keys on it."""
-    monkeypatch.delenv("LOG_PARSER_TPU_PLATFORM", raising=False)
+    # pin_platform writes os.environ directly on the fallback path;
+    # delenv of an ABSENT key registers no undo in pytest, so the
+    # setenv-then-delenv pair records state to restore — otherwise the
+    # var leaks into every later test and subprocess
+    monkeypatch.setenv("LOG_PARSER_TPU_PLATFORM", "")
+    monkeypatch.delenv("LOG_PARSER_TPU_PLATFORM")
     monkeypatch.setattr(bench_common, "PROBE_TIMEOUT_S", 2.0)
     # small but NONZERO pause: a 0.0 pause turns the retry loop into a
     # hot loop (~13k no-op attempts/second into the diagnostics list)

@@ -19,7 +19,7 @@ run() { # run <artifact-stem> <cmd...>
   local out rc
   # no pipe here: a pipe would mask the bench's exit code with tail's,
   # and a bench that exits 3 with a {"value": null} diagnostics line
-  # (bench_common._exit_null) must NOT overwrite the previous artifact.
+  # (bench_common.exit_null) must NOT overwrite the previous artifact.
   # stderr goes to a temp first for the same reason: the kept .json and
   # its committed .stderr provenance must stay a matched pair
   out=$("$@" 2>"bench_results/${stem}.stderr.tmp"); rc=$?
@@ -32,7 +32,7 @@ run() { # run <artifact-stem> <cmd...>
   else
     mv -f "bench_results/${stem}.stderr.tmp" "bench_results/${stem}.failed.stderr"
     # a failed bench may still have printed the {"value": null}
-    # diagnostics line (bench_common._exit_null) carrying every probe
+    # diagnostics line (bench_common.exit_null) carrying every probe
     # attempt's stderr tail — keep it beside the intact artifact. Remove
     # any previous failure's copy first: the failed.json/.failed.stderr
     # pair must come from the SAME run
