@@ -150,7 +150,9 @@ def main() -> None:
         r = engine.analyze_pipelined(data)
         assert r.summary.significant_events > 0
 
-    curve, campaign_error = bench_common.run_campaign(analyze_once, N_LINES, campaign_s)
+    curve, campaign_error = bench_common.run_campaign(
+        analyze_once, N_LINES, campaign_s, request_floor_s=best
+    )
     measured = [p for p in curve if "error" not in p]
     if not measured:  # nothing steady-state survived — a number here would be a lie
         raise RuntimeError(f"campaign produced no complete level: {campaign_error}")

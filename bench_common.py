@@ -193,11 +193,52 @@ def join_bounded(threads, budget_s: float) -> bool:
     return any(th.is_alive() for th in threads)
 
 
+def run_bounded(workers: list, budget_s: float, metric: str, unit: str,
+                platform: str, what: str) -> list:
+    """Run ``workers`` (zero-arg callables) in daemon threads under one
+    bounded join; returns their results in order.  A wedge (any worker
+    still alive after the budget) emits the null diagnostics artifact —
+    with the first sibling error as the likely root cause — and exits 3;
+    a worker error (all workers finished) re-raises.  The ONE wrapper
+    every bench fan-out goes through, so the wedge policy (message
+    format, exit_null-on-wedge, error propagation) cannot drift between
+    benches."""
+    results: list = [None] * len(workers)
+    errors: list[BaseException] = []
+
+    def wrap(i: int, fn):
+        def inner() -> None:
+            try:
+                results[i] = fn()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                errors.append(exc)
+
+        return inner
+
+    threads = [
+        threading.Thread(target=wrap(i, fn), daemon=True)
+        for i, fn in enumerate(workers)
+    ]
+    for th in threads:
+        th.start()
+    if join_bounded(threads, budget_s):
+        exit_null(
+            metric, unit, platform,
+            wedge_failure(
+                f"wedged: no progress after {budget_s:.0f}s ({what})", errors
+            ),
+        )
+    if errors:
+        raise errors[0]
+    return results
+
+
 def run_campaign(
     analyze_once,
     n_lines: int,
     campaign_s: float,
     levels: tuple[int, ...] = CAMPAIGN_LEVELS,
+    request_floor_s: float = 0.0,
 ) -> tuple[list[dict], str | None]:
     """Hold each concurrency level at steady state for ``campaign_s`` of
     wall clock, calling ``analyze_once`` from ``concurrency`` client
@@ -245,7 +286,20 @@ def run_campaign(
             th.start()
         stop.wait(campaign_s)  # a failing client ends the dwell early
         stop.set()
-        drain_s = max(DRAIN_FLOOR_S, 4.0 * campaign_s)
+        # the drain must scale with REQUEST size, not just the dwell: a
+        # 1M-line request is ~5x a 200k one and a C=8 queue multiplies
+        # further. ``request_floor_s`` is the caller's measured serial
+        # request time (x10 covers a full C=8 queue depth); the max
+        # latency observed IN this level adapts to live conditions the
+        # caller couldn't have measured (e.g. a degraded relay)
+        with lock:
+            observed = max(lat, default=0.0)
+        drain_s = max(
+            DRAIN_FLOOR_S,
+            4.0 * campaign_s,
+            10.0 * request_floor_s,
+            5.0 * observed,
+        )
         wedged = join_bounded(threads, drain_s)
         dt = time.perf_counter() - t0
         failure = None

@@ -100,40 +100,12 @@ def main() -> None:
             else:
                 engine.analyze(data)
 
-    # EVERY phase — warmup, serial stream, concurrent fan-out — runs in
-    # daemon worker threads under bench_common.join_bounded (the shared
-    # wedge-detection rule): a backend that stops returning mid-request
-    # must yield a {"value": null} diagnostics exit, not an rc=124 hang
-    # with no artifact. Worker errors propagate; only a thread still
-    # alive after the budget is a wedge.
+    # EVERY phase — warmup, serial stream, concurrent fan-out — runs
+    # through bench_common.run_bounded (the shared wedge wrapper): a
+    # backend that stops returning mid-request must yield a
+    # {"value": null} diagnostics exit, not an rc=124 hang.
     def run_bounded(workers: list, budget_s: float, what: str) -> None:
-        errors: list[BaseException] = []
-
-        def wrap(fn):
-            def inner() -> None:
-                try:
-                    fn()
-                except BaseException as exc:  # noqa: BLE001 - re-raised below
-                    errors.append(exc)
-
-            return inner
-
-        threads = [
-            threading.Thread(target=wrap(fn), daemon=True) for fn in workers
-        ]
-        for th in threads:
-            th.start()
-        if bench_common.join_bounded(threads, budget_s):
-            bench_common.exit_null(
-                metric, "ms", platform,
-                bench_common.wedge_failure(
-                    f"wedged: requests still in flight after {budget_s:.0f}s "
-                    f"({what})",
-                    errors,
-                ),
-            )
-        if errors:
-            raise errors[0]
+        bench_common.run_bounded(workers, budget_s, metric, "ms", platform, what)
 
     def warmup() -> None:
         for i in range(3):  # compile every shape bucket the stream hits

@@ -16,6 +16,8 @@ import sys
 import threading
 import time
 
+import pytest
+
 import bench_common
 
 
@@ -145,6 +147,37 @@ def test_run_campaign_detects_wedged_level(monkeypatch):
         assert len(curve) == 1  # nothing after the wedged level ran
     finally:
         release.set()  # let the leaked daemon client threads exit
+
+
+def test_run_bounded_returns_results_in_order():
+    out = bench_common.run_bounded(
+        [lambda: 1, lambda: 2, lambda: 3], 10.0, "m", "u", "p", "phase"
+    )
+    assert out == [1, 2, 3]
+
+
+def test_run_bounded_reraises_worker_error():
+    def boom():
+        raise ValueError("backend died")
+
+    with pytest.raises(ValueError, match="backend died"):
+        bench_common.run_bounded([boom], 10.0, "m", "u", "p", "phase")
+
+
+def test_run_bounded_wedge_exits_with_null_artifact(capsys):
+    """A worker that never returns must produce the exit-3 diagnostics
+    line, never an unbounded hang — the harness contract every bench
+    (latency, mesh) now rides on."""
+    release = threading.Event()
+    try:
+        with pytest.raises(SystemExit) as exc_info:
+            bench_common.run_bounded([release.wait], 0.2, "m", "u", "p", "phase")
+        assert exc_info.value.code == 3
+        out = capsys.readouterr().out
+        assert '"value": null' in out
+        assert "wedged" in out
+    finally:
+        release.set()
 
 
 def test_pin_platform_cpu_pins(monkeypatch):

@@ -46,6 +46,13 @@ run() { # run <artifact-stem> <cmd...>
 
 run "config2_${platform}"          python bench.py
 run "config2_hostcol_${platform}"  python bench.py --host-col
+run "config3_1m_singlechip_${platform}" python bench.py --lines 1000000
+# the full sharded DP program at corpus scale on the virtual 8-device
+# mesh. Runs on EVERY refresh round (bench_mesh.py pins itself to the
+# virtual CPU mesh regardless of $platform, hence the fixed cpu stem) so
+# the artifact never goes stale beside freshly-stamped siblings; real
+# multi-chip mode is LOG_PARSER_TPU_MESH=real on a multi-chip host
+run "config3_1m_mesh8_cpu" python bench_mesh.py --devices 8 --lines 1000000
 run "config4_2k_${platform}"       python bench_bank.py --patterns 2000 --lines 65536
 run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines 65536
 run "config5_direct_${platform}"   python bench_latency.py
