@@ -90,9 +90,12 @@ def main() -> None:
     import shutil
     import tempfile
 
-    platform = bench_common.probe_backend(
-        f"match_lines_per_sec_{N_PATTERNS}regex_library", "lines/s"
-    )
+    metric = f"match_lines_per_sec_{N_PATTERNS}regex_library"
+    platform = bench_common.probe_backend(metric, "lines/s")
+
+    # every device touch must yield the {"value": null} diagnostics exit
+    # on a wedged backend, never an unbounded hang
+    bounded = bench_common.bounded_runner(metric, "lines/s", platform)
 
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.models.pod import PodFailureData
@@ -102,26 +105,37 @@ def main() -> None:
     cache_dir = tempfile.mkdtemp(prefix="lpt-bankbench-")
     os.environ["LOG_PARSER_TPU_CACHE"] = cache_dir
     try:
+        # bank compiles are host-side work, but the engine constructor
+        # also touches the device layer — keep them bounded too
         t0 = time.perf_counter()
-        engine = PatternShardedEngine(sets, ScoringConfig())
+        engine = bounded(
+            lambda: PatternShardedEngine(sets, ScoringConfig()),
+            bench_common.PROBE_TIMEOUT_S,
+            "cold compile",
+        )
         cold_compile = time.perf_counter() - t0
         assert not engine.skipped_patterns, engine.skipped_patterns[:3]
 
         t0 = time.perf_counter()
-        engine = PatternShardedEngine(sets, ScoringConfig())
+        engine = bounded(
+            lambda: PatternShardedEngine(sets, ScoringConfig()),
+            bench_common.PROBE_TIMEOUT_S,
+            "warm compile",
+        )
         warm_compile = time.perf_counter() - t0
 
         data = PodFailureData(
             pod={"metadata": {"name": "bank"}}, logs=synth_logs(N_LINES, N_PATTERNS)
         )
-        engine.analyze(data)  # warmup compile of the device programs
-        t0 = time.perf_counter()
-        result = engine.analyze(data)
-        elapsed = time.perf_counter() - t0
+        # warmup (device-program compile) + best-of-n under the shared
+        # sequence (bench_common.measured_phase)
+        result, _, elapsed = bench_common.measured_phase(
+            bounded, lambda: engine.analyze(data)
+        )
         assert result.summary.significant_events > 0
 
         bench_common.emit(
-            f"match_lines_per_sec_{N_PATTERNS}regex_library",
+            metric,
             round(N_LINES / elapsed, 1),
             "lines/s",
             round(warm_compile, 3),

@@ -233,6 +233,62 @@ def run_bounded(workers: list, budget_s: float, metric: str, unit: str,
     return results
 
 
+def run_bounded_one(fn, budget_s: float, metric: str, unit: str,
+                    platform: str, what: str):
+    """Single-worker :func:`run_bounded` — the common shape for serial
+    bench phases (device init, warmup, the timed measure)."""
+    return run_bounded([fn], budget_s, metric, unit, platform, what)[0]
+
+
+def bounded_runner(metric: str, unit: str, platform):
+    """Bind a bench's artifact identity once and get its per-phase wedge
+    wrapper ``bounded(fn, budget_s, what)`` — so every serial bench
+    carries the identical wrapper instead of a local re-binding copy.
+
+    ``platform`` may be the label string or a zero-arg getter: a bench
+    that refines its label mid-run (bench_mesh's real mode reports the
+    actual device platform discovered during init) passes
+    ``lambda: platform`` so every phase reads the CURRENT label — a
+    frozen stale label on a wedge artifact would be a mislabel."""
+
+    def bounded(fn, budget_s: float, what: str):
+        p = platform() if callable(platform) else platform
+        return run_bounded_one(fn, budget_s, metric, unit, p, what)
+
+    return bounded
+
+
+#: Run count of every timed measure phase (bench_common.timeit n=...);
+#: one constant so measure_budget and the timeit call sites cannot drift.
+MEASURE_RUNS = 3
+
+
+def measure_budget(warmup_dt: float, n: int = MEASURE_RUNS) -> float:
+    """Wedge budget for an n-run timed measure phase, derived from the
+    OBSERVED warmup duration: warmup includes compilation, so 5x it
+    over-covers a steady-state run — a slower host or a bigger workload
+    scales the budget instead of tripping a false wedge.  One formula so
+    benches cannot drift."""
+    return n * max(60.0, 5.0 * warmup_dt)
+
+
+def measured_phase(bounded, fn, n: int = MEASURE_RUNS):
+    """THE serial measurement sequence shared by every bench: one warmup
+    call of ``fn`` under the cold-start budget (compiles + caches), then
+    best-of-``n`` timing under the warmup-derived wedge budget.  Returns
+    ``(warmup_result, warmup_dt, best_seconds)``.  ``bounded`` is the
+    bench's :func:`bounded_runner` wrapper."""
+    w0 = time.perf_counter()
+    result = bounded(fn, PROBE_TIMEOUT_S, "warmup")
+    warmup_dt = time.perf_counter() - w0
+    best = bounded(
+        lambda: timeit(fn, n=n, warmup=0),
+        measure_budget(warmup_dt, n),
+        "measure",
+    )
+    return result, warmup_dt, best
+
+
 def run_campaign(
     analyze_once,
     n_lines: int,

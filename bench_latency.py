@@ -101,11 +101,11 @@ def main() -> None:
                 engine.analyze(data)
 
     # EVERY phase — warmup, serial stream, concurrent fan-out — runs
-    # through bench_common.run_bounded (the shared wedge wrapper): a
-    # backend that stops returning mid-request must yield a
-    # {"value": null} diagnostics exit, not an rc=124 hang.
-    def run_bounded(workers: list, budget_s: float, what: str) -> None:
-        bench_common.run_bounded(workers, budget_s, metric, "ms", platform, what)
+    # through the shared wedge wrappers: a backend that stops returning
+    # mid-request must yield a {"value": null} diagnostics exit, not an
+    # rc=124 hang. Single-worker phases ride bounded_runner; the
+    # concurrent fan-out uses run_bounded directly.
+    bounded = bench_common.bounded_runner(metric, "ms", platform)
 
     def warmup() -> None:
         for i in range(3):  # compile every shape bucket the stream hits
@@ -114,7 +114,7 @@ def main() -> None:
     # warmup budget: first-compile on TPU is 20-40s; through a cold
     # tunneled runtime it has been observed past 100s — match the probe
     # harness's total budget before calling it a wedge
-    run_bounded([warmup], bench_common.PROBE_TIMEOUT_S, "warmup")
+    bounded(warmup, bench_common.PROBE_TIMEOUT_S, "warmup")
 
     lat: list[float] = []
     # measurement budget: a generous per-request ceiling times the whole
@@ -134,7 +134,14 @@ def main() -> None:
 
             return inner
 
-        run_bounded([client(c) for c in range(CONCURRENCY)], budget_s, "stream")
+        bench_common.run_bounded(
+            [client(c) for c in range(CONCURRENCY)],
+            budget_s,
+            metric,
+            "ms",
+            platform,
+            "stream",
+        )
         for vals in per_thread:
             lat.extend(vals)
     else:
@@ -145,7 +152,7 @@ def main() -> None:
                 run_one(i)
                 lat.append((time.perf_counter() - t0) * 1e3)
 
-        run_bounded([serial], budget_s, "stream")
+        bounded(serial, budget_s, "stream")
     lat.sort()
 
     bench_common.emit(

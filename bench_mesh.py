@@ -70,17 +70,16 @@ def main() -> None:
     metric = "dp_mesh_lines_per_sec"
     platform = f"{'cpu-virtual' if MODE == 'virtual' else 'real'}-mesh{N_DEVICES}"
 
-    def bounded(fn, budget_s: float, what: str):
-        """Shared wedge wrapper: in ``real`` mode device discovery and
-        every analyze() go through a possibly-wedged backend, and the
-        harness contract is a {"value": null} diagnostics exit, never an
-        unbounded hang."""
-        return bench_common.run_bounded(
-            [fn], budget_s, metric, "lines/s", platform, what
-        )[0]
+    # in ``real`` mode device discovery and every analyze() go through a
+    # possibly-wedged backend; the contract is a {"value": null}
+    # diagnostics exit, never an unbounded hang. The label getter reads
+    # the CURRENT platform: setup() refines it in real mode
+    bounded = bench_common.bounded_runner(metric, "lines/s", lambda: platform)
+
+    visible_devices = 0
 
     def setup():
-        nonlocal platform
+        nonlocal platform, visible_devices
         import jax
 
         if MODE == "virtual":
@@ -89,6 +88,7 @@ def main() -> None:
             # re-pin as __graft_entry__.dryrun_multichip)
             jax.config.update("jax_platforms", "cpu")
         devices = jax.devices()
+        visible_devices = len(devices)
         if MODE == "real":
             # label with what the devices actually ARE (the stale-flag
             # masquerade is already prevented by the flag strip above;
@@ -120,24 +120,12 @@ def main() -> None:
         pod={"metadata": {"name": "bench-mesh"}}, logs=build_corpus(N_LINES)
     )
 
-    # warmup compiles the sharded program — same budget class as a cold
-    # backend start; then the shared best-of-3 timing rule
-    import time
-
-    w0 = time.perf_counter()
-    result = bounded(
-        lambda: engine.analyze(data), bench_common.PROBE_TIMEOUT_S, "warmup"
+    # warmup (sharded-program compile) + best-of-n under the shared
+    # sequence (bench_common.measured_phase)
+    result, _, dt = bench_common.measured_phase(
+        bounded, lambda: engine.analyze(data)
     )
-    warmup_dt = time.perf_counter() - w0
     assert result.summary.significant_events > 0
-    # measure budget derives from the OBSERVED warmup (which includes
-    # compile, so it over-covers a steady-state run): a slower host or a
-    # bigger --lines scales the budget instead of tripping a false wedge
-    dt = bounded(
-        lambda: bench_common.timeit(lambda: engine.analyze(data), n=3, warmup=0),
-        3 * max(60.0, 5.0 * warmup_dt),
-        "measure",
-    )
     rate = N_LINES / dt
 
     bench_common.emit(
@@ -148,6 +136,9 @@ def main() -> None:
         platform,
         n_lines=N_LINES,
         n_devices=N_DEVICES,
+        # OBSERVED count, not an echo of --devices: lets consumers (and
+        # the smoke test) verify the topology request actually took
+        visible_devices=visible_devices,
         mode=MODE,
         n_events=result.summary.significant_events,
     )

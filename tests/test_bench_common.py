@@ -180,6 +180,40 @@ def test_run_bounded_wedge_exits_with_null_artifact(capsys):
         release.set()
 
 
+def test_bench_mesh_smoke():
+    """bench_mesh end-to-end at tiny shapes on a 2-device virtual mesh.
+    The suite env carries an 8-device XLA_FLAGS count from conftest, so
+    this also exercises the stale-flag replacement (--devices must win).
+    """
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo  # hermetic: drops any device plugin
+    # a stall anywhere must surface as the bench's own diagnostics exit,
+    # not a bare TimeoutExpired: the subprocess kill must exceed the SUM
+    # of the worst-case stage budgets. With PROBE_TIMEOUT_S=60: device
+    # init <= 60, warmup <= 60, measure <= measure_budget(60) =
+    # 3*max(60, 300) = 900 -> total <= 1020 (+ script overhead); the
+    # healthy path finishes in ~30s
+    env["LOG_PARSER_TPU_PROBE_TIMEOUT"] = "60"
+    r = subprocess.run(
+        [sys.executable, "bench_mesh.py", "--devices", "2", "--lines", "200"],
+        capture_output=True,
+        text=True,
+        timeout=1100,
+        cwd=repo,
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    import json
+
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["platform"] == "cpu-virtual-mesh2"
+    assert doc["n_devices"] == 2 and doc["value"] > 0 and doc["n_events"] > 0
+    # OBSERVED device count, not an echo of --devices: proves the
+    # stale 8-device flag from conftest was actually replaced
+    assert doc["visible_devices"] == 2
+
+
 def test_pin_platform_cpu_pins(monkeypatch):
     import jax
 
