@@ -1,16 +1,26 @@
 """Extended engine-vs-golden parity sweep.
 
 Reuses the suite's own generators (tests/test_engine_parity.py) over an
-arbitrary seed range — the suite pins seeds 0..7 for CI speed; this tool
-runs the long tail on demand. Every seed builds a random pattern library,
-then runs three corpora through BOTH the device engine (CPU backend,
+arbitrary seed range — the suite pins small seed sets for CI speed; this
+tool runs the long tail on demand. Every seed builds a random pattern
+library, then runs corpora through BOTH a device engine (CPU backend,
 fallback disabled) and the pure-host golden analyzer, asserting
 event-for-event equality and score deltas <= 1e-9 with evolving
 cross-request frequency state.
 
-Usage: python tools/fuzz_sweep.py [--start 8] [--end 200]
-Record: seeds 8..199 (192 libraries, 576 corpora) passed clean on the
-round-4 engine (2026-07-30).
+Two modes:
+- default: single-device ``AnalysisEngine`` — mirrors
+  ``test_random_library_parity`` (suite seeds 0..7).
+- ``--sharded``: ``ShardedEngine`` over the virtual 8-device mesh
+  (shard_map halos, all_gather chains, cross-shard frequency prefix) —
+  mirrors ``test_random_parity_small_batches`` (suite seeds 1000..1003;
+  pass raw offsets, the tool adds nothing).
+
+Usage: python tools/fuzz_sweep.py [--start N] [--end M] [--sharded]
+(defaults per mode: 8..200 single-device, 1004..1054 sharded — i.e. the
+documented records below are what a bare run reproduces; --end exclusive)
+Record (round-4 engine, 2026-07-30): default seeds 8..199 (192 libraries,
+576 corpora) clean; sharded seeds 1004..1053 (50 libraries) clean.
 """
 
 from __future__ import annotations
@@ -22,7 +32,15 @@ import sys
 import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# append-if-missing (the conftest idiom), NOT setdefault: a pre-set
+# XLA_FLAGS would otherwise silently drop the 8-device topology and turn
+# the --sharded sweep into a vacuous 1-device pass (make_mesh slices
+# devices[:n] without complaint)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 os.environ["LOG_PARSER_TPU_NO_FALLBACK"] = "1"
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -37,9 +55,17 @@ def main() -> int:
         # vacuous clean pass
         sys.exit("refusing to run under python -O: parity asserts would be stripped")
     ap = argparse.ArgumentParser()
-    ap.add_argument("--start", type=int, default=8)
-    ap.add_argument("--end", type=int, default=200)
+    ap.add_argument("--start", type=int, default=None)
+    ap.add_argument("--end", type=int, default=None)
+    ap.add_argument("--sharded", action="store_true")
     args = ap.parse_args()
+    # per-mode defaults: a bare run reproduces the documented record,
+    # and the sharded seed space stays disjoint from the suite's 0..7
+    # and the single-device sweep's 8..199
+    if args.start is None:
+        args.start = 1004 if args.sharded else 8
+    if args.end is None:
+        args.end = 1054 if args.sharded else 200
 
     import jax
 
@@ -55,26 +81,37 @@ def main() -> int:
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.golden import GoldenAnalyzer
     from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.parallel import ShardedEngine, make_mesh
     from log_parser_tpu.runtime import AnalysisEngine
 
+    mesh = make_mesh(8) if args.sharded else None
     t0 = time.time()
     fails: list[tuple[int, str]] = []
     for seed in range(args.start, args.end):
         rng = random.Random(seed)
         # construction inside the guard: a library the compiler rejects
         # is exactly the kind of find the sweep records, not an abort.
-        # Per-seed config variation and the end-of-seed frequency-stats
-        # check mirror the suite's test_random_library_parity exactly.
+        # Config variation, corpus counts, and the end-of-seed
+        # frequency-stats check mirror the corresponding suite test
+        # exactly (rng call order included, so seed N here draws the
+        # same library the suite's seed N would).
         try:
-            sets = random_library(rng, rng.randrange(2, 8))
-            config = ScoringConfig(
-                frequency_threshold=rng.choice([2.0, 10.0]),
-                proximity_max_window=rng.choice([5, 100]),
-            )
-            engine = AnalysisEngine(sets, config, clock=FakeClock())
+            if args.sharded:
+                sets = random_library(rng, rng.randrange(2, 6))
+                config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
+                engine = ShardedEngine(sets, config, mesh=mesh, clock=FakeClock())
+                n_runs, max_lines = 2, 90
+            else:
+                sets = random_library(rng, rng.randrange(2, 8))
+                config = ScoringConfig(
+                    frequency_threshold=rng.choice([2.0, 10.0]),
+                    proximity_max_window=rng.choice([5, 100]),
+                )
+                engine = AnalysisEngine(sets, config, clock=FakeClock())
+                n_runs, max_lines = 3, 120
             golden = GoldenAnalyzer(sets, config, clock=FakeClock())
-            for _ in range(3):  # frequency state must evolve identically
-                logs = random_logs(rng, rng.randrange(5, 120))
+            for _ in range(n_runs):  # frequency state must evolve identically
+                logs = random_logs(rng, rng.randrange(5, max_lines))
                 data = PodFailureData(pod={"metadata": {"name": "fuzz"}}, logs=logs)
                 assert_results_match(engine.analyze(data), golden.analyze(data))
             # explicit raise, not assert: python -O would strip an
