@@ -8,19 +8,27 @@ fallback disabled) and the pure-host golden analyzer, asserting
 event-for-event equality and score deltas <= 1e-9 with evolving
 cross-request frequency state.
 
-Two modes:
+Three modes:
 - default: single-device ``AnalysisEngine`` — mirrors
   ``test_random_library_parity`` (suite seeds 0..7).
 - ``--sharded``: ``ShardedEngine`` over the virtual 8-device mesh
   (shard_map halos, all_gather chains, cross-shard frequency prefix) —
   mirrors ``test_random_parity_small_batches`` (suite seeds 1000..1003;
   pass raw offsets, the tool adds nothing).
+- ``--pattern-sharded``: ``PatternShardedEngine`` with per-seed block
+  counts (the pattern-axis / TP-analogue path, stable (line, pattern)
+  merge) — mirrors ``test_pattern_sharded.test_random_parity_vs_golden``
+  (suite seeds 9000..9002 x n_blocks {1,3,4}).
 
-Usage: python tools/fuzz_sweep.py [--start N] [--end M] [--sharded]
-(defaults per mode: 8..200 single-device, 1004..1054 sharded — i.e. the
-documented records below are what a bare run reproduces; --end exclusive)
+Usage: python tools/fuzz_sweep.py [--start N] [--end M]
+       [--sharded | --pattern-sharded]
+(defaults per mode: 8..200 single-device, 1004..1054 sharded,
+9003..9053 pattern-sharded — a bare run reproduces the documented
+records below; --end exclusive)
 Record (round-4 engine, 2026-07-30): default seeds 8..199 (192 libraries,
-576 corpora) clean; sharded seeds 1004..1053 (50 libraries) clean.
+576 corpora) clean; sharded seeds 1004..1053 (50 libraries) clean;
+pattern-sharded seeds 9003..9052 (50 libraries, n_blocks cycling 1/3/4)
+clean.
 """
 
 from __future__ import annotations
@@ -57,15 +65,17 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--start", type=int, default=None)
     ap.add_argument("--end", type=int, default=None)
-    ap.add_argument("--sharded", action="store_true")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sharded", action="store_true")
+    mode.add_argument("--pattern-sharded", action="store_true")
     args = ap.parse_args()
     # per-mode defaults: a bare run reproduces the documented record,
-    # and the sharded seed space stays disjoint from the suite's 0..7
-    # and the single-device sweep's 8..199
+    # and each mode's seed space stays disjoint from the suite's pinned
+    # seeds and the other modes' sweeps
     if args.start is None:
-        args.start = 1004 if args.sharded else 8
+        args.start = 1004 if args.sharded else 9003 if args.pattern_sharded else 8
     if args.end is None:
-        args.end = 1054 if args.sharded else 200
+        args.end = 1054 if args.sharded else 9053 if args.pattern_sharded else 200
 
     import jax
 
@@ -81,7 +91,11 @@ def main() -> int:
     from log_parser_tpu.config import ScoringConfig
     from log_parser_tpu.golden import GoldenAnalyzer
     from log_parser_tpu.models.pod import PodFailureData
-    from log_parser_tpu.parallel import ShardedEngine, make_mesh
+    from log_parser_tpu.parallel import (
+        PatternShardedEngine,
+        ShardedEngine,
+        make_mesh,
+    )
     from log_parser_tpu.runtime import AnalysisEngine
 
     mesh = make_mesh(8) if args.sharded else None
@@ -100,7 +114,17 @@ def main() -> int:
                 sets = random_library(rng, rng.randrange(2, 6))
                 config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
                 engine = ShardedEngine(sets, config, mesh=mesh, clock=FakeClock())
-                n_runs, max_lines = 2, 90
+                n_runs, lines_lo, lines_hi = 2, 5, 90
+            elif args.pattern_sharded:
+                sets = random_library(rng, rng.randrange(3, 7))
+                config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
+                engine = PatternShardedEngine(
+                    sets,
+                    config,
+                    n_blocks=(1, 3, 4)[seed % 3],
+                    clock=FakeClock(),
+                )
+                n_runs, lines_lo, lines_hi = 2, 20, 200
             else:
                 sets = random_library(rng, rng.randrange(2, 8))
                 config = ScoringConfig(
@@ -108,10 +132,10 @@ def main() -> int:
                     proximity_max_window=rng.choice([5, 100]),
                 )
                 engine = AnalysisEngine(sets, config, clock=FakeClock())
-                n_runs, max_lines = 3, 120
+                n_runs, lines_lo, lines_hi = 3, 5, 120
             golden = GoldenAnalyzer(sets, config, clock=FakeClock())
             for _ in range(n_runs):  # frequency state must evolve identically
-                logs = random_logs(rng, rng.randrange(5, max_lines))
+                logs = random_logs(rng, rng.randrange(lines_lo, lines_hi))
                 data = PodFailureData(pod={"metadata": {"name": "fuzz"}}, logs=logs)
                 assert_results_match(engine.analyze(data), golden.analyze(data))
             # explicit raise, not assert: python -O would strip an
