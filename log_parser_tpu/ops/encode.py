@@ -25,21 +25,27 @@ def _next_pow2(n: int) -> int:
 # below this row count, batches pad to the plain next power of two: the
 # absolute waste is tiny and the compile-shape set stays minimal
 _QUARTER_RUNG_FLOOR = 8192
+# above this row count, rungs refine to eighth-powers-of-two (≤12.5%
+# padding waste): at ≥64k rows the four extra rungs per octave cost up
+# to four more cached compiles per octave, but the padding rows they
+# trim are pure linear scan time
+_EIGHTH_RUNG_FLOOR = 65536
 
 
 def _pad_rows(n: int, min_rows: int) -> int:
-    """Row count for an ``n``-line batch: the next quarter-power-of-two
-    rung (p, 1.25p, 1.5p, 1.75p — bounded compile-shape set, ≤25% padding
-    waste vs ≤100% for plain pow2; device scan cost is linear in rows)
-    rounded up to a multiple of ``min_rows`` (a sharded engine passes the
-    mesh size, which may not be a power of two — the batch axis must stay
-    divisible by it)."""
+    """Row count for an ``n``-line batch: the next fractional-power-of-two
+    rung — quarter rungs (p, 1.25p, 1.5p, 1.75p) above 8k rows, eighth
+    rungs above 64k — bounding both the compile-shape set and the padding
+    waste (≤25% / ≤12.5% vs ≤100% for plain pow2; device scan cost is
+    linear in rows), rounded up to a multiple of ``min_rows`` (a sharded
+    engine passes the mesh size, which may not be a power of two — the
+    batch axis must stay divisible by it)."""
     n = max(1, n)
     if n <= _QUARTER_RUNG_FLOOR:
         rows = _next_pow2(n)
     else:
         p = _next_pow2(n) // 2  # n > p by construction
-        q = p // 4
+        q = p // 8 if n > _EIGHTH_RUNG_FLOOR else p // 4
         rows = p + q * (-(-(n - p) // q))
     return -(-rows // min_rows) * min_rows
 
