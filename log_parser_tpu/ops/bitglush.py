@@ -101,7 +101,13 @@ class BitGlushBank:
                 base = g
                 for j, item in enumerate(alt.items):
                     for byte in item.byteset:
-                        setbit(bmask[byte], g)
+                        # NUL never reaches the device scan as content
+                        # (NUL-bearing lines are needs_host — encode) so
+                        # byte 0 stays padding-only: its bmask row is
+                        # empty and the stepper's pad0_transparent fast
+                        # path holds for every bank
+                        if byte != 0:
+                            setbit(bmask[byte], g)
                     if item.self_loop:
                         setbit(s_static, g)
                     if item.skippable:
@@ -169,6 +175,25 @@ class BitGlushBank:
         # tools/probe_paircompose.py.)
         self.allow_bc = jnp.asarray(allow4[1])  # boundary present
         self.allow_nb = jnp.asarray(allow4[0])  # no boundary
+        # fused start injection: one [W] select on the scalar ``pos == 0``
+        # feeds a single broadcast OR instead of two (start, then a
+        # caret-gated second OR)
+        self.start_all = jnp.asarray(start | caret_start)
+        # The ungated hit term is ``hits |= d & f_plain``, and after the
+        # update ``d[fin] = bmask[byte][fin] & (...)`` — so a padding
+        # byte (0) can only contribute a hit if some PLAIN-final
+        # position's byteset admits NUL. When none does, the per-byte
+        # ``pos < length`` gating of plain-final accumulation is a
+        # provable no-op and the stepper drops it (gap/self-loop
+        # positions may freely survive padding — they cannot hit).
+        # ``$``/trailing-``\b`` finals keep their eol-equality gates,
+        # which can never fire at a padding position. The builder above
+        # strips byte 0 from every byteset (NUL-bearing lines are
+        # needs_host — encode.py), so today this is True for every bank;
+        # the flag still computes the sound condition and the gated
+        # stepper path is retained as the correctness fallback should a
+        # future bank ever admit the padding byte.
+        self.pad0_transparent = not bool((bmask[0] & f_plain).any())
 
     # --------------------------------------------------------------- device
 
@@ -190,8 +215,10 @@ class BitGlushBank:
         ``bmask`` row take per byte; the \\b/\\B allow mask is the
         takeless two-constant select built in ``__init__``. The
         post-line-end state freeze is dropped — every hit term is gated
-        by its byte's ``pos < length`` and positions only grow, so a
-        polluted ``d`` past end-of-line can never contribute a hit."""
+        by its byte's ``pos < length`` (or, on a ``pad0_transparent``
+        bank, by the padding byte zeroing ``d`` itself) and positions
+        only grow, so a polluted ``d`` past end-of-line can never
+        contribute a hit."""
         W = self.n_words
         init = (
             jnp.zeros((B, W), jnp.uint32),
@@ -201,10 +228,11 @@ class BitGlushBank:
         zero = jnp.uint32(0)
 
         def one(d, hits, pw, b, pos):
-            ok = pos < lengths
             b32 = b.astype(jnp.int32)
             cw = _is_word(b32) if self.needs_wordness else None
-            okc = ok[:, None]
+            if not self.pad0_transparent or self.needs_wordness:
+                ok = pos < lengths
+                okc = ok[:, None]
             if self.has_tb or self.has_preassert:
                 bc = (pw != cw)[:, None]
 
@@ -215,11 +243,15 @@ class BitGlushBank:
 
             c = self._shift1(d)
             if self.has_caret:
-                c = c & self.not_caret
-            c = c | self.start
-            if self.has_caret:
-                # ^-anchored starts inject only at each line's first byte
-                c = c | jnp.where(pos == 0, self.caret_start, zero)
+                # ^-anchored starts inject only at each line's first
+                # byte: one scalar-pred [W] select feeds a single
+                # broadcast OR (the separate caret-gated second OR was
+                # a whole extra [B, W] op per byte)
+                c = (c & self.not_caret) | jnp.where(
+                    pos == 0, self.start_all, self.start
+                )
+            else:
+                c = c | self.start
             for _ in range(self.max_skip_run):
                 sk = self._shift1(c & self.k_skip)
                 if self.has_caret:
@@ -227,13 +259,18 @@ class BitGlushBank:
                 c = c | sk
 
             brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
+            # factored: (c & brow) | (d & brow & s) == brow & (c | (d & s))
+            # — one fewer [B, W] AND per byte
             if self.has_preassert:
                 allow = jnp.where(bc, self.allow_bc, self.allow_nb)
-                d = (c & allow & brow) | (d & brow & self.s_static)
+                d = brow & ((c & allow) | (d & self.s_static))
             else:
-                d = (c & brow) | (d & brow & self.s_static)
+                d = brow & (c | (d & self.s_static))
 
-            hits = hits | jnp.where(okc, d & self.f_plain, zero)
+            if self.pad0_transparent:
+                hits = hits | (d & self.f_plain)
+            else:
+                hits = hits | jnp.where(okc, d & self.f_plain, zero)
             if self.has_dollar or self.has_tb:
                 eol = (pos == lengths - 1)[:, None]
             if self.has_dollar:

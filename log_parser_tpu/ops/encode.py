@@ -140,6 +140,7 @@ def encode_lines(
     # ~9x the output batch in temporaries (int64 indices + bool mask) and
     # OOM on 1M-line corpora with a wide width
     u8 = np.zeros((rows, width), dtype=np.uint8)
+    non_ascii = np.zeros(rows, dtype=bool)
     if len(flat):
         col = np.arange(width, dtype=np.int64)[None, :]
         chunk = max(1, (64 << 20) // max(1, width))  # ~64MB of indices per chunk
@@ -147,10 +148,17 @@ def encode_lines(
             hi = min(n, lo + chunk)
             take = starts[lo:hi, None] + col
             mask = col < np.minimum(lengths[lo:hi], width)[:, None]
-            u8[lo:hi] = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
-
-    non_ascii = np.zeros(rows, dtype=bool)
-    non_ascii[:n] = np.bitwise_or.reduce(u8[:n] & 0x80, axis=1) != 0
+            rows_u8 = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
+            u8[lo:hi] = rows_u8
+            # host re-match flags, accumulated chunk-wise like the fill
+            # itself (a full [n, width] temporary would OOM at 1M lines):
+            # non-ASCII bytes, or content NULs — zeros beyond the padding
+            # count (mirrors lpn_split_fill). Keeping byte 0 padding-only
+            # lets the device automata drop it from every byteset, which
+            # makes the bit tiers' end-of-line gating removable.
+            non_ascii[lo:hi] = ((rows_u8 & 0x80) != 0).any(axis=1) | (
+                (rows_u8 == 0).sum(axis=1) != (~mask).sum(axis=1)
+            )
     over_long = np.zeros(rows, dtype=bool)
     # host re-match when the device row can't hold the full line: the
     # capped-width tail OR max_line_bytes overflow (same rule as the

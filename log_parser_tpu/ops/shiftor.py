@@ -118,7 +118,11 @@ class ShiftOrBank:
                 p = g + j
                 bit = np.uint32(1 << (p % 32))
                 for c in byteset:
-                    mask[c, p // 32] &= ~bit
+                    # byte 0 is padding-only (NUL-bearing lines are
+                    # needs_host — encode): keep mask[0] all-ones so
+                    # pad0_transparent holds for every bank
+                    if c != 0:
+                        mask[c, p // 32] &= ~bit
             # chain continuation words receive bit 31 of their predecessor
             for w in range(g // 32 + 1, (g + len(seq) - 1) // 32 + 1):
                 cont_mask[w] |= np.uint32(1)
@@ -129,6 +133,18 @@ class ShiftOrBank:
         self.end_mask = jnp.asarray(end_mask)
         self.has_chains = bool(cont_mask.any())
         self.cont_mask = jnp.asarray(cont_mask)
+        # The hit term is ``hits |= (~d_new) & end_mask`` and
+        # ``d_new = sh | mask[byte]`` — so a padding byte (0) can only
+        # contribute a hit if some sequence's END position admits NUL
+        # (its ``mask[0]`` bit is 0). When every end bit is set in
+        # ``mask[0]`` the per-byte ``pos < length`` gating is a provable
+        # no-op and the stepper drops it (two [B, W] selects per byte).
+        # The builder above strips byte 0 from every byteset (NUL-bearing
+        # lines are needs_host — encode.py), so today this is True for
+        # every bank; the flag still computes the sound condition and the
+        # gated stepper path is retained as the correctness fallback
+        # should a future bank ever admit the padding byte.
+        self.pad0_transparent = bool(((mask[0] & end_mask) == end_mask).all())
 
         # host copies for probes/serialization (tools/probe_paircompose.py)
         self._np = {"mask": mask, "start_clear": start_clear,
@@ -167,6 +183,12 @@ class ShiftOrBank:
                 )
                 sh = sh | (cr & self.cont_mask[None, :])
             d_new = sh | m
+            if self.pad0_transparent:
+                # padding bytes saturate d_new to all-ones (mask[0] is
+                # all-ones), so end-bit hits past a line's end are
+                # impossible — no gating needed
+                hits = hits | ((~d_new) & self.end_mask[None, :])
+                return d_new, hits
             active = pos_ok[:, None]
             hits = jnp.where(
                 active, hits | ((~d_new) & self.end_mask[None, :]), hits
@@ -174,6 +196,9 @@ class ShiftOrBank:
             return jnp.where(active, d_new, d), hits
 
         def step(carry, b1, b2, t):
+            if self.pad0_transparent:
+                carry = one(carry, b1, None)
+                return one(carry, b2, None)
             p0 = 2 * t
             carry = one(carry, b1, p0 < lengths)
             return one(carry, b2, p0 + 1 < lengths)

@@ -71,8 +71,10 @@ int64_t lpn_split_scan(const uint8_t* buf, int64_t n, int64_t* out_max_len) {
 // Pass 2: fill the padded batch. u8 is a zeroed [rows, width] buffer;
 // starts/ends receive byte offsets of each line within buf (for lazy string
 // decode on the host); lengths receives min(len, width); needs_host is set
-// when a line has non-ASCII bytes within the clipped window or exceeds
-// max_line_bytes.
+// when a line has non-ASCII or NUL bytes within the clipped window or
+// exceeds max_line_bytes. NUL routes to host so the device automata can
+// treat byte 0 as padding-only (no byteset admits it), which lets the
+// bit-tier steppers drop their per-byte end-of-line gating.
 void lpn_split_fill(const uint8_t* buf, int64_t n, int64_t n_lines,
                     uint8_t* u8, int64_t width, int32_t* lengths,
                     uint8_t* needs_host, int64_t* starts, int64_t* ends,
@@ -89,9 +91,13 @@ void lpn_split_fill(const uint8_t* buf, int64_t n, int64_t n_lines,
         uint8_t* dst = u8 + row * width;
         std::memcpy(dst, buf + start, static_cast<size_t>(clipped));
         uint8_t non_ascii = 0;
-        for (int64_t j = 0; j < clipped; ++j) non_ascii |= dst[j] & 0x80;
+        bool has_nul = false;
+        for (int64_t j = 0; j < clipped; ++j) {
+            non_ascii |= dst[j] & 0x80;
+            has_nul = has_nul || (dst[j] == 0);
+        }
         lengths[row] = static_cast<int32_t>(clipped);
-        needs_host[row] = (non_ascii != 0) || (len > max_line_bytes);
+        needs_host[row] = (non_ascii != 0) || has_nul || (len > max_line_bytes);
         starts[row] = start;
         ends[row] = end;
         ++row;

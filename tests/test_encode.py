@@ -58,3 +58,39 @@ def test_pad_rows_min_rows_divisibility():
             rows = _pad_rows(n, min_rows)
             assert rows % min_rows == 0
             assert rows >= n
+
+
+def test_nul_line_routes_to_host():
+    from log_parser_tpu.ops.encode import encode_lines
+
+    enc = encode_lines(["plain ok", "has\x00nul", "also fine"])
+    assert not enc.needs_host[0]
+    assert enc.needs_host[1]  # content NUL -> host re-match
+    assert not enc.needs_host[2]
+
+
+def test_nul_line_routes_to_host_native():
+    from log_parser_tpu.native import available
+    from log_parser_tpu.native.ingest import Corpus
+
+    if not available():
+        pytest.skip("native library unavailable")
+    enc = Corpus("plain ok\nhas\x00nul\nalso fine").encoded
+    assert not enc.needs_host[0]
+    assert enc.needs_host[1]
+    assert not enc.needs_host[2]
+
+
+def test_bit_tiers_pad0_transparent_for_builtin_bank():
+    """Byte 0 is stripped from every device byteset (NUL lines are
+    needs_host), so both bit tiers must take the gate-free stepper —
+    a regression here silently re-adds two [B, W] selects per byte."""
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    mb = MatcherBanks(
+        PatternBank(load_builtin_pattern_sets()), bitglush_max_words=192
+    )
+    assert mb.shiftor is not None and mb.shiftor.pad0_transparent
+    assert mb.bitglush is not None and mb.bitglush.pad0_transparent

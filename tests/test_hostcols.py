@@ -147,3 +147,25 @@ def test_lenient_backref_is_widest():
     texts = {lit.text for lit in lits} if lits else set()
     # x/y/z single-char runs; none may claim the backref's content
     assert texts and all(len(t) <= 2 for t in texts)
+
+
+def test_nul_line_matches_exactly_via_host_override():
+    """A content NUL routes the line to host re-match (encode flags it
+    needs_host) so stripping byte 0 from device bytesets is invisible:
+    engine results stay event-for-event equal to golden."""
+    engine, golden = _pair(
+        [
+            make_pattern(
+                "nul-neg", severity="HIGH",
+                regex="fail[^ ]*ure", confidence=0.8,
+            ),
+            make_pattern(
+                "nul-lit", severity="LOW", regex="tick", confidence=0.5,
+            ),
+        ]
+    )
+    logs = "\n".join(
+        ["tick ok", "fail\x00ure mid-line nul", "failhardure", "tick end"]
+    )
+    data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+    assert_results_match(engine.analyze(data), golden.analyze(data))
