@@ -3,8 +3,13 @@
 Vectorized with numpy (one ``encode()`` of the whole corpus + fancy
 indexing, no per-line Python loop). Returns, per line, its byte length and
 whether it needs host-side verification (non-ASCII content — where UTF-8
-byte automata and Java UTF-16 semantics can diverge — or length beyond the
-device padding cap).
+byte automata and Java UTF-16 semantics can diverge — content NUL bytes,
+or length beyond the device padding cap).
+
+The NUL rule is load-bearing for the device scans: every gate-free
+stepper (bit tiers, dense pair-stride, union any-hit, AC prefilter)
+relies on byte 0 being PADDING-ONLY — content NULs must re-match on
+host, never reach a device automaton.
 """
 
 from __future__ import annotations
@@ -57,7 +62,10 @@ class EncodedLines:
     u8: np.ndarray  # uint8 [B, T]
     lengths: np.ndarray  # int32 [B] byte length clipped to T; over-long
     # lines are flagged needs_host and re-matched from the original string
-    needs_host: np.ndarray  # bool [B] non-ASCII or over-long
+    # bool [B]: non-ASCII, content NUL, or over-long. The NUL condition is
+    # an invariant the gate-free device steppers depend on — byte 0 must
+    # be padding-only on device (see module docstring)
+    needs_host: np.ndarray
     n_lines: int
 
 
@@ -140,14 +148,15 @@ def encode_lines(
     # ~9x the output batch in temporaries (int64 indices + bool mask) and
     # OOM on 1M-line corpora with a wide width
     u8 = np.zeros((rows, width), dtype=np.uint8)
-    non_ascii = np.zeros(rows, dtype=bool)
+    host_flag = np.zeros(rows, dtype=bool)
     if len(flat):
         col = np.arange(width, dtype=np.int64)[None, :]
         chunk = max(1, (64 << 20) // max(1, width))  # ~64MB of indices per chunk
         for lo in range(0, n, chunk):
             hi = min(n, lo + chunk)
             take = starts[lo:hi, None] + col
-            mask = col < np.minimum(lengths[lo:hi], width)[:, None]
+            clamped = np.minimum(lengths[lo:hi], width)
+            mask = col < clamped[:, None]
             rows_u8 = np.where(mask, flat[np.clip(take, 0, len(flat) - 1)], 0)
             u8[lo:hi] = rows_u8
             # host re-match flags, accumulated chunk-wise like the fill
@@ -155,9 +164,9 @@ def encode_lines(
             # non-ASCII bytes, or content NULs — zeros beyond the padding
             # count (mirrors lpn_split_fill). Keeping byte 0 padding-only
             # lets the device automata drop it from every byteset, which
-            # makes the bit tiers' end-of-line gating removable.
-            non_ascii[lo:hi] = ((rows_u8 & 0x80) != 0).any(axis=1) | (
-                (rows_u8 == 0).sum(axis=1) != (~mask).sum(axis=1)
+            # makes the gate-free stepper paths sound.
+            host_flag[lo:hi] = ((rows_u8 & 0x80) != 0).any(axis=1) | (
+                (rows_u8 == 0).sum(axis=1) != (width - clamped)
             )
     over_long = np.zeros(rows, dtype=bool)
     # host re-match when the device row can't hold the full line: the
@@ -171,6 +180,6 @@ def encode_lines(
     return EncodedLines(
         u8=u8,
         lengths=full_lengths,
-        needs_host=non_ascii | over_long,
+        needs_host=host_flag | over_long,
         n_lines=n,
     )

@@ -79,6 +79,13 @@ class DfaBank:
             accept[i, : d.n_states] = d.accept_end
             start[i] = d.start
         self.smax, self.cmax = smax, cmax
+        # Byte 0 maps to the identity padding class (index cmax): content
+        # NULs never reach the device (encode routes them to host), so
+        # padding bytes select identity through the class map itself and
+        # the pair-stride scan needs no per-step ``pos < length`` selects.
+        # The non-pair paths keep their gating; their (clamped,
+        # out-of-range) byte-0 lookups only occur at gated padding bytes.
+        byte_class[:, 0] = cmax
         # flat layout for a single fused gather per scan step
         self.flat_trans = jnp.asarray(trans.reshape(-1))
         self.byte_class = jnp.asarray(byte_class)
@@ -151,14 +158,12 @@ class DfaBank:
 
         if self.pair_stride:
             cpad = self.cpad
-            pad_cls = jnp.int32(self.cmax)
 
             def step(states, b1, b2, t):
-                p0 = 2 * t
+                # gate-free: padding bytes (0) map to the identity class
+                # through byte_class itself (see __init__)
                 c1 = jnp.take(self.byte_class, b1.astype(jnp.int32), axis=1)  # [R, B]
                 c2 = jnp.take(self.byte_class, b2.astype(jnp.int32), axis=1)
-                c1 = jnp.where((p0 < lengths)[None, :], c1, pad_cls)
-                c2 = jnp.where((p0 + 1 < lengths)[None, :], c2, pad_cls)
                 idx = ((r_off + states) * cpad + c1.T) * cpad + c2.T  # [B, R]
                 return jnp.take(self.flat_trans2, idx.reshape(-1)).reshape(B, R)
 
@@ -238,12 +243,23 @@ class MultiDfaBank:
         packed = md.trans.astype(np.int64) | (
             reports.astype(np.int64)[md.trans] << 30
         )
+        # byte-precomposition below spreads classes over the byte axis;
+        # byte 0 is then overridden to a SELF-LOOP carrying the state's
+        # own report flag: content NULs never reach the device (encode
+        # routes them to host), so past a line's end the state freezes
+        # itself and the any-hit flag OR is an idempotent re-OR — the
+        # pair_stepper runs gate-free. The exact word_stepper keeps its
+        # gating (out2 rows are word-ness-dependent, and a padding byte
+        # must not re-emit them).
         # byte-precomposed: trans_byte[s, b] = packed[s, byte_class[b]].
         # Host-side until first use: when the group joins a
         # MultiDfaCluster, the cluster's concatenated device buffer is
         # shared back (via _adopt_table) so the table exists on device
         # exactly once however it is reached.
-        self._packed_byte_np = packed[:, md.byte_class].reshape(-1).astype(np.int32)
+        packed_byte = packed[:, md.byte_class].astype(np.int32)
+        s_idx = np.arange(S, dtype=np.int32)
+        packed_byte[:, 0] = s_idx | (reports[s_idx].astype(np.int32) << 30)
+        self._packed_byte_np = packed_byte.reshape(-1)
         self._flat: jax.Array | None = None
         self._flat_base = 0
         self.start_reports = bool(reports[md.start])
@@ -274,19 +290,16 @@ class MultiDfaBank:
             jnp.full((B,), self.start_reports, bool),
         )
 
-        def one(s, rep, b, ok):
+        def one(s, rep, b):
+            # gate-free: padding bytes (0) self-loop with the state's own
+            # report flag (see the packed-table build)
             v = jnp.take(flat, base + s * 256 + b.astype(jnp.int32))
-            nxt = v & self._STATE_MASK
-            flag = v >= self._REPORT_BIT
-            s = jnp.where(ok, nxt, s)
-            rep = rep | (ok & flag)
-            return s, rep
+            return v & self._STATE_MASK, rep | (v >= self._REPORT_BIT)
 
         def step(carry, b1, b2, t):
             s, rep = carry
-            p0 = 2 * t
-            s, rep = one(s, rep, b1, p0 < lengths)
-            s, rep = one(s, rep, b2, p0 + 1 < lengths)
+            s, rep = one(s, rep, b1)
+            s, rep = one(s, rep, b2)
             return (s, rep)
 
         def finish(carry):
@@ -381,18 +394,17 @@ class MultiDfaCluster:
             jnp.broadcast_to(self._start_reports[None, :], (B, G)),
         )
 
-        def one(s, rep, b, ok):
+        def one(s, rep, b):
+            # gate-free: each group's byte-0 column self-loops with the
+            # state's own report flag (MultiDfaBank packed-table build)
             idx = self._base + s * 256 + b.astype(jnp.int32)[:, None]
             v = jnp.take(self._flat, idx)  # [B, G]
-            s = jnp.where(ok[:, None], v & mask, s)
-            rep = rep | (ok[:, None] & (v >= MultiDfaBank._REPORT_BIT))
-            return s, rep
+            return v & mask, rep | (v >= MultiDfaBank._REPORT_BIT)
 
         def step(carry, b1, b2, t):
             s, rep = carry
-            p0 = 2 * t
-            s, rep = one(s, rep, b1, p0 < lengths)
-            s, rep = one(s, rep, b2, p0 + 1 < lengths)
+            s, rep = one(s, rep, b1)
+            s, rep = one(s, rep, b2)
             return (s, rep)
 
         def finish(carry):

@@ -107,6 +107,13 @@ class PrefilterBank:
         # total literal bytes, so states ≤ ~262k → table ≤ ~268 MB int32.
         goto_b = self.ac.goto[:, byte_class]  # [S, 256] int32
         packed = goto_b | (self.ac.has_out[goto_b].astype(np.int32) << 30)
+        # Byte 0 is padding-only (content NULs are needs_host — encode):
+        # make it a SELF-LOOP carrying the state's own flag, so past a
+        # line's end the state freezes itself and the flag/out-word ORs
+        # are idempotent re-ORs — both stepper stages then run gate-free
+        # (no ``pos < length`` compares or selects per byte).
+        s_idx = np.arange(packed.shape[0], dtype=np.int32)
+        packed[:, 0] = s_idx | (self.ac.has_out[s_idx].astype(np.int32) << 30)
         self.flat_goto_byte = jnp.asarray(packed.reshape(-1))
 
     @staticmethod
@@ -144,17 +151,17 @@ class PrefilterBank:
             jnp.zeros((B,), bool),
         )
 
-        def one(s, a, b, ok):
+        def one(s, a, b):
+            # gate-free: padding bytes (0) self-loop with the state's own
+            # flag (see the packed-table build), so s freezes and a
+            # re-ORs an already-recorded flag
             v = jnp.take(self.flat_goto_byte, s * 256 + b.astype(jnp.int32))
-            s = jnp.where(ok, v & mask, s)
-            a = a | (ok & (v >= (1 << 30)))
-            return s, a
+            return v & mask, a | (v >= (1 << 30))
 
         def step(carry, b1, b2, t):
             s, a = carry
-            p0 = 2 * t
-            s, a = one(s, a, b1, p0 < lengths)
-            s, a = one(s, a, b2, p0 + 1 < lengths)
+            s, a = one(s, a, b1)
+            s, a = one(s, a, b2)
             return (s, a)
 
         def finish(carry):
@@ -172,19 +179,18 @@ class PrefilterBank:
             jnp.zeros((N, self.n_words), jnp.uint32),
         )
 
-        def one(s, w, b, ok):
+        def one(s, w, b):
+            # gate-free like the any-hit stage: padding self-loops make
+            # the out-word OR an idempotent re-OR of the frozen state
             v = jnp.take(self.flat_goto_byte, s * 256 + b.astype(jnp.int32))
-            s = jnp.where(ok, v & jnp.int32((1 << 30) - 1), s)
-            w = w | jnp.where(
-                ok[:, None], jnp.take(self.out_words, s, axis=0), jnp.uint32(0)
-            )
+            s = v & jnp.int32((1 << 30) - 1)
+            w = w | jnp.take(self.out_words, s, axis=0)
             return s, w
 
         def step(carry, b1, b2, t):
             s, w = carry
-            p0 = 2 * t
-            s, w = one(s, w, b1, p0 < lengths)
-            s, w = one(s, w, b2, p0 + 1 < lengths)
+            s, w = one(s, w, b1)
+            s, w = one(s, w, b2)
             return (s, w)
 
         def finish(carry):
