@@ -60,17 +60,58 @@ class BitGlushBank:
     """Packed bit programs for a set of (column, BitProgram) entries."""
 
     @staticmethod
-    def count_packed_words(programs) -> int:
-        """Sequential packing: positions sum / 32, rounded up."""
-        total = sum(p.n_positions for p in programs)
+    def sink_eligible(programs) -> bool:
+        """Sticky *sink* positions (see ``__init__``) drop the per-byte
+        hit accumulation — and its ``[B, W]`` carry — from the stepper.
+        A trailing ``\\b``/``\\B`` final would need a sink whose admission
+        depends on the FINAL item's word-ness too, so those banks keep
+        the per-byte hit path (in practice ``expand_asserts`` removes
+        them before packing)."""
+        return not any(
+            a.post_assert in ("b", "B")
+            for p in programs
+            for a in p.alternatives
+        )
+
+    @staticmethod
+    def alloc_positions(program) -> int:
+        """Packed positions one program contributes: its Glushkov
+        positions plus one sink per alternative. THE single source of
+        the sink-packing arithmetic — ``count_packed_words``,
+        ``__init__``, and the tier budget gates in ops/match.py all
+        price programs through this. (On the rare sink-ineligible bank
+        the sinks go unallocated and the price is conservative.)"""
+        return program.n_positions + len(program.alternatives)
+
+    @classmethod
+    def count_packed_words(cls, programs) -> int:
+        """Sequential packing: positions sum / 32, rounded up — plus one
+        sink position per alternative on sink-eligible banks (the rule
+        ``__init__`` packs by; tier gates must agree with it)."""
+        if cls.sink_eligible(programs):
+            total = sum(cls.alloc_positions(p) for p in programs)
+        else:
+            total = sum(p.n_positions for p in programs)
         return max(1, -(-total // 32))
 
     def __init__(self, column_programs: list[tuple[int, BitProgram]]):
         self.columns = [c for c, _ in column_programs]
-        total = sum(p.n_positions for _, p in column_programs)
-        self.n_words = W = self.count_packed_words(
-            [p for _, p in column_programs]
-        )
+        programs = [p for _, p in column_programs]
+        # Sink mode: each alternative gets one extra position after its
+        # last item. A sink admits every byte (``$``-final sinks admit
+        # ONLY the padding byte 0 — i.e. they fire exactly at end of
+        # line) and self-loops, so "some final position was ever alive"
+        # becomes readable from the FINAL state: arrival rides the
+        # existing shift/closure machinery (the trailing skippable
+        # cascade that feeds multiple finals propagates into the sink the
+        # same way), persistence rides ``s_static``, and the stepper
+        # drops both per-byte hit ORs and the whole ``hits`` carry.
+        self.use_sinks = self.sink_eligible(programs)
+        if self.use_sinks:
+            total = sum(self.alloc_positions(p) for p in programs)
+        else:
+            total = sum(p.n_positions for p in programs)
+        self.n_words = W = self.count_packed_words(programs)
         self.n_positions = total
         self.max_skip_run = max(
             (p.max_skip_run for _, p in column_programs), default=0
@@ -91,6 +132,9 @@ class BitGlushBank:
         fin_word: list[int] = []
         fin_bit: list[int] = []
         fin_slot: list[int] = []
+        snk_word: list[int] = []
+        snk_bit: list[int] = []
+        snk_slot: list[int] = []
 
         def setbit(arr, g):
             arr[g // 32] |= np.uint32(1) << np.uint32(g % 32)
@@ -133,6 +177,28 @@ class BitGlushBank:
                     fin_word.append((base + j) // 32)
                     fin_bit.append((base + j) % 32)
                     fin_slot.append(slot)
+                if self.use_sinks:
+                    # sink: one extra position after the alternative's
+                    # last item. Arrival = shift/closure from any final;
+                    # a plain final's sink admits every byte (padding
+                    # included — completion at the last content byte
+                    # still sweeps in), a ``$`` final's sink admits ONLY
+                    # byte 0, so it fires exactly when the line ends.
+                    # Self-loop makes it sticky; ``finish`` runs one
+                    # virtual padding byte so full-width (length == T)
+                    # lines sweep their finals in too.
+                    bit = np.uint32(1) << np.uint32(g % 32)
+                    if alt.post_assert == "$":
+                        bmask[0, g // 32] |= bit
+                    else:
+                        bmask[:, g // 32] |= bit
+                    setbit(s_static, g)
+                    for combo in range(4):
+                        setbit(allow4[combo], g)
+                    snk_word.append(g // 32)
+                    snk_bit.append(g % 32)
+                    snk_slot.append(slot)
+                    g += 1
 
         self.bmask = jnp.asarray(bmask)
         self.s_static = jnp.asarray(s_static)
@@ -163,6 +229,9 @@ class BitGlushBank:
         self.fin_word = np.asarray(fin_word, dtype=np.int32)
         self.fin_bit = np.asarray(fin_bit, dtype=np.int32)
         self.fin_slot = np.asarray(fin_slot, dtype=np.int32)
+        self.snk_word = np.asarray(snk_word, dtype=np.int32)
+        self.snk_bit = np.asarray(snk_bit, dtype=np.int32)
+        self.snk_slot = np.asarray(snk_slot, dtype=np.int32)
 
         # Assert-partition constants: the per-byte allow mask is the
         # TAKELESS combine ``where(pw != cw, allow_bc, allow_nb)`` —
@@ -210,7 +279,88 @@ class BitGlushBank:
 
     def pair_stepper(self, B: int, lengths: jax.Array):
         """(init, step(carry, b1, b2, t), finish) — composable with the
-        other banks into the single fused scan. Carry: (state [B, W]
+        other banks into the single fused scan. Sink-mode banks (the
+        default whenever no trailing ``\\b``/``\\B`` final exists) carry
+        only (state [B, W] uint32, prev_wordness [B] bool) and read hits
+        from sticky sink positions at the end; the rest carry (state,
+        hits [B, W] uint32, prev_wordness) and accumulate per byte."""
+        if self.use_sinks:
+            return self._sink_pair_stepper(B, lengths)
+        return self._hits_pair_stepper(B, lengths)
+
+    def _sink_pair_stepper(self, B: int, lengths: jax.Array):
+        """Sink-mode stepper: no hit terms, no ``hits`` carry, no
+        end-of-line gating at all — ``$`` acceptance is the dollar
+        sink's padding-byte admission, and plain finals sweep into
+        always-admitting sinks. ``finish`` advances one virtual padding
+        byte so lines that fill every scanned byte (length == T) sweep
+        their last-byte finals in, then reads the sink bits."""
+        W = self.n_words
+        init = (
+            jnp.zeros((B, W), jnp.uint32),
+            jnp.zeros((B,), bool),
+        )
+
+        def one(d, pw, b, pos):
+            b32 = b.astype(jnp.int32)
+            c = self._shift1(d)
+            if self.has_caret:
+                c = (c & self.not_caret) | jnp.where(
+                    pos == 0, self.start_all, self.start
+                )
+            else:
+                c = c | self.start
+            for _ in range(self.max_skip_run):
+                sk = self._shift1(c & self.k_skip)
+                if self.has_caret:
+                    sk = sk & self.not_caret
+                c = c | sk
+            brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
+            if self.has_preassert:
+                cw = _is_word(b32)
+                bc = ((pw != cw))[:, None]
+                allow = jnp.where(bc, self.allow_bc, self.allow_nb)
+                d = brow & ((c & allow) | (d & self.s_static))
+                # no end-of-line freeze: past the line end only sink
+                # positions can stay alive (brow is empty elsewhere) and
+                # sinks ignore the allow mask, so pw's padding word-ness
+                # gates nothing that matters
+                pw = cw
+            else:
+                d = brow & (c | (d & self.s_static))
+            return d, pw
+
+        def step(carry, b1, b2, t):
+            d, pw = carry
+            p0 = 2 * t
+            d, pw = one(d, pw, b1, p0)
+            d, pw = one(d, pw, b2, p0 + 1)
+            return (d, pw)
+
+        def finish(carry):
+            d, pw = carry
+            pad = jnp.zeros((B,), jnp.uint8)
+            d, _ = one(d, pw, pad, jnp.int32(1))
+            return self.columns_from_sinks(d)
+
+        return init, step, finish
+
+    def columns_from_sinks(self, d: jax.Array) -> jax.Array:
+        """uint32 [N, W] final sink-mode state -> bool [N, n_columns]."""
+        N = d.shape[0]
+        alive = (
+            jnp.take(d, jnp.asarray(self.snk_word), axis=1)
+            >> jnp.asarray(self.snk_bit)[None, :]
+        ) & 1  # [N, n_sinks]
+        out = jnp.zeros((N, max(1, len(self.columns))), dtype=jnp.int32)
+        out = out.at[:, jnp.asarray(self.snk_slot)].max(
+            alive.astype(jnp.int32)
+        )
+        return out.astype(bool)
+
+    def _hits_pair_stepper(self, B: int, lengths: jax.Array):
+        """Per-byte hit accumulation — the path for banks with trailing
+        ``\\b``/``\\B`` finals (no sink encoding). Carry: (state [B, W]
         uint32, hits [B, W] uint32, prev_wordness [B] bool). One
         ``bmask`` row take per byte; the \\b/\\B allow mask is the
         takeless two-constant select built in ``__init__``. The

@@ -28,10 +28,16 @@ conditions are 0/1 int32 carried to 0/-1 masks, logical right shifts via
 ``jax.lax.shift_right_logical``, cross-word shift carry via
 ``pltpu.roll`` with the lane-0 wraparound masked off.
 
-Semantics are IDENTICAL to BitGlushBank.pair_stepper — same candidate /
-ε-closure / assertion-gating / accept pipeline, verified bit-exactly by
-tests/test_bitglush.py (interpreter mode) and the TPU-side parity sweep
-in tools/probe_tiers.py.
+Semantics are IDENTICAL to BitGlushBank's per-byte *hits* pipeline
+(``_hits_pair_stepper``) — same candidate / ε-closure / assertion-gating
+/ accept path, verified bit-exactly by tests/test_bitglush.py
+(interpreter mode) and the TPU-side parity sweep in
+tools/probe_tiers.py. The scan path's default stepper is now the sink
+stepper (no hits carry), but on sink-packed banks the hits machinery
+(``f_plain``/``f_dollar``/``fin_*``, the ``pos < length`` gates)
+remains VALID — sinks only add always-admitting positions that no hit
+term reads — and this kernel is its remaining consumer: do not strip
+those constants from ``use_sinks`` banks while this path exists.
 """
 
 from __future__ import annotations

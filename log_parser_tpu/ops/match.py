@@ -685,9 +685,10 @@ class MatcherBanks:
                 continue
             if prog.n_positions > self.BITGLUSH_MAX_COLUMN_POSITIONS:
                 continue
-            if bit_positions + prog.n_positions > 32 * bit_budget:
+            need = BitGlushBank.alloc_positions(prog)
+            if bit_positions + need > 32 * bit_budget:
                 continue
-            bit_positions += prog.n_positions
+            bit_positions += need
             bit_entries.append((i, prog))
         # De-assert rewrite, all-or-nothing: the op-group savings are
         # BANK-wide capability flags, so expansion only pays if every
@@ -701,7 +702,9 @@ class MatcherBanks:
             if expanded is not None and all(
                 p.n_positions <= self.BITGLUSH_MAX_COLUMN_POSITIONS
                 for _, p in expanded
-            ) and sum(p.n_positions for _, p in expanded) <= 32 * bit_budget:
+            ) and sum(
+                BitGlushBank.alloc_positions(p) for _, p in expanded
+            ) <= 32 * bit_budget:
                 bit_entries = expanded
         # ONE bank for all bit programs. A measured A/B split the
         # assert-free programs into their own light bank (no word-ness /

@@ -30,16 +30,22 @@ Chains let DFA-less literal columns with >32-position sequences (this
 tier is their only device path) stay exact instead of falling to host.
 
 The row-select ``mask[byte]`` is a small-table ``jnp.take`` ([256, W]
-rows, contiguous). This exact shape — one-level takes from a 256-row
-table indexed by the raw byte, minimal row width — is a measured local
-optimum. Two families of "structural" improvements were built, measured
-SLOWER on TPU v5e, and deleted (tools/probe_paircompose.py, PERF.md §9):
+rows, contiguous): one-level takes from a 256-row table indexed by the
+raw byte, minimal row width. The default stepper is *pair-composed*: two
+independent takes from that SAME table feed one shift-by-two update
+(``_composed_pair_stepper`` — the m-term is composed with vector ops at
+runtime, so no table grows), with sticky *sink* bits replacing the
+per-byte hit check. Two families of table-side "improvements" were
+built, measured SLOWER on TPU v5e, and deleted
+(tools/probe_paircompose.py, PERF.md §9b):
 
-- *pair-composed recurrences* (``D2 = (D<<2) & SC2 | M2[b1,b2]``,
-  halving the serial per-byte chain): every variant lost because take
-  cost scales with gathered row width, not take count — the composed
-  tables need 1.5-2x the row words (0.130-0.242s vs 0.089s for the
-  builtin bank, 229k lines);
+- *m-term-precomposed tables* (``D2 = (D<<2) & SC2 | M2[b1,b2]`` with
+  ``M2`` materialized per byte pair): every variant lost because take
+  cost scales with gathered row width and table locality, not take
+  count — the composed tables need 1.5-2x the row words or 65536 rows
+  (0.130-0.242s vs 0.089s for the builtin bank, 229k lines). Composing
+  the recurrence is fine (it is exact and now the default); composing
+  the TABLE is what loses;
 - *byte-class indirection* (``[C², 2W]`` tables behind a ``[256]``
   class map, C=62): any dependent two-level gather inside the scan adds
   ~3ms/step at this batch — 0.24-0.29s even with 40KB tables.
@@ -69,29 +75,36 @@ class ShiftOrBank:
     def _plan(seq_lengths, budget: int | None = None):
         """Packing plan — THE single source of the packing rule (tier
         gates that estimate word cost must agree with ``__init__``).
-        Sequences >32 take fresh word-aligned runs (cross-word chains)
-        whose tail remainder stays open to first-fit; sequences <=32
+        Every sequence's allocation is its length + 2 *sink* bits (the
+        sticky match flags the pair-composed stepper reads at scan end).
+        Allocations >32 bits take fresh word-aligned runs (cross-word
+        chains) whose tail remainder stays open to first-fit; the rest
         first-fit within any word. Returns (global start bits, n_words);
         with a ``budget``, bails early once the count exceeds it."""
         starts: list[int] = []
         word_fill: list[int] = []
         for m in seq_lengths:
-            if m > 32:
+            alloc = m + 2
+            if alloc > 32:
                 w0 = len(word_fill)
-                nw = (m + 31) // 32
+                nw = (alloc + 31) // 32
                 starts.append(w0 * 32)
                 word_fill.extend([32] * (nw - 1))
-                word_fill.append(m - 32 * (nw - 1))
+                word_fill.append(alloc - 32 * (nw - 1))
             else:
                 w = next(
-                    (i for i, used in enumerate(word_fill) if used + m <= 32),
+                    (
+                        i
+                        for i, used in enumerate(word_fill)
+                        if used + alloc <= 32
+                    ),
                     None,
                 )
                 if w is None:
                     w = len(word_fill)
                     word_fill.append(0)
                 starts.append(w * 32 + word_fill[w])
-                word_fill[w] += m
+                word_fill[w] += alloc
             if budget is not None and len(word_fill) > budget:
                 return starts, len(word_fill)
         return starts, max(1, len(word_fill))
@@ -107,11 +120,20 @@ class ShiftOrBank:
         self.n_seqs = len(flat)
 
         # mask[c, w]: bit (o+j) = 1 iff byte c not allowed at position j;
-        # unused bits are always-1 (inert)
+        # unused bits are always-1 (inert). Each sequence's allocation
+        # ends with two *sink* bits that admit EVERY byte (padding
+        # included): once the end position goes alive, the following
+        # shifts park the match in a sink, where the pair-composed
+        # stepper's persistence term keeps it for the rest of the scan —
+        # two sinks because a pair step shifts by two, so completions of
+        # either parity land in one of them.
         mask = np.full((256, self.n_words), 0xFFFFFFFF, dtype=np.uint32)
         start_clear = np.full(self.n_words, 0xFFFFFFFF, dtype=np.uint32)
         cont_mask = np.zeros(self.n_words, dtype=np.uint32)
         end_mask = np.zeros(self.n_words, dtype=np.uint32)
+        sink_mask = np.zeros(self.n_words, dtype=np.uint32)
+        snk_word: list[int] = []
+        snk_bit: list[int] = []
         for (col, seq), g in zip(flat, starts):
             start_clear[g // 32] &= ~np.uint32(1 << (g % 32))
             for j, byteset in enumerate(seq):
@@ -123,8 +145,15 @@ class ShiftOrBank:
                     # pad0_transparent holds for every bank
                     if c != 0:
                         mask[c, p // 32] &= ~bit
-            # chain continuation words receive bit 31 of their predecessor
-            for w in range(g // 32 + 1, (g + len(seq) - 1) // 32 + 1):
+            for p in (g + len(seq), g + len(seq) + 1):  # the two sinks
+                bit = np.uint32(1 << (p % 32))
+                mask[:, p // 32] &= ~bit
+                sink_mask[p // 32] |= bit
+                snk_word.append(p // 32)
+                snk_bit.append(p % 32)
+            # chain continuation words receive shift carry from their
+            # predecessor (the allocation spans len + 2 sink bits)
+            for w in range(g // 32 + 1, (g + len(seq) + 1) // 32 + 1):
                 cont_mask[w] |= np.uint32(1)
             e = g + len(seq) - 1
             end_mask[e // 32] |= np.uint32(1 << (e % 32))
@@ -133,6 +162,34 @@ class ShiftOrBank:
         self.end_mask = jnp.asarray(end_mask)
         self.has_chains = bool(cont_mask.any())
         self.cont_mask = jnp.asarray(cont_mask)
+        # pair-composed constants. One Shift-Or step is the affine map
+        # f(D) = s1(D) & sc | m[b] (s1 = chain-aware shift); s1 relocates
+        # bits, so it distributes over & and |, and two steps compose
+        # EXACTLY into one shift-by-two step:
+        #   f2(f1(D)) = s2(D) & C2 | (s1(m1) & sc | m2)
+        # with C2 = s1(sc) & sc precomputed — same-width rows from the
+        # same 256-row table, so take cost is unchanged while the vector
+        # chain (and the serial depth) nearly halves. The earlier
+        # pair-composition attempts that measured SLOWER (docstring
+        # above) precomposed the m-term into 65536-row or wider tables —
+        # the loss was table locality / row width, not the algebra.
+        np1 = lambda x: (  # noqa: E731 — numpy s1 on [..., W] constants
+            (x << 1).astype(np.uint32)
+            | (
+                np.concatenate(
+                    [np.zeros_like(x[..., :1]), x[..., :-1] >> 31], axis=-1
+                )
+                & cont_mask
+            )
+        )
+        self.c2 = jnp.asarray(np1(start_clear) & start_clear)
+        self.cont2_mask = jnp.asarray(cont_mask * np.uint32(3))
+        self.not_sink = jnp.asarray(~sink_mask)
+        # the virtual padding pair finish() applies for full-width rows:
+        # both bytes are padding, so the m-term is a constant
+        self.pad_m12 = jnp.asarray(np1(mask[0]) & start_clear | mask[0])
+        self.snk_word = np.asarray(snk_word, dtype=np.int32)
+        self.snk_bit = np.asarray(snk_bit, dtype=np.int32)
         # The hit term is ``hits |= (~d_new) & end_mask`` and
         # ``d_new = sh | mask[byte]`` — so a padding byte (0) can only
         # contribute a hit if some sequence's END position admits NUL
@@ -159,15 +216,80 @@ class ShiftOrBank:
         self.seq_slot = np.asarray(
             [slot_of_col[col] for col, _ in flat], dtype=np.int32
         )
+        # two sink entries per sequence, in the same flat order
+        self.snk_slot = np.repeat(self.seq_slot, 2)
 
     # --------------------------------------------------------------- device
 
     def _row_select(self, bytes_t: jax.Array) -> jax.Array:
         return jnp.take(self.mask, bytes_t.astype(jnp.int32), axis=0)  # [B, W]
 
+    def _s1(self, x: jax.Array) -> jax.Array:
+        """Chain-aware shift by one position (device)."""
+        sh = x << 1
+        if self.has_chains:
+            carry = jnp.concatenate(
+                [jnp.zeros_like(x[:, :1]), x[:, :-1] >> 31], axis=1
+            )
+            sh = sh | (carry & self.cont_mask[None, :])
+        return sh
+
+    def _s2(self, x: jax.Array) -> jax.Array:
+        """Chain-aware shift by two positions (device)."""
+        sh = x << 2
+        if self.has_chains:
+            carry = jnp.concatenate(
+                [jnp.zeros_like(x[:, :1]), x[:, :-1] >> 30], axis=1
+            )
+            sh = sh | (carry & self.cont2_mask[None, :])
+        return sh
+
     def pair_stepper(self, B: int, lengths: jax.Array):
-        """(init, step(carry, b1, b2, t), finish) — composable with the DFA
-        bank's stepper into one fused scan over byte pairs."""
+        """(init, step(carry, b1, b2, t), finish). On the (universal
+        today) ``pad0_transparent`` banks this is the pair-composed sink
+        stepper: per byte PAIR, two independent row takes and one
+        composed update — no per-byte hit term, no ``hits`` carry, and
+        half the serial depth; matches park in sticky sink bits that
+        ``finish`` reads once (after one virtual padding pair, so rows
+        that fill every scanned byte sweep their last-byte completions
+        in). Non-transparent banks keep the gated per-byte path."""
+        if self.pad0_transparent:
+            return self._composed_pair_stepper(B)
+        return self._perbyte_pair_stepper(B, lengths)
+
+    def _composed_pair_stepper(self, B: int):
+        select = self._row_select
+        d0 = jnp.full((B, self.n_words), 0xFFFFFFFF, dtype=jnp.uint32)
+
+        def step(d, b1, b2, t):
+            m1 = select(b1)
+            m2 = select(b2)
+            m12 = (self._s1(m1) & self.start_clear[None, :]) | m2
+            cand = (self._s2(d) & self.c2[None, :]) | m12
+            # sinks (0 = a match parked there) persist; everywhere else
+            # the composed update stands
+            return cand & (d | self.not_sink[None, :])
+
+        def finish(d):
+            cand = (self._s2(d) & self.c2[None, :]) | self.pad_m12[None, :]
+            d = cand & (d | self.not_sink[None, :])
+            alive = (
+                jnp.take(d, jnp.asarray(self.snk_word), axis=1)
+                >> jnp.asarray(self.snk_bit)[None, :]
+            ) & 1  # [B, n_seqs * 2]; 0 = match parked in this sink
+            out = jnp.zeros((B, max(1, len(self.columns))), dtype=jnp.int32)
+            out = out.at[:, jnp.asarray(self.snk_slot)].max(
+                1 - alive.astype(jnp.int32)
+            )
+            return out.astype(bool)
+
+        return d0, step, finish
+
+    def _perbyte_pair_stepper(self, B: int, lengths: jax.Array):
+        """Per-byte gated fallback for banks whose padding byte is not
+        provably transparent (unreachable for banks built by this module
+        — the builder strips byte 0 — but kept as the correctness path
+        should a future builder admit it)."""
         select = self._row_select
         d0 = jnp.full((B, self.n_words), 0xFFFFFFFF, dtype=jnp.uint32)
         hits0 = jnp.zeros((B, self.n_words), dtype=jnp.uint32)
@@ -175,20 +297,7 @@ class ShiftOrBank:
         def one(carry, b, pos_ok):
             d, hits = carry
             m = select(b)
-            sh = (d << 1) & self.start_clear[None, :]
-            if self.has_chains:
-                # bit 31 of each chain word flows into bit 0 of the next
-                cr = jnp.concatenate(
-                    [jnp.zeros_like(d[:, :1]), d[:, :-1] >> 31], axis=1
-                )
-                sh = sh | (cr & self.cont_mask[None, :])
-            d_new = sh | m
-            if self.pad0_transparent:
-                # padding bytes saturate d_new to all-ones (mask[0] is
-                # all-ones), so end-bit hits past a line's end are
-                # impossible — no gating needed
-                hits = hits | ((~d_new) & self.end_mask[None, :])
-                return d_new, hits
+            d_new = (self._s1(d) & self.start_clear[None, :]) | m
             active = pos_ok[:, None]
             hits = jnp.where(
                 active, hits | ((~d_new) & self.end_mask[None, :]), hits
@@ -196,9 +305,6 @@ class ShiftOrBank:
             return jnp.where(active, d_new, d), hits
 
         def step(carry, b1, b2, t):
-            if self.pad0_transparent:
-                carry = one(carry, b1, None)
-                return one(carry, b2, None)
             p0 = 2 * t
             carry = one(carry, b1, p0 < lengths)
             return one(carry, b2, p0 + 1 < lengths)

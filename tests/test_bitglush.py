@@ -297,6 +297,62 @@ def test_fuzz_random_ascii():
     check_exact(regexes, lines)
 
 
+def test_sink_mode_full_width_lines():
+    """Sink-mode acceptance at the scan's last byte: a line that fills
+    every scanned byte (length == T, no padding inside the scan) relies
+    on finish()'s virtual padding step to sweep last-byte finals into
+    their sinks — both the plain and the ``$`` kind."""
+    regexes = [
+        ("Error$", False),
+        ("Error", False),
+        ("fail(ed)?$", False),
+        ("x[45]\\d$", False),
+    ]
+    # encode_lines pads T to a multiple of 32: 32-char lines are
+    # full-width rows, shorter ones see real in-scan padding
+    lines = [
+        "x" * 27 + "Error",          # 32 chars, Error at the very end
+        "Error" + "y" * 27,          # Error mid-line, 32 chars
+        "z" * 25 + "failed",         # 31 chars: one padding byte in scan
+        "q" * 26 + "failed",         # 32 chars, ends at T
+        "w" * 28 + "fail",           # optional group empty at line end
+        "v" * 29 + "x45",            # $ after class item, full width
+        "v" * 20 + "x45" + "z" * 9,  # same match mid-line: $ must miss
+        "",
+        "Error",
+    ]
+    entries = [
+        (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
+    ]
+    bank = BitGlushBank(entries)
+    assert bank.use_sinks
+    check_exact(regexes, lines)
+
+
+def test_sink_mode_skippable_cascade_into_sink():
+    """Finals that cascade back through a trailing skippable suffix all
+    reach the sink via the existing closure unrolling."""
+    regexes = [("ab?c?", False), ("de*", False), ("fg?$", False)]
+    lines = ["za", "zab", "zabc", "zd", "zdee", "zf", "zfg", "zfgh", "q"]
+    entries = [
+        (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
+    ]
+    assert BitGlushBank(entries).use_sinks
+    check_exact(regexes, lines)
+
+
+def test_trailing_boundary_bank_keeps_hits_path():
+    """A trailing \\b final is sink-ineligible: the bank keeps the
+    per-byte hit path and stays exact."""
+    regexes = [("Error\\b", False), ("plain", False)]
+    entries = [
+        (i, compile_bitprog_regex(rx, ci)) for i, (rx, ci) in enumerate(regexes)
+    ]
+    bank = BitGlushBank(entries)
+    assert not bank.use_sinks
+    check_exact(regexes, ["Error", "Errors", "xError", "plainly", "no"])
+
+
 def test_unsupported_shapes_rejected():
     for rx in [
         "(ab)+c",  # unbounded group repeat

@@ -70,6 +70,66 @@ def test_exactness_vs_host_re():
         )
 
 
+def _check_exact(regexes: list[str], lines: list[str]):
+    bank, hosts = _bank_for(regexes)
+    enc = encode_lines(lines)
+    got = np.asarray(bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths)))
+    for i, host in enumerate(hosts):
+        for j, line in enumerate(lines):
+            want = bool(host.search(line))
+            assert bool(got[j, i]) == want, (regexes[i], line)
+
+
+def test_sink_full_width_lines():
+    """Completions at the scan's very last byte rely on finish()'s
+    virtual padding pair to sweep the end bit into a sink — exercised by
+    lines that exactly fill the padded width (multiples of 32)."""
+    regexes = ["Error", "ab"]
+    lines = [
+        "x" * 27 + "Error",      # 32 chars, completion at byte 31
+        "x" * 59 + "Error",      # 64 chars, completion at byte 63
+        "x" * 26 + "Error" + "y",  # completion one byte before the end
+        "x" * 30 + "ab",         # 2-seq completion at full width
+        "x" * 31 + "a",          # suffix is only a prefix of the seq
+        "Error" + "z" * 27,      # completion early in a full-width row
+        "",
+    ]
+    _check_exact(regexes, lines)
+
+
+def test_sink_one_byte_sequences():
+    """m=1 sequences: start == end; the sink pair sits right after."""
+    _check_exact(["q", "[0-9]"], ["q", "zq", "3", "zzz3", "none", ""])
+
+
+def test_sink_31_32_length_sequences_chain():
+    """Lengths 31-32 now allocate 33-34 bits and ride cross-word chains;
+    exactness must survive the chain carry in both shift parities."""
+    s31 = "abcdefghijklmnopqrstuvwxyz01234"
+    s32 = s31 + "5"
+    bank, _ = _bank_for([s31, s32])
+    assert bank.has_chains
+    _check_exact(
+        [s31, s32],
+        [
+            s31, s32, "x" + s31, "xy" + s31, s31[:-1],
+            "x" * 30 + s32, s32 + "tail", s32[1:],
+        ],
+    )
+
+
+def test_sink_long_chain_sequences():
+    """>32-length sequences (multi-word chains) with the composed
+    stepper: carries cross two word boundaries."""
+    s62 = "A fatal error has been detected by the Java Runtime Environmen"
+    bank, _ = _bank_for([s62])
+    assert bank.has_chains
+    _check_exact(
+        [s62],
+        [s62, "x" + s62 + "y", s62[:-1] + "X", "pad " * 8 + s62, ""],
+    )
+
+
 def test_word_packing_isolates_neighbors():
     """Sequences packed into one word must not leak shift bits into each
     other: 'ab' and 'ba' share a word; 'aba' contains both, 'aa' neither."""
