@@ -30,11 +30,26 @@ Per consumed byte:
    breaks word-ness (checked one step later from the pre-update state,
    and at end-of-line against the final byte's word-ness).
 
-Positions are packed sequentially across 32-bit words (alternatives may
-span words — unlike Shift-Or there is no 32-position limit); stray
-cross-alternative shifts are harmless because every non-anchored start
-position is re-injected each step anyway, and anchored starts are
-explicitly blocked.
+Alternatives are first-fit word-packed (like Shift-Or): each
+alternative's allocation (its positions, plus one sink bit in sink
+mode) lives inside ONE 32-bit word whenever it fits, so the
+one-position shift needs NO cross-word carry and the whole carry op
+group (a concatenate per shift — a fusion breaker that measured 2.5x
+the chainless stepper on v5e, tools/probe_chainless.py) disappears
+from chain-free banks. Allocations over 32 bits take word-aligned
+runs of whole words and turn the bank-wide carry back on
+(``has_chains``) — ops/match.py keeps such alternatives out of the
+builtin-library bank by truncating primary-only columns (necessity-
+preserving, host-verified at assembly) and routing long literal
+columns to Shift-Or's chain path.
+
+Stray cross-allocation shifts are harmless by construction: within an
+allocation the shift is the intended advance; the bit leaking OUT of an
+allocation lands on the NEXT allocation's first bit — a start position
+(re-injected every byte anyway for ``find()`` restart semantics, or
+explicitly blocked when ``^``-anchored) — or on an unused fragmentation
+bit, whose ``bmask`` row is all-zero so the byte-class AND kills it the
+same step. A leaked bit therefore never travels more than one position.
 """
 
 from __future__ import annotations
@@ -76,23 +91,33 @@ class BitGlushBank:
     @staticmethod
     def alloc_positions(program) -> int:
         """Packed positions one program contributes: its Glushkov
-        positions plus one sink per alternative. THE single source of
-        the sink-packing arithmetic — ``count_packed_words``,
-        ``__init__``, and the tier budget gates in ops/match.py all
-        price programs through this. (On the rare sink-ineligible bank
-        the sinks go unallocated and the price is conservative.)"""
+        positions plus one sink per alternative. The tier budget gates
+        in ops/match.py price programs through this (a bits/32 floor —
+        first-fit fragmentation can pack a few words wider, which the
+        128-lane padding absorbs). On the rare sink-ineligible bank the
+        sinks go unallocated and the price is conservative."""
         return program.n_positions + len(program.alternatives)
+
+    @staticmethod
+    def _plan(allocs):
+        """First-fit packing plan over per-alternative allocation sizes
+        (:func:`~log_parser_tpu.ops.shiftor.first_fit_plan` — shared
+        with the Shift-Or tier; ``count_packed_words`` and ``__init__``
+        must agree)."""
+        from log_parser_tpu.ops.shiftor import first_fit_plan
+
+        return first_fit_plan(allocs)
+
+    @classmethod
+    def _alt_allocs(cls, programs) -> list[int]:
+        sink = 1 if cls.sink_eligible(programs) else 0
+        return [
+            a.n_positions + sink for p in programs for a in p.alternatives
+        ]
 
     @classmethod
     def count_packed_words(cls, programs) -> int:
-        """Sequential packing: positions sum / 32, rounded up — plus one
-        sink position per alternative on sink-eligible banks (the rule
-        ``__init__`` packs by; tier gates must agree with it)."""
-        if cls.sink_eligible(programs):
-            total = sum(cls.alloc_positions(p) for p in programs)
-        else:
-            total = sum(p.n_positions for p in programs)
-        return max(1, -(-total // 32))
+        return cls._plan(cls._alt_allocs(programs))[1]
 
     def __init__(self, column_programs: list[tuple[int, BitProgram]]):
         self.columns = [c for c, _ in column_programs]
@@ -107,12 +132,13 @@ class BitGlushBank:
         # same way), persistence rides ``s_static``, and the stepper
         # drops both per-byte hit ORs and the whole ``hits`` carry.
         self.use_sinks = self.sink_eligible(programs)
-        if self.use_sinks:
-            total = sum(self.alloc_positions(p) for p in programs)
-        else:
-            total = sum(p.n_positions for p in programs)
-        self.n_words = W = self.count_packed_words(programs)
-        self.n_positions = total
+        allocs = self._alt_allocs(programs)
+        alt_starts, self.n_words = self._plan(allocs)
+        W = self.n_words
+        self.n_positions = sum(allocs)
+        # any word-straddling allocation turns the bank-wide shift carry
+        # on; chain-free banks shift with a bare ``<< 1``
+        self.has_chains = any(a > 32 for a in allocs)
         self.max_skip_run = max(
             (p.max_skip_run for _, p in column_programs), default=0
         )
@@ -139,10 +165,10 @@ class BitGlushBank:
         def setbit(arr, g):
             arr[g // 32] |= np.uint32(1) << np.uint32(g % 32)
 
-        g = 0
+        alt_iter = iter(alt_starts)
         for slot, (_col, prog) in enumerate(column_programs):
             for alt in prog.alternatives:
-                base = g
+                base = g = next(alt_iter)
                 for j, item in enumerate(alt.items):
                     for byte in item.byteset:
                         # NUL never reaches the device scan as content
@@ -267,10 +293,15 @@ class BitGlushBank:
     # --------------------------------------------------------------- device
 
     def _shift1(self, d: jax.Array) -> jax.Array:
-        """One-position shift across the packed word stream: bit 31 of
-        word w carries into bit 0 of word w+1."""
+        """One-position shift. Chain-free banks (every allocation inside
+        one word — the first-fit invariant) shift with a bare ``<< 1``;
+        only banks holding a word-straddling allocation pay the carry.
+        The carry stays UNCONDITIONAL across all word boundaries (no
+        cont-mask): a carry landing outside a chained run hits the next
+        allocation's start bit (re-injected / caret-blocked anyway) or an
+        unused bit (killed by the ``bmask`` AND) — see module docstring."""
         sh = d << 1
-        if self.n_words > 1:
+        if self.has_chains and self.n_words > 1:
             carry = jnp.concatenate(
                 [jnp.zeros_like(d[:, :1]), d[:, :-1] >> 31], axis=1
             )

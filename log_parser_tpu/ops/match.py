@@ -533,6 +533,7 @@ class MatcherBanks:
         multi_min_columns: int | None = None,
         shiftor_max_words: int | None = None,
         bitglush_max_words: int | None = None,
+        shiftor_sinks: bool | None = None,
     ):
         import jax.numpy as jnp
 
@@ -572,6 +573,14 @@ class MatcherBanks:
         # — the second lane-tile doubles the heavy ~18-op bitglush chain
         # (cube 0.44s vs 0.27s split, config-2, v5e). Two banks, each one
         # tile, pay 18 + 8 op-tiles; that is the cheap shape (PERF.md §9).
+        # Shift-Or layout is platform-dependent (shiftor.py docstring):
+        # on TPU the take cost scales with gathered row width, so the
+        # bank packs bare (no sink bits) and accumulates hits per byte;
+        # on hosts the pair-composed sink stepper's halved serial chain
+        # wins, so the bank packs sinks (probe_sink_ab.py, PERF.md §9d)
+        self.shiftor_sinks = (
+            (not on_tpu) if shiftor_sinks is None else shiftor_sinks
+        )
         use_shiftor = n_device >= threshold
         # Word-budget gate (see SHIFTOR_MAX_WORDS): DFA-backed literal
         # columns only ride Shift-Or while the packed word count stays
@@ -584,14 +593,47 @@ class MatcherBanks:
             if shiftor_max_words is None
             else shiftor_max_words
         )
-        # DFA-backed columns with any sequence over 32 positions go to the
-        # dense pool instead of Shift-Or: chains would widen every
-        # Shift-Or take row (take cost ∝ row width — 81→114 words
-        # measured 0.088→0.154 s), while inside bitglush's lane-padded
-        # chain the extra positions are ~free. Chains still serve
-        # DFA-less literal columns, whose only device tier this is.
+        # DFA-backed columns with any sequence over 32 positions stay
+        # off Shift-Or BY DEFAULT: chains would widen every Shift-Or
+        # take row (take cost ∝ row width — 81→114 words measured
+        # 0.088→0.154 s). Two exceptions ride its cont-mask chains
+        # anyway: DFA-less literal columns (their only device tier) and
+        # _chain_literal columns below (long literals in secondary/
+        # sequence/context roles, where bitglush truncation would be
+        # unsound — a couple of words of width beats re-chaining the
+        # whole bitglush bank, PERF.md §9d).
         def _short_seqs(c) -> bool:
             return all(len(s) <= 32 for s in c.exact_seqs)
+
+        # Column roles. A cube column may serve several patterns and
+        # roles; bitglush's truncation of >31-position alternatives
+        # (over-approximate device match + exact host re-verify of the
+        # flagged EVENTS at assembly, runtime/engine.py) is only sound
+        # for columns used EXCLUSIVELY as primaries — a secondary /
+        # sequence / context false positive would silently shift the
+        # proximity / temporal / context factors extracted on device.
+        # Long-literal columns in other roles ride Shift-Or's cont-mask
+        # chain path instead (a couple of words of take-row width);
+        # anything long, non-literal, and non-primary-only keeps its
+        # exact chained bitglush allocation (has_chains — correct,
+        # slower, absent from the builtin library).
+        from log_parser_tpu.patterns.bank import CTX_EXCEPTION
+
+        primary_only = set(int(c) for c in bank.primary_columns)
+        primary_only -= {s.column for s in bank.secondaries}
+        primary_only -= {
+            c for e in bank.sequences for c in e.event_columns
+        }
+        primary_only -= set(range(CTX_EXCEPTION + 1))
+
+        def _chain_literal(i, c) -> bool:
+            # long-literal column that may NOT be truncated: its exact
+            # home is the Shift-Or chain path
+            return (
+                c.exact_seqs is not None
+                and not _short_seqs(c)
+                and i not in primary_only
+            )
 
         if use_shiftor:
             # count the whole candidate bank, INCLUDING the DFA-less
@@ -602,12 +644,17 @@ class MatcherBanks:
             n_words = ShiftOrBank.count_packed_words(
                 (
                     len(seq)
-                    for c in bank.columns
+                    for i, c in enumerate(bank.columns)
                     if c.exact_seqs is not None
-                    and (c.dfa is None or _short_seqs(c))
+                    and (
+                        c.dfa is None
+                        or _short_seqs(c)
+                        or _chain_literal(i, c)
+                    )
                     for seq in c.exact_seqs
                 ),
                 budget=word_budget,
+                sinks=self.shiftor_sinks,
             )
             if n_words > word_budget:
                 use_shiftor = False
@@ -615,7 +662,10 @@ class MatcherBanks:
             i
             for i, c in enumerate(bank.columns)
             if c.exact_seqs is not None
-            and ((use_shiftor and _short_seqs(c)) or c.dfa is None)
+            and (
+                (use_shiftor and (_short_seqs(c) or _chain_literal(i, c)))
+                or c.dfa is None
+            )
         ]
         shiftor_set = set(self.shiftor_cols)
         dense_cols = [
@@ -671,6 +721,7 @@ class MatcherBanks:
             compile_bitprog_regex,
             expand_asserts,
             has_asserts,
+            truncate_long_alternatives,
         )
 
         bit_entries: list[tuple[int, object]] = []
@@ -706,6 +757,30 @@ class MatcherBanks:
                 BitGlushBank.alloc_positions(p) for _, p in expanded
             ) <= 32 * bit_budget:
                 bit_entries = expanded
+        # Truncate >31-position alternatives of primary-only columns so
+        # their allocations (alternative + sink bit) fit one word and
+        # the bank stays on the chainless shift (the carry's concat per
+        # shift measured 2.5x the chainless stepper on v5e —
+        # tools/probe_chainless.py). The truncated column OVER-matches;
+        # the engine re-verifies its rare flagged events with the exact
+        # host regex at assembly (runtime/engine.py, approx_cols).
+        # Non-truncatable long programs stay exact and keep the carry.
+        max_items = 32 - (1 if BitGlushBank.sink_eligible(
+            [p for _, p in bit_entries]
+        ) else 0)
+        approx: list[int] = []
+        truncated_entries: list[tuple[int, object]] = []
+        for i, p in bit_entries:
+            if i in primary_only and any(
+                a.n_positions > max_items for a in p.alternatives
+            ):
+                cut = truncate_long_alternatives(p, max_items)
+                if cut is not None:
+                    p = cut[0]
+                    approx.append(i)
+            truncated_entries.append((i, p))
+        bit_entries = truncated_entries
+        self.approx_cols = approx
         # ONE bank for all bit programs. A measured A/B split the
         # assert-free programs into their own light bank (no word-ness /
         # allow / caret work): cube 0.31 → 0.39s on v5e — the asserted
@@ -787,7 +862,8 @@ class MatcherBanks:
         )
         self.shiftor = (
             ShiftOrBank(
-                [(i, bank.columns[i].exact_seqs) for i in self.shiftor_cols]
+                [(i, bank.columns[i].exact_seqs) for i in self.shiftor_cols],
+                sinks=self.shiftor_sinks,
             )
             if self.shiftor_cols
             else None

@@ -68,55 +68,80 @@ import numpy as np
 ByteSeq = tuple  # tuple[frozenset[int], ...]
 
 
+def first_fit_plan(allocs, budget: int | None = None):
+    """First-fit word-packing plan shared by the two bit tiers — THE
+    single source of the packing rule (tier gates that estimate word
+    cost must agree with the bank constructors). Allocations ≤ 32 bits
+    first-fit within any word, never straddling one (the chainless-shift
+    invariant both banks rely on); larger allocations take word-aligned
+    runs of whole words whose tail remainder stays open to first-fit.
+    Returns (per-allocation start bits, n_words); with a ``budget``,
+    bails early once the word count exceeds it."""
+    starts: list[int] = []
+    word_fill: list[int] = []
+    for alloc in allocs:
+        if alloc > 32:
+            w0 = len(word_fill)
+            nw = (alloc + 31) // 32
+            starts.append(w0 * 32)
+            word_fill.extend([32] * (nw - 1))
+            word_fill.append(alloc - 32 * (nw - 1))
+        else:
+            w = next(
+                (i for i, used in enumerate(word_fill) if used + alloc <= 32),
+                None,
+            )
+            if w is None:
+                w = len(word_fill)
+                word_fill.append(0)
+            starts.append(w * 32 + word_fill[w])
+            word_fill[w] += alloc
+        if budget is not None and len(word_fill) > budget:
+            return starts, len(word_fill)
+    return starts, max(1, len(word_fill))
+
+
 class ShiftOrBank:
     """Packed Shift-Or program for a set of (column, sequences) entries."""
 
     @staticmethod
-    def _plan(seq_lengths, budget: int | None = None):
-        """Packing plan — THE single source of the packing rule (tier
-        gates that estimate word cost must agree with ``__init__``).
-        Every sequence's allocation is its length + 2 *sink* bits (the
-        sticky match flags the pair-composed stepper reads at scan end).
-        Allocations >32 bits take fresh word-aligned runs (cross-word
-        chains) whose tail remainder stays open to first-fit; the rest
-        first-fit within any word. Returns (global start bits, n_words);
-        with a ``budget``, bails early once the count exceeds it."""
-        starts: list[int] = []
-        word_fill: list[int] = []
-        for m in seq_lengths:
-            alloc = m + 2
-            if alloc > 32:
-                w0 = len(word_fill)
-                nw = (alloc + 31) // 32
-                starts.append(w0 * 32)
-                word_fill.extend([32] * (nw - 1))
-                word_fill.append(alloc - 32 * (nw - 1))
-            else:
-                w = next(
-                    (
-                        i
-                        for i, used in enumerate(word_fill)
-                        if used + alloc <= 32
-                    ),
-                    None,
-                )
-                if w is None:
-                    w = len(word_fill)
-                    word_fill.append(0)
-                starts.append(w * 32 + word_fill[w])
-                word_fill[w] += alloc
-            if budget is not None and len(word_fill) > budget:
-                return starts, len(word_fill)
-        return starts, max(1, len(word_fill))
+    def _plan(seq_lengths, budget: int | None = None, sinks: bool = True):
+        """Packing plan via :func:`first_fit_plan`. With ``sinks`` every
+        sequence's allocation is its length + 2 *sink* bits (the sticky
+        match flags the pair-composed stepper reads at scan end);
+        without, exactly its length (the hits-carry steppers need no
+        extra state)."""
+        return first_fit_plan(
+            ((m + 2 if sinks else m) for m in seq_lengths), budget
+        )
 
     @classmethod
-    def count_packed_words(cls, seq_lengths, budget: int | None = None) -> int:
-        return cls._plan(seq_lengths, budget)[1]
+    def count_packed_words(
+        cls, seq_lengths, budget: int | None = None, sinks: bool = True
+    ) -> int:
+        return cls._plan(seq_lengths, budget, sinks=sinks)[1]
 
-    def __init__(self, column_seqs: list[tuple[int, tuple[ByteSeq, ...]]]):
+    def __init__(
+        self,
+        column_seqs: list[tuple[int, tuple[ByteSeq, ...]]],
+        sinks: bool = True,
+    ):
+        """``sinks`` picks the bank layout AND the stepper family:
+        True (the CPU default) packs two sticky sink bits per sequence
+        and steps with the pair-composed sink recurrence — the fastest
+        form on hosts, where the halved serial chain dominates. False
+        (the TPU default, chosen by MatcherBanks) packs sequences
+        bare and accumulates hits per byte — on v5e the take cost
+        scales with the gathered row width, so the ~12% narrower table
+        beats the halved chain (tools/probe_sink_ab.py, PERF.md §9d:
+        0.082 s vs 0.112 s at config-2 shapes; on CPU the same A/B
+        reads 0.253 s vs 0.151 s the other way)."""
         self.columns = [c for c, _ in column_seqs]
+        self.sinks = sinks
         flat = [(col, seq) for col, seqs in column_seqs for seq in seqs]
-        starts, self.n_words = self._plan([len(seq) for _, seq in flat])
+        starts, self.n_words = self._plan(
+            [len(seq) for _, seq in flat], sinks=sinks
+        )
         self.n_seqs = len(flat)
 
         # mask[c, w]: bit (o+j) = 1 iff byte c not allowed at position j;
@@ -145,15 +170,18 @@ class ShiftOrBank:
                     # pad0_transparent holds for every bank
                     if c != 0:
                         mask[c, p // 32] &= ~bit
-            for p in (g + len(seq), g + len(seq) + 1):  # the two sinks
-                bit = np.uint32(1 << (p % 32))
-                mask[:, p // 32] &= ~bit
-                sink_mask[p // 32] |= bit
-                snk_word.append(p // 32)
-                snk_bit.append(p % 32)
+            if sinks:
+                for p in (g + len(seq), g + len(seq) + 1):  # the two sinks
+                    bit = np.uint32(1 << (p % 32))
+                    mask[:, p // 32] &= ~bit
+                    sink_mask[p // 32] |= bit
+                    snk_word.append(p // 32)
+                    snk_bit.append(p % 32)
             # chain continuation words receive shift carry from their
-            # predecessor (the allocation spans len + 2 sink bits)
-            for w in range(g // 32 + 1, (g + len(seq) + 1) // 32 + 1):
+            # predecessor (the allocation spans the sequence positions
+            # plus, in sink layout, the 2 sink bits)
+            last_p = g + len(seq) - 1 + (2 if sinks else 0)
+            for w in range(g // 32 + 1, last_p // 32 + 1):
                 cont_mask[w] |= np.uint32(1)
             e = g + len(seq) - 1
             end_mask[e // 32] |= np.uint32(1 << (e % 32))
@@ -182,12 +210,13 @@ class ShiftOrBank:
                 & cont_mask
             )
         )
-        self.c2 = jnp.asarray(np1(start_clear) & start_clear)
-        self.cont2_mask = jnp.asarray(cont_mask * np.uint32(3))
-        self.not_sink = jnp.asarray(~sink_mask)
-        # the virtual padding pair finish() applies for full-width rows:
-        # both bytes are padding, so the m-term is a constant
-        self.pad_m12 = jnp.asarray(np1(mask[0]) & start_clear | mask[0])
+        if sinks:
+            self.c2 = jnp.asarray(np1(start_clear) & start_clear)
+            self.cont2_mask = jnp.asarray(cont_mask * np.uint32(3))
+            self.not_sink = jnp.asarray(~sink_mask)
+            # the virtual padding pair finish() applies for full-width
+            # rows: both bytes are padding, so the m-term is a constant
+            self.pad_m12 = jnp.asarray(np1(mask[0]) & start_clear | mask[0])
         self.snk_word = np.asarray(snk_word, dtype=np.int32)
         self.snk_bit = np.asarray(snk_bit, dtype=np.int32)
         # The hit term is ``hits |= (~d_new) & end_mask`` and
@@ -246,16 +275,58 @@ class ShiftOrBank:
 
     def pair_stepper(self, B: int, lengths: jax.Array):
         """(init, step(carry, b1, b2, t), finish). On the (universal
-        today) ``pad0_transparent`` banks this is the pair-composed sink
-        stepper: per byte PAIR, two independent row takes and one
-        composed update — no per-byte hit term, no ``hits`` carry, and
-        half the serial depth; matches park in sticky sink bits that
+        today) ``pad0_transparent`` banks, sink layout steps with the
+        pair-composed sink recurrence — per byte PAIR, two independent
+        row takes and one composed update, no per-byte hit term, half
+        the serial depth; matches park in sticky sink bits that
         ``finish`` reads once (after one virtual padding pair, so rows
         that fill every scanned byte sweep their last-byte completions
-        in). Non-transparent banks keep the gated per-byte path."""
+        in). Bare layout steps per byte with an ungated hits carry
+        (sound because a padding byte sets every end bit in ``d``, so
+        past-end positions can never contribute a hit). Non-transparent
+        banks keep the gated per-byte path."""
         if self.pad0_transparent:
-            return self._composed_pair_stepper(B)
+            if self.sinks:
+                return self._composed_pair_stepper(B)
+            return self._ungated_hits_stepper(B)
         return self._perbyte_pair_stepper(B, lengths)
+
+    def _ungated_hits_stepper(self, B: int):
+        """Per-byte hits accumulation with NO length gating — the TPU
+        form: one [256, W] row take plus four [B, W] vector ops per
+        byte on the narrowest possible rows (no sink bits)."""
+        select = self._row_select
+        sc = self.start_clear[None, :]
+        e = self.end_mask[None, :]
+        d0 = jnp.full((B, self.n_words), 0xFFFFFFFF, dtype=jnp.uint32)
+        h0 = jnp.zeros((B, self.n_words), dtype=jnp.uint32)
+
+        def one(carry, b):
+            d, hits = carry
+            d = (self._s1(d) & sc) | select(b)
+            return d, hits | ((~d) & e)
+
+        def step(carry, b1, b2, t):
+            return one(one(carry, b1), b2)
+
+        def finish(carry):
+            _, hits = carry
+            return self.columns_from_hits(hits)
+
+        return (d0, h0), step, finish
+
+    def columns_from_hits(self, hits: jax.Array) -> jax.Array:
+        """uint32 [N, W] accumulated hit words -> bool [N, n_columns]."""
+        N = hits.shape[0]
+        seq_hit = (
+            jnp.take(hits, jnp.asarray(self.seq_word), axis=1)
+            >> jnp.asarray(self.seq_bit)[None, :]
+        ) & 1  # [N, n_seqs]
+        out = jnp.zeros((N, max(1, len(self.columns))), dtype=jnp.int32)
+        out = out.at[:, jnp.asarray(self.seq_slot)].max(
+            seq_hit.astype(jnp.int32)
+        )
+        return out.astype(bool)
 
     def _composed_pair_stepper(self, B: int):
         select = self._row_select
@@ -311,15 +382,7 @@ class ShiftOrBank:
 
         def finish(carry):
             _, hits = carry
-            seq_hit = (
-                jnp.take(hits, jnp.asarray(self.seq_word), axis=1)
-                >> jnp.asarray(self.seq_bit)[None, :]
-            ) & 1  # [B, n_seqs]
-            out = jnp.zeros((B, max(1, len(self.columns))), dtype=jnp.int32)
-            out = out.at[:, jnp.asarray(self.seq_slot)].max(
-                seq_hit.astype(jnp.int32)
-            )
-            return out.astype(bool)
+            return self.columns_from_hits(hits)
 
         return (d0, hits0), step, finish
 

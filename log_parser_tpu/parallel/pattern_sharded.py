@@ -107,6 +107,21 @@ class PatternShardedEngine(AnalysisEngine):
             f"({offset} != {self.bank.n_patterns})"
         )
 
+    def _approx_col_sources(self):
+        """Each block's device program truncates against its OWN bank
+        (role sets are computed per block, so a column primary-only in
+        one block may stay exact in another); union every block's
+        (approx_cols, bank, global pattern offset) so flagged events of
+        any block get host-verified."""
+        out = []
+        offset = 0
+        for fused, _global_idx, _dev in self._block_engines:
+            out.append(
+                (getattr(fused.matchers, "approx_cols", []), fused.bank, offset)
+            )
+            offset += fused.bank.n_patterns
+        return out
+
     def _block_overrides(self, fused: FusedMatchScore, om, ov):
         """Overrides index the FULL bank's columns; each block re-derives
         its slice by interned regex key."""

@@ -429,5 +429,42 @@ def expand_asserts(prog: BitProgram) -> BitProgram:
     return out
 
 
+def truncate_long_alternatives(
+    prog: BitProgram, max_items: int
+) -> tuple[BitProgram, bool] | None:
+    """Cut every alternative longer than ``max_items`` down to its first
+    ``max_items`` items, dropping its post-assertion.
+
+    The truncated program *over-approximates* the original: a line the
+    full alternative matches always contains a match of its item prefix
+    (each of the first ``max_items`` items was consumed or skipped at
+    the same place, and ``final_positions`` cascading covers a skipped
+    tail), and dropping ``$``/``\b`` post-assertions only weakens the
+    condition further. Callers therefore MUST re-verify every flagged
+    line with the exact host regex (runtime/engine.py does, per event
+    at assembly) — used so long alternatives never force the packed
+    bank onto the cross-word chain path (ops/bitglush.py).
+
+    Returns (program, changed). Returns None when some long
+    alternative's prefix would be all-skippable — a truncated program
+    that matches EVERY line selects the whole corpus for host
+    verification, which is worse than keeping the exact chain path.
+    """
+    alts: list[BitAlternative] = []
+    changed = False
+    for a in prog.alternatives:
+        if a.n_positions <= max_items:
+            alts.append(a)
+            continue
+        head = a.items[:max_items]
+        if all(it.skippable for it in head):
+            return None
+        alts.append(
+            BitAlternative(items=tuple(head), caret=a.caret, post_assert=None)
+        )
+        changed = True
+    return BitProgram(alternatives=tuple(alts)), changed
+
+
 def compile_bitprog_regex(regex: str, case_insensitive: bool) -> BitProgram:
     return compile_bitprog(parse_java_regex(regex, case_insensitive))

@@ -317,6 +317,7 @@ class AnalysisEngine:
             float(os.environ.get("LOG_PARSER_TPU_DEVICE_TIMEOUT_S", "0"))
         )
         self._k_hint = 0  # previous request's match count → starting K bucket
+        self._approx_pat_mask = None  # lazy — see _approx_patterns
         # serializes frequency-coupled state (finish phase, admin routes,
         # golden fallback) across transports; the prepare phase (ingest +
         # device) deliberately runs OUTSIDE it — see analyze_pipelined
@@ -415,6 +416,65 @@ class AnalysisEngine:
     # ----------------------------------------------------- device-step hooks
     # ShardedEngine overrides these two to swap in the shard_map program;
     # everything else in analyze() is shared.
+
+    def _approx_patterns(self) -> np.ndarray:
+        """bool [n_patterns]: patterns whose device-side primary column
+        OVER-matches (truncated >31-position bitglush alternatives —
+        ops/match.py approx_cols) and whose flagged events must be
+        re-verified with the exact host regex before they count."""
+        if self._approx_pat_mask is None:
+            mask = np.zeros(max(1, self.bank.n_patterns), dtype=bool)
+            for cols, bank, offset in self._approx_col_sources():
+                if not cols:
+                    continue
+                cset = set(cols)
+                for p in range(bank.n_patterns):
+                    if int(bank.primary_columns[p]) in cset:
+                        mask[offset + p] = True
+            self._approx_pat_mask = mask
+        return self._approx_pat_mask
+
+    def _approx_col_sources(self):
+        """(approx_cols, bank, global pattern offset) triples —
+        overridden by engines whose device programs run on different
+        banks (pattern sharding)."""
+        return [(getattr(self.matchers, "approx_cols", []), self.bank, 0)]
+
+    def _verify_approx(self, corpus: Corpus, recs):
+        """Drop device match records whose (approximate) primary column
+        flagged a line the exact host regex rejects. Runs in ``_prepare``
+        — OUTSIDE the serialization lock — and before the frequency read,
+        so counts, scores, ordering, and assembly all see exactly the
+        reference's match set (AnalysisService.java:93-95 semantics)."""
+        m = recs.n_matches
+        mask = self._approx_patterns()
+        if m == 0 or not mask.any():
+            return recs
+        pat = recs.pattern[:m].astype(np.int64)
+        cand = np.nonzero(mask[pat])[0]
+        if cand.size == 0:
+            return recs
+        keep = np.ones(m, dtype=bool)
+        for i in cand:
+            col = self.bank.columns[
+                int(self.bank.primary_columns[int(pat[i])])
+            ]
+            keep[i] = (
+                col.host.search(corpus.line(int(recs.line[i]))) is not None
+            )
+        if keep.all():
+            return recs
+        import dataclasses
+
+        return dataclasses.replace(
+            recs,
+            n_matches=int(keep.sum()),
+            line=recs.line[:m][keep],
+            pattern=recs.pattern[:m][keep],
+            sec_dist=recs.sec_dist[:m][keep],
+            seq_ok=recs.seq_ok[:m][keep],
+            ctx_counts=recs.ctx_counts[:m][keep],
+        )
 
     def _corpus_min_rows(self) -> int:
         return 8
@@ -519,6 +579,11 @@ class AnalysisEngine:
             recs = self.watchdog.run(
                 lambda: self._run_device(enc, corpus.n_lines, om, ov)
             )
+        # capacity hint tracks the RAW device match count (the buffer the
+        # device actually needs), before approx verification drops rows
+        self._k_hint = recs.n_matches
+        with trace.phase("verify"):
+            recs = self._verify_approx(corpus, recs)
         return _Prepared(start, trace, corpus, recs)
 
     def _finish(self, prepared: "_Prepared") -> AnalysisResult:
@@ -532,8 +597,6 @@ class AnalysisEngine:
             prepared.corpus,
             prepared.recs,
         )
-        self._k_hint = recs.n_matches
-
         # windowed frequency counts at batch start (pruned by the tracker);
         # "entry exists" is tracked separately — an expired window still has
         # an entry and takes the formula path, not the null early-return

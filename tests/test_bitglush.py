@@ -588,3 +588,157 @@ def test_word_count():
     assert BitGlushBank.count_packed_words(progs) == BitGlushBank(
         list(enumerate(progs))
     ).n_words
+
+
+# ---------------------------------------------------- truncation + verify
+
+
+def test_first_fit_packing_never_straddles():
+    """The packing invariant the chainless shift relies on: every ≤32-bit
+    allocation is placed INSIDE one word (start%32 + alloc ≤ 32), and
+    >32-bit allocations start word-aligned."""
+    progs = [compile_bitprog_regex(rx, ci) for rx, ci in FEATURES]
+    allocs = BitGlushBank._alt_allocs(progs)
+    starts, n_words = BitGlushBank._plan(allocs)
+    assert len(starts) == len(allocs)
+    for s, a in zip(starts, allocs):
+        if a <= 32:
+            assert s % 32 + a <= 32, (s, a)
+        else:
+            assert s % 32 == 0, (s, a)
+        assert s + a <= n_words * 32
+
+
+def test_chained_bank_exact_vs_host_re():
+    """A bank holding a >32-position alternative (word-straddling
+    allocation → has_chains → conditional carry) must stay exact —
+    including co-packed short, caret, and skip programs sharing the
+    bank, and matches crossing both word boundaries of the chain."""
+    long_rx = "could not connect to server: Connection refused no retry"
+    regexes = [
+        (long_rx, False),
+        ("^anchored", False),
+        ("time.?out", False),
+        ("x\\d+y", False),
+    ]
+    progs = [compile_bitprog_regex(rx, ci) for rx, ci in regexes]
+    bank = BitGlushBank(list(enumerate(progs)))
+    assert bank.has_chains and bank.n_words >= 3
+    rng = random.Random(7)
+    alphabet = "cold nt sever:Cfu Retry anhItime-outx123y "
+    lines = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 70)))
+        for _ in range(200)
+    ] + [
+        long_rx,                       # exact
+        "zz " + long_rx + " tail",     # offset: chain restart mid-line
+        long_rx[:-1],                  # one short: no match
+        long_rx[:33] + "X" + long_rx[34:],  # broken at the word boundary
+        "anchored here",               # caret at start
+        "not anchored here",           # caret unmet
+        "a timeout b",
+        "x42y",
+        "",
+    ]
+    check_exact(regexes, lines)
+
+
+def test_truncated_primary_column_engine_exact():
+    """End-to-end: a primary-only column whose long alternative is
+    truncated on device must still produce EXACTLY the reference's
+    events — the engine re-verifies flagged lines with the host regex
+    and drops prefix-only false positives before scoring, frequency
+    recording, and assembly."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden.engine import GoldenAnalyzer
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    long_lit = "Connection is not available, request timed out after"
+    sets = [
+        make_pattern_set(
+            [
+                make_pattern("plong", regex=long_lit, confidence=0.9),
+                make_pattern("pshort", regex="timed out", confidence=0.5),
+            ]
+        )
+    ]
+    logs = "\n".join(
+        [
+            f"{long_lit} 30000ms",        # true match (both patterns)
+            "Connection is not available, request timed out",  # prefix only
+            "request timed out again",     # short pattern only
+            "clean line",
+        ]
+    )
+    data = PodFailureData(logs=logs)
+
+    engine = AnalysisEngine(sets, ScoringConfig())
+    # force the TPU tier policy on the CPU test backend so the long
+    # alternative actually rides (truncated) bitglush
+    engine._matchers = MatcherBanks(
+        engine.bank,
+        bitglush_max_words=192,
+        shiftor_min_columns=10**9,
+        prefilter_min_columns=10**9,
+        multi_min_columns=10**9,
+    )
+    mb = engine.matchers
+    long_col = next(
+        i for i, c in enumerate(engine.bank.columns) if c.regex == long_lit
+    )
+    assert long_col in mb.approx_cols
+
+    got = engine.analyze(data)
+    want = GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+    assert [e.line_number for e in got.events] == [
+        e.line_number for e in want.events
+    ]
+    assert [e.matched_pattern.id for e in got.events] == [
+        e.matched_pattern.id for e in want.events
+    ]
+    for g, w in zip(got.events, want.events):
+        assert abs(g.score - w.score) < 1e-9
+    # the false positive line (prefix only) produced no plong event
+    assert all(
+        not (e.matched_pattern.id == "plong" and e.line_number == 2)
+        for e in got.events
+    )
+
+
+def test_truncation_skips_non_primary_roles():
+    """A long-literal column also used as a SECONDARY must not be
+    truncated (device factors read it) — it routes to Shift-Or's chain
+    path instead and stays exact in the cube."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.models.pattern import SecondaryPattern
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    long_lit = "Back-off restarting failed container"
+    p1 = make_pattern("p1", regex="primary thing", confidence=0.5)
+    p1.secondary_patterns = [
+        SecondaryPattern(regex=long_lit, weight=0.5, proximity_window=5)
+    ]
+    p2 = make_pattern("p2", regex=long_lit, confidence=0.5)
+    bank = PatternBank([make_pattern_set([p1, p2])])
+    mb = MatcherBanks(
+        bank,
+        bitglush_max_words=192,
+        shiftor_min_columns=1,
+    )
+    col = next(i for i, c in enumerate(bank.columns) if c.regex == long_lit)
+    # not truncated anywhere
+    assert col not in mb.approx_cols
+    # rides the Shift-Or chain path, exact
+    assert col in mb.shiftor_cols
+    assert mb.shiftor.has_chains
+    lines = [long_lit, long_lit[:-1], "x " + long_lit + " y", ""]
+    enc = encode_lines(lines)
+    got = np.asarray(
+        mb.cube(jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths))
+    )[: len(lines), col]
+    np.testing.assert_array_equal(got, [True, False, True, False])

@@ -27,7 +27,9 @@ REGEXES = [
 ]
 
 
-def _bank_for(regexes: list[str]) -> tuple[ShiftOrBank, list[re.Pattern]]:
+def _bank_for(
+    regexes: list[str], sinks: bool = True
+) -> tuple[ShiftOrBank, list[re.Pattern]]:
     entries = []
     hosts = []
     for i, rx in enumerate(regexes):
@@ -35,11 +37,17 @@ def _bank_for(regexes: list[str]) -> tuple[ShiftOrBank, list[re.Pattern]]:
         assert seqs is not None, rx
         entries.append((i, seqs))
         hosts.append(compile_java_regex(rx))
-    return ShiftOrBank(entries), hosts
+    return ShiftOrBank(entries, sinks=sinks), hosts
 
 
-def test_exactness_vs_host_re():
-    bank, hosts = _bank_for(REGEXES)
+BOTH_LAYOUTS = pytest.mark.parametrize(
+    "sinks", [True, False], ids=["sinks", "bare"]
+)
+
+
+@BOTH_LAYOUTS
+def test_exactness_vs_host_re(sinks):
+    bank, hosts = _bank_for(REGEXES, sinks)
     rng = random.Random(11)
     alphabet = "aAbx45 GCgcOutfMemoryErrConnectionRefusedTimeoutcodestatus=d019"
     lines = [
@@ -70,8 +78,8 @@ def test_exactness_vs_host_re():
         )
 
 
-def _check_exact(regexes: list[str], lines: list[str]):
-    bank, hosts = _bank_for(regexes)
+def _check_exact(regexes: list[str], lines: list[str], sinks: bool = True):
+    bank, hosts = _bank_for(regexes, sinks)
     enc = encode_lines(lines)
     got = np.asarray(bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths)))
     for i, host in enumerate(hosts):
@@ -80,7 +88,8 @@ def _check_exact(regexes: list[str], lines: list[str]):
             assert bool(got[j, i]) == want, (regexes[i], line)
 
 
-def test_sink_full_width_lines():
+@BOTH_LAYOUTS
+def test_sink_full_width_lines(sinks):
     """Completions at the scan's very last byte rely on finish()'s
     virtual padding pair to sweep the end bit into a sink — exercised by
     lines that exactly fill the padded width (multiples of 32)."""
@@ -94,46 +103,52 @@ def test_sink_full_width_lines():
         "Error" + "z" * 27,      # completion early in a full-width row
         "",
     ]
-    _check_exact(regexes, lines)
+    _check_exact(regexes, lines, sinks)
 
 
-def test_sink_one_byte_sequences():
+@BOTH_LAYOUTS
+def test_sink_one_byte_sequences(sinks):
     """m=1 sequences: start == end; the sink pair sits right after."""
-    _check_exact(["q", "[0-9]"], ["q", "zq", "3", "zzz3", "none", ""])
+    _check_exact(["q", "[0-9]"], ["q", "zq", "3", "zzz3", "none", ""], sinks)
 
 
-def test_sink_31_32_length_sequences_chain():
+@BOTH_LAYOUTS
+def test_sink_31_32_length_sequences_chain(sinks):
     """Lengths 31-32 now allocate 33-34 bits and ride cross-word chains;
     exactness must survive the chain carry in both shift parities."""
     s31 = "abcdefghijklmnopqrstuvwxyz01234"
     s32 = s31 + "5"
-    bank, _ = _bank_for([s31, s32])
-    assert bank.has_chains
+    bank, _ = _bank_for([s31, s32], sinks)
+    assert bank.has_chains or not sinks
     _check_exact(
         [s31, s32],
         [
             s31, s32, "x" + s31, "xy" + s31, s31[:-1],
             "x" * 30 + s32, s32 + "tail", s32[1:],
         ],
+        sinks,
     )
 
 
-def test_sink_long_chain_sequences():
+@BOTH_LAYOUTS
+def test_sink_long_chain_sequences(sinks):
     """>32-length sequences (multi-word chains) with the composed
     stepper: carries cross two word boundaries."""
     s62 = "A fatal error has been detected by the Java Runtime Environmen"
-    bank, _ = _bank_for([s62])
+    bank, _ = _bank_for([s62], sinks)
     assert bank.has_chains
     _check_exact(
         [s62],
         [s62, "x" + s62 + "y", s62[:-1] + "X", "pad " * 8 + s62, ""],
+        sinks,
     )
 
 
-def test_word_packing_isolates_neighbors():
+@BOTH_LAYOUTS
+def test_word_packing_isolates_neighbors(sinks):
     """Sequences packed into one word must not leak shift bits into each
     other: 'ab' and 'ba' share a word; 'aba' contains both, 'aa' neither."""
-    bank, _ = _bank_for(["ab", "ba"])
+    bank, _ = _bank_for(["ab", "ba"], sinks)
     assert bank.n_words == 1
     enc = encode_lines(["aba", "aa", "ab", "ba", ""])
     got = np.asarray(bank._run(np.asarray(enc.u8.T), np.asarray(enc.lengths)))
@@ -142,7 +157,8 @@ def test_word_packing_isolates_neighbors():
     )
 
 
-def test_cross_word_chain_sequences():
+@BOTH_LAYOUTS
+def test_cross_word_chain_sequences(sinks):
     """Sequences longer than 32 positions span words via the carry chain
     (cont_mask): exactness at every boundary-straddling offset, no leak
     into co-packed short sequences, correct restart mid-line."""
@@ -153,7 +169,7 @@ def test_cross_word_chain_sequences():
         (1, (tuple(frozenset([ord("b")]) for _ in range(33)),)),
         (2, (tuple(frozenset([ord(c)]) for c in "xy"),)),
     ]
-    bank = ShiftOrBank(entries)
+    bank = ShiftOrBank(entries, sinks=sinks)
     assert bank.has_chains and bank.n_words >= 3
     lines = [
         long_a,                       # exact
@@ -176,11 +192,14 @@ def test_cross_word_chain_sequences():
         )
 
 
-def test_mixed_literal_alternation_column_exact():
-    """A column mixing long pure-literal alternatives with a \\d+
-    alternative is not exact-sequence eligible, so with the bit tier on
-    it rides bitglush whole; the cube must equal host re on every
-    alternative, including the >32-char literal."""
+def test_mixed_literal_alternation_column_truncated_superset():
+    """A primary-only column mixing a >31-position literal alternative
+    with a \\d+ alternative rides bitglush TRUNCATED (the long
+    alternative is cut so the bank stays chainless): the cube must be a
+    SUPERSET of host re — exact on every short alternative, and exactly
+    the 31-item prefix condition on the long one — and the column must
+    be flagged in ``approx_cols`` so the engine re-verifies its events
+    (tests/test_bitglush.py covers end-to-end exactness)."""
     from log_parser_tpu.patterns.bank import PatternBank
     from helpers import make_pattern, make_pattern_set
 
@@ -194,22 +213,28 @@ def test_mixed_literal_alternation_column_exact():
     )
     mb = MatcherBanks(bank, bitglush_max_words=192)
     assert mb.shiftor is None  # no exact-sequence columns in this bank
+    col = next(i for i, c in enumerate(bank.columns) if c.regex == rx)
+    assert mb.approx_cols == [col]
+    assert mb.bitglush is not None and not mb.bitglush.has_chains
     lines = [
         "Connection is not available, request timed out after 30000ms",
         "HikariPool-1 - Connection marked as broken",
         "a short one here",
         "Connection is not available, request timed out",  # prefix only
-        "HikariPool- - Connection marked as broken",  # \d+ unmet
+        "HikariPool- - Connection marked as broken",  # \\d+ unmet
         "nothing",
     ]
-    col = next(i for i, c in enumerate(bank.columns) if c.regex == rx)
     enc = encode_lines(lines)
     got = np.asarray(
         mb.cube(np.asarray(enc.u8.T), np.asarray(enc.lengths))
     )[: len(lines), col]
     host = compile_java_regex(rx)
+    want = [bool(host.search(ln)) for ln in lines]
+    # superset: every true match is flagged
+    assert all(g or not w for g, w in zip(got, want))
+    # exact everywhere except the long alternative's prefix-only line
     np.testing.assert_array_equal(
-        got, [bool(host.search(ln)) for ln in lines]
+        got, [True, True, True, True, False, False]
     )
 
 
@@ -267,4 +292,56 @@ def test_word_budget_gate_reroutes_and_stays_exact():
     np.testing.assert_array_equal(
         np.asarray(wide.cube(lt, ln))[: len(lines)],
         np.asarray(gated.cube(lt, ln))[: len(lines)],
+    )
+
+
+def test_bare_layout_through_matcher_banks():
+    """The TPU-side bank layout (shiftor_sinks=False — no sink bits,
+    ungated hits stepper) produces an identical match cube to the CPU
+    sink layout through the full fused MatcherBanks path, at fewer
+    packed words."""
+    import jax.numpy as jnp
+
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.patterns.bank import PatternBank
+
+    patterns = [
+        make_pattern(f"p{i}", regex=rx, confidence=0.5)
+        for i, rx in enumerate(
+            [
+                "OutOfMemoryError",
+                "Connection refused",
+                "[Tt]imeout waiting",
+                "status=[45]\\d\\d",
+                "q",  # one-byte sequence: start == end
+                "A fatal error has been detected by the Java Runtime",
+            ]
+        )
+    ]
+    bank = PatternBank([make_pattern_set(patterns)])
+    sink = MatcherBanks(bank, shiftor_min_columns=1, shiftor_sinks=True)
+    bare = MatcherBanks(bank, shiftor_min_columns=1, shiftor_sinks=False)
+    assert sink.shiftor is not None and bare.shiftor is not None
+    assert sink.shiftor.sinks and not bare.shiftor.sinks
+    assert bare.shiftor.n_words < sink.shiftor.n_words
+
+    lines = [
+        "java.lang.OutOfMemoryError: heap",
+        "dial tcp: Connection refused",
+        "Timeout waiting for connection",
+        "status=503 from upstream",
+        "status=200 ok",
+        "zq",
+        "A fatal error has been detected by the Java Runtime",
+        "x" * 27 + "Error",  # full-width completion parity
+        "",
+        "no match here",
+    ]
+    enc = encode_lines(lines)
+    lt = jnp.asarray(enc.u8.T)
+    ln = jnp.asarray(enc.lengths)
+    np.testing.assert_array_equal(
+        np.asarray(sink.cube(lt, ln))[: len(lines)],
+        np.asarray(bare.cube(lt, ln))[: len(lines)],
     )
