@@ -110,9 +110,17 @@ class BitGlushBank:
 
     @classmethod
     def _alt_allocs(cls, programs) -> list[int]:
+        """Per-alternative allocation sizes: positions, plus one sink on
+        sink-eligible banks, plus one dead *guard* bit BEFORE every
+        ``^``-anchored alternative. The guard bit is never admitted by
+        any byte row, so nothing can shift or skip-propagate into the
+        caret start after t=0 — which lets the steppers drop their two
+        per-byte ``& not_caret`` ops entirely."""
         sink = 1 if cls.sink_eligible(programs) else 0
         return [
-            a.n_positions + sink for p in programs for a in p.alternatives
+            a.n_positions + sink + (1 if a.caret else 0)
+            for p in programs
+            for a in p.alternatives
         ]
 
     @classmethod
@@ -168,7 +176,9 @@ class BitGlushBank:
         alt_iter = iter(alt_starts)
         for slot, (_col, prog) in enumerate(column_programs):
             for alt in prog.alternatives:
-                base = g = next(alt_iter)
+                # the caret guard bit (dead, leak-absorbing) is the
+                # allocation's first bit; items start right after it
+                base = g = next(alt_iter) + (1 if alt.caret else 0)
                 for j, item in enumerate(alt.items):
                     for byte in item.byteset:
                         # NUL never reaches the device scan as content
@@ -231,7 +241,6 @@ class BitGlushBank:
         self.k_skip = jnp.asarray(k_skip)
         self.start = jnp.asarray(start)
         self.caret_start = jnp.asarray(caret_start)
-        self.not_caret = jnp.asarray(~caret_start)
         self.allow4 = jnp.asarray(allow4)
         self.f_plain = jnp.asarray(f_plain)
         self.f_dollar = jnp.asarray(f_dollar)
@@ -335,17 +344,16 @@ class BitGlushBank:
         def one(d, pw, b, pos):
             b32 = b.astype(jnp.int32)
             c = self._shift1(d)
+            # the guard bit before every ^-anchored alternative absorbs
+            # shift/skip leaks (it is never admitted by any byte row), so
+            # no ``& not_caret`` is needed anywhere — caret starts are
+            # only ever injected by the pos==0 select below
             if self.has_caret:
-                c = (c & self.not_caret) | jnp.where(
-                    pos == 0, self.start_all, self.start
-                )
+                c = c | jnp.where(pos == 0, self.start_all, self.start)
             else:
                 c = c | self.start
             for _ in range(self.max_skip_run):
-                sk = self._shift1(c & self.k_skip)
-                if self.has_caret:
-                    sk = sk & self.not_caret
-                c = c | sk
+                c = c | self._shift1(c & self.k_skip)
             brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
             if self.has_preassert:
                 cw = _is_word(b32)
@@ -423,21 +431,15 @@ class BitGlushBank:
                 )
 
             c = self._shift1(d)
+            # ^-anchored starts inject only at each line's first byte;
+            # the caret guard bit absorbs shift/skip leaks, so no
+            # ``& not_caret`` anywhere (see _alt_allocs)
             if self.has_caret:
-                # ^-anchored starts inject only at each line's first
-                # byte: one scalar-pred [W] select feeds a single
-                # broadcast OR (the separate caret-gated second OR was
-                # a whole extra [B, W] op per byte)
-                c = (c & self.not_caret) | jnp.where(
-                    pos == 0, self.start_all, self.start
-                )
+                c = c | jnp.where(pos == 0, self.start_all, self.start)
             else:
                 c = c | self.start
             for _ in range(self.max_skip_run):
-                sk = self._shift1(c & self.k_skip)
-                if self.has_caret:
-                    sk = sk & self.not_caret
-                c = c | sk
+                c = c | self._shift1(c & self.k_skip)
 
             brow = jnp.take(self.bmask, b32, axis=0)  # [B, W]
             # factored: (c & brow) | (d & brow & s) == brow & (c | (d & s))

@@ -124,7 +124,6 @@ def _kernel(
     k_skip = consts_ref[1, :].reshape(1, W)
     start = consts_ref[2, :].reshape(1, W)
     caret_start = consts_ref[3, :].reshape(1, W)
-    not_caret = ~caret_start
     f_plain = consts_ref[4, :].reshape(1, W)
     f_dollar = consts_ref[5, :].reshape(1, W)
     f_tb = consts_ref[6, :].reshape(1, W)
@@ -174,11 +173,13 @@ def _kernel(
             plane = _dotT(ohT, mp[:])
             brow = brow | (plane.astype(jnp.int32) << (8 * p))
 
-        c = (shift1(d) & not_caret) | start
-        # ^-anchored starts inject only at the line's first byte
+        c = shift1(d) | start
+        # ^-anchored starts inject only at the line's first byte; the
+        # caret guard bit (bitglush.py _alt_allocs) absorbs shift/skip
+        # leaks, so no ``& not_caret`` is needed here either
         c = c | (caret_start & full_mask(ge(jnp.int32(0), t)))
         for _ in range(skip_run):
-            c = c | (shift1(c & k_skip) & not_caret)
+            c = c | shift1(c & k_skip)
 
         pwm = full_mask(pw)
         cwm = full_mask(cw)

@@ -757,24 +757,30 @@ class MatcherBanks:
                 BitGlushBank.alloc_positions(p) for _, p in expanded
             ) <= 32 * bit_budget:
                 bit_entries = expanded
-        # Truncate >31-position alternatives of primary-only columns so
-        # their allocations (alternative + sink bit) fit one word and
-        # the bank stays on the chainless shift (the carry's concat per
-        # shift measured 2.5x the chainless stepper on v5e —
-        # tools/probe_chainless.py). The truncated column OVER-matches;
-        # the engine re-verifies its rare flagged events with the exact
-        # host regex at assembly (runtime/engine.py, approx_cols).
+        # Truncate over-long alternatives of primary-only columns so
+        # their allocations fit one word and the bank stays on the
+        # chainless shift (the carry's concat per shift measured 2.5x
+        # the chainless stepper on v5e — tools/probe_chainless.py). The
+        # per-alternative item budget reserves the sink bit
+        # UNCONDITIONALLY (truncation drops \b/\B post-asserts, which
+        # can flip a pre-truncation sink-ineligible bank eligible) and
+        # the caret guard bit where the alternative is ^-anchored —
+        # otherwise a truncated allocation could still straddle a word
+        # and re-enable the bank-wide carry the truncation exists to
+        # remove. The truncated column OVER-matches; the engine
+        # re-verifies its rare flagged events with the exact host regex
+        # at assembly (runtime/engine.py, approx_cols).
         # Non-truncatable long programs stay exact and keep the carry.
-        max_items = 32 - (1 if BitGlushBank.sink_eligible(
-            [p for _, p in bit_entries]
-        ) else 0)
+        def _item_budget(alt) -> int:
+            return 31 - (1 if alt.caret else 0)
+
         approx: list[int] = []
         truncated_entries: list[tuple[int, object]] = []
         for i, p in bit_entries:
             if i in primary_only and any(
-                a.n_positions > max_items for a in p.alternatives
+                a.n_positions > _item_budget(a) for a in p.alternatives
             ):
-                cut = truncate_long_alternatives(p, max_items)
+                cut = truncate_long_alternatives(p, _item_budget)
                 if cut is not None:
                     p = cut[0]
                     approx.append(i)

@@ -430,10 +430,13 @@ def expand_asserts(prog: BitProgram) -> BitProgram:
 
 
 def truncate_long_alternatives(
-    prog: BitProgram, max_items: int
+    prog: BitProgram, max_items
 ) -> tuple[BitProgram, bool] | None:
-    """Cut every alternative longer than ``max_items`` down to its first
-    ``max_items`` items, dropping its post-assertion.
+    """Cut every alternative longer than its item budget down to that
+    budget, dropping its post-assertion. ``max_items`` is an int or a
+    callable ``(BitAlternative) -> int`` — packers whose per-alternative
+    overhead varies (e.g. the bitglush caret guard bit) pass a callable
+    so a truncated allocation can never exceed the packer's word size.
 
     The truncated program *over-approximates* the original: a line the
     full alternative matches always contains a match of its item prefix
@@ -453,10 +456,11 @@ def truncate_long_alternatives(
     alts: list[BitAlternative] = []
     changed = False
     for a in prog.alternatives:
-        if a.n_positions <= max_items:
+        budget = max_items(a) if callable(max_items) else max_items
+        if a.n_positions <= budget:
             alts.append(a)
             continue
-        head = a.items[:max_items]
+        head = a.items[:budget]
         if all(it.skippable for it in head):
             return None
         alts.append(

@@ -742,3 +742,53 @@ def test_truncation_skips_non_primary_roles():
         mb.cube(jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths))
     )[: len(lines), col]
     np.testing.assert_array_equal(got, [True, False, True, False])
+
+
+def test_truncated_caret_alternative_stays_chainless():
+    """Regression (r4 review): the truncation budget must reserve the
+    caret guard bit — a ^-anchored >31-position primary-only column
+    must truncate to an allocation that fits one word, keeping the
+    bank chainless, and stay exact end-to-end via host re-verify."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden.engine import GoldenAnalyzer
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    long_anchored = "^FATAL: unrecoverable disk failure on device"  # 43 items
+    sets = [
+        make_pattern_set(
+            [make_pattern("pa", regex=long_anchored, confidence=0.9)]
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    engine._matchers = MatcherBanks(
+        engine.bank,
+        bitglush_max_words=192,
+        shiftor_min_columns=10**9,
+        prefilter_min_columns=10**9,
+        multi_min_columns=10**9,
+    )
+    mb = engine.matchers
+    assert mb.bitglush is not None
+    assert not mb.bitglush.has_chains  # the budget reserved the guard bit
+    assert mb.approx_cols  # truncated -> engine verifies
+
+    body = "FATAL: unrecoverable disk failure on device sda"
+    logs = "\n".join(
+        [
+            body,                        # anchored true match
+            "x " + body,                 # caret unmet
+            body[:40],                   # prefix of the TRUNCATED region only
+            "clean",
+        ]
+    )
+    data = PodFailureData(logs=logs)
+    got = engine.analyze(data)
+    want = GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+    assert [(e.line_number, e.matched_pattern.id) for e in got.events] == [
+        (e.line_number, e.matched_pattern.id) for e in want.events
+    ]
+    for a, b in zip(got.events, want.events):
+        assert abs(a.score - b.score) < 1e-9

@@ -74,16 +74,14 @@ def main() -> None:
     gi, gstep, _gf = g.pair_stepper(B, lens)
     report["v_ship_s"] = round(timeit(scan_of(gstep, gi), args.repeats), 4)
 
-    def chainless_stepper(W, bmask, nc, s_all, s, k, ss):
+    def chainless_stepper(W, bmask, s_all, s, k, ss):
+        # mirrors the shipping (guard-bit, carry-free) sink stepper
         init = (jnp.zeros((B, W), jnp.uint32), jnp.zeros((B,), bool))
 
         def one(d, pw, b, pos):
-            c = d << 1
-            c = (c & nc) | jnp.where(pos == 0, s_all, s)
+            c = (d << 1) | jnp.where(pos == 0, s_all, s)
             for _ in range(g.max_skip_run):
-                sk = (c & k) << 1
-                sk = sk & nc
-                c = c | sk
+                c = c | ((c & k) << 1)
             brow = jnp.take(bmask, b.astype(jnp.int32), axis=0)
             return brow & (c | (d & ss)), pw
 
@@ -98,8 +96,7 @@ def main() -> None:
 
     # same width, no carry
     init, step = chainless_stepper(
-        g.n_words, g.bmask, g.not_caret, g.start_all, g.start,
-        g.k_skip, g.s_static,
+        g.n_words, g.bmask, g.start_all, g.start, g.k_skip, g.s_static
     )
     report["v_nocarry_s"] = round(timeit(scan_of(step, init), args.repeats), 4)
 
@@ -114,7 +111,7 @@ def main() -> None:
             np.pad(np.asarray(a), (0, pad))
         )
         init, step = chainless_stepper(
-            Wp, bm, padv(g.not_caret), padv(g.start_all), padv(g.start),
+            Wp, bm, padv(g.start_all), padv(g.start),
             padv(g.k_skip), padv(g.s_static),
         )
         report["v_nocarry_wide_s"] = round(
