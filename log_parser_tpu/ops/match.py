@@ -606,25 +606,30 @@ class MatcherBanks:
             return all(len(s) <= 32 for s in c.exact_seqs)
 
         # Column roles. A cube column may serve several patterns and
-        # roles; bitglush's truncation of >31-position alternatives
-        # (over-approximate device match + exact host re-verify of the
-        # flagged EVENTS at assembly, runtime/engine.py) is only sound
-        # for columns used EXCLUSIVELY as primaries — a secondary /
-        # sequence / context false positive would silently shift the
-        # proximity / temporal / context factors extracted on device.
-        # Long-literal columns in other roles ride Shift-Or's cont-mask
-        # chain path instead (a couple of words of take-row width);
-        # anything long, non-literal, and non-primary-only keeps its
-        # exact chained bitglush allocation (has_chains — correct,
-        # slower, absent from the builtin library).
+        # roles; bitglush's truncation of over-long alternatives
+        # (over-approximate device match + exact host repair in
+        # runtime/engine.py) is sound for PRIMARY roles (flagged events
+        # are re-verified with the host regex and dropped) and for
+        # SECONDARY roles (a truncated secondary only feeds the
+        # proximity distances, which the engine repairs exactly: the
+        # device's claimed min-distance names at most two lines, both
+        # host-verified, with a host window re-scan in the rare case
+        # both were prefix-only false positives). Sequence-event and
+        # context columns feed device-side factor extraction with no
+        # cheap repair, so they are NEVER truncated: long literal ones
+        # ride Shift-Or's cont-mask chain path; anything long,
+        # non-literal, and non-truncatable keeps its exact chained
+        # bitglush allocation (has_chains — correct, slower, absent
+        # from the builtin library).
         from log_parser_tpu.patterns.bank import CTX_EXCEPTION
 
-        primary_only = set(int(c) for c in bank.primary_columns)
-        primary_only -= {s.column for s in bank.secondaries}
-        primary_only -= {
+        exact_role_cols = {
             c for e in bank.sequences for c in e.event_columns
-        }
-        primary_only -= set(range(CTX_EXCEPTION + 1))
+        } | set(range(CTX_EXCEPTION + 1))
+        truncatable = (
+            set(int(c) for c in bank.primary_columns)
+            | {s.column for s in bank.secondaries}
+        ) - exact_role_cols
 
         def _chain_literal(i, c) -> bool:
             # long-literal column that may NOT be truncated: its exact
@@ -632,7 +637,7 @@ class MatcherBanks:
             return (
                 c.exact_seqs is not None
                 and not _short_seqs(c)
-                and i not in primary_only
+                and i in exact_role_cols
             )
 
         if use_shiftor:
@@ -757,9 +762,9 @@ class MatcherBanks:
                 BitGlushBank.alloc_positions(p) for _, p in expanded
             ) <= 32 * bit_budget:
                 bit_entries = expanded
-        # Truncate over-long alternatives of primary-only columns so
-        # their allocations fit one word and the bank stays on the
-        # chainless shift (the carry's concat per shift measured 2.5x
+        # Truncate over-long alternatives of primary/secondary-role
+        # columns so their allocations fit one word and the bank stays
+        # on the chainless shift (the carry's concat per shift measured 2.5x
         # the chainless stepper on v5e — tools/probe_chainless.py). The
         # per-alternative item budget reserves the sink bit
         # UNCONDITIONALLY (truncation drops \b/\B post-asserts, which
@@ -777,7 +782,7 @@ class MatcherBanks:
         approx: list[int] = []
         truncated_entries: list[tuple[int, object]] = []
         for i, p in bit_entries:
-            if i in primary_only and any(
+            if i in truncatable and any(
                 a.n_positions > _item_budget(a) for a in p.alternatives
             ):
                 cut = truncate_long_alternatives(p, _item_budget)

@@ -180,6 +180,20 @@ class PatternShardedEngine(AnalysisEngine):
     def _col_index(self) -> dict:
         return self.bank._column_by_key
 
+    def _approx_global_cols(self) -> set:
+        """Union of every block's approximate columns, translated from
+        block-local to full-bank indexes by interned (regex, ci) key —
+        conservative (see AnalysisEngine._approx_secondaries): a column
+        exact in the block that ran a given pattern repairs as a no-op."""
+        out: set = set()
+        for fused, _global_idx, _dev in self._block_engines:
+            for c in getattr(fused.matchers, "approx_cols", []):
+                col = fused.bank.columns[c]
+                g = self._col_index.get((col.regex, col.case_insensitive))
+                if g is not None:
+                    out.add(g)
+        return out
+
     def _globalize(self, recs: MatchRecords, global_idx: np.ndarray) -> MatchRecords:
         """Rewrite block-local pattern indexes to full-bank indexes."""
         m = recs.n_matches

@@ -318,6 +318,7 @@ class AnalysisEngine:
         )
         self._k_hint = 0  # previous request's match count → starting K bucket
         self._approx_pat_mask = None  # lazy — see _approx_patterns
+        self._approx_sec = None  # lazy — see _approx_secondaries
         # serializes frequency-coupled state (finish phase, admin routes,
         # golden fallback) across transports; the prepare phase (ingest +
         # device) deliberately runs OUTSIDE it — see analyze_pipelined
@@ -440,40 +441,142 @@ class AnalysisEngine:
         banks (pattern sharding)."""
         return [(getattr(self.matchers, "approx_cols", []), self.bank, 0)]
 
+    def _approx_global_cols(self) -> set:
+        """Engine-bank column indexes whose device tier over-matches, in
+        GLOBAL column coordinates — overridden by pattern sharding to
+        translate block-local indexes."""
+        return set(getattr(self.matchers, "approx_cols", []))
+
+    def _approx_secondaries(self):
+        """[(pattern_idx, slot, column, effective_window)] — secondary
+        entries whose column may over-match on device, and whose record
+        distances therefore need the exact host repair. Slot order
+        mirrors FusedStaticTables.pat_sec (declaration order within the
+        pattern). Conservative across sharded engines: an entry whose
+        column is exact in the block that ran it still repairs cleanly
+        (the claimed line verifies and the distance stands)."""
+        if self._approx_sec is None:
+            cols = self._approx_global_cols()
+            out = []
+            if cols:
+                slot_of: dict[int, int] = {}
+                for e in self.bank.secondaries:
+                    j = slot_of.get(e.pattern_idx, 0)
+                    slot_of[e.pattern_idx] = j + 1
+                    if e.column in cols:
+                        out.append(
+                            (
+                                e.pattern_idx,
+                                j,
+                                e.column,
+                                min(
+                                    self.config.proximity_max_window,
+                                    e.window,
+                                ),
+                            )
+                        )
+            self._approx_sec = out
+        return self._approx_sec
+
     def _verify_approx(self, corpus: Corpus, recs):
-        """Drop device match records whose (approximate) primary column
-        flagged a line the exact host regex rejects. Runs in ``_prepare``
-        — OUTSIDE the serialization lock — and before the frequency read,
-        so counts, scores, ordering, and assembly all see exactly the
-        reference's match set (AnalysisService.java:93-95 semantics)."""
-        m = recs.n_matches
-        mask = self._approx_patterns()
-        if m == 0 or not mask.any():
-            return recs
-        pat = recs.pattern[:m].astype(np.int64)
-        cand = np.nonzero(mask[pat])[0]
-        if cand.size == 0:
-            return recs
-        keep = np.ones(m, dtype=bool)
-        for i in cand:
-            col = self.bank.columns[
-                int(self.bank.primary_columns[int(pat[i])])
-            ]
-            keep[i] = (
-                col.host.search(corpus.line(int(recs.line[i]))) is not None
-            )
-        if keep.all():
-            return recs
+        """Exact host repair for approximate (truncated) device columns.
+        Runs in ``_prepare`` — OUTSIDE the serialization lock — and
+        before the frequency read, so counts, scores, ordering, and
+        assembly all see exactly the reference's match/factor set
+        (AnalysisService.java:93-95, ScoringService.java:315-347).
+
+        Stage 1 (primary roles): drop records whose approximate primary
+        column flagged a line the exact host regex rejects.
+        Stage 2 (secondary roles): a truncated secondary only feeds the
+        proximity distances. The device min-distance d names at most two
+        lines (record line ± d); if either truly matches, d is exact
+        (true hits are a subset of device hits, so the true minimum is
+        never smaller). Otherwise both were prefix-only false positives
+        and the true distance is recovered by an outward host scan
+        bounded by the entry's effective window (beyond it the factor is
+        zero either way)."""
         import dataclasses
 
+        from log_parser_tpu.ops.fused import NO_HIT
+
+        m = recs.n_matches
+        if m == 0:
+            return recs
+        mask = self._approx_patterns()
+        if mask.any():
+            pat = recs.pattern[:m].astype(np.int64)
+            cand = np.nonzero(mask[pat])[0]
+            keep = np.ones(m, dtype=bool)
+            for i in cand:
+                col = self.bank.columns[
+                    int(self.bank.primary_columns[int(pat[i])])
+                ]
+                keep[i] = (
+                    col.host.search(corpus.line(int(recs.line[i])))
+                    is not None
+                )
+            if not keep.all():
+                m = int(keep.sum())
+                recs = dataclasses.replace(
+                    recs,
+                    n_matches=m,
+                    line=recs.line[: len(keep)][keep],
+                    pattern=recs.pattern[: len(keep)][keep],
+                    sec_dist=recs.sec_dist[: len(keep)][keep],
+                    seq_ok=recs.seq_ok[: len(keep)][keep],
+                    ctx_counts=recs.ctx_counts[: len(keep)][keep],
+                )
+
+        sec_entries = self._approx_secondaries()
+        if not sec_entries or m == 0:
+            return recs
+        by_pattern: dict[int, list] = {}
+        for p, j, col, w in sec_entries:
+            by_pattern.setdefault(p, []).append((j, col, w))
+        pat = recs.pattern[:m]
+        approx_mask = np.zeros(max(1, self.bank.n_patterns), dtype=bool)
+        approx_mask[list(by_pattern)] = True
+        rows = np.flatnonzero(approx_mask[pat.astype(np.int64)])
+        if rows.size == 0:
+            return recs
+        n = corpus.n_lines
+        sec_dist = None  # copy-on-write
+        for i in rows:
+            line = int(recs.line[i])
+            for j, col, w in by_pattern[int(pat[i])]:
+                d = int(recs.sec_dist[i, j] if sec_dist is None else sec_dist[i, j])
+                if d >= NO_HIT or d > w:
+                    continue  # out of window: zero factor either way
+                host = self.bank.columns[col].host
+                if (
+                    line - d >= 0
+                    and host.search(corpus.line(line - d)) is not None
+                ) or (
+                    line + d < n
+                    and host.search(corpus.line(line + d)) is not None
+                ):
+                    continue  # the claimed distance is exact
+                if sec_dist is None:
+                    sec_dist = recs.sec_dist[:m].copy()
+                nd = NO_HIT
+                for k in range(d + 1, w + 1):
+                    if (
+                        line - k >= 0
+                        and host.search(corpus.line(line - k)) is not None
+                    ) or (
+                        line + k < n
+                        and host.search(corpus.line(line + k)) is not None
+                    ):
+                        nd = k
+                        break
+                sec_dist[i, j] = nd
+        if sec_dist is None:
+            return recs
         return dataclasses.replace(
             recs,
-            n_matches=int(keep.sum()),
-            line=recs.line[:m][keep],
-            pattern=recs.pattern[:m][keep],
-            sec_dist=recs.sec_dist[:m][keep],
-            seq_ok=recs.seq_ok[:m][keep],
-            ctx_counts=recs.ctx_counts[:m][keep],
+            sec_dist=np.concatenate([sec_dist, recs.sec_dist[m:]], axis=0)
+            if recs.sec_dist.shape[0] > m
+            else sec_dist,
         )
 
     def _corpus_min_rows(self) -> int:

@@ -709,39 +709,129 @@ def test_truncated_primary_column_engine_exact():
     )
 
 
-def test_truncation_skips_non_primary_roles():
-    """A long-literal column also used as a SECONDARY must not be
-    truncated (device factors read it) — it routes to Shift-Or's chain
-    path instead and stays exact in the cube."""
+def test_truncation_roles():
+    """Secondary-role long columns truncate (their distances get the
+    exact host repair in the engine); sequence-event-role long columns
+    never truncate — they ride Shift-Or's chain path and stay exact in
+    the cube."""
     from helpers import make_pattern, make_pattern_set
-    from log_parser_tpu.models.pattern import SecondaryPattern
+    from log_parser_tpu.models.pattern import (
+        SecondaryPattern,
+        SequenceEvent,
+        SequencePattern,
+    )
     from log_parser_tpu.ops.match import MatcherBanks
     from log_parser_tpu.patterns.bank import PatternBank
 
-    long_lit = "Back-off restarting failed container"
+    sec_lit = "Back-off restarting failed container"
+    seq_lit = "Liveness probe failed repeatedly for main container"
     p1 = make_pattern("p1", regex="primary thing", confidence=0.5)
     p1.secondary_patterns = [
-        SecondaryPattern(regex=long_lit, weight=0.5, proximity_window=5)
+        SecondaryPattern(regex=sec_lit, weight=0.5, proximity_window=5)
     ]
-    p2 = make_pattern("p2", regex=long_lit, confidence=0.5)
+    p1.sequence_patterns = [
+        SequencePattern(
+            description="d",
+            bonus_multiplier=0.4,
+            events=[SequenceEvent(regex=seq_lit)],
+        )
+    ]
+    p2 = make_pattern("p2", regex=sec_lit, confidence=0.5)
     bank = PatternBank([make_pattern_set([p1, p2])])
     mb = MatcherBanks(
         bank,
         bitglush_max_words=192,
         shiftor_min_columns=1,
     )
-    col = next(i for i, c in enumerate(bank.columns) if c.regex == long_lit)
-    # not truncated anywhere
-    assert col not in mb.approx_cols
-    # rides the Shift-Or chain path, exact
-    assert col in mb.shiftor_cols
+    sec_col = next(i for i, c in enumerate(bank.columns) if c.regex == sec_lit)
+    seq_col = next(i for i, c in enumerate(bank.columns) if c.regex == seq_lit)
+    # secondary-role long column: truncated on device, flagged approx
+    assert sec_col in mb.approx_cols
+    assert sec_col not in mb.shiftor_cols
+    # sequence-event-role long column: exact, on the Shift-Or chain path
+    assert seq_col not in mb.approx_cols
+    assert seq_col in mb.shiftor_cols
     assert mb.shiftor.has_chains
-    lines = [long_lit, long_lit[:-1], "x " + long_lit + " y", ""]
+    lines = [seq_lit, seq_lit[:-1], "x " + seq_lit + " y", ""]
     enc = encode_lines(lines)
     got = np.asarray(
         mb.cube(jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths))
-    )[: len(lines), col]
+    )[: len(lines), seq_col]
     np.testing.assert_array_equal(got, [True, False, True, False])
+
+
+def test_truncated_secondary_distance_repair():
+    """End-to-end: a pattern whose long SECONDARY is truncated on device
+    must still score exactly — the engine verifies the claimed nearest
+    lines and, when both were prefix-only false positives, recovers the
+    true distance (or its absence) by the bounded host scan."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden.engine import GoldenAnalyzer
+    from log_parser_tpu.models.pattern import SecondaryPattern
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    sec_lit = "Back-off restarting failed container"
+    prefix_only = sec_lit[:31]  # matches the truncated program only
+    p = make_pattern("pp", regex="primary thing", confidence=0.8)
+    p.secondary_patterns = [
+        SecondaryPattern(regex=sec_lit, weight=0.5, proximity_window=8)
+    ]
+    sets = [make_pattern_set([p])]
+
+    def build_engine():
+        e = AnalysisEngine(sets, ScoringConfig())
+        e._matchers = MatcherBanks(
+            e.bank,
+            bitglush_max_words=192,
+            shiftor_min_columns=10**9,
+            prefilter_min_columns=10**9,
+            multi_min_columns=10**9,
+        )
+        sec_col = next(
+            i for i, c in enumerate(e.bank.columns) if c.regex == sec_lit
+        )
+        assert sec_col in e.matchers.approx_cols
+        return e
+
+    cases = [
+        # (log lines, label)
+        (
+            [
+                "primary thing here",
+                prefix_only,          # false positive at distance 1
+                "filler",
+                sec_lit,              # true hit at distance 3
+            ],
+            "false-then-true",
+        ),
+        (
+            ["x", "primary thing here", sec_lit + " tail"],
+            "true-adjacent",
+        ),
+        (
+            ["primary thing here", prefix_only, "y"],
+            "false-only",
+        ),
+        (
+            [prefix_only, "a", "primary thing here", "b", prefix_only],
+            "false-both-sides",
+        ),
+    ]
+    for lines, label in cases:
+        data = PodFailureData(logs="\n".join(lines))
+        got = build_engine().analyze(data)
+        want = GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+        assert len(got.events) == len(want.events), label
+        for a, b in zip(got.events, want.events):
+            assert a.line_number == b.line_number, label
+            assert abs(a.score - b.score) < 1e-9, (
+                label,
+                a.score,
+                b.score,
+            )
 
 
 def test_truncated_caret_alternative_stays_chainless():
