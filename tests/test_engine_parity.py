@@ -147,6 +147,106 @@ def test_random_library_parity(seed):
     )
 
 
+LONG_LITERALS = [
+    # >31 positions: truncated on the bit tier (primary and secondary
+    # roles), exact via the engine's host verify / distance repair
+    "could not connect to server: Connection refused",
+    "Back-off restarting failed container in pod sandbox",
+    "A fatal error has been detected by the Java Runtime",
+    "Liveness probe failed: HTTP probe failed with statuscode: 503",
+]
+
+
+def random_long_library(rng: random.Random, n_patterns: int):
+    """Libraries whose primaries/secondaries include >31-char literals
+    and literal-bearing alternations — the truncation + repair paths."""
+    patterns = []
+    for i in range(n_patterns):
+        lit = rng.choice(LONG_LITERALS)
+        regex = rng.choice(
+            [
+                lit,
+                rf"(?:{lit}|{rng.choice(FRAGMENTS)})",
+                rf"^{lit}",
+                lit + r"\d*",
+            ]
+        )
+        secondaries = None
+        if rng.random() < 0.6:
+            secondaries = [
+                (rng.choice(LONG_LITERALS + FRAGMENTS),
+                 round(rng.uniform(0.1, 0.9), 2),
+                 rng.choice([3, 8, 100]))
+                for _ in range(rng.randrange(1, 3))
+            ]
+        patterns.append(
+            make_pattern(
+                f"p{i}",
+                regex=regex,
+                confidence=round(rng.uniform(0.1, 1.0), 2),
+                severity=rng.choice(["CRITICAL", "HIGH", "LOW"]),
+                secondaries=secondaries,
+            )
+        )
+    return [make_pattern_set(patterns, "liblong")]
+
+
+def random_long_logs(rng: random.Random, n_lines: int) -> str:
+    """Corpora that plant full long literals AND their 31-char prefixes
+    (device-only false positives the engine must repair away)."""
+    lines = []
+    for _ in range(n_lines):
+        r = rng.random()
+        lit = rng.choice(LONG_LITERALS)
+        if r < 0.25:
+            lines.append(lit + rng.choice(["", " tail", "!"]))
+        elif r < 0.5:
+            # the poison case: exactly the truncated prefix, not the full
+            lines.append(rng.choice(["", "pad "]) + lit[:31])
+        elif r < 0.65:
+            lines.append(rng.choice(FRAGMENTS) + " happened")
+        else:
+            lines.append("noise " + "".join(rng.choice("xyz ") for _ in range(12)))
+    return "\n".join(lines) + rng.choice(["", "\n"])
+
+
+def _force_bit_policy(engine: AnalysisEngine) -> None:
+    """Build the engine's matcher banks under the TPU tier policy (bit
+    tiers on, truncation active) on the CPU test backend. Must run
+    before the first ``engine.matchers`` access."""
+    from log_parser_tpu.ops.match import MatcherBanks
+
+    engine._matchers = MatcherBanks(
+        engine.bank,
+        bitglush_max_words=MatcherBanks.BITGLUSH_MAX_WORDS_TPU,
+        shiftor_min_columns=MatcherBanks.SHIFTOR_MIN_COLUMNS_TPU,
+        prefilter_min_columns=MatcherBanks.PREFILTER_MIN_COLUMNS_TPU,
+        shiftor_sinks=False,
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_long_literal_parity_bit_policy(seed):
+    """Truncation + host verify/repair fuzz: long-literal libraries under
+    the TPU tier policy, corpora salted with prefix-only poison lines,
+    engine vs golden over evolving frequency state."""
+    rng = random.Random(31000 + seed)
+    sets = random_long_library(rng, rng.randrange(2, 6))
+    config = ScoringConfig(proximity_max_window=rng.choice([5, 100]))
+    engine = AnalysisEngine(sets, config, clock=FakeClock())
+    _force_bit_policy(engine)
+    assert engine.matchers.bitglush is not None
+    golden = GoldenAnalyzer(sets, config, clock=FakeClock())
+    for _ in range(3):
+        logs = random_long_logs(rng, rng.randrange(5, 80))
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+        assert_results_match(engine.analyze(data), golden.analyze(data))
+    assert (
+        engine.frequency.get_frequency_statistics()
+        == golden.frequency.get_frequency_statistics()
+    )
+
+
 class TestEngineEdgeCases:
     def _pair(self, patterns, config=None):
         sets = [make_pattern_set(patterns)]

@@ -19,16 +19,23 @@ Three modes:
   counts (the pattern-axis / TP-analogue path, stable (line, pattern)
   merge) — mirrors ``test_pattern_sharded.test_random_parity_vs_golden``
   (suite seeds 9000..9002 x n_blocks {1,3,4}).
+- ``--long``: single-device engine under the TPU tier policy (bit tiers
+  on) with >31-char-literal libraries and prefix-poisoned corpora — the
+  bitglush truncation + host verify / distance-repair paths; mirrors
+  ``test_random_long_literal_parity_bit_policy`` (suite seeds
+  31000..31005).
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
-       [--sharded | --pattern-sharded]
+       [--sharded | --pattern-sharded | --long]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
-9003..9053 pattern-sharded — a bare run reproduces the documented
-records below; --end exclusive)
+9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
+the documented records below; --end exclusive)
 Record (round-4 engine, 2026-07-30): default seeds 8..199 (192 libraries,
 576 corpora) clean; sharded seeds 1004..1053 (50 libraries) clean;
 pattern-sharded seeds 9003..9052 (50 libraries, n_blocks cycling 1/3/4)
 clean.
+Record (round-4 engine, 2026-07-31, truncation/repair build): long seeds
+31006..31055 (50 libraries, 150 corpora) clean.
 """
 
 from __future__ import annotations
@@ -68,23 +75,43 @@ def main() -> int:
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--sharded", action="store_true")
     mode.add_argument("--pattern-sharded", action="store_true")
+    mode.add_argument("--long", action="store_true")
     args = ap.parse_args()
     # per-mode defaults: a bare run reproduces the documented record,
     # and each mode's seed space stays disjoint from the suite's pinned
     # seeds and the other modes' sweeps
     if args.start is None:
-        args.start = 1004 if args.sharded else 9003 if args.pattern_sharded else 8
+        args.start = (
+            1004
+            if args.sharded
+            else 9003
+            if args.pattern_sharded
+            else 31006
+            if args.long
+            else 8
+        )
     if args.end is None:
-        args.end = 1054 if args.sharded else 9053 if args.pattern_sharded else 200
+        args.end = (
+            1054
+            if args.sharded
+            else 9053
+            if args.pattern_sharded
+            else 31056
+            if args.long
+            else 200
+        )
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
     from test_engine_parity import (  # the suite's generators ARE the spec
+        _force_bit_policy,
         assert_results_match,
         random_library,
         random_logs,
+        random_long_library,
+        random_long_logs,
     )
     from tests.conftest import FakeClock
 
@@ -125,6 +152,15 @@ def main() -> int:
                     clock=FakeClock(),
                 )
                 n_runs, lines_lo, lines_hi = 2, 20, 200
+            elif args.long:
+                sets = random_long_library(rng, rng.randrange(2, 6))
+                config = ScoringConfig(proximity_max_window=rng.choice([5, 100]))
+                engine = AnalysisEngine(sets, config, clock=FakeClock())
+                _force_bit_policy(engine)
+                # guard against a vacuous pass: the mode exists to fuzz
+                # the bit tier's truncation/repair paths
+                assert engine.matchers.bitglush is not None
+                n_runs, lines_lo, lines_hi = 3, 5, 80
             else:
                 sets = random_library(rng, rng.randrange(2, 8))
                 config = ScoringConfig(
@@ -134,8 +170,9 @@ def main() -> int:
                 engine = AnalysisEngine(sets, config, clock=FakeClock())
                 n_runs, lines_lo, lines_hi = 3, 5, 120
             golden = GoldenAnalyzer(sets, config, clock=FakeClock())
+            gen_logs = random_long_logs if args.long else random_logs
             for _ in range(n_runs):  # frequency state must evolve identically
-                logs = random_logs(rng, rng.randrange(lines_lo, lines_hi))
+                logs = gen_logs(rng, rng.randrange(lines_lo, lines_hi))
                 data = PodFailureData(pod={"metadata": {"name": "fuzz"}}, logs=logs)
                 assert_results_match(engine.analyze(data), golden.analyze(data))
             # explicit raise, not assert: python -O would strip an
