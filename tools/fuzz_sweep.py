@@ -35,8 +35,9 @@ Record (round-4 engine, 2026-07-30): default seeds 8..199 (192 libraries,
 pattern-sharded seeds 9003..9052 (50 libraries, n_blocks cycling 1/3/4)
 clean.
 Record (round-4 engine, 2026-07-31, truncation/repair build): long seeds
-31006..31055 (50 libraries, 150 corpora) clean; sharded 1004..1053 and
-pattern-sharded 9003..9052 re-run clean on the same build.
+31006..31055 (50 libraries, 150 corpora) clean; default 8..199 (192
+libraries, 576 corpora), sharded 1004..1053, and pattern-sharded
+9003..9052 all re-run clean on the same build.
 """
 
 from __future__ import annotations
