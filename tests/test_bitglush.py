@@ -882,3 +882,39 @@ def test_truncated_caret_alternative_stays_chainless():
     ]
     for a, b in zip(got.events, want.events):
         assert abs(a.score - b.score) < 1e-9
+
+
+def test_all_poison_corpus_zero_events():
+    """Worst case for truncation: EVERY line is the 31-char prefix of a
+    long primary literal. The device flags every line (K ladder may
+    climb), the engine's host verify drops every record, and the result
+    is exactly golden's: zero events, NONE summary, zero frequency."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden.engine import GoldenAnalyzer
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    lit = "Connection is not available, request timed out after"
+    sets = [make_pattern_set([make_pattern("pl", regex=lit, confidence=0.9)])]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    engine._matchers = MatcherBanks(
+        engine.bank,
+        bitglush_max_words=MatcherBanks.BITGLUSH_MAX_WORDS_TPU,
+        shiftor_min_columns=10**9,
+        prefilter_min_columns=10**9,
+        multi_min_columns=10**9,
+    )
+    assert engine.matchers.approx_cols
+    logs = "\n".join([lit[:31]] * 5000)
+    data = PodFailureData(logs=logs)
+    golden = GoldenAnalyzer(sets, ScoringConfig())
+    got = engine.analyze(data)
+    want = golden.analyze(data)
+    assert got.events == [] and want.events == []
+    assert got.summary.to_dict() == want.summary.to_dict()
+    assert (
+        engine.frequency.get_frequency_statistics()
+        == golden.frequency.get_frequency_statistics()
+    )
