@@ -1,12 +1,14 @@
-"""Timing probe: what does bitglush's cross-word shift carry cost on
-the live backend, and what would a chainless (first-fit word-packed)
-bank cost at its wider row width?
+"""Timing probe that decided the first-fit chainless bitglush layout
+(PERF.md §9d: carry removal measured 0.162 -> 0.064 s on v5e; the
+shipping stepper has been chainless since). Still useful for width
+sensitivity on the live backend:
 
-Variants (identical op shapes, mask CONTENTS don't affect timing):
-- v_ship:        the shipping sink stepper (sequential pack, carry)
-- v_nocarry:     same ops minus the concat-carry in every shift (W=88)
-- v_nocarry_w:   chainless ops at a padded width (first-fit
-                 fragmentation estimate, default 112 words)
+- v_ship:        the shipping stepper (now first-fit, carry-free on
+                 chainless banks)
+- v_nocarry:     the synthetic carry-free form at the bank's width
+                 (≈ v_ship on a chainless bank — the historical A/B)
+- v_nocarry_w:   same ops at a padded width (fragmentation estimate,
+                 default 112 words)
 
 Usage: python tools/probe_chainless.py [--lines 200000] [--width 112]
 """

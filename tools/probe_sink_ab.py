@@ -1,14 +1,17 @@
 """Session-matched A/B of the Shift-Or stepper forms on the live
-backend, all sharing the CURRENT bank's constants (sinks included):
+backend (the probe that decided the platform-split layout, PERF.md
+§9d), all sharing the CURRENT bank's constants:
 
-- v_ship:         the shipping pair-composed sink stepper
-- v_perbyte_sink: per-byte sink update (1 take + ~6 ops/byte, 64 steps)
-- v_perbyte_hits: gate-free per-byte hits form (round-3 shape on the
-                  current bank: 1 take + ~5 ops/byte, hits carry)
+- v_ship:         the shipping stepper for this platform (TPU: bare
+                  nh-carry hits; CPU: pair-composed sinks)
+- v_perbyte_sink: per-byte sink update (only on a sink-layout bank)
+- v_perbyte_hits: gate-free per-byte hits form on the current bank
+- v_nosink_hits:  the bare 81-word layout rebuilt from scratch
+- v_nosink_chain: bare layout + one 36-char chained literal (the
+                  historical col-80 routing question)
 
 Also times the bitglush shipping stepper alone so the cube split is
-attributable in the same session. Prints one JSON line (PERF.md §9b
-methodology).
+attributable in the same session. Prints one JSON line.
 
 Usage: python tools/probe_sink_ab.py [--lines 200000] [--repeats 3]
 """
