@@ -89,24 +89,24 @@ class BitGlushBank:
         )
 
     @staticmethod
-    def alloc_positions(program) -> int:
-        """Packed positions one program contributes: its Glushkov
-        positions plus one sink per alternative. The tier budget gates
-        in ops/match.py price programs through this (a bits/32 floor —
-        first-fit fragmentation can pack a few words wider, which the
-        128-lane padding absorbs). On the rare sink-ineligible bank the
-        sinks go unallocated and the price is conservative."""
-        return program.n_positions + len(program.alternatives)
-
-    @staticmethod
-    def _plan(allocs):
+    def _plan(allocs, budget: int | None = None):
         """First-fit packing plan over per-alternative allocation sizes
         (:func:`~log_parser_tpu.ops.shiftor.first_fit_plan` — shared
         with the Shift-Or tier; ``count_packed_words`` and ``__init__``
-        must agree)."""
+        both route through here so they cannot disagree)."""
         from log_parser_tpu.ops.shiftor import first_fit_plan
 
-        return first_fit_plan(allocs)
+        return first_fit_plan(allocs, budget=budget)
+
+    @staticmethod
+    def alt_alloc(alt, sink: int) -> int:
+        """Bits ONE alternative allocates under bank-wide sink mode
+        ``sink`` (0/1): positions, plus the sink, plus one dead *guard*
+        bit BEFORE every ``^``-anchored alternative.  THE per-alternative
+        sizing formula — the tier admission gate in ops/match.py prices
+        candidates through this too, so a new guard-style bit cannot
+        silently diverge the gate from the constructor."""
+        return alt.n_positions + sink + (1 if alt.caret else 0)
 
     @classmethod
     def _alt_allocs(cls, programs) -> list[int]:
@@ -118,14 +118,21 @@ class BitGlushBank:
         per-byte ``& not_caret`` ops entirely."""
         sink = 1 if cls.sink_eligible(programs) else 0
         return [
-            a.n_positions + sink + (1 if a.caret else 0)
+            cls.alt_alloc(a, sink)
             for p in programs
             for a in p.alternatives
         ]
 
     @classmethod
-    def count_packed_words(cls, programs) -> int:
-        return cls._plan(cls._alt_allocs(programs))[1]
+    def count_packed_words(cls, programs, budget: int | None = None) -> int:
+        """Exact packed word count the constructor would produce for
+        ``programs`` — same first-fit plan, same sink/caret-guard
+        allocations.  The tier admission gate in ops/match.py prices
+        candidates through this (ADVICE r4: a positions/32 floor ignored
+        guard bits and fragmentation, so a constructed bank could exceed
+        the budget and cross a 128-lane tile).  ``budget`` bails the plan
+        early once the count exceeds it."""
+        return cls._plan(cls._alt_allocs(programs), budget=budget)[1]
 
     def __init__(self, column_programs: list[tuple[int, BitProgram]]):
         self.columns = [c for c, _ in column_programs]

@@ -729,8 +729,22 @@ class MatcherBanks:
             truncate_long_alternatives,
         )
 
+        # Admission prices the EXACT packed bank the constructor would
+        # build — same first-fit plan, sink + caret-guard bits included
+        # (ADVICE r4: the old positions/32 floor under-counted both, so
+        # a constructed bank could exceed the budget and cross a
+        # 128-lane tile, doubling per-byte scan cost).  Pricing is
+        # incremental (one FirstFitPacker carried across candidates);
+        # the one event that invalidates it — a candidate with a \b/\B
+        # post-assert flipping the bank sink-INELIGIBLE, which strips a
+        # bit from every admitted alternative — triggers a full repack,
+        # and eligibility can only flip once (off) per admitted set.
+        from log_parser_tpu.ops.shiftor import FirstFitPacker
+
         bit_entries: list[tuple[int, object]] = []
-        bit_positions = 0
+        bit_progs: list = []
+        packer = FirstFitPacker()
+        sink_on = True  # BitGlushBank.sink_eligible([]) — empty set
         for i in dense_cols if bit_budget > 0 else []:
             if i in pref_set:
                 continue
@@ -741,10 +755,31 @@ class MatcherBanks:
                 continue
             if prog.n_positions > self.BITGLUSH_MAX_COLUMN_POSITIONS:
                 continue
-            need = BitGlushBank.alloc_positions(prog)
-            if bit_positions + need > 32 * bit_budget:
+            flips = sink_on and not BitGlushBank.sink_eligible([prog])
+            if flips:
+                # eligibility flip strips a bit from every admitted
+                # alternative: one full repack (at most once per
+                # admitted set while ON; a rejected flip candidate
+                # leaves eligibility as-is and pays the same one pass)
+                trial = FirstFitPacker()
+                allocs = BitGlushBank._alt_allocs(bit_progs + [prog])
+            else:
+                trial = packer.clone()
+                allocs = [
+                    BitGlushBank.alt_alloc(alt, 1 if sink_on else 0)
+                    for alt in prog.alternatives
+                ]
+            over = False
+            for a in allocs:
+                trial.add(a)
+                if trial.n_words > bit_budget:
+                    over = True
+                    break
+            if over:
                 continue
-            bit_positions += need
+            packer = trial
+            sink_on = sink_on and not flips
+            bit_progs.append(prog)
             bit_entries.append((i, prog))
         # De-assert rewrite, all-or-nothing: the op-group savings are
         # BANK-wide capability flags, so expansion only pays if every
@@ -758,9 +793,9 @@ class MatcherBanks:
             if expanded is not None and all(
                 p.n_positions <= self.BITGLUSH_MAX_COLUMN_POSITIONS
                 for _, p in expanded
-            ) and sum(
-                BitGlushBank.alloc_positions(p) for _, p in expanded
-            ) <= 32 * bit_budget:
+            ) and BitGlushBank.count_packed_words(
+                [p for _, p in expanded], budget=bit_budget
+            ) <= bit_budget:
                 bit_entries = expanded
         # Truncate over-long alternatives of primary/secondary-role
         # columns so their allocations fit one word and the bank stays
@@ -791,7 +826,19 @@ class MatcherBanks:
                     approx.append(i)
             truncated_entries.append((i, p))
         bit_entries = truncated_entries
-        self.approx_cols = approx
+        # Truncation can FLIP the bank sink-eligible (it drops \b/\B
+        # post-asserts), adding one sink bit per alternative BANK-wide —
+        # so the admission-time price can be stale. Re-price the final
+        # set and shed entries until the constructed bank fits again
+        # (shed columns fall through to the union/prefilter/dense tiers
+        # like any other reject); the loop re-prices every iteration, so
+        # eligibility flips caused by the shedding itself are priced too.
+        while bit_entries and BitGlushBank.count_packed_words(
+            [p for _, p in bit_entries], budget=bit_budget
+        ) > bit_budget:
+            bit_entries.pop()
+        kept = {i for i, _ in bit_entries}
+        self.approx_cols = [i for i in approx if i in kept]
         # ONE bank for all bit programs. A measured A/B split the
         # assert-free programs into their own light bank (no word-ness /
         # allow / caret work): cube 0.31 → 0.39s on v5e — the asserted

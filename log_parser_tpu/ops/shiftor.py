@@ -68,37 +68,67 @@ import numpy as np
 ByteSeq = tuple  # tuple[frozenset[int], ...]
 
 
-def first_fit_plan(allocs, budget: int | None = None):
-    """First-fit word-packing plan shared by the two bit tiers — THE
-    single source of the packing rule (tier gates that estimate word
-    cost must agree with the bank constructors). Allocations ≤ 32 bits
-    first-fit within any word, never straddling one (the chainless-shift
-    invariant both banks rely on); larger allocations take word-aligned
-    runs of whole words whose tail remainder stays open to first-fit.
-    Returns (per-allocation start bits, n_words); with a ``budget``,
-    bails early once the word count exceeds it."""
-    starts: list[int] = []
-    word_fill: list[int] = []
-    for alloc in allocs:
+class FirstFitPacker:
+    """Incremental first-fit packing state — the same rule as
+    :func:`first_fit_plan` (which is implemented on top of it), exposed
+    statefully so tier admission gates can price one candidate in
+    O(candidate + words) instead of repacking every already-admitted
+    allocation per candidate (r5 code review: the full repack made
+    MatcherBanks construction quadratic on wide libraries)."""
+
+    __slots__ = ("word_fill",)
+
+    def __init__(self) -> None:
+        self.word_fill: list[int] = []
+
+    def add(self, alloc: int) -> int:
+        """Pack one allocation; returns its start bit.  Allocations ≤ 32
+        bits first-fit within any word, never straddling one (the
+        chainless-shift invariant both banks rely on); larger
+        allocations take word-aligned runs of whole words whose tail
+        remainder stays open to first-fit."""
+        word_fill = self.word_fill
         if alloc > 32:
             w0 = len(word_fill)
             nw = (alloc + 31) // 32
-            starts.append(w0 * 32)
             word_fill.extend([32] * (nw - 1))
             word_fill.append(alloc - 32 * (nw - 1))
-        else:
-            w = next(
-                (i for i, used in enumerate(word_fill) if used + alloc <= 32),
-                None,
-            )
-            if w is None:
-                w = len(word_fill)
-                word_fill.append(0)
-            starts.append(w * 32 + word_fill[w])
-            word_fill[w] += alloc
-        if budget is not None and len(word_fill) > budget:
-            return starts, len(word_fill)
-    return starts, max(1, len(word_fill))
+            return w0 * 32
+        w = next(
+            (i for i, used in enumerate(word_fill) if used + alloc <= 32),
+            None,
+        )
+        if w is None:
+            w = len(word_fill)
+            word_fill.append(0)
+        start = w * 32 + word_fill[w]
+        word_fill[w] += alloc
+        return start
+
+    @property
+    def n_words(self) -> int:
+        return len(self.word_fill)
+
+    def clone(self) -> "FirstFitPacker":
+        p = FirstFitPacker()
+        p.word_fill = list(self.word_fill)
+        return p
+
+
+def first_fit_plan(allocs, budget: int | None = None):
+    """First-fit word-packing plan shared by the two bit tiers — THE
+    single source of the packing rule (tier gates that estimate word
+    cost must agree with the bank constructors; both route through
+    :class:`FirstFitPacker`).  Returns (per-allocation start bits,
+    n_words); with a ``budget``, bails early once the word count
+    exceeds it."""
+    packer = FirstFitPacker()
+    starts: list[int] = []
+    for alloc in allocs:
+        starts.append(packer.add(alloc))
+        if budget is not None and packer.n_words > budget:
+            return starts, packer.n_words
+    return starts, max(1, packer.n_words)
 
 
 class ShiftOrBank:

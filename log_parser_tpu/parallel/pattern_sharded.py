@@ -107,6 +107,9 @@ class PatternShardedEngine(AnalysisEngine):
             f"({offset} != {self.bank.n_patterns})"
         )
 
+    def _approx_sources_token(self) -> tuple:
+        return tuple(f.matchers for f, _g, _d in self._block_engines)
+
     def _approx_col_sources(self):
         """Each block's device program truncates against its OWN bank
         (role sets are computed per block, so a column primary-only in

@@ -319,6 +319,7 @@ class AnalysisEngine:
         self._k_hint = 0  # previous request's match count → starting K bucket
         self._approx_pat_mask = None  # lazy — see _approx_patterns
         self._approx_sec = None  # lazy — see _approx_secondaries
+        self._approx_token: tuple | None = None  # matcher identities the caches derive from
         # serializes frequency-coupled state (finish phase, admin routes,
         # golden fallback) across transports; the prepare phase (ingest +
         # device) deliberately runs OUTSIDE it — see analyze_pipelined
@@ -418,11 +419,33 @@ class AnalysisEngine:
     # ShardedEngine overrides these two to swap in the shard_map program;
     # everything else in analyze() is shared.
 
+    def _approx_sources_token(self) -> tuple:
+        """The matcher objects the approx caches derive from, compared by
+        IDENTITY — overridden by engines with several device programs."""
+        return (self.matchers,)
+
+    def _check_approx_caches(self) -> None:
+        """Drop the lazily-built approx caches whenever the matcher tier
+        assignment they were computed from is replaced (ADVICE r4: tests
+        swap ``self._matchers``; a stale cache would skip the host
+        re-verification of truncated columns)."""
+        token = self._approx_sources_token()
+        prev = self._approx_token
+        if (
+            prev is None
+            or len(prev) != len(token)
+            or any(a is not b for a, b in zip(prev, token))
+        ):
+            self._approx_pat_mask = None
+            self._approx_sec = None
+            self._approx_token = token
+
     def _approx_patterns(self) -> np.ndarray:
         """bool [n_patterns]: patterns whose device-side primary column
         OVER-matches (truncated >31-position bitglush alternatives —
         ops/match.py approx_cols) and whose flagged events must be
         re-verified with the exact host regex before they count."""
+        self._check_approx_caches()
         if self._approx_pat_mask is None:
             mask = np.zeros(max(1, self.bank.n_patterns), dtype=bool)
             for cols, bank, offset in self._approx_col_sources():
@@ -455,6 +478,7 @@ class AnalysisEngine:
         pattern). Conservative across sharded engines: an entry whose
         column is exact in the block that ran it still repairs cleanly
         (the claimed line verifies and the distance stands)."""
+        self._check_approx_caches()
         if self._approx_sec is None:
             cols = self._approx_global_cols()
             out = []

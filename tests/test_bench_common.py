@@ -214,6 +214,152 @@ def test_bench_mesh_smoke():
     assert doc["visible_devices"] == 2
 
 
+def test_probe_timeout_abandons_never_kills(monkeypatch, tmp_path):
+    """The round-4 relay wedge rule, code-enforced: an attempt window that
+    expires must leave the probe RUNNING (abandoned, never signaled), and
+    the next attempt must resume polling the SAME process instead of
+    spawning a second one against the single-session relay."""
+    release = tmp_path / "release"
+    src = (
+        "import os, time\n"
+        f"while not os.path.exists({str(release)!r}):\n"
+        "    time.sleep(0.05)\n"
+        "print('PROBE_OK cpu 1', flush=True)\n"
+    )
+    monkeypatch.setattr(bench_common, "_PROBE_SRC", src)
+    monkeypatch.setattr(bench_common, "_live_probe", None)
+    monkeypatch.setattr(
+        bench_common, "_PROBE_STATE_PATH", str(tmp_path / "state.json")
+    )
+    try:
+        p, diag = bench_common._one_attempt(0.5)
+        assert p is None
+        assert diag["outcome"] == "timeout" and diag["abandoned_running"]
+        proc = bench_common._live_probe["proc"]
+        assert proc.poll() is None  # alive: abandoned, not killed
+        pid1 = proc.pid
+        p2, diag2 = bench_common._one_attempt(0.3)
+        assert p2 is None and diag2["outcome"] == "timeout"
+        assert bench_common._live_probe["proc"].pid == pid1  # resumed
+        release.touch()
+        p3, diag3 = bench_common._one_attempt(15.0)
+        assert p3 == "cpu" and diag3["outcome"] == "ok"
+        assert bench_common._live_probe is None  # slot cleared on exit
+    finally:
+        lp = bench_common._live_probe
+        if lp is not None:  # only on assertion failure above
+            release.touch()
+            lp["proc"].wait(15)
+            bench_common._live_probe = None
+
+
+def test_probe_orphan_adopted_not_doubled(monkeypatch, tmp_path):
+    """A probe abandoned by a PREVIOUS bench process (handoff record left
+    on disk) must be ADOPTED — polled to completion via /proc — instead
+    of a second probe being spawned against the single-session relay
+    (two concurrent clients is the round-4 wedge condition)."""
+    import json
+
+    release = tmp_path / "release"
+    out, err = tmp_path / "probe.out", tmp_path / "probe.err"
+    src = (
+        "import os, time\n"
+        f"while not os.path.exists({str(release)!r}):\n"
+        "    time.sleep(0.05)\n"
+        "print('PROBE_OK cpu 1', flush=True)\n"
+    )
+    with open(out, "w") as fo, open(err, "w") as fe:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", src],
+            stdout=fo,
+            stderr=fe,
+            start_new_session=True,
+        )
+    state = tmp_path / "state.json"
+    state.write_text(
+        json.dumps({"pid": proc.pid, "out": str(out), "err": str(err)})
+    )
+    monkeypatch.setattr(bench_common, "_PROBE_STATE_PATH", str(state))
+    monkeypatch.setattr(bench_common, "_live_probe", None)
+    # any spawn would be a double-up: make it unmistakable in the diag
+    monkeypatch.setattr(bench_common, "_PROBE_SRC", "raise SystemExit(99)")
+    try:
+        p, diag = bench_common._one_attempt(0.4)
+        assert p is None and diag["outcome"] == "timeout"
+        assert bench_common._live_probe["pid"] == proc.pid  # adopted
+        assert bench_common._live_probe["proc"] is None
+        release.touch()
+        p2, diag2 = bench_common._one_attempt(15.0)
+        assert p2 == "cpu" and diag2["outcome"] == "ok"
+        assert diag2.get("adopted_orphan") is True
+        assert not state.exists()  # handoff record cleared at completion
+    finally:
+        release.touch()
+        proc.wait(15)
+        bench_common._live_probe = None
+
+
+def test_probe_dead_orphan_discarded(monkeypatch, tmp_path):
+    """A DEAD orphan's result is stale (its bench already fell back);
+    the record and its probe-output files are discarded, not trusted —
+    but only paths that LOOK like our probe files are unlinked (the
+    record sits in a world-writable tempdir; a forged record must not
+    turn the cleaner into arbitrary file deletion)."""
+    import json
+    import tempfile
+
+    fd_out, out = tempfile.mkstemp(prefix="lpt_probe_", suffix=".out")
+    fd_err, err = tempfile.mkstemp(prefix="lpt_probe_", suffix=".err")
+    with os.fdopen(fd_out, "w") as f:
+        f.write("PROBE_OK cpu 1")  # stale success from a prior bench
+    os.close(fd_err)
+    victim = tmp_path / "victim.txt"  # forged-path target
+    victim.write_text("do not delete")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait(15)
+    state = tmp_path / "state.json"
+    state.write_text(
+        json.dumps({"pid": proc.pid, "out": out, "err": str(victim)})
+    )
+    monkeypatch.setattr(bench_common, "_PROBE_STATE_PATH", str(state))
+    assert bench_common._adopt_orphan() is None
+    assert not state.exists() and not os.path.exists(out)
+    assert victim.exists()  # forged path survived
+    os.unlink(err)
+
+
+def test_emit_includes_relay_health(monkeypatch, capsys):
+    import json
+
+    monkeypatch.setattr(
+        bench_common, "last_relay_health", {"tiny_dispatch_ms_p50": 1.2}
+    )
+    monkeypatch.setattr(bench_common, "last_probe_diagnostics", [])
+    bench_common.emit("m", 1.0, "u", None, "tpu")
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["relay_health"] == {"tiny_dispatch_ms_p50": 1.2}
+
+
+def test_emit_omits_relay_health_when_unset(monkeypatch, capsys):
+    import json
+
+    monkeypatch.setattr(bench_common, "last_relay_health", None)
+    monkeypatch.setattr(bench_common, "last_probe_diagnostics", [])
+    bench_common.emit("m", 1.0, "u", None, "cpu")
+    assert "relay_health" not in json.loads(capsys.readouterr().out)
+
+
+def test_stamp_relay_health_timeout_records_error(monkeypatch):
+    """A wedged tiny-dispatch must degrade to an error field, never hang
+    or fail the bench — the bench's own bounded phases own wedge exits."""
+    monkeypatch.setattr(
+        bench_common, "_measure_relay_health", lambda: time.sleep(30)
+    )
+    bench_common._stamp_relay_health(budget_s=0.2)
+    assert "error" in bench_common.last_relay_health
+    bench_common.last_relay_health = None
+
+
 def test_pin_platform_cpu_pins(monkeypatch):
     import jax
 

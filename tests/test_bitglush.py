@@ -643,6 +643,94 @@ def test_chained_bank_exact_vs_host_re():
     check_exact(regexes, lines)
 
 
+def test_bitglush_budget_holds_after_truncation():
+    """The constructed bank must NEVER exceed bitglush_max_words, however
+    post-admission truncation reshapes the packing (r5 code review).
+    Two ways the admission-time price can go stale: dropping \\b/\\B
+    post-asserts can flip the bank sink-eligible (+1 bit per alternative
+    bank-wide — in engine banks the never-truncated context columns keep
+    their own \\b finals, so the flip needs every remaining b-final to
+    sit on truncated alternatives), and first-fit packing is non-monotone
+    (a SHRUNK allocation can reshuffle the plan into more words).  The
+    post-truncation re-price/shed loop in MatcherBanks is the invariant's
+    single enforcement point; this pins it across budgets on a bank mixing
+    exactly-one-word sequence columns with a truncated long primary."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    # six distinct 32-position non-literal SEQUENCE-EVENT regexes:
+    # non-truncatable (no cheap repair for the temporal chain), exactly
+    # one word each while the bank is sink-less, two words once sinks
+    # flip on (32 + 1 sink = 33 bits straddles a word boundary)
+    seq_rx = [f"stage {k} failed with retcode n" + "\\d\\d\\d" for k in range(6)]
+    assert all(
+        len(s) - 3 * len("\\d") + 3 == 32 for s in seq_rx
+    )  # 29 literal chars + 3 class positions
+    long_b = "Connection is not available, request timed out after\\b"
+    sets = [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "plong",
+                    regex=long_b,
+                    confidence=0.9,
+                    sequences=[(1.5, seq_rx)],
+                )
+            ]
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    for budget in (2, 4, 8, 12):
+        mb = MatcherBanks(
+            engine.bank,
+            bitglush_max_words=budget,
+            shiftor_min_columns=10**9,
+            prefilter_min_columns=10**9,
+            multi_min_columns=10**9,
+        )
+        if mb.bitglush is not None:
+            assert mb.bitglush.n_words <= budget, (budget, mb.bitglush.n_words)
+
+
+def test_approx_caches_invalidate_on_matcher_swap():
+    """ADVICE r4: the lazily-built approx caches are keyed on matcher
+    object identity — rebuilding/replacing ``engine._matchers`` after a
+    first analyze must refresh ``_approx_patterns``/``_approx_secondaries``,
+    or stale (empty) repair sets would skip the host re-verification of
+    truncated columns."""
+    from helpers import make_pattern, make_pattern_set
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.ops.match import MatcherBanks
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    long_lit = "Connection is not available, request timed out after"
+    sets = [
+        make_pattern_set(
+            [
+                make_pattern("plong", regex=long_lit, confidence=0.9),
+                make_pattern("pshort", regex="timed out", confidence=0.5),
+            ]
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    # default CPU-policy matchers: nothing truncated, caches built empty
+    assert not engine._approx_patterns().any()
+    assert engine._approx_secondaries() == []
+    # swap in the TPU-style tier build that truncates the long literal
+    engine._matchers = MatcherBanks(
+        engine.bank,
+        bitglush_max_words=192,
+        shiftor_min_columns=10**9,
+        prefilter_min_columns=10**9,
+        multi_min_columns=10**9,
+    )
+    assert engine.matchers.approx_cols
+    # the caches must follow the swap, not serve the stale empty sets
+    assert engine._approx_patterns().any()
+
+
 def test_truncated_primary_column_engine_exact():
     """End-to-end: a primary-only column whose long alternative is
     truncated on device must still produce EXACTLY the reference's
