@@ -299,8 +299,9 @@ class PatternBank:
             exact_seqs = exact_sequences(node)
             literals = extract_literals(node)
             # DFA is compiled (cache-amortized) even for Shift-Or-capable
-            # columns: MatcherBanks picks the tier per bank size
-            dfa = compile_regex_to_dfa_cached(regex, case_insensitive)
+            # columns: MatcherBanks picks the tier per bank size; the
+            # parsed node rides along so a cache miss doesn't re-parse
+            dfa = compile_regex_to_dfa_cached(regex, case_insensitive, node=node)
         except (RegexUnsupportedError, DfaLimitError) as exc:
             if exact_seqs is None:
                 if literals is None:

@@ -178,6 +178,7 @@ def compile_regex_to_dfa(
     regex: str,
     case_insensitive: bool = False,
     max_states: int = 4096,
+    node: Node | None = None,
 ) -> CompiledDfa:
     """Java regex → packed DFA with ``find()`` substring semantics.
 
@@ -185,8 +186,11 @@ def compile_regex_to_dfa(
     minimizes, shrinking the packed device tables — with the Python builder
     as fallback. Raises :class:`RegexUnsupportedError` (dialect) or
     :class:`DfaLimitError` (state blowup); both mean "host fallback".
-    """
-    node: Node = parse_java_regex(regex, case_insensitive)
+    ``node``: an already-parsed AST for this exact (regex, flags) pair,
+    so boot paths that parsed for literal/sequence extraction don't pay
+    the parse twice."""
+    if node is None:
+        node = parse_java_regex(regex, case_insensitive)
     nfa = build_nfa(node, unanchored_prefix=True)
 
     from log_parser_tpu.native.dfabuild import DfaLimitExceeded, build_dfa_native

@@ -184,23 +184,69 @@ class TestLimitsAndRejection:
 
 def test_dfa_disk_cache_roundtrip(tmp_path, monkeypatch):
     """A cache hit must reproduce the compiled automaton exactly; corrupt
-    entries are ignored and rewritten."""
+    pack data is ignored and the entry rebuilt."""
     import numpy as np
 
-    from log_parser_tpu.patterns.regex.cache import compile_regex_to_dfa_cached
+    from log_parser_tpu.patterns.regex import cache as c
 
     monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
-    first = compile_regex_to_dfa_cached("time(out|r)+x", False)
-    files = list(tmp_path.glob("*.npz"))
-    assert len(files) == 1
-    second = compile_regex_to_dfa_cached("time(out|r)+x", False)  # hit
+    first = c.compile_regex_to_dfa_cached("time(out|r)+x", False)
+    assert c.flush(10.0)  # entries land as a pack + index pair
+    packs = list(tmp_path.glob("*.pack"))
+    idxs = list(tmp_path.glob("*.packidx.json"))
+    assert len(packs) == 1 and len(idxs) == 1
+    # a FRESH process (cleared in-memory index) must hit the pack: patch
+    # the module-level index cache back to unloaded
+    monkeypatch.setattr(c, "_pack_index", None)
+    key = c._key("time(out|r)+x", False, 4096)
+    assert c._pack_lookup(tmp_path, key) is not None  # real disk hit
+    second = c.compile_regex_to_dfa_cached("time(out|r)+x", False)
     np.testing.assert_array_equal(first.trans, second.trans)
     np.testing.assert_array_equal(first.byte_class, second.byte_class)
     np.testing.assert_array_equal(first.accept_end, second.accept_end)
     assert (first.start, first.n_states, first.n_classes) == (
         second.start, second.n_states, second.n_classes
     )
-    files[0].write_bytes(b"garbage")
-    third = compile_regex_to_dfa_cached("time(out|r)+x", False)  # corrupt -> rebuild
+    packs[0].write_bytes(b"garbage")  # corrupt the pack data
+    monkeypatch.setattr(c, "_pack_index", None)
+    third = c.compile_regex_to_dfa_cached("time(out|r)+x", False)  # rebuild
     np.testing.assert_array_equal(first.trans, third.trans)
     assert third.matches(b"timeoutx") and not third.matches(b"time")
+    # the rebuild republished under a LATER time-ordered stem: a fresh
+    # process's index must serve the good entry even though the torn
+    # pack is still on disk (newest-wins collision rule)
+    assert c.flush(10.0)
+    monkeypatch.setattr(c, "_pack_index", None)
+    blob = c._pack_lookup(tmp_path, key)
+    assert blob is not None
+    z = c._read_arrays(blob)  # parses cleanly -> the repair won
+    assert int(z["start"]) == third.start
+
+
+def test_dfa_pack_compaction(tmp_path, monkeypatch):
+    """Session packs accumulate one pair per cold build; crossing the
+    compaction threshold must merge live entries into ONE pack, drop the
+    old files, and keep every entry readable."""
+    from log_parser_tpu.patterns.regex import cache as c
+
+    monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+    monkeypatch.setattr(c, "_PACK_COMPACT_AT", 100)  # no mid-loop compaction
+    regexes = [f"compacted{i}[0-9]+" for i in range(6)]
+    for rx in regexes:  # one flush per regex = one pack pair each
+        monkeypatch.setattr(c, "_pack_index", None)
+        c.compile_regex_to_dfa_cached(rx, False)
+        assert c.flush(10.0)
+    assert len(list(tmp_path.glob("*.packidx.json"))) == 6
+    monkeypatch.setattr(c, "_PACK_COMPACT_AT", 4)
+    monkeypatch.setattr(c, "_pack_index", None)
+    idx = c._load_pack_index(tmp_path)  # crosses threshold -> compacts
+    assert len(list(tmp_path.glob("*.packidx.json"))) == 1
+    assert len(list(tmp_path.glob("*.pack"))) == 1
+    for rx in regexes:  # every entry survived, via the caller's view
+        key = c._key(rx, False, 4096)
+        assert idx.get(key) is not None
+        assert c._read_arrays(c._pack_lookup(tmp_path, key))["trans"].size
+    # and via a fresh load
+    monkeypatch.setattr(c, "_pack_index", None)
+    for rx in regexes:
+        assert c._pack_lookup(tmp_path, c._key(rx, False, 4096)) is not None

@@ -26,10 +26,13 @@ Three modes:
   31000..31005).
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
-       [--sharded | --pattern-sharded | --long]
+       [--sharded | --pattern-sharded | --long | --quick]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
 9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
 the documented records below; --end exclusive)
+``--quick`` is the CI tier: the first 5 seeds of EVERY mode in one
+process (~2 min), run as a workflow job after the suite so a parity
+regression in any engine mode fails the PR (VERDICT r4 #5).
 Record (round-4 engine, 2026-07-30): default seeds 8..199 (192 libraries,
 576 corpora) clean; sharded seeds 1004..1053 (50 libraries) clean;
 pattern-sharded seeds 9003..9052 (50 libraries, n_blocks cycling 1/3/4)
@@ -78,31 +81,50 @@ def main() -> int:
     mode.add_argument("--sharded", action="store_true")
     mode.add_argument("--pattern-sharded", action="store_true")
     mode.add_argument("--long", action="store_true")
+    mode.add_argument(
+        "--quick",
+        action="store_true",
+        help="CI tier: 5 seeds of EVERY mode (VERDICT r4 #5 — a parity "
+        "regression in any engine mode fails the PR, not a future "
+        "manual sweep); --start/--end are ignored",
+    )
     args = ap.parse_args()
+    if args.quick:
+        rc = 0
+        for m in ("default", "sharded", "pattern-sharded", "long"):
+            start = _MODE_DEFAULTS[m][0]
+            print(f"== quick sweep: {m} seeds {start}..{start + 4}", flush=True)
+            rc |= run_sweep(m, start, start + 5)
+        return rc
+    m = (
+        "sharded"
+        if args.sharded
+        else "pattern-sharded"
+        if args.pattern_sharded
+        else "long"
+        if args.long
+        else "default"
+    )
     # per-mode defaults: a bare run reproduces the documented record,
     # and each mode's seed space stays disjoint from the suite's pinned
     # seeds and the other modes' sweeps
-    if args.start is None:
-        args.start = (
-            1004
-            if args.sharded
-            else 9003
-            if args.pattern_sharded
-            else 31006
-            if args.long
-            else 8
-        )
-    if args.end is None:
-        args.end = (
-            1054
-            if args.sharded
-            else 9053
-            if args.pattern_sharded
-            else 31056
-            if args.long
-            else 200
-        )
+    start, end = _MODE_DEFAULTS[m]
+    if args.start is not None:
+        start = args.start
+    if args.end is not None:
+        end = args.end
+    return run_sweep(m, start, end)
 
+
+_MODE_DEFAULTS = {
+    "default": (8, 200),
+    "sharded": (1004, 1054),
+    "pattern-sharded": (9003, 9053),
+    "long": (31006, 31056),
+}
+
+
+def run_sweep(mode: str, start: int, end: int) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -127,10 +149,10 @@ def main() -> int:
     )
     from log_parser_tpu.runtime import AnalysisEngine
 
-    mesh = make_mesh(8) if args.sharded else None
+    mesh = make_mesh(8) if mode == "sharded" else None
     t0 = time.time()
     fails: list[tuple[int, str]] = []
-    for seed in range(args.start, args.end):
+    for seed in range(start, end):
         rng = random.Random(seed)
         # construction inside the guard: a library the compiler rejects
         # is exactly the kind of find the sweep records, not an abort.
@@ -139,12 +161,12 @@ def main() -> int:
         # exactly (rng call order included, so seed N here draws the
         # same library the suite's seed N would).
         try:
-            if args.sharded:
+            if mode == "sharded":
                 sets = random_library(rng, rng.randrange(2, 6))
                 config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
                 engine = ShardedEngine(sets, config, mesh=mesh, clock=FakeClock())
                 n_runs, lines_lo, lines_hi = 2, 5, 90
-            elif args.pattern_sharded:
+            elif mode == "pattern-sharded":
                 sets = random_library(rng, rng.randrange(3, 7))
                 config = ScoringConfig(frequency_threshold=rng.choice([2.0, 10.0]))
                 engine = PatternShardedEngine(
@@ -154,7 +176,7 @@ def main() -> int:
                     clock=FakeClock(),
                 )
                 n_runs, lines_lo, lines_hi = 2, 20, 200
-            elif args.long:
+            elif mode == "long":
                 sets = random_long_library(rng, rng.randrange(2, 6))
                 config = ScoringConfig(proximity_max_window=rng.choice([5, 100]))
                 engine = AnalysisEngine(sets, config, clock=FakeClock())
@@ -172,7 +194,7 @@ def main() -> int:
                 engine = AnalysisEngine(sets, config, clock=FakeClock())
                 n_runs, lines_lo, lines_hi = 3, 5, 120
             golden = GoldenAnalyzer(sets, config, clock=FakeClock())
-            gen_logs = random_long_logs if args.long else random_logs
+            gen_logs = random_long_logs if mode == "long" else random_logs
             for _ in range(n_runs):  # frequency state must evolve identically
                 logs = gen_logs(rng, rng.randrange(lines_lo, lines_hi))
                 data = PodFailureData(pod={"metadata": {"name": "fuzz"}}, logs=logs)
@@ -189,7 +211,7 @@ def main() -> int:
             print(f"SEED {seed} FAILED: {exc!r}", flush=True)
         if seed % 20 == 0:
             print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
-    print(f"DONE seeds {args.start}..{args.end - 1} fails: {fails} "
+    print(f"DONE {mode} seeds {start}..{end - 1} fails: {fails} "
           f"({time.time() - t0:.0f}s)")
     return 1 if fails else 0
 
