@@ -155,6 +155,31 @@ def main() -> None:
         bounded(serial, budget_s, "stream")
     lat.sort()
 
+    # decompose request latency into engine phases (VERDICT r4 #7): the
+    # HTTP/tunnel share of p99 is (request p99 - engine-total p99), and
+    # device_step_ms is the device dispatch+sync phase alone — config-5
+    # on the tunneled chip is RTT-dominated (~6 ms CPU floor for
+    # identical host code), and without this split an engine regression
+    # is indistinguishable from tunnel weather in the artifact
+    traces = list(engine.trace_history)[-REQUESTS:]
+    phase_pcts: dict[str, object] = {}
+    if traces:
+        for name in ("device", "ingest", "finalize", "lock_wait"):
+            vals = sorted(1e3 * t.as_dict().get(name, 0.0) for t in traces)
+            phase_pcts[f"{name}_ms"] = {
+                "p50": round(percentile(vals, 0.50), 3),
+                "p99": round(percentile(vals, 0.99), 3),
+            }
+        totals = sorted(1e3 * t.total for t in traces)
+        phase_pcts["engine_total_ms"] = {
+            "p50": round(percentile(totals, 0.50), 3),
+            "p99": round(percentile(totals, 0.99), 3),
+        }
+        # the trace deque is bounded (maxlen 512): when --requests
+        # exceeds it, the phase stats cover only this tail window while
+        # the headline p99 covers the whole run — say so in the artifact
+        phase_pcts["phase_sample_n"] = len(traces)
+
     bench_common.emit(
         metric,
         round(percentile(lat, 0.99), 3),
@@ -162,6 +187,7 @@ def main() -> None:
         round(percentile(lat, 0.50), 3),
         platform,
         n_requests=REQUESTS,
+        **phase_pcts,
     )
 
 

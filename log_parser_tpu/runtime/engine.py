@@ -32,6 +32,7 @@ import os
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Callable
 
 import numpy as np
@@ -327,6 +328,7 @@ class AnalysisEngine:
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
         self.last_trace: PhaseTrace | None = None
+        self.trace_history: deque[PhaseTrace] = deque(maxlen=512)
         self.last_finalized: FinalizedBatch | None = None
         # how many requests this engine served from the golden host path
         # because the device layer failed (surfaced via GET /trace/last)
@@ -648,7 +650,14 @@ class AnalysisEngine:
         except Exception as exc:
             with lock:
                 return self._serve_fallback(data, exc)
-        with lock:
+        # lock WAIT is a traced phase: under concurrency the finish
+        # phases serialize here, and a latency decomposition that omits
+        # the wait would misattribute it to HTTP/tunnel transport.
+        # ``lock`` may be a real Lock (pipelined) or a nullcontext
+        # (bare analyze), so enter/exit the context protocol directly.
+        with prepared.trace.phase("lock_wait"):
+            lock.__enter__()
+        try:
             # roll frequency state back on ANY failure: a partially-run
             # request (e.g. one that died after recording its matches)
             # must not leave the tracker double-counted — whether golden
@@ -659,6 +668,8 @@ class AnalysisEngine:
             except Exception as exc:
                 self.frequency._load_state(saved_freq)
                 return self._serve_fallback(data, exc)
+        finally:
+            lock.__exit__(None, None, None)
 
     def _serve_fallback(self, data: PodFailureData, exc: Exception) -> AnalysisResult:
         """Serve ``data`` from the golden host path if ``exc`` is a device
@@ -769,5 +780,10 @@ class AnalysisEngine:
                 summary=build_summary(events),
             )
         self.last_trace = trace
+        # bounded history for latency decomposition (bench_latency emits
+        # device-phase percentiles beside the HTTP p99, so a reader can
+        # split engine time from tunnel RTT — VERDICT r4 #7); deque
+        # appends are thread-safe under concurrent _finish callers
+        self.trace_history.append(trace)
         self.last_finalized = fin
         return result
