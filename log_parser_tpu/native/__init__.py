@@ -89,6 +89,43 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lpn_multi_dfa_read.restype = None
     lib.lpn_multi_dfa_free.argtypes = [ctypes.c_void_p]
     lib.lpn_multi_dfa_free.restype = None
+
+    lib.lpn_regex_batch_build.argtypes = [
+        u8p, i64p, u8p, ctypes.c_int32,      # blob, offs, ci flags, n
+        u8p,                                  # word mask
+        ctypes.c_int32, ctypes.c_int32,       # max_states, do_minimize
+    ]
+    lib.lpn_regex_batch_build.restype = ctypes.c_void_p
+    lib.lpn_regex_batch_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32p, i32p, i32p,
+    ]
+    lib.lpn_regex_batch_get.restype = ctypes.c_int32
+    lib.lpn_regex_batch_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32p, i32p, u8p,
+    ]
+    lib.lpn_regex_batch_read.restype = None
+    lib.lpn_regex_batch_extract_totals.argtypes = [
+        ctypes.c_void_p, i64p, i64p, i64p, i64p, i64p,
+    ]
+    lib.lpn_regex_batch_extract_totals.restype = None
+    lib.lpn_regex_batch_extract_all.argtypes = [
+        ctypes.c_void_p,
+        i8p, i32p, i64p, u8p, u8p,   # lit status/counts/offs/ci/blob
+        i8p, i32p, i32p, i32p, u8p,  # seq status/counts/lens/pos_counts/blob
+    ]
+    lib.lpn_regex_batch_extract_all.restype = None
+    lib.lpn_regex_batch_free.argtypes = [ctypes.c_void_p]
+    lib.lpn_regex_batch_free.restype = None
+
+    lib.lpn_ac_build.argtypes = [
+        u8p, i64p, i32p, ctypes.c_int32, ctypes.c_int32,  # blob, offs, groups, n, n_groups
+        i32p, i32p, i32p,                                  # out nodes/classes/words
+    ]
+    lib.lpn_ac_build.restype = ctypes.c_void_p
+    lib.lpn_ac_read.argtypes = [ctypes.c_void_p, i32p, i32p, u32p, u8p]
+    lib.lpn_ac_read.restype = None
+    lib.lpn_ac_free.argtypes = [ctypes.c_void_p]
+    lib.lpn_ac_free.restype = None
     return lib
 
 

@@ -131,6 +131,156 @@ def build_dfa_native(nfa: Nfa, max_states: int = 4096, minimize: bool = True):
     return trans, byte_class, accept.astype(bool), out_start.value
 
 
+def _read_extraction_all(lib, handle, n: int):
+    """Per-regex (literals | None, exact_seqs | None) pairs — the native
+    port of patterns/regex/literals.py, transferred for the WHOLE batch
+    in one call (per-regex crossings measured ~0.6 s at 10k) and
+    reconstructed into the same Literal frozensets / byteset-sequence
+    tuples.  Position bytesets come as compact byte LISTS, so each
+    frozenset builds straight off a bytes slice."""
+    from log_parser_tpu.patterns.regex.literals import Literal
+
+    t_lit = ctypes.c_int64(0)
+    t_lit_b = ctypes.c_int64(0)
+    t_seq = ctypes.c_int64(0)
+    t_pos = ctypes.c_int64(0)
+    t_seq_b = ctypes.c_int64(0)
+    lib.lpn_regex_batch_extract_totals(
+        handle, ctypes.byref(t_lit), ctypes.byref(t_lit_b),
+        ctypes.byref(t_seq), ctypes.byref(t_pos), ctypes.byref(t_seq_b),
+    )
+    p = _p
+    lit_status = np.zeros(n, dtype=np.int8)
+    lit_counts = np.zeros(n, dtype=np.int32)
+    lit_offs = np.zeros(t_lit.value + 1, dtype=np.int64)
+    lit_ci = np.zeros(max(1, t_lit.value), dtype=np.uint8)
+    lit_blob_a = np.zeros(max(1, t_lit_b.value), dtype=np.uint8)
+    seq_status = np.zeros(n, dtype=np.int8)
+    seq_counts = np.zeros(n, dtype=np.int32)
+    seq_lens = np.zeros(max(1, t_seq.value), dtype=np.int32)
+    pos_counts = np.zeros(max(1, t_pos.value), dtype=np.int32)
+    seq_blob_a = np.zeros(max(1, t_seq_b.value), dtype=np.uint8)
+    lib.lpn_regex_batch_extract_all(
+        handle,
+        p(lit_status, ctypes.c_int8), p(lit_counts, ctypes.c_int32),
+        p(lit_offs, ctypes.c_int64), p(lit_ci, ctypes.c_uint8),
+        p(lit_blob_a, ctypes.c_uint8),
+        p(seq_status, ctypes.c_int8), p(seq_counts, ctypes.c_int32),
+        p(seq_lens, ctypes.c_int32), p(pos_counts, ctypes.c_int32),
+        p(seq_blob_a, ctypes.c_uint8),
+    )
+    lit_blob = lit_blob_a.tobytes()
+    seq_blob = seq_blob_a.tobytes()
+    loffs = lit_offs.tolist()
+    lcis = lit_ci.tolist()
+    slens = seq_lens.tolist()
+    pcounts = pos_counts.tolist()
+    out = []
+    lk = 0
+    sk = 0
+    pk = 0
+    sboff = 0
+    for r in range(n):
+        literals = None
+        if lit_status[r] == 0:
+            nl = int(lit_counts[r])
+            literals = frozenset(
+                Literal(lit_blob[loffs[lk + k]:loffs[lk + k + 1]],
+                        bool(lcis[lk + k]))
+                for k in range(nl)
+            )
+            lk += nl
+        seqs = None
+        if seq_status[r] == 0:
+            built = []
+            for s in range(int(seq_counts[r])):
+                ln = slens[sk]
+                sk += 1
+                pos_sets = []
+                for _ in range(ln):
+                    cnt = pcounts[pk]
+                    pk += 1
+                    pos_sets.append(frozenset(seq_blob[sboff:sboff + cnt]))
+                    sboff += cnt
+                built.append(tuple(pos_sets))
+            seqs = tuple(built) if built else None
+        out.append((literals, seqs))
+    return out
+
+
+def build_dfas_batch(
+    entries: list[tuple[str, bool]], max_states: int = 4096,
+    minimize: bool = True, with_extraction: bool = False,
+):
+    """Compile ``entries`` (regex, case_insensitive) through the fully
+    native parse → Thompson → subset pipeline in ONE call.
+
+    Returns a list aligned with ``entries``: ``(trans, byte_class,
+    accept, start)`` per success, ``None`` where the native port
+    declined (unsupported construct or state cap) — the caller runs the
+    Python pipeline for those, which reproduces the exact
+    RegexUnsupportedError/DfaLimitError classification.  Returns None
+    for the WHOLE batch when the native library is unavailable.
+    With ``with_extraction`` each success becomes a 3-tuple
+    ``(dfa_arrays, literals, exact_seqs)`` — the native port of
+    literals.py computed on the same parse.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not entries:
+        return []
+    pats = [r.encode("utf-8") for r, _ in entries]
+    blob = np.frombuffer(b"".join(pats) or b"\0", dtype=np.uint8)
+    offs = np.zeros(len(entries) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in pats], out=offs[1:])
+    ci = np.asarray([1 if c else 0 for _, c in entries], dtype=np.uint8)
+
+    p = _p
+    handle = lib.lpn_regex_batch_build(
+        p(blob, ctypes.c_uint8), p(offs, ctypes.c_int64),
+        p(ci, ctypes.c_uint8), len(entries),
+        p(_WORD_MASK, ctypes.c_uint8), max_states, int(minimize),
+    )
+    if not handle:
+        return None
+    out = []
+    try:
+        extraction = (
+            _read_extraction_all(lib, handle, len(entries))
+            if with_extraction
+            else None
+        )
+        ns = ctypes.c_int32(0)
+        nc = ctypes.c_int32(0)
+        start = ctypes.c_int32(0)
+        for i in range(len(entries)):
+            status = lib.lpn_regex_batch_get(
+                handle, i, ctypes.byref(ns), ctypes.byref(nc),
+                ctypes.byref(start),
+            )
+            if status != 0:
+                out.append(None)
+                continue
+            trans = np.zeros((ns.value, nc.value), dtype=np.int32)
+            byte_class = np.zeros(256, dtype=np.int32)
+            accept = np.zeros(ns.value, dtype=np.uint8)
+            lib.lpn_regex_batch_read(
+                handle, i,
+                p(trans, ctypes.c_int32), p(byte_class, ctypes.c_int32),
+                p(accept, ctypes.c_uint8),
+            )
+            arrays = (trans, byte_class, accept.astype(bool), start.value)
+            if extraction is not None:
+                lits, seqs = extraction[i]
+                out.append((arrays, lits, seqs))
+            else:
+                out.append(arrays)
+    finally:
+        lib.lpn_regex_batch_free(handle)
+    return out
+
+
 def build_multi_dfa_native(
     nfa: Nfa, finals: list[int], max_states: int = 8192, minimize: bool = True
 ):

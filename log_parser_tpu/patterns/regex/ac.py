@@ -52,6 +52,9 @@ class AhoCorasick:
         self.n_groups = (max(groups) + 1) if groups else 0
         self.n_words = max(1, (self.n_groups + 31) // 32)
 
+        if self._build_native(literals, groups):
+            return
+
         # --- trie -----------------------------------------------------------
         children: list[dict[int, int]] = [{}]
         out: list[set[int]] = [set()]
@@ -110,6 +113,62 @@ class AhoCorasick:
         self.byte_class = byte_class
         self.out_words = out_words
         self.has_out = out_words.any(axis=1)
+
+    def _build_native(self, literals: list[bytes], groups: list[int]) -> bool:
+        """Native trie/BFS build (same algorithm, C++): the Python BFS is
+        ~1.6 s of a 10k-library cold boot.  False -> Python fallback."""
+        import ctypes
+
+        from log_parser_tpu.native import get_lib
+
+        lib = get_lib()
+        if lib is None:
+            return False
+        blob = np.frombuffer(b"".join(literals) or b"\0", dtype=np.uint8)
+        offs = np.zeros(len(literals) + 1, dtype=np.int64)
+        np.cumsum([len(t) for t in literals], out=offs[1:])
+        groups_a = np.asarray(groups or [0], dtype=np.int32)
+
+        def p(arr, ct):
+            return arr.ctypes.data_as(ctypes.POINTER(ct))
+
+        out_nodes = ctypes.c_int32(0)
+        out_classes = ctypes.c_int32(0)
+        out_nwords = ctypes.c_int32(0)
+        handle = lib.lpn_ac_build(
+            p(blob, ctypes.c_uint8), p(offs, ctypes.c_int64),
+            p(groups_a, ctypes.c_int32), len(literals), self.n_groups,
+            ctypes.byref(out_nodes), ctypes.byref(out_classes),
+            ctypes.byref(out_nwords),
+        )
+        if not handle:
+            return False
+        try:
+            nn, nc = out_nodes.value, out_classes.value
+            if out_nwords.value != self.n_words:
+                # native/Python word-count disagreement (e.g. a stale
+                # prebuilt .so): fall back rather than size-mismatch the
+                # read below — never an assert, which -O would strip
+                # right in front of a native-sized memcpy
+                return False
+            goto = np.zeros((nn, nc), dtype=np.int32)
+            byte_class = np.zeros(256, dtype=np.int32)
+            out_words = np.zeros((nn, self.n_words), dtype=np.uint32)
+            has_out = np.zeros(nn, dtype=np.uint8)
+            lib.lpn_ac_read(
+                handle,
+                p(goto, ctypes.c_int32), p(byte_class, ctypes.c_int32),
+                p(out_words, ctypes.c_uint32), p(has_out, ctypes.c_uint8),
+            )
+        finally:
+            lib.lpn_ac_free(handle)
+        self.n_nodes = nn
+        self.n_classes = nc
+        self.goto = goto
+        self.byte_class = byte_class
+        self.out_words = out_words
+        self.has_out = has_out.astype(bool)
+        return True
 
     # ---------------------------------------------------------- disk cache
 

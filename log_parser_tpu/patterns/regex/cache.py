@@ -48,24 +48,6 @@ COMPILER_VERSION = 2
 _MAGIC = b"LPDFA\x02"
 
 
-def _write_arrays(f, arrays: dict[str, np.ndarray]) -> None:
-    f.write(_MAGIC)
-    f.write(len(arrays).to_bytes(2, "little"))
-    for name, a in arrays.items():
-        # reshape back after ascontiguousarray: it promotes 0-d scalars
-        # to shape (1,), which would round-trip start/n_states as 1-d
-        # and break int() on future numpy
-        shp = np.shape(a)
-        a = np.ascontiguousarray(a).reshape(shp)
-        # newline-separated: dtype.str itself contains "|" for
-        # byte-order-free dtypes (bool is "|b1"), so "|" can't delimit
-        head = f"{name}\n{a.dtype.str}\n{','.join(map(str, a.shape))}".encode()
-        f.write(len(head).to_bytes(2, "little"))
-        f.write(head)
-        f.write(a.nbytes.to_bytes(8, "little"))
-        f.write(a.tobytes())
-
-
 def _read_arrays(buf: bytes) -> dict[str, np.ndarray]:
     if buf[: len(_MAGIC)] != _MAGIC:
         raise ValueError("bad magic")
@@ -427,19 +409,33 @@ def compile_regex_to_dfa_cached(
                     _pack_index.pop(key, None)  # don't re-hit the torn bytes
 
     dfa = compile_regex_to_dfa(regex, case_insensitive, max_states, node=node)
-    import io
-
-    buf = io.BytesIO()
-    _write_arrays(
-        buf,
-        {
-            "trans": dfa.trans,
-            "byte_class": dfa.byte_class,
-            "accept_end": dfa.accept_end,
-            "start": np.int64(dfa.start),
-            "n_states": np.int64(dfa.n_states),
-            "n_classes": np.int64(dfa.n_classes),
-        },
-    )
-    _pack_enqueue(cache, key, buf.getvalue())
+    _pack_enqueue(cache, key, _entry_bytes(dfa))
     return dfa
+
+
+def _entry_bytes(dfa: CompiledDfa) -> bytes:
+    """THE entry writer (:func:`_read_arrays` is its inverse): flat
+    bytes-join of MAGIC, count, then per-array
+    ``len(head) | head | nbytes | raw`` records.  Heads are
+    newline-separated ``name\\ndtype\\nshape`` (dtype.str contains "|"
+    for byte-order-free dtypes like bool, so "|" can't delimit);
+    ``reshape`` after ``ascontiguousarray`` keeps 0-d scalars 0-d."""
+    parts = [_MAGIC, (6).to_bytes(2, "little")]
+    for name, a in (
+        ("trans", dfa.trans),
+        ("byte_class", dfa.byte_class),
+        ("accept_end", dfa.accept_end),
+        ("start", np.int64(dfa.start)),
+        ("n_states", np.int64(dfa.n_states)),
+        ("n_classes", np.int64(dfa.n_classes)),
+    ):
+        shp = np.shape(a)
+        a = np.ascontiguousarray(a).reshape(shp)
+        head = f"{name}\n{a.dtype.str}\n{','.join(map(str, shp))}".encode()
+        parts.append(len(head).to_bytes(2, "little"))
+        parts.append(head)
+        parts.append(a.nbytes.to_bytes(8, "little"))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+

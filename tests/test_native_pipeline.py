@@ -1,0 +1,315 @@
+"""Differential tests: native batched regex pipeline vs the Python one.
+
+The C++ port (native/log_parser_native.cpp section 4) re-implements the
+STRICT mode of patterns/regex/parser.py + nfa.py so a whole library
+compiles in one native call.  Its contract: for every regex it either
+produces an automaton BEHAVIORALLY equal to the Python pipeline's, or
+declines (status != 0) exactly where the Python pipeline raises
+RegexUnsupportedError / DfaLimitError — it may never succeed with
+different semantics.  These tests hold that contract over a curated
+feature corpus, the builtin pattern library, and the synthetic bench
+shapes.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from log_parser_tpu.native import get_lib
+from log_parser_tpu.native.dfabuild import build_dfas_batch
+from log_parser_tpu.patterns.regex.dfa import (
+    CompiledDfa,
+    DfaLimitError,
+    compile_regex_to_dfa,
+)
+from log_parser_tpu.patterns.regex.parser import RegexUnsupportedError
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="native library unavailable"
+)
+
+# (regex, ci) pairs covering every construct the parser handles, plus the
+# unsupported ones (which must decline on BOTH sides)
+FEATURE_CORPUS = [
+    ("error", False),
+    ("Error", True),
+    ("time(out|r)+x", False),
+    ("^anchored start", False),
+    ("trailing end$", False),
+    ("\\bword\\b", False),
+    ("non\\Bboundary", False),
+    ("\\AabsStart and \\z", False),
+    ("before final \\Z", False),
+    ("a.c", False),
+    ("x\\d+y", False),
+    ("\\D\\w\\W\\s\\S", False),
+    ("[abc]+", False),
+    ("[a-f0-9]{2,4}", False),
+    ("[^xyz]", False),
+    ("[\\d\\s]", False),
+    ("[\\x41-\\x5a]", False),
+    ("[\\u0041b]", False),
+    ("[-a]", False),
+    ("[a-]", False),
+    ("[]x]", False),  # first ']' is literal
+    ("[\\n\\t\\r\\f\\a\\e]", False),
+    ("\\x41\\u0042", False),
+    ("\\Qliteral.*+?()\\E tail", False),
+    ("\\Q unterminated quote", False),
+    ("\\n\\t\\r\\f\\a\\e", False),
+    ("(?:group)ed", False),
+    ("(?<name>named)", False),
+    ("(?i)rest insensitive", False),
+    ("pre(?i:mid)post", False),
+    ("(?i)outer(?-i:inner)", True),
+    ("a{3}", False),
+    ("a{2,}", False),
+    ("a{2,5}", False),
+    ("a{,5}", False),  # literal brace in Java
+    ("a{}", False),
+    ("lazy.*?end", False),
+    ("(\\b)*quantified assertion", False),
+    ("(\\b)+kept", False),
+    ("café utf8", False),
+    ("\\u00e9scape", False),
+    ("\\p{Alpha}\\p{Digit}\\p{Punct}", False),
+    ("\\P{Digit}", False),
+    ("[\\p{Upper}]", False),
+    ("escaped \\. \\* \\( \\[ \\\\", False),
+    ("status=[45]\\d\\d", False),
+    ("pod-\\w+-[0-9a-f]{5}", False),
+    ("^\\s*at\\s+[\\w\\.\\$]+\\(.*\\)\\s*$", False),
+    ("\\b(ERROR|FATAL|CRITICAL|SEVERE)\\b", False),
+    ("\\b\\w*Exception\\b|\\b\\w*Error\\b", False),
+    ("", False),
+    ("()", False),
+    ("a|", False),
+    ("|b", False),
+    # unsupported on both sides
+    ("look(?=ahead)", False),
+    ("look(?!neg)", False),
+    ("(?<=behind)x", False),
+    ("(?<!negbehind)x", False),
+    ("back(ref)\\1", False),
+    ("named(?<g>x)\\k<g>", False),
+    ("atomic(?>group)", False),
+    ("possessive a*+", False),
+    ("class[a&&b]", False),
+    ("octal \\0101", False),
+    ("control \\cA", False),
+    ("\\G anchored", False),
+    ("a{100}", True),  # counted rep beyond MAX_COUNTED=64
+    ("nested [[a]]", False),
+    ("[é]", False),  # non-ASCII in class
+    ("bad flag (?m:x)", False),
+    ("\\p{IsGreek}", False),
+    ("trailing backslash \\", False),
+    ("unbalanced (", False),
+    ("unbalanced )", False),
+    ("dangling *", False),
+    ("reversed [z-a]", False),
+    ("bad quant a{5,2}", False),
+]
+
+
+def _python_compile(rx: str, ci: bool):
+    try:
+        return compile_regex_to_dfa(rx, ci)
+    except (RegexUnsupportedError, DfaLimitError):
+        return None
+
+
+def _to_dfa(rx: str, item) -> CompiledDfa:
+    trans, byte_class, accept, start = item
+    return CompiledDfa(
+        regex=rx,
+        trans=trans,
+        byte_class=byte_class,
+        accept_end=accept,
+        start=start,
+        n_states=trans.shape[0],
+        n_classes=trans.shape[1],
+    )
+
+
+def _probe_inputs(rx: str) -> list[bytes]:
+    """Inputs biased toward the regex's own bytes plus structured noise."""
+    rng = random.Random(hash(rx) & 0xFFFF)
+    lits = rx.encode("utf-8", "ignore")
+    alphabet = (lits.replace(b"\\", b"") or b"ab") + b" aA0_.-\tz\r"
+    out = [
+        b"",
+        lits,
+        b" " + lits + b" ",
+        lits.lower(),
+        lits.upper(),
+        b"prefix " + lits,
+        lits + b" suffix",
+        lits + b"\r",
+    ]
+    for _ in range(40):
+        n = rng.randrange(0, 24)
+        out.append(bytes(rng.choice(alphabet) for _ in range(n)))
+    return out
+
+
+def _assert_equivalent(rx: str, ci: bool, py, nat) -> None:
+    if py is None:
+        assert nat is None, f"{rx!r}: python declines but native compiled"
+        return
+    assert nat is not None, f"{rx!r}: native declined but python compiles"
+    ndfa = _to_dfa(rx, nat)
+    for s in _probe_inputs(rx):
+        assert py.matches(s) == ndfa.matches(s), (
+            f"{rx!r} disagrees on {s!r}: "
+            f"python={py.matches(s)} native={ndfa.matches(s)}"
+        )
+
+
+def test_feature_corpus_equivalence():
+    batch = build_dfas_batch(FEATURE_CORPUS)
+    assert batch is not None and len(batch) == len(FEATURE_CORPUS)
+    for (rx, ci), nat in zip(FEATURE_CORPUS, batch):
+        _assert_equivalent(rx, ci, _python_compile(rx, ci), nat)
+
+
+def test_builtin_library_equivalence():
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    entries: list[tuple[str, bool]] = []
+    for ps in load_builtin_pattern_sets():
+        for p in ps.patterns:
+            if p.primary_pattern:
+                entries.append((p.primary_pattern.regex, False))
+            for sec in p.secondary_patterns or []:
+                entries.append((sec.regex, False))
+            for seq in p.sequence_patterns or []:
+                for ev in seq.events or []:
+                    entries.append((ev.regex, False))
+    entries = sorted(set(entries))
+    assert len(entries) > 80
+    batch = build_dfas_batch(entries)
+    assert batch is not None
+    n_native = sum(1 for item in batch if item is not None)
+    for (rx, ci), nat in zip(entries, batch):
+        _assert_equivalent(rx, ci, _python_compile(rx, ci), nat)
+    # the whole builtin library must ride the native pipeline (its dialect
+    # is the port's floor) — a silent mass-decline would erase the boot win
+    assert n_native == len(entries)
+
+
+def test_synthetic_bench_shapes_equivalence():
+    import sys
+
+    sys.path.insert(0, "")  # repo root on path for bench_bank
+    import bench_bank
+
+    sets = bench_bank.synth_library(200)
+    entries = []
+    for ps in sets:
+        for p in ps.patterns:
+            entries.append((p.primary_pattern.regex, False))
+            for sec in p.secondary_patterns or []:
+                entries.append((sec.regex, False))
+    batch = build_dfas_batch(entries)
+    assert batch is not None
+    for (rx, ci), nat in zip(entries, batch):
+        _assert_equivalent(rx, ci, _python_compile(rx, ci), nat)
+    assert all(item is not None for item in batch)
+
+
+def test_extraction_equivalence():
+    """Native literal/exact-sequence extraction must EQUAL the Python
+    one — including set contents, ci folding, truncation, sequence
+    order (it feeds Shift-Or packing), and the None classifications."""
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.patterns.regex.literals import (
+        exact_sequences,
+        extract_literals,
+    )
+    from log_parser_tpu.patterns.regex.parser import parse_java_regex
+
+    entries = [e for e in FEATURE_CORPUS]
+    for ps in load_builtin_pattern_sets():
+        for p in ps.patterns:
+            if p.primary_pattern:
+                entries.append((p.primary_pattern.regex, False))
+            for sec in p.secondary_patterns or []:
+                entries.append((sec.regex, False))
+    entries = sorted(set(entries))
+    batch = build_dfas_batch(entries, with_extraction=True)
+    assert batch is not None
+    checked = 0
+    for (rx, ci), item in zip(entries, batch):
+        if item is None:
+            continue
+        _, nat_lits, nat_seqs = item
+        node = parse_java_regex(rx, ci)
+        assert nat_lits == extract_literals(node), rx
+        assert nat_seqs == exact_sequences(node), rx
+        checked += 1
+    assert checked > 100
+
+
+def test_ac_native_matches_python(monkeypatch):
+    """The native AC build must produce ARRAY-identical tables to the
+    Python BFS (same algorithm, same insertion/class order)."""
+    import numpy as np
+
+    import log_parser_tpu.native as native_mod
+    from log_parser_tpu.patterns.regex.ac import AhoCorasick
+
+    cases = [
+        ([b"error", b"err", b"rror", b"timeout", b"time", b"out", b"x", b"",
+          b"status=ok", b"statue"], [0, 0, 1, 2, 3, 1, 4, 5, 2, 3]),
+        ([b"a"], None),
+        ([], None),
+    ]
+    rng = random.Random(99)
+    for _ in range(5):
+        lits = [
+            bytes(rng.randrange(97, 123) for _ in range(rng.randrange(1, 12)))
+            for _ in range(rng.randrange(2, 60))
+        ]
+        cases.append((lits, [rng.randrange(0, 8) for _ in lits]))
+
+    for lits, groups in cases:
+        nat = AhoCorasick(lits, groups)
+        with monkeypatch.context() as m:
+            m.setattr(native_mod, "get_lib", lambda: None)
+            py = AhoCorasick(lits, groups)
+        assert (nat.n_nodes, nat.n_classes, nat.n_words) == (
+            py.n_nodes, py.n_classes, py.n_words
+        )
+        np.testing.assert_array_equal(nat.goto, py.goto)
+        np.testing.assert_array_equal(nat.byte_class, py.byte_class)
+        np.testing.assert_array_equal(nat.out_words, py.out_words)
+        np.testing.assert_array_equal(nat.has_out, py.has_out)
+
+
+def test_random_library_equivalence():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_engine_parity import random_library
+
+    entries: list[tuple[str, bool]] = []
+    for seed in range(40):
+        rng = random.Random(10_000 + seed)
+        for ps in random_library(rng, rng.randrange(2, 8)):
+            for p in ps.patterns:
+                if p.primary_pattern:
+                    entries.append((p.primary_pattern.regex, False))
+                for sec in p.secondary_patterns or []:
+                    entries.append((sec.regex, False))
+                for seq in p.sequence_patterns or []:
+                    for ev in seq.events or []:
+                        entries.append((ev.regex, False))
+    entries = sorted(set(entries))
+    batch = build_dfas_batch(entries)
+    assert batch is not None
+    for (rx, ci), nat in zip(entries, batch):
+        _assert_equivalent(rx, ci, _python_compile(rx, ci), nat)
