@@ -437,5 +437,3 @@ def _entry_bytes(dfa: CompiledDfa) -> bytes:
         parts.append(a.nbytes.to_bytes(8, "little"))
         parts.append(a.tobytes())
     return b"".join(parts)
-
-

@@ -1,0 +1,126 @@
+"""Session-matched A/B: XLA bitglush stepper vs the Pallas kernel on the
+CURRENT (chainless, caret-guarded) bank shape — VERDICT r4 #6 asks the
+kernel to earn default status on this bank or be deleted with a recorded
+negative.  The round-4 parity verdict (0.197 vs 0.198 s, PERF.md §9) was
+measured on the old chained 74-word bank; the chainless carry-free
+stepper moved the goalposts (0.064 s at W=88 in tools/probe_chainless.py),
+so the kernel's serial-latency floor must be re-priced against a much
+faster baseline.
+
+Run on a LIVE TPU session (one process, nothing concurrent — PERF.md §10):
+
+    nohup python tools/probe_pallas_ab.py > /tmp/pallas_ab.out 2>&1 &
+
+Two compiles total (one per variant), well inside relay etiquette.
+Prints one JSON line with both times, bit-equality, and the ratio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_common import timeit  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lines", type=int, default=200_000)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.native.ingest import Corpus
+    from log_parser_tpu.ops.match import pack_byte_pairs
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    bank = engine.matchers.bitglush
+    if bank is None:
+        sys.exit("no bitglush bank under the current tier policy "
+                 "(force it like tests do, or run on the TPU policy)")
+    corpus = Corpus(bench.build_corpus(args.lines))
+    enc = corpus.encoded
+    lines_tb = jnp.asarray(enc.u8.T)
+    lens = jnp.asarray(enc.lengths)
+    jax.block_until_ready((lines_tb, lens))
+    B = int(lens.shape[0])
+
+    report = {
+        "platform": jax.devices()[0].platform,
+        "rows": B,
+        "T": int(lines_tb.shape[0]),
+        "n_words": bank.n_words,
+        "has_chains": bool(bank.has_chains),
+        "use_sinks": bool(bank.use_sinks),
+    }
+
+    # XLA scan path: the bank's own pair stepper alone in one lax.scan
+    # (exact probe_tiers.py methodology, so numbers line up with its
+    # bitglush_s row)
+    stepper = bank.pair_stepper(B, lens)
+
+    @jax.jit
+    def xla_scan(lines_tb, lens):
+        pairs, ts = pack_byte_pairs(lines_tb)
+
+        def step(carry, xs):
+            pair, t = xs
+            return stepper[1](carry, pair[0], pair[1], t), None
+
+        final, _ = jax.lax.scan(step, stepper[0], (pairs, ts))
+        return final
+
+    out = xla_scan(lines_tb, lens)
+    jax.block_until_ready(out)
+    report["xla_stepper_s"] = round(
+        timeit(lambda: jax.block_until_ready(xla_scan(lines_tb, lens)),
+               n=args.repeats), 4
+    )
+
+    from log_parser_tpu.ops.bitglush_pallas import (
+        bitglush_hits_pallas,
+        pick_tile,
+    )
+
+    if pick_tile(B) is None:
+        report["pallas_s"] = None
+        report["note"] = "no valid pallas tile for this batch size"
+        print(json.dumps(report))
+        return
+
+    @jax.jit
+    def pallas_scan(lines_tb, lens):
+        return bitglush_hits_pallas(bank, lines_tb, lens)
+
+    phits = pallas_scan(lines_tb, lens)
+    jax.block_until_ready(phits)
+    report["pallas_s"] = round(
+        timeit(lambda: jax.block_until_ready(pallas_scan(lines_tb, lens)),
+               n=args.repeats), 4
+    )
+    # verdict basis: per-column results must agree (the stepper's carry
+    # layout differs from the kernel's hits array — and may be sink-mode
+    # on CPU policy — so compare through the bank's own column readers:
+    # finish(final_carry) and columns_from_hits both yield [B, n_cols])
+    cols_xla = np.asarray(stepper[2](out))
+    cols_pallas = np.asarray(bank.columns_from_hits(phits))
+    report["bit_equal"] = bool(np.array_equal(cols_xla, cols_pallas))
+    report["pallas_over_xla"] = round(
+        report["pallas_s"] / report["xla_stepper_s"], 3
+    )
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

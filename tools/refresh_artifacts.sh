@@ -53,6 +53,16 @@ run "config3_1m_singlechip_${platform}" python bench.py --lines 1000000
 # the artifact never goes stale beside freshly-stamped siblings; real
 # multi-chip mode is LOG_PARSER_TPU_MESH=real on a multi-chip host
 run "config3_1m_mesh8_cpu" python bench_mesh.py --devices 8 --lines 1000000
+# measured shard-program overhead (VERDICT r4 #4): the FULL ShardedEngine
+# vs the plain engine at matched batch. On a TPU host the mesh=1 real row
+# isolates program structure (halos/all_gather/concat, zero real
+# communication) — the factor under the config-3 "per-chip x N" projection
+if [ "$platform" = "tpu" ]; then
+  LOG_PARSER_TPU_MESH=real run "config3_shard_overhead_mesh1_tpu" \
+    python bench_mesh.py --devices 1 --lines 200000 --overhead
+fi
+run "config3_shard_overhead_mesh8_cpu" \
+  python bench_mesh.py --devices 8 --lines 200000 --overhead
 run "config4_2k_${platform}"       python bench_bank.py --patterns 2000 --lines 65536
 run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines 65536
 run "config5_direct_${platform}"   python bench_latency.py
