@@ -111,6 +111,26 @@ def translate_java_regex(pattern: str) -> str:
                     out.append(r"(?=\r?\Z)")
                     i += 2
                     continue
+                if nxt == "Q":
+                    # Java \Q...\E literal quoting: Python re has no \Q,
+                    # so splice the quoted run in escaped. Passing \Q
+                    # through made re.compile reject and the whole
+                    # pattern skip at boot — a parity gap against the
+                    # Java engine, which accepts these. (In-class \Q is
+                    # left alone: the device parser reads it as a
+                    # literal 'Q' there, and the skip keeps both sides
+                    # consistent.)
+                    end = pattern.find("\\E", i + 2)
+                    content = pattern[i + 2 : end if end >= 0 else n]
+                    escaped = re.escape(content)
+                    if escaped and escaped[0].isdigit():
+                        # a bare leading digit could merge into a
+                        # preceding numeric token (\1 + "2" -> \12, a
+                        # different backreference): emit it as \xNN
+                        escaped = f"\\x{ord(escaped[0]):02x}" + escaped[1:]
+                    out.append(escaped)
+                    i = (end + 2) if end >= 0 else n
+                    continue
             out.append(pattern[i : i + 2])
             i += 2
             continue

@@ -144,6 +144,7 @@ class _Parser:
         self.n = len(pattern)
         self.ci = case_insensitive
         self.lenient = lenient
+        self._quoted_run = False  # last atom was a multi-char \Q..\E run
 
     def fail(self, what: str) -> RegexUnsupportedError:
         return RegexUnsupportedError(f"{what} at index {self.i} in {self.p!r}")
@@ -180,11 +181,20 @@ class _Parser:
         return parts[0] if len(parts) == 1 else Cat(tuple(parts))
 
     def parse_rep(self) -> Node:
-        atom = self.parse_atom()
+        self._quoted_run = False
+        atom = self.parse_atom()  # _quoted() sets the flag
+        was_quoted = self._quoted_run
         while True:
             quant = self._parse_quantifier()
             if quant is None:
                 return atom
+            if was_quoted and isinstance(atom, Cat):
+                # Java binds a quantifier after \Q..\E to the LAST quoted
+                # char (quoting is per-char escaping), but this parser
+                # returns the run as one atom — quantifying it would
+                # repeat the WHOLE run. Decline to the host path, whose
+                # translation has the exact Java binding.
+                raise self.fail("quantifier after multi-char \\Q..\\E run")
             lo, hi = quant
             if isinstance(atom, Assertion):
                 # quantified assertions are meaningless; Java allows (\b)* etc.
@@ -448,7 +458,10 @@ class _Parser:
             parts.append(self._literal(self.take()))
         if not parts:
             return Empty()
-        return parts[0] if len(parts) == 1 else Cat(tuple(parts))
+        if len(parts) == 1:
+            return parts[0]
+        self._quoted_run = True  # parse_rep declines to quantify the run
+        return Cat(tuple(parts))
 
     # ----------------------------------------------------------- char class
 

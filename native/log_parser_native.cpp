@@ -790,6 +790,7 @@ struct RxParser {
     int64_t n;
     int64_t i = 0;
     bool ci;
+    bool quoted_run = false;  // last atom was a multi-char \Q..\E run
     std::vector<PNode> arena;
     std::vector<ByteSet> bsets;
 
@@ -880,10 +881,16 @@ struct RxParser {
     }
 
     int32_t parse_rep() {
-        int32_t atom = parse_atom();
+        quoted_run = false;
+        int32_t atom = parse_atom();  // parse_quoted sets the flag
+        bool was_quoted = quoted_run;
         for (;;) {
             int32_t lo, hi;
             if (!parse_quantifier(lo, hi)) return atom;
+            // Java binds a quantifier after \Q..\E to the LAST quoted
+            // char; this parser returns the run as one atom — decline
+            // to the host path (parser.py parse_rep does the same)
+            if (was_quoted) fail();
             if (arena[atom].kind == PNode::ASSERT) {
                 // quantified assertions: keep if lo > 0, else epsilon
                 if (lo == 0) atom = empty();
@@ -1090,13 +1097,16 @@ struct RxParser {
 
     int32_t parse_quoted() {  // \Q ... \E literal run
         std::vector<int32_t> parts;
+        int n_chars = 0;  // CHARS, not bytes (parity with parser.py's count)
         while (i < n) {
             if (p[i] == '\\' && i + 1 < n && p[i + 1] == 'E') { i += 2; break; }
             int ch = take();
+            if (ch < 0x80 || ch >= 0xC0) ++n_chars;  // not a continuation byte
             parts.push_back(ch >= 0x80 ? lit(single(ch))
                                        : lit(ci ? fold_byte(ch) : single(ch)));
         }
         if (parts.empty()) return empty();
+        if (n_chars > 1) quoted_run = true;
         if (parts.size() == 1) return parts[0];
         PNode cat; cat.kind = PNode::CAT; cat.kids = std::move(parts);
         return node(std::move(cat));

@@ -147,3 +147,89 @@ class TestJavaRegex:
         assert not compile_java_regex(r"c\z").search("abc\r")  # absolute end
         assert compile_java_regex(r"c\z").search("abc")
         assert compile_java_regex(r"c\Z").search("abc\r")  # before final term
+
+
+class TestQuoting:
+    def test_quoted_run_is_literal(self):
+        # Java \Q...\E quotes metachars; Python re has no \Q — the
+        # translation splices the run in escaped (passing \Q through
+        # made re.compile reject and the whole pattern skip at boot,
+        # a parity gap against the Java engine)
+        p = compile_java_regex(r"start \Qa.b*c\E end")
+        assert p.search("xx start a.b*c end yy")
+        assert not p.search("start aXbYc end")
+
+    def test_unterminated_quote_runs_to_end(self):
+        assert compile_java_regex(r"\Qtail.").search("has tail. here")
+        assert not compile_java_regex(r"\Qtail.").search("has tailX here")
+
+    def test_quoted_engine_golden_parity(self):
+        # end-to-end: a \Q pattern must now produce events (device parser
+        # already supported it; the host gate was the blocker) and agree
+        # with the golden engine exactly
+        from helpers import make_pattern, make_pattern_set
+
+        from log_parser_tpu.config import ScoringConfig
+        from log_parser_tpu.golden import GoldenAnalyzer
+        from log_parser_tpu.models.pod import PodFailureData
+        from log_parser_tpu.runtime import AnalysisEngine
+
+        sets = [make_pattern_set([
+            make_pattern("pq", regex=r"err \Qcode[3]\E hit", confidence=0.9),
+        ])]
+        data = PodFailureData(
+            pod={"metadata": {"name": "q"}},
+            logs="ok line\nerr code[3] hit now\nerr codeX3Y hit\n",
+        )
+        got = AnalysisEngine(sets, ScoringConfig()).analyze(data)
+        want = GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+        assert [e.line_number for e in got.events] == [2]
+        assert [e.line_number for e in want.events] == [2]
+        assert abs(got.events[0].score - want.events[0].score) < 1e-9
+
+    def test_quantifier_after_quoted_run_binds_to_last_char(self):
+        # Java binds {2} to the last quoted char: "ab{2}" matches "abb"
+        p = compile_java_regex(r"x \Qab\E{2} y")
+        assert p.search("x abb y")
+        assert not p.search("x abab y")
+        # the DEVICE parser declines this shape (it holds the run as one
+        # atom), so the engine serves it host-side — results must still
+        # match golden exactly
+        import pytest
+
+        from log_parser_tpu.patterns.regex.parser import (
+            RegexUnsupportedError,
+            parse_java_regex,
+        )
+
+        with pytest.raises(RegexUnsupportedError):
+            parse_java_regex(r"x \Qab\E{2} y")
+        # single-char runs stay device-parseable (binding is unambiguous)
+        parse_java_regex(r"x \Qa\E{2} y")
+
+    def test_quoted_leading_digit_cannot_merge_into_backref(self):
+        # (a)\1 then literal "2": a bare splice would produce \12 (a
+        # different backreference); Java matches "aa2"
+        p = compile_java_regex(r"(a)\1\Q2\E")
+        assert p.search("xx aa2 yy")
+        assert not p.search("xx a2 yy")
+
+    def test_quoted_run_engine_golden_parity_host_fallback(self):
+        from helpers import make_pattern, make_pattern_set
+
+        from log_parser_tpu.config import ScoringConfig
+        from log_parser_tpu.golden import GoldenAnalyzer
+        from log_parser_tpu.models.pod import PodFailureData
+        from log_parser_tpu.runtime import AnalysisEngine
+
+        sets = [make_pattern_set([
+            make_pattern("pq2", regex=r"x \Qab\E{2} y", confidence=0.8),
+        ])]
+        data = PodFailureData(
+            pod={"metadata": {"name": "q2"}},
+            logs="x abb y\nx abab y\nother\n",
+        )
+        got = AnalysisEngine(sets, ScoringConfig()).analyze(data)
+        want = GoldenAnalyzer(sets, ScoringConfig()).analyze(data)
+        assert [e.line_number for e in got.events] == [1]
+        assert [e.line_number for e in want.events] == [1]
