@@ -41,6 +41,10 @@ Record (round-4 engine, 2026-07-31, truncation/repair build): long seeds
 31006..31055 (50 libraries, 150 corpora) clean; default 8..199 (192
 libraries, 576 corpora), sharded 1004..1053, and pattern-sharded
 9003..9052 all re-run clean on the same build.
+Record (round-5 engine, 2026-08-01 — native batched regex pipeline,
+pack-file cache, exact bitglush pricing, \\Q quoting): ALL FOUR full
+sweeps clean — default 8..199 (192 libraries), sharded 1004..1053,
+pattern-sharded 9003..9052, long 31006..31055.
 """
 
 from __future__ import annotations
