@@ -63,6 +63,11 @@ if [ "$platform" = "tpu" ]; then
 fi
 run "config3_shard_overhead_mesh8_cpu" \
   python bench_mesh.py --devices 8 --lines 200000 --overhead
+# the §9 Pallas kernel verdict (VERDICT r4 #6): session-matched A/B on
+# the chainless bank; delete the kernel if pallas_over_xla >= ~1
+if [ "$platform" = "tpu" ]; then
+  run "pallas_ab_tpu" python tools/probe_pallas_ab.py
+fi
 run "config4_2k_${platform}"       python bench_bank.py --patterns 2000 --lines 65536
 run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines 65536
 run "config5_direct_${platform}"   python bench_latency.py
