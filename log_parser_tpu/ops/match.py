@@ -266,9 +266,16 @@ class MultiDfaBank:
 
     def _table(self) -> tuple[jax.Array, int]:
         """(device buffer, base offset) of this group's byte-precomposed
-        transition table, uploading it standalone on first use."""
+        transition table, uploading it standalone on first use.  Never
+        caches under an active jit trace (jnp.asarray would yield a
+        trace-local constant whose escape poisons every later call) —
+        MatcherBanks pre-uploads eagerly on the no-cluster path so the
+        guard is a backstop, not the common case."""
         if self._flat is None:
-            self._flat = jnp.asarray(self._packed_byte_np)
+            arr = jnp.asarray(self._packed_byte_np)
+            if isinstance(arr, jax.core.Tracer):
+                return arr, self._flat_base
+            self._flat = arr
         return self._flat, self._flat_base
 
     def _adopt_table(self, flat: jax.Array, base: int) -> None:
@@ -455,22 +462,33 @@ class AcRunner:
 class MatcherBanks:
     """Tiered device matchers for one PatternBank's columns.
 
-    Tier selection is static per column (patterns/bank.py): literal-shaped
+    Tier selection is static per column (patterns/bank.py) and
+    PLATFORM-DEPENDENT: on TPU, literal-shaped
     regexes go to the bit-parallel Shift-Or bank (cost independent of bank
-    size); in wide banks, regexes with required literals ride the AC
+    size), while on CPU hosts they ride the union multi-DFA / prefilter
+    instead (XLA:CPU's vectorized gathers beat mask arithmetic — see
+    SHIFTOR_MIN_COLUMNS; Shift-Or re-engages only on degraded hosts
+    without the native lib, and for DFA-less literal columns always);
+    in wide banks, regexes with required literals ride the AC
     prefilter + per-record verify tier (ops/prefilter.py — cost per byte
     independent of library width); the rest go to the packed dense DFA
     bank; automaton-unsupported regexes stay host-side (the engine injects
     them as cube overrides).
     """
 
-    # CPU thresholds. Below this many device columns, the whole bank rides
-    # the pair-stride DFA alone: the [B, R] transition gather is small, and
-    # adding the Shift-Or stage to the scan costs more than the width it
-    # removes. Wide banks (the 10k-regex configuration) move every
-    # literal-shaped column to Shift-Or, whose per-step cost is O(packed
-    # words), not O(R).
-    SHIFTOR_MIN_COLUMNS = 64
+    # CPU threshold. Shift-Or is a TPU-shaped tier: on the host, XLA's
+    # vectorized gathers beat [B, W] mask arithmetic at EVERY width
+    # measured — the 59 builtin literal columns scan 3.3x faster through
+    # the union multi-DFA (config-2 cube 1.455 -> 0.445 s, 200k lines,
+    # bit-equal; r5 A/B), and the 1008-column synthetic bank ran 4.5x
+    # faster through the prefilter (PERF.md §6). So DFA-backed literal
+    # columns are NEVER rerouted to Shift-Or on CPU; DFA-less literal
+    # columns still ride it everywhere (their only device tier).  On a
+    # degraded host WITHOUT the native library the union tier is off, so
+    # Shift-Or re-engages at the old threshold rather than stranding
+    # literal columns on the dense [B, R] gather.
+    SHIFTOR_MIN_COLUMNS = 10**9
+    SHIFTOR_MIN_COLUMNS_NO_NATIVE = 64
     # below this many DENSE-DFA columns, the prefilter tier stays off: the
     # dense gather is cheap and the extra scans aren't worth their latency
     PREFILTER_MIN_COLUMNS = 64
@@ -545,9 +563,15 @@ class MatcherBanks:
         on_tpu = jax.default_backend() == "tpu"
         threshold = shiftor_min_columns
         if threshold is None:
-            threshold = (
-                self.SHIFTOR_MIN_COLUMNS_TPU if on_tpu else self.SHIFTOR_MIN_COLUMNS
-            )
+            if on_tpu:
+                threshold = self.SHIFTOR_MIN_COLUMNS_TPU
+            elif get_lib() is not None:
+                threshold = self.SHIFTOR_MIN_COLUMNS
+            else:
+                # degraded host (no native lib -> no union tier): Shift-Or
+                # is still far cheaper than stranding literal columns on
+                # the dense [B, R] gather — keep the old CPU engagement
+                threshold = self.SHIFTOR_MIN_COLUMNS_NO_NATIVE
         pref_threshold = prefilter_min_columns
         if pref_threshold is None:
             pref_threshold = (
@@ -911,10 +935,21 @@ class MatcherBanks:
         self.dfa_cols = dense_cols
         # built once: cube() runs under jit, and constructing the cluster
         # there would re-run the table concatenation and bake a duplicate
-        # copy of the fused table into every compiled executable
+        # copy of the fused table into every compiled executable.
+        # Platform split (r5 A/B, builtin bank, 200k lines): the ONE-wide-
+        # gather cluster is how TPU schedules several groups well (PERF.md
+        # §7.2: separate steppers cost 1.03 s vs 0.62 s clustered on v5e),
+        # but XLA:CPU runs the cluster 2x SLOWER than the same groups as
+        # separate scan stages (0.250 vs 0.124 s) — the cluster is a TPU
+        # shape; CPU keeps per-group steppers in the fused scan
         self.multi_cluster = (
-            MultiDfaCluster(self.multi_groups) if self.multi_groups else None
+            MultiDfaCluster(self.multi_groups)
+            if self.multi_groups and on_tpu
+            else None
         )
+        if self.multi_cluster is None:
+            for g in self.multi_groups:
+                g._table()  # upload now, outside any jit trace (_table)
         self.dfa_bank = DfaBank(
             [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
         )
@@ -991,6 +1026,14 @@ class MatcherBanks:
             steppers.append(
                 (cluster.pair_stepper(B, lengths), cluster, False)
             )
+        elif self.multi_groups:
+            # CPU: per-group steppers in the same fused scan (see the
+            # cluster construction note); group order must match
+            # self.multi_groups — _multi_contribution zips against it
+            for g in self.multi_groups:
+                steppers.append(
+                    (g.pair_stepper(B, lengths), "multi_group", False)
+                )
         if self.prefilter is not None:
             steppers.append(
                 (self.prefilter.anyhit_stepper(B, lengths), None, False)
@@ -1021,6 +1064,9 @@ class MatcherBanks:
                 continue
             if isinstance(cols, MultiDfaCluster):  # per-group reported cols
                 multi_reps.extend(out)
+                continue
+            if isinstance(cols, str):  # "multi_group": one group's carry
+                multi_reps.append(out[1])
                 continue
             if is_dfa:
                 out = out[:, : len(cols)]
