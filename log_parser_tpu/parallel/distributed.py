@@ -71,6 +71,12 @@ def broadcast_bytes(payload: bytes | None) -> bytes:
     """Broadcast a byte string from process 0 to every process (two
     fixed-shape collectives: an int64 length header, then the buffer).
     Non-coordinators pass ``None`` and receive the coordinator's bytes."""
+    from log_parser_tpu.runtime import faults
+
+    # chaos point BEFORE the first collective: an injected raise/hang here
+    # models a coordinator dying (or stalling) pre-broadcast — the one
+    # window where failure must not desync the follower group
+    faults.fire("broadcast")
     from jax.experimental import multihost_utils as mh
 
     header = np.array(

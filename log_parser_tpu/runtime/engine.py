@@ -49,6 +49,7 @@ from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus
 from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
+from log_parser_tpu.runtime import faults
 from log_parser_tpu.ops.match import DfaBank, MatcherBanks
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
@@ -109,6 +110,11 @@ def is_device_error(exc: BaseException) -> bool:
 
     if isinstance(exc, DeviceHungError):
         return True
+    if isinstance(exc, faults.InjectedDeviceFault):
+        # injected device-layer chaos reacts exactly like a dead backend;
+        # faults injected elsewhere (ingest/finalize/transport) are plain
+        # InjectedFault and take the propagate-to-500 path of a logic bug
+        return True
     if isinstance(exc, jax.errors.JaxRuntimeError):
         return True
     if isinstance(exc, RuntimeError):
@@ -142,6 +148,15 @@ class DeviceWatchdog:
     the front door), and any late error is logged so the root cause of
     the wedge reaches the operator.
 
+    Half-open recovery: waiting for the last outstanding worker alone
+    would leave the circuit stuck open forever when a worker NEVER
+    responds (a truly lost backend thread). After ``cooldown_s``
+    (default: the timeout itself; ``LOG_PARSER_TPU_BREAKER_COOLDOWN_S``
+    overrides) the breaker goes half-open: exactly one trial request is
+    admitted to the device path. Success closes the circuit even with
+    abandoned workers still pending; a timeout or error re-arms the
+    cool-down and the circuit stays open.
+
     Default OFF (0): a first request legitimately spends tens of
     seconds in XLA compilation, and only an operator knows a deadline
     that separates that from a wedge. Hung worker threads cannot be
@@ -151,10 +166,17 @@ class DeviceWatchdog:
     no new ones are created.
     """
 
-    def __init__(self, timeout_s: float):
+    def __init__(self, timeout_s: float, cooldown_s: float | None = None):
         self.timeout_s = timeout_s
+        if cooldown_s is None:
+            cooldown_s = float(
+                os.environ.get("LOG_PARSER_TPU_BREAKER_COOLDOWN_S", "0")
+            ) or timeout_s
+        self.cooldown_s = cooldown_s
         self._lock = threading.Lock()
         self._open = False
+        self._opened_at = 0.0
+        self._probing = False  # at most one half-open trial at a time
         self._inflight = 0
 
     @property
@@ -165,12 +187,22 @@ class DeviceWatchdog:
     def run(self, fn):
         if self.timeout_s <= 0:
             return fn()
+        probe = False
         with self._lock:
             if self._open:
-                raise DeviceHungError(
-                    "device backend still hung from a previous timeout "
-                    "(circuit open); serving from the host path"
-                )
+                if (
+                    self.cooldown_s > 0
+                    and not self._probing
+                    and time.monotonic() - self._opened_at >= self.cooldown_s
+                ):
+                    # half-open: this request is the single recovery trial
+                    self._probing = True
+                    probe = True
+                else:
+                    raise DeviceHungError(
+                        "device backend still hung from a previous timeout "
+                        "(circuit open); serving from the host path"
+                    )
             self._inflight += 1
         result: list = []
         error: list = []
@@ -215,12 +247,30 @@ class DeviceWatchdog:
                     # longer be un-done by this set).
                     abandoned[0] = True
                     self._open = True
+                    self._opened_at = time.monotonic()
+                    if probe:
+                        # failed trial: re-arm the cool-down, next probe
+                        # waits a full period again
+                        self._probing = False
                     raise DeviceHungError(
                         f"device step exceeded {self.timeout_s:g}s; "
                         "serving from the host path until the backend "
                         "responds"
                     )
             done.wait()  # finished[0] is True: done.set() is imminent
+        if probe:
+            with self._lock:
+                self._probing = False
+                if error:
+                    # the backend RESPONDED (not wedged) but with an error:
+                    # don't close on an error — re-arm the cool-down and
+                    # let the inflight==0 bookkeeping decide as before
+                    self._opened_at = time.monotonic()
+                else:
+                    # trial succeeded: the backend serves again. Close even
+                    # with abandoned workers still pending — the stuck-open
+                    # fix this probe exists for.
+                    self._open = False
         if error:
             raise error[0]
         return result[0]
@@ -333,6 +383,13 @@ class AnalysisEngine:
         # how many requests this engine served from the golden host path
         # because the device layer failed (surfaced via GET /trace/last)
         self.fallback_count = 0
+        # ... and how many were ROUTED there deliberately by admission
+        # pressure (serve/admission.py ladder rung 2) — a separate counter,
+        # because pressure routing is policy, not failure
+        self.host_routed_count = 0
+        # chaos: pick up LOG_PARSER_TPU_FAULTS once per process (no-op
+        # when unset or when a test installed a registry explicitly)
+        faults.ensure_env()
 
     @property
     def skipped_patterns(self) -> list[tuple[str, str]]:
@@ -644,6 +701,23 @@ class AnalysisEngine:
         the reference serializes nothing and data-races instead)."""
         return self._analyze(data, self.state_lock)
 
+    def analyze_host_routed(self, data: PodFailureData) -> AnalysisResult:
+        """Serve one request from the golden host path because the
+        admission gate routed it there under pressure (ladder rung 2,
+        serve/admission.py) — NOT because anything failed. Same frequency
+        state, same rollback-on-failure invariant as the error fallback,
+        separate counter."""
+        with self.state_lock:
+            self.host_routed_count += 1
+            saved_freq = self.frequency._save_state()
+            try:
+                return self.golden_fallback.analyze(data)
+            except Exception:
+                # golden records matches as it runs — a failure partway
+                # through must not leak partial counts
+                self.frequency._load_state(saved_freq)
+                raise
+
     def _analyze(self, data: PodFailureData, lock) -> AnalysisResult:
         try:
             prepared = self._prepare(data)
@@ -707,16 +781,22 @@ class AnalysisEngine:
         start = time.monotonic()
         trace = PhaseTrace()
         with trace.phase("ingest"):
+            faults.fire("ingest")
             corpus = Corpus(data.logs or "", min_rows=self._corpus_min_rows())
             enc = corpus.encoded
 
         with trace.phase("overrides"):
             overrides = self._overrides(corpus)
         om, ov = overrides if overrides is not None else (None, None)
+
+        def _device_step():
+            # chaos point INSIDE the watchdog worker: an injected hang
+            # exercises the timeout/breaker exactly like a wedged backend
+            faults.fire("device")
+            return self._run_device(enc, corpus.n_lines, om, ov)
+
         with trace.phase("device"):
-            recs = self.watchdog.run(
-                lambda: self._run_device(enc, corpus.n_lines, om, ov)
-            )
+            recs = self.watchdog.run(_device_step)
         # capacity hint tracks the RAW device match count (the buffer the
         # device actually needs), before approx verification drops rows
         self._k_hint = recs.n_matches
@@ -745,6 +825,7 @@ class AnalysisEngine:
             freq_exists[slot] = self.frequency.has_entry(pid)
 
         with trace.phase("finalize"):
+            faults.fire("finalize")
             fin = finalize_batch(
                 self.bank, self.tables, self.config, recs, corpus.n_lines,
                 freq_base, freq_exists,

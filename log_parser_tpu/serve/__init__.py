@@ -1,6 +1,18 @@
 """HTTP serving: the reference's ``POST /parse`` contract plus operational
-endpoints the reference lacked (health, frequency admin)."""
+endpoints the reference lacked (health, frequency admin), guarded by the
+engine-wide admission gate (admission.py)."""
 
+from log_parser_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    shared_gate,
+)
 from log_parser_tpu.serve.http import ParseServer, make_server
 
-__all__ = ["ParseServer", "make_server"]
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "ParseServer",
+    "make_server",
+    "shared_gate",
+]

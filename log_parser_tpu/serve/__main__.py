@@ -18,6 +18,7 @@ import sys
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.patterns import load_pattern_directory
 from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.serve.admission import install_drain_handlers
 from log_parser_tpu.serve.http import make_server
 
 
@@ -52,9 +53,51 @@ def main(argv: list[str] | None = None) -> int:
         "trips the circuit and requests serve from the host path until "
         "it responds (default: off; also LOG_PARSER_TPU_DEVICE_TIMEOUT_S)",
     )
+    # overload controls (docs/OPS.md "Overload & degradation") — flags win
+    # over the LOG_PARSER_TPU_* env vars they mirror
+    parser.add_argument(
+        "--max-inflight", type=int, default=None,
+        help="bound on concurrently-executing parses; 0 = unbounded "
+        "(LOG_PARSER_TPU_MAX_INFLIGHT)",
+    )
+    parser.add_argument(
+        "--max-queue", type=int, default=None,
+        help="bound on parses waiting for a slot before the gate sheds "
+        "with 429 (LOG_PARSER_TPU_MAX_QUEUE)",
+    )
+    parser.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="default per-request deadline; X-Request-Deadline-Ms "
+        "overrides per request (LOG_PARSER_TPU_DEADLINE_MS)",
+    )
+    parser.add_argument(
+        "--drain-s", type=float, default=None,
+        help="SIGTERM drain deadline: finish in-flight work up to this "
+        "many seconds before exiting (LOG_PARSER_TPU_DRAIN_S)",
+    )
+    parser.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault-injection DSL, e.g. 'device_hang:2@after=3' "
+        "(LOG_PARSER_TPU_FAULTS; see runtime/faults.py)",
+    )
+    parser.add_argument(
+        "--fault-seed", type=int, default=None,
+        help="PRNG seed for probabilistic fault specs "
+        "(LOG_PARSER_TPU_FAULT_SEED)",
+    )
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
+    for flag, env_key in (
+        (args.max_inflight, "LOG_PARSER_TPU_MAX_INFLIGHT"),
+        (args.max_queue, "LOG_PARSER_TPU_MAX_QUEUE"),
+        (args.deadline_ms, "LOG_PARSER_TPU_DEADLINE_MS"),
+        (args.drain_s, "LOG_PARSER_TPU_DRAIN_S"),
+        (args.faults, "LOG_PARSER_TPU_FAULTS"),
+        (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
+    ):
+        if flag is not None:
+            os.environ[env_key] = str(flag)
 
     logging.basicConfig(
         level=args.log_level.upper(),
@@ -114,7 +157,31 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.coordinator and args.process_id != 0:
         # followers own no network surface: they replay the coordinator's
-        # broadcast requests so every process enters each SPMD dispatch
+        # broadcast requests so every process enters each SPMD dispatch.
+        # SIGTERM/SIGINT must NOT kill a follower mid-collective — orderly
+        # exit is the coordinator's shutdown sentinel, which arrives after
+        # the coordinator finishes draining. A second signal forces out.
+        import signal
+
+        signals_seen = {"n": 0}
+
+        def _follower_signal(signum, frame):
+            signals_seen["n"] += 1
+            if signals_seen["n"] > 1:
+                log.warning(
+                    "Follower %d: second signal, exiting immediately",
+                    args.process_id,
+                )
+                raise SystemExit(1)
+            log.info(
+                "Follower %d: signal %d ignored — waiting for the "
+                "coordinator's drain sentinel (signal again to force exit)",
+                args.process_id,
+                signum,
+            )
+
+        signal.signal(signal.SIGTERM, _follower_signal)
+        signal.signal(signal.SIGINT, _follower_signal)
         log.info("Follower %d ready", args.process_id)
         engine.follower_loop()
         return 0
@@ -128,10 +195,18 @@ def main(argv: list[str] | None = None) -> int:
         if args.coordinator:
             engine.shutdown_followers()
         raise
+    # SIGTERM/SIGINT drain instead of killing in-flight work: readiness
+    # flips to 503, the gate refuses new parses, in-flight ones finish (up
+    # to --drain-s), then serve_forever returns and the normal shutdown
+    # sequence below runs — including the follower sentinel in distributed
+    # mode, which therefore always lands AFTER the drain, never
+    # mid-broadcast (the analyze lock covers the straggler case).
+    install_drain_handlers(server, server.admission, log)
     log.info("Serving POST /parse on %s:%d", args.host, args.port)
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
+        log.info("Drained; shutting down")
+    except KeyboardInterrupt:  # pre-handler-install window only
         log.info("Shutting down")
     finally:
         server.server_close()

@@ -1,0 +1,279 @@
+"""Admission control + the degradation ladder — ONE gate for every
+transport.
+
+The reference accepts unbounded concurrent work (a thread per connection,
+no deadline, no shedding — its overload story is "the JVM falls over",
+SURVEY.md §5.2). This module is the serving-side half of robustness,
+pairing the device-side half (DeviceWatchdog + golden fallback,
+runtime/engine.py):
+
+ladder (evaluated per request at admission):
+
+1. **device path** — an in-flight slot is free: full service.
+2. **host-path routing** — slots saturated but the bounded wait queue has
+   room: the request waits for a slot and is then served from the cheaper
+   golden host path (``engine.analyze_host_routed``), relieving device
+   pressure before anything is refused. Counted separately from
+   error-fallbacks (CelerLog-style dynamic fast/slow routing, PAPERS.md).
+3. **shed** — queue full, or the request would start past its deadline
+   (checked while queued, so a doomed request never does dead work):
+   reject with 429 + ``Retry-After``.
+4. **drain** — SIGTERM: ``/health/ready`` flips to 503, new work is
+   refused (503), in-flight work finishes up to a drain deadline, then
+   the process exits.
+
+Deadlines come from ``LOG_PARSER_TPU_DEADLINE_MS`` (0 = none) or the
+per-request ``X-Request-Deadline-Ms`` header (header wins). Concurrency
+bounds: ``LOG_PARSER_TPU_MAX_INFLIGHT`` (0 = unbounded) and
+``LOG_PARSER_TPU_MAX_QUEUE``; drain: ``LOG_PARSER_TPU_DRAIN_S``.
+
+Sharing: :func:`shared_gate` attaches one controller to the engine, so the
+HTTP front-end and both shim transports (which each hold the same engine)
+admit through the same semaphore — saturating one transport sheds on the
+others, exactly like the shared ``state_lock``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+ENV_MAX_INFLIGHT = "LOG_PARSER_TPU_MAX_INFLIGHT"
+ENV_MAX_QUEUE = "LOG_PARSER_TPU_MAX_QUEUE"
+ENV_DEADLINE_MS = "LOG_PARSER_TPU_DEADLINE_MS"
+ENV_DRAIN_S = "LOG_PARSER_TPU_DRAIN_S"
+
+
+class AdmissionRejected(Exception):
+    """The gate refused this request (shed or draining). Transports map it
+    onto their wire: HTTP 429/503 + Retry-After, shim error envelope, gRPC
+    RESOURCE_EXHAUSTED/UNAVAILABLE."""
+
+    def __init__(self, reason: str, retry_after_s: int, status: int):
+        super().__init__(f"overloaded: {reason}; retry after {retry_after_s}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.status = status  # HTTP mapping: 429 shed, 503 draining
+
+
+class AdmissionController:
+    """Bounded in-flight semaphore + bounded wait queue + drain latch."""
+
+    def __init__(
+        self,
+        max_inflight: int = 0,
+        max_queue: int = 0,
+        default_deadline_ms: float = 0.0,
+        drain_deadline_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.max_queue = int(max_queue)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.clock = clock
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._waiting = 0
+        self._draining = False
+        # ladder counters (GET /trace/last)
+        self.admitted_device = 0
+        self.admitted_host = 0
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+        self.shed_draining = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "AdmissionController":
+        env = os.environ if env is None else env
+        return cls(
+            max_inflight=int(env.get(ENV_MAX_INFLIGHT, "0")),
+            max_queue=int(env.get(ENV_MAX_QUEUE, "0")),
+            default_deadline_ms=float(env.get(ENV_DEADLINE_MS, "0")),
+            drain_deadline_s=float(env.get(ENV_DRAIN_S, "10")),
+        )
+
+    # ----------------------------------------------------------- admission
+
+    def _retry_after(self) -> int:
+        # rough wait estimate: everything ahead of a new arrival, one
+        # second per queued/running request, floor 1s (callers hold no lock)
+        return max(1, self._waiting + (1 if self._inflight else 0))
+
+    def acquire(self, deadline_ms: float | None = None) -> str:
+        """Admit or refuse one request. Returns the route — ``"device"``
+        (free slot) or ``"host"`` (had to queue: degrade to the host
+        path) — or raises :class:`AdmissionRejected`. Callers MUST pair a
+        successful acquire with :meth:`release`.
+
+        ``deadline_ms`` is this request's budget from arrival (header);
+        None uses the configured default; 0/negative budget means none.
+        """
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (
+            self.clock() + deadline_ms / 1e3 if deadline_ms and deadline_ms > 0
+            else None
+        )
+        with self._cv:
+            if self._draining:
+                self.shed_draining += 1
+                raise AdmissionRejected("draining", self._retry_after(), 503)
+            if self.max_inflight <= 0 or self._inflight < self.max_inflight:
+                # unbounded mode still counts in-flight so drain can wait
+                self._inflight += 1
+                self.admitted_device += 1
+                return "device"
+            if self._waiting >= self.max_queue:
+                self.shed_queue_full += 1
+                raise AdmissionRejected("queue full", self._retry_after(), 429)
+            self._waiting += 1
+            try:
+                while True:
+                    if self._draining:
+                        self.shed_draining += 1
+                        raise AdmissionRejected(
+                            "draining", self._retry_after(), 503
+                        )
+                    if self._inflight < self.max_inflight:
+                        # queue head: starting past the deadline is dead
+                        # work — shed instead
+                        if deadline is not None and self.clock() >= deadline:
+                            self.shed_deadline += 1
+                            raise AdmissionRejected(
+                                "deadline", self._retry_after(), 429
+                            )
+                        self._inflight += 1
+                        self.admitted_host += 1
+                        return "host"
+                    timeout = (
+                        None if deadline is None else deadline - self.clock()
+                    )
+                    if timeout is not None and timeout <= 0:
+                        self.shed_deadline += 1
+                        raise AdmissionRejected(
+                            "deadline", self._retry_after(), 429
+                        )
+                    self._cv.wait(timeout)
+            finally:
+                self._waiting -= 1
+
+    def release(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
+
+    # --------------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        with self._cv:
+            return self._draining
+
+    def begin_drain(self) -> None:
+        """Refuse new work from now on; queued waiters are woken and shed."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    def wait_idle(self, timeout_s: float | None = None) -> bool:
+        """Block until no request is in flight (True) or the drain deadline
+        passes (False — the operator chose to abandon stragglers)."""
+        if timeout_s is None:
+            timeout_s = self.drain_deadline_s
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._inflight == 0, timeout=timeout_s
+            )
+
+    # ------------------------------------------------------- observability
+
+    @property
+    def inflight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+    @property
+    def queued(self) -> int:
+        with self._cv:
+            return self._waiting
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "maxInflight": self.max_inflight,
+                "maxQueue": self.max_queue,
+                "inflight": self._inflight,
+                "queued": self._waiting,
+                "draining": self._draining,
+                "admittedDevice": self.admitted_device,
+                "admittedHost": self.admitted_host,
+                "shedQueueFull": self.shed_queue_full,
+                "shedDeadline": self.shed_deadline,
+                "shedDraining": self.shed_draining,
+            }
+
+
+_ATTACH_LOCK = threading.Lock()
+
+
+def shared_gate(engine) -> AdmissionController:
+    """The engine-wide admission gate, created on first use (env-config)
+    and attached to the engine so every transport wrapping this engine —
+    HTTP, framed shim, gRPC — admits through the same bounded semaphore."""
+    with _ATTACH_LOCK:
+        gate = getattr(engine, "admission_gate", None)
+        if gate is None:
+            gate = AdmissionController.from_env()
+            engine.admission_gate = gate
+        return gate
+
+
+def install_drain_handlers(server, gate, log, on_second_signal=None):
+    """Route SIGTERM/SIGINT through the drain path: flip the gate (readiness
+    goes 503, new work refused), let in-flight requests finish up to the
+    drain deadline, then stop ``server``'s accept loop — ``serve_forever``
+    returns and the caller's normal shutdown sequence (follower sentinel,
+    server_close) runs exactly as on a clean exit, never mid-request.
+
+    A second signal skips the wait and stops immediately. Returns the
+    handler (so tests can invoke it without a real signal). Must be called
+    from the main thread (CPython signal rule)."""
+    import signal
+
+    state = {"signals": 0}
+
+    def _drain():
+        drained = gate.wait_idle()
+        if not drained:
+            log.warning(
+                "drain deadline (%.1fs) passed with %d request(s) still "
+                "in flight; stopping anyway",
+                gate.drain_deadline_s,
+                gate.inflight,
+            )
+        server.shutdown()
+
+    def _handler(signum, frame):
+        state["signals"] += 1
+        if state["signals"] > 1:
+            log.info("second signal: stopping immediately")
+            if on_second_signal is not None:
+                on_second_signal()
+            server.shutdown()
+            return
+        log.info(
+            "signal %d: draining (readiness 503, %d in flight, up to %.1fs)",
+            signum,
+            gate.inflight,
+            gate.drain_deadline_s,
+        )
+        gate.begin_drain()
+        # serve_forever blocks the main thread (where this handler runs);
+        # the idle-wait + shutdown must happen off-thread
+        threading.Thread(target=_drain, name="drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+    return _handler

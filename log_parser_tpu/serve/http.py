@@ -23,16 +23,27 @@ finish phase serializes, on the engine's own ``state_lock`` (shared with
 the shim transports and the admin routes). The reference's concurrency
 story was an unsynchronized data race on shared pattern objects
 (SURVEY.md §5.2) — not a behavior to reproduce.
+
+Overload: ``POST /parse`` admits through the engine-wide
+:class:`~log_parser_tpu.serve.admission.AdmissionController` (one gate
+shared with the shim transports — docs/OPS.md "Overload & degradation").
+A request may carry ``X-Request-Deadline-Ms``; one that would start past
+its deadline, or that finds the bounded queue full, is refused with 429 +
+``Retry-After``. During drain ``/health/ready`` answers 503 and new parses
+get 503.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.engine import AnalysisEngine
+from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 
 log = logging.getLogger(__name__)
 
@@ -48,6 +59,12 @@ class ParseServer(ThreadingHTTPServer):
         # the engine's own state lock: admin routes and the analyze finish
         # phase serialize on ONE lock across every transport (HTTP + shim)
         self.analyze_lock = engine.state_lock
+        # ... and the engine's one admission gate, shared the same way
+        self.admission = shared_gate(engine)
+        # responses we failed to write because the client had already gone
+        # away (GET /trace/last "droppedResponses")
+        self.dropped_responses = 0
+        self._drop_lock = threading.Lock()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -58,12 +75,30 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args) -> None:  # route to logging, not stderr
         log.debug("%s " + fmt, self.address_string(), *args)
 
-    def _send_json(self, status: int, payload: bytes) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+    def _send_json(
+        self, status: int, payload: bytes, headers: dict[str, str] | None = None
+    ) -> None:
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError) as exc:
+            # the client hung up first (its own timeout, or a shed it did
+            # not wait for). Not a server fault: count it, keep the worker
+            # thread's stderr free of ThreadingHTTPServer's default
+            # traceback spew.
+            with self.server._drop_lock:
+                self.server.dropped_responses += 1
+            log.debug(
+                "client %s disconnected before the response: %s",
+                self.address_string(),
+                exc,
+            )
+            self.close_connection = True
 
     # --------------------------------------------------------------- routes
 
@@ -102,6 +137,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
+            # draining: readiness fails (load balancers stop sending) but
+            # liveness holds — in-flight work is still finishing
+            if self.path == "/health/ready" and self.server.admission.draining:
+                return self._send_json(
+                    503,
+                    b'{"status":"DOWN","checks":[{"name":"draining",'
+                    b'"status":"DOWN"}]}',
+                )
             # still UP with the circuit open — requests serve from the
             # host path — but the degradation is visible to probes
             if self.server.engine.watchdog.circuit_open:
@@ -126,9 +169,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "totalMs": trace.total * 1e3,
             }
             payload["fallbackCount"] = self.server.engine.fallback_count
+            payload["hostRoutedCount"] = self.server.engine.host_routed_count
             payload["deviceCircuitOpen"] = (
                 self.server.engine.watchdog.circuit_open
             )
+            with self.server._drop_lock:
+                payload["droppedResponses"] = self.server.dropped_responses
+            payload["admission"] = self.server.admission.stats()
+            fault_stats = faults.stats()
+            if fault_stats is not None:
+                payload["faults"] = fault_stats
             return self._send_json(200, json.dumps(payload).encode())
         if self.path == "/debug/factors":
             fin = self.server.engine.last_finalized
@@ -137,6 +187,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, b'{"error":"not found"}')
 
     def _parse(self) -> None:
+        try:
+            faults.fire("http")
+        except Exception:
+            log.exception("injected HTTP-transport fault")
+            return self._send_json(500, b'{"error":"Internal analysis failure"}')
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b""
@@ -149,18 +204,49 @@ class _Handler(BaseHTTPRequestHandler):
         if data is None or data.pod is None:
             return self._send_json(400, _INVALID)
 
-        log.info("Received analysis request for pod: %s", data.pod_name)
+        deadline_ms = None  # None -> the gate's configured default
+        header = self.headers.get("X-Request-Deadline-Ms")
+        if header is not None:
+            try:
+                deadline_ms = float(header)
+            except ValueError:
+                return self._send_json(
+                    400, b'{"error":"invalid X-Request-Deadline-Ms"}'
+                )
+
         try:
-            # pipelined: ingest + device work of this request overlaps the
-            # host finalize of in-flight ones; only the frequency-coupled
-            # finish phase serializes (on engine.state_lock)
-            result = self.server.engine.analyze_pipelined(data)
-        except Exception:
-            # non-device bugs propagate out of analyze() by design
-            # (runtime/engine.py is_device_error) — answer with a JSON 500
-            # instead of dropping the connection mid-request
-            log.exception("Analysis failed for pod: %s", data.pod_name)
-            return self._send_json(500, b'{"error":"Internal analysis failure"}')
+            route = self.server.admission.acquire(deadline_ms)
+        except AdmissionRejected as exc:
+            # shed (429) or draining (503) — either way tell the client
+            # when it is worth coming back
+            return self._send_json(
+                exc.status,
+                json.dumps({"error": "overloaded", "reason": exc.reason}).encode(),
+                headers={"Retry-After": str(exc.retry_after_s)},
+            )
+        try:
+            log.info("Received analysis request for pod: %s", data.pod_name)
+            try:
+                if route == "host":
+                    # ladder rung 2: device slots saturated, this request
+                    # queued — serve it from the cheaper golden host path
+                    result = self.server.engine.analyze_host_routed(data)
+                else:
+                    # pipelined: ingest + device work of this request
+                    # overlaps the host finalize of in-flight ones; only
+                    # the frequency-coupled finish phase serializes (on
+                    # engine.state_lock)
+                    result = self.server.engine.analyze_pipelined(data)
+            except Exception:
+                # non-device bugs propagate out of analyze() by design
+                # (runtime/engine.py is_device_error) — answer with a JSON
+                # 500 instead of dropping the connection mid-request
+                log.exception("Analysis failed for pod: %s", data.pod_name)
+                return self._send_json(
+                    500, b'{"error":"Internal analysis failure"}'
+                )
+        finally:
+            self.server.admission.release()
         log.info(
             "Analysis complete for pod: %s. Found %d significant events.",
             data.pod_name,

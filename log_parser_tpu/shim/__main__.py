@@ -38,9 +38,40 @@ def main(argv: list[str] | None = None) -> int:
         metavar="SECONDS",
         help="watchdog deadline for the device step (see serve --help)",
     )
+    parser.add_argument(
+        "--max-inflight", type=int, default=None,
+        help="bound on concurrently-executing parses (see serve --help)",
+    )
+    parser.add_argument(
+        "--max-queue", type=int, default=None,
+        help="bound on queued parses before shedding (see serve --help)",
+    )
+    parser.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="default per-request deadline (see serve --help)",
+    )
+    parser.add_argument(
+        "--drain-s", type=float, default=None,
+        help="SIGTERM drain deadline (see serve --help)",
+    )
+    parser.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault-injection DSL (see serve --help)",
+    )
+    parser.add_argument("--fault-seed", type=int, default=None)
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
+    for flag, env_key in (
+        (args.max_inflight, "LOG_PARSER_TPU_MAX_INFLIGHT"),
+        (args.max_queue, "LOG_PARSER_TPU_MAX_QUEUE"),
+        (args.deadline_ms, "LOG_PARSER_TPU_DEADLINE_MS"),
+        (args.drain_s, "LOG_PARSER_TPU_DRAIN_S"),
+        (args.faults, "LOG_PARSER_TPU_FAULTS"),
+        (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
+    ):
+        if flag is not None:
+            os.environ[env_key] = str(flag)
 
     logging.basicConfig(
         level=args.log_level.upper(),
@@ -72,10 +103,17 @@ def main(argv: list[str] | None = None) -> int:
         )
         grpc_server.start()
         log.info("Shim serving gRPC (logparser.LogParser) on %s:%d", args.host, bound)
+    # same drain path as the HTTP front-end: SIGTERM/SIGINT flip the
+    # shared gate (both shim transports refuse new parses), in-flight
+    # work finishes, then the framed accept loop stops and gRPC follows
+    from log_parser_tpu.serve.admission import install_drain_handlers
+
+    install_drain_handlers(server, server.admission, log)
     log.info("Shim serving framed protobuf on %s:%d", args.host, args.port)
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
+        log.info("Drained; shutting down")
+    except KeyboardInterrupt:  # pre-handler-install window only
         log.info("Shutting down")
     finally:
         if grpc_server is not None:

@@ -15,6 +15,7 @@ so environments without grpcio still get the framed transport.
 
 from __future__ import annotations
 
+from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
 SERVICE_NAME = "logparser.LogParser"
@@ -33,6 +34,16 @@ def _handlers(service: LogParserService):
         def unary(request, context):
             try:
                 return fn(request)
+            except AdmissionRejected as exc:
+                # overload ladder: shed maps to RESOURCE_EXHAUSTED, a
+                # draining server to UNAVAILABLE — both carry the retry
+                # hint in the status message
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE
+                    if exc.reason == "draining"
+                    else grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    str(exc),
+                )
             except CLIENT_ERRORS as exc:
                 # client errors only: null pod, malformed JSON, invalid
                 # snapshot payloads. Internal bugs that surface as plain

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import socketserver
 
+from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
 from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
@@ -42,6 +43,10 @@ class ShimServer(socketserver.ThreadingTCPServer):
     @property
     def analyze_lock(self):
         return self.service.lock
+
+    @property
+    def admission(self):
+        return self.service.admission
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -74,6 +79,11 @@ class _Handler(socketserver.BaseRequestHandler):
                         method=envelope.method,
                         payload=fn(req).SerializeToString(),
                     )
+            except AdmissionRejected as exc:
+                # expected under overload/drain: shed quietly, the client
+                # reads the retry hint out of the error text
+                log.info("shim request shed on %s: %s", envelope.method, exc)
+                response = pb.Envelope(method=envelope.method, error=str(exc))
             except CLIENT_ERRORS as exc:
                 # expected client errors only (null pod, malformed JSON,
                 # invalid snapshot payload): no traceback, keep the log

@@ -14,6 +14,8 @@ from __future__ import annotations
 import json
 
 from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.serve.admission import shared_gate
 from log_parser_tpu.shim import logparser_pb2 as pb
 
 
@@ -41,16 +43,30 @@ class LogParserService:
         self.engine = engine
         # the engine's own state lock — one lock across every transport
         self.lock = engine.state_lock
+        # ... and the engine's one admission gate (serve/admission.py):
+        # saturating the shim sheds on HTTP and vice versa
+        self.admission = shared_gate(engine)
 
     # ----------------------------------------------------------------- parse
 
     def parse(self, req: pb.ParseRequest) -> pb.ParseResponse:
+        faults.fire("shim")
         pod = json.loads(req.pod_json) if req.pod_json else None
         if pod is None:
             raise InvalidPodError()
         data = PodFailureData(pod=pod, logs=req.logs)
-        # pipelined: only the finish phase takes self.lock (inside)
-        result = self.engine.analyze_pipelined(data)
+        # the shared gate may shed (AdmissionRejected propagates to the
+        # transport: error envelope / RESOURCE_EXHAUSTED) or route this
+        # request to the host path under pressure
+        route = self.admission.acquire()
+        try:
+            if route == "host":
+                result = self.engine.analyze_host_routed(data)
+            else:
+                # pipelined: only the finish phase takes self.lock (inside)
+                result = self.engine.analyze_pipelined(data)
+        finally:
+            self.admission.release()
 
         resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
         for event in result.events:

@@ -1,0 +1,416 @@
+"""Admission control + the degradation ladder (serve/admission.py) under
+injected chaos (runtime/faults.py).
+
+The acceptance scenario from the robustness issue runs here end-to-end
+with a fixed fault seed: device-path 200s → host-path 200s with the
+breaker open → 429s with Retry-After once the queue bound is hit → a
+drain where in-flight work completes, readiness goes 503, and the serve
+loop exits cleanly."""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.engine import DeviceWatchdog
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.serve import make_server
+from log_parser_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    install_drain_handlers,
+    shared_gate,
+)
+from log_parser_tpu.shim.client import ShimClient
+from log_parser_tpu.shim.server import make_shim_server
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+
+pytestmark = pytest.mark.chaos
+
+LOGS = "ok\nERROR boom\nok"
+POD = {"pod": {"metadata": {"name": "p"}}, "logs": LOGS}
+
+
+def _sets():
+    return [make_pattern_set([make_pattern("e", regex="ERROR", confidence=0.7)])]
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _post(url, payload=POD, headers=None):
+    """(status, body, response headers) for one POST."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _await(predicate, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _Client(threading.Thread):
+    """One request on its own thread, result captured for later asserts."""
+
+    def __init__(self, url, payload=POD, headers=None):
+        super().__init__(daemon=True)
+        self.url, self.payload, self.headers = url, payload, headers
+        self.result = None
+        self.start()
+
+    def join_result(self, timeout=30):
+        self.join(timeout)
+        assert not self.is_alive(), "client request never completed"
+        return self.result
+
+    def run(self):
+        self.result = _post(self.url, self.payload, self.headers)
+
+
+@pytest.fixture
+def served_engine():
+    """Engine + HTTP server on an ephemeral port; gate/watchdog are set
+    per-test BEFORE the fixture is used via the returned builder."""
+    state = {}
+
+    def build(gate=None, watchdog=None, fallback=True):
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.fallback_to_golden = fallback
+        if watchdog is not None:
+            engine.watchdog = watchdog
+        if gate is not None:
+            engine.admission_gate = gate  # shared_gate() will find it
+        server = make_server(engine, host="127.0.0.1", port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        state.update(engine=engine, server=server, thread=thread)
+        return engine, server, f"http://127.0.0.1:{server.server_address[1]}", thread
+
+    yield build
+    if state:
+        state["server"].shutdown()
+        state["server"].server_close()
+
+
+# --------------------------------------------------------------- unit level
+
+
+class TestController:
+    def test_routes_and_counters(self):
+        gate = AdmissionController(max_inflight=2, max_queue=1)
+        assert gate.acquire() == "device"
+        assert gate.acquire() == "device"
+        # saturated now; a queued acquire on another thread degrades to host
+        got = []
+        t = threading.Thread(target=lambda: got.append(gate.acquire()))
+        t.start()
+        _await(lambda: gate.queued == 1, what="waiter to queue")
+        # queue full: the next arrival sheds immediately with 429
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.acquire()
+        assert exc.value.status == 429 and exc.value.reason == "queue full"
+        assert exc.value.retry_after_s >= 1
+        gate.release()
+        t.join(5)
+        assert got == ["host"]
+        stats = gate.stats()
+        assert stats["admittedDevice"] == 2
+        assert stats["admittedHost"] == 1
+        assert stats["shedQueueFull"] == 1
+
+    def test_unbounded_mode_still_counts_inflight(self):
+        gate = AdmissionController()  # max_inflight=0: no shedding...
+        for _ in range(5):
+            assert gate.acquire() == "device"
+        assert gate.inflight == 5  # ...but drain can still wait for work
+        for _ in range(5):
+            gate.release()
+        assert gate.wait_idle(0.1)
+
+    def test_deadline_sheds_queued_request(self):
+        gate = AdmissionController(max_inflight=1, max_queue=2)
+        gate.acquire()
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.acquire(deadline_ms=50)  # slot never frees within 50ms
+        assert exc.value.reason == "deadline"
+        assert gate.stats()["shedDeadline"] == 1
+        gate.release()
+
+    def test_default_deadline_applies_when_no_header(self):
+        gate = AdmissionController(
+            max_inflight=1, max_queue=2, default_deadline_ms=50
+        )
+        gate.acquire()
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.acquire()  # None -> default 50ms budget
+        assert exc.value.reason == "deadline"
+        gate.release()
+
+    def test_drain_rejects_and_wakes_waiters(self):
+        gate = AdmissionController(max_inflight=1, max_queue=2)
+        gate.acquire()
+        errors = []
+
+        def waiter():
+            try:
+                gate.acquire()
+            except AdmissionRejected as exc:
+                errors.append(exc)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        _await(lambda: gate.queued == 1, what="waiter to queue")
+        gate.begin_drain()
+        t.join(5)
+        assert errors and errors[0].status == 503
+        with pytest.raises(AdmissionRejected):
+            gate.acquire()
+        assert not gate.wait_idle(0.05)  # one still in flight
+        gate.release()
+        assert gate.wait_idle(1.0)
+
+    def test_shared_gate_is_one_per_engine(self):
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        assert shared_gate(engine) is shared_gate(engine)
+
+
+# ---------------------------------------------------------------- the ladder
+
+
+class TestDegradationLadder:
+    def test_full_ladder_under_injected_hang(self, served_engine):
+        """The acceptance scenario, seeded and sequenced deterministically:
+        (a) device-path 200s, (b) host-path 200s with the breaker open,
+        (c) 429 + Retry-After at the queue bound, (d) drain: in-flight
+        completes, readiness 503, serve loop exits cleanly."""
+        gate = AdmissionController(max_inflight=1, max_queue=1)
+        engine, server, url, serve_thread = served_engine(
+            gate=gate,
+            # long cooldown: no half-open probe interferes mid-test
+            watchdog=DeviceWatchdog(timeout_s=60.0, cooldown_s=60.0),
+        )
+        # warm-up takes the one-time XLA compile off the watchdog clock,
+        # then the deadline drops to something a wedge will overrun
+        assert _post(url + "/parse")[0] == 200
+        engine.watchdog.timeout_s = 0.3
+        faults.install(
+            FaultRegistry.parse(
+                # device call 3 wedges for good; ingest calls 4-5 are slow
+                # (they hold the admission slot so the queue fills)
+                "device_hang:inf@after=2@times=1,"
+                "ingest_slow:1.0@after=3@times=2",
+                seed=42,
+            )
+        )
+
+        # (a) full service: two requests on the device path
+        for _ in range(2):
+            status, body, _ = _post(url + "/parse")
+            assert status == 200 and body["summary"]["significantEvents"] == 1
+        assert engine.fallback_count == 0
+
+        # (b) the injected wedge: watchdog times out, breaker opens, the
+        # request is still answered 200 from the host path
+        status, body, _ = _post(url + "/parse")
+        assert status == 200 and body["summary"]["significantEvents"] == 1
+        assert engine.fallback_count == 1
+        assert engine.watchdog.circuit_open
+        _, health = _get(url + "/health")
+        assert health["checks"] == [{"name": "device", "status": "DEGRADED"}]
+
+        # (c) saturate: A holds the one slot (slow ingest), B queues (will
+        # degrade to host ROUTING, not fallback), C finds the queue full
+        a = _Client(url + "/parse")
+        _await(lambda: gate.inflight == 1, what="A to hold the slot")
+        b = _Client(url + "/parse")
+        _await(lambda: gate.queued == 1, what="B to queue")
+        status, body, headers = _post(url + "/parse")  # C
+        assert status == 429
+        assert body == {"error": "overloaded", "reason": "queue full"}
+        assert int(headers["Retry-After"]) >= 1
+
+        status, _, _ = a.join_result()
+        assert status == 200  # A: breaker open -> host path serves it
+        status, _, _ = b.join_result()
+        assert status == 200  # B: routed to the host path by the gate
+        assert engine.host_routed_count == 1
+        assert engine.fallback_count == 2  # request (b) + A
+
+        _, trace = _get(url + "/trace/last")
+        assert trace["admission"]["shedQueueFull"] == 1
+        assert trace["admission"]["admittedHost"] == 1
+        assert trace["hostRoutedCount"] == 1
+        assert trace["faults"]["seed"] == 42
+        assert trace["faults"]["fired"]["device_hang"] == 1
+        assert trace["faults"]["fired"]["ingest_slow"] >= 1
+
+        # (d) drain: D in flight (slow ingest), then the SIGTERM handler
+        old_term = signal.getsignal(signal.SIGTERM)
+        old_int = signal.getsignal(signal.SIGINT)
+        try:
+            import logging
+
+            handler = install_drain_handlers(
+                server, gate, logging.getLogger("test-drain")
+            )
+            d = _Client(url + "/parse")
+            _await(lambda: gate.inflight == 1, what="D to hold the slot")
+            handler(signal.SIGTERM, None)
+            _await(lambda: gate.draining, what="drain to begin")
+            status, _ = _get(url + "/health/ready")
+            assert status == 503
+            status, body, headers = _post(url + "/parse")
+            assert status == 503 and body["reason"] == "draining"
+            assert "Retry-After" in headers
+            status, _, _ = d.join_result()
+            assert status == 200  # in-flight work finished during drain
+            serve_thread.join(10)
+            assert not serve_thread.is_alive()  # serve loop exited cleanly
+        finally:
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+
+    def test_deadline_header_sheds_at_queue_head(self, served_engine):
+        """A queued request whose X-Request-Deadline-Ms expires before a
+        slot frees is shed with 429 instead of doing dead work."""
+        faults.install(FaultRegistry.parse("ingest_slow:1.0@times=1", seed=1))
+        gate = AdmissionController(max_inflight=1, max_queue=2)
+        engine, server, url, _ = served_engine(gate=gate)
+
+        a = _Client(url + "/parse")
+        _await(lambda: gate.inflight == 1, what="A to hold the slot")
+        status, body, headers = _post(
+            url + "/parse", headers={"X-Request-Deadline-Ms": "80"}
+        )
+        assert status == 429 and body["reason"] == "deadline"
+        assert "Retry-After" in headers
+        assert a.join_result()[0] == 200
+        assert gate.stats()["shedDeadline"] == 1
+
+    def test_bad_deadline_header_is_400(self, served_engine):
+        _, _, url, _ = served_engine()
+        status, body, _ = _post(
+            url + "/parse", headers={"X-Request-Deadline-Ms": "soon"}
+        )
+        assert status == 400
+
+
+# ------------------------------------------------------- cross-transport gate
+
+
+class TestSharedGateAcrossTransports:
+    def test_http_saturation_sheds_on_shim(self, served_engine):
+        """ONE semaphore guards every transport: filling it over HTTP makes
+        the framed shim shed, and vice versa once the slot frees."""
+        faults.install(FaultRegistry.parse("ingest_slow:1.2@times=1", seed=3))
+        gate = AdmissionController(max_inflight=1, max_queue=0)
+        engine, server, url, _ = served_engine(gate=gate)
+        shim = make_shim_server(engine, host="127.0.0.1", port=0)
+        shim_port = shim.server_address[1]
+        assert shim.admission is gate  # same object, not a twin
+        shim_thread = threading.Thread(target=shim.serve_forever, daemon=True)
+        shim_thread.start()
+        try:
+            a = _Client(url + "/parse")  # HTTP holds the only slot
+            _await(lambda: gate.inflight == 1, what="HTTP to hold the slot")
+            with ShimClient("127.0.0.1", shim_port) as client:
+                with pytest.raises(ValueError, match="overloaded"):
+                    client.parse(POD["pod"], POD["logs"])
+                assert a.join_result()[0] == 200
+                # slot free again: the shim serves
+                resp = client.parse(POD["pod"], POD["logs"])
+                assert resp.summary.significant_events == 1
+            assert gate.stats()["shedQueueFull"] == 1
+        finally:
+            shim.shutdown()
+            shim.server_close()
+
+
+# ----------------------------------------------------------- half-open probe
+
+
+class TestHalfOpenProbe:
+    def test_probe_restores_device_serving_with_abandoned_workers(
+        self, served_engine
+    ):
+        """Acceptance: a permanent injected hang opens the circuit and its
+        workers never respond; once injection stops (times= exhausted),
+        the half-open probe closes the circuit again — with the abandoned
+        workers STILL outstanding. The old close-on-last-worker rule alone
+        would have left the breaker stuck open forever."""
+        watchdog = DeviceWatchdog(timeout_s=60.0, cooldown_s=0.35)
+        engine, server, url, _ = served_engine(watchdog=watchdog)
+        # compile the device path before the tight deadline applies, so
+        # the final probe measures the real step, not XLA compilation
+        assert _post(url + "/parse")[0] == 200
+        watchdog.timeout_s = 0.15
+        faults.install(FaultRegistry.parse("device_hang:inf@times=2", seed=5))
+
+        # hang #1: breaker opens, golden answers
+        status, _, _ = _post(url + "/parse")
+        assert status == 200
+        assert engine.fallback_count == 1 and watchdog.circuit_open
+
+        # inside the cool-down: NO probe — instant host path, the wedged
+        # backend is not re-entered
+        status, _, _ = _post(url + "/parse")
+        assert status == 200
+        assert engine.fallback_count == 2
+        assert faults.active().counts()["device_hang"] == 1
+
+        # cool-down elapsed: the next request is the half-open trial; it
+        # meets hang #2, times out, and re-arms the breaker
+        time.sleep(0.4)
+        status, _, _ = _post(url + "/parse")
+        assert status == 200
+        assert engine.fallback_count == 3 and watchdog.circuit_open
+        assert faults.active().counts()["device_hang"] == 2
+
+        # injection exhausted. After another cool-down the probe reaches
+        # the real device, succeeds, and closes the circuit even though
+        # both abandoned workers are still parked in their hang.
+        time.sleep(0.4)
+        status, body, _ = _post(url + "/parse")
+        assert status == 200 and body["summary"]["significantEvents"] == 1
+        assert not watchdog.circuit_open
+        assert engine.fallback_count == 3  # the probe served on-device
+        with watchdog._lock:
+            assert watchdog._inflight == 2  # abandoned workers outstanding
+
+        # recovered: subsequent requests take the device path directly
+        status, _, _ = _post(url + "/parse")
+        assert status == 200 and engine.fallback_count == 3

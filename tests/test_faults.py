@@ -1,0 +1,190 @@
+"""Fault-injection subsystem (runtime/faults.py): DSL parsing, seeded
+determinism, trigger bookkeeping, and the behavior of injected faults at
+each pipeline point — device faults degrade to the golden host path, every
+other site propagates like the logic bug it simulates."""
+
+from __future__ import annotations
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.engine import is_device_error
+from log_parser_tpu.runtime.faults import (
+    FaultRegistry,
+    FaultSpecError,
+    InjectedDeviceFault,
+    InjectedFault,
+    parse_spec,
+)
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+
+pytestmark = pytest.mark.chaos
+
+LOGS = "ok\nERROR boom\nok\nERROR again"
+
+
+def _sets():
+    return [make_pattern_set([make_pattern("e", regex="ERROR", confidence=0.7)])]
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """Every test starts and ends with no registry installed; teardown
+    lifts any hangs so no injected waiter outlives its test."""
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+class TestDSL:
+    def test_parse_full_grammar(self):
+        spec = parse_spec("device_hang:2.5@after=3@times=1@p=0.5")
+        assert spec.site == "device" and spec.action == "hang"
+        assert spec.arg == 2.5 and spec.after == 3 and spec.times == 1
+        assert spec.p == 0.5
+
+    def test_raise_arg_is_probability(self):
+        assert parse_spec("ingest_raise:0.25").p == 0.25
+        assert parse_spec("ingest_raise").p == 1.0
+
+    def test_multi_underscore_site(self):
+        spec = parse_spec("http_body_raise")
+        assert spec.site == "http_body" and spec.action == "raise"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "device",  # no action
+            "device_explode",  # unknown action
+            "_raise",  # empty site
+            "device_raise:2.0",  # probability out of range
+            "device_hang:-1",  # negative delay
+            "device_hang:2@nope=1",  # unknown modifier
+            "device_hang:2@after=x",  # non-integer modifier
+            "device_raise@p=0",  # p out of range
+        ],
+    )
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(FaultSpecError):
+            parse_spec(bad)
+
+    def test_from_env(self):
+        reg = FaultRegistry.from_env(
+            {faults.ENV_SPECS: "device_raise, shim_raise:0.5", faults.ENV_SEED: "11"}
+        )
+        assert [s.point for s in reg.specs] == ["device_raise", "shim_raise"]
+        assert reg.seed == 11
+        assert FaultRegistry.from_env({}) is None
+
+
+class TestRegistry:
+    def test_after_and_times_window(self):
+        reg = FaultRegistry.parse("device_raise@after=2@times=2")
+        outcomes = []
+        for _ in range(6):
+            try:
+                reg.fire("device")
+                outcomes.append("ok")
+            except InjectedDeviceFault:
+                outcomes.append("boom")
+        # evaluations 1-2 skipped, 3-4 injected, 5-6 exhausted
+        assert outcomes == ["ok", "ok", "boom", "boom", "ok", "ok"]
+        assert reg.counts() == {"device_raise": 2}
+        assert reg.stats()["calls"] == {"device_raise": 6}
+
+    def test_seeded_probability_is_reproducible(self):
+        def run(seed):
+            reg = FaultRegistry.parse("shim_raise:0.5", seed=seed)
+            out = []
+            for _ in range(32):
+                try:
+                    reg.fire("shim")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = run(7), run(7)
+        assert a == b and 0 < sum(a) < 32  # same seed, same decisions
+        assert run(8) != a  # different seed, different sequence
+
+    def test_lift_releases_hangs_and_disables(self):
+        import threading
+        import time
+
+        reg = FaultRegistry.parse("device_hang:inf")
+        t0 = time.monotonic()
+        hung = threading.Thread(target=lambda: reg.fire("device"))
+        hung.start()
+        hung.join(0.05)
+        assert hung.is_alive()  # parked on the release event
+        reg.lift("device_hang")
+        hung.join(5)
+        assert not hung.is_alive()
+        reg.fire("device")  # lifted: no longer injects
+        assert time.monotonic() - t0 < 5
+        assert reg.counts() == {"device_hang": 1}
+
+    def test_unknown_site_is_noop(self):
+        reg = FaultRegistry.parse("device_raise")
+        reg.fire("ingest")
+        assert reg.counts() == {"device_raise": 0}
+
+    def test_module_fire_without_registry_is_noop(self):
+        faults.fire("device")
+        assert faults.stats() is None
+
+
+class TestEngineIntegration:
+    def test_injected_device_fault_degrades_to_golden(self):
+        """A device_raise fault is a device error: the golden host path
+        serves the request, the fallback counter moves."""
+        faults.install(FaultRegistry.parse("device_raise@times=1"))
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.fallback_to_golden = True
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+        result = engine.analyze(data)
+        assert len(result.events) == 2
+        assert engine.fallback_count == 1
+        # injection exhausted: the next request runs on the device
+        engine.analyze(data)
+        assert engine.fallback_count == 1
+        assert faults.active().counts() == {"device_raise": 1}
+
+    def test_injected_ingest_fault_propagates(self):
+        """Non-device faults simulate logic bugs: never masked by the
+        fallback, exactly like is_device_error demands of the real thing."""
+        faults.install(FaultRegistry.parse("ingest_raise@times=1"))
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.fallback_to_golden = True
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+        with pytest.raises(InjectedFault):
+            engine.analyze(data)
+        assert engine.fallback_count == 0
+
+    def test_injected_finalize_fault_rolls_back_frequency(self):
+        faults.install(FaultRegistry.parse("finalize_raise@times=1"))
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=LOGS)
+        with pytest.raises(InjectedFault):
+            engine.analyze(data)
+        assert engine.frequency.get_frequency_statistics() == {}
+        engine.analyze(data)  # exhausted: clean request works
+        assert engine.frequency.get_frequency_statistics() == {"e": 2}
+
+    def test_classification(self):
+        assert is_device_error(InjectedDeviceFault("device_raise", 1))
+        assert not is_device_error(InjectedFault("ingest_raise", 1))
+
+    def test_injected_broadcast_fault(self):
+        """The distributed broadcast fires its chaos point before the
+        first collective, so a single-process call trips it too."""
+        from log_parser_tpu.parallel.distributed import broadcast_bytes
+
+        faults.install(FaultRegistry.parse("broadcast_raise@times=1"))
+        with pytest.raises(InjectedFault):
+            broadcast_bytes(b"payload")

@@ -138,6 +138,113 @@ class TestAnalysisFailure:
             server.shutdown()
 
 
+class TestFrequencyRestoreValidation:
+    """POST /frequency/restore is all-or-nothing: any invalid entry fails
+    the whole request with 400 and existing state stays untouched."""
+
+    @pytest.fixture()
+    def fresh_server(self):
+        engine = AnalysisEngine(
+            [make_pattern_set([make_pattern("err", regex=r"\bERROR\b",
+                                            confidence=0.5)], "lib")],
+            ScoringConfig(),
+        )
+        server = make_server(engine, host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        server.server_close()
+
+    def _warm(self, url):
+        """Record one real match so 'state untouched' is observable."""
+        post(url + "/parse",
+             {"pod": {"metadata": {"name": "p"}}, "logs": "an ERROR here"})
+        _, stats = get(url + "/frequency/stats")
+        assert stats == {"err": 1}
+
+    def test_valid_restore_replaces_state(self, fresh_server):
+        self._warm(fresh_server)
+        status, body = post(
+            fresh_server + "/frequency/restore", {"oom": [0.0, 12.5]}
+        )
+        assert status == 200 and body == {"status": "restored"}
+        _, stats = get(fresh_server + "/frequency/stats")
+        assert stats == {"oom": 2}  # replaced, not merged: "err" is gone
+
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            {"ok": [1.0], "bad": [2.0, -0.5]},  # one negative age poisons all
+            {"ok": [1.0], "bad": 7},  # non-list value
+            {"ok": [1.0], "bad": [1.0, "soon"]},  # non-numeric age
+            {"ok": [-1.0]},  # negative age alone
+            [["ok", [1.0]]],  # non-dict payload
+            "nope",
+        ],
+    )
+    def test_invalid_payload_is_400_and_state_untouched(
+        self, fresh_server, payload
+    ):
+        self._warm(fresh_server)
+        status, body = post(fresh_server + "/frequency/restore", payload)
+        assert status == 400
+        assert body == {"error": "expected {patternId: [ageSeconds >= 0]}"}
+        _, stats = get(fresh_server + "/frequency/stats")
+        assert stats == {"err": 1}  # nothing partially applied
+
+    def test_malformed_json_is_400(self, fresh_server):
+        self._warm(fresh_server)
+        status, _ = post(fresh_server + "/frequency/restore", None, raw=b"{oops")
+        assert status == 400
+        _, stats = get(fresh_server + "/frequency/stats")
+        assert stats == {"err": 1}
+
+
+class TestDroppedResponses:
+    def test_client_gone_is_counted_not_raised(self):
+        """A client that hangs up before the response lands (BrokenPipe /
+        ConnectionReset on write) is counted in droppedResponses and
+        logged at debug — no traceback spew, no handler crash."""
+        from log_parser_tpu.serve.http import ParseServer, _Handler
+
+        engine = AnalysisEngine(
+            [make_pattern_set([make_pattern("e", regex="E")])], ScoringConfig()
+        )
+        server = make_server(engine, host="127.0.0.1", port=0)
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+
+            class _GonePipe:
+                def write(self, data):
+                    raise BrokenPipeError(32, "Broken pipe")
+
+                def flush(self):
+                    pass
+
+            for exc in (BrokenPipeError, ConnectionResetError):
+                handler = _Handler.__new__(_Handler)
+                handler.server = server
+                handler.client_address = ("127.0.0.1", 1)
+                handler.request_version = "HTTP/1.1"
+                handler.requestline = "POST /parse HTTP/1.1"
+                handler.close_connection = False
+                pipe = _GonePipe()
+                pipe.write = lambda data, exc=exc: (_ for _ in ()).throw(
+                    exc(32, "gone")
+                )
+                handler.wfile = pipe
+                handler._send_json(200, b"{}")  # must not raise
+                assert handler.close_connection is True
+
+            assert server.dropped_responses == 2
+            _, trace = get(url + "/trace/last")
+            assert trace["droppedResponses"] == 2
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
 class TestDegradedHealth:
     def test_health_reports_device_circuit(self):
         """Health stays UP with the watchdog circuit open (requests serve

@@ -1,0 +1,310 @@
+"""Chaos sweep: the fault-injection DSL against a LIVE server.
+
+The chaos tests (tests/test_admission.py, tests/test_faults.py) exercise
+the ladder in-process; this tool runs the same scenarios the way an
+operator meets them — a real ``python -m log_parser_tpu.serve`` child
+process, concurrent HTTP clients, signals — and prints a pass/fail table.
+Every scenario pins ``LOG_PARSER_TPU_FAULT_SEED``, so a failing row
+reproduces bit-identically when re-run.
+
+Scenarios:
+
+- ``baseline``        no faults — every request 200.
+- ``device-raise``    probabilistic device faults — every request still
+                      200 (golden fallback absorbs them), fallbackCount
+                      moved, NOTHING shed.
+- ``device-wedge``    a permanent device hang under ``--device-timeout``
+                      — breaker opens, service stays 200 from the host
+                      path, health shows DEGRADED.
+- ``queue-shed``      slow ingest + max-inflight=1/max-queue=1 + a burst
+                      — some 200s, some 429s carrying Retry-After.
+- ``drain``           SIGTERM with a slow request in flight — in-flight
+                      answered 200, /health/ready 503 during drain,
+                      child exits 0.
+
+Usage: python tools/chaos_sweep.py [--only NAME] [--keep-logs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PATTERN_DIR = os.path.join(REPO, "log_parser_tpu", "patterns", "builtin")
+LOGS = "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after"
+PAYLOAD = json.dumps(
+    {"pod": {"metadata": {"name": "chaos"}}, "logs": LOGS}
+).encode()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def post(url: str, headers: dict | None = None, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url + "/parse",
+        data=PAYLOAD,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def get(url: str, path: str):
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class Server:
+    """One serve child; scenario args via CLI flags, chaos via env."""
+
+    def __init__(self, name: str, args: list[str], env: dict[str, str]):
+        self.port = free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.log = tempfile.NamedTemporaryFile(
+            "wb", prefix=f"chaos_{name}_", suffix=".log", delete=False
+        )
+        child_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONUNBUFFERED": "1",
+            **env,
+        }
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "log_parser_tpu.serve",
+                "--pattern-dir", PATTERN_DIR,
+                "--host", "127.0.0.1", "--port", str(self.port),
+                *args,
+            ],
+            cwd=REPO,
+            env=child_env,
+            stdout=self.log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def wait_ready(self, timeout: float = 90.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"server exited rc={self.proc.returncode} before ready "
+                    f"(log: {self.log.name})"
+                )
+            try:
+                status, _ = get(self.url, "/health/ready")
+                if status == 200:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.25)
+        raise RuntimeError(f"server never became ready (log: {self.log.name})")
+
+    def stop(self, expect_zero: bool = False) -> int:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(10)
+        rc = self.proc.returncode
+        if expect_zero and rc != 0:
+            raise AssertionError(f"expected clean exit, got rc={rc}")
+        return rc
+
+
+class Burst:
+    """N concurrent posts; collect (status, headers) pairs."""
+
+    def __init__(self, url: str, n: int, headers: dict | None = None):
+        self.results: list[tuple[int, dict]] = []
+        self._lock = threading.Lock()
+
+        def one():
+            status, _, hdrs = post(url, headers)
+            with self._lock:
+                self.results.append((status, hdrs))
+
+        self.threads = [threading.Thread(target=one) for _ in range(n)]
+        for t in self.threads:
+            t.start()
+
+    def join(self, timeout: float = 60.0):
+        for t in self.threads:
+            t.join(timeout)
+        assert all(not t.is_alive() for t in self.threads), "burst stuck"
+        return self.results
+
+
+# ------------------------------------------------------------- scenarios
+
+
+def scenario_baseline(srv: Server):
+    for _ in range(4):
+        status, body, _ = post(srv.url)
+        assert status == 200, f"expected 200, got {status}"
+        assert body["summary"]["significantEvents"] >= 1
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_device_raise(srv: Server):
+    statuses = [post(srv.url)[0] for _ in range(12)]
+    assert statuses == [200] * 12, statuses
+    _, trace = get(srv.url, "/trace/last")
+    fired = trace["faults"]["fired"]["device_raise"]
+    assert 0 < fired < 12, f"seeded p=0.5 fired {fired}/12"
+    assert trace["fallbackCount"] == fired, trace
+    assert trace["admission"]["shedQueueFull"] == 0
+
+
+def scenario_device_wedge(srv: Server):
+    # warm up off the wedge (after=1), then hit it: still 200, via golden
+    assert post(srv.url)[0] == 200
+    statuses = [post(srv.url)[0] for _ in range(3)]
+    assert statuses == [200] * 3, statuses
+    status, health = get(srv.url, "/health")
+    assert status == 200 and health.get("checks"), health
+    assert health["checks"][0]["status"] == "DEGRADED", health
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["deviceCircuitOpen"] is True
+    assert trace["fallbackCount"] >= 1
+
+
+def scenario_queue_shed(srv: Server):
+    post(srv.url)  # warm: XLA compile outside the contended burst
+    results = Burst(srv.url, 6).join()
+    codes = sorted(s for s, _ in results)
+    assert codes.count(200) >= 2, codes
+    assert codes.count(429) >= 1, codes
+    for status, hdrs in results:
+        if status == 429:
+            assert int(hdrs["Retry-After"]) >= 1, hdrs
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["admission"]["shedQueueFull"] >= 1, trace["admission"]
+
+
+def scenario_drain(srv: Server):
+    post(srv.url)  # warm
+    slow = Burst(srv.url, 1)  # ingest_slow holds this one in flight
+    time.sleep(0.4)
+    srv.proc.send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + 10
+    saw_unready = False
+    while time.monotonic() < deadline and not saw_unready:
+        try:
+            status, _ = get(srv.url, "/health/ready")
+            saw_unready = status == 503
+        except OSError:  # listener already gone: drain finished
+            break
+        time.sleep(0.05)
+    results = slow.join()
+    assert results[0][0] == 200, f"in-flight request got {results[0][0]}"
+    srv.proc.wait(30)
+    assert srv.proc.returncode == 0, f"rc={srv.proc.returncode}"
+    assert saw_unready, "never observed /health/ready 503 during drain"
+
+
+SCENARIOS = [
+    ("baseline", [], {}, scenario_baseline),
+    (
+        "device-raise",
+        [],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_raise:0.5",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_device_raise,
+    ),
+    (
+        "device-wedge",
+        ["--device-timeout", "2.0"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_hang:inf@after=1@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+            "LOG_PARSER_TPU_BREAKER_COOLDOWN_S": "600",
+        },
+        scenario_device_wedge,
+    ),
+    (
+        "queue-shed",
+        ["--max-inflight", "1", "--max-queue", "1"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "ingest_slow:1.0@after=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_queue_shed,
+    ),
+    (
+        "drain",
+        ["--drain-s", "20"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "ingest_slow:2.0@after=1@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_drain,
+    ),
+]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="chaos_sweep")
+    parser.add_argument("--only", help="run a single scenario by name")
+    parser.add_argument(
+        "--keep-logs", action="store_true",
+        help="keep child logs even for passing scenarios",
+    )
+    args = parser.parse_args(argv)
+
+    rows = []
+    failed = 0
+    for name, flags, env, check in SCENARIOS:
+        if args.only and name != args.only:
+            continue
+        t0 = time.monotonic()
+        srv = Server(name, flags, env)
+        try:
+            srv.wait_ready()
+            check(srv)
+            if name != "drain":  # drain stops (and asserts on) itself
+                srv.stop()
+            rows.append((name, "PASS", time.monotonic() - t0, ""))
+            if not args.keep_logs:
+                os.unlink(srv.log.name)
+        except Exception as exc:  # one row per scenario, keep sweeping
+            srv.stop()
+            failed += 1
+            rows.append((name, "FAIL", time.monotonic() - t0,
+                         f"{exc} (log: {srv.log.name})"))
+
+    width = max(len(r[0]) for r in rows) if rows else 8
+    print(f"\n{'scenario':<{width}}  result  seconds  detail")
+    for name, result, secs, detail in rows:
+        print(f"{name:<{width}}  {result:<6}  {secs:7.1f}  {detail}")
+    print(f"\n{len(rows) - failed}/{len(rows)} scenarios passed (seed 42)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
