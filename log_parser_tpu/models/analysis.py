@@ -64,6 +64,10 @@ class AnalysisMetadata(Model):
     total_lines: int = 0
     analyzed_at: str = ""
     patterns_used: list[str] | None = None
+    # set (e.g. "distributed-fallback") when the response was served on a
+    # degraded path instead of the full mesh; None (omitted from JSON via
+    # drop_none) on the normal path — the reference has no such field
+    degraded: str | None = None
 
 
 @dataclasses.dataclass

@@ -17,6 +17,18 @@ broadcasts each request's raw payload to the followers
 process runs the identical analyze() pipeline in lockstep. Followers
 discard their (identical) results; the coordinator answers the client.
 
+Resilience (parallel/resilience.py): every coordinator→follower dispatch
+runs under a deadline and is retried with backoff while it provably never
+entered a collective; a group that stops acking is declared dead and the
+coordinator flips to **degrade-to-local** — requests run on its local
+devices through a private single-process `ShardedFusedStep` (or the
+golden host path when it has none), stamped ``metadata.degraded =
+"distributed-fallback"``. A background heartbeat (`_PING` broadcast +
+ack `process_allgather`) keeps per-follower liveness fresh and re-admits
+the mesh once followers respond again. The control-plane collectives are
+behind a swappable :class:`Transport` so single-process tests can drive
+the whole ladder with a stub follower group.
+
 Frequency note: each process evolves its own host-side frequency tracker
 from the same deterministic request stream, so trackers agree except for
 sub-second wall-clock skew at window boundaries. Device dispatches take no
@@ -24,21 +36,36 @@ frequency input (finalization is host-side, runtime/finalize.py), so skew
 can never desynchronize the collectives; the coordinator's scores are the
 canonical response. Admin mutations (reset/restore) apply on the
 coordinator only — snapshot/restore across a restart re-seeds followers.
+During a degraded window only the coordinator advances its tracker; on
+readmission followers resume from their pre-window state, which widens
+the same benign skew and keeps the coordinator canonical.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
+import threading
 
 import numpy as np
 
 from log_parser_tpu.models.pod import PodFailureData
-from log_parser_tpu.parallel.sharded import ShardedEngine
+from log_parser_tpu.parallel.resilience import (
+    DEGRADED_MARKER,
+    ENV_HEARTBEAT_S,
+    MeshHealth,
+    MeshUnavailable,
+    RetryPolicy,
+    dispatch_with_retry,
+)
+from log_parser_tpu.parallel.sharded import ShardedEngine, ShardedFusedStep
+from log_parser_tpu.runtime import faults
 
 log = logging.getLogger(__name__)
 
 _SHUTDOWN = b"\x00shutdown"
+_PING = b"\x00ping"
 
 
 def init_distributed(
@@ -67,44 +94,96 @@ def init_distributed(
     )
 
 
+class JaxProcessTransport:
+    """The real control plane: byte broadcast + ack allgather as collectives
+    over the `jax.distributed` runtime."""
+
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def broadcast(self, payload: bytes | None) -> bytes:
+        """Broadcast a byte string from process 0 to every process (two
+        fixed-shape collectives: an int64 length header, then the buffer).
+        Non-coordinators pass ``None`` and receive the coordinator's
+        bytes."""
+        from jax.experimental import multihost_utils as mh
+
+        header = np.array(
+            [len(payload) if payload is not None else 0], dtype=np.int64
+        )
+        n = int(np.asarray(mh.broadcast_one_to_all(header))[0])
+        if n == 0:
+            return b""
+        buf = (
+            np.frombuffer(payload, dtype=np.uint8)
+            if payload is not None
+            else np.zeros((n,), dtype=np.uint8)
+        )
+        out = np.asarray(mh.broadcast_one_to_all(buf))
+        return out.tobytes()
+
+    def allgather(self, row: np.ndarray) -> np.ndarray:
+        """Every process contributes one fixed-shape row; all receive the
+        [P, ...] stack — the heartbeat ack channel."""
+        from jax.experimental import multihost_utils as mh
+
+        return np.asarray(mh.process_allgather(row))
+
+
+_TRANSPORT: JaxProcessTransport = JaxProcessTransport()
+
+
+def transport():
+    return _TRANSPORT
+
+
+def install_transport(t) -> object:
+    """Swap the control-plane transport (tests install a stub follower
+    group; ``None`` restores the real one). Returns the previous
+    transport so callers can restore it."""
+    global _TRANSPORT
+    prev = _TRANSPORT
+    _TRANSPORT = t if t is not None else JaxProcessTransport()
+    return prev
+
+
 def broadcast_bytes(payload: bytes | None) -> bytes:
-    """Broadcast a byte string from process 0 to every process (two
-    fixed-shape collectives: an int64 length header, then the buffer).
-    Non-coordinators pass ``None`` and receive the coordinator's bytes."""
-    from log_parser_tpu.runtime import faults
-
-    # chaos point BEFORE the first collective: an injected raise/hang here
-    # models a coordinator dying (or stalling) pre-broadcast — the one
-    # window where failure must not desync the follower group
+    """Broadcast through the installed transport. The chaos point sits
+    BEFORE the first collective: an injected raise/hang here models a peer
+    dying (or stalling) pre-broadcast — the one window where failure must
+    not desync the follower group."""
     faults.fire("broadcast")
-    from jax.experimental import multihost_utils as mh
-
-    header = np.array(
-        [len(payload) if payload is not None else 0], dtype=np.int64
-    )
-    n = int(np.asarray(mh.broadcast_one_to_all(header))[0])
-    if n == 0:
-        return b""
-    buf = (
-        np.frombuffer(payload, dtype=np.uint8)
-        if payload is not None
-        else np.zeros((n,), dtype=np.uint8)
-    )
-    out = np.asarray(mh.broadcast_one_to_all(buf))
-    return out.tobytes()
+    return transport().broadcast(payload)
 
 
 class DistributedShardedEngine(ShardedEngine):
     """ShardedEngine over a process-spanning mesh with request fan-out.
 
     On the coordinator, :meth:`analyze` first replicates the request to
-    every follower, then runs the inherited pipeline (whose device step
-    all processes enter together). Followers sit in :meth:`follower_loop`
-    replaying broadcast requests until :meth:`shutdown_followers`.
+    every follower (bounded + retried, see module docstring), then runs
+    the inherited pipeline (whose device step all processes enter
+    together); with the follower group declared dead it serves locally
+    instead. Followers sit in :meth:`follower_loop` replaying broadcast
+    requests until :meth:`shutdown_followers`.
     """
+
+    _LOCAL_STEP_UNBUILT = object()
 
     def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
         super().__init__(pattern_sets, config, mesh=mesh, clock=clock)
+        self.follower_errors = 0  # follower-side malformed-payload count
+        self.mesh_health: MeshHealth | None = None
+        self.retry_policy = RetryPolicy.from_env()
+        self._local_step_cache = self._LOCAL_STEP_UNBUILT
+        self._health_thread: threading.Thread | None = None
+        self._health_stop: threading.Event | None = None
         if self._is_multiprocess():
             # the golden host fallback is UNSAFE here: a device error on
             # one process would abandon an in-flight collective while the
@@ -113,49 +192,251 @@ class DistributedShardedEngine(ShardedEngine):
             # request symmetrically; the server answers with a 500 and the
             # group stays in lockstep for the next broadcast.
             self.fallback_to_golden = False
+            self.mesh_health = MeshHealth(transport().process_count())
 
     def _is_multiprocess(self) -> bool:
-        import jax
-
-        return jax.process_count() > 1
+        return transport().process_count() > 1
 
     def _is_coordinator(self) -> bool:
-        import jax
+        return transport().process_index() == 0
 
-        return jax.process_index() == 0
+    # ----------------------------------------------------- bounded dispatch
+
+    def _dispatch_broadcast(self, payload: bytes, label: str = "broadcast") -> None:
+        """One bounded, retried coordinator→follower broadcast. The fault
+        sites and the cancellation check both sit BEFORE
+        ``enter_collective``, so an abandoned (hung) attempt can never
+        emit a stale broadcast after its deadline."""
+
+        def attempt(ctx):
+            faults.fire("follower")  # a follower stalling/failing the dispatch
+            faults.fire("broadcast")  # the coordinator-side transport itself
+            ctx.enter_collective()
+            transport().broadcast(payload)
+
+        dispatch_with_retry(
+            attempt, self.retry_policy, self.mesh_health, label=label
+        )
+
+    # ------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData):
         if self._is_multiprocess() and self._is_coordinator():
-            payload = json.dumps(
-                {"pod": data.pod, "logs": data.logs, "events": data.events}
-            ).encode("utf-8")
-            broadcast_bytes(payload)
+            health = self.mesh_health
+            if not health.degraded:
+                payload = json.dumps(
+                    {"pod": data.pod, "logs": data.logs, "events": data.events}
+                ).encode("utf-8")
+                try:
+                    self._dispatch_broadcast(payload)
+                except MeshUnavailable as exc:
+                    # the retry budget (or a wedge) already updated health;
+                    # make the flip explicit even below the dead_after
+                    # threshold — this REQUEST could not be dispatched
+                    health.declare_degraded(str(exc))
+                    log.error("degrading to local serving: %s", exc)
+            if health.degraded:
+                return self._analyze_degraded(data)
         return super().analyze(data)
 
     def analyze_pipelined(self, data: PodFailureData):
         """Multi-process requests cannot pipeline: each request is a
         broadcast + lockstep SPMD dispatch on every process, so two
         concurrent prepare phases would interleave their broadcasts and
-        desync the mesh. Serialize the whole request instead."""
+        desync the mesh. Serialize the whole request instead (the
+        heartbeat probe serializes on the same lock)."""
         if self._is_multiprocess():
             with self.state_lock:
                 return self.analyze(data)
         return super().analyze_pipelined(data)
 
+    # ----------------------------------------------------- degrade-to-local
+
+    @property
+    def _local_step(self) -> ShardedFusedStep | None:
+        """Lazy single-process SPMD step over this process's local devices
+        — the degraded serving path. None when local devices are unusable
+        (then the golden host path serves)."""
+        if self._local_step_cache is self._LOCAL_STEP_UNBUILT:
+            self._local_step_cache = None
+            try:
+                import jax
+
+                local = jax.local_devices()
+                if local:
+                    from log_parser_tpu.parallel.mesh import make_mesh
+
+                    self._local_step_cache = ShardedFusedStep(
+                        self.bank,
+                        self.config,
+                        make_mesh(devices=local),
+                        self.matchers,
+                        multiprocess=False,
+                    )
+                    log.info(
+                        "degrade-to-local: %d local devices ready", len(local)
+                    )
+            except Exception:
+                log.exception(
+                    "degrade-to-local: local step unavailable; degraded "
+                    "requests will serve from the golden host path"
+                )
+        return self._local_step_cache
+
+    def _run_device(self, enc, n_lines: int, om, ov):
+        # batch rows are padded to a multiple of the GLOBAL mesh size
+        # (_corpus_min_rows), which the local device count divides — the
+        # local shard_map sees the same shapes, just fewer shards
+        if (
+            self.mesh_health is not None
+            and self.mesh_health.degraded
+            and self._is_coordinator()
+        ):
+            step = self._local_step
+            if step is None:
+                raise RuntimeError("degraded mode: no usable local devices")
+            B = enc.u8.shape[0]
+            C = self.bank.n_columns
+            if om is None:
+                om = np.zeros((B, C), dtype=bool)
+                ov = np.zeros((B, C), dtype=bool)
+            return step(enc.u8, enc.lengths, om, ov, n_lines, k_hint=self._k_hint)
+        return super()._run_device(enc, n_lines, om, ov)
+
+    def _analyze_degraded(self, data: PodFailureData):
+        """Serve one request without the followers: local SPMD step when
+        this process owns devices, golden host path otherwise. The
+        response is marked so callers can see it was served degraded."""
+        health = self.mesh_health
+        health.record_degraded_request()
+        if self._local_step is not None:
+            result = ShardedEngine.analyze(self, data)
+        else:
+            result = self._golden_serve(data)
+        if result.metadata is not None:
+            result.metadata.degraded = DEGRADED_MARKER
+        return result
+
+    # ------------------------------------------------------------ heartbeat
+
+    def probe_mesh(self) -> bool:
+        """One bounded heartbeat round-trip: broadcast the ``_PING``
+        sentinel, gather one ack row ``[process_index, follower_errors]``
+        per process, refresh :class:`MeshHealth`, and re-admit a degraded
+        mesh on success. Callers in concurrent settings hold
+        ``state_lock`` (a probe must never interleave with a request
+        broadcast)."""
+        if not (self._is_multiprocess() and self._is_coordinator()):
+            return True
+        health = self.mesh_health
+        if health.wedged:
+            return False
+        t = transport()
+
+        def attempt(ctx):
+            faults.fire("heartbeat")
+            ctx.enter_collective()
+            t.broadcast(_PING)
+            row = np.array([t.process_index(), 0], dtype=np.int64)
+            return t.allgather(row)
+
+        try:
+            acks = dispatch_with_retry(
+                attempt, self.retry_policy, health, label="heartbeat"
+            )
+        except MeshUnavailable as exc:
+            health.record_probe(False)
+            log.warning("heartbeat failed: %s", exc)
+            return False
+        for pid, errors in np.asarray(acks).reshape(-1, 2):
+            if int(pid) != 0:
+                health.record_ack(int(pid), int(errors))
+        health.record_probe(True)
+        if health.degraded:
+            health.readmit()
+        return True
+
+    def start_health_loop(self, interval_s: float | None = None):
+        """Coordinator-side heartbeat daemon: probes the follower group
+        every ``interval_s`` (env ``LOG_PARSER_TPU_HEARTBEAT_S``; 0
+        disables). Serializes with requests on ``state_lock``."""
+        if not (self._is_multiprocess() and self._is_coordinator()):
+            return None
+        if self._health_thread is not None:
+            return self._health_thread
+        if interval_s is None:
+            try:
+                interval_s = float(os.environ.get(ENV_HEARTBEAT_S, "10"))
+            except ValueError:
+                interval_s = 10.0
+        if interval_s <= 0:
+            return None
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                if self.mesh_health.wedged:
+                    continue
+                with self.state_lock:
+                    if stop.is_set():
+                        break
+                    self.probe_mesh()
+
+        thread = threading.Thread(target=loop, name="mesh-health", daemon=True)
+        self._health_stop = stop
+        self._health_thread = thread
+        thread.start()
+        log.info("mesh health loop up (every %gs)", interval_s)
+        return thread
+
+    def stop_health_loop(self) -> None:
+        if self._health_stop is not None:
+            self._health_stop.set()
+        thread = self._health_thread
+        self._health_thread = None
+        self._health_stop = None
+        if thread is not None:
+            thread.join(timeout=0.5)  # best-effort; the thread is a daemon
+
+    # ------------------------------------------------------------ followers
+
     def follower_loop(self) -> None:
         """Run on processes > 0: participate in every broadcast request's
-        SPMD dispatches until the coordinator shuts the group down."""
+        SPMD dispatches until the coordinator shuts the group down.
+        Heartbeat pings are acked inline; malformed payloads are counted
+        and skipped — a follower must outlive a coordinator bug."""
         if self._is_coordinator():
             raise RuntimeError("follower_loop must not run on the coordinator")
+        t = transport()
         while True:
             payload = broadcast_bytes(None)
             if payload == _SHUTDOWN or payload == b"":
                 log.info("follower shutting down")
                 return
-            d = json.loads(payload.decode("utf-8"))
-            data = PodFailureData(
-                pod=d.get("pod"), logs=d.get("logs") or "", events=d.get("events")
-            )
+            if payload == _PING:
+                row = np.array(
+                    [t.process_index(), self.follower_errors], dtype=np.int64
+                )
+                t.allgather(row)
+                continue
+            try:
+                d = json.loads(payload.decode("utf-8"))
+                data = PodFailureData(
+                    pod=d.get("pod"),
+                    logs=d.get("logs") or "",
+                    events=d.get("events"),
+                )
+            except Exception as exc:
+                self.follower_errors += 1
+                log.warning(
+                    "follower %d: malformed broadcast payload "
+                    "(%d bytes, error #%d): %s — skipped",
+                    t.process_index(),
+                    len(payload),
+                    self.follower_errors,
+                    exc,
+                )
+                continue
             try:
                 super().analyze(data)
             except Exception:
@@ -165,5 +446,20 @@ class DistributedShardedEngine(ShardedEngine):
                 log.exception("follower analyze failed")
 
     def shutdown_followers(self) -> None:
-        if self._is_multiprocess() and self._is_coordinator():
-            broadcast_bytes(_SHUTDOWN)
+        if not (self._is_multiprocess() and self._is_coordinator()):
+            return
+        self.stop_health_loop()
+        health = self.mesh_health
+        if health is not None and health.wedged:
+            # a sentinel into a torn collective would hang this process
+            # too; followers exit on their own second-signal path
+            log.warning("mesh wedged: skipping the shutdown sentinel")
+            return
+        try:
+            self._dispatch_broadcast(_SHUTDOWN, label="shutdown")
+        except MeshUnavailable as exc:
+            log.warning(
+                "followers unreachable for the shutdown sentinel (%s); "
+                "they exit via their own signal handling",
+                exc,
+            )

@@ -1,0 +1,370 @@
+"""Distributed resilience: follower health, bounded broadcast dispatch,
+retry with backoff, and the degrade-to-local state machine.
+
+The lockstep serving model of parallel/distributed.py has one structural
+weakness: every coordinator→follower broadcast is a *collective*, so a
+single dead or wedged follower stalls `broadcast_one_to_all` forever and
+takes every future request down with it. The reference has no analogue
+(one JVM, no peers); the admission ladder of PR 1 stops at the process
+boundary. This module extends the same degrade-don't-block discipline
+across the mesh:
+
+- :class:`MeshHealth` — the coordinator's view of the follower group:
+  per-follower last-ack time / consecutive-failure counts / error
+  counters, the serving mode (``distributed`` / ``degraded`` /
+  ``wedged``), and the counters surfaced on ``GET /trace/last``.
+- :func:`bounded_call` — run a dispatch attempt on a worker thread under
+  a deadline, exactly like the device watchdog (runtime/engine.py
+  DeviceWatchdog): on timeout the worker is *abandoned*, never killed.
+  The :class:`DispatchContext` handed to the attempt closes the inherent
+  race: the attempt must call :meth:`DispatchContext.enter_collective`
+  immediately before its first collective, which atomically refuses if
+  the deadline already expired — so an abandoned attempt can never emit
+  a stale broadcast that would desynchronize the follower group.
+- :func:`dispatch_with_retry` — bounded attempts with exponential
+  backoff + jitter up to a budget. Only *timeouts* are retried (and only
+  when the attempt provably never entered a collective); exceptions
+  propagate — an injected ``follower_raise`` models a logic bug exactly
+  like every other non-device site. A timeout that fired *inside* a
+  collective is unrecoverable by construction (the group's collective
+  state is torn): the mesh is marked ``wedged`` and stays degraded until
+  restart — no probe can re-admit a torn collective.
+
+Knobs (env, mirrored by ``serve`` flags):
+
+==============================================  ===========================
+``LOG_PARSER_TPU_BROADCAST_TIMEOUT_S``          per-attempt deadline
+                                                (default 60; 0 disables)
+``LOG_PARSER_TPU_BROADCAST_RETRIES``            extra attempts (default 2)
+``LOG_PARSER_TPU_BROADCAST_BACKOFF_S``          base backoff (default 0.05,
+                                                doubled per retry + jitter)
+``LOG_PARSER_TPU_HEARTBEAT_S``                  probe interval (default 10;
+                                                0 disables the loop)
+``LOG_PARSER_TPU_DEAD_AFTER``                   consecutive dispatch
+                                                failures before the group
+                                                is declared dead (def. 3)
+==============================================  ===========================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+ENV_TIMEOUT_S = "LOG_PARSER_TPU_BROADCAST_TIMEOUT_S"
+ENV_RETRIES = "LOG_PARSER_TPU_BROADCAST_RETRIES"
+ENV_BACKOFF_S = "LOG_PARSER_TPU_BROADCAST_BACKOFF_S"
+ENV_HEARTBEAT_S = "LOG_PARSER_TPU_HEARTBEAT_S"
+ENV_DEAD_AFTER = "LOG_PARSER_TPU_DEAD_AFTER"
+
+MODE_DISTRIBUTED = "distributed"
+MODE_DEGRADED = "degraded"
+
+DEGRADED_MARKER = "distributed-fallback"
+
+
+class BroadcastTimeout(RuntimeError):
+    """One bounded dispatch attempt blew its deadline. ``entered_collective``
+    records whether the abandoned worker had already committed to a
+    collective when the deadline fired — True means retrying is unsafe."""
+
+    def __init__(self, label: str, timeout_s: float, entered_collective: bool):
+        state = "inside a collective" if entered_collective else "pre-collective"
+        super().__init__(f"{label} dispatch exceeded {timeout_s:g}s ({state})")
+        self.label = label
+        self.timeout_s = timeout_s
+        self.entered_collective = entered_collective
+
+
+class MeshUnavailable(RuntimeError):
+    """The retry budget is exhausted (or the mesh is wedged): the follower
+    group cannot be reached. Callers degrade to local serving."""
+
+
+class DispatchCancelled(Exception):
+    """Raised inside an abandoned attempt at ``enter_collective`` — the
+    deadline expired first, so the attempt must not touch the group."""
+
+
+class DispatchContext:
+    """Handshake between a bounded attempt and its deadline watcher."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cancelled = False
+        self._entered = False
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def enter_collective(self) -> None:
+        """Commit to the first collective. Atomic vs. :meth:`cancel`: after
+        this returns the watcher sees ``entered``; if the deadline won the
+        race, :class:`DispatchCancelled` aborts the attempt before it can
+        emit anything the followers would see."""
+        with self._lock:
+            if self._cancelled:
+                raise DispatchCancelled()
+            self._entered = True
+
+    def cancel(self) -> bool:
+        """Abandon the attempt; returns whether it had already entered a
+        collective (observed atomically against :meth:`enter_collective`)."""
+        with self._lock:
+            self._cancelled = True
+            return self._entered
+
+
+def bounded_call(fn, timeout_s: float, label: str = "broadcast"):
+    """Run ``fn(ctx)`` under a deadline on a daemon worker thread; on
+    timeout the worker is abandoned (a blocked collective cannot be
+    interrupted — same policy as the device watchdog) and
+    :class:`BroadcastTimeout` carries whether it had entered a collective.
+    ``timeout_s <= 0`` runs inline, unbounded."""
+    ctx = DispatchContext()
+    if timeout_s is None or timeout_s <= 0:
+        return fn(ctx)
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn(ctx)
+        except BaseException as exc:  # surfaced to the caller below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=run, name=f"dispatch-{label}", daemon=True)
+    worker.start()
+    if not done.wait(timeout_s):
+        entered = ctx.cancel()
+        raise BroadcastTimeout(label, timeout_s, entered_collective=entered)
+    err = box.get("error")
+    if err is not None:
+        if isinstance(err, DispatchCancelled):  # lost the race post-cancel
+            raise BroadcastTimeout(label, timeout_s, entered_collective=False)
+        raise err
+    return box.get("value")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Deadline + budget for one logical dispatch."""
+
+    timeout_s: float = 60.0
+    retries: int = 2
+    backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    jitter: float = 0.5  # +[0, jitter) fraction of the delay
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy":
+        env = os.environ if env is None else env
+
+        def _f(key: str, default: float) -> float:
+            try:
+                return float(env.get(key, default))
+            except (TypeError, ValueError):
+                return default
+
+        return cls(
+            timeout_s=_f(ENV_TIMEOUT_S, cls.timeout_s),
+            retries=max(0, int(_f(ENV_RETRIES, cls.retries))),
+            backoff_s=_f(ENV_BACKOFF_S, cls.backoff_s),
+        )
+
+    def delay_for(self, attempt: int) -> float:
+        """Exponential backoff + jitter before retry ``attempt`` (1-based)."""
+        base = min(self.max_backoff_s, self.backoff_s * (2 ** (attempt - 1)))
+        return base * (1.0 + self.jitter * random.random())
+
+
+def dispatch_with_retry(
+    fn,
+    policy: RetryPolicy,
+    health: "MeshHealth | None" = None,
+    label: str = "broadcast",
+    sleep=time.sleep,
+):
+    """Bounded attempts of ``fn(ctx)`` with backoff between them. Retries
+    ONLY pre-collective timeouts; an in-collective timeout wedges the mesh
+    (see module docstring) and exceptions propagate unretried. Raises
+    :class:`MeshUnavailable` when the budget is spent."""
+    last: BroadcastTimeout | None = None
+    for attempt in range(policy.retries + 1):
+        if attempt:
+            if health is not None:
+                health.record_retry()
+            sleep(policy.delay_for(attempt))
+        try:
+            return bounded_call(fn, policy.timeout_s, label=label)
+        except BroadcastTimeout as exc:
+            last = exc
+            if health is not None:
+                health.record_broadcast_timeout()
+            if exc.entered_collective:
+                if health is not None:
+                    health.mark_wedged(str(exc))
+                log.error("%s: %s — collective state torn, not retrying", label, exc)
+                break
+            log.warning(
+                "%s: %s (attempt %d/%d)", label, exc, attempt + 1, policy.retries + 1
+            )
+    raise MeshUnavailable(f"{label}: retry budget exhausted: {last}") from last
+
+
+class MeshHealth:
+    """Coordinator-side liveness view of the follower group.
+
+    Thread-safe; updated from the request path (dispatch timeouts), the
+    heartbeat loop (acks / probe outcomes), and read by ``/trace/last``
+    and ``/q/health``. Followers are identified by process index 1..P-1."""
+
+    def __init__(
+        self,
+        process_count: int,
+        dead_after: int | None = None,
+        clock=time.monotonic,
+    ):
+        if dead_after is None:
+            try:
+                dead_after = int(os.environ.get(ENV_DEAD_AFTER, "3"))
+            except ValueError:
+                dead_after = 3
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.process_count = process_count
+        self.dead_after = max(1, dead_after)
+        self.mode = MODE_DISTRIBUTED
+        self.wedged = False
+        self.reason: str | None = None
+        self.followers: dict[int, dict] = {
+            pid: {"last_seen": None, "consecutive_failures": 0, "errors": 0}
+            for pid in range(1, process_count)
+        }
+        self.broadcast_timeouts = 0
+        self.broadcast_retries = 0
+        self.degraded_requests = 0
+        self.probes = 0
+        self.probe_failures = 0
+        self.readmissions = 0
+
+    # ------------------------------------------------------------ transitions
+
+    @property
+    def degraded(self) -> bool:
+        return self.mode != MODE_DISTRIBUTED
+
+    def record_broadcast_timeout(self) -> None:
+        """One bounded attempt timed out: every follower is a suspect (the
+        collective gives no per-peer attribution). Crossing ``dead_after``
+        consecutive failures declares the group dead."""
+        with self._lock:
+            self.broadcast_timeouts += 1
+            worst = 0
+            for row in self.followers.values():
+                row["consecutive_failures"] += 1
+                worst = max(worst, row["consecutive_failures"])
+            if worst >= self.dead_after and self.mode == MODE_DISTRIBUTED:
+                self._declare(
+                    f"{worst} consecutive dispatch failures (threshold "
+                    f"{self.dead_after})"
+                )
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.broadcast_retries += 1
+
+    def declare_degraded(self, reason: str) -> None:
+        with self._lock:
+            if self.mode == MODE_DISTRIBUTED:
+                self._declare(reason)
+
+    def _declare(self, reason: str) -> None:  # caller holds the lock
+        self.mode = MODE_DEGRADED
+        self.reason = reason
+        log.error("mesh degraded: %s — serving locally until followers ack", reason)
+
+    def mark_wedged(self, reason: str) -> None:
+        """A dispatch died inside a collective: the group's collective
+        state is torn and no probe can restore it — degraded for good."""
+        with self._lock:
+            self.wedged = True
+            if self.mode == MODE_DISTRIBUTED:
+                self._declare(reason)
+            self.reason = f"wedged: {reason}"
+
+    def record_ack(self, pid: int, errors: int) -> None:
+        """A heartbeat ack from follower ``pid`` (its malformed-payload
+        error counter rides along for observability)."""
+        with self._lock:
+            row = self.followers.get(pid)
+            if row is None:
+                return
+            row["last_seen"] = self._clock()
+            row["consecutive_failures"] = 0
+            row["errors"] = int(errors)
+
+    def record_probe(self, ok: bool) -> None:
+        with self._lock:
+            self.probes += 1
+            if not ok:
+                self.probe_failures += 1
+
+    def record_degraded_request(self) -> None:
+        with self._lock:
+            self.degraded_requests += 1
+
+    def readmit(self) -> bool:
+        """Back to distributed serving after a successful probe. Refused
+        while wedged."""
+        with self._lock:
+            if self.wedged or self.mode == MODE_DISTRIBUTED:
+                return False
+            self.mode = MODE_DISTRIBUTED
+            self.reason = None
+            self.readmissions += 1
+            for row in self.followers.values():
+                row["consecutive_failures"] = 0
+            log.info("mesh readmitted: followers ack again, distributed serving on")
+            return True
+
+    # ------------------------------------------------------------ reporting
+
+    def stats(self) -> dict:
+        """camelCase snapshot for ``GET /trace/last``."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "mode": self.mode,
+                "wedged": self.wedged,
+                "reason": self.reason,
+                "processCount": self.process_count,
+                "deadAfter": self.dead_after,
+                "followers": {
+                    str(pid): {
+                        "lastSeenAgoS": (
+                            None
+                            if row["last_seen"] is None
+                            else round(now - row["last_seen"], 3)
+                        ),
+                        "consecutiveFailures": row["consecutive_failures"],
+                        "errors": row["errors"],
+                    }
+                    for pid, row in self.followers.items()
+                },
+                "broadcastTimeouts": self.broadcast_timeouts,
+                "broadcastRetries": self.broadcast_retries,
+                "degradedRequests": self.degraded_requests,
+                "probes": self.probes,
+                "probeFailures": self.probe_failures,
+                "readmissions": self.readmissions,
+            }

@@ -64,10 +64,11 @@ from log_parser_tpu.patterns.bank import (
 from log_parser_tpu.runtime.engine import AnalysisEngine
 
 
-def _ring_halo(x: jax.Array, h: int) -> jax.Array:
+def _ring_halo(x: jax.Array, h: int, d: int) -> jax.Array:
     """[Bl, K] -> [h + Bl + h, K]: h rows from each ring neighbor via
-    ppermute; edge shards receive zeros (ppermute's missing-source fill)."""
-    d = jax.lax.axis_size(DATA_AXIS)
+    ppermute; edge shards receive zeros (ppermute's missing-source fill).
+    ``d`` is the mesh axis size — the permutation list must be static, so
+    the caller passes it rather than querying the traced axis."""
     from_left = jax.lax.ppermute(
         x[-h:], DATA_AXIS, [(i, i + 1) for i in range(d - 1)]
     )
@@ -80,7 +81,14 @@ def _ring_halo(x: jax.Array, h: int) -> jax.Array:
 class ShardedFusedStep:
     """The full per-batch SPMD program, shard_mapped over the mesh."""
 
-    def __init__(self, bank: PatternBank, config: ScoringConfig, mesh, matchers):
+    def __init__(
+        self,
+        bank: PatternBank,
+        config: ScoringConfig,
+        mesh,
+        matchers,
+        multiprocess: bool | None = None,
+    ):
         self.bank = bank
         self.config = config
         self.mesh = mesh
@@ -105,8 +113,12 @@ class ShardedFusedStep:
         # one mesh may span multiple processes (parallel/distributed.py);
         # then inputs must be assembled as global arrays (each process
         # donating its addressable shards) and outputs gathered across
-        # processes before host assembly
-        self.multiprocess = jax.process_count() > 1
+        # processes before host assembly. A process-local mesh inside a
+        # multi-process runtime (the degrade-to-local step) passes an
+        # explicit False: its collectives must never leave this process.
+        self.multiprocess = (
+            jax.process_count() > 1 if multiprocess is None else multiprocess
+        )
 
     # ------------------------------------------------- host<->device helpers
 
@@ -259,7 +271,7 @@ class ShardedFusedStep:
         local row 0). ppermute halo when shards are big enough; all_gather
         when the halo would span multiple shards."""
         if h < Bl:
-            return _ring_halo(cols, h), h  # offset is static
+            return _ring_halo(cols, h, self.n_shards), h  # offset is static
         gathered = jax.lax.all_gather(cols, DATA_AXIS, axis=0, tiled=True)
         d = jax.lax.axis_index(DATA_AXIS)
         return gathered, d * Bl  # offset is traced

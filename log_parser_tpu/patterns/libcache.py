@@ -25,7 +25,19 @@ Trust model: the cache directory (``$LOG_PARSER_TPU_CACHE`` or
 only by this process — the same trust boundary as JAX's persistent
 executable cache, which deserializes compiled binaries from the same
 tree. Entries are pickles; do not point the cache at untrusted storage.
-Corrupt or version-skewed entries are ignored and rebuilt.
+
+Crash safety: entries publish via write-to-temp + fsync + atomic rename
+(regex/cache.py ``atomic_publish``) with a sha256 content checksum in a
+``<key>.pkl.sum`` sidecar — the snapshot file itself stays a bare pickle
+so older readers (and tests) keep working. A checksum mismatch or an
+unreadable pickle quarantines the entry (renamed ``<key>.pkl.corrupt``,
+kept for post-mortems) and the bank rebuilds cold; nothing raises out of
+:func:`load`. A sidecar-less entry is trusted like before (legacy /
+hand-placed entries). Only the half-open window between publishing the
+snapshot and its sidecar can misclassify a good entry, and the cost is
+one rebuild, not wrong scores. The ``cache`` fault site
+(``LOG_PARSER_TPU_FAULTS=cache_raise``) injects read failures here —
+contained as a miss, never a quarantine of a healthy entry.
 
 Disable with ``LOG_PARSER_TPU_CACHE=0`` (shared switch with the DFA
 cache); ``LOG_PARSER_TPU_LIBCACHE=0`` disables just this layer.
@@ -88,7 +100,31 @@ def library_key(pattern_sets, context_regexes) -> str | None:
     return h.hexdigest()
 
 
+def _sidecar(path: pathlib.Path) -> pathlib.Path:
+    # ".pkl.sum", NOT ".sum": it must never match the "*.pkl" globs that
+    # enumerate snapshots (tests and cleanup scripts count entries so)
+    return path.with_name(path.name + ".sum")
+
+
+def _quarantine(path: pathlib.Path, reason: str) -> None:
+    """Move a corrupt entry aside (``.corrupt``) instead of deleting it —
+    the bytes are the post-mortem — and drop its sidecar so the name
+    reads as a plain miss from now on. Best-effort: an entry we cannot
+    even rename is still just a miss."""
+    log.warning("Quarantining corrupt bank snapshot %s: %s", path.name, reason)
+    try:
+        os.replace(path, path.with_name(path.name + ".corrupt"))
+    except OSError as exc:
+        log.warning("Could not quarantine %s: %s", path.name, exc)
+    try:
+        _sidecar(path).unlink()
+    except OSError:
+        pass
+
+
 def load(key: str | None) -> dict[str, Any] | None:
+    from log_parser_tpu.runtime import faults
+
     d = _dir()
     if d is None or key is None:
         return None
@@ -96,13 +132,30 @@ def load(key: str | None) -> dict[str, Any] | None:
     if not path.exists():
         return None
     try:
-        with open(path, "rb") as f:
-            snap = pickle.load(f)
+        # chaos point: an injected cache fault is an I/O failure, not
+        # corruption — contained as a miss, the entry stays untouched
+        faults.fire("cache")
+        blob = path.read_bytes()
+    except Exception as exc:
+        log.warning("Bank snapshot %s unreadable: %s", path.name, exc)
+        return None
+    recorded = None
+    try:
+        recorded = _sidecar(path).read_text().split()[0]
+    except (OSError, IndexError):
+        pass  # no sidecar: legacy entry, trusted as before
+    if recorded is not None and recorded != hashlib.sha256(blob).hexdigest():
+        _quarantine(path, "content checksum mismatch")
+        return None
+    try:
+        snap = pickle.loads(blob)
         if snap.get("version") != SNAPSHOT_VERSION:
             return None
         return snap
     except Exception as exc:
-        log.warning("Ignoring corrupt bank snapshot %s: %s", path.name, exc)
+        # checksum passed (or legacy) yet unpicklable: torn/truncated
+        # bytes from a pre-sidecar writer, or bit rot — same treatment
+        _quarantine(path, f"undecodable: {exc}")
         return None
 
 
@@ -117,8 +170,11 @@ def save(key: str | None, snap: dict[str, Any]) -> None:
     except OSError as exc:
         log.warning("Bank snapshot dir unavailable: %s", exc)
         return
+    blob = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(blob).hexdigest()
+    atomic_publish(d, f"{key}.pkl", lambda f: f.write(blob))
+    # sidecar second: a crash between the two leaves a good snapshot with
+    # a stale/missing sidecar — worst case one spurious rebuild
     atomic_publish(
-        d,
-        f"{key}.pkl",
-        lambda f: pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL),
+        d, f"{key}.pkl.sum", lambda f: f.write(f"{digest} {len(blob)}\n".encode())
     )

@@ -97,17 +97,20 @@ def cache_subdir(name: str) -> pathlib.Path | None:
 
 def atomic_publish(directory: pathlib.Path, name: str, writer) -> None:
     """Best-effort atomic cache write shared by every cache layer (dfa /
-    bank / ac): ``writer(file)`` fills a tempfile that is then renamed
-    into place, so concurrent readers never see a torn entry. ANY
-    failure is logged and swallowed — a cache write must never break
-    the build it is caching (the read sides contain corrupt entries the
-    same way)."""
+    bank / ac): ``writer(file)`` fills a tempfile that is flushed,
+    fsynced, and then renamed into place, so concurrent readers never
+    see a torn entry and a crash (power loss included) leaves either the
+    old entry or the complete new one — never a prefix. ANY failure is
+    logged and swallowed — a cache write must never break the build it
+    is caching (the read sides contain corrupt entries the same way)."""
     tmp = None
     try:
         directory.mkdir(parents=True, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
         with os.fdopen(fd, "wb") as f:
             writer(f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, directory / name)
         tmp = None
     except Exception as exc:

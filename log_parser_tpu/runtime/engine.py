@@ -684,6 +684,19 @@ class AnalysisEngine:
             self._golden.frequency = self.frequency
         return self._golden
 
+    def _golden_serve(self, data: PodFailureData) -> AnalysisResult:
+        """Run one request on the golden host path with the shared
+        frequency tracker rolled back on ANY failure — golden records
+        matches as it runs, and a request that dies partway through must
+        not leak partial counts. Caller holds the lock (or is otherwise
+        serialized)."""
+        saved_freq = self.frequency._save_state()
+        try:
+            return self.golden_fallback.analyze(data)
+        except Exception:
+            self.frequency._load_state(saved_freq)
+            raise
+
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
@@ -709,14 +722,7 @@ class AnalysisEngine:
         separate counter."""
         with self.state_lock:
             self.host_routed_count += 1
-            saved_freq = self.frequency._save_state()
-            try:
-                return self.golden_fallback.analyze(data)
-            except Exception:
-                # golden records matches as it runs — a failure partway
-                # through must not leak partial counts
-                self.frequency._load_state(saved_freq)
-                raise
+            return self._golden_serve(data)
 
     def _analyze(self, data: PodFailureData, lock) -> AnalysisResult:
         try:
@@ -764,14 +770,7 @@ class AnalysisEngine:
         # device-side observability does not describe this request
         self.last_trace = None
         self.last_finalized = None
-        saved_freq = self.frequency._save_state()
-        try:
-            return self.golden_fallback.analyze(data)
-        except Exception:
-            # golden records matches as it runs — a failure partway
-            # through must not leak its partial counts either
-            self.frequency._load_state(saved_freq)
-            raise
+        return self._golden_serve(data)
 
     def _prepare(self, data: PodFailureData) -> "_Prepared":
         """Ingest + overrides + the device batch: everything before the

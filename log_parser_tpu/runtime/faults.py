@@ -16,8 +16,12 @@ DSL (``LOG_PARSER_TPU_FAULTS``, comma-separated specs)::
 Each spec is ``<site>_<action>[:<arg>][@mod=value]*``:
 
 - site: where to inject — ``device``, ``ingest``, ``finalize``, ``http``,
-  ``shim``, ``broadcast`` (any string works; sites are just names the
-  code fires, see :func:`fire` call sites);
+  ``shim``, ``broadcast`` (coordinator-side transport, pre-collective),
+  ``follower`` (a follower failing/stalling a dispatch, fired before the
+  coordinator commits to the collective), ``heartbeat`` (the liveness
+  probe of parallel/resilience.py), ``cache`` (on-disk cache reads —
+  contained as a miss, libcache/xlacache). Any string works; sites are
+  just names the code fires, see :func:`fire` call sites;
 - action: ``raise`` (raise :class:`InjectedFault`; at the ``device`` site
   :class:`InjectedDeviceFault`, which ``is_device_error`` classifies as a
   device failure so the golden fallback serves it), ``hang`` (block for

@@ -44,6 +44,28 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    # distributed resilience (docs/OPS.md "Distributed failure modes")
+    parser.add_argument(
+        "--broadcast-timeout", type=float, default=None, metavar="SECONDS",
+        help="deadline per coordinator→follower dispatch attempt; 0 = "
+        "unbounded (LOG_PARSER_TPU_BROADCAST_TIMEOUT_S)",
+    )
+    parser.add_argument(
+        "--broadcast-retries", type=int, default=None,
+        help="extra dispatch attempts after a pre-collective timeout "
+        "(LOG_PARSER_TPU_BROADCAST_RETRIES)",
+    )
+    parser.add_argument(
+        "--heartbeat-s", type=float, default=None, metavar="SECONDS",
+        help="follower heartbeat interval on the coordinator; 0 disables "
+        "(LOG_PARSER_TPU_HEARTBEAT_S)",
+    )
+    parser.add_argument(
+        "--dead-after", type=int, default=None,
+        help="consecutive dispatch failures before the follower group is "
+        "declared dead and serving degrades to local "
+        "(LOG_PARSER_TPU_DEAD_AFTER)",
+    )
     parser.add_argument(
         "--device-timeout",
         type=float,
@@ -95,6 +117,10 @@ def main(argv: list[str] | None = None) -> int:
         (args.drain_s, "LOG_PARSER_TPU_DRAIN_S"),
         (args.faults, "LOG_PARSER_TPU_FAULTS"),
         (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
+        (args.broadcast_timeout, "LOG_PARSER_TPU_BROADCAST_TIMEOUT_S"),
+        (args.broadcast_retries, "LOG_PARSER_TPU_BROADCAST_RETRIES"),
+        (args.heartbeat_s, "LOG_PARSER_TPU_HEARTBEAT_S"),
+        (args.dead_after, "LOG_PARSER_TPU_DEAD_AFTER"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -202,6 +228,10 @@ def main(argv: list[str] | None = None) -> int:
     # mode, which therefore always lands AFTER the drain, never
     # mid-broadcast (the analyze lock covers the straggler case).
     install_drain_handlers(server, server.admission, log)
+    if args.coordinator:
+        # follower liveness probe + degraded-mesh readmission; serializes
+        # with request broadcasts on the engine's state_lock
+        engine.start_health_loop()
     log.info("Serving POST /parse on %s:%d", args.host, args.port)
     try:
         server.serve_forever()

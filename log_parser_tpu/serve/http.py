@@ -145,13 +145,18 @@ class _Handler(BaseHTTPRequestHandler):
                     b'{"status":"DOWN","checks":[{"name":"draining",'
                     b'"status":"DOWN"}]}',
                 )
-            # still UP with the circuit open — requests serve from the
-            # host path — but the degradation is visible to probes
+            # still UP while degraded — requests serve from the host path
+            # (circuit open) or the coordinator's local devices (follower
+            # group dead) — but the degradation is visible to probes
+            checks = []
             if self.server.engine.watchdog.circuit_open:
+                checks.append({"name": "device", "status": "DEGRADED"})
+            mesh = getattr(self.server.engine, "mesh_health", None)
+            if mesh is not None and mesh.degraded:
+                checks.append({"name": "mesh", "status": "DEGRADED"})
+            if checks:
                 return self._send_json(
-                    200,
-                    b'{"status":"UP","checks":[{"name":"device",'
-                    b'"status":"DEGRADED"}]}',
+                    200, json.dumps({"status": "UP", "checks": checks}).encode()
                 )
             return self._send_json(200, b'{"status":"UP"}')
         if self.path == "/frequency/stats":
@@ -176,6 +181,11 @@ class _Handler(BaseHTTPRequestHandler):
             with self.server._drop_lock:
                 payload["droppedResponses"] = self.server.dropped_responses
             payload["admission"] = self.server.admission.stats()
+            mesh = getattr(self.server.engine, "mesh_health", None)
+            if mesh is not None:
+                # follower liveness + degrade-to-local counters
+                # (docs/OPS.md "Distributed failure modes")
+                payload["distributed"] = mesh.stats()
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats

@@ -1,23 +1,95 @@
 """Reference shim client — the executable documentation of the wire
 protocol for the JVM implementer (protobuf-java + a Socket is all the
-front-end needs)."""
+front-end needs).
+
+Retry contract: every RPC the shim exposes is idempotent (Parse derives
+everything from the request payload; frequency evolution is the server's
+own windowed state, identical whether a retry lands once or the original
+eventually dies with the connection), so the client retries connect/read
+failures with exponential backoff + jitter, reconnecting between
+attempts, up to a bounded budget. An ``overloaded: ...; retry after Ns``
+error envelope (the framed-wire analogue of HTTP 429 + ``Retry-After``,
+shim/server.py) is honored the same way: sleep the server's hint
+(capped), then retry. ``last_attempts`` on the client records how many
+attempts the most recent call consumed — the shim's metadata channel.
+"""
 
 from __future__ import annotations
 
 import json
+import logging
+import random
+import re
 import socket
+import time
 
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import read_frame, write_frame
 
+log = logging.getLogger(__name__)
+
+# shim/server.py sheds with str(AdmissionRejected):
+#   "overloaded: <reason>; retry after <N>s"
+_RETRY_AFTER = re.compile(r"retry after (\d+(?:\.\d+)?)s")
+
 
 class ShimClient:
-    def __init__(self, host: str = "127.0.0.1", port: int = 9090):
-        self.sock = socket.create_connection((host, port))
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9090,
+        *,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+        retry_after_cap_s: float = 5.0,
+        sleep=time.sleep,
+    ):
+        self.host = host
+        self.port = port
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.retry_after_cap_s = retry_after_cap_s
+        self._sleep = sleep
+        self.last_attempts = 0  # attempts consumed by the most recent call
+        self.sock: socket.socket | None = None
+        self._connect_with_retry()
+
+    # ------------------------------------------------------------ transport
+
+    def _connect(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        sock = socket.create_connection((self.host, self.port))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = sock
+
+    def _connect_with_retry(self) -> None:
+        for attempt in range(self.retries + 1):
+            try:
+                self._connect()
+                return
+            except OSError as exc:
+                if attempt >= self.retries:
+                    raise
+                delay = self._delay(attempt)
+                log.debug(
+                    "shim connect to %s:%d failed (%s); retry in %.3fs",
+                    self.host, self.port, exc, delay,
+                )
+                self._sleep(delay)
+
+    def _delay(self, attempt: int) -> float:
+        # exponential backoff + jitter so a fleet of clients re-arriving
+        # after a shim restart does not re-arrive in lockstep
+        return self.backoff_s * (2 ** attempt) * (1.0 + random.random())
 
     def close(self) -> None:
-        self.sock.close()
+        if self.sock is not None:
+            self.sock.close()
 
     def __enter__(self):
         return self
@@ -25,19 +97,54 @@ class ShimClient:
     def __exit__(self, *exc):
         self.close()
 
+    # ------------------------------------------------------------------ rpc
+
     def call(self, method: str, message) -> pb.Envelope:
-        write_frame(
-            self.sock,
-            pb.Envelope(
-                method=method, payload=message.SerializeToString()
-            ).SerializeToString(),
-        )
-        frame = read_frame(self.sock)
-        if frame is None:
-            raise ConnectionError("shim server closed the connection")
+        """One framed RPC with bounded retry (see module docstring). The
+        request frame is built once and resent verbatim on each attempt."""
+        payload = pb.Envelope(
+            method=method, payload=message.SerializeToString()
+        ).SerializeToString()
         env = pb.Envelope()
-        env.ParseFromString(frame)
-        return env
+        for attempt in range(self.retries + 1):
+            self.last_attempts = attempt + 1
+            try:
+                write_frame(self.sock, payload)
+                frame = read_frame(self.sock)
+                if frame is None:
+                    raise ConnectionError("shim server closed the connection")
+                env = pb.Envelope()
+                env.ParseFromString(frame)
+            except (ConnectionError, OSError) as exc:
+                if attempt >= self.retries:
+                    raise
+                delay = self._delay(attempt)
+                log.debug(
+                    "shim %s attempt %d failed (%s); reconnect + retry in %.3fs",
+                    method, attempt + 1, exc, delay,
+                )
+                self._sleep(delay)
+                try:
+                    self._connect()
+                except OSError:
+                    pass  # the next write fails fast and consumes the attempt
+                continue
+            hint = self._overload_hint(env)
+            if hint is not None and attempt < self.retries:
+                # shed, not failed: wait out the server's own hint
+                self._sleep(min(hint, self.retry_after_cap_s))
+                continue
+            return env
+        return env  # budget spent on sheds: hand the caller the envelope
+
+    @staticmethod
+    def _overload_hint(env: pb.Envelope) -> float | None:
+        """Server-suggested backoff seconds from a shed envelope, else
+        None (including errors that are real failures, not sheds)."""
+        if not env.error.startswith("overloaded"):
+            return None
+        m = _RETRY_AFTER.search(env.error)
+        return float(m.group(1)) if m else 1.0
 
     # ---------------------------------------------------------- convenience
 

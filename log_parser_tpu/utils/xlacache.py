@@ -20,16 +20,88 @@ persistent cache has no eviction — the directory grows without bound
 across bank/shape changes. Entries are content-addressed and individually
 deletable, so periodic cleanup is safe: ``find <dir> -atime +30 -delete``
 (or wipe the directory; the only cost is one cold compile set).
+
+Crash safety: :func:`verify_cache_integrity` sweeps the directory at
+enable time, keeping a sha256 sidecar per entry under ``<dir>/.integrity``
+(JAX never reads that subtree). An entry whose bytes no longer match its
+recorded checksum — truncated by a crashed writer, bit-rotted, torn by a
+non-atomic copy — is quarantined with a ``.corrupt`` suffix, which JAX
+sees as a miss and recompiles; startup never fails on a poisoned cache.
+First sight of an entry records its checksum, so the sweep detects
+corruption *between* runs, not a writer that crashed before the very
+first sweep (JAX itself publishes entries atomically). The sweep is
+best-effort: any I/O failure logs and returns — never raises into boot.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 
 log = logging.getLogger(__name__)
 
 _configured = False
+
+
+def verify_cache_integrity(path: str) -> dict[str, int]:
+    """Checksum-sweep a persistent-cache directory (see module docstring).
+    Returns ``{"checked": n, "recorded": n, "quarantined": n}``."""
+    from log_parser_tpu.runtime import faults
+
+    counts = {"checked": 0, "recorded": 0, "quarantined": 0}
+    side_dir = os.path.join(path, ".integrity")
+    try:
+        # chaos point: an injected cache fault aborts the sweep, which
+        # must read as "cache cold", never as a boot failure
+        faults.fire("cache")
+        if not os.path.isdir(path):
+            return counts
+        os.makedirs(side_dir, exist_ok=True)
+        entries = set()
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if name == ".integrity" or not os.path.isfile(full):
+                continue
+            if name.endswith((".corrupt", ".tmp")):
+                continue
+            # JAX pairs each immutable "-cache" payload with a "-atime"
+            # marker it rewrites on every hit — mutation is its normal
+            # behavior, so checksumming it would quarantine healthy entries
+            if name.endswith("-atime"):
+                continue
+            entries.add(name)
+            counts["checked"] += 1
+            digest = hashlib.sha256()
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    digest.update(chunk)
+            want = digest.hexdigest()
+            sidecar = os.path.join(side_dir, name + ".sum")
+            if not os.path.exists(sidecar):
+                tmp = sidecar + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(want + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, sidecar)
+                counts["recorded"] += 1
+            elif open(sidecar).read().split()[0] != want:
+                log.warning(
+                    "XLA cache entry %s fails its checksum; quarantined "
+                    "(.corrupt) — it will recompile on next use", name
+                )
+                os.replace(full, full + ".corrupt")
+                os.unlink(sidecar)
+                counts["quarantined"] += 1
+        # sidecars whose entry is gone (cleanup, eviction) are dropped so
+        # the subtree cannot grow without bound either
+        for name in os.listdir(side_dir):
+            if name.endswith(".sum") and name[: -len(".sum")] not in entries:
+                os.unlink(os.path.join(side_dir, name))
+    except Exception as exc:  # best-effort by contract
+        log.warning("XLA cache integrity sweep aborted: %s", exc)
+    return counts
 
 
 def enable_persistent_cache() -> None:
@@ -53,6 +125,7 @@ def enable_persistent_cache() -> None:
         import jax
 
         os.makedirs(path, exist_ok=True)
+        verify_cache_integrity(path)
         jax.config.update("jax_compilation_cache_dir", path)
         # cache everything, however small or quick: warm restarts should
         # replay the whole compile set, including tier probes and admin

@@ -347,7 +347,10 @@ class TestSharedGateAcrossTransports:
         try:
             a = _Client(url + "/parse")  # HTTP holds the only slot
             _await(lambda: gate.inflight == 1, what="HTTP to hold the slot")
-            with ShimClient("127.0.0.1", shim_port) as client:
+            # retries=0: observe the raw shed — the client's default
+            # Retry-After honoring would wait out the hint and succeed
+            # once the HTTP request releases the slot
+            with ShimClient("127.0.0.1", shim_port, retries=0) as client:
                 with pytest.raises(ValueError, match="overloaded"):
                     client.parse(POD["pod"], POD["logs"])
                 assert a.join_result()[0] == 200

@@ -1,0 +1,235 @@
+"""Crash-safe on-disk caches: checksum sidecars, quarantine of corrupt
+or truncated entries, rebuild-not-raise, and the ``cache`` fault site
+(patterns/libcache.py sidecars + utils/xlacache.py integrity sweep)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import pytest
+
+from helpers import make_pattern, make_pattern_set
+
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+    return tmp_path
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern("oom", regex="OutOfMemoryError", confidence=0.9),
+                make_pattern("to", regex="\\btimeout\\b", confidence=0.7,
+                             severity="MEDIUM"),
+            ]
+        )
+    ]
+
+
+def _snapshot(cache_dir):
+    (path,) = (cache_dir / "bank").glob("*.pkl")
+    return path
+
+
+# ----------------------------------------------------------- libcache
+
+
+class TestLibcacheCrashSafety:
+    def test_save_publishes_checksum_sidecar(self, cache_dir):
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        PatternBank(_sets())
+        path = _snapshot(cache_dir)
+        sidecar = path.with_name(path.name + ".sum")
+        assert sidecar.exists()
+        digest, size = sidecar.read_text().split()
+        blob = path.read_bytes()
+        assert digest == hashlib.sha256(blob).hexdigest()
+        assert int(size) == len(blob)
+
+    def test_flipped_byte_quarantined_and_rebuilt(self, cache_dir):
+        """A single flipped byte mid-file — the torn-write/bit-rot case a
+        bare ``pickle.load`` may well decode into silent garbage — is
+        caught by the checksum, quarantined, and rebuilt cold."""
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        PatternBank(_sets())
+        path = _snapshot(cache_dir)
+        blob = bytearray(path.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        path.write_bytes(bytes(blob))
+
+        bank = PatternBank(_sets())  # must not raise
+        assert bank.n_patterns == 2
+        corrupt = list((cache_dir / "bank").glob("*.pkl.corrupt"))
+        assert len(corrupt) == 1
+        # the rebuild republished a healthy snapshot + fresh sidecar
+        path = _snapshot(cache_dir)
+        assert (
+            path.with_name(path.name + ".sum").read_text().split()[0]
+            == hashlib.sha256(path.read_bytes()).hexdigest()
+        )
+
+    def test_truncated_entry_quarantined_and_rebuilt(self, cache_dir):
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        PatternBank(_sets())
+        path = _snapshot(cache_dir)
+        blob = path.read_bytes()
+        path.write_bytes(blob[: len(blob) // 2])
+
+        bank = PatternBank(_sets())
+        assert bank.n_patterns == 2
+        assert list((cache_dir / "bank").glob("*.pkl.corrupt"))
+
+    def test_sidecarless_legacy_entry_still_loads(self, cache_dir):
+        from log_parser_tpu.patterns import libcache
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        PatternBank(_sets())
+        path = _snapshot(cache_dir)
+        path.with_name(path.name + ".sum").unlink()
+        key = path.stem
+        assert libcache.load(key) is not None  # trusted, like before
+
+    def test_corrupt_rebuild_scores_match_cold_build(self, cache_dir):
+        """Acceptance: startup over a corrupted entry succeeds AND the
+        rebuilt bank scores identically to a cold build."""
+        from log_parser_tpu.config import ScoringConfig
+        from log_parser_tpu.models.pod import PodFailureData
+        from log_parser_tpu.runtime import AnalysisEngine
+
+        logs = "ok\njava.lang.OutOfMemoryError: heap\na timeout b"
+        data = PodFailureData(pod={"metadata": {"name": "p"}}, logs=logs)
+        r_cold = AnalysisEngine(_sets(), ScoringConfig()).analyze(data)
+
+        path = _snapshot(cache_dir)
+        blob = bytearray(path.read_bytes())
+        blob[10] ^= 0x55
+        path.write_bytes(bytes(blob))
+
+        r_rebuilt = AnalysisEngine(_sets(), ScoringConfig()).analyze(data)
+        assert [(e.matched_pattern.id, e.line_number, e.score)
+                for e in r_rebuilt.events] == [
+            (e.matched_pattern.id, e.line_number, e.score)
+            for e in r_cold.events
+        ]
+        assert len(r_cold.events) == 2
+
+    def test_injected_cache_fault_is_a_miss_not_a_quarantine(self, cache_dir):
+        from log_parser_tpu.patterns import libcache
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        PatternBank(_sets())
+        path = _snapshot(cache_dir)
+        key = path.stem
+
+        faults.install(FaultRegistry.parse("cache_raise@times=1"))
+        assert libcache.load(key) is None  # injected read failure: a miss
+        assert path.exists()  # the healthy entry was NOT quarantined
+        assert not list((cache_dir / "bank").glob("*.pkl.corrupt"))
+        assert libcache.load(key) is not None  # budget spent: loads again
+
+
+# ----------------------------------------------------------- xlacache
+
+
+class TestXlaCacheIntegrity:
+    def _entry(self, d, name, content):
+        path = os.path.join(d, name)
+        with open(path, "wb") as f:
+            f.write(content)
+        return path
+
+    def test_sweep_records_then_detects_corruption(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        d = str(tmp_path)
+        self._entry(d, "exec-a", b"compiled-bytes-a" * 100)
+        self._entry(d, "exec-b", b"compiled-bytes-b" * 100)
+
+        first = verify_cache_integrity(d)
+        assert first == {"checked": 2, "recorded": 2, "quarantined": 0}
+        assert sorted(os.listdir(os.path.join(d, ".integrity"))) == [
+            "exec-a.sum", "exec-b.sum",
+        ]
+
+        # truncate one entry the way a crashed writer would
+        with open(os.path.join(d, "exec-a"), "wb") as f:
+            f.write(b"compiled")
+        second = verify_cache_integrity(d)
+        assert second["quarantined"] == 1
+        assert not os.path.exists(os.path.join(d, "exec-a"))
+        assert os.path.exists(os.path.join(d, "exec-a.corrupt"))
+        assert os.path.exists(os.path.join(d, "exec-b"))
+
+        # the quarantined name is now a plain miss: sweeps stay stable
+        third = verify_cache_integrity(d)
+        assert third == {"checked": 1, "recorded": 0, "quarantined": 0}
+
+    def test_unmodified_entries_pass_repeated_sweeps(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        d = str(tmp_path)
+        self._entry(d, "exec-a", b"stable" * 1000)
+        verify_cache_integrity(d)
+        for _ in range(3):
+            counts = verify_cache_integrity(d)
+            assert counts == {"checked": 1, "recorded": 0, "quarantined": 0}
+
+    def test_mutable_atime_markers_are_never_checksummed(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        d = str(tmp_path)
+        self._entry(d, "jit_f-abc123-cache", b"payload" * 100)
+        self._entry(d, "jit_f-abc123-atime", b"\x00" * 8)
+
+        first = verify_cache_integrity(d)
+        assert first == {"checked": 1, "recorded": 1, "quarantined": 0}
+
+        # JAX rewrites the atime marker on every cache hit; the sweep
+        # must not mistake that for corruption
+        self._entry(d, "jit_f-abc123-atime", b"\x01" * 8)
+        second = verify_cache_integrity(d)
+        assert second == {"checked": 1, "recorded": 0, "quarantined": 0}
+        assert os.path.exists(os.path.join(d, "jit_f-abc123-atime"))
+
+    def test_orphan_sidecars_are_dropped(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        d = str(tmp_path)
+        path = self._entry(d, "exec-a", b"bytes")
+        verify_cache_integrity(d)
+        os.unlink(path)  # operator cleanup (find -atime +30 -delete)
+        verify_cache_integrity(d)
+        assert os.listdir(os.path.join(d, ".integrity")) == []
+
+    def test_missing_directory_is_a_noop(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        counts = verify_cache_integrity(str(tmp_path / "never-created"))
+        assert counts == {"checked": 0, "recorded": 0, "quarantined": 0}
+
+    def test_injected_cache_fault_aborts_sweep_quietly(self, tmp_path):
+        from log_parser_tpu.utils.xlacache import verify_cache_integrity
+
+        d = str(tmp_path)
+        self._entry(d, "exec-a", b"bytes")
+        faults.install(FaultRegistry.parse("cache_raise@times=1"))
+        counts = verify_cache_integrity(d)  # must not raise into boot
+        assert counts == {"checked": 0, "recorded": 0, "quarantined": 0}
+        assert os.path.exists(os.path.join(d, "exec-a"))

@@ -1,0 +1,555 @@
+"""Distributed resilience (parallel/resilience.py + distributed.py):
+bounded broadcast dispatch with retry/backoff, follower health tracking,
+degrade-to-local entry/exit, follower-side malformed-payload containment,
+and the shim client's bounded retry.
+
+Everything here is single-process: a :class:`StubTransport` stands in for
+the jax.distributed control plane, so the whole ladder — timeout, retry,
+degraded serving, heartbeat readmission — runs deterministically in-proc.
+The real 2-process wire is covered by tests/test_distributed.py (and its
+slow chaos variant)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from helpers import make_pattern, make_pattern_set
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.parallel import distributed as dist
+from log_parser_tpu.parallel.distributed import (
+    _PING,
+    _SHUTDOWN,
+    DistributedShardedEngine,
+)
+from log_parser_tpu.parallel.resilience import (
+    BroadcastTimeout,
+    DispatchCancelled,
+    MeshHealth,
+    MeshUnavailable,
+    RetryPolicy,
+    bounded_call,
+    dispatch_with_retry,
+)
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    """Every test starts and ends with no fault registry installed;
+    clearing lifts hung waiters so abandoned workers cannot linger."""
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+class StubTransport:
+    """In-process stand-in for the jax.distributed control plane: records
+    coordinator broadcasts, replays a scripted inbox to a follower, and
+    answers ack allgathers for a fully-responsive follower group."""
+
+    def __init__(self, process_count=2, process_index=0, inbox=()):
+        self.n = process_count
+        self.i = process_index
+        self.sent: list[bytes] = []
+        self.inbox = list(inbox)
+        self.acks: list[list[int]] = []
+        self.follower_errors = {pid: 0 for pid in range(1, process_count)}
+
+    def process_count(self):
+        return self.n
+
+    def process_index(self):
+        return self.i
+
+    def broadcast(self, payload):
+        if payload is None:  # follower side: receive the next script entry
+            return self.inbox.pop(0)
+        self.sent.append(payload)
+        return payload
+
+    def allgather(self, row):
+        self.acks.append([int(v) for v in np.asarray(row)])
+        rows = {int(np.asarray(row)[0]): np.asarray(row, dtype=np.int64)}
+        for pid in range(self.n):
+            rows.setdefault(
+                pid,
+                np.array([pid, self.follower_errors.get(pid, 0)], dtype=np.int64),
+            )
+        return np.stack([rows[pid] for pid in range(self.n)])
+
+
+@pytest.fixture()
+def stub():
+    prev = dist.install_transport(StubTransport())
+    yield dist.transport()
+    dist.install_transport(prev)
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom", regex="OutOfMemoryError", confidence=0.8,
+                    severity="HIGH", secondaries=[("GC overhead", 0.6, 10)],
+                ),
+                make_pattern("conn", regex="Connection refused", confidence=0.7,
+                             severity="MEDIUM"),
+            ]
+        )
+    ]
+
+
+def _data():
+    logs = "\n".join(
+        "GC overhead limit" if i == 7
+        else "java.lang.OutOfMemoryError: heap" if i == 9
+        else "dial tcp: Connection refused" if i == 3
+        else f"INFO tick {i}"
+        for i in range(32)
+    )
+    return PodFailureData(pod={"metadata": {"name": "res"}}, logs=logs)
+
+
+def _fast_policy(**kw):
+    kw.setdefault("timeout_s", 0.2)
+    kw.setdefault("retries", 1)
+    kw.setdefault("backoff_s", 0.01)
+    kw.setdefault("max_backoff_s", 0.02)
+    return RetryPolicy(**kw)
+
+
+# ----------------------------------------------------------- bounded_call
+
+
+class TestBoundedCall:
+    def test_returns_value_within_deadline(self):
+        assert bounded_call(lambda ctx: 41 + 1, 5.0) == 42
+
+    def test_unbounded_when_timeout_disabled(self):
+        assert bounded_call(lambda ctx: "inline", 0) == "inline"
+
+    def test_timeout_pre_collective(self):
+        hang = threading.Event()
+        with pytest.raises(BroadcastTimeout) as err:
+            bounded_call(lambda ctx: hang.wait(5), 0.05, label="x")
+        assert not err.value.entered_collective
+        hang.set()
+
+    def test_timeout_inside_collective(self):
+        hang = threading.Event()
+
+        def attempt(ctx):
+            ctx.enter_collective()
+            hang.wait(5)
+
+        with pytest.raises(BroadcastTimeout) as err:
+            bounded_call(attempt, 0.05)
+        assert err.value.entered_collective
+        hang.set()
+
+    def test_abandoned_worker_cannot_enter_collective(self):
+        """The watcher's cancel and the worker's enter_collective are
+        atomic: once the deadline fires, a late worker aborts instead of
+        emitting a stale broadcast."""
+        release = threading.Event()
+        outcome = {}
+
+        def attempt(ctx):
+            release.wait(5)  # deadline fires while we are parked here
+            try:
+                ctx.enter_collective()
+                outcome["entered"] = True
+            except DispatchCancelled:
+                outcome["cancelled"] = True
+
+        with pytest.raises(BroadcastTimeout):
+            bounded_call(attempt, 0.05)
+        release.set()
+        for _ in range(100):
+            if outcome:
+                break
+            import time
+
+            time.sleep(0.01)
+        assert outcome == {"cancelled": True}
+
+    def test_exceptions_propagate(self):
+        with pytest.raises(ValueError, match="boom"):
+            bounded_call(lambda ctx: (_ for _ in ()).throw(ValueError("boom")), 1.0)
+
+
+# ----------------------------------------------------- dispatch_with_retry
+
+
+class TestDispatchRetry:
+    def test_retry_succeeds_within_budget(self):
+        health = MeshHealth(2)
+        hang = threading.Event()
+        calls = {"n": 0}
+
+        def attempt(ctx):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                hang.wait(5)  # first attempt blows the deadline
+            return "ok"
+
+        out = dispatch_with_retry(attempt, _fast_policy(), health, sleep=lambda s: None)
+        hang.set()
+        assert out == "ok"
+        assert calls["n"] == 2
+        assert health.broadcast_timeouts == 1
+        assert health.broadcast_retries == 1
+        assert not health.degraded
+
+    def test_budget_exhausted_raises_mesh_unavailable(self):
+        health = MeshHealth(2, dead_after=99)
+        hang = threading.Event()
+        with pytest.raises(MeshUnavailable):
+            dispatch_with_retry(
+                lambda ctx: hang.wait(5), _fast_policy(), health,
+                sleep=lambda s: None,
+            )
+        hang.set()
+        assert health.broadcast_timeouts == 2  # initial + 1 retry
+        assert not health.degraded  # below dead_after; the caller declares
+
+    def test_in_collective_timeout_wedges_without_retry(self):
+        health = MeshHealth(2)
+        hang = threading.Event()
+        calls = {"n": 0}
+
+        def attempt(ctx):
+            calls["n"] += 1
+            ctx.enter_collective()
+            hang.wait(5)
+
+        with pytest.raises(MeshUnavailable):
+            dispatch_with_retry(attempt, _fast_policy(retries=3), health,
+                                sleep=lambda s: None)
+        hang.set()
+        assert calls["n"] == 1  # a torn collective is never retried
+        assert health.wedged and health.degraded
+
+    def test_exceptions_are_not_retried(self):
+        calls = {"n": 0}
+
+        def attempt(ctx):
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            dispatch_with_retry(attempt, _fast_policy(retries=5), None,
+                                sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_backoff_grows_exponentially(self):
+        policy = RetryPolicy(timeout_s=1, retries=3, backoff_s=0.1,
+                             max_backoff_s=10, jitter=0.0)
+        assert [policy.delay_for(a) for a in (1, 2, 3)] == [0.1, 0.2, 0.4]
+        capped = RetryPolicy(backoff_s=1.0, max_backoff_s=1.5, jitter=0.0)
+        assert capped.delay_for(5) == 1.5
+        jittered = RetryPolicy(backoff_s=0.1, jitter=0.5)
+        assert all(0.1 <= jittered.delay_for(1) <= 0.15 for _ in range(16))
+
+
+# ------------------------------------------------------------- MeshHealth
+
+
+class TestMeshHealth:
+    def test_threshold_declares_degraded(self):
+        health = MeshHealth(3, dead_after=3)
+        for _ in range(2):
+            health.record_broadcast_timeout()
+        assert not health.degraded
+        health.record_broadcast_timeout()
+        assert health.degraded and "3 consecutive" in health.reason
+
+    def test_ack_resets_consecutive_failures(self):
+        health = MeshHealth(2, dead_after=3)
+        health.record_broadcast_timeout()
+        health.record_broadcast_timeout()
+        health.record_ack(1, errors=7)
+        health.record_broadcast_timeout()
+        assert not health.degraded
+        stats = health.stats()
+        assert stats["followers"]["1"]["errors"] == 7
+        assert stats["followers"]["1"]["lastSeenAgoS"] is not None
+
+    def test_readmit_restores_distributed_mode(self):
+        health = MeshHealth(2, dead_after=1)
+        health.record_broadcast_timeout()
+        assert health.degraded
+        assert health.readmit()
+        assert not health.degraded
+        assert health.stats()["readmissions"] == 1
+        assert not health.readmit()  # idempotent: already distributed
+
+    def test_wedged_refuses_readmission(self):
+        health = MeshHealth(2)
+        health.mark_wedged("torn")
+        assert health.degraded and not health.readmit()
+        stats = health.stats()
+        assert stats["wedged"] and stats["mode"] == "degraded"
+
+
+# ------------------------------------------------- degrade-to-local ladder
+
+
+class TestDegradeToLocal:
+    def _engine(self, stub):
+        engine = DistributedShardedEngine(_sets(), ScoringConfig())
+        engine.retry_policy = _fast_policy()
+        return engine
+
+    def test_follower_hang_degrades_then_probe_readmits(self, stub):
+        """The acceptance scenario, in-process: a seeded follower hang
+        exhausts the dispatch budget, the engine flips to degrade-to-local
+        (responses marked), the probe re-admits once the fault clears, and
+        every response matches the healthy sequence score-for-score."""
+        engine = self._engine(stub)
+        assert engine._is_multiprocess() and engine._is_coordinator()
+        faults.install(FaultRegistry.parse("follower_hang:30@times=2"))
+
+        r1 = engine.analyze(_data())  # both attempts hang -> degraded
+        assert engine.mesh_health.degraded
+        assert r1.metadata.degraded == "distributed-fallback"
+        assert stub.sent == []  # the request never reached the group
+        stats = engine.mesh_health.stats()
+        assert stats["broadcastTimeouts"] == 2
+        assert stats["broadcastRetries"] == 1
+        assert stats["degradedRequests"] == 1
+
+        r2 = engine.analyze(_data())  # still degraded: no dispatch attempt
+        assert r2.metadata.degraded == "distributed-fallback"
+
+        # fault budget (times=2) is spent: the next probe heals the mesh
+        assert engine.probe_mesh()
+        assert not engine.mesh_health.degraded
+        assert stub.sent == [_PING]
+        assert engine.mesh_health.stats()["readmissions"] == 1
+
+        r3 = engine.analyze(_data())  # distributed again, broadcast flows
+        assert r3.metadata.degraded is None
+        assert len(stub.sent) == 2 and b"OutOfMemoryError" in stub.sent[1]
+
+        # the degraded window served REAL results: identical to a healthy
+        # engine fed the same three-request stream
+        control = DistributedShardedEngine(_sets(), ScoringConfig())
+        expect = [control.analyze(_data()) for _ in range(3)]
+        for got, want in zip((r1, r2, r3), expect):
+            assert [e.score for e in got.events] == [e.score for e in want.events]
+            assert [e.line_number for e in got.events] == [
+                e.line_number for e in want.events
+            ]
+
+    def test_transient_hang_retries_within_budget(self, stub):
+        """One timed-out attempt + one clean retry: the request dispatches
+        and the mesh never degrades — the satellite's deadline-budget
+        contract."""
+        engine = self._engine(stub)
+        faults.install(FaultRegistry.parse("follower_hang:30@times=1"))
+        result = engine.analyze(_data())
+        assert result.metadata.degraded is None
+        assert not engine.mesh_health.degraded
+        assert len(stub.sent) == 1
+        stats = engine.mesh_health.stats()
+        assert stats["broadcastTimeouts"] == 1 and stats["broadcastRetries"] == 1
+
+    def test_wedged_skips_shutdown_sentinel(self, stub):
+        engine = self._engine(stub)
+        engine.mesh_health.mark_wedged("torn collective")
+        assert not engine.probe_mesh()
+        engine.shutdown_followers()
+        assert stub.sent == []  # no sentinel into a torn collective
+
+    def test_shutdown_sentinel_flows_when_healthy(self, stub):
+        engine = self._engine(stub)
+        engine.shutdown_followers()
+        assert stub.sent == [_SHUTDOWN]
+
+    def test_health_loop_probes_and_stops(self, stub):
+        engine = self._engine(stub)
+        thread = engine.start_health_loop(interval_s=0.02)
+        assert thread is not None
+        for _ in range(200):
+            if engine.mesh_health.stats()["probes"]:
+                break
+            import time
+
+            time.sleep(0.01)
+        engine.stop_health_loop()
+        assert engine.mesh_health.stats()["probes"] >= 1
+        assert _PING in stub.sent
+        assert engine._health_thread is None
+
+
+# ------------------------------------------------------------- followers
+
+
+class TestFollowerLoop:
+    def test_malformed_payload_counted_not_fatal(self):
+        """Satellite: garbage broadcasts are logged with length + process
+        id and counted — the follower survives to serve the next request
+        and its error counter rides the next heartbeat ack."""
+        stub = StubTransport(
+            process_index=1,
+            inbox=[b"\xff\xfenot json", _PING, _SHUTDOWN],
+        )
+        prev = dist.install_transport(stub)
+        try:
+            engine = DistributedShardedEngine(_sets(), ScoringConfig())
+            engine.follower_loop()  # returns on the shutdown sentinel
+            assert engine.follower_errors == 1
+            assert stub.acks == [[1, 1]]  # [process_index, follower_errors]
+        finally:
+            dist.install_transport(prev)
+
+    def test_follower_loop_refused_on_coordinator(self, stub):
+        engine = DistributedShardedEngine(_sets(), ScoringConfig())
+        with pytest.raises(RuntimeError, match="coordinator"):
+            engine.follower_loop()
+
+
+# ------------------------------------------------------ shim client retry
+
+
+class _FakeShimServer:
+    """Scripted framed-protocol server: each connection serves from a
+    script of per-request actions ('close' drops the connection after
+    accept; an Envelope is framed back)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        from log_parser_tpu.shim.framing import read_frame, write_frame
+
+        while self.script:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                while self.script:
+                    action = self.script.pop(0)
+                    if action == "close":
+                        break  # drop the connection mid-conversation
+                    if read_frame(conn) is None:
+                        break
+                    write_frame(conn, action.SerializeToString())
+
+    def close(self):
+        self.sock.close()
+
+
+class TestShimClientRetry:
+    def _ok_envelope(self):
+        from log_parser_tpu.shim import logparser_pb2 as pb
+
+        return pb.Envelope(
+            method="Parse", payload=pb.ParseResponse().SerializeToString()
+        )
+
+    def test_read_failure_reconnects_and_retries(self):
+        from log_parser_tpu.shim.client import ShimClient
+
+        server = _FakeShimServer(["close", self._ok_envelope()])
+        try:
+            sleeps = []
+            with ShimClient(
+                "127.0.0.1", server.port, retries=2, backoff_s=0.001,
+                sleep=sleeps.append,
+            ) as client:
+                resp = client.parse({"metadata": {"name": "x"}}, "INFO ok")
+            assert resp is not None
+            assert client.last_attempts == 2
+            assert sleeps  # backed off between attempts
+        finally:
+            server.close()
+
+    def test_retry_budget_exhausted_raises(self):
+        from log_parser_tpu.shim.client import ShimClient
+
+        server = _FakeShimServer(["close", "close", "close"])
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                with ShimClient(
+                    "127.0.0.1", server.port, retries=2, backoff_s=0.001,
+                    sleep=lambda s: None,
+                ) as client:
+                    client.parse({"metadata": {"name": "x"}}, "INFO ok")
+        finally:
+            server.close()
+
+    def test_overload_envelope_honors_retry_after(self):
+        from log_parser_tpu.shim import logparser_pb2 as pb
+        from log_parser_tpu.shim.client import ShimClient
+
+        shed = pb.Envelope(
+            method="Parse", error="overloaded: queue full; retry after 3s"
+        )
+        server = _FakeShimServer([shed, self._ok_envelope()])
+        try:
+            sleeps = []
+            with ShimClient(
+                "127.0.0.1", server.port, retries=2, backoff_s=0.001,
+                retry_after_cap_s=0.5, sleep=sleeps.append,
+            ) as client:
+                resp = client.parse({"metadata": {"name": "x"}}, "INFO ok")
+            assert resp is not None
+            assert client.last_attempts == 2
+            assert 0.5 in sleeps  # the server's 3s hint, capped
+        finally:
+            server.close()
+
+    def test_connect_retries_until_listener_responds(self, monkeypatch):
+        from log_parser_tpu.shim import client as client_mod
+        from log_parser_tpu.shim.client import ShimClient
+
+        server = _FakeShimServer([])
+        real_create = socket.create_connection
+        calls = {"n": 0}
+
+        def flaky(addr, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionRefusedError("listener not up yet")
+            return real_create(addr, *a, **kw)
+
+        monkeypatch.setattr(client_mod.socket, "create_connection", flaky)
+        try:
+            client = ShimClient(
+                "127.0.0.1", server.port, retries=3, backoff_s=0.001,
+                sleep=lambda s: None,
+            )
+            client.close()
+            assert calls["n"] == 3
+        finally:
+            server.close()
+
+    def test_connect_budget_exhausted_raises(self, monkeypatch):
+        from log_parser_tpu.shim import client as client_mod
+        from log_parser_tpu.shim.client import ShimClient
+
+        monkeypatch.setattr(
+            client_mod.socket,
+            "create_connection",
+            lambda *a, **kw: (_ for _ in ()).throw(ConnectionRefusedError()),
+        )
+        with pytest.raises(OSError):
+            ShimClient("127.0.0.1", 1, retries=1, backoff_s=0.001,
+                       sleep=lambda s: None)

@@ -22,7 +22,20 @@ Scenarios:
                       answered 200, /health/ready 503 during drain,
                       child exits 0.
 
-Usage: python tools/chaos_sweep.py [--only NAME] [--keep-logs]
+Distributed group (``--group distributed``; needs a jax build whose CPU
+backend supports multi-process collectives — reported SKIP otherwise):
+
+- ``follower-degrade``  coordinator + follower sharing a jax.distributed
+                        runtime; a seeded follower hang exhausts the
+                        bounded-broadcast budget, requests keep answering
+                        200 with the ``degraded: distributed-fallback``
+                        marker, the heartbeat re-admits the mesh
+                        (/trace/last ``distributed.mode`` back to
+                        ``distributed``), and SIGTERM still shuts both
+                        processes down cleanly.
+
+Usage: python tools/chaos_sweep.py [--only NAME] [--group base|distributed|all]
+                                   [--keep-logs]
 """
 
 from __future__ import annotations
@@ -226,6 +239,124 @@ def scenario_drain(srv: Server):
     assert saw_unready, "never observed /health/ready 503 during drain"
 
 
+# ------------------------------------------------- distributed scenarios
+
+
+_NO_CPU_MULTIPROCESS = "Multiprocess computations aren't implemented"
+
+
+class DistributedPair:
+    """A coordinator serve child + one follower child sharing a
+    jax.distributed runtime (4 virtual CPU devices each → one 8-device
+    global mesh). The coordinator owns HTTP; the follower replays
+    broadcasts in follower_loop."""
+
+    def __init__(self, name: str, coord_args: list[str], coord_env: dict):
+        dist_port = free_port()
+        shared = [
+            "--coordinator", f"127.0.0.1:{dist_port}", "--num-processes", "2",
+        ]
+        base_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+        self.follower_log = tempfile.NamedTemporaryFile(
+            "wb", prefix=f"chaos_{name}_follower_", suffix=".log", delete=False
+        )
+        self.follower = subprocess.Popen(
+            [
+                sys.executable, "-m", "log_parser_tpu.serve",
+                "--pattern-dir", PATTERN_DIR,
+                *shared, "--process-id", "1",
+            ],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONUNBUFFERED": "1", **base_env},
+            stdout=self.follower_log,
+            stderr=subprocess.STDOUT,
+        )
+        self.coord = Server(
+            name,
+            [*shared, "--process-id", "0", *coord_args],
+            {**base_env, **coord_env},
+        )
+        self.url = self.coord.url
+        self.log = self.coord.log
+
+    def logs_tail(self) -> str:
+        out = []
+        for path in (self.coord.log.name, self.follower_log.name):
+            try:
+                with open(path, "rb") as f:
+                    out.append(f.read()[-4000:].decode(errors="replace"))
+            except OSError:
+                pass
+        return "\n".join(out)
+
+    def stop(self) -> None:
+        self.coord.stop()
+        if self.follower.poll() is None:
+            try:
+                self.follower.wait(30)
+            except subprocess.TimeoutExpired:
+                self.follower.kill()
+                self.follower.wait(10)
+
+
+def scenario_follower_degrade(pair: DistributedPair):
+    # r1 rides the full mesh before the fault arms (after=1)
+    status, body, _ = post(pair.url, timeout=60)
+    assert status == 200, f"expected 200, got {status}"
+    assert "degraded" not in body.get("metadata", {}), body["metadata"]
+
+    # r2: the follower hang burns the whole broadcast budget (2s x 2) —
+    # the request must still answer 200, served degraded from local chips
+    status, body, _ = post(pair.url, timeout=120)
+    assert status == 200, f"degraded request got {status}"
+    assert body["metadata"].get("degraded") == "distributed-fallback", (
+        body.get("metadata")
+    )
+    _, health = get(pair.url, "/health")
+    assert {"name": "mesh", "status": "DEGRADED"} in health.get("checks", []), health
+
+    # the heartbeat probe must re-admit the mesh once the hang expires
+    # (times=2 budget was spent inside r2)
+    deadline = time.monotonic() + 30
+    mode = None
+    while time.monotonic() < deadline:
+        _, trace = get(pair.url, "/trace/last")
+        mode = trace.get("distributed", {}).get("mode")
+        if mode == "distributed":
+            break
+        time.sleep(0.3)
+    assert mode == "distributed", f"mesh never re-admitted (mode={mode})"
+    assert trace["distributed"]["broadcastTimeouts"] >= 2, trace["distributed"]
+    assert trace["distributed"]["degradedRequests"] >= 1, trace["distributed"]
+    assert trace["distributed"]["readmissions"] >= 1, trace["distributed"]
+
+    # r3 is distributed again, and SIGTERM shuts BOTH processes down
+    status, body, _ = post(pair.url, timeout=60)
+    assert status == 200 and "degraded" not in body.get("metadata", {})
+    pair.coord.proc.send_signal(signal.SIGTERM)
+    pair.coord.proc.wait(60)
+    assert pair.coord.proc.returncode == 0, f"rc={pair.coord.proc.returncode}"
+    pair.follower.wait(60)
+    assert pair.follower.returncode == 0, f"follower rc={pair.follower.returncode}"
+
+
+DISTRIBUTED_SCENARIOS = [
+    (
+        "follower-degrade",
+        [
+            "--broadcast-timeout", "2", "--broadcast-retries", "1",
+            "--dead-after", "2", "--heartbeat-s", "0.5",
+        ],
+        {
+            "LOG_PARSER_TPU_FAULTS": "follower_hang:30@after=1@times=2",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_follower_degrade,
+    ),
+]
+
+
 SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
@@ -272,6 +403,11 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="chaos_sweep")
     parser.add_argument("--only", help="run a single scenario by name")
     parser.add_argument(
+        "--group", choices=("base", "distributed", "all"), default="base",
+        help="which scenario group to sweep (default: base; the "
+        "distributed group needs multi-process CPU collective support)",
+    )
+    parser.add_argument(
         "--keep-logs", action="store_true",
         help="keep child logs even for passing scenarios",
     )
@@ -279,30 +415,57 @@ def main(argv: list[str] | None = None) -> int:
 
     rows = []
     failed = 0
-    for name, flags, env, check in SCENARIOS:
-        if args.only and name != args.only:
-            continue
-        t0 = time.monotonic()
-        srv = Server(name, flags, env)
-        try:
-            srv.wait_ready()
-            check(srv)
-            if name != "drain":  # drain stops (and asserts on) itself
+    if args.group in ("base", "all"):
+        for name, flags, env, check in SCENARIOS:
+            if args.only and name != args.only:
+                continue
+            t0 = time.monotonic()
+            srv = Server(name, flags, env)
+            try:
+                srv.wait_ready()
+                check(srv)
+                if name != "drain":  # drain stops (and asserts on) itself
+                    srv.stop()
+                rows.append((name, "PASS", time.monotonic() - t0, ""))
+                if not args.keep_logs:
+                    os.unlink(srv.log.name)
+            except Exception as exc:  # one row per scenario, keep sweeping
                 srv.stop()
-            rows.append((name, "PASS", time.monotonic() - t0, ""))
-            if not args.keep_logs:
-                os.unlink(srv.log.name)
-        except Exception as exc:  # one row per scenario, keep sweeping
-            srv.stop()
-            failed += 1
-            rows.append((name, "FAIL", time.monotonic() - t0,
-                         f"{exc} (log: {srv.log.name})"))
+                failed += 1
+                rows.append((name, "FAIL", time.monotonic() - t0,
+                             f"{exc} (log: {srv.log.name})"))
+    if args.group in ("distributed", "all"):
+        for name, flags, env, check in DISTRIBUTED_SCENARIOS:
+            if args.only and name != args.only:
+                continue
+            t0 = time.monotonic()
+            pair = DistributedPair(name, flags, env)
+            try:
+                pair.coord.wait_ready(timeout=180)
+                check(pair)
+                pair.stop()
+                rows.append((name, "PASS", time.monotonic() - t0, ""))
+                if not args.keep_logs:
+                    os.unlink(pair.coord.log.name)
+                    os.unlink(pair.follower_log.name)
+            except Exception as exc:
+                tail = pair.logs_tail()
+                pair.stop()
+                if _NO_CPU_MULTIPROCESS in tail:
+                    rows.append((name, "SKIP", time.monotonic() - t0,
+                                 "CPU backend lacks multi-process collectives"))
+                else:
+                    failed += 1
+                    rows.append((name, "FAIL", time.monotonic() - t0,
+                                 f"{exc} (logs: {pair.coord.log.name}, "
+                                 f"{pair.follower_log.name})"))
 
     width = max(len(r[0]) for r in rows) if rows else 8
     print(f"\n{'scenario':<{width}}  result  seconds  detail")
     for name, result, secs, detail in rows:
         print(f"{name:<{width}}  {result:<6}  {secs:7.1f}  {detail}")
-    print(f"\n{len(rows) - failed}/{len(rows)} scenarios passed (seed 42)")
+    passed = sum(1 for r in rows if r[1] == "PASS")
+    print(f"\n{passed}/{len(rows)} scenarios passed (seed 42)")
     return 1 if failed else 0
 
 
