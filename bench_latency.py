@@ -7,6 +7,14 @@ full REST stack on a local server for end-to-end request latency.
 
 Prints exactly one JSON line:
     {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": p50_ms}
+
+``--sweep`` switches to the micro-batching concurrency sweep (ISSUE 3
+acceptance): 1/4/16/64 concurrent clients x batching off/on against the
+engine directly, per-level p50/p99 plus aggregate lines/sec. The
+headline value is the 16-client batching-ON throughput, vs_baseline the
+16-client OFF throughput, with the full curve in ``sweep``. Defaults to
+small 64-line corpora (where per-request dispatch overhead dominates
+and coalescing pays); ``--lines`` overrides.
 """
 
 from __future__ import annotations
@@ -18,9 +26,25 @@ import time
 
 import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
 
-BATCH_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 512
+SWEEP = "--sweep" in sys.argv
+BATCH_LINES = (
+    int(sys.argv[sys.argv.index("--lines") + 1])
+    if "--lines" in sys.argv
+    else (16 if SWEEP else 512)
+)
 REQUESTS = int(sys.argv[sys.argv.index("--requests") + 1]) if "--requests" in sys.argv else 60
 USE_HTTP = "--http" in sys.argv
+SWEEP_LEVELS = (1, 4, 16, 64)
+SWEEP_WAIT_MS = (
+    float(sys.argv[sys.argv.index("--batch-wait-ms") + 1])
+    if "--batch-wait-ms" in sys.argv
+    else 12.0
+)
+SWEEP_BATCH_MAX = (
+    int(sys.argv[sys.argv.index("--batch-max") + 1])
+    if "--batch-max" in sys.argv
+    else 16
+)
 # N concurrent clients: measures how well the pipelined serving path
 # (engine.analyze_pipelined) overlaps ingest/device work across requests;
 # 1 = the sequential stream
@@ -51,7 +75,142 @@ def percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def sweep_main() -> None:
+    metric = f"parse_agg_lines_per_s_c16_batched_{BATCH_LINES}line"
+    platform = bench_common.probe_backend(metric, "lines/s")
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+
+    def run_level(batching: bool, c: int, per_client: int) -> dict:
+        per_thread: list[list[float]] = [[] for _ in range(c)]
+
+        def client(ci: int):
+            def inner() -> None:
+                for j in range(per_client):
+                    data = PodFailureData(
+                        pod={"metadata": {"name": "sweep"}},
+                        logs=micro_batch(ci * per_client + j, BATCH_LINES),
+                    )
+                    t0 = time.perf_counter()
+                    if batching:
+                        engine.analyze_batched(data)
+                    else:
+                        engine.analyze_pipelined(data)
+                    per_thread[ci].append((time.perf_counter() - t0) * 1e3)
+
+            return inner
+
+        n_requests = c * per_client
+        budget_s = max(bench_common.DRAIN_FLOOR_S, 10.0 * n_requests)
+        mode = "on" if batching else "off"
+        t0 = time.perf_counter()
+        bench_common.run_bounded(
+            [client(ci) for ci in range(c)],
+            budget_s,
+            metric,
+            "lines/s",
+            platform,
+            f"sweep c{c} batching={mode}",
+        )
+        wall = time.perf_counter() - t0
+        lat = sorted(x for vals in per_thread for x in vals)
+        return {
+            "concurrency": c,
+            "batching": mode,
+            "requests": n_requests,
+            "wall_s": round(wall, 3),
+            "lines_per_sec": round(n_requests * BATCH_LINES / wall, 1),
+            "p50_ms": round(percentile(lat, 0.50), 3),
+            "p99_ms": round(percentile(lat, 0.99), 3),
+        }
+
+    def prewarm_batcher(batcher) -> None:
+        """Compile every (R, B, T) shape the sweep can realize BEFORE the
+        timed levels: group the request stream's corpora by encoded shape,
+        then coalesce exact power-of-two batches of each group through the
+        real batcher path. Without this, stray XLA compiles of the vmapped
+        program land inside a timed window and read as 4-second p99s."""
+        from log_parser_tpu.native.ingest import Corpus
+
+        by_shape: dict[tuple, list[int]] = {}
+        for i in range(97):  # the micro_batch content cycle
+            corpus = Corpus(
+                micro_batch(i, BATCH_LINES),
+                min_rows=engine._corpus_min_rows(),
+            )
+            by_shape.setdefault(corpus.encoded.u8.shape, []).append(i)
+        old_wait = batcher.wait_s
+        batcher.wait_s = 0.25  # hold each round open until fully enqueued
+        try:
+            for idxs in by_shape.values():
+                r = 1
+                while r <= batcher.batch_max:
+                    pend = [
+                        batcher._enqueue(
+                            PodFailureData(
+                                pod={"metadata": {"name": "warm"}},
+                                logs=micro_batch(i, BATCH_LINES),
+                            ),
+                            None,
+                        )
+                        for i in (idxs * r)[:r]
+                    ]
+                    for p in pend:
+                        p.done.wait()
+                    r <<= 1
+        finally:
+            batcher.wait_s = old_wait
+
+    curve = []
+    batcher_stats = None
+    for batching in (False, True):
+        if batching:
+            batcher = engine.enable_batching(
+                wait_ms=SWEEP_WAIT_MS, batch_max=SWEEP_BATCH_MAX
+            )
+            bounded = bench_common.bounded_runner(metric, "lines/s", platform)
+            bounded(
+                lambda: prewarm_batcher(batcher),
+                bench_common.PROBE_TIMEOUT_S,
+                "batch prewarm",
+            )
+        for c in SWEEP_LEVELS:
+            # warmup round (untimed): the unbatched R=1 shapes, and with
+            # batching on the residual scheduler timing at this fan-in
+            run_level(batching, c, 2)
+            curve.append(run_level(batching, c, max(3, REQUESTS // c)))
+        if batching:
+            batcher_stats = engine.batcher.stats()
+            engine.batcher.close()
+            engine.batcher = None
+
+    def level(mode: str, c: int) -> dict:
+        return next(
+            r for r in curve if r["batching"] == mode and r["concurrency"] == c
+        )
+
+    bench_common.emit(
+        metric,
+        level("on", 16)["lines_per_sec"],
+        "lines/s",
+        level("off", 16)["lines_per_sec"],
+        platform,
+        lines_per_request=BATCH_LINES,
+        batch_wait_ms=SWEEP_WAIT_MS,
+        batch_max=SWEEP_BATCH_MAX,
+        sweep=curve,
+        batcher=batcher_stats,
+    )
+
+
 def main() -> None:
+    if SWEEP:
+        return sweep_main()
     suffix = "_http" if USE_HTTP else ""
     if CONCURRENCY > 1:
         suffix += f"_c{CONCURRENCY}"

@@ -50,6 +50,27 @@ from log_parser_tpu.patterns.bank import (
 # overflow when compared/subtracted
 NO_HIT = np.int32(1 << 30)
 
+# This jaxlib (0.4.x) ships no batching rule for optimization_barrier,
+# which blocks vmap-ing _step over a leading request axis (the
+# cross-request micro-batcher, runtime/batcher.py). The barrier is
+# identity-shaped — a fusion hint with no data semantics — so the rule is
+# the trivial one: bind the primitive on the batched operands and keep the
+# batch dims. Registered defensively: if jax internals move, the batched
+# program fails loudly at trace time and the serve path simply runs
+# unbatched.
+try:  # pragma: no cover - exercised implicitly by every vmapped _step
+    from jax._src.lax.lax import optimization_barrier_p as _barrier_p
+    from jax.interpreters import batching as _batching
+
+    if _barrier_p not in _batching.primitive_batchers:
+
+        def _barrier_batcher(args, dims, **params):
+            return _barrier_p.bind(*args, **params), dims
+
+        _batching.primitive_batchers[_barrier_p] = _barrier_batcher
+except Exception:  # noqa: BLE001 - jax internals moved; vmap will raise
+    pass
+
 # K-capped record buffers: ladder of compiled bucket sizes; a batch whose
 # match count overflows the chosen bucket re-runs at the next rung
 K_LADDER = (4096, 32768, 262144, 2097152)
@@ -490,3 +511,71 @@ class FusedMatchScore:
                 total = hi - lo
             per_shape.append(jnp.concatenate([counts, total[:, None]], axis=1))
         return jnp.stack(per_shape, axis=1)  # [B, U, 5]
+
+
+class FusedBatchMatchScore:
+    """Cross-request batched fused program: ``vmap`` of
+    :meth:`FusedMatchScore._step` over a leading request axis R.
+
+    One dispatch serves R coalesced requests (runtime/batcher.py): inputs
+    are ``lines_u8 [R, B, T]``, ``lengths [R, B]``, ``n_lines [R]`` and
+    optionally stacked override cubes ``[R, B, C]``. Each vmapped instance
+    sees ONLY its own rows and its own ``n_lines`` valid-mask, so match
+    bits, distances, sequence chains, and context windows can never bleed
+    across requests — and because the device math is integer-only, vmap
+    cannot perturb results: per-request records are bit-identical to the
+    unbatched program's (tests/test_batcher.py asserts equality, which
+    subsumes the ≤1e-6 score-parity requirement).
+
+    K (the record capacity) is a shared static arg: one rung serves the
+    whole batch, sized by the engine's k_hint, and if ANY request
+    overflows, the whole batch re-runs at the next rung (per-request caps
+    are equal within a bucket — same B, same pattern count).
+    """
+
+    def __init__(self, fused: FusedMatchScore):
+        self.fused = fused
+        self._jit_plain = jax.jit(
+            lambda k, lines, lens, n: jax.vmap(
+                lambda L, le, nn: fused._step(k, L, le, nn, None)
+            )(lines, lens, n),
+            static_argnums=(0,),
+        )
+        self._jit_ov = jax.jit(
+            lambda k, lines, lens, n, om, ov: jax.vmap(
+                lambda L, le, nn, m, v: fused._step(k, L, le, nn, (m, v))
+            )(lines, lens, n, om, ov),
+            static_argnums=(0,),
+        )
+
+    def run(
+        self,
+        lines_u8: np.ndarray,  # [R, B, T] uint8
+        lengths: np.ndarray,  # [R, B] int
+        n_lines: np.ndarray,  # [R] int
+        override_mask: np.ndarray | None = None,  # [R, B, C] bool
+        override_val: np.ndarray | None = None,
+        k_hint: int = 0,
+    ) -> list[MatchRecords]:
+        """One batched dispatch per K rung; returns per-request records in
+        request order. Overflow of any slot climbs the shared ladder."""
+        R = lines_u8.shape[0]
+        ladder, cap = self.fused.k_ladder(lines_u8[0], k_hint)
+        lines = jnp.asarray(lines_u8)
+        lens = jnp.asarray(lengths)
+        n = jnp.asarray(n_lines, dtype=jnp.int32)
+        for k in ladder:
+            if override_mask is not None:
+                out = self._jit_ov(
+                    k, lines, lens, n,
+                    jnp.asarray(override_mask), jnp.asarray(override_val),
+                )
+            else:
+                out = self._jit_plain(k, lines, lens, n)
+            arr = np.asarray(out)  # [R, packed] — ONE device→host transfer
+            recs = [self.fused.resolve(arr[i]) for i in range(R)]
+            if all(r is not None for r in recs):
+                return recs
+            if k >= cap:
+                raise AssertionError("unreachable: K ladder capped at B*P")
+        raise AssertionError("unreachable: K ladder capped at B*P")

@@ -1,0 +1,390 @@
+"""Cross-request dynamic micro-batching — the serving-side throughput
+lever between the admission gate and the engine.
+
+Without this layer every request runs the device pipeline alone: under
+concurrent small parses the chip spends most of its time on per-request
+dispatch overhead and padding, and the engine's ``state_lock`` turns N
+clients into a serial stream (SURVEY.md §5.2). Continuous batching is the
+standard fix in serving stacks, and shape-routed grouping before the
+expensive matcher is exactly where dynamic-routing parsers like CelerLog
+get their throughput (PAPERS.md).
+
+Data flow (docs/ARCHITECTURE.md "Cross-request micro-batching"):
+
+1. **submit** (caller thread): ingest + host-regex overrides — the same
+   prepare work ``AnalysisEngine._prepare`` does, minus the device step —
+   then the prepared corpus enqueues into a *bucket* keyed by its padded
+   row count. Buckets exist so one flush compiles one ``[R, B, T]`` shape:
+   row counts are already quantized (fractional power-of-two rungs × the
+   engine's min-rows floor, ops/encode.py ``_pad_rows``), widths to
+   power-of-two rungs, and R pads to the next power of two below
+   ``batch_max`` — so the jit-shape space stays as bounded as the
+   unbatched path's.
+2. **scheduler** (one background thread): flushes a bucket when it is
+   FULL (``batch_max`` queued), when the oldest entry has waited
+   ``wait_ms``, or when the earliest enqueued request's admission
+   DEADLINE approaches (a tight deadline must not sit out the coalescing
+   window). Each flush stacks the bucket into one padded device batch and
+   runs ONE vmapped fused program (ops/fused.py
+   :class:`~log_parser_tpu.ops.fused.FusedBatchMatchScore`) through the
+   engine's watchdog — per-request ``n_lines`` masks inside the vmap
+   guarantee scores never bleed across requests.
+3. **demux** (scheduler thread): per-request records resolve in ENQUEUE
+   order — approx verification, then the frequency-coupled finish under
+   ``engine.state_lock`` with the same save/rollback the unbatched path
+   uses. The frequency read-before-record ordering is therefore exactly
+   what a serial stream in enqueue order would produce. Failures stay
+   per-request: a device-classified error falls back to the golden host
+   path for THAT request only; a logic bug propagates to its caller and
+   its batchmates never notice.
+
+Chaos sites (runtime/faults.py): ``batcher`` fires at flush start (so
+``batcher_slow`` delays a flush and ``batcher_raise`` fails a whole batch
+into per-request fallback), ``batcher_demux`` fires per request during
+demux (a dropped demux slot fails one request, not the batch), and
+``batcher_oversize`` — when armed — makes the flush take EVERYTHING
+queued in the bucket, ignoring ``batch_max`` (an oversized batch
+exercising the R-padding ladder).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.utils.trace import PhaseTrace
+
+if TYPE_CHECKING:  # import cycle: engine imports nothing from here at boot
+    from log_parser_tpu.models.analysis import AnalysisResult
+    from log_parser_tpu.models.pod import PodFailureData
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _Pending:
+    """One enqueued request: prepare outputs + the rendezvous the caller
+    blocks on. ``result``/``error`` are written by the scheduler thread
+    before ``done`` is set."""
+
+    __slots__ = (
+        "data", "start", "trace", "corpus", "om", "ov",
+        "deadline", "enqueued_at", "done", "result", "error", "seq",
+    )
+
+    def __init__(self, data, start, trace, corpus, om, ov, deadline, seq):
+        self.data = data
+        self.start = start
+        self.trace = trace
+        self.corpus = corpus
+        self.om = om
+        self.ov = ov
+        self.deadline = deadline  # monotonic seconds, or None
+        self.enqueued_at = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.seq = seq
+
+
+class MicroBatcher:
+    """Background scheduler coalescing concurrent analyze() calls into one
+    padded device batch per shape bucket. Created via
+    ``engine.enable_batching()``; transports call ``engine.analyze_batched``
+    which routes here."""
+
+    def __init__(self, engine, wait_ms: float = 2.0, batch_max: int = 8):
+        from log_parser_tpu.ops.fused import FusedBatchMatchScore
+
+        self.engine = engine
+        self.wait_s = max(0.0, float(wait_ms)) / 1e3
+        self.batch_max = max(1, int(batch_max))
+        self.program = FusedBatchMatchScore(engine.fused)
+        self._cv = threading.Condition()
+        self._queues: dict[int, list[_Pending]] = {}  # bucket rows -> FIFO
+        self._closed = False
+        self._seq = 0
+        self._thread: threading.Thread | None = None
+        # counters (GET /trace/last "batcher"; guarded by _cv)
+        self.requests_batched = 0
+        self.batches_flushed = 0
+        self.last_batch_size = 0
+        self.max_batch_seen = 0
+        self.flush_full = 0
+        self.flush_wait = 0
+        self.flush_deadline = 0
+        self.demux_errors = 0
+
+    # ---------------------------------------------------------------- API
+
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(
+            target=self._scheduler, name="micro-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, flush what is queued, join the scheduler.
+        Late submit() calls run unbatched through the engine."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def submit(self, data: "PodFailureData", deadline_ms: float | None = None):
+        """Blocking analyze-through-the-batcher: prepare on THIS thread,
+        coalesce on the scheduler, return this request's result (or raise
+        its per-request error). Semantics match ``analyze_pipelined``
+        request-for-request."""
+        pending = self._enqueue(data, deadline_ms)
+        if pending is None:  # closed: serve unbatched, same contract
+            return self.engine.analyze_pipelined(data)
+        pending.done.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    # ------------------------------------------------------------- enqueue
+
+    def _enqueue(self, data, deadline_ms) -> _Pending | None:
+        """Prepare (ingest + overrides) on the caller thread and queue the
+        request into its shape bucket. Returns None when closed. A prepare
+        failure takes the engine's normal fallback/propagate path — under
+        ``state_lock``, exactly like ``_analyze``'s prepare except-arm."""
+        start = time.monotonic()
+        trace = PhaseTrace()
+        try:
+            with trace.phase("ingest"):
+                faults.fire("ingest")
+                corpus = Corpus(
+                    data.logs or "", min_rows=self.engine._corpus_min_rows()
+                )
+                corpus.encoded  # materialize outside the scheduler
+            with trace.phase("overrides"):
+                overrides = self.engine._overrides(corpus)
+        except Exception as exc:
+            with self.engine.state_lock:
+                result = self.engine._serve_fallback(data, exc)
+            done = _Pending(data, start, trace, None, None, None, None, -1)
+            done.result = result
+            done.done.set()
+            return done
+        om, ov = overrides if overrides is not None else (None, None)
+        deadline = (
+            start + deadline_ms / 1e3
+            if deadline_ms is not None and deadline_ms > 0
+            else None
+        )
+        with self._cv:
+            if self._closed:
+                return None
+            pending = _Pending(
+                data, start, trace, corpus, om, ov, deadline, self._seq
+            )
+            self._seq += 1
+            rows = corpus.encoded.u8.shape[0]
+            self._queues.setdefault(rows, []).append(pending)
+            self.requests_batched += 1
+            self._cv.notify_all()
+        return pending
+
+    # ----------------------------------------------------------- scheduler
+
+    def _flush_at(self, item: _Pending) -> float:
+        """When this entry stops waiting for batchmates: its coalescing
+        window closes at ``enqueued_at + wait_s``, but an admission
+        deadline pulls the flush earlier — leaving a ``wait_s`` margin for
+        the device step, floored at the enqueue time (a request that
+        arrives nearly dead flushes immediately rather than never)."""
+        at = item.enqueued_at + self.wait_s
+        if item.deadline is not None:
+            at = min(at, max(item.enqueued_at, item.deadline - self.wait_s))
+        return at
+
+    def _pick_flush(self, now: float):
+        """(bucket, reason) ready to flush now, or (None, earliest time a
+        bucket becomes ready). Caller holds ``_cv``."""
+        soonest = None
+        for rows, q in self._queues.items():
+            if not q:
+                continue
+            if len(q) >= self.batch_max:
+                return rows, "full"
+            at = min(self._flush_at(i) for i in q)
+            if at <= now:
+                # deadline-pulled when the wait window alone wouldn't
+                # have fired yet
+                wait_only = min(i.enqueued_at for i in q) + self.wait_s
+                return rows, ("deadline" if at < wait_only - 1e-9 else "wait")
+            soonest = at if soonest is None else min(soonest, at)
+        return None, soonest
+
+    def _scheduler(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    now = time.monotonic()
+                    bucket, when = self._pick_flush(now)
+                    if bucket is not None:
+                        reason = when
+                        break
+                    if self._closed and not any(self._queues.values()):
+                        return
+                    self._cv.wait(
+                        None if when is None else max(0.0, when - now)
+                    )
+                q = self._queues[bucket]
+                take = min(len(q), self.batch_max)
+                try:
+                    # chaos: an armed oversize fault widens this flush to
+                    # the whole bucket, past batch_max
+                    faults.fire("batcher_oversize")
+                except faults.InjectedFault:
+                    take = len(q)
+                items = q[:take]
+                del q[:take]
+                self.batches_flushed += 1
+                self.last_batch_size = len(items)
+                self.max_batch_seen = max(self.max_batch_seen, len(items))
+                if reason == "full":
+                    self.flush_full += 1
+                elif reason == "deadline":
+                    self.flush_deadline += 1
+                else:
+                    self.flush_wait += 1
+            try:
+                self._flush(items)
+            except BaseException:  # pragma: no cover - must never kill the loop
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "micro-batcher flush failed after demux; "
+                    "requests were already resolved"
+                )
+
+    # --------------------------------------------------------------- flush
+
+    def _flush(self, items: list[_Pending]) -> None:
+        engine = self.engine
+        now = time.monotonic()
+        for item in items:
+            item.trace.add("batch_wait", now - item.enqueued_at)
+        try:
+            t0 = time.perf_counter()
+            # chaos at the flush boundary: batcher_slow delays the whole
+            # batch; batcher_raise fails it into per-request fallback below
+            faults.fire("batcher")
+            recs_list = self._device_batch(items)
+            dt = time.perf_counter() - t0
+            for item in items:
+                item.trace.add("device", dt)
+        except Exception as exc:
+            # whole-batch failure: every request takes the engine's
+            # per-request fallback/propagate decision individually — a
+            # device-layer error serves from the golden host path, a logic
+            # bug propagates to each caller
+            for item in items:
+                try:
+                    with engine.state_lock:
+                        item.result = engine._serve_fallback(item.data, exc)
+                except BaseException as per_req:  # noqa: BLE001
+                    item.error = per_req
+                finally:
+                    item.done.set()
+            return
+        # demux in enqueue order: the frequency evolution equals a serial
+        # stream's (read-before-record per request, under state_lock)
+        for item, recs in zip(items, recs_list):
+            try:
+                faults.fire("batcher_demux")
+                with item.trace.phase("verify"):
+                    recs = engine._verify_approx(item.corpus, recs)
+                from log_parser_tpu.runtime.engine import _Prepared
+
+                prepared = _Prepared(item.start, item.trace, item.corpus, recs)
+                with item.trace.phase("lock_wait"):
+                    engine.state_lock.acquire()
+                try:
+                    saved_freq = engine.frequency._save_state()
+                    try:
+                        item.result = engine._finish(prepared)
+                    except Exception as exc:
+                        engine.frequency._load_state(saved_freq)
+                        item.result = engine._serve_fallback(item.data, exc)
+                finally:
+                    engine.state_lock.release()
+            except BaseException as exc:  # noqa: BLE001 - delivered to caller
+                with self._cv:
+                    self.demux_errors += 1
+                item.error = exc
+            finally:
+                item.done.set()
+
+    def _device_batch(self, items: list[_Pending]):
+        """Stack the bucket into one padded [R, B, T] batch, run the
+        vmapped program through the watchdog, return per-item records."""
+        engine = self.engine
+        B = items[0].corpus.encoded.u8.shape[0]
+        T = max(i.corpus.encoded.u8.shape[1] for i in items)
+        R = _next_pow2(len(items))
+        C = engine.bank.n_columns
+        lines = np.zeros((R, B, T), dtype=np.uint8)
+        lens = np.zeros((R, B), dtype=items[0].corpus.encoded.lengths.dtype)
+        nlin = np.zeros((R,), dtype=np.int32)
+        has_ov = any(i.om is not None for i in items)
+        om = np.zeros((R, B, C), dtype=bool) if has_ov else None
+        ov = np.zeros((R, B, C), dtype=bool) if has_ov else None
+        for r, item in enumerate(items):
+            enc = item.corpus.encoded
+            # width padding is semantically neutral: bytes past a line's
+            # length are already the zero padding byte at any width rung
+            lines[r, :, : enc.u8.shape[1]] = enc.u8
+            lens[r] = enc.lengths
+            nlin[r] = item.corpus.n_lines
+            if item.om is not None:
+                om[r] = item.om
+                ov[r] = item.ov
+        # rows R >= len(items) are dummy slots: n_lines == 0 masks every
+        # line invalid, so they produce zero matches at zero risk
+
+        def _device_step():
+            faults.fire("device")
+            return self.program.run(
+                lines, lens, nlin, om, ov, k_hint=engine._k_hint
+            )
+
+        recs_list = engine.watchdog.run(_device_step)
+        engine._k_hint = max(r.n_matches for r in recs_list)
+        return recs_list[: len(items)]
+
+    # ------------------------------------------------------- observability
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "waitMs": self.wait_s * 1e3,
+                "batchMax": self.batch_max,
+                "queueDepth": sum(len(q) for q in self._queues.values()),
+                "buckets": sorted(
+                    rows for rows, q in self._queues.items() if q
+                ),
+                "requestsBatched": self.requests_batched,
+                "batchesFlushed": self.batches_flushed,
+                "lastBatchSize": self.last_batch_size,
+                "maxBatchSeen": self.max_batch_seen,
+                "flushFull": self.flush_full,
+                "flushWait": self.flush_wait,
+                "flushDeadline": self.flush_deadline,
+                "demuxErrors": self.demux_errors,
+            }

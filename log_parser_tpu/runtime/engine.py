@@ -387,6 +387,10 @@ class AnalysisEngine:
         # pressure (serve/admission.py ladder rung 2) — a separate counter,
         # because pressure routing is policy, not failure
         self.host_routed_count = 0
+        # cross-request micro-batching scheduler (runtime/batcher.py);
+        # None until enable_batching() — transports then route analyze
+        # calls through analyze_batched
+        self.batcher = None
         # chaos: pick up LOG_PARSER_TPU_FAULTS once per process (no-op
         # when unset or when a test installed a registry explicitly)
         faults.ensure_env()
@@ -713,6 +717,33 @@ class AnalysisEngine:
         boundary is the only true serialization point (SURVEY.md §5.2;
         the reference serializes nothing and data-races instead)."""
         return self._analyze(data, self.state_lock)
+
+    def enable_batching(self, wait_ms: float = 2.0, batch_max: int = 8):
+        """Attach and start the cross-request micro-batching scheduler
+        (runtime/batcher.py): concurrent ``analyze_batched`` calls coalesce
+        into one padded vmapped device batch per shape bucket. Only the
+        single-device fused program supports the leading request axis —
+        sharded/distributed engines keep the unbatched path."""
+        from log_parser_tpu.runtime.batcher import MicroBatcher
+
+        self.batcher = MicroBatcher(
+            self, wait_ms=wait_ms, batch_max=batch_max
+        ).start()
+        return self.batcher
+
+    def analyze_batched(
+        self, data: PodFailureData, deadline_ms: float | None = None
+    ) -> AnalysisResult:
+        """Thread-safe analyze through the micro-batcher: this request may
+        share its device step with concurrent callers, with per-request
+        results, fallback, and frequency semantics identical to
+        :meth:`analyze_pipelined` (which it degrades to when batching is
+        off). ``deadline_ms``: remaining budget — a tight deadline pulls
+        this request's batch flush earlier."""
+        batcher = self.batcher
+        if batcher is None:
+            return self.analyze_pipelined(data)
+        return batcher.submit(data, deadline_ms)
 
     def analyze_host_routed(self, data: PodFailureData) -> AnalysisResult:
         """Serve one request from the golden host path because the

@@ -20,8 +20,14 @@ Each spec is ``<site>_<action>[:<arg>][@mod=value]*``:
   ``follower`` (a follower failing/stalling a dispatch, fired before the
   coordinator commits to the collective), ``heartbeat`` (the liveness
   probe of parallel/resilience.py), ``cache`` (on-disk cache reads —
-  contained as a miss, libcache/xlacache). Any string works; sites are
-  just names the code fires, see :func:`fire` call sites;
+  contained as a miss, libcache/xlacache), ``batcher`` (micro-batcher
+  flush start — ``slow`` delays a flush, ``raise`` fails the whole batch
+  into per-request fallback), ``batcher_demux`` (per request during batch
+  demux — a dropped demux slot fails ONE request, never its batchmates),
+  ``batcher_oversize`` (armed ``raise`` makes the next flush take the
+  whole bucket past ``--batch-max`` — an oversized batch). Any string
+  works; sites are just names the code fires, see :func:`fire` call
+  sites;
 - action: ``raise`` (raise :class:`InjectedFault`; at the ``device`` site
   :class:`InjectedDeviceFault`, which ``is_device_error`` classifies as a
   device failure so the golden fallback serves it), ``hang`` (block for

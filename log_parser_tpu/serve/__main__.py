@@ -97,6 +97,23 @@ def main(argv: list[str] | None = None) -> int:
         help="SIGTERM drain deadline: finish in-flight work up to this "
         "many seconds before exiting (LOG_PARSER_TPU_DRAIN_S)",
     )
+    # cross-request micro-batching (docs/OPS.md "Micro-batching")
+    parser.add_argument(
+        "--batching", choices=("on", "off"), default=None,
+        help="coalesce concurrent parses into shared device batches "
+        "(runtime/batcher.py; single-device engine only; "
+        "LOG_PARSER_TPU_BATCHING)",
+    )
+    parser.add_argument(
+        "--batch-wait-ms", type=float, default=None, metavar="MS",
+        help="max time a request waits for batchmates before its bucket "
+        "flushes (LOG_PARSER_TPU_BATCH_WAIT_MS)",
+    )
+    parser.add_argument(
+        "--batch-max", type=int, default=None,
+        help="requests per coalesced device batch; a full bucket flushes "
+        "immediately (LOG_PARSER_TPU_BATCH_MAX)",
+    )
     parser.add_argument(
         "--faults", default=None, metavar="SPEC",
         help="fault-injection DSL, e.g. 'device_hang:2@after=3' "
@@ -115,6 +132,9 @@ def main(argv: list[str] | None = None) -> int:
         (args.max_queue, "LOG_PARSER_TPU_MAX_QUEUE"),
         (args.deadline_ms, "LOG_PARSER_TPU_DEADLINE_MS"),
         (args.drain_s, "LOG_PARSER_TPU_DRAIN_S"),
+        (args.batching, "LOG_PARSER_TPU_BATCHING"),
+        (args.batch_wait_ms, "LOG_PARSER_TPU_BATCH_WAIT_MS"),
+        (args.batch_max, "LOG_PARSER_TPU_BATCH_MAX"),
         (args.faults, "LOG_PARSER_TPU_FAULTS"),
         (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
         (args.broadcast_timeout, "LOG_PARSER_TPU_BROADCAST_TIMEOUT_S"),
@@ -181,6 +201,25 @@ def main(argv: list[str] | None = None) -> int:
         sum(1 for c in engine.bank.columns if c.dfa is not None),
     )
 
+    if os.environ.get("LOG_PARSER_TPU_BATCHING", "off").strip().lower() == "on":
+        if args.coordinator or args.sharded:
+            # the vmapped batch program has no shard_map counterpart yet —
+            # the request axis and the line/pattern mesh axes would need a
+            # combined layout (ROADMAP)
+            log.warning(
+                "--batching is only supported on the single-device "
+                "engine; serving unbatched"
+            )
+        else:
+            wait_ms = float(os.environ.get("LOG_PARSER_TPU_BATCH_WAIT_MS", "2"))
+            batch_max = int(os.environ.get("LOG_PARSER_TPU_BATCH_MAX", "8"))
+            engine.enable_batching(wait_ms=wait_ms, batch_max=batch_max)
+            log.info(
+                "Micro-batching on: wait %.1f ms, batch max %d",
+                wait_ms,
+                batch_max,
+            )
+
     if args.coordinator and args.process_id != 0:
         # followers own no network surface: they replay the coordinator's
         # broadcast requests so every process enters each SPMD dispatch.
@@ -240,6 +279,9 @@ def main(argv: list[str] | None = None) -> int:
         log.info("Shutting down")
     finally:
         server.server_close()
+        if engine.batcher is not None:
+            # flush anything still queued before the process exits
+            engine.batcher.close()
         if args.coordinator:
             # under the analyze lock: a daemon handler thread may still be
             # mid-broadcast inside analyze(); interleaving the shutdown

@@ -10,11 +10,15 @@ runtime/engine.py):
 ladder (evaluated per request at admission):
 
 1. **device path** — an in-flight slot is free: full service.
-2. **host-path routing** — slots saturated but the bounded wait queue has
-   room: the request waits for a slot and is then served from the cheaper
-   golden host path (``engine.analyze_host_routed``), relieving device
-   pressure before anything is refused. Counted separately from
-   error-fallbacks (CelerLog-style dynamic fast/slow routing, PAPERS.md).
+2. **queued** — slots saturated but the bounded wait queue has room: the
+   request waits for a slot. What the wait buys depends on the engine:
+   with micro-batching on (runtime/batcher.py) the request coalesces
+   into the next shared device batch (route ``"batched"`` — a
+   first-class outcome with FULL device service, not a degradation);
+   otherwise it is served from the cheaper golden host path
+   (``engine.analyze_host_routed``), relieving device pressure before
+   anything is refused. Both counted separately from error-fallbacks
+   (CelerLog-style dynamic fast/slow routing, PAPERS.md).
 3. **shed** — queue full, or the request would start past its deadline
    (checked while queued, so a doomed request never does dead work):
    reject with 429 + ``Retry-After``.
@@ -80,6 +84,7 @@ class AdmissionController:
         # ladder counters (GET /trace/last)
         self.admitted_device = 0
         self.admitted_host = 0
+        self.admitted_batched = 0
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.shed_draining = 0
@@ -101,11 +106,16 @@ class AdmissionController:
         # second per queued/running request, floor 1s (callers hold no lock)
         return max(1, self._waiting + (1 if self._inflight else 0))
 
-    def acquire(self, deadline_ms: float | None = None) -> str:
+    def acquire(
+        self, deadline_ms: float | None = None, batchable: bool = False
+    ) -> str:
         """Admit or refuse one request. Returns the route — ``"device"``
-        (free slot) or ``"host"`` (had to queue: degrade to the host
-        path) — or raises :class:`AdmissionRejected`. Callers MUST pair a
-        successful acquire with :meth:`release`.
+        (free slot), ``"batched"`` (had to queue, but the transport's
+        engine runs the micro-batcher: the request coalesces into the next
+        device batch — a FIRST-CLASS outcome with full device service, not
+        a degradation), or ``"host"`` (had to queue without batching:
+        degrade to the host path) — or raises :class:`AdmissionRejected`.
+        Callers MUST pair a successful acquire with :meth:`release`.
 
         ``deadline_ms`` is this request's budget from arrival (header);
         None uses the configured default; 0/negative budget means none.
@@ -145,6 +155,13 @@ class AdmissionController:
                                 "deadline", self._retry_after(), 429
                             )
                         self._inflight += 1
+                        if batchable:
+                            # queued-then-batched: the wait bought this
+                            # request a shared device batch, not the
+                            # golden host path — count it as admission,
+                            # not degradation
+                            self.admitted_batched += 1
+                            return "batched"
                         self.admitted_host += 1
                         return "host"
                     timeout = (
@@ -209,6 +226,7 @@ class AdmissionController:
                 "draining": self._draining,
                 "admittedDevice": self.admitted_device,
                 "admittedHost": self.admitted_host,
+                "admittedBatched": self.admitted_batched,
                 "shedQueueFull": self.shed_queue_full,
                 "shedDeadline": self.shed_deadline,
                 "shedDraining": self.shed_draining,

@@ -38,6 +38,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu.models.pod import PodFailureData
@@ -181,6 +182,11 @@ class _Handler(BaseHTTPRequestHandler):
             with self.server._drop_lock:
                 payload["droppedResponses"] = self.server.dropped_responses
             payload["admission"] = self.server.admission.stats()
+            batcher = getattr(self.server.engine, "batcher", None)
+            if batcher is not None:
+                # queue depth, batch sizes, flush reasons (docs/OPS.md
+                # "Micro-batching")
+                payload["batcher"] = batcher.stats()
             mesh = getattr(self.server.engine, "mesh_health", None)
             if mesh is not None:
                 # follower liveness + degrade-to-local counters
@@ -224,8 +230,12 @@ class _Handler(BaseHTTPRequestHandler):
                     400, b'{"error":"invalid X-Request-Deadline-Ms"}'
                 )
 
+        batcher = getattr(self.server.engine, "batcher", None)
+        arrival = time.monotonic()
         try:
-            route = self.server.admission.acquire(deadline_ms)
+            route = self.server.admission.acquire(
+                deadline_ms, batchable=batcher is not None
+            )
         except AdmissionRejected as exc:
             # shed (429) or draining (503) — either way tell the client
             # when it is worth coming back
@@ -241,6 +251,22 @@ class _Handler(BaseHTTPRequestHandler):
                     # ladder rung 2: device slots saturated, this request
                     # queued — serve it from the cheaper golden host path
                     result = self.server.engine.analyze_host_routed(data)
+                elif batcher is not None:
+                    # micro-batching on: this request ("device" or
+                    # queued-then-"batched") coalesces with concurrent
+                    # arrivals into one shared device batch. Pass the
+                    # REMAINING deadline budget — time already burned
+                    # waiting for admission must pull the flush earlier.
+                    effective = (
+                        deadline_ms
+                        if deadline_ms is not None
+                        else (self.server.admission.default_deadline_ms or None)
+                    )
+                    if effective is not None:
+                        effective -= (time.monotonic() - arrival) * 1e3
+                    result = self.server.engine.analyze_batched(
+                        data, effective
+                    )
                 else:
                     # pipelined: ingest + device work of this request
                     # overlaps the host finalize of in-flight ones; only

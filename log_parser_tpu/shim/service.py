@@ -58,10 +58,18 @@ class LogParserService:
         # the shared gate may shed (AdmissionRejected propagates to the
         # transport: error envelope / RESOURCE_EXHAUSTED) or route this
         # request to the host path under pressure
-        route = self.admission.acquire()
+        batcher = getattr(self.engine, "batcher", None)
+        route = self.admission.acquire(batchable=batcher is not None)
         try:
             if route == "host":
                 result = self.engine.analyze_host_routed(data)
+            elif batcher is not None:
+                # micro-batching on (framed shim AND gRPC run through this
+                # body): coalesce with concurrent arrivals under the
+                # gate's default deadline budget
+                result = self.engine.analyze_batched(
+                    data, self.admission.default_deadline_ms or None
+                )
             else:
                 # pipelined: only the finish phase takes self.lock (inside)
                 result = self.engine.analyze_pipelined(data)

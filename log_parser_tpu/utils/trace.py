@@ -16,14 +16,22 @@ metadata field for API parity and adds:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 
 class PhaseTrace:
-    """Named wall-clock phase timers for one request."""
+    """Named wall-clock phase timers for one request.
+
+    Thread-safe: the micro-batcher (runtime/batcher.py) accumulates into a
+    request's trace from both the submitting thread (ingest/overrides) and
+    the scheduler thread (batch_wait/device/finish phases), so the
+    read-modify-write accumulation is guarded — an unguarded ``get()+set``
+    would drop one side's time under interleaving."""
 
     def __init__(self) -> None:
         self.phases: dict[str, float] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -31,17 +39,24 @@ class PhaseTrace:
         try:
             yield
         finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate ``seconds`` into ``name`` (for callers that measured
+        a span themselves — e.g. one shared device step attributed to every
+        request of a coalesced batch)."""
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + seconds
 
     @property
     def total(self) -> float:
-        return sum(self.phases.values())
+        with self._lock:
+            return sum(self.phases.values())
 
     def as_dict(self) -> dict[str, float]:
         """Seconds per phase, insertion-ordered."""
-        return dict(self.phases)
+        with self._lock:
+            return dict(self.phases)
 
     def __repr__(self) -> str:
         parts = ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in self.phases.items())

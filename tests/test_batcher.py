@@ -1,0 +1,295 @@
+"""Cross-request micro-batching (runtime/batcher.py).
+
+The contract under test: coalescing concurrent requests into one padded
+device batch changes THROUGHPUT, never semantics — batched scores equal
+unbatched scores exactly (integer device math + vmap adds no arithmetic),
+the frequency stream evolves as if the requests had arrived serially in
+enqueue order, and failures stay contained to their own demux slot.
+
+Tests drive the batcher through ``_enqueue`` (non-blocking) so enqueue
+order — and therefore batch composition — is deterministic; ``submit``
+is the same path plus a blocking wait.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.faults import FaultRegistry, InjectedFault
+from log_parser_tpu.serve.admission import AdmissionController
+from log_parser_tpu.utils.trace import PhaseTrace
+
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom",
+                    regex="OutOfMemoryError",
+                    confidence=0.9,
+                    severity="CRITICAL",
+                    secondaries=[("GC overhead", 0.3, 10)],
+                    sequences=[(1.5, ["Full GC", "OutOfMemoryError"])],
+                    context=(1, 1),
+                ),
+                make_pattern("conn", regex="Connection refused", confidence=0.7),
+                make_pattern("fatal", regex="FATAL", confidence=0.8),
+            ]
+        )
+    ]
+
+
+def _pod(lines: list[str]) -> PodFailureData:
+    return PodFailureData(
+        pod={"metadata": {"name": "batch"}}, logs="\n".join(lines)
+    )
+
+
+# four corpora with DIFFERENT line counts that share one row bucket
+# (3-7 lines all pad to the same min-rows floor), exercising per-request
+# n_lines masks inside one batch
+MIXED = [
+    _pod(["INFO a", "Full GC", "java OutOfMemoryError here"]),
+    _pod(["GC overhead", "INFO b", "OutOfMemoryError", "INFO c", "INFO d"]),
+    _pod(["dial tcp: Connection refused", "INFO", "INFO", "INFO", "INFO", "INFO"]),
+    _pod(
+        ["INFO"] * 5
+        + ["Full GC", "OutOfMemoryError boom"]
+    ),
+]
+
+
+def _events(result):
+    return [
+        (e.line_number, e.matched_pattern.id, e.score) for e in result.events
+    ]
+
+
+def _batched_engine(wait_ms=5000.0, batch_max=4):
+    engine = AnalysisEngine(_sets(), ScoringConfig())
+    engine.enable_batching(wait_ms=wait_ms, batch_max=batch_max)
+    return engine
+
+
+def _drain(pendings, timeout=60.0):
+    for p in pendings:
+        assert p.done.wait(timeout), "batched request never resolved"
+
+
+def test_batched_parity_mixed_sizes():
+    """One full batch of mixed-size corpora == the same stream served
+    serially by an unbatched engine — exact equality, not a tolerance."""
+    serial = AnalysisEngine(_sets(), ScoringConfig())
+    expected = [_events(serial.analyze_pipelined(d)) for d in MIXED]
+
+    engine = _batched_engine(batch_max=len(MIXED))
+    try:
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED]
+        _drain(pend)
+        for p, want in zip(pend, expected):
+            assert p.error is None
+            assert _events(p.result) == want  # scores bit-identical
+        stats = engine.batcher.stats()
+        assert stats["batchesFlushed"] == 1
+        assert stats["lastBatchSize"] == len(MIXED)
+        assert stats["flushFull"] == 1
+        assert engine.fallback_count == 0
+    finally:
+        engine.batcher.close()
+
+
+def test_bucket_selection_separates_row_buckets():
+    """Corpora whose line counts pad to different row rungs never share a
+    batch; each bucket fills and flushes independently."""
+    small = [_pod(["ERROR", "Connection refused x"]), _pod(["Connection refused y"])]
+    large = [
+        _pod(["INFO"] * 79 + ["FATAL disk"]),
+        _pod(["FATAL net"] + ["INFO"] * 79),
+    ]
+    engine = _batched_engine(batch_max=2)
+    try:
+        # interleave buckets on purpose: small, large, small, large
+        pend = [
+            engine.batcher._enqueue(d, None)
+            for d in (small[0], large[0], small[1], large[1])
+        ]
+        _drain(pend)
+        for p in pend:
+            assert p.error is None
+        assert [e[1] for e in _events(pend[0].result)] == ["conn"]
+        assert [e[1] for e in _events(pend[1].result)] == ["fatal"]
+        stats = engine.batcher.stats()
+        # two FULL flushes of size 2 — never one batch of four
+        assert stats["batchesFlushed"] == 2
+        assert stats["maxBatchSeen"] == 2
+        assert stats["flushFull"] == 2
+    finally:
+        engine.batcher.close()
+
+
+def test_deadline_triggered_flush():
+    """An admission deadline pulls the flush long before the coalescing
+    window (wait_ms=5000) would close."""
+    engine = _batched_engine(wait_ms=5000.0, batch_max=8)
+    try:
+        t0 = time.monotonic()
+        p = engine.batcher._enqueue(MIXED[0], 80.0)
+        assert p.done.wait(30)
+        assert p.error is None and p.result is not None
+        assert time.monotonic() - t0 < 5.0, "flush waited out the window"
+        assert engine.batcher.stats()["flushDeadline"] >= 1
+    finally:
+        engine.batcher.close()
+
+
+def test_wait_triggered_flush():
+    """No batchmates and no deadline: the bucket flushes when the oldest
+    entry has waited wait_ms."""
+    engine = _batched_engine(wait_ms=30.0, batch_max=8)
+    try:
+        p = engine.batcher._enqueue(MIXED[0], None)
+        assert p.done.wait(30)
+        assert p.error is None
+        stats = engine.batcher.stats()
+        assert stats["flushWait"] >= 1
+        assert stats["lastBatchSize"] == 1
+    finally:
+        engine.batcher.close()
+
+
+def test_demux_fault_isolated_per_request():
+    """A dropped demux slot fails exactly ONE request; its batchmates
+    resolve normally (per-request containment)."""
+    engine = _batched_engine(batch_max=len(MIXED))
+    try:
+        faults.install(FaultRegistry.parse("batcher_demux_raise@times=1"))
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED]
+        _drain(pend)
+        assert isinstance(pend[0].error, InjectedFault)
+        for p in pend[1:]:
+            assert p.error is None and p.result is not None
+        assert engine.batcher.stats()["demuxErrors"] == 1
+    finally:
+        engine.batcher.close()
+
+
+def test_whole_batch_device_fault_falls_back_per_request():
+    """A device-classified failure of the shared step serves EVERY member
+    from the golden host path — one fallback per request, no errors."""
+    engine = _batched_engine(batch_max=len(MIXED))
+    engine.fallback_to_golden = True  # conftest disables it via env
+    try:
+        faults.install(FaultRegistry.parse("device_raise@times=1"))
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED]
+        _drain(pend)
+        for p in pend:
+            assert p.error is None
+            assert p.result is not None and p.result.events
+        assert engine.fallback_count == len(MIXED)
+    finally:
+        engine.batcher.close()
+
+
+def test_logic_fault_propagates_to_every_caller():
+    """A non-device batch failure (a logic bug) must propagate to each
+    caller, exactly like the unbatched path — never silently fall back."""
+    engine = _batched_engine(batch_max=2)
+    try:
+        faults.install(FaultRegistry.parse("batcher_raise@times=1"))
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED[:2]]
+        _drain(pend)
+        for p in pend:
+            assert isinstance(p.error, InjectedFault)
+        assert engine.fallback_count == 0
+    finally:
+        engine.batcher.close()
+
+
+def test_oversize_fault_takes_whole_bucket():
+    """An armed batcher_oversize fault widens one flush past batch_max —
+    the oversized batch still serves every request correctly."""
+    engine = _batched_engine(wait_ms=5000.0, batch_max=2)
+    try:
+        faults.install(FaultRegistry.parse("batcher_oversize_raise@times=1"))
+        # hold the scheduler out (its flush pick needs _cv) until all five
+        # are enqueued, so the oversize take is deterministic
+        with engine.batcher._cv:
+            pend = [
+                engine.batcher._enqueue(MIXED[i % len(MIXED)], None)
+                for i in range(5)
+            ]
+        _drain(pend)
+        for p in pend:
+            assert p.error is None and p.result is not None
+        stats = engine.batcher.stats()
+        assert stats["batchesFlushed"] == 1
+        assert stats["maxBatchSeen"] == 5
+    finally:
+        engine.batcher.close()
+
+
+def test_submit_after_close_serves_unbatched():
+    engine = _batched_engine()
+    engine.batcher.close()
+    result = engine.batcher.submit(MIXED[0])
+    assert result is not None and result.events
+    assert engine.batcher.stats()["requestsBatched"] == 0
+
+
+def test_admission_batched_route_is_first_class():
+    """A queued request on a batching engine admits as "batched" — full
+    device service, counted as admission rather than host degradation."""
+    gate = AdmissionController(max_inflight=1, max_queue=4)
+    assert gate.acquire(batchable=True) == "device"
+    routes = []
+    t = threading.Thread(
+        target=lambda: routes.append(gate.acquire(batchable=True)),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.05)
+    gate.release()  # frees the slot the queued waiter is blocked on
+    t.join(5)
+    assert routes == ["batched"]
+    gate.release()
+    stats = gate.stats()
+    assert stats["admittedBatched"] == 1
+    assert stats["admittedHost"] == 0
+
+
+def test_phase_trace_thread_safe():
+    """The batcher accumulates phases into one trace from the submitting
+    thread AND the scheduler thread; concurrent adds must not lose time."""
+    trace = PhaseTrace()
+    n_threads, n_adds = 8, 500
+
+    def worker():
+        for _ in range(n_adds):
+            trace.add("x", 0.001)
+            with trace.phase("y"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    phases = trace.as_dict()
+    assert phases["x"] == pytest.approx(n_threads * n_adds * 0.001)
+    assert trace.total == pytest.approx(phases["x"] + phases["y"])

@@ -22,6 +22,19 @@ Scenarios:
                       answered 200, /health/ready 503 during drain,
                       child exits 0.
 
+Batcher group (``--group batcher``; micro-batching on — docs/OPS.md
+"Micro-batching"):
+
+- ``batch-coalesce``     a burst under a generous --batch-wait-ms —
+                         every request 200, /trace/last shows real
+                         coalescing (maxBatchSeen ≥ 2).
+- ``batch-demux-drop``   a seeded ``batcher_demux`` fault drops ONE
+                         demux slot — exactly that request 500s, its
+                         batchmates answer 200 untouched.
+- ``batch-device-fault`` an injected device fault fails a WHOLE batch —
+                         every member still answers 200 from the golden
+                         per-request fallback.
+
 Distributed group (``--group distributed``; needs a jax build whose CPU
 backend supports multi-process collectives — reported SKIP otherwise):
 
@@ -34,7 +47,8 @@ backend supports multi-process collectives — reported SKIP otherwise):
                         ``distributed``), and SIGTERM still shuts both
                         processes down cleanly.
 
-Usage: python tools/chaos_sweep.py [--only NAME] [--group base|distributed|all]
+Usage: python tools/chaos_sweep.py [--only NAME]
+                                   [--group base|batcher|distributed|all]
                                    [--keep-logs]
 """
 
@@ -239,6 +253,74 @@ def scenario_drain(srv: Server):
     assert saw_unready, "never observed /health/ready 503 during drain"
 
 
+# ----------------------------------------------------- batcher scenarios
+
+
+def scenario_batch_coalesce(srv: Server):
+    post(srv.url)  # warm: compile the R=1 batch program off the clock
+    results = Burst(srv.url, 6).join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    assert codes == [200] * 6, codes
+    _, trace = get(srv.url, "/trace/last")
+    b = trace["batcher"]
+    assert b["requestsBatched"] >= 7, b  # warm + burst all went through it
+    assert b["maxBatchSeen"] >= 2, f"burst never coalesced: {b}"
+    assert b["flushFull"] + b["flushWait"] >= 1, b
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_batch_demux_drop(srv: Server):
+    # two warm posts burn the fault's after=2 budget outside the burst
+    assert post(srv.url)[0] == 200
+    assert post(srv.url)[0] == 200
+    results = Burst(srv.url, 4).join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    # the dropped demux slot fails exactly ONE request; batchmates are
+    # untouched — the containment contract of runtime/batcher.py
+    assert codes == [200, 200, 200, 500], codes
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["batcher"]["demuxErrors"] == 1, trace["batcher"]
+    assert trace["faults"]["fired"]["batcher_demux_raise"] == 1, trace["faults"]
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_batch_device_fault(srv: Server):
+    post(srv.url)  # warm: one device call burns after=1
+    results = Burst(srv.url, 4).join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    # a whole-batch device failure serves every member from the golden
+    # host path — nobody sees a 500
+    assert codes == [200] * 4, codes
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["fallbackCount"] >= 1, trace["fallbackCount"]
+    assert trace["batcher"]["demuxErrors"] == 0, trace["batcher"]
+
+
+BATCHER_FLAGS = ["--batching", "on", "--batch-wait-ms", "200", "--batch-max", "8"]
+
+BATCHER_SCENARIOS = [
+    ("batch-coalesce", BATCHER_FLAGS, {}, scenario_batch_coalesce),
+    (
+        "batch-demux-drop",
+        BATCHER_FLAGS,
+        {
+            "LOG_PARSER_TPU_FAULTS": "batcher_demux_raise@times=1@after=2",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_batch_demux_drop,
+    ),
+    (
+        "batch-device-fault",
+        BATCHER_FLAGS,
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_raise@times=1@after=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_batch_device_fault,
+    ),
+]
+
+
 # ------------------------------------------------- distributed scenarios
 
 
@@ -403,7 +485,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="chaos_sweep")
     parser.add_argument("--only", help="run a single scenario by name")
     parser.add_argument(
-        "--group", choices=("base", "distributed", "all"), default="base",
+        "--group", choices=("base", "batcher", "distributed", "all"),
+        default="base",
         help="which scenario group to sweep (default: base; the "
         "distributed group needs multi-process CPU collective support)",
     )
@@ -415,8 +498,13 @@ def main(argv: list[str] | None = None) -> int:
 
     rows = []
     failed = 0
+    single_server = []
     if args.group in ("base", "all"):
-        for name, flags, env, check in SCENARIOS:
+        single_server.extend(SCENARIOS)
+    if args.group in ("batcher", "all"):
+        single_server.extend(BATCHER_SCENARIOS)
+    if single_server:
+        for name, flags, env, check in single_server:
             if args.only and name != args.only:
                 continue
             t0 = time.monotonic()

@@ -11,7 +11,9 @@ Checks (all files tracked by git, minus excluded dirs):
   3. YAML files parse;
   4. no file larger than 1 MiB enters the repo;
   5. every Python file compiles (syntax gate);
-  6. Python files use 4-space indentation, never tabs.
+  6. Python files use 4-space indentation, never tabs;
+  7. every serve-path flag declared in serve/__main__.py is documented in
+     docs/OPS.md (flag drift from new PRs fails the gate, not a reader).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -21,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import py_compile
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -105,6 +108,24 @@ def check_file(path: Path, fix: bool) -> list[str]:
     return problems
 
 
+def check_serve_flags_documented(root: Path) -> list[str]:
+    """Check 7: the operator-facing flag surface of ``serve/__main__.py``
+    must appear in docs/OPS.md (the serve-flags reference table). A
+    literal-substring check is deliberate — it catches a renamed or
+    undocumented flag without parsing argparse."""
+    src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops = root / "docs" / "OPS.md"
+    if not src.is_file() or not ops.is_file():
+        return []  # partial checkouts (pre-commit on a subset) skip this
+    flags = re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src.read_text())
+    ops_text = ops.read_text()
+    return [
+        f"{src}: serve flag {flag} is not documented in docs/OPS.md"
+        for flag in flags
+        if flag not in ops_text
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -123,6 +144,9 @@ def main() -> int:
     problems: list[str] = []
     for path in files:
         problems.extend(check_file(path, args.fix))
+    if not args.paths:
+        # repo-wide invariant, only meaningful on a full scan
+        problems.extend(check_serve_flags_documented(root))
 
     for p in problems:
         print(p)
