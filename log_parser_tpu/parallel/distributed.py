@@ -66,6 +66,9 @@ log = logging.getLogger(__name__)
 
 _SHUTDOWN = b"\x00shutdown"
 _PING = b"\x00ping"
+# reload-epoch broadcast: sentinel prefix + JSON {"epoch": N, "sets": [...]}
+# — followers rebuild the library and swap in lockstep (runtime/reload.py)
+_RELOAD = b"\x00reload:"
 
 
 def init_distributed(
@@ -244,10 +247,16 @@ class DistributedShardedEngine(ShardedEngine):
         broadcast + lockstep SPMD dispatch on every process, so two
         concurrent prepare phases would interleave their broadcasts and
         desync the mesh. Serialize the whole request instead (the
-        heartbeat probe serializes on the same lock)."""
+        heartbeat probe serializes on the same lock).
+
+        The request scope is entered BEFORE ``state_lock`` — the same
+        order :meth:`apply_library` relies on (quiesce, then lock). The
+        nested scope inside ``analyze`` is reentrant, so this costs one
+        thread-local increment."""
         if self._is_multiprocess():
-            with self.state_lock:
-                return self.analyze(data)
+            with self._request_scope():
+                with self.state_lock:
+                    return self.analyze(data)
         return super().analyze_pipelined(data)
 
     # ----------------------------------------------------- degrade-to-local
@@ -419,6 +428,9 @@ class DistributedShardedEngine(ShardedEngine):
                 )
                 t.allgather(row)
                 continue
+            if payload.startswith(_RELOAD):
+                self._apply_reload_payload(payload[len(_RELOAD):])
+                continue
             try:
                 d = json.loads(payload.decode("utf-8"))
                 data = PodFailureData(
@@ -444,6 +456,70 @@ class DistributedShardedEngine(ShardedEngine):
                 # same deterministic input and answered the client with a
                 # 500; the follower stays alive for the next request
                 log.exception("follower analyze failed")
+
+    def _apply_reload_payload(self, raw: bytes) -> None:
+        """Follower side of a reload-epoch broadcast: rebuild the library
+        from the serialized pattern sets and swap in lockstep with the
+        coordinator. The coordinator already canary-validated this exact
+        library, so the follower applies without its own canary; a
+        follower that still fails to build/apply keeps the old banks live
+        and counts the error — the next heartbeat ack carries the count
+        and the operator sees the epoch skew on /trace/last."""
+        from log_parser_tpu.models.pattern import PatternSet
+        from log_parser_tpu.runtime.engine import AnalysisEngine
+
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            sets = [PatternSet.from_dict(d) for d in doc["sets"]]
+            source = AnalysisEngine(sets, self.config)
+            self.apply_library(source)
+            log.info(
+                "follower %d: reload epoch %s applied (%d pattern set(s))",
+                transport().process_index(),
+                doc.get("epoch"),
+                len(sets),
+            )
+        except Exception:
+            self.follower_errors += 1
+            log.exception(
+                "follower reload failed (error #%d); old banks stay live",
+                self.follower_errors,
+            )
+
+    def broadcast_reload(self, sets) -> None:
+        """Coordinator side: ship the new library to every follower as one
+        reload-epoch broadcast. Runs inside apply_library's quiesced
+        critical section (see runtime/reload.py), so it can never
+        interleave with a request broadcast. A mesh that cannot take the
+        broadcast marks itself DEGRADED and the coordinator swaps alone —
+        degraded serving is coordinator-local, so responses stay
+        consistent until the group is re-seeded."""
+        if not (self._is_multiprocess() and self._is_coordinator()):
+            return
+        health = self.mesh_health
+        if health is not None and health.degraded:
+            return  # followers are already out of the serving path
+        payload = _RELOAD + json.dumps(
+            {
+                "epoch": self.reload_epoch + 1,
+                "sets": [s.to_dict() for s in sets],
+            }
+        ).encode("utf-8")
+        try:
+            self._dispatch_broadcast(payload, label="reload")
+        except MeshUnavailable as exc:
+            if health is not None:
+                health.declare_degraded(str(exc))
+            log.error(
+                "reload broadcast failed — mesh DEGRADED, coordinator "
+                "swaps alone: %s", exc,
+            )
+
+    def _install_library(self, source) -> None:
+        super()._install_library(source)
+        # the degrade-to-local step caches a program compiled against the
+        # old bank — rebuild lazily on next degraded request
+        self._local_step_cache = self._LOCAL_STEP_UNBUILT
 
     def shutdown_followers(self) -> None:
         if not (self._is_multiprocess() and self._is_coordinator()):

@@ -353,6 +353,15 @@ class ShardedEngine(AnalysisEngine):
         self.step = ShardedFusedStep(self.bank, self.config, mesh, self.matchers)
         self.tables = self.step.t
 
+    def _install_library(self, source) -> None:
+        # the SPMD program and its static tables are compiled against the
+        # bank — rebuild both on the swapped library (hot reload)
+        super()._install_library(source)
+        self.step = ShardedFusedStep(
+            self.bank, self.config, self.mesh, self.matchers
+        )
+        self.tables = self.step.t
+
     def _corpus_min_rows(self) -> int:
         # row padding must be divisible by the mesh size for shard_map
         return max(8, self.mesh.devices.size)

@@ -146,14 +146,20 @@ class MicroBatcher:
         """Blocking analyze-through-the-batcher: prepare on THIS thread,
         coalesce on the scheduler, return this request's result (or raise
         its per-request error). Semantics match ``analyze_pipelined``
-        request-for-request."""
-        pending = self._enqueue(data, deadline_ms)
-        if pending is None:  # closed: serve unbatched, same contract
-            return self.engine.analyze_pipelined(data)
-        pending.done.wait()
-        if pending.error is not None:
-            raise pending.error
-        return pending.result
+        request-for-request.
+
+        The whole call sits inside the engine's request scope: a pattern
+        reload that arrives after this request enqueued waits for its
+        demux, so already-enqueued batches always finish on the banks
+        they were prepared against."""
+        with self.engine._request_scope():
+            pending = self._enqueue(data, deadline_ms)
+            if pending is None:  # closed: serve unbatched, same contract
+                return self.engine.analyze_pipelined(data)
+            pending.done.wait()
+            if pending.error is not None:
+                raise pending.error
+            return pending.result
 
     # ------------------------------------------------------------- enqueue
 

@@ -375,6 +375,22 @@ class AnalysisEngine:
         # golden fallback) across transports; the prepare phase (ingest +
         # device) deliberately runs OUTSIDE it — see analyze_pipelined
         self.state_lock = threading.Lock()
+        # quiescence gate for hot pattern reload (runtime/reload.py):
+        # every request enters _request_scope; apply_library waits for
+        # active==0 and blocks NEW admissions while swapping, so in-flight
+        # (and already-enqueued batched) requests finish on the old banks
+        # and the next admission sees the new ones
+        self._quiesce_cv = threading.Condition()
+        self._active_requests = 0
+        self._swap_pending = False
+        self._scope_local = threading.local()
+        # durable frequency state (runtime/journal.py) — None until
+        # attach_journal(); reload bookkeeping for /trace/last
+        self.journal = None
+        self.reload_epoch = 0
+        self.reload_count = 0
+        self.reload_failures = 0
+        self.last_reload_error: str | None = None
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
         self.last_trace: PhaseTrace | None = None
@@ -701,6 +717,161 @@ class AnalysisEngine:
             self.frequency._load_state(saved_freq)
             raise
 
+    # ------------------------------------------- durable state + hot reload
+
+    @contextlib.contextmanager
+    def _request_scope(self):
+        """Count this thread as an active request for the duration.
+        Re-entrant per thread (batched submit degrades to pipelined, which
+        would otherwise self-deadlock against a pending swap); a pending
+        :meth:`apply_library` blocks NEW top-level entries until the swap
+        completes, and the swap waits until the count reaches zero."""
+        local = self._scope_local
+        if getattr(local, "depth", 0) > 0:
+            local.depth += 1
+            try:
+                yield
+            finally:
+                local.depth -= 1
+            return
+        with self._quiesce_cv:
+            while self._swap_pending:
+                self._quiesce_cv.wait()
+            self._active_requests += 1
+        local.depth = 1
+        try:
+            yield
+        finally:
+            local.depth = 0
+            with self._quiesce_cv:
+                self._active_requests -= 1
+                if self._active_requests == 0:
+                    self._quiesce_cv.notify_all()
+
+    def attach_journal(
+        self,
+        state_dir: str,
+        *,
+        fsync_ms: float = 50.0,
+        snapshot_every: int = 512,
+    ):
+        """Make frequency state durable: recover snapshot + journal tail
+        from ``state_dir``, swap in a journaling tracker, start group-fsync
+        and snapshot maintenance, and write the boot-baseline snapshot.
+        Registers a best-effort ``atexit`` flush for non-serve embeddings
+        (the serve path additionally flushes on SIGTERM drain)."""
+        import atexit
+
+        from log_parser_tpu.runtime.journal import (
+            DurableFrequencyTracker,
+            FrequencyJournal,
+        )
+
+        journal = FrequencyJournal(
+            state_dir, fsync_ms=fsync_ms, snapshot_every=snapshot_every
+        )
+        tracker = DurableFrequencyTracker(
+            self.config, self.frequency.clock, journal
+        )
+        pre = self.frequency._save_state()
+        if pre:
+            # warm attach (tests, embeddings): fold pre-attach in-memory
+            # entries into the recovered state; the _load_state barrier
+            # makes the merged state the journal's new truth
+            merged = tracker._save_state()
+            for pid, ts in pre.items():
+                merged[pid] = sorted(merged.get(pid, []) + list(ts))
+            tracker._load_state(merged)
+        with self.state_lock:
+            self.frequency = tracker
+            if self._golden is not None:
+                self._golden.frequency = tracker
+        self.journal = journal
+        journal.start(tracker.snapshot, self.state_lock)
+        # boot baseline: the recovered state becomes one durable snapshot
+        # and the replayed tail is truncated away
+        journal.snapshot_now()
+        atexit.register(journal.flush)
+        return journal
+
+    def _install_library(self, source: "AnalysisEngine") -> None:
+        """Transplant every library-derived component from ``source``
+        (a fully-built engine of the same class family). Caller holds the
+        state lock with the request gate quiesced. Subclasses with extra
+        device programs (pattern sharding) extend this."""
+        self.bank = source.bank
+        self.tables = source.tables
+        self._matchers = source._matchers
+        self._fused = source._fused
+        self._host_cols = source._host_cols
+        self._device_cols = source._device_cols
+        self._host_pref_cols = source._host_pref_cols
+        self._host_slow_cols = source._host_slow_cols
+        self._host_prefilter = source._host_prefilter
+        self._golden = None  # lazily rebuilt against the new bank
+        self._approx_pat_mask = None
+        self._approx_sec = None
+        self._approx_token = None
+        self._k_hint = 0
+
+    def apply_library(
+        self,
+        source: "AnalysisEngine",
+        timeout_s: float = 30.0,
+        pre_swap: Callable[[], None] | None = None,
+    ) -> int:
+        """Atomically swap this engine onto ``source``'s pattern library.
+
+        Admission of new requests pauses, in-flight (and already-enqueued
+        batched) requests drain on the OLD banks, then the swap happens
+        under the state lock; frequency entries for pattern ids surviving
+        into the new library carry over, the rest are dropped (their
+        windowed history is meaningless without the pattern). ``pre_swap``
+        runs inside the quiesced critical section — the distributed
+        coordinator broadcasts the reload there so no request broadcast
+        can interleave. Returns the new reload epoch."""
+        deadline = time.monotonic() + timeout_s
+        with self._quiesce_cv:
+            if self._swap_pending:
+                raise RuntimeError("another pattern reload is in progress")
+            self._swap_pending = True
+            try:
+                while self._active_requests > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"reload quiesce timed out after {timeout_s:g}s "
+                            f"({self._active_requests} request(s) in flight)"
+                        )
+                    self._quiesce_cv.wait(remaining)
+            except BaseException:
+                self._swap_pending = False
+                self._quiesce_cv.notify_all()
+                raise
+        try:
+            with self.state_lock:
+                if pre_swap is not None:
+                    pre_swap()
+                self._install_library(source)
+                survivors = set(self.bank.freq_ids)
+                for pid in list(self.frequency._frequencies):
+                    if pid not in survivors:
+                        del self.frequency._frequencies[pid]
+                if self.batcher is not None:
+                    from log_parser_tpu.ops.fused import FusedBatchMatchScore
+
+                    self.batcher.program = FusedBatchMatchScore(self.fused)
+                self.reload_epoch += 1
+                if self.journal is not None:
+                    # the carry-over pruning above bypassed the tracker's
+                    # journaling overrides; one barrier records the truth
+                    self.journal.append_barrier(self.frequency.snapshot())
+        finally:
+            with self._quiesce_cv:
+                self._swap_pending = False
+                self._quiesce_cv.notify_all()
+        return self.reload_epoch
+
     # --------------------------------------------------------------- analyze
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
@@ -751,11 +922,15 @@ class AnalysisEngine:
         serve/admission.py) — NOT because anything failed. Same frequency
         state, same rollback-on-failure invariant as the error fallback,
         separate counter."""
-        with self.state_lock:
+        with self._request_scope(), self.state_lock:
             self.host_routed_count += 1
             return self._golden_serve(data)
 
     def _analyze(self, data: PodFailureData, lock) -> AnalysisResult:
+        with self._request_scope():
+            return self._analyze_in_scope(data, lock)
+
+    def _analyze_in_scope(self, data: PodFailureData, lock) -> AnalysisResult:
         try:
             prepared = self._prepare(data)
         except Exception as exc:

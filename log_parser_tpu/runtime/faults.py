@@ -25,7 +25,14 @@ Each spec is ``<site>_<action>[:<arg>][@mod=value]*``:
   into per-request fallback), ``batcher_demux`` (per request during batch
   demux — a dropped demux slot fails ONE request, never its batchmates),
   ``batcher_oversize`` (armed ``raise`` makes the next flush take the
-  whole bucket past ``--batch-max`` — an oversized batch). Any string
+  whole bucket past ``--batch-max`` — an oversized batch),
+  ``journal`` (a WAL append failing — contained: the journal goes
+  unhealthy, the request is still served), ``journal_torn`` (write half
+  a frame then wedge the journal — the recovery-time torn-tail case),
+  ``snapshot`` (background snapshot write fails — the WAL is NOT
+  truncated, nothing is lost), ``reload_build`` / ``reload_canary``
+  (candidate library build / canary validation fails during a hot
+  reload — structured 409, the old banks keep serving). Any string
   works; sites are just names the code fires, see :func:`fire` call
   sites;
 - action: ``raise`` (raise :class:`InjectedFault`; at the ``device`` site

@@ -1,0 +1,488 @@
+"""Durable frequency state: write-ahead journal + atomic snapshots.
+
+The only state the engine *evolves* across requests is the per-pattern
+frequency window feeding the seven-factor score (FrequencyTrackingService
+in the reference; ``GoldenFrequencyTracker`` here). PR 2 made the on-disk
+*caches* crash-safe; this module makes the engine state itself crash-safe:
+
+- every frequency mutation appends one CRC-framed record to
+  ``journal.wal`` (write+flush per record so the bytes reach the OS page
+  cache immediately — ``kill -9`` semantics lose nothing — with *group*
+  fsync on a configurable interval so durability-to-platter does not sit
+  on the request path);
+- a background snapshotter periodically writes ``snapshot.json``
+  atomically (tmp + fsync + ``os.replace``; sha256 sidecar; mismatch
+  quarantined to ``.corrupt`` — the same discipline as patterns/libcache)
+  and truncates the journal;
+- on boot :class:`FrequencyJournal` restores the snapshot and replays the
+  journal tail, tolerating a torn final record (the torn bytes are
+  quarantined to ``journal.wal.torn`` and the file truncated to the last
+  whole frame — a crash mid-``write`` is an expected event, not
+  corruption).
+
+Records carry wall-clock time so replay is portable across processes:
+each match record is aged exactly like :meth:`GoldenFrequencyTracker
+.snapshot` ages live entries. The frequency window is *hours* wide, so
+the seconds of skew a crash/restart introduces cannot move a timestamp
+across the window boundary in any realistic deployment — windowed counts,
+and therefore scores, replay bit-identically.
+
+Fault sites (LOG_PARSER_TPU_FAULTS): ``journal`` (an append fails —
+contained: the request is still served, the journal marks itself
+unhealthy and /q/health degrades), ``journal_torn`` (the append writes a
+deliberately torn frame and the journal wedges so the torn frame stays
+final — the recovery drill), ``snapshot`` (the snapshotter aborts without
+truncating the journal — no state is lost, the journal just keeps
+growing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Callable
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import GoldenFrequencyTracker
+from log_parser_tpu.runtime import faults
+
+log = logging.getLogger(__name__)
+
+# frame header: little-endian payload length + CRC32 of the payload
+_FRAME = struct.Struct("<II")
+# sanity bound on a single record (a barrier carries a full snapshot)
+_MAX_PAYLOAD = 64 << 20
+
+SNAPSHOT_NAME = "snapshot.json"
+JOURNAL_NAME = "journal.wal"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename, then the sha256 sidecar (same publish
+    discipline as patterns/libcache — the sidecar window is two fsyncs
+    wide; recovery treats a mismatch as quarantine, never a crash)."""
+    directory = os.path.dirname(path) or "."
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    sum_tmp = path + ".sum.tmp"
+    with open(sum_tmp, "w", encoding="utf-8") as f:
+        f.write(hashlib.sha256(data).hexdigest() + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(sum_tmp, path + ".sum")
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - platform-specific directory fsync
+        pass
+
+
+class FrequencyJournal:
+    """CRC-framed WAL + snapshot pair under one state directory.
+
+    Thread contract: mutation appends happen under the engine state lock
+    (the tracker is only ever mutated there), so appends are serialized;
+    the maintenance thread synchronizes with appenders on ``_mu`` and
+    takes the engine state lock (``source_lock``) only to read a snapshot.
+    """
+
+    def __init__(
+        self,
+        state_dir: str,
+        *,
+        fsync_ms: float = 50.0,
+        snapshot_every: int = 512,
+        wall: Callable[[], float] = time.time,
+    ):
+        self.state_dir = str(state_dir)
+        self.fsync_ms = float(fsync_ms)
+        self.snapshot_every = int(snapshot_every)
+        self._wall = wall
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._snap_path = os.path.join(self.state_dir, SNAPSHOT_NAME)
+        self._wal_path = os.path.join(self.state_dir, JOURNAL_NAME)
+
+        self._mu = threading.Lock()
+        self.healthy = True
+        self.epoch = 0
+        self.records = 0  # appended this process
+        self.replayed = 0  # records replayed at boot
+        self.fsyncs = 0
+        self.snapshots = 0
+        self.write_errors = 0
+        self.snapshot_errors = 0
+        self.torn_tails = 0  # torn final records quarantined at boot
+        self.snapshot_corrupt = 0  # snapshots quarantined at boot
+        self._dirty = False
+        self._since_snapshot = 0
+        self._wedged = False  # a journal_torn fault leaves the torn frame final
+
+        self.recovered_ages: dict[str, list[float]] = self._recover()
+
+        self._fp = open(self._wal_path, "ab")
+        self._source: Callable[[], dict[str, list[float]]] | None = None
+        self._source_lock: threading.Lock | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ recovery
+
+    def _recover(self) -> dict[str, list[float]]:
+        now = self._wall()
+        state = self._load_snapshot(now)
+        for payload in self._replay_wal():
+            self._apply(state, payload, now)
+            self.replayed += 1
+        return state
+
+    def _load_snapshot(self, now: float) -> dict[str, list[float]]:
+        if not os.path.exists(self._snap_path):
+            return {}
+        try:
+            with open(self._snap_path, "rb") as f:
+                raw = f.read()
+            with open(self._snap_path + ".sum", "r", encoding="utf-8") as f:
+                want = f.read().strip()
+            if hashlib.sha256(raw).hexdigest() != want:
+                raise ValueError("sha256 mismatch")
+            doc = json.loads(raw.decode("utf-8"))
+            ages = doc["ages"]
+            if not isinstance(ages, dict):
+                raise ValueError("snapshot ages must be a mapping")
+            self.epoch = int(doc.get("epoch", 0))
+            wall = float(doc.get("wall", now))
+            drift = max(0.0, now - wall)
+            return {
+                str(pid): [max(0.0, float(a)) + drift for a in ages_list]
+                for pid, ages_list in ages.items()
+            }
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            self.snapshot_corrupt += 1
+            log.error("quarantining corrupt snapshot %s: %s", self._snap_path, exc)
+            try:
+                os.replace(self._snap_path, self._snap_path + ".corrupt")
+            except OSError:  # pragma: no cover - quarantine is best-effort
+                pass
+            try:
+                os.remove(self._snap_path + ".sum")
+            except OSError:
+                pass
+            return {}
+
+    def _replay_wal(self) -> list[dict]:
+        """Parse whole frames; a torn tail (short header, short payload, or
+        CRC mismatch on the FINAL frame) is quarantined and truncated away.
+        Corruption *before* the final frame also lands here: everything
+        from the first bad frame on is unreadable by construction, so the
+        honest move is the same quarantine + truncate."""
+        if not os.path.exists(self._wal_path):
+            return []
+        with open(self._wal_path, "rb") as f:
+            raw = f.read()
+        out: list[dict] = []
+        off = 0
+        while off + _FRAME.size <= len(raw):
+            length, crc = _FRAME.unpack_from(raw, off)
+            start = off + _FRAME.size
+            if length > _MAX_PAYLOAD or start + length > len(raw):
+                break
+            payload = raw[start:start + length]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                out.append(json.loads(payload.decode("utf-8")))
+            except ValueError:
+                break
+            off = start + length
+        if off < len(raw):
+            self.torn_tails += 1
+            torn = raw[off:]
+            log.warning(
+                "journal %s: torn tail of %d byte(s) after %d good record(s); "
+                "quarantining to .torn", self._wal_path, len(torn), len(out),
+            )
+            try:
+                with open(self._wal_path + ".torn", "ab") as f:
+                    f.write(torn)
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(off)
+            except OSError:  # pragma: no cover - quarantine is best-effort
+                log.exception("failed to quarantine torn journal tail")
+        return out
+
+    def _apply(self, state: dict[str, list[float]], payload: dict, now: float) -> None:
+        kind = payload.get("k")
+        if kind == "m":  # match: n timestamps at wall-clock w
+            pid = payload.get("id")
+            n = int(payload.get("n", 0))
+            if not pid or n <= 0:
+                return
+            age = max(0.0, now - float(payload.get("w", now)))
+            state.setdefault(str(pid), []).extend([age] * n)
+        elif kind == "r":  # reset one id (entry kept, emptied) or all
+            pid = payload.get("id")
+            if pid is None:
+                state.clear()
+            elif pid in state:
+                state[pid] = []
+        elif kind == "b":  # barrier: full-state replace (admin restore,
+            # rollback) — replay converges here regardless of the tail above
+            ages = payload.get("ages")
+            if not isinstance(ages, dict):
+                return
+            drift = max(0.0, now - float(payload.get("w", now)))
+            state.clear()
+            for pid, ages_list in ages.items():
+                state[str(pid)] = [max(0.0, float(a)) + drift for a in ages_list]
+        # unknown kinds are skipped: a newer writer's records must not
+        # brick an older reader
+
+    # ------------------------------------------------------------- appends
+
+    def append_match(self, pattern_id: str, n: int) -> None:
+        self._append({"k": "m", "id": pattern_id, "n": int(n), "w": self._wall()})
+
+    def append_reset(self, pattern_id: str | None) -> None:
+        self._append({"k": "r", "id": pattern_id, "w": self._wall()})
+
+    def append_barrier(self, ages: dict[str, list[float]]) -> None:
+        self._append({"k": "b", "ages": ages, "w": self._wall()})
+
+    def _append(self, payload_obj: dict) -> None:
+        """One framed record: write+flush (OS page cache) now, fsync later
+        on the group interval. NEVER raises into the request path — any
+        failure marks the journal unhealthy for /q/health instead."""
+        fp = self._fp
+        if fp is None or self._wedged:
+            return
+        try:
+            faults.fire("journal")
+        except faults.InjectedFault:
+            self.write_errors += 1
+            self.healthy = False
+            return
+        torn = False
+        try:
+            faults.fire("journal_torn")
+        except faults.InjectedFault:
+            torn = True
+        payload = json.dumps(payload_obj, separators=(",", ":")).encode("utf-8")
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        if torn:
+            # write a deliberately torn frame and wedge: the torn frame
+            # must stay FINAL for recovery to exercise the truncate path
+            frame = frame[: _FRAME.size + max(0, len(payload) // 2)]
+        try:
+            with self._mu:
+                if torn:
+                    self._wedged = True
+                    self.healthy = False
+                fp.write(frame)
+                fp.flush()
+                self._dirty = True
+                if not torn:
+                    self.records += 1
+                    self._since_snapshot += 1
+        except (OSError, ValueError) as exc:
+            self.write_errors += 1
+            self.healthy = False
+            log.error("journal append failed: %s", exc)
+
+    # --------------------------------------------------------- maintenance
+
+    def start(
+        self,
+        source: Callable[[], dict[str, list[float]]],
+        source_lock: threading.Lock,
+    ) -> None:
+        """Begin group-fsync + periodic-snapshot maintenance. ``source``
+        reads the live tracker's portable snapshot; it is called under
+        ``source_lock`` (the engine state lock) so it never races a
+        request's finish phase."""
+        self._source = source
+        self._source_lock = source_lock
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._maintain, name="freq-journal", daemon=True
+            )
+            self._thread.start()
+
+    def _maintain(self) -> None:
+        interval = max(0.001, self.fsync_ms / 1000.0)
+        while not self._stop.wait(interval):
+            self.flush()
+            if self._since_snapshot >= self.snapshot_every:
+                self.snapshot_now()
+
+    def flush(self) -> None:
+        """Group fsync: durability point for everything appended so far.
+        Called on the interval, on SIGTERM drain, and at interpreter exit."""
+        try:
+            with self._mu:
+                fp = self._fp
+                if fp is None or not self._dirty:
+                    return
+                fp.flush()
+                os.fsync(fp.fileno())
+                self._dirty = False
+                self.fsyncs += 1
+        except (OSError, ValueError) as exc:
+            self.write_errors += 1
+            self.healthy = False
+            log.error("journal fsync failed: %s", exc)
+
+    def snapshot_now(self) -> bool:
+        """Write an atomic snapshot of the live tracker and truncate the
+        journal. An injected/organic failure aborts WITHOUT truncating —
+        the journal keeps the full tail, nothing is lost."""
+        source, lock = self._source, self._source_lock
+        if source is None or lock is None or self._fp is None:
+            return False
+        with lock:
+            ages = source()
+        try:
+            faults.fire("snapshot")
+            doc = {
+                "version": 1,
+                "epoch": self.epoch + 1,
+                "wall": self._wall(),
+                "ages": ages,
+            }
+            _atomic_write(
+                self._snap_path,
+                json.dumps(doc, separators=(",", ":")).encode("utf-8"),
+            )
+        except (faults.InjectedFault, OSError, ValueError) as exc:
+            self.snapshot_errors += 1
+            log.error("snapshot aborted (journal NOT truncated): %s", exc)
+            return False
+        # snapshot + sidecar durable -> the journal tail is now redundant
+        try:
+            with self._mu:
+                fp = self._fp
+                if fp is None:
+                    return False
+                fp.flush()
+                fp.truncate(0)
+                os.fsync(fp.fileno())
+                self._dirty = False
+                self._since_snapshot = 0
+                self.epoch += 1
+                self.snapshots += 1
+        except (OSError, ValueError) as exc:
+            self.write_errors += 1
+            self.healthy = False
+            log.error("journal truncate failed: %s", exc)
+            return False
+        return True
+
+    # ------------------------------------------------------------ shutdown
+
+    def close(self) -> None:
+        """Clean shutdown: stop maintenance, flush, close. After this a
+        boot needs no replay beyond reading the (already-durable) tail."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.flush()
+        with self._mu:
+            fp, self._fp = self._fp, None
+            if fp is not None:
+                try:
+                    fp.close()
+                except OSError:  # pragma: no cover
+                    pass
+
+    def abandon(self) -> None:
+        """Crash simulation for tests: stop maintenance and drop the file
+        handle WITHOUT the final fsync/snapshot. Because every append
+        already write+flushed to the OS page cache, this is byte-for-byte
+        what a ``kill -9`` leaves behind."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._mu:
+            fp, self._fp = self._fp, None
+            if fp is not None:
+                try:
+                    fp.close()  # per-append flush means no buffered bytes
+                except OSError:  # pragma: no cover
+                    pass
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "stateDir": self.state_dir,
+                "healthy": self.healthy,
+                "epoch": self.epoch,
+                "records": self.records,
+                "replayed": self.replayed,
+                "fsyncs": self.fsyncs,
+                "snapshots": self.snapshots,
+                "writeErrors": self.write_errors,
+                "snapshotErrors": self.snapshot_errors,
+                "tornTails": self.torn_tails,
+                "snapshotCorrupt": self.snapshot_corrupt,
+            }
+
+
+class DurableFrequencyTracker(GoldenFrequencyTracker):
+    """GoldenFrequencyTracker whose every mutation is journaled. Dropped
+    in as ``engine.frequency`` by :meth:`AnalysisEngine.attach_journal`;
+    all mutation channels (fused finish phase, golden per-match recording,
+    admin reset/restore, rollback ``_load_state``) route through the four
+    overrides below, so nothing escapes the WAL."""
+
+    def __init__(self, config: ScoringConfig, clock, journal: FrequencyJournal):
+        super().__init__(config, clock=clock)
+        self.journal = journal
+        if journal.recovered_ages:
+            # bypass the journaling restore() override: recovery replays
+            # the log, it must not extend it
+            GoldenFrequencyTracker.restore(self, journal.recovered_ages)
+
+    def record_pattern_matches(self, pattern_id: str | None, n: int) -> None:
+        if n <= 0 or pattern_id is None or pattern_id.strip() == "":
+            return  # mirror the base guard so no-op calls stay un-journaled
+        super().record_pattern_matches(pattern_id, n)
+        self.journal.append_match(pattern_id, n)
+
+    def reset_pattern_frequency(self, pattern_id: str) -> None:
+        super().reset_pattern_frequency(pattern_id)
+        self.journal.append_reset(pattern_id)
+
+    def reset_all_frequencies(self) -> None:
+        super().reset_all_frequencies()
+        self.journal.append_reset(None)
+
+    def restore(self, ages: dict[str, list[float]]) -> None:
+        """Admin restore writes a journal *barrier* (full-state replace):
+        a crash immediately afterwards recovers the restored state, never
+        the pre-restore tail. Validation failures raise before the barrier
+        — a rejected restore leaves the journal untouched."""
+        super().restore(ages)
+        self.journal.append_barrier(self.snapshot())
+
+    def _load_state(self, state: dict[str, list[float]]) -> None:
+        """Rollback path (request crash containment, batch demux). A
+        barrier makes replay converge to the rolled-back state even though
+        the aborted request's match records already hit the journal."""
+        super()._load_state(state)
+        self.journal.append_barrier(self.snapshot())

@@ -1,0 +1,343 @@
+"""Zero-downtime pattern-library hot reload, canary-gated.
+
+The reference service can only change its pattern library by restarting
+the JVM (PatternService loads once at boot); production log-parsing
+fleets roll pattern changes into *running* processes (PAPERS.md — the
+Dynatrace DPL conversion pipeline, CelerLog's dynamic routing). Here the
+swap is made safe in three stages:
+
+1. **build off to the side** — a fresh :class:`AnalysisEngine` compiles
+   the new MatcherBanks/DfaBank/fused ladder without touching the live
+   engine (fault site ``reload_build``);
+2. **canary-validate** — the fresh engine's *device* output is compared
+   event-for-event (line, pattern id, score to 1e-9) against a fresh
+   golden host engine on a built-in validation corpus, augmented with
+   lines synthesized from the new library's own required literals so new
+   patterns actually fire (fault site ``reload_canary``);
+3. **atomic swap** — :meth:`AnalysisEngine.apply_library` quiesces the
+   request gate (in-flight and already-enqueued batched requests finish
+   on the old banks), swaps every library-derived component under the
+   state lock, carries frequency entries of surviving pattern ids over,
+   and bumps the reload epoch. On a distributed coordinator the epoch is
+   broadcast inside the quiesced section so followers swap in lockstep
+   (or the mesh marks itself DEGRADED).
+
+Any failure in stages 1-2 raises :class:`ReloadError` and the live
+engine is untouched — the HTTP layer turns that into a structured 409.
+
+``PatternWatcher`` is the ``--watch-patterns`` mtime poller: the same
+reload path, triggered by an on-disk change to the pattern directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import yaml
+
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.patterns.loader import load_pattern_directory
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.engine import AnalysisEngine
+
+log = logging.getLogger(__name__)
+
+# Built-in validation corpus: generic log shapes that exercise ingest,
+# context extraction, severity scoring, and the sequence/proximity paths
+# regardless of which library is being loaded. Library-specific lines are
+# synthesized from the new bank's literals at canary time.
+VALIDATION_LOGS = """\
+2024-01-01T00:00:00Z INFO startup: service listening on :8080
+2024-01-01T00:00:01Z WARN disk usage at 91% on /var/lib
+2024-01-01T00:00:02Z ERROR OOMKilled: container exceeded memory limit
+java.lang.OutOfMemoryError: Java heap space
+    at com.example.Worker.process(Worker.java:42)
+    at com.example.Main.run(Main.java:17)
+2024-01-01T00:00:03Z ERROR connection refused: upstream db:5432
+2024-01-01T00:00:04Z FATAL CrashLoopBackOff: back-off restarting failed container
+2024-01-01T00:00:05Z WARN retrying request (attempt 3/5)
+2024-01-01T00:00:06Z ERROR java.net.SocketTimeoutException: Read timed out
+2024-01-01T00:00:07Z INFO health probe ok
+"""
+
+_SCORE_TOL = 1e-9
+_MAX_LITERAL_LINES = 64
+
+
+class ReloadError(Exception):
+    """A pattern reload rejected before the swap — the live engine is
+    untouched. ``stage`` is ``"build"``, ``"canary"``, or ``"swap"``."""
+
+    def __init__(self, stage: str, reason: str):
+        super().__init__(f"pattern reload failed at {stage}: {reason}")
+        self.stage = stage
+        self.reason = reason
+
+    def to_json(self) -> dict:
+        return {"error": "reload rejected", "stage": self.stage,
+                "reason": self.reason}
+
+
+def parse_yaml_sets(text: str) -> list[PatternSet]:
+    """Pattern sets from an inline YAML body (one document per set, or
+    one document holding a list of set mappings). Raises ReloadError on
+    anything malformed — inline bodies fail loudly, unlike the directory
+    walk's log-and-skip (an operator POSTing a library wants the error)."""
+    try:
+        docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    except yaml.YAMLError as exc:
+        raise ReloadError("build", f"invalid YAML: {exc}") from exc
+    flat: list[dict] = []
+    for doc in docs:
+        items = doc if isinstance(doc, list) else [doc]
+        for item in items:
+            if not isinstance(item, dict):
+                raise ReloadError(
+                    "build", f"pattern set must be a mapping, got {type(item).__name__}"
+                )
+            flat.append(item)
+    if not flat:
+        raise ReloadError("build", "no pattern sets in body")
+    try:
+        return [PatternSet.from_dict(d) for d in flat]
+    except Exception as exc:
+        raise ReloadError("build", f"invalid pattern set: {exc}") from exc
+
+
+def canary_corpus(bank) -> str:
+    """The built-in corpus plus one synthetic line per pattern embedding
+    a required literal from its primary column — so a brand-new pattern
+    demonstrably fires through the device path before it goes live."""
+    lines = [VALIDATION_LOGS]
+    emitted = 0
+    for p in range(bank.n_patterns):
+        if emitted >= _MAX_LITERAL_LINES:
+            break
+        col = bank.columns[int(bank.primary_columns[p])]
+        if not col.literals:
+            continue
+        lit = min(col.literals, key=lambda l: (len(l.text), l.text))
+        try:
+            text = lit.text.decode("ascii")
+        except UnicodeDecodeError:
+            continue
+        lines.append(f"canary probe {text} end\n")
+        emitted += 1
+    return "".join(lines)
+
+
+def build_candidate(
+    sets: list[PatternSet], config, engine_clock=None
+) -> AnalysisEngine:
+    """Stage 1: compile the new library entirely off to the side."""
+    try:
+        faults.fire("reload_build")
+        if not sets:
+            raise ValueError("no pattern sets")
+        source = AnalysisEngine(
+            sets, config, clock=engine_clock or time.monotonic
+        )
+        # canary must not hide device failures behind the host fallback
+        source.fallback_to_golden = False
+        return source
+    except ReloadError:
+        raise
+    except Exception as exc:
+        raise ReloadError("build", str(exc)) from exc
+
+
+def canary_validate(source: AnalysisEngine) -> int:
+    """Stage 2: run the candidate's device pipeline against a fresh golden
+    host engine on the validation corpus; any divergence (count, line,
+    pattern id, score beyond 1e-9) rejects the library. Both sides start
+    from empty frequency state, so frequency evolution is identical by
+    construction. Returns the number of events validated."""
+    from log_parser_tpu.golden.engine import GoldenAnalyzer
+
+    try:
+        faults.fire("reload_canary")
+        data = PodFailureData(
+            pod="reload-canary",
+            logs=canary_corpus(source.bank),
+            events=None,
+        )
+        got = source.analyze(data)
+        want = GoldenAnalyzer(source.bank.pattern_sets, source.config).analyze(data)
+    except ReloadError:
+        raise
+    except Exception as exc:
+        raise ReloadError("canary", str(exc)) from exc
+    if len(got.events) != len(want.events):
+        raise ReloadError(
+            "canary",
+            f"device produced {len(got.events)} event(s), golden "
+            f"{len(want.events)}",
+        )
+    for i, (g, w) in enumerate(zip(got.events, want.events)):
+        if g.line_number != w.line_number:
+            raise ReloadError(
+                "canary",
+                f"event {i}: line {g.line_number} != golden {w.line_number}",
+            )
+        gid = g.matched_pattern.id if g.matched_pattern else None
+        wid = w.matched_pattern.id if w.matched_pattern else None
+        if gid != wid:
+            raise ReloadError(
+                "canary", f"event {i}: pattern {gid!r} != golden {wid!r}"
+            )
+        if abs(g.score - w.score) > _SCORE_TOL:
+            raise ReloadError(
+                "canary",
+                f"event {i}: score {g.score!r} != golden {w.score!r}",
+            )
+    return len(got.events)
+
+
+class PatternReloader:
+    """The full reload pipeline against one live engine. Serialized on an
+    internal lock: concurrent reload requests queue rather than racing
+    two builds (the second sees the first's epoch in its response)."""
+
+    def __init__(self, engine: AnalysisEngine, pattern_dir: str | None = None):
+        self.engine = engine
+        self.pattern_dir = pattern_dir
+        self._lock = threading.Lock()
+
+    def reload(
+        self,
+        *,
+        pattern_dir: str | None = None,
+        yaml_text: str | None = None,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """Build + canary + swap. Raises :class:`ReloadError` (engine
+        untouched) on any failure; returns the success envelope."""
+        with self._lock:
+            engine = self.engine
+            try:
+                if yaml_text is not None:
+                    sets = parse_yaml_sets(yaml_text)
+                else:
+                    directory = pattern_dir or self.pattern_dir
+                    if not directory:
+                        raise ReloadError(
+                            "build", "no pattern directory configured and no "
+                            "inline YAML body",
+                        )
+                    sets = load_pattern_directory(directory)
+                    if not sets:
+                        raise ReloadError(
+                            "build", f"no pattern sets loaded from {directory!r}"
+                        )
+                source = build_candidate(
+                    sets, engine.config, engine_clock=engine.frequency.clock
+                )
+                validated = canary_validate(source)
+                pre_swap = None
+                broadcast = getattr(engine, "broadcast_reload", None)
+                if callable(broadcast):
+                    pre_swap = lambda: broadcast(sets)  # noqa: E731
+                try:
+                    epoch = engine.apply_library(
+                        source, timeout_s=timeout_s, pre_swap=pre_swap
+                    )
+                except (TimeoutError, RuntimeError) as exc:
+                    raise ReloadError("swap", str(exc)) from exc
+            except ReloadError as exc:
+                engine.reload_failures += 1
+                engine.last_reload_error = str(exc)
+                log.error("%s (old banks stay live)", exc)
+                raise
+            engine.reload_count += 1
+            engine.last_reload_error = None
+            log.info(
+                "pattern library reloaded: epoch %d, %d set(s), %d "
+                "pattern(s), %d canary event(s)",
+                epoch, len(sets), source.bank.n_patterns, validated,
+            )
+            return {
+                "status": "reloaded",
+                "epoch": epoch,
+                "patternSets": len(sets),
+                "patterns": source.bank.n_patterns,
+                "canaryEvents": validated,
+            }
+
+
+class PatternWatcher:
+    """``--watch-patterns``: poll the pattern directory's latest mtime
+    and run the reload pipeline when it changes. A failed reload (canary
+    rejection, mid-edit broken YAML) is logged and retried on the NEXT
+    mtime change — the old banks serve throughout."""
+
+    def __init__(
+        self,
+        reloader: PatternReloader,
+        directory: str,
+        interval_s: float = 2.0,
+    ):
+        self.reloader = reloader
+        self.directory = directory
+        self.interval_s = interval_s
+        self.reload_attempts = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_sig = self._signature()
+
+    def _signature(self) -> tuple:
+        """(path, mtime_ns, size) of every pattern file — catches edits,
+        adds, and deletes without hashing content on every poll."""
+        sig = []
+        try:
+            for root, _dirs, files in sorted(
+                (r, d, f) for r, d, f in os.walk(self.directory)
+            ):
+                for name in sorted(files):
+                    if not name.endswith((".yml", ".yaml")):
+                        continue
+                    path = os.path.join(root, name)
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        continue
+                    sig.append((path, st.st_mtime_ns, st.st_size))
+        except OSError:
+            pass
+        return tuple(sig)
+
+    def start(self) -> "PatternWatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="pattern-watch", daemon=True
+            )
+            self._thread.start()
+            log.info(
+                "watching %s for pattern changes (every %gs)",
+                self.directory, self.interval_s,
+            )
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            sig = self._signature()
+            if sig == self._last_sig:
+                continue
+            self._last_sig = sig
+            self.reload_attempts += 1
+            try:
+                self.reloader.reload(pattern_dir=self.directory)
+            except ReloadError:
+                # already logged with stage + reason; old banks stay live
+                pass
+            except Exception:
+                log.exception("pattern watcher reload failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

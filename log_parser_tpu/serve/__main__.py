@@ -124,6 +124,28 @@ def main(argv: list[str] | None = None) -> int:
         help="PRNG seed for probabilistic fault specs "
         "(LOG_PARSER_TPU_FAULT_SEED)",
     )
+    # durable state + hot reload (docs/OPS.md "State durability & recovery")
+    parser.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="directory for the frequency WAL + snapshots; enables crash "
+        "recovery across restarts (LOG_PARSER_TPU_STATE_DIR)",
+    )
+    parser.add_argument(
+        "--journal-fsync-ms", type=float, default=None, metavar="MS",
+        help="group-fsync interval for the frequency journal "
+        "(LOG_PARSER_TPU_JOURNAL_FSYNC_MS)",
+    )
+    parser.add_argument(
+        "--snapshot-every", type=int, default=None,
+        help="journal records between background snapshots; a snapshot "
+        "truncates the WAL (LOG_PARSER_TPU_SNAPSHOT_EVERY)",
+    )
+    parser.add_argument(
+        "--watch-patterns", type=float, default=None, metavar="SECONDS",
+        help="poll the pattern directory at this interval and hot-reload "
+        "on change (canary-gated, runtime/reload.py); 0 disables "
+        "(LOG_PARSER_TPU_WATCH_PATTERNS)",
+    )
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
@@ -141,6 +163,10 @@ def main(argv: list[str] | None = None) -> int:
         (args.broadcast_retries, "LOG_PARSER_TPU_BROADCAST_RETRIES"),
         (args.heartbeat_s, "LOG_PARSER_TPU_HEARTBEAT_S"),
         (args.dead_after, "LOG_PARSER_TPU_DEAD_AFTER"),
+        (args.state_dir, "LOG_PARSER_TPU_STATE_DIR"),
+        (args.journal_fsync_ms, "LOG_PARSER_TPU_JOURNAL_FSYNC_MS"),
+        (args.snapshot_every, "LOG_PARSER_TPU_SNAPSHOT_EVERY"),
+        (args.watch_patterns, "LOG_PARSER_TPU_WATCH_PATTERNS"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -251,6 +277,30 @@ def main(argv: list[str] | None = None) -> int:
         engine.follower_loop()
         return 0
 
+    # durable frequency state: recover + journal under --state-dir.
+    # Followers never reach this point (follower_loop above), so in
+    # distributed mode only the coordinator journals — its tracker is the
+    # canonical one; followers converge from the broadcast replay.
+    journal = None
+    state_dir = os.environ.get("LOG_PARSER_TPU_STATE_DIR")
+    if state_dir:
+        journal = engine.attach_journal(
+            state_dir,
+            fsync_ms=float(
+                os.environ.get("LOG_PARSER_TPU_JOURNAL_FSYNC_MS", "50")
+            ),
+            snapshot_every=int(
+                os.environ.get("LOG_PARSER_TPU_SNAPSHOT_EVERY", "512")
+            ),
+        )
+        log.info(
+            "Frequency journal at %s: epoch %d, %d record(s) replayed%s",
+            state_dir,
+            journal.epoch,
+            journal.replayed,
+            ", torn tail quarantined" if journal.torn_tails else "",
+        )
+
     try:
         server = make_server(engine, args.host, args.port)
     except OSError:
@@ -266,7 +316,24 @@ def main(argv: list[str] | None = None) -> int:
     # sequence below runs — including the follower sentinel in distributed
     # mode, which therefore always lands AFTER the drain, never
     # mid-broadcast (the analyze lock covers the straggler case).
-    install_drain_handlers(server, server.admission, log)
+    install_drain_handlers(
+        server,
+        server.admission,
+        log,
+        on_drained=None if journal is None else journal.flush,
+    )
+    # canary-gated hot reload: POST /patterns/reload re-reads this
+    # directory (or takes inline YAML); --watch-patterns polls it
+    from log_parser_tpu.runtime.reload import PatternReloader, PatternWatcher
+
+    server.reloader = PatternReloader(engine, config.pattern_directory)
+    watch_s = float(os.environ.get("LOG_PARSER_TPU_WATCH_PATTERNS", "0"))
+    if watch_s > 0:
+        server.watcher = PatternWatcher(
+            server.reloader, config.pattern_directory, interval_s=watch_s
+        )
+        server.watcher.start()
+        log.info("Watching %s every %.1fs", config.pattern_directory, watch_s)
     if args.coordinator:
         # follower liveness probe + degraded-mesh readmission; serializes
         # with request broadcasts on the engine's state_lock
@@ -279,9 +346,16 @@ def main(argv: list[str] | None = None) -> int:
         log.info("Shutting down")
     finally:
         server.server_close()
+        if server.watcher is not None:
+            server.watcher.stop()
         if engine.batcher is not None:
             # flush anything still queued before the process exits
             engine.batcher.close()
+        if journal is not None:
+            # fold the WAL tail into one final durable snapshot — a clean
+            # shutdown must never need replay on the next boot
+            journal.snapshot_now()
+            journal.close()
         if args.coordinator:
             # under the analyze lock: a daemon handler thread may still be
             # mid-broadcast inside analyze(); interleaving the shutdown

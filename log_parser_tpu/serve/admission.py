@@ -248,12 +248,19 @@ def shared_gate(engine) -> AdmissionController:
         return gate
 
 
-def install_drain_handlers(server, gate, log, on_second_signal=None):
+def install_drain_handlers(
+    server, gate, log, on_second_signal=None, on_drained=None
+):
     """Route SIGTERM/SIGINT through the drain path: flip the gate (readiness
     goes 503, new work refused), let in-flight requests finish up to the
     drain deadline, then stop ``server``'s accept loop — ``serve_forever``
     returns and the caller's normal shutdown sequence (follower sentinel,
     server_close) runs exactly as on a clean exit, never mid-request.
+
+    ``on_drained`` runs after the in-flight wait, before the accept loop
+    stops — the journal flush hook: every frequency record the drained
+    requests appended is fsync'd before the process exits (a clean
+    shutdown must never need replay).
 
     A second signal skips the wait and stops immediately. Returns the
     handler (so tests can invoke it without a real signal). Must be called
@@ -271,12 +278,24 @@ def install_drain_handlers(server, gate, log, on_second_signal=None):
                 gate.drain_deadline_s,
                 gate.inflight,
             )
+        if on_drained is not None:
+            try:
+                on_drained()
+            except Exception:
+                log.exception("on_drained hook failed; stopping anyway")
         server.shutdown()
 
     def _handler(signum, frame):
         state["signals"] += 1
         if state["signals"] > 1:
             log.info("second signal: stopping immediately")
+            if on_drained is not None:
+                # best-effort durability even on an impatient operator's
+                # double ^C — a flush is milliseconds
+                try:
+                    on_drained()
+                except Exception:
+                    log.exception("on_drained hook failed on second signal")
             if on_second_signal is not None:
                 on_second_signal()
             server.shutdown()

@@ -49,6 +49,11 @@ from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 log = logging.getLogger(__name__)
 
 _INVALID = b'{"error":"Invalid PodFailureData provided"}'
+# admin bodies (/patterns/reload, /frequency/restore) are operator input,
+# not parse traffic — bound them so a runaway payload cannot balloon the
+# process before validation even starts
+_ADMIN_MAX_BODY = 4 << 20
+_TOO_LARGE = b'{"error":"payload too large"}'
 
 
 class ParseServer(ThreadingHTTPServer):
@@ -66,6 +71,18 @@ class ParseServer(ThreadingHTTPServer):
         # away (GET /trace/last "droppedResponses")
         self.dropped_responses = 0
         self._drop_lock = threading.Lock()
+        # hot pattern reload (runtime/reload.py): set by serve/__main__.py
+        # (or lazily on the first POST /patterns/reload); the watcher is
+        # the optional --watch-patterns poller, stopped with the server
+        self.reloader = None
+        self.watcher = None
+
+    def get_reloader(self):
+        from log_parser_tpu.runtime.reload import PatternReloader
+
+        if self.reloader is None:
+            self.reloader = PatternReloader(self.engine)
+        return self.reloader
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -106,13 +123,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if self.path == "/parse":
             return self._parse()
+        if self.path == "/patterns/reload":
+            return self._patterns_reload()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
                 length = int(self.headers.get("Content-Length", 0))
+                if length > _ADMIN_MAX_BODY:
+                    return self._send_json(413, _TOO_LARGE)
                 ages = json.loads(self.rfile.read(length) if length else b"{}")
             except ValueError:
                 return self._send_json(400, bad)
+            # versioned envelope (the GET /frequency/snapshot shape) and
+            # the legacy bare mapping both restore; the envelope's epoch
+            # is informational — restore is state, not history
+            if (
+                isinstance(ages, dict)
+                and isinstance(ages.get("ages"), dict)
+                and set(ages) <= {"ages", "epoch"}
+            ):
+                ages = ages["ages"]
             # validate the FULL shape before touching state: restore must be
             # all-or-nothing, never partial. Negative ages are future
             # timestamps that never prune — rejected.
@@ -123,8 +153,16 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 return self._send_json(400, bad)
             with self.server.analyze_lock:
+                # a journal-backed tracker writes a barrier record here: a
+                # crash right after this response still recovers the
+                # restored state, not the pre-restore tail
                 self.server.engine.frequency.restore(ages)
-            return self._send_json(200, b'{"status":"restored"}')
+            journal = self.server.engine.journal
+            epoch = 0 if journal is None else journal.epoch
+            return self._send_json(
+                200,
+                json.dumps({"status": "restored", "epoch": epoch}).encode(),
+            )
         if self.path == "/frequency/reset":
             with self.server.analyze_lock:
                 self.server.engine.frequency.reset_all_frequencies()
@@ -135,6 +173,35 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.engine.frequency.reset_pattern_frequency(pattern_id)
             return self._send_json(200, b'{"status":"reset"}')
         self._send_json(404, b'{"error":"not found"}')
+
+    def _patterns_reload(self) -> None:
+        """Canary-gated hot reload (runtime/reload.py). Empty body: re-read
+        the configured pattern directory. Non-empty body: inline YAML
+        pattern sets. Any build/canary failure is a structured 409 and the
+        live engine is untouched — in-flight requests never notice."""
+        from log_parser_tpu.runtime.reload import ReloadError
+
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > _ADMIN_MAX_BODY:
+                return self._send_json(413, _TOO_LARGE)
+            body = self.rfile.read(length) if length else b""
+        except ValueError:
+            return self._send_json(400, b'{"error":"bad request body"}')
+        try:
+            yaml_text = body.decode("utf-8") if body.strip() else None
+        except UnicodeDecodeError:
+            return self._send_json(400, b'{"error":"body is not UTF-8"}')
+        try:
+            envelope = self.server.get_reloader().reload(yaml_text=yaml_text)
+        except ReloadError as exc:
+            return self._send_json(409, json.dumps(exc.to_json()).encode())
+        except Exception:
+            log.exception("pattern reload failed")
+            return self._send_json(
+                500, b'{"error":"Internal reload failure"}'
+            )
+        return self._send_json(200, json.dumps(envelope).encode())
 
     def do_GET(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
@@ -155,6 +222,11 @@ class _Handler(BaseHTTPRequestHandler):
             mesh = getattr(self.server.engine, "mesh_health", None)
             if mesh is not None and mesh.degraded:
                 checks.append({"name": "mesh", "status": "DEGRADED"})
+            journal = self.server.engine.journal
+            if journal is not None and not journal.healthy:
+                # requests still serve, but frequency durability is gone:
+                # a crash now loses the un-journaled tail
+                checks.append({"name": "journal", "status": "DEGRADED"})
             if checks:
                 return self._send_json(
                     200, json.dumps({"status": "UP", "checks": checks}).encode()
@@ -167,7 +239,12 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/frequency/snapshot":
             with self.server.analyze_lock:
                 snap = self.server.engine.frequency.snapshot()
-            return self._send_json(200, json.dumps(snap).encode())
+            journal = self.server.engine.journal
+            epoch = 0 if journal is None else journal.epoch
+            # versioned envelope; POST /frequency/restore accepts it as-is
+            return self._send_json(
+                200, json.dumps({"epoch": epoch, "ages": snap}).encode()
+            )
         if self.path == "/trace/last":
             trace = self.server.engine.last_trace
             payload = {"phasesMs": {}, "totalMs": 0.0} if trace is None else {
@@ -192,6 +269,16 @@ class _Handler(BaseHTTPRequestHandler):
                 # follower liveness + degrade-to-local counters
                 # (docs/OPS.md "Distributed failure modes")
                 payload["distributed"] = mesh.stats()
+            journal = self.server.engine.journal
+            if journal is not None:
+                # WAL/snapshot counters (docs/OPS.md "State durability")
+                payload["journal"] = journal.stats()
+            payload["reload"] = {
+                "epoch": self.server.engine.reload_epoch,
+                "count": self.server.engine.reload_count,
+                "failures": self.server.engine.reload_failures,
+                "lastError": self.server.engine.last_reload_error,
+            }
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats

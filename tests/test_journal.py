@@ -1,0 +1,452 @@
+"""Durable frequency state (runtime/journal.py).
+
+The contract under test: with ``--state-dir`` attached, NO crash loses
+frequency state — ``kill -9`` mid-stream resumes with windowed counts
+and scores identical to an uninterrupted run. ``journal.abandon()`` is
+the in-process crash: appends write+flush to the OS page cache, so
+closing the fd without the final fsync/snapshot leaves byte-for-byte
+what SIGKILL leaves (a genuine subprocess SIGKILL run is the slow-marked
+test at the bottom). Torn final records are an EXPECTED crash artifact:
+quarantined to ``.torn``, never an error.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import SnapshotValidationError
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.journal import (
+    JOURNAL_NAME,
+    SNAPSHOT_NAME,
+    DurableFrequencyTracker,
+    FrequencyJournal,
+)
+from tests.conftest import FakeClock
+from tests.helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _journal(tmp_path, **kw) -> FrequencyJournal:
+    return FrequencyJournal(str(tmp_path), **kw)
+
+
+def _wal(tmp_path) -> str:
+    return os.path.join(str(tmp_path), JOURNAL_NAME)
+
+
+def _snap(tmp_path) -> str:
+    return os.path.join(str(tmp_path), SNAPSHOT_NAME)
+
+
+# ------------------------------------------------------------ WAL framing
+
+
+class TestWalReplay:
+    def test_round_trip(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_match("a", 2)
+        j.append_match("b", 1)
+        j.append_reset("a")  # entry kept, timestamps cleared
+        j.abandon()
+
+        j2 = _journal(tmp_path)
+        assert j2.replayed == 3
+        assert j2.torn_tails == 0
+        assert set(j2.recovered_ages) == {"a", "b"}
+        assert j2.recovered_ages["a"] == []
+        assert len(j2.recovered_ages["b"]) == 1
+        assert j2.recovered_ages["b"][0] >= 0.0
+        j2.abandon()
+
+    def test_reset_all(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_match("a", 1)
+        j.append_reset(None)
+        j.abandon()
+        j2 = _journal(tmp_path)
+        assert j2.recovered_ages == {}
+        j2.abandon()
+
+    def test_barrier_replaces_everything_before_it(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_match("a", 5)
+        j.append_barrier({"c": [7.0]})
+        j.abandon()
+        j2 = _journal(tmp_path)
+        assert set(j2.recovered_ages) == {"c"}
+        assert len(j2.recovered_ages["c"]) == 1
+        assert j2.recovered_ages["c"][0] >= 7.0
+        j2.abandon()
+
+    def test_torn_short_payload_quarantined(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_match("a", 1)
+        j.append_match("b", 1)
+        j.abandon()
+        good_size = os.path.getsize(_wal(tmp_path))
+        with open(_wal(tmp_path), "ab") as f:
+            # header promises 64 payload bytes; only 4 follow — a crash
+            # mid-write
+            f.write(struct.pack("<II", 64, 0) + b"torn")
+
+        j2 = _journal(tmp_path)
+        assert j2.replayed == 2
+        assert j2.torn_tails == 1
+        assert os.path.exists(_wal(tmp_path) + ".torn")
+        assert os.path.getsize(_wal(tmp_path)) == good_size
+        j2.abandon()
+
+        # the truncated journal is clean: a second boot replays quietly
+        j3 = _journal(tmp_path)
+        assert j3.replayed == 2 and j3.torn_tails == 0
+        j3.abandon()
+
+    def test_crc_mismatch_tail_quarantined(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_match("a", 1)
+        j.append_match("b", 1)
+        j.abandon()
+        with open(_wal(tmp_path), "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+
+        j2 = _journal(tmp_path)
+        assert j2.replayed == 1  # the flipped record is gone, not fatal
+        assert j2.torn_tails == 1
+        assert set(j2.recovered_ages) == {"a"}
+        j2.abandon()
+
+
+# ------------------------------------------------------------ fault sites
+
+
+class TestFaultSites:
+    def test_journal_fault_contained(self, tmp_path):
+        faults.install(FaultRegistry.parse("journal_raise@times=1"))
+        j = _journal(tmp_path)
+        j.append_match("a", 1)  # must NOT raise into the request path
+        assert j.healthy is False
+        assert j.write_errors == 1
+        j.append_match("b", 1)  # budget spent: appends work again
+        j.abandon()
+        j2 = _journal(tmp_path)
+        assert set(j2.recovered_ages) == {"b"}
+        j2.abandon()
+
+    def test_journal_torn_fault_wedges(self, tmp_path):
+        faults.install(FaultRegistry.parse("journal_torn_raise@after=1"))
+        j = _journal(tmp_path)
+        j.append_match("a", 1)  # clean
+        j.append_match("b", 1)  # written torn; journal wedges
+        j.append_match("c", 1)  # wedged: ignored, torn frame stays final
+        assert j.healthy is False
+        j.abandon()
+
+        faults.install(None)
+        j2 = _journal(tmp_path)
+        assert j2.replayed == 1
+        assert j2.torn_tails == 1
+        assert set(j2.recovered_ages) == {"a"}
+        j2.abandon()
+
+    def test_snapshot_fault_preserves_wal(self, tmp_path):
+        import threading
+
+        j = _journal(tmp_path, fsync_ms=10_000)
+        j.append_match("a", 3)
+        j._source = lambda: {"a": [0.0] * 3}
+        j._source_lock = threading.Lock()
+        wal_size = os.path.getsize(_wal(tmp_path))
+        faults.install(FaultRegistry.parse("snapshot_raise@times=1"))
+        assert j.snapshot_now() is False
+        assert j.snapshot_errors == 1
+        assert j.epoch == 0
+        assert os.path.getsize(_wal(tmp_path)) == wal_size  # NOT truncated
+        assert not os.path.exists(_snap(tmp_path))
+        # budget spent: the next snapshot succeeds and truncates
+        assert j.snapshot_now() is True
+        assert j.epoch == 1
+        assert os.path.getsize(_wal(tmp_path)) == 0
+        j.close()
+
+
+# -------------------------------------------------------------- snapshots
+
+
+class TestSnapshots:
+    def test_rotation_and_recovery(self, tmp_path):
+        import threading
+
+        j = _journal(tmp_path, fsync_ms=10_000)
+        j._source = lambda: {"a": [1.5, 3.0]}
+        j._source_lock = threading.Lock()
+        j.append_match("a", 2)
+        assert j.snapshot_now() is True
+        assert os.path.getsize(_wal(tmp_path)) == 0
+        assert os.path.exists(_snap(tmp_path))
+        assert os.path.exists(_snap(tmp_path) + ".sum")
+        j.append_match("b", 1)  # post-snapshot tail
+        j.abandon()
+
+        j2 = _journal(tmp_path)
+        assert j2.epoch == 1
+        assert j2.replayed == 1
+        assert set(j2.recovered_ages) == {"a", "b"}
+        assert len(j2.recovered_ages["a"]) == 2
+        assert all(a >= 1.5 for a in j2.recovered_ages["a"])
+        j2.abandon()
+
+    def test_corrupt_snapshot_quarantined(self, tmp_path):
+        import threading
+
+        j = _journal(tmp_path, fsync_ms=10_000)
+        j._source = lambda: {"a": [1.0]}
+        j._source_lock = threading.Lock()
+        assert j.snapshot_now() is True
+        j.append_match("b", 1)
+        j.abandon()
+        with open(_snap(tmp_path), "r+b") as f:
+            f.write(b"\x00\x00\x00\x00")
+
+        j2 = _journal(tmp_path)
+        assert j2.snapshot_corrupt == 1
+        assert os.path.exists(_snap(tmp_path) + ".corrupt")
+        # boot survives on the journal tail alone
+        assert set(j2.recovered_ages) == {"b"}
+        j2.abandon()
+
+
+# ------------------------------------------------------- durable tracker
+
+
+class TestDurableTracker:
+    def _tracker(self, tmp_path, clock=None):
+        j = _journal(tmp_path, fsync_ms=10_000)
+        return DurableFrequencyTracker(ScoringConfig(), clock or FakeClock(), j), j
+
+    def test_mutations_survive_crash(self, tmp_path):
+        t, j = self._tracker(tmp_path)
+        t.record_pattern_matches("oom", 3)
+        t.record_pattern_matches("conn", 1)
+        t.reset_pattern_frequency("conn")
+        j.abandon()
+
+        t2, j2 = self._tracker(tmp_path)
+        assert t2.get_frequency_statistics() == {"oom": 3, "conn": 0}
+        j2.abandon()
+
+    def test_noop_mutations_not_journaled(self, tmp_path):
+        t, j = self._tracker(tmp_path)
+        t.record_pattern_matches(None, 5)
+        t.record_pattern_matches("", 5)
+        t.record_pattern_matches("a", 0)
+        assert j.records == 0
+        j.abandon()
+
+    def test_restore_barrier_survives_crash(self, tmp_path):
+        t, j = self._tracker(tmp_path)
+        t.record_pattern_matches("old", 9)
+        t.restore({"new": [2.0]})
+        j.abandon()
+        t2, j2 = self._tracker(tmp_path)
+        assert t2.get_frequency_statistics() == {"new": 1}
+        j2.abandon()
+
+    def test_rejected_restore_leaves_journal_untouched(self, tmp_path):
+        t, j = self._tracker(tmp_path)
+        t.record_pattern_matches("a", 2)
+        with pytest.raises(SnapshotValidationError):
+            t.restore({"bad": [-1.0]})
+        j.abandon()
+        t2, j2 = self._tracker(tmp_path)
+        assert t2.get_frequency_statistics() == {"a": 2}
+        j2.abandon()
+
+
+# ----------------------------------------------- crash-recovery parity
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom",
+                    regex="OutOfMemoryError",
+                    confidence=0.9,
+                    severity="CRITICAL",
+                    secondaries=[("GC overhead", 0.3, 10)],
+                    context=(1, 1),
+                ),
+                make_pattern("conn", regex="Connection refused", confidence=0.7),
+                make_pattern("fatal", regex="FATAL", confidence=0.8),
+            ]
+        )
+    ]
+
+
+REQUESTS = [
+    "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after",
+    "WARN x\nConnection refused\nFATAL crash",
+    "java.lang.OutOfMemoryError: heap\nGC overhead limit exceeded",
+    "Connection refused\njava.lang.OutOfMemoryError: heap\nFATAL boom",
+]
+
+
+def _pod(logs: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "crash"}}, logs=logs)
+
+
+def _events(result) -> list[tuple]:
+    return [
+        (
+            e.line_number,
+            e.matched_pattern.id if e.matched_pattern else None,
+            e.score,
+        )
+        for e in result.events
+    ]
+
+
+class TestCrashRecoveryParity:
+    """N requests, hard-kill at every phase boundary, restart on the same
+    state dir, run the remainder: final scores and frequency stats must
+    be bit-identical to one uninterrupted engine taking all N."""
+
+    def _control(self):
+        engine = AnalysisEngine(_sets(), ScoringConfig())
+        results = [engine.analyze(_pod(logs)) for logs in REQUESTS]
+        return _events(results[-1]), engine.frequency.get_frequency_statistics()
+
+    @pytest.mark.parametrize("crash_after", [0, 1, 2, 3])
+    def test_kill9_parity_unbatched(self, tmp_path, crash_after):
+        want_events, want_stats = self._control()
+
+        first = AnalysisEngine(_sets(), ScoringConfig())
+        first.attach_journal(str(tmp_path), fsync_ms=10_000)
+        for logs in REQUESTS[:crash_after]:
+            first.analyze(_pod(logs))
+        first.journal.abandon()  # kill -9: no flush, no final snapshot
+
+        second = AnalysisEngine(_sets(), ScoringConfig())
+        second.attach_journal(str(tmp_path), fsync_ms=10_000)
+        results = [second.analyze(_pod(logs)) for logs in REQUESTS[crash_after:]]
+        assert _events(results[-1]) == want_events
+        assert second.frequency.get_frequency_statistics() == want_stats
+        second.journal.abandon()
+
+    def test_kill9_parity_batched(self, tmp_path):
+        """Same contract with the micro-batcher attached on both sides of
+        the crash (sequential submits: deterministic enqueue order)."""
+        want_events, want_stats = self._control()
+
+        first = AnalysisEngine(_sets(), ScoringConfig())
+        first.attach_journal(str(tmp_path), fsync_ms=10_000)
+        first.enable_batching(wait_ms=1.0)
+        for logs in REQUESTS[:2]:
+            first.analyze_batched(_pod(logs))
+        first.batcher.close()
+        first.journal.abandon()
+
+        second = AnalysisEngine(_sets(), ScoringConfig())
+        second.attach_journal(str(tmp_path), fsync_ms=10_000)
+        second.enable_batching(wait_ms=1.0)
+        results = [second.analyze_batched(_pod(logs)) for logs in REQUESTS[2:]]
+        assert _events(results[-1]) == want_events
+        assert second.frequency.get_frequency_statistics() == want_stats
+        second.batcher.close()
+        second.journal.abandon()
+
+    def test_torn_final_record_parity(self, tmp_path):
+        """A crash that tears the last record loses ONLY that request's
+        frequency contribution — and the torn bytes are quarantined, not
+        fatal. (The chaos sweep drives the same path through a live
+        server; this pins the arithmetic.)"""
+        first = AnalysisEngine(_sets(), ScoringConfig())
+        first.attach_journal(str(tmp_path), fsync_ms=10_000)
+        first.analyze(_pod(REQUESTS[0]))
+        # request 2's (single) match record is written torn
+        faults.install(FaultRegistry.parse("journal_torn_raise@times=1"))
+        first.analyze(_pod(REQUESTS[0]))
+        faults.install(None)
+        first.journal.abandon()
+
+        second = AnalysisEngine(_sets(), ScoringConfig())
+        second.attach_journal(str(tmp_path), fsync_ms=10_000)
+        assert second.journal.torn_tails == 1
+        assert os.path.exists(_wal(tmp_path) + ".torn")
+        # only request 1's record survived — the control is a single run
+        control = AnalysisEngine(_sets(), ScoringConfig())
+        control.analyze(_pod(REQUESTS[0]))
+        assert (
+            second.frequency.get_frequency_statistics()
+            == control.frequency.get_frequency_statistics()
+        )
+        second.journal.abandon()
+
+
+@pytest.mark.slow
+class TestSubprocessSigkill:
+    """The genuine article: a separate interpreter hard-killed by SIGKILL
+    mid-stream, recovered by this process from the same state dir."""
+
+    def test_sigkill_replay_parity(self, tmp_path):
+        state = str(tmp_path / "state")
+        child = textwrap.dedent(
+            f"""
+            import os, signal
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import sys
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+            from log_parser_tpu.config import ScoringConfig
+            from log_parser_tpu.models.pod import PodFailureData
+            from log_parser_tpu.runtime import AnalysisEngine
+            from tests.test_journal import REQUESTS, _sets
+            engine = AnalysisEngine(_sets(), ScoringConfig())
+            engine.attach_journal({state!r}, fsync_ms=10000)
+            for logs in REQUESTS[:2]:
+                engine.analyze(
+                    PodFailureData(pod={{"metadata": {{"name": "crash"}}}}, logs=logs)
+                )
+            os.kill(os.getpid(), signal.SIGKILL)
+            """
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=300,
+        )
+        assert proc.returncode == -signal.SIGKILL
+
+        engine = AnalysisEngine(_sets(), ScoringConfig())
+        engine.attach_journal(state, fsync_ms=10_000)
+        results = [engine.analyze(_pod(logs)) for logs in REQUESTS[2:]]
+
+        control = AnalysisEngine(_sets(), ScoringConfig())
+        control_results = [control.analyze(_pod(logs)) for logs in REQUESTS]
+        assert _events(results[-1]) == _events(control_results[-1])
+        assert (
+            engine.frequency.get_frequency_statistics()
+            == control.frequency.get_frequency_statistics()
+        )
+        engine.journal.abandon()

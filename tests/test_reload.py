@@ -1,0 +1,346 @@
+"""Zero-downtime pattern hot reload (runtime/reload.py).
+
+The rollback invariant under test everywhere: any failure at any stage
+(parse, build, canary, swap) leaves the live engine byte-for-byte
+untouched — same bank OBJECT, same frequency stats, same scores — and
+a retry after the failure succeeds. Success swaps atomically under the
+quiescence gate: concurrent (batched) requests all complete, none fail.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.reload import (
+    PatternReloader,
+    PatternWatcher,
+    ReloadError,
+    parse_yaml_sets,
+)
+from log_parser_tpu.serve import make_server
+from tests.helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _yaml(sets) -> str:
+    return "\n---\n".join(yaml.safe_dump(s.to_dict(drop_none=True)) for s in sets)
+
+
+def _v1_sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                             severity="CRITICAL"),
+                make_pattern("conn", regex="Connection refused", confidence=0.7),
+            ],
+            "lib-v1",
+        )
+    ]
+
+
+def _v2_sets():
+    # "oom" survives, "conn" is dropped, "disk" is new
+    return [
+        make_pattern_set(
+            [
+                make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                             severity="CRITICAL"),
+                make_pattern("disk", regex="No space left on device",
+                             confidence=0.8, severity="HIGH"),
+            ],
+            "lib-v2",
+        )
+    ]
+
+
+def _pod(logs: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "reload"}}, logs=logs)
+
+
+def _engine() -> AnalysisEngine:
+    return AnalysisEngine(_v1_sets(), ScoringConfig())
+
+
+MIXED = (
+    "INFO boot\n"
+    "java.lang.OutOfMemoryError: heap\n"
+    "Connection refused\n"
+    "No space left on device\n"
+)
+
+
+def _matched_ids(result) -> set:
+    return {
+        e.matched_pattern.id for e in result.events if e.matched_pattern
+    }
+
+
+# --------------------------------------------------------- parse_yaml_sets
+
+
+class TestParseYamlSets:
+    def test_multi_document_and_list_forms(self):
+        text = _yaml(_v1_sets() + _v2_sets())
+        assert [s.metadata.library_id for s in parse_yaml_sets(text)] == [
+            "lib-v1", "lib-v2",
+        ]
+        as_list = yaml.safe_dump(
+            [s.to_dict(drop_none=True) for s in _v1_sets() + _v2_sets()]
+        )
+        assert len(parse_yaml_sets(as_list)) == 2
+
+    @pytest.mark.parametrize(
+        "text,reason_part",
+        [
+            ("{unclosed: [", "invalid YAML"),
+            ("just a scalar", "must be a mapping"),
+            ("- 1\n- 2\n", "must be a mapping"),
+            ("", "no pattern sets"),
+            ("---\n---\n", "no pattern sets"),
+            ("metadata: {library_id: x}\npatterns: 7\n", "invalid pattern set"),
+        ],
+    )
+    def test_malformed_body_raises_build_error(self, text, reason_part):
+        with pytest.raises(ReloadError) as err:
+            parse_yaml_sets(text)
+        assert err.value.stage == "build"
+        assert reason_part in err.value.reason
+        assert err.value.to_json()["error"] == "reload rejected"
+
+
+# ----------------------------------------------------------- swap contract
+
+
+class TestReloadSwap:
+    def test_swap_replaces_banks_and_bumps_epoch(self):
+        engine = _engine()
+        before = _matched_ids(engine.analyze(_pod(MIXED)))
+        assert before == {"oom", "conn"}
+
+        envelope = PatternReloader(engine).reload(yaml_text=_yaml(_v2_sets()))
+        assert envelope["status"] == "reloaded"
+        assert envelope["epoch"] == 1 == engine.reload_epoch
+        assert envelope["patternSets"] == 1
+        assert envelope["patterns"] == 2
+        assert envelope["canaryEvents"] > 0
+        assert engine.reload_count == 1 and engine.reload_failures == 0
+
+        after = _matched_ids(engine.analyze(_pod(MIXED)))
+        assert after == {"oom", "disk"}  # old pattern gone, new one live
+
+    def test_frequency_carries_over_for_survivors_only(self):
+        engine = _engine()
+        engine.analyze(_pod(MIXED))  # oom: 1, conn: 1
+        assert engine.frequency.get_frequency_statistics() == {
+            "oom": 1, "conn": 1,
+        }
+        PatternReloader(engine).reload(yaml_text=_yaml(_v2_sets()))
+        # the survivor keeps its history; the dropped id is pruned, the
+        # new id starts cold
+        assert engine.frequency.get_frequency_statistics() == {"oom": 1}
+        engine.analyze(_pod(MIXED))
+        assert engine.frequency.get_frequency_statistics() == {
+            "oom": 2, "disk": 1,
+        }
+
+    @pytest.mark.parametrize("site", ["reload_build", "reload_canary"])
+    def test_injected_failure_rolls_back_untouched(self, site):
+        engine = _engine()
+        before_events = [
+            (e.line_number, e.score) for e in engine.analyze(_pod(MIXED)).events
+        ]
+        bank_before = engine.bank
+        stats_before = engine.frequency.get_frequency_statistics()
+        reloader = PatternReloader(engine)
+
+        faults.install(FaultRegistry.parse(f"{site}_raise@times=1"))
+        with pytest.raises(ReloadError) as err:
+            reloader.reload(yaml_text=_yaml(_v2_sets()))
+        assert err.value.stage == ("build" if site == "reload_build" else "canary")
+        assert engine.bank is bank_before  # the same object: no partial swap
+        assert engine.reload_epoch == 0
+        assert engine.reload_failures == 1
+        assert engine.last_reload_error is not None
+        assert engine.frequency.get_frequency_statistics() == stats_before
+        # served results are unchanged after the rollback
+        again = [
+            (e.line_number, e.score) for e in engine.analyze(_pod(MIXED)).events
+        ]
+        assert again == before_events
+
+        # fault budget spent: the retry goes through
+        envelope = reloader.reload(yaml_text=_yaml(_v2_sets()))
+        assert envelope["epoch"] == 1
+        assert engine.last_reload_error is None
+
+    def test_reload_under_concurrent_batched_load(self):
+        """The acceptance gate: a swap while batched requests are in
+        flight — every request completes, none fail, and requests that
+        entered before the swap score on the OLD banks."""
+        engine = _engine()
+        engine.enable_batching(wait_ms=2.0, batch_max=4)
+        reloader = PatternReloader(engine)
+        errors: list = []
+        results: list = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    results.append(engine.analyze_batched(_pod(MIXED)))
+                except Exception as exc:  # noqa: BLE001 - any failure fails the test
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.1)  # requests genuinely in flight
+            envelope = reloader.reload(yaml_text=_yaml(_v2_sets()))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+        try:
+            assert errors == []
+            assert envelope["epoch"] == 1
+            assert engine.reload_failures == 0
+            assert results  # the hammers did real work
+            # after the dust settles the new library serves
+            assert _matched_ids(engine.analyze_batched(_pod(MIXED))) == {
+                "oom", "disk",
+            }
+        finally:
+            engine.batcher.close()
+
+
+# ------------------------------------------------------------ HTTP contract
+
+
+def _post(url: str, body: bytes):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/x-yaml"}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestReloadEndpoint:
+    @pytest.fixture()
+    def server_url(self):
+        server = make_server(_engine(), host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        server.server_close()
+
+    def test_valid_body_swaps_and_answers_envelope(self, server_url):
+        status, body = _post(
+            server_url + "/patterns/reload", _yaml(_v2_sets()).encode()
+        )
+        assert status == 200
+        assert body["status"] == "reloaded" and body["epoch"] == 1
+
+    def test_invalid_yaml_is_structured_409(self, server_url):
+        status, body = _post(server_url + "/patterns/reload", b"{unclosed: [")
+        assert status == 409
+        assert body["error"] == "reload rejected"
+        assert body["stage"] == "build"
+        assert "invalid YAML" in body["reason"]
+
+    def test_empty_body_without_pattern_dir_is_409(self, server_url):
+        status, body = _post(server_url + "/patterns/reload", b"")
+        assert status == 409 and body["stage"] == "build"
+
+    def test_non_utf8_body_is_400(self, server_url):
+        status, body = _post(server_url + "/patterns/reload", b"\xff\xfe\x00ok")
+        assert status == 400
+        assert body == {"error": "body is not UTF-8"}
+
+    def test_oversized_body_is_413(self, server_url):
+        """The cap rejects on declared Content-Length BEFORE reading the
+        body (a runaway payload must not balloon the process), so speak
+        raw HTTP: send only the head and read the immediate 413."""
+        import socket
+
+        host, port = server_url[len("http://"):].rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=30) as sock:
+            sock.sendall(
+                b"POST /patterns/reload HTTP/1.1\r\n"
+                b"Host: test\r\n"
+                b"Content-Length: %d\r\n"
+                b"Connection: close\r\n\r\n" % ((4 << 20) + 1)
+            )
+            raw = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                raw = raw + chunk
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        assert b" 413 " in head.split(b"\r\n", 1)[0]
+        assert json.loads(payload) == {"error": "payload too large"}
+
+
+# ----------------------------------------------------------------- watcher
+
+
+class TestPatternWatcher:
+    def test_directory_edit_triggers_reload(self, tmp_path):
+        path = tmp_path / "lib.yaml"
+        path.write_text(_yaml(_v1_sets()))
+        engine = _engine()
+        watcher = PatternWatcher(
+            PatternReloader(engine, str(tmp_path)), str(tmp_path),
+            interval_s=0.05,
+        )
+        watcher.start()
+        try:
+            path.write_text(_yaml(_v2_sets()))
+            deadline = time.monotonic() + 60.0
+            while engine.reload_epoch == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert engine.reload_epoch == 1
+            assert watcher.reload_attempts >= 1
+            assert _matched_ids(engine.analyze(_pod(MIXED))) == {"oom", "disk"}
+        finally:
+            watcher.stop()
+
+    def test_broken_edit_keeps_old_banks_until_fixed(self, tmp_path):
+        path = tmp_path / "lib.yaml"
+        path.write_text(_yaml(_v1_sets()))
+        engine = _engine()
+        reloader = PatternReloader(engine, str(tmp_path))
+        watcher = PatternWatcher(reloader, str(tmp_path), interval_s=0.05)
+        watcher.start()
+        try:
+            path.write_text("{unclosed: [")  # an operator mid-edit
+            deadline = time.monotonic() + 60.0
+            while watcher.reload_attempts == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert engine.reload_epoch == 0  # old banks still serving
+            assert _matched_ids(engine.analyze(_pod(MIXED))) == {"oom", "conn"}
+        finally:
+            watcher.stop()

@@ -167,7 +167,7 @@ class TestFrequencyRestoreValidation:
         status, body = post(
             fresh_server + "/frequency/restore", {"oom": [0.0, 12.5]}
         )
-        assert status == 200 and body == {"status": "restored"}
+        assert status == 200 and body == {"status": "restored", "epoch": 0}
         _, stats = get(fresh_server + "/frequency/stats")
         assert stats == {"oom": 2}  # replaced, not merged: "err" is gone
 

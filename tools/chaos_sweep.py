@@ -35,6 +35,26 @@ Batcher group (``--group batcher``; micro-batching on — docs/OPS.md
                          every member still answers 200 from the golden
                          per-request fallback.
 
+State group (``--group state``; durable frequency state + hot reload —
+docs/OPS.md "State durability & recovery"):
+
+- ``state-kill9-replay``     N requests, SIGKILL mid-stream, restart on
+                             the same ``--state-dir``, remainder — final
+                             frequency stats and scores identical to an
+                             uninterrupted run.
+- ``state-torn-tail``        a ``journal_torn`` fault leaves half a
+                             frame as the WAL's final bytes — the
+                             restart quarantines it to ``.torn``,
+                             replays every whole record, and serves.
+- ``state-canary-rollback``  an injected ``reload_canary`` fault turns
+                             ``POST /patterns/reload`` into a structured
+                             409 — the old banks keep serving, scores
+                             unchanged; the next reload (budget spent)
+                             succeeds.
+- ``state-reload-under-load``  a concurrent burst of batched requests
+                             races a hot reload — zero failed requests,
+                             the reload completes, epoch bumps.
+
 Distributed group (``--group distributed``; needs a jax build whose CPU
 backend supports multi-process collectives — reported SKIP otherwise):
 
@@ -48,7 +68,7 @@ backend supports multi-process collectives — reported SKIP otherwise):
                         processes down cleanly.
 
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|distributed|all]
+                                   [--group base|batcher|state|distributed|all]
                                    [--keep-logs]
 """
 
@@ -321,6 +341,189 @@ BATCHER_SCENARIOS = [
 ]
 
 
+# ------------------------------------------------------- state scenarios
+
+
+def post_raw(url: str, path: str, data: bytes, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url + path, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _final_scores(body: dict) -> list:
+    return [
+        (ev.get("lineNumber"), ev.get("matchedPattern", {}).get("id"),
+         ev.get("score"))
+        for ev in body.get("events", [])
+    ]
+
+
+def scenario_state_kill9_replay():
+    """Crash-recovery parity, operator-grade: a server hard-killed after
+    3 requests and restarted on the same --state-dir must end (after 2
+    more) with the same frequency stats and the same last-response scores
+    as one uninterrupted server that took all 5."""
+    with tempfile.TemporaryDirectory(prefix="chaos_state_") as tmp:
+        crash_dir = os.path.join(tmp, "crash")
+        control_dir = os.path.join(tmp, "control")
+
+        srv = Server("state-kill9-a", ["--state-dir", crash_dir], {})
+        srv.wait_ready()
+        for _ in range(3):
+            assert post(srv.url)[0] == 200
+        srv.proc.kill()  # SIGKILL: no drain, no final snapshot
+        srv.proc.wait(30)
+        log_a = srv.log.name
+
+        srv2 = Server("state-kill9-b", ["--state-dir", crash_dir], {})
+        try:
+            srv2.wait_ready()
+            _, trace = get(srv2.url, "/trace/last")
+            j = trace["journal"]
+            # the kill-9 tail was replayed (or already folded into the
+            # boot snapshot of run A — either way nothing was lost)
+            assert j["stateDir"] == crash_dir, j
+            for _ in range(1):
+                assert post(srv2.url)[0] == 200
+            status, last_body, _ = post(srv2.url)
+            assert status == 200
+            _, crashed_stats = get(srv2.url, "/frequency/stats")
+        finally:
+            srv2.stop()
+
+        control = Server("state-kill9-control", ["--state-dir", control_dir], {})
+        try:
+            control.wait_ready()
+            for _ in range(4):
+                assert post(control.url)[0] == 200
+            status, control_body, _ = post(control.url)
+            assert status == 200
+            _, control_stats = get(control.url, "/frequency/stats")
+        finally:
+            control.stop()
+
+        assert crashed_stats == control_stats, (crashed_stats, control_stats)
+        assert _final_scores(last_body) == _final_scores(control_body), (
+            last_body, control_body
+        )
+        for path in (log_a, srv2.log.name, control.log.name):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def scenario_state_torn_tail():
+    """A crash mid-append leaves half a frame as the WAL's final bytes.
+    The fault writes exactly that (then wedges the journal so it stays
+    final); the restart must quarantine the torn bytes, replay every
+    whole record, and serve."""
+    with tempfile.TemporaryDirectory(prefix="chaos_state_") as tmp:
+        state_dir = os.path.join(tmp, "state")
+        srv = Server(
+            "state-torn-a",
+            ["--state-dir", state_dir, "--snapshot-every", "100000"],
+            {
+                # 3rd append (request 3's match record) is written torn
+                "LOG_PARSER_TPU_FAULTS": "journal_torn_raise@after=2",
+                "LOG_PARSER_TPU_FAULT_SEED": "42",
+            },
+        )
+        srv.wait_ready()
+        for _ in range(4):
+            assert post(srv.url)[0] == 200
+        srv.proc.kill()
+        srv.proc.wait(30)
+        log_a = srv.log.name
+
+        srv2 = Server("state-torn-b", ["--state-dir", state_dir], {})
+        try:
+            srv2.wait_ready()
+            assert os.path.exists(os.path.join(state_dir, "journal.wal.torn"))
+            _, trace = get(srv2.url, "/trace/last")
+            assert trace["journal"]["tornTails"] == 1, trace["journal"]
+            assert trace["journal"]["healthy"] is True, trace["journal"]
+            assert post(srv2.url)[0] == 200
+        finally:
+            srv2.stop()
+        for path in (log_a, srv2.log.name):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def scenario_state_canary_rollback(srv: Server):
+    """An injected canary divergence must turn the reload into a 409 and
+    leave the served results unchanged; the retry (fault budget spent)
+    must succeed and bump the epoch."""
+    status, before, _ = post(srv.url)
+    assert status == 200
+    status, body = post_raw(srv.url, "/patterns/reload", b"")
+    assert status == 409, (status, body)
+    assert body["stage"] == "canary", body
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["reload"]["epoch"] == 0, trace["reload"]
+    assert trace["reload"]["failures"] == 1, trace["reload"]
+    # old banks still serving, scores unchanged
+    status, after, _ = post(srv.url)
+    assert status == 200
+    assert _final_scores(after) == _final_scores(before), (after, before)
+    status, body = post_raw(srv.url, "/patterns/reload", b"")
+    assert status == 200, (status, body)
+    assert body["epoch"] == 1, body
+    assert post(srv.url)[0] == 200
+
+
+def scenario_state_reload_under_load(srv: Server):
+    """Hot reload racing a concurrent batched burst: every request 200,
+    the reload completes, nothing wedges."""
+    post(srv.url)  # warm the batch program
+    burst = Burst(srv.url, 8)
+    time.sleep(0.05)  # let the burst enqueue before the swap quiesces
+    status, body = post_raw(srv.url, "/patterns/reload", b"")
+    results = burst.join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    assert codes == [200] * 8, codes
+    assert status == 200, (status, body)
+    assert body["epoch"] == 1, body
+    # the swapped banks serve the next request
+    assert post(srv.url)[0] == 200
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["reload"]["epoch"] == 1, trace["reload"]
+    assert trace["reload"]["failures"] == 0, trace["reload"]
+
+
+# state scenarios that manage their own server lifecycle (kill/restart)
+STATE_STANDALONE = [
+    ("state-kill9-replay", scenario_state_kill9_replay),
+    ("state-torn-tail", scenario_state_torn_tail),
+]
+
+STATE_SCENARIOS = [
+    (
+        "state-canary-rollback",
+        [],
+        {
+            "LOG_PARSER_TPU_FAULTS": "reload_canary_raise@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_state_canary_rollback,
+    ),
+    (
+        "state-reload-under-load",
+        ["--batching", "on", "--batch-wait-ms", "20", "--batch-max", "8"],
+        {},
+        scenario_state_reload_under_load,
+    ),
+]
+
+
 # ------------------------------------------------- distributed scenarios
 
 
@@ -485,7 +688,7 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="chaos_sweep")
     parser.add_argument("--only", help="run a single scenario by name")
     parser.add_argument(
-        "--group", choices=("base", "batcher", "distributed", "all"),
+        "--group", choices=("base", "batcher", "state", "distributed", "all"),
         default="base",
         help="which scenario group to sweep (default: base; the "
         "distributed group needs multi-process CPU collective support)",
@@ -503,6 +706,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(SCENARIOS)
     if args.group in ("batcher", "all"):
         single_server.extend(BATCHER_SCENARIOS)
+    if args.group in ("state", "all"):
+        single_server.extend(STATE_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:
@@ -522,6 +727,17 @@ def main(argv: list[str] | None = None) -> int:
                 failed += 1
                 rows.append((name, "FAIL", time.monotonic() - t0,
                              f"{exc} (log: {srv.log.name})"))
+    if args.group in ("state", "all"):
+        for name, check in STATE_STANDALONE:
+            if args.only and name != args.only:
+                continue
+            t0 = time.monotonic()
+            try:
+                check()
+                rows.append((name, "PASS", time.monotonic() - t0, ""))
+            except Exception as exc:
+                failed += 1
+                rows.append((name, "FAIL", time.monotonic() - t0, str(exc)))
     if args.group in ("distributed", "all"):
         for name, flags, env, check in DISTRIBUTED_SCENARIOS:
             if args.only and name != args.only:

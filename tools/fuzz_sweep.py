@@ -24,9 +24,15 @@ Three modes:
   bitglush truncation + host verify / distance-repair paths; mirrors
   ``test_random_long_literal_parity_bit_policy`` (suite seeds
   31000..31005).
+- ``--admin``: NOT a parity sweep — a rejection sweep over the admin
+  surface. An in-process ``ParseServer`` takes seeded malformed bodies
+  (broken YAML, wrong JSON shapes, negative/NaN ages, oversized
+  payloads) on ``POST /patterns/reload`` and ``POST /frequency/restore``
+  and every response must be 400/409/413 with the engine provably
+  untouched: same bank object, same frequency stats, same reload epoch.
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
-       [--sharded | --pattern-sharded | --long | --quick]
+       [--sharded | --pattern-sharded | --long | --admin | --quick]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
 9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
 the documented records below; --end exclusive)
@@ -85,6 +91,7 @@ def main() -> int:
     mode.add_argument("--sharded", action="store_true")
     mode.add_argument("--pattern-sharded", action="store_true")
     mode.add_argument("--long", action="store_true")
+    mode.add_argument("--admin", action="store_true")
     mode.add_argument(
         "--quick",
         action="store_true",
@@ -99,7 +106,17 @@ def main() -> int:
             start = _MODE_DEFAULTS[m][0]
             print(f"== quick sweep: {m} seeds {start}..{start + 4}", flush=True)
             rc |= run_sweep(m, start, start + 5)
+        start = _MODE_DEFAULTS["admin"][0]
+        print(f"== quick sweep: admin seeds {start}..{start + 4}", flush=True)
+        rc |= run_admin_sweep(start, start + 5)
         return rc
+    if args.admin:
+        start, end = _MODE_DEFAULTS["admin"]
+        if args.start is not None:
+            start = args.start
+        if args.end is not None:
+            end = args.end
+        return run_admin_sweep(start, end)
     m = (
         "sharded"
         if args.sharded
@@ -125,7 +142,154 @@ _MODE_DEFAULTS = {
     "sharded": (1004, 1054),
     "pattern-sharded": (9003, 9053),
     "long": (31006, 31056),
+    "admin": (41000, 41050),
 }
+
+
+def _admin_reload_bodies(rng: "random.Random") -> list[bytes]:
+    """Seeded malformed YAML for POST /patterns/reload. Every body is
+    malformed BY SHAPE (not by luck), so a 200 is always a real finding:
+    the engine swapped banks on garbage."""
+    junk = "".join(rng.choice("abcxyz(){}<>|&*?!") for _ in range(rng.randrange(1, 12)))
+    n = rng.randrange(1, 9)
+    return [
+        b"\xff\xfe" + junk.encode() * n,                   # not UTF-8 -> 400
+        b"{unclosed: [" + junk.encode(),                   # YAML error
+        f"- {rng.randrange(1 << 30)}\n- {n}\n".encode(),   # docs: list of ints
+        f"{junk}: [unbalanced\n".encode(),                 # YAML error
+        f"scalar-{junk}".encode(),                         # non-mapping doc
+        f"name: {junk}\npatterns: {n}\n".encode(),         # patterns not a list
+        f"patterns:\n- {junk}\n- {n}\n".encode(),          # members not mappings
+        b"#" * ((4 << 20) + 1 + n),                        # > _ADMIN_MAX_BODY -> 413
+    ]
+
+
+def _admin_restore_bodies(rng: "random.Random") -> list[bytes]:
+    """Seeded malformed JSON for POST /frequency/restore: wrong shapes,
+    negative/NaN ages, bad envelopes, oversized."""
+    pid = "".join(rng.choice("abcdefgh") for _ in range(rng.randrange(1, 8)))
+    neg = -rng.random() - 1e-6
+    return [
+        b"not json " + pid.encode(),                       # parse error
+        b"[1, 2, 3]",                                      # not a mapping
+        f'{{"{pid}": 1}}'.encode(),                        # value not a list
+        f'{{"{pid}": ["x", 1]}}'.encode(),                 # non-numeric age
+        f'{{"{pid}": [{neg}]}}'.encode(),                  # negative age
+        f'{{"{pid}": [NaN]}}'.encode(),                    # NaN never >= 0
+        f'{{"ages": {{"{pid}": [{neg}]}}, "epoch": 0}}'.encode(),  # bad envelope
+        f'{{"ages": "{pid}", "epoch": 0}}'.encode(),       # envelope, ages not dict
+        b'{"' + pid.encode() + b'": [' + b"0," * (3 << 20) + b"0]}",  # oversized
+    ]
+
+
+def run_admin_sweep(start: int, end: int) -> int:
+    """Fuzz the admin mutation surface of an in-process ParseServer: every
+    malformed body must be rejected (400/409/413) and the engine must be
+    bit-for-bit untouched — same bank object identity, same frequency
+    stats, same reload epoch. Explicit raises (not asserts) so the
+    startup -O guard is belt-and-braces here."""
+    import json
+    import random
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns import load_pattern_directory
+    from log_parser_tpu.runtime import AnalysisEngine
+    from log_parser_tpu.runtime.reload import PatternReloader
+    from log_parser_tpu.serve.http import make_server
+
+    pattern_dir = os.path.join(_REPO, "log_parser_tpu", "patterns", "builtin")
+    engine = AnalysisEngine(load_pattern_directory(pattern_dir), ScoringConfig())
+    server = make_server(engine, "127.0.0.1", 0)
+    server.reloader = PatternReloader(engine, pattern_dir)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(path: str, body: bytes) -> int:
+        if len(body) > (4 << 20):
+            # the server 413s from Content-Length alone, before draining
+            # the body; urllib would die on the resulting broken pipe, so
+            # declare the length raw and never send the payload
+            import socket
+
+            host, port = server.server_address[:2]
+            with socket.create_connection((host, port), timeout=60) as sock:
+                sock.sendall(
+                    b"POST %s HTTP/1.1\r\nHost: fuzz\r\n"
+                    b"Content-Length: %d\r\nConnection: close\r\n\r\n"
+                    % (path.encode(), len(body))
+                )
+                raw = b""
+                while True:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    raw = raw + chunk
+            return int(raw.split(b" ", 2)[1])
+        req = urllib.request.Request(
+            url + path, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+                return resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    # prime real frequency state so "stats unchanged" is a non-vacuous check
+    engine.analyze(
+        PodFailureData(
+            pod={"metadata": {"name": "fuzz-admin"}},
+            logs="INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after",
+        )
+    )
+    base_bank = engine.bank
+    base_stats = json.dumps(
+        engine.frequency.get_frequency_statistics(), sort_keys=True
+    )
+    base_epoch = engine.reload_epoch
+
+    t0 = time.time()
+    fails: list[tuple[int, str]] = []
+    try:
+        for seed in range(start, end):
+            rng = random.Random(seed)
+            cases = [("/patterns/reload", b) for b in _admin_reload_bodies(rng)]
+            cases += [("/frequency/restore", b) for b in _admin_restore_bodies(rng)]
+            for path, body in cases:
+                try:
+                    status = post(path, body)
+                    if status not in (400, 409, 413):
+                        raise AssertionError(
+                            f"{path} accepted garbage with {status}: {body[:80]!r}"
+                        )
+                    if engine.bank is not base_bank:
+                        raise AssertionError(f"{path} swapped the bank on a reject")
+                    stats = json.dumps(
+                        engine.frequency.get_frequency_statistics(), sort_keys=True
+                    )
+                    if stats != base_stats:
+                        raise AssertionError(
+                            f"{path} mutated frequency state on a reject: "
+                            f"{stats} != {base_stats}"
+                        )
+                    if engine.reload_epoch != base_epoch:
+                        raise AssertionError(f"{path} bumped the reload epoch")
+                except Exception as exc:  # noqa: BLE001 - recorded, sweep continues
+                    fails.append((seed, repr(exc)[:300]))
+                    print(f"SEED {seed} FAILED: {exc!r}", flush=True)
+            if seed % 20 == 0:
+                print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
+    finally:
+        server.shutdown()
+        server.server_close()
+    print(f"DONE admin seeds {start}..{end - 1} fails: {fails} "
+          f"({time.time() - t0:.0f}s)")
+    return 1 if fails else 0
 
 
 def run_sweep(mode: str, start: int, end: int) -> int:

@@ -13,7 +13,10 @@ Checks (all files tracked by git, minus excluded dirs):
   5. every Python file compiles (syntax gate);
   6. Python files use 4-space indentation, never tabs;
   7. every serve-path flag declared in serve/__main__.py is documented in
-     docs/OPS.md (flag drift from new PRs fails the gate, not a reader).
+     docs/OPS.md (flag drift from new PRs fails the gate, not a reader);
+  8. every fault-injection site fired anywhere in log_parser_tpu/ appears
+     in the docs/OPS.md fault-site table (a chaos point nobody can look
+     up is a chaos point nobody exercises).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -126,6 +129,35 @@ def check_serve_flags_documented(root: Path) -> list[str]:
     ]
 
 
+def check_fault_sites_documented(root: Path) -> list[str]:
+    """Check 8: every ``faults.fire("<site>")`` call site in the package
+    must appear in docs/OPS.md. Same literal-substring philosophy as
+    check 7 — a new chaos point lands with its docs row or the gate
+    fails."""
+    pkg = root / "log_parser_tpu"
+    ops = root / "docs" / "OPS.md"
+    if not pkg.is_dir() or not ops.is_file():
+        return []
+    ops_text = ops.read_text()
+    problems: list[str] = []
+    seen: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        for site in re.findall(
+            r'faults\.fire\(\s*"([a-z0-9_]+)"', path.read_text()
+        ):
+            if site in seen:
+                continue
+            seen.add(site)
+            if f"`{site}`" not in ops_text:
+                problems.append(
+                    f"{path}: fault site {site!r} is not documented in "
+                    "docs/OPS.md"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -145,8 +177,9 @@ def main() -> int:
     for path in files:
         problems.extend(check_file(path, args.fix))
     if not args.paths:
-        # repo-wide invariant, only meaningful on a full scan
+        # repo-wide invariants, only meaningful on a full scan
         problems.extend(check_serve_flags_documented(root))
+        problems.extend(check_fault_sites_documented(root))
 
     for p in problems:
         print(p)
