@@ -58,7 +58,22 @@ class Corpus:
         import ctypes
 
         self._lines = None
-        blob = logs.encode("utf-8")
+        try:
+            blob = logs.encode("utf-8")
+        except UnicodeEncodeError:
+            # lone surrogates (json.loads passes "\udXXX" escapes through
+            # unpaired) cannot encode — take the pure-Python path, which
+            # replaces per line and flags those lines for host re-match so
+            # golden's str-level semantics still decide them
+            lines = java_split_lines(logs)
+            self._lines = lines
+            self._blob = None
+            self._starts = self._ends = None
+            self.n_lines = len(lines)
+            self.encoded = encode_lines(
+                lines, max_line_bytes, pad_to_multiple, min_rows
+            )
+            return
         self._blob = blob
         # zero-copy view of the bytes object (blob outlives the calls via self)
         bufp = ctypes.cast(
@@ -124,7 +139,12 @@ class Corpus:
             return self._lines[i]
         if not 0 <= i < self.n_lines:
             raise IndexError(i)
-        return self._blob[self._starts[i] : self._ends[i]].decode("utf-8")
+        # errors="replace" is defensive only: the blob encoded from a str,
+        # so slices at line boundaries are valid UTF-8 — but a malformed
+        # lazy read must never crash a request that already matched
+        return self._blob[self._starts[i] : self._ends[i]].decode(
+            "utf-8", errors="replace"
+        )
 
     def __getitem__(self, key):
         if isinstance(key, slice):

@@ -131,7 +131,25 @@ def encode_lines(
             needs_host=np.zeros(min_rows, dtype=bool),
             n_lines=0,
         )
-    blob = "\n".join(lines).encode("utf-8")
+    try:
+        blob = "\n".join(lines).encode("utf-8")
+        bad_rows = None
+    except UnicodeEncodeError:
+        # lone surrogates reach here unmodified from the wire (json.loads
+        # happily yields "\ud800" escapes as unpaired surrogates). They
+        # cannot encode; replace per line and force those lines to host
+        # verification — golden matches the ORIGINAL str, the device only
+        # ever sees the replacement bytes, so the flag keeps them in
+        # agreement (same rule as non-ASCII content).
+        parts: list[bytes] = []
+        bad_rows = np.zeros(n, dtype=bool)
+        for i, line in enumerate(lines):
+            try:
+                parts.append(line.encode("utf-8"))
+            except UnicodeEncodeError:
+                parts.append(line.encode("utf-8", errors="replace"))
+                bad_rows[i] = True
+        blob = b"\n".join(parts)
     flat = np.frombuffer(blob, dtype=np.uint8)
     # line boundaries: newline positions in the joined blob
     seps = np.flatnonzero(flat == 0x0A)
@@ -173,6 +191,9 @@ def encode_lines(
     # capped-width tail OR max_line_bytes overflow (same rule as the
     # native Corpus path: C fill flags the latter, ingest.py the former)
     over_long[:n] = (lengths > width) | (lengths > max_line_bytes)
+    if bad_rows is not None:
+        # replacement bytes are ASCII ('?'), invisible to host_flag above
+        over_long[:n] |= bad_rows
 
     full_lengths = np.zeros(rows, dtype=np.int32)
     full_lengths[:n] = np.minimum(lengths, width)

@@ -38,13 +38,30 @@ Data flow (docs/ARCHITECTURE.md "Cross-request micro-batching"):
    path for THAT request only; a logic bug propagates to its caller and
    its batchmates never notice.
 
+**Bisection** (``_resolve_records``): a device-classified fault on the
+fused batched step no longer sinks the whole flush to golden. The batch
+is split log₂-wise — each half retried as its own smaller device batch —
+until the poison row(s) are isolated: the healthy majority is served
+ON-DEVICE exactly as if the poison had never shared their flush, and
+only the culprits take the golden fallback (which strikes their
+fingerprint into ``runtime/quarantine.py`` so the NEXT arrival never
+reaches the device step at all). Demux still runs in enqueue order over
+the concatenated per-item outcomes, so frequency serial-equivalence is
+untouched. A watchdog circuit-open error (``pre_run``) skips bisection —
+every sub-batch would short-circuit identically — as does a non-device
+logic error (it would reproduce deterministically on every split).
+
 Chaos sites (runtime/faults.py): ``batcher`` fires at flush start (so
 ``batcher_slow`` delays a flush and ``batcher_raise`` fails a whole batch
-into per-request fallback), ``batcher_demux`` fires per request during
-demux (a dropped demux slot fails one request, not the batch), and
-``batcher_oversize`` — when armed — makes the flush take EVERYTHING
-queued in the bucket, ignoring ``batch_max`` (an oversized batch
-exercising the R-padding ladder).
+into per-request fallback), ``quarantine`` fires per request inside the
+batched device step keyed by the request's log blob (``match=`` poisons
+one row of a healthy batch), ``bisect`` fires at each split decision
+(``bisect_raise`` aborts isolation and fails the faulted sub-batch
+whole), ``batcher_demux`` fires per request during demux (a dropped
+demux slot fails one request, not the batch), and ``batcher_oversize`` —
+when armed — makes the flush take EVERYTHING queued in the bucket,
+ignoring ``batch_max`` (an oversized batch exercising the R-padding
+ladder).
 """
 
 from __future__ import annotations
@@ -123,6 +140,9 @@ class MicroBatcher:
         self.flush_wait = 0
         self.flush_deadline = 0
         self.demux_errors = 0
+        self.bisects = 0
+        self.bisect_aborts = 0
+        self.bisect_isolated = 0
 
     # ---------------------------------------------------------------- API
 
@@ -153,6 +173,12 @@ class MicroBatcher:
         demux, so already-enqueued batches always finish on the banks
         they were prepared against."""
         with self.engine._request_scope():
+            # quarantined fingerprints never enqueue: they would poison a
+            # flush their batchmates share — straight to the host path
+            fp = self.engine._quarantine_check(data)
+            if fp is not None:
+                with self.engine.state_lock:
+                    return self.engine._serve_quarantined(data, fp)
             pending = self._enqueue(data, deadline_ms)
             if pending is None:  # closed: serve unbatched, same contract
                 return self.engine.analyze_pipelined(data)
@@ -286,39 +312,46 @@ class MicroBatcher:
         now = time.monotonic()
         for item in items:
             item.trace.add("batch_wait", now - item.enqueued_at)
+        t0 = time.perf_counter()
         try:
-            t0 = time.perf_counter()
             # chaos at the flush boundary: batcher_slow delays the whole
-            # batch; batcher_raise fails it into per-request fallback below
+            # batch; batcher_raise fails it into per-request fallback
             faults.fire("batcher")
-            recs_list = self._device_batch(items)
-            dt = time.perf_counter() - t0
-            for item in items:
-                item.trace.add("device", dt)
+            resolved = self._resolve_records(items)
         except Exception as exc:
-            # whole-batch failure: every request takes the engine's
-            # per-request fallback/propagate decision individually — a
-            # device-layer error serves from the golden host path, a logic
-            # bug propagates to each caller
-            for item in items:
+            # pre-device failure (injected batcher fault, stacking bug):
+            # every request takes the per-request fallback decision
+            resolved = [exc] * len(items)
+        dt = time.perf_counter() - t0
+        for item in items:
+            item.trace.add("device", dt)
+        # demux in enqueue order: the frequency evolution equals a serial
+        # stream's (read-before-record per request, under state_lock).
+        # ``resolved`` holds per-item device records OR the exception that
+        # survived bisection for that row — failures stay per-request.
+        for item, recs in zip(items, resolved):
+            if isinstance(recs, BaseException):
+                # this row's (sub-)batch faulted: the engine's normal
+                # fallback/propagate decision, individually — a device
+                # error serves golden (and strikes quarantine), a logic
+                # bug propagates to this caller alone
                 try:
                     with engine.state_lock:
-                        item.result = engine._serve_fallback(item.data, exc)
+                        item.result = engine._serve_fallback(item.data, recs)
                 except BaseException as per_req:  # noqa: BLE001
                     item.error = per_req
                 finally:
                     item.done.set()
-            return
-        # demux in enqueue order: the frequency evolution equals a serial
-        # stream's (read-before-record per request, under state_lock)
-        for item, recs in zip(items, recs_list):
+                continue
             try:
                 faults.fire("batcher_demux")
                 with item.trace.phase("verify"):
                     recs = engine._verify_approx(item.corpus, recs)
                 from log_parser_tpu.runtime.engine import _Prepared
 
-                prepared = _Prepared(item.start, item.trace, item.corpus, recs)
+                prepared = _Prepared(
+                    item.start, item.trace, item.corpus, recs, item.data
+                )
                 with item.trace.phase("lock_wait"):
                     engine.state_lock.acquire()
                 try:
@@ -336,6 +369,46 @@ class MicroBatcher:
                 item.error = exc
             finally:
                 item.done.set()
+
+    # ----------------------------------------------------------- bisection
+
+    def _resolve_records(self, items: list[_Pending], depth: int = 0):
+        """Per-item outcomes for one flush: device records on success, or
+        the exception each row is charged with. On a device-classified
+        fault the batch splits in half and each half retries as its own
+        smaller device batch (log₂ extra steps), isolating poison row(s)
+        so the healthy majority still serves ON-DEVICE. Outcomes
+        concatenate in the original order, so the enqueue-order demux —
+        and with it frequency serial-equivalence — is untouched."""
+        from log_parser_tpu.runtime.engine import is_device_error
+
+        try:
+            return self._device_batch(items)
+        except Exception as exc:
+            if len(items) == 1:
+                if depth > 0:
+                    with self._cv:
+                        self.bisect_isolated += 1
+                return [exc]
+            if not is_device_error(exc):
+                # deterministic logic error: every split reproduces it
+                return [exc] * len(items)
+            if getattr(exc, "pre_run", False):
+                # watchdog circuit open — the device step never ran and
+                # every sub-batch would short-circuit identically
+                return [exc] * len(items)
+            try:
+                faults.fire("bisect")
+            except faults.InjectedFault:
+                with self._cv:
+                    self.bisect_aborts += 1
+                return [exc] * len(items)
+            with self._cv:
+                self.bisects += 1
+            mid = len(items) // 2
+            return self._resolve_records(
+                items[:mid], depth + 1
+            ) + self._resolve_records(items[mid:], depth + 1)
 
     def _device_batch(self, items: list[_Pending]):
         """Stack the bucket into one padded [R, B, T] batch, run the
@@ -365,6 +438,11 @@ class MicroBatcher:
         # line invalid, so they produce zero matches at zero risk
 
         def _device_step():
+            # chaos: a keyed quarantine fault poisons the row(s) whose log
+            # blob contains match= — the fused step dies exactly as a real
+            # poison pill would, exercising bisection end to end
+            for item in items:
+                faults.fire("quarantine", key=item.data.logs or "")
             faults.fire("device")
             return self.program.run(
                 lines, lens, nlin, om, ov, k_hint=engine._k_hint
@@ -393,4 +471,7 @@ class MicroBatcher:
                 "flushWait": self.flush_wait,
                 "flushDeadline": self.flush_deadline,
                 "demuxErrors": self.demux_errors,
+                "bisects": self.bisects,
+                "bisectAborts": self.bisect_aborts,
+                "bisectIsolated": self.bisect_isolated,
             }

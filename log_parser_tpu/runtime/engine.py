@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import random
 import threading
 import time
 import uuid
@@ -53,6 +54,15 @@ from log_parser_tpu.runtime import faults
 from log_parser_tpu.ops.match import DfaBank, MatcherBanks
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
+from log_parser_tpu.runtime.quarantine import (
+    DEFAULT_BREAKER_COOLDOWN_S,
+    DEFAULT_CAPACITY,
+    DEFAULT_STRIKES,
+    DEFAULT_TTL_S,
+    PatternBreakerBoard,
+    QuarantineTable,
+    fingerprint as quarantine_fingerprint,
+)
 from log_parser_tpu.utils.trace import PhaseTrace
 
 # Substrings identifying plain RuntimeErrors raised by the device layer
@@ -128,7 +138,15 @@ def is_device_error(exc: BaseException) -> bool:
 class DeviceHungError(RuntimeError):
     """The device step exceeded the watchdog timeout (or the breaker is
     open from a previous hang). Classified as a device error so the
-    golden fallback serves the request."""
+    golden fallback serves the request.
+
+    ``pre_run`` distinguishes the circuit-open short-circuit (this
+    request's step never entered the device — it proves nothing about
+    the request) from an actual timeout: only the latter counts as a
+    quarantine strike, and the batcher skips bisecting the former (the
+    sub-batches would short-circuit identically)."""
+
+    pre_run = False
 
 
 class DeviceWatchdog:
@@ -199,10 +217,12 @@ class DeviceWatchdog:
                     self._probing = True
                     probe = True
                 else:
-                    raise DeviceHungError(
+                    exc = DeviceHungError(
                         "device backend still hung from a previous timeout "
                         "(circuit open); serving from the host path"
                     )
+                    exc.pre_run = True
+                    raise exc
             self._inflight += 1
         result: list = []
         error: list = []
@@ -280,15 +300,18 @@ _NULL_LOCK = contextlib.nullcontext()
 
 
 class _Prepared:
-    """One request's prepare-phase outputs, handed to the finish phase."""
+    """One request's prepare-phase outputs, handed to the finish phase.
+    ``data`` rides along so the finish phase can hand the original
+    request to the shadow verifier."""
 
-    __slots__ = ("start", "trace", "corpus", "recs")
+    __slots__ = ("start", "trace", "corpus", "recs", "data")
 
-    def __init__(self, start, trace, corpus, recs):
+    def __init__(self, start, trace, corpus, recs, data=None):
         self.start = start
         self.trace = trace
         self.corpus = corpus
         self.recs = recs
+        self.data = data
 
 
 class AnalysisEngine:
@@ -407,6 +430,48 @@ class AnalysisEngine:
         # None until enable_batching() — transports then route analyze
         # calls through analyze_batched
         self.batcher = None
+        # poison-request quarantine (runtime/quarantine.py): organic
+        # device failures strike the request's fingerprint; at the
+        # threshold repeats route straight to golden until TTL expiry
+        self.quarantine = QuarantineTable(
+            strikes=int(
+                os.environ.get(
+                    "LOG_PARSER_TPU_QUARANTINE_STRIKES", str(DEFAULT_STRIKES)
+                )
+            ),
+            ttl_s=float(
+                os.environ.get(
+                    "LOG_PARSER_TPU_QUARANTINE_TTL_S", str(DEFAULT_TTL_S)
+                )
+            ),
+            capacity=int(
+                os.environ.get(
+                    "LOG_PARSER_TPU_QUARANTINE_CAPACITY", str(DEFAULT_CAPACITY)
+                )
+            ),
+            clock=clock,
+        )
+        # per-pattern circuit breakers tripped by shadow divergence: an
+        # open breaker serves ONLY that pattern's columns from the exact
+        # host regex (see _overrides) instead of degrading the engine
+        self.breakers = PatternBreakerBoard(
+            cooldown_s=float(
+                os.environ.get(
+                    "LOG_PARSER_TPU_PATTERN_BREAKER_COOLDOWN_S",
+                    str(DEFAULT_BREAKER_COOLDOWN_S),
+                )
+            ),
+            clock=clock,
+        )
+        self._breaker_map: dict[str, set[int]] | None = None
+        self._breaker_map_bank = None
+        # online shadow verification (ShadowVerifier below): sample
+        # --shadow-rate of served requests, re-run on golden off the hot
+        # path, compare scores at 1e-9; None until enable_shadow()
+        self.shadow = None
+        shadow_rate = float(os.environ.get("LOG_PARSER_TPU_SHADOW_RATE", "0") or 0)
+        if shadow_rate > 0:
+            self.enable_shadow(shadow_rate)
         # chaos: pick up LOG_PARSER_TPU_FAULTS once per process (no-op
         # when unset or when a test installed a registry explicitly)
         faults.ensure_env()
@@ -447,7 +512,8 @@ class AnalysisEngine:
         override transfer entirely."""
         enc = corpus.encoded
         host_lines = np.flatnonzero(enc.needs_host[: corpus.n_lines])
-        if not self._host_cols and len(host_lines) == 0:
+        breaker_cols = self._breaker_columns()
+        if not self._host_cols and not breaker_cols and len(host_lines) == 0:
             return None
         B = enc.u8.shape[0]
         n = corpus.n_lines
@@ -487,12 +553,56 @@ class AnalysisEngine:
                     host = self.bank.columns[ci].host
                     for i in cand:
                         val[i, ci] = bool(host.search(text[int(i)]))
+        if breaker_cols:
+            # per-pattern breaker containment: an OPEN breaker's columns
+            # are served from the exact host regex on every line — host
+            # truth is exact, so a column shared with a healthy pattern
+            # is corrected, never corrupted
+            mask[:, breaker_cols] = True
+            for i, line in enumerate(corpus.materialize()):
+                for col in breaker_cols:
+                    val[i, col] = bool(self.bank.columns[col].host.search(line))
         for i in host_lines:
             line = corpus.line(int(i))
             for col in self._device_cols:
                 mask[i, col] = True
                 val[i, col] = bool(self.bank.columns[col].host.search(line))
         return mask, val
+
+    def _breaker_columns(self) -> list[int]:
+        """Engine-bank columns of every pattern whose shadow breaker is
+        currently OPEN (primary + secondary + sequence-event roles) —
+        the override set that serves just those patterns from host truth.
+        Empty in the steady state, so the common path costs one set
+        check."""
+        board = self.breakers
+        if board is None:
+            return []
+        pids = board.overridden_patterns()
+        if not pids:
+            return []
+        if self._breaker_map is None or self._breaker_map_bank is not self.bank:
+            by_id: dict[str, set[int]] = {}
+            for p, pat in enumerate(self.bank.patterns):
+                by_id.setdefault(pat.id, set()).add(
+                    int(self.bank.primary_columns[p])
+                )
+            for e in self.bank.secondaries:
+                by_id.setdefault(
+                    self.bank.patterns[e.pattern_idx].id, set()
+                ).add(int(e.column))
+            for s in self.bank.sequences:
+                by_id.setdefault(
+                    self.bank.patterns[s.pattern_idx].id, set()
+                ).update(int(c) for c in s.event_columns)
+            self._breaker_map = by_id
+            self._breaker_map_bank = self.bank
+        cols: set[int] = set()
+        for pid in pids:
+            cols.update(self._breaker_map.get(pid, ()))
+        # columns with no DFA are already host-evaluated unconditionally
+        cols.difference_update(self._host_cols)
+        return sorted(cols)
 
     # ----------------------------------------------------- device-step hooks
     # ShardedEngine overrides these two to swap in the shard_map program;
@@ -902,6 +1012,20 @@ class AnalysisEngine:
         ).start()
         return self.batcher
 
+    def enable_shadow(self, rate: float, seed: int | None = None):
+        """Attach and start the online shadow verifier: ``rate`` of
+        served device/batched requests are re-run on the golden host path
+        off the hot path (cloned frequency state, never double-counted)
+        and compared at 1e-9; a divergence trips the divergent pattern's
+        breaker (see :class:`ShadowVerifier`). ``seed`` pins the sampling
+        RNG (``LOG_PARSER_TPU_SHADOW_SEED`` when None)."""
+        if seed is None:
+            seed = int(os.environ.get("LOG_PARSER_TPU_SHADOW_SEED", "0"))
+        if self.shadow is not None:
+            self.shadow.close()
+        self.shadow = ShadowVerifier(self, rate, seed=seed).start()
+        return self.shadow
+
     def analyze_batched(
         self, data: PodFailureData, deadline_ms: float | None = None
     ) -> AnalysisResult:
@@ -931,6 +1055,10 @@ class AnalysisEngine:
             return self._analyze_in_scope(data, lock)
 
     def _analyze_in_scope(self, data: PodFailureData, lock) -> AnalysisResult:
+        fp = self._quarantine_check(data)
+        if fp is not None:
+            with lock:
+                return self._serve_quarantined(data, fp)
         try:
             prepared = self._prepare(data)
         except Exception as exc:
@@ -957,6 +1085,53 @@ class AnalysisEngine:
         finally:
             lock.__exit__(None, None, None)
 
+    def _quarantine_check(self, data: PodFailureData) -> str | None:
+        """The request's fingerprint when it is actively quarantined,
+        else None. The sha256 is only computed once any fingerprint is
+        being tracked — the steady state pays one counter read."""
+        q = self.quarantine
+        if q is None or not q._table:
+            return None
+        fp = quarantine_fingerprint(data.logs or "")
+        return fp if q.check(fp) else None
+
+    def _serve_quarantined(self, data: PodFailureData, fp: str) -> AnalysisResult:
+        """Serve a quarantined request straight from the golden host path
+        — it never reaches the device step, the watchdog breaker, or a
+        shared batch. Only when golden ALSO fails does the caller get a
+        structured 429 + Retry-After (QuarantineRejected). Caller holds
+        the lock."""
+        from log_parser_tpu.runtime.quarantine import QuarantineRejected
+
+        try:
+            result = self._golden_serve(data)
+        except Exception as exc:
+            self.quarantine.note_rejected()
+            raise QuarantineRejected(
+                fp, self.quarantine.retry_after(fp)
+            ) from exc
+        self.quarantine.note_served()
+        return result
+
+    def _strike_worthy(self, exc: Exception) -> bool:
+        """Does this device-classified failure accuse the REQUEST? Only
+        organic CRASHES strike: injected backend chaos (device_raise)
+        would quarantine innocent traffic, and a hang — circuit-open
+        short-circuit or an actual watchdog timeout — accuses the
+        BACKEND, whose containment is the watchdog breaker (an innocent
+        request in flight when the device wedges, or the half-open probe
+        itself, must stay device-eligible once the backend recovers).
+        The injected poison pill (InjectedPoisonFault, the ``quarantine``
+        fault site) is the deliberate exception — it simulates an
+        organic poison."""
+        if isinstance(exc, faults.InjectedPoisonFault):
+            return True
+        if isinstance(exc, faults.InjectedFault):
+            return False
+        if isinstance(exc, DeviceHungError):
+            return False
+        return True
+
     def _serve_fallback(self, data: PodFailureData, exc: Exception) -> AnalysisResult:
         """Serve ``data`` from the golden host path if ``exc`` is a device
         failure and the fallback is enabled; re-raise otherwise. Caller
@@ -968,6 +1143,17 @@ class AnalysisEngine:
         import logging
 
         self.fallback_count += 1
+        if self._strike_worthy(exc):
+            fp = quarantine_fingerprint(data.logs or "")
+            if self.quarantine.strike(fp):
+                logging.getLogger(__name__).warning(
+                    "Quarantined request fingerprint %s… for %gs after "
+                    "%d device-failure strike(s); repeats serve from the "
+                    "host path without touching the device",
+                    fp[:12],
+                    self.quarantine.ttl_s,
+                    self.quarantine.threshold,
+                )
         logging.getLogger(__name__).exception(
             "Device batch failed (fallback #%d); serving this request "
             "from the golden host path",
@@ -995,8 +1181,11 @@ class AnalysisEngine:
         om, ov = overrides if overrides is not None else (None, None)
 
         def _device_step():
-            # chaos point INSIDE the watchdog worker: an injected hang
-            # exercises the timeout/breaker exactly like a wedged backend
+            # chaos points INSIDE the watchdog worker: an injected hang
+            # exercises the timeout/breaker exactly like a wedged backend;
+            # the quarantine site is keyed by this request's content so a
+            # match= spec can poison exactly one request
+            faults.fire("quarantine", key=data.logs or "")
             faults.fire("device")
             return self._run_device(enc, corpus.n_lines, om, ov)
 
@@ -1007,7 +1196,7 @@ class AnalysisEngine:
         self._k_hint = recs.n_matches
         with trace.phase("verify"):
             recs = self._verify_approx(corpus, recs)
-        return _Prepared(start, trace, corpus, recs)
+        return _Prepared(start, trace, corpus, recs, data)
 
     def _finish(self, prepared: "_Prepared") -> AnalysisResult:
         """Frequency read → exact-f64 finalize → frequency record →
@@ -1020,6 +1209,14 @@ class AnalysisEngine:
             prepared.corpus,
             prepared.recs,
         )
+        # shadow sampling decides (and captures the pre-record tracker
+        # state) HERE, under the lock: the golden re-run must read exactly
+        # the windowed counts this request's finalize reads, cloned so it
+        # can never double-count the live tracker
+        shadow = self.shadow
+        shadow_state = None
+        if shadow is not None and prepared.data is not None and shadow.should_sample():
+            shadow_state = self.frequency._save_state()
         # windowed frequency counts at batch start (pruned by the tracker);
         # "entry exists" is tracked separately — an expired window still has
         # an entry and takes the formula path, not the null early-return
@@ -1072,4 +1269,234 @@ class AnalysisEngine:
         # appends are thread-safe under concurrent _finish callers
         self.trace_history.append(trace)
         self.last_finalized = fin
+        if shadow_state is not None:
+            shadow.submit(prepared.data, shadow_state, result)
         return result
+
+
+class ShadowVerifier:
+    """Online device-vs-golden verification off the hot path.
+
+    The offline parity harness only proves parity for corpora someone
+    thought to run; a silent device-vs-golden divergence on production
+    traffic (a mistranslated regex corner, a tier bug on one byte
+    sequence) would otherwise go unnoticed until the next offline run.
+    This worker samples ``rate`` of served requests (decided under
+    ``state_lock`` by a dedicated seeded RNG, so a sweep replays the same
+    sampling decisions) and re-runs each on a golden analyzer whose
+    frequency tracker is a CLONE of the pre-record state the device
+    request read — the live tracker is never touched, so shadowing adds
+    zero frequency drift and batched/unbatched scores stay bit-identical
+    to a no-shadow run.
+
+    Comparison is per event ``(line_number, pattern id, score)`` at 1e-9.
+    On divergence: counters move (``/trace/last`` → ``shadow``),
+    ``/q/health`` reports a DEGRADED ``shadow`` check, and the divergent
+    pattern's breaker opens (:class:`PatternBreakerBoard`) — that pattern
+    serves from the exact host regex while everything else stays
+    on-device, then half-opens after the cool-down and the next forced
+    shadow comparison closes or re-opens it.
+
+    The ``shadow`` fault site fires in the worker per comparison; an
+    injected raise is treated as a synthetic divergence on the request's
+    first matched pattern (chaos drills the breaker ladder without
+    needing a genuinely mistranslated pattern).
+    """
+
+    def __init__(
+        self,
+        engine: AnalysisEngine,
+        rate: float,
+        seed: int = 0,
+        queue_max: int = 64,
+        tolerance: float = 1e-9,
+    ):
+        self.engine = engine
+        self.rate = min(1.0, max(0.0, float(rate)))
+        self.tolerance = tolerance
+        self.queue_max = max(1, int(queue_max))
+        self._rng = random.Random(seed)
+        self._cond = threading.Condition()
+        self._jobs: deque = deque()
+        self._pending = 0  # queued + in-flight comparisons
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        # counters (guarded by _cond; GET /trace/last "shadow")
+        self.sampled = 0
+        self.forced = 0
+        self.compared = 0
+        self.divergences = 0
+        self.dropped = 0
+        self.errors = 0
+        self.last_divergence: dict | None = None
+        # golden clone, rebuilt whenever the engine's bank is swapped
+        self._golden = None
+        self._golden_bank = None
+
+    def start(self) -> "ShadowVerifier":
+        self._thread = threading.Thread(
+            target=self._worker, name="shadow-verifier", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    # ------------------------------------------------------------ sampling
+
+    def should_sample(self) -> bool:
+        """Called under ``state_lock`` (one RNG draw per served request —
+        deterministic under a seed). A pending half-open breaker forces
+        the sample so the probe actually resolves."""
+        with self._cond:
+            if self.engine.breakers.probe_pending():
+                self.forced += 1
+                self.sampled += 1
+                return True
+            if self.rate >= 1.0 or self._rng.random() < self.rate:
+                self.sampled += 1
+                return True
+            return False
+
+    def submit(self, data, freq_state: dict, result) -> None:
+        """Hand one served request to the worker. Non-blocking: a full
+        queue drops the sample (counted) rather than stalling serving."""
+        events = [
+            (e.line_number, e.matched_pattern.id, e.score)
+            for e in result.events
+        ]
+        with self._cond:
+            if self._closed:
+                return
+            if len(self._jobs) >= self.queue_max:
+                self.dropped += 1
+                return
+            self._jobs.append((data, freq_state, events))
+            self._pending += 1
+            self._cond.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until every submitted comparison has been processed
+        (tests and sweeps; serving never calls this)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._pending == 0, timeout_s
+            )
+
+    # -------------------------------------------------------------- worker
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._jobs and not self._closed:
+                    self._cond.wait()
+                if not self._jobs and self._closed:
+                    return
+                data, freq_state, device_events = self._jobs.popleft()
+            try:
+                self._compare(data, freq_state, device_events)
+            except Exception:
+                import logging
+
+                with self._cond:
+                    self.errors += 1
+                logging.getLogger(__name__).exception(
+                    "shadow verification failed (the request was already "
+                    "served; this affects only the comparison)"
+                )
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def _golden_clone(self):
+        from log_parser_tpu.golden.engine import GoldenAnalyzer
+
+        bank = self.engine.bank
+        if self._golden is None or self._golden_bank is not bank:
+            self._golden = GoldenAnalyzer(
+                bank.pattern_sets,
+                self.engine.config,
+                clock=self.engine.frequency.clock,
+            )
+            self._golden_bank = bank
+        return self._golden
+
+    def _compare(self, data, freq_state, device_events) -> None:
+        synthetic = False
+        try:
+            faults.fire("shadow")
+        except faults.InjectedFault:
+            synthetic = True
+        diverged: set[str] = set()
+        seen: set[str] = {pid for _, pid, _ in device_events}
+        if synthetic:
+            # chaos: declare the request's first matched pattern divergent
+            if device_events:
+                diverged.add(device_events[0][1])
+        else:
+            golden = self._golden_clone()
+            from log_parser_tpu.golden.engine import GoldenFrequencyTracker
+
+            tracker = GoldenFrequencyTracker(
+                self.engine.config, clock=self.engine.frequency.clock
+            )
+            tracker._load_state(freq_state)
+            golden.frequency = tracker
+            gresult = golden.analyze(data)
+            dev = {(ln, pid): s for ln, pid, s in device_events}
+            gol = {
+                (e.line_number, e.matched_pattern.id): e.score
+                for e in gresult.events
+            }
+            seen |= {pid for _, pid in gol}
+            for key in dev.keys() | gol.keys():
+                if key not in dev or key not in gol:
+                    diverged.add(key[1])
+                elif abs(dev[key] - gol[key]) > self.tolerance:
+                    diverged.add(key[1])
+        with self._cond:
+            self.compared += 1
+            if diverged:
+                self.divergences += 1
+                self.last_divergence = {
+                    "patterns": sorted(diverged),
+                    "synthetic": synthetic,
+                }
+        if diverged:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "Shadow divergence on pattern(s) %s%s — opening per-"
+                "pattern breaker(s); those patterns serve from the host "
+                "regex until a clean half-open probe",
+                sorted(diverged),
+                " (synthetic, injected)" if synthetic else "",
+            )
+            for pid in diverged:
+                self.engine.breakers.trip(pid)
+        self.engine.breakers.resolve(seen, diverged)
+
+    # ------------------------------------------------------- observability
+
+    def stats(self) -> dict:
+        with self._cond:
+            payload = {
+                "rate": self.rate,
+                "sampled": self.sampled,
+                "forced": self.forced,
+                "compared": self.compared,
+                "divergences": self.divergences,
+                "dropped": self.dropped,
+                "errors": self.errors,
+                "queueDepth": len(self._jobs),
+                "breakers": self.engine.breakers.stats(),
+            }
+            if self.last_divergence is not None:
+                payload["lastDivergence"] = self.last_divergence
+            return payload

@@ -44,7 +44,20 @@ Each spec is ``<site>_<action>[:<arg>][@mod=value]*``:
   ``hang``/``slow``;
 - mods: ``after=N`` (skip the first N evaluations at the site),
   ``times=N`` (inject at most N times), ``p=F`` (probability gate for
-  ``hang``/``slow``).
+  ``hang``/``slow``), ``match=SUBSTR`` (content-conditional: the spec is
+  eligible only at keyed fire sites — :func:`fire` called with
+  ``key=...`` — whose key contains ``SUBSTR``; an unkeyed evaluation
+  never matches. This is how a *poison request* is simulated
+  deterministically: ``quarantine_raise@match=MARKER`` fails exactly the
+  requests carrying MARKER in their logs, wherever they land — alone,
+  inside a fused batch, or inside a bisected sub-batch).
+
+The ``quarantine`` site (fired per request at the device-step boundary
+with the request's log content as the key) raises
+:class:`InjectedPoisonFault` — a *device-classified* fault that, unlike
+every other injected fault, also accrues a quarantine strike: it stands
+in for an organic poison pill, so the quarantine/bisection machinery
+must react to it exactly as to the real thing.
 
 Seed: ``LOG_PARSER_TPU_FAULT_SEED`` (default 0). Probabilistic specs draw
 from one ``random.Random(seed)`` in evaluation order, so a single-threaded
@@ -87,6 +100,15 @@ class InjectedDeviceFault(InjectedFault):
     backend."""
 
 
+class InjectedPoisonFault(InjectedDeviceFault):
+    """An injected poison *request* (the ``quarantine`` fire site):
+    device-classified like :class:`InjectedDeviceFault`, but additionally
+    treated as ORGANIC by the quarantine strike rule — injected backend
+    chaos (``device_raise``) must never quarantine innocent traffic,
+    while an injected poison pill must exercise the whole
+    strike/quarantine/bisection ladder end to end."""
+
+
 class FaultSpecError(ValueError):
     """Malformed ``LOG_PARSER_TPU_FAULTS`` entry."""
 
@@ -100,6 +122,7 @@ class FaultSpec:
     p: float = 1.0  # probability gate
     after: int = 0  # skip the first N evaluations
     times: int | None = None  # max injections
+    match: str | None = None  # eligible only when the fire key contains this
     # runtime state
     calls: int = 0  # evaluations at this site
     fired: int = 0  # actual injections
@@ -152,6 +175,10 @@ def parse_spec(entry: str) -> FaultSpec:
                     raise FaultSpecError(
                         f"p must be in (0, 1]: {entry!r}"
                     )
+            elif key == "match":
+                if not value:
+                    raise FaultSpecError(f"empty match in {entry!r}")
+                spec.match = value
             else:
                 raise FaultSpecError(f"unknown modifier {key!r} in {entry!r}")
         except ValueError as exc:
@@ -186,13 +213,21 @@ class FaultRegistry:
 
     # ------------------------------------------------------------- firing
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, key: str | None = None) -> None:
         """Evaluate every spec registered at ``site``; the first that
         triggers performs its action (raise / hang / slow). Evaluation
-        order is declaration order, draws come from the one seeded RNG."""
+        order is declaration order, draws come from the one seeded RNG.
+        ``key`` is the content a ``match=`` spec filters on (the request's
+        log blob at per-request sites); a spec with ``match`` set is
+        skipped entirely — no counter or RNG advance — when the key does
+        not contain its substring."""
         chosen: FaultSpec | None = None
         with self._lock:
             for spec in self._by_site.get(site, ()):
+                if spec.match is not None and (
+                    key is None or spec.match not in key
+                ):
+                    continue
                 spec.calls += 1
                 if spec.lifted or spec.calls <= spec.after:
                     continue
@@ -206,7 +241,12 @@ class FaultRegistry:
         if chosen is None:
             return
         if chosen.action == "raise":
-            exc_t = InjectedDeviceFault if site == "device" else InjectedFault
+            if site == "quarantine":
+                exc_t = InjectedPoisonFault
+            elif site == "device":
+                exc_t = InjectedDeviceFault
+            else:
+                exc_t = InjectedFault
             raise exc_t(chosen.point, chosen.fired)
         # hang/slow: block on the spec's release event so lift() can free
         # waiters; a finite arg is simply the wait timeout
@@ -272,11 +312,11 @@ def active() -> FaultRegistry | None:
     return _REGISTRY
 
 
-def fire(site: str) -> None:
+def fire(site: str, key: str | None = None) -> None:
     """Injection point — a no-op unless a registry is installed."""
     reg = _REGISTRY
     if reg is not None:
-        reg.fire(site)
+        reg.fire(site, key)
 
 
 def stats() -> dict | None:

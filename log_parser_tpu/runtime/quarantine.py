@@ -1,0 +1,288 @@
+"""Poison-request quarantine and per-pattern shadow breakers — the
+blast-radius-isolation layer between one pathological request and the
+rest of the fleet.
+
+The golden fallback (runtime/engine.py) answers *this* request when the
+device step dies, but it does nothing about the NEXT arrival of the same
+request: a poison pill replayed by a retrying client re-enters the device
+step every time, re-trips the watchdog breaker (punishing innocent
+traffic with host-path latency), and — under micro-batching — keeps
+sinking whole flushes. "Lost in Translation?" (PAPERS.md, arxiv
+2506.19539) shows translated regex semantics drift exactly in the corner
+cases production traffic finds first; CelerLog (arxiv 2605.26005) shows
+the fix is dynamic routing of hard inputs, not trust-at-build-time.
+
+Three cooperating pieces:
+
+- :class:`QuarantineTable` — request fingerprints (sha256 of the
+  normalized log blob + its power-of-two shape bucket) accumulate a
+  *strike* whenever their device step raises an organic (non-injected)
+  device error. At ``--quarantine-strikes`` strikes the fingerprint is
+  quarantined for ``--quarantine-ttl-s``: repeats are routed straight to
+  the golden host path without ever touching the device step, and only
+  when golden ALSO fails does the caller see a structured 429 +
+  Retry-After (:class:`QuarantineRejected`). The table is LRU-capped so
+  an attacker rotating payloads can only evict other suspects, never
+  grow memory.
+- batch bisection (runtime/batcher.py) — feeds this table: a faulted
+  fused flush is split log₂-wise to isolate the poison row(s), the
+  healthy majority is served on-device, and only the culprits strike.
+- :class:`PatternBreakerBoard` — per-pattern circuit breakers driven by
+  online shadow verification (runtime/engine.py ``ShadowVerifier``). A
+  device-vs-golden score divergence on pattern P opens P's breaker: P's
+  columns are served from the exact host regex (a cube override —
+  surgical containment) while every other pattern stays on-device.
+  After ``cooldown_s`` the breaker goes half-open: overrides lift and
+  the next shadow comparison either closes it or re-opens it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable
+
+DEFAULT_STRIKES = 2
+DEFAULT_TTL_S = 300.0
+DEFAULT_CAPACITY = 4096
+DEFAULT_BREAKER_COOLDOWN_S = 30.0
+
+
+def fingerprint(logs: str) -> str:
+    """sha256 over the normalized log blob plus its shape bucket.
+
+    Normalization matches the ingest path (utf-8 with ``errors="replace"``
+    — native/ingest.py), so two byte-wise different payloads that encode
+    to the same device batch share a fingerprint. The power-of-two line
+    bucket keeps a prefix of a poison corpus (same bytes, different
+    padded shape → different compiled program) from aliasing the full
+    one."""
+    blob = (logs or "").encode("utf-8", errors="replace")
+    n_lines = blob.count(b"\n") + 1
+    bucket = 1
+    while bucket < n_lines:
+        bucket <<= 1
+    h = hashlib.sha256(blob)
+    h.update(b"|rows=%d" % bucket)
+    return h.hexdigest()
+
+
+class QuarantineRejected(RuntimeError):
+    """A quarantined request that the golden host path could not serve
+    either — the caller gets a structured 429 with Retry-After instead of
+    another crack at the device step."""
+
+    def __init__(self, fp: str, retry_after_s: float):
+        super().__init__(
+            f"request fingerprint {fp[:12]}… is quarantined and the host "
+            "path failed; retry after TTL expiry"
+        )
+        self.fingerprint = fp
+        self.retry_after_s = max(1.0, float(retry_after_s))
+        self.status = 429
+        self.reason = "quarantined"
+
+
+class _Entry:
+    __slots__ = ("strikes", "quarantined_at")
+
+    def __init__(self):
+        self.strikes = 0
+        self.quarantined_at: float | None = None
+
+
+class QuarantineTable:
+    """Strike ledger + active-quarantine set, LRU-capped.
+
+    Thread-safe; the clock is injectable so TTL expiry is testable
+    without sleeping. All counters are lifetime totals surfaced on
+    ``GET /trace/last`` (the ``quarantine`` block)."""
+
+    def __init__(
+        self,
+        strikes: int = DEFAULT_STRIKES,
+        ttl_s: float = DEFAULT_TTL_S,
+        capacity: int = DEFAULT_CAPACITY,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.threshold = max(1, int(strikes))
+        self.ttl_s = float(ttl_s)
+        self.capacity = max(1, int(capacity))
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._table: OrderedDict[str, _Entry] = OrderedDict()
+        # lifetime counters (guarded by _lock)
+        self.strike_count = 0
+        self.quarantined_count = 0
+        self.served_golden = 0
+        self.rejected_count = 0
+        self.readmitted_count = 0
+        self.evicted_count = 0
+
+    def strike(self, fp: str) -> bool:
+        """Record one strike against ``fp``; True when this strike crosses
+        the threshold and the fingerprint enters quarantine."""
+        with self._lock:
+            entry = self._table.get(fp)
+            if entry is None:
+                entry = _Entry()
+                self._table[fp] = entry
+                while len(self._table) > self.capacity:
+                    self._table.popitem(last=False)
+                    self.evicted_count += 1
+            else:
+                self._table.move_to_end(fp)
+            self.strike_count += 1
+            entry.strikes += 1
+            if entry.quarantined_at is None and entry.strikes >= self.threshold:
+                entry.quarantined_at = self.clock()
+                self.quarantined_count += 1
+                return True
+            return False
+
+    def check(self, fp: str) -> bool:
+        """True while ``fp`` is actively quarantined. A TTL-expired entry
+        is dropped entirely (strikes included) and the fingerprint is
+        re-admitted to the device path with a clean slate."""
+        with self._lock:
+            entry = self._table.get(fp)
+            if entry is None or entry.quarantined_at is None:
+                return False
+            if self.ttl_s > 0 and self.clock() - entry.quarantined_at >= self.ttl_s:
+                del self._table[fp]
+                self.readmitted_count += 1
+                return False
+            self._table.move_to_end(fp)
+            return True
+
+    def retry_after(self, fp: str) -> float:
+        """Seconds until ``fp``'s quarantine expires (the Retry-After a
+        429 carries when even the host path cannot serve it)."""
+        with self._lock:
+            entry = self._table.get(fp)
+            if entry is None or entry.quarantined_at is None:
+                return 1.0
+            if self.ttl_s <= 0:
+                return 1.0
+            return max(1.0, self.ttl_s - (self.clock() - entry.quarantined_at))
+
+    def note_served(self) -> None:
+        with self._lock:
+            self.served_golden += 1
+
+    def note_rejected(self) -> None:
+        with self._lock:
+            self.rejected_count += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            active = sum(
+                1 for e in self._table.values() if e.quarantined_at is not None
+            )
+            return {
+                "threshold": self.threshold,
+                "ttlS": self.ttl_s,
+                "capacity": self.capacity,
+                "tracked": len(self._table),
+                "active": active,
+                "strikes": self.strike_count,
+                "quarantined": self.quarantined_count,
+                "servedGolden": self.served_golden,
+                "rejected": self.rejected_count,
+                "readmitted": self.readmitted_count,
+                "evicted": self.evicted_count,
+            }
+
+
+class PatternBreakerBoard:
+    """Per-pattern circuit breakers: open on shadow divergence, half-open
+    after a cool-down, closed by a clean shadow comparison.
+
+    While a pattern's breaker is OPEN, the engine serves that pattern's
+    columns from the exact host regex (``AnalysisEngine._overrides``) —
+    the rest of the bank stays on-device, so one mistranslated pattern
+    never degrades the whole engine. HALF-OPEN lifts the override and
+    forces shadow sampling; the next comparison on a request decides:
+    divergence on the pattern re-opens (cool-down re-arms), a clean run
+    closes it."""
+
+    def __init__(
+        self,
+        cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._open: dict[str, float] = {}  # pattern id -> opened_at
+        self._half_open: set[str] = set()
+        self.trip_count = 0
+        self.reopen_count = 0
+        self.close_count = 0
+
+    def trip(self, pattern_id: str) -> bool:
+        """Open (or re-open, from half-open) ``pattern_id``'s breaker.
+        True when this call changed the state."""
+        with self._lock:
+            was_half = pattern_id in self._half_open
+            self._half_open.discard(pattern_id)
+            already_open = pattern_id in self._open
+            self._open[pattern_id] = self.clock()
+            if was_half:
+                self.reopen_count += 1
+                return True
+            if not already_open:
+                self.trip_count += 1
+                return True
+            return False
+
+    def overridden_patterns(self) -> set[str]:
+        """Pattern ids whose columns must be served from the host regex
+        right now. Cool-down expiry transitions open → half-open here
+        (the next device batch serves the pattern natively again, under
+        forced shadow observation)."""
+        with self._lock:
+            now = self.clock()
+            for pid in [
+                p
+                for p, opened in self._open.items()
+                if self.cooldown_s > 0 and now - opened >= self.cooldown_s
+            ]:
+                del self._open[pid]
+                self._half_open.add(pid)
+            return set(self._open)
+
+    def probe_pending(self) -> bool:
+        """True while any breaker is half-open — the shadow sampler
+        forces a comparison so the probe actually resolves."""
+        with self._lock:
+            return bool(self._half_open)
+
+    def resolve(self, seen: set[str], diverged: set[str]) -> None:
+        """Feed one shadow-comparison outcome to the half-open breakers:
+        a half-open pattern SEEN in the comparison (it matched on this
+        request) without diverging closes. Divergent patterns are
+        re-opened via :meth:`trip` by the verifier; half-open patterns
+        absent from the request stay half-open — a corpus that never
+        exercises the pattern proves nothing."""
+        with self._lock:
+            for pid in list(self._half_open):
+                if pid in seen and pid not in diverged:
+                    self._half_open.discard(pid)
+                    self.close_count += 1
+
+    def any_active(self) -> bool:
+        with self._lock:
+            return bool(self._open or self._half_open)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "open": sorted(self._open),
+                "halfOpen": sorted(self._half_open),
+                "trips": self.trip_count,
+                "reopens": self.reopen_count,
+                "closes": self.close_count,
+            }

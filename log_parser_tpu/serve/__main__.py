@@ -114,6 +114,25 @@ def main(argv: list[str] | None = None) -> int:
         help="requests per coalesced device batch; a full bucket flushes "
         "immediately (LOG_PARSER_TPU_BATCH_MAX)",
     )
+    # poison-request quarantine + online shadow verification
+    # (docs/OPS.md "Poison-request triage" / "Shadow divergence")
+    parser.add_argument(
+        "--quarantine-strikes", type=int, default=None,
+        help="organic device-failure strikes before a request fingerprint "
+        "is quarantined to the golden host path "
+        "(LOG_PARSER_TPU_QUARANTINE_STRIKES)",
+    )
+    parser.add_argument(
+        "--quarantine-ttl-s", type=float, default=None, metavar="SECONDS",
+        help="how long a quarantined fingerprint stays off the device "
+        "step before re-admission (LOG_PARSER_TPU_QUARANTINE_TTL_S)",
+    )
+    parser.add_argument(
+        "--shadow-rate", type=float, default=None, metavar="RATE",
+        help="fraction of served requests re-run on the golden host path "
+        "off the hot path and compared at 1e-9; divergence trips a "
+        "per-pattern breaker (0 disables; LOG_PARSER_TPU_SHADOW_RATE)",
+    )
     parser.add_argument(
         "--faults", default=None, metavar="SPEC",
         help="fault-injection DSL, e.g. 'device_hang:2@after=3' "
@@ -157,6 +176,9 @@ def main(argv: list[str] | None = None) -> int:
         (args.batching, "LOG_PARSER_TPU_BATCHING"),
         (args.batch_wait_ms, "LOG_PARSER_TPU_BATCH_WAIT_MS"),
         (args.batch_max, "LOG_PARSER_TPU_BATCH_MAX"),
+        (args.quarantine_strikes, "LOG_PARSER_TPU_QUARANTINE_STRIKES"),
+        (args.quarantine_ttl_s, "LOG_PARSER_TPU_QUARANTINE_TTL_S"),
+        (args.shadow_rate, "LOG_PARSER_TPU_SHADOW_RATE"),
         (args.faults, "LOG_PARSER_TPU_FAULTS"),
         (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
         (args.broadcast_timeout, "LOG_PARSER_TPU_BROADCAST_TIMEOUT_S"),
@@ -351,6 +373,8 @@ def main(argv: list[str] | None = None) -> int:
         if engine.batcher is not None:
             # flush anything still queued before the process exits
             engine.batcher.close()
+        if engine.shadow is not None:
+            engine.shadow.close()
         if journal is not None:
             # fold the WAL tail into one final durable snapshot — a clean
             # shutdown must never need replay on the next boot

@@ -26,6 +26,14 @@ ladder (evaluated per request at admission):
    refused (503), in-flight work finishes up to a drain deadline, then
    the process exits.
 
+One rung sits BELOW this ladder, inside the engine: a request whose
+fingerprint is quarantined (runtime/quarantine.py — repeated organic
+device failures) is still admitted here and spends its slot, but the
+engine routes it straight to the golden host path without touching the
+device step; only if golden also fails does the caller see 429 +
+Retry-After (``QuarantineRejected`` — same wire shape as a shed, but
+scoped to ONE poison fingerprint rather than global load).
+
 Deadlines come from ``LOG_PARSER_TPU_DEADLINE_MS`` (0 = none) or the
 per-request ``X-Request-Deadline-Ms`` header (header wins). Concurrency
 bounds: ``LOG_PARSER_TPU_MAX_INFLIGHT`` (0 = unbounded) and

@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.engine import AnalysisEngine
+from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 
 log = logging.getLogger(__name__)
@@ -58,6 +59,10 @@ _TOO_LARGE = b'{"error":"payload too large"}'
 
 class ParseServer(ThreadingHTTPServer):
     daemon_threads = True
+    # socketserver's default listen backlog is 5; a synchronized burst
+    # (the micro-batching client pattern) can overflow it and get
+    # connection-refused before admission control ever sees the request
+    request_queue_size = 128
 
     def __init__(self, address: tuple[str, int], engine: AnalysisEngine):
         super().__init__(address, _Handler)
@@ -227,6 +232,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # requests still serve, but frequency durability is gone:
                 # a crash now loses the un-journaled tail
                 checks.append({"name": "journal", "status": "DEGRADED"})
+            if self.server.engine.breakers.any_active():
+                # shadow verification caught a device-vs-golden divergence:
+                # the divergent pattern(s) serve from the host regex until
+                # a clean half-open probe (docs/OPS.md "Shadow divergence")
+                checks.append({"name": "shadow", "status": "DEGRADED"})
             if checks:
                 return self._send_json(
                     200, json.dumps({"status": "UP", "checks": checks}).encode()
@@ -273,6 +283,13 @@ class _Handler(BaseHTTPRequestHandler):
             if journal is not None:
                 # WAL/snapshot counters (docs/OPS.md "State durability")
                 payload["journal"] = journal.stats()
+            # poison-request ledger (docs/OPS.md "Poison-request triage")
+            payload["quarantine"] = self.server.engine.quarantine.stats()
+            shadow = getattr(self.server.engine, "shadow", None)
+            if shadow is not None:
+                # online device-vs-golden verification + per-pattern
+                # breakers (docs/OPS.md "Shadow divergence")
+                payload["shadow"] = shadow.stats()
             payload["reload"] = {
                 "epoch": self.server.engine.reload_epoch,
                 "count": self.server.engine.reload_count,
@@ -360,6 +377,20 @@ class _Handler(BaseHTTPRequestHandler):
                     # the frequency-coupled finish phase serializes (on
                     # engine.state_lock)
                     result = self.server.engine.analyze_pipelined(data)
+            except QuarantineRejected as exc:
+                # a quarantined fingerprint the golden host path could not
+                # serve either — structured 429, try again after the TTL
+                return self._send_json(
+                    exc.status,
+                    json.dumps(
+                        {
+                            "error": "quarantined",
+                            "reason": exc.reason,
+                            "fingerprint": exc.fingerprint,
+                        }
+                    ).encode(),
+                    headers={"Retry-After": str(exc.retry_after_s)},
+                )
             except Exception:
                 # non-device bugs propagate out of analyze() by design
                 # (runtime/engine.py is_device_error) — answer with a JSON

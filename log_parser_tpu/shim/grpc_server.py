@@ -15,6 +15,7 @@ so environments without grpcio still get the framed transport.
 
 from __future__ import annotations
 
+from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
@@ -44,6 +45,10 @@ def _handlers(service: LogParserService):
                     else grpc.StatusCode.RESOURCE_EXHAUSTED,
                     str(exc),
                 )
+            except QuarantineRejected as exc:
+                # poison fingerprint whose golden path also failed: same
+                # back-off semantics as a shed, scoped to one request
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             except CLIENT_ERRORS as exc:
                 # client errors only: null pod, malformed JSON, invalid
                 # snapshot payloads. Internal bugs that surface as plain

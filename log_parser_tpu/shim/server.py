@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import socketserver
 
+from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
@@ -79,9 +80,11 @@ class _Handler(socketserver.BaseRequestHandler):
                         method=envelope.method,
                         payload=fn(req).SerializeToString(),
                     )
-            except AdmissionRejected as exc:
-                # expected under overload/drain: shed quietly, the client
-                # reads the retry hint out of the error text
+            except (AdmissionRejected, QuarantineRejected) as exc:
+                # expected under overload/drain (shed) or for a poison
+                # fingerprint whose golden path also failed (quarantine):
+                # shed quietly, the client reads the retry hint out of
+                # the error text
                 log.info("shim request shed on %s: %s", envelope.method, exc)
                 response = pb.Envelope(method=envelope.method, error=str(exc))
             except CLIENT_ERRORS as exc:

@@ -189,9 +189,10 @@ def test_demux_fault_isolated_per_request():
         engine.batcher.close()
 
 
-def test_whole_batch_device_fault_falls_back_per_request():
-    """A device-classified failure of the shared step serves EVERY member
-    from the golden host path — one fallback per request, no errors."""
+def test_transient_batch_device_fault_recovers_on_device():
+    """A TRANSIENT device fault on the fused step (one injected raise)
+    no longer sinks the flush to golden: bisection retries the halves,
+    which succeed, and every member is served on-device."""
     engine = _batched_engine(batch_max=len(MIXED))
     engine.fallback_to_golden = True  # conftest disables it via env
     try:
@@ -201,7 +202,99 @@ def test_whole_batch_device_fault_falls_back_per_request():
         for p in pend:
             assert p.error is None
             assert p.result is not None and p.result.events
+        assert engine.fallback_count == 0
+        stats = engine.batcher.stats()
+        assert stats["bisects"] >= 1
+    finally:
+        engine.batcher.close()
+
+
+def test_persistent_batch_device_fault_falls_back_per_request():
+    """A PERSISTENT device fault (fires on every retry) bisects all the
+    way down and every member takes the golden host path individually —
+    one fallback per request, no errors, log₂ structure visible in the
+    counters (len-1 sub-batches each isolate)."""
+    engine = _batched_engine(batch_max=len(MIXED))
+    engine.fallback_to_golden = True  # conftest disables it via env
+    try:
+        faults.install(FaultRegistry.parse("device_raise"))
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED]
+        _drain(pend)
+        for p in pend:
+            assert p.error is None
+            assert p.result is not None and p.result.events
         assert engine.fallback_count == len(MIXED)
+        stats = engine.batcher.stats()
+        assert stats["bisects"] >= 1
+        assert stats["bisectIsolated"] == len(MIXED)
+    finally:
+        engine.batcher.close()
+
+
+def test_poison_row_isolated_and_quarantined():
+    """ONE poison row in a fused flush: bisection isolates it, the three
+    healthy batchmates serve on-device with scores identical to a clean
+    serial stream, the culprit serves from golden and its fingerprint is
+    quarantined — a repeat submit never reaches the device step."""
+    poison = _pod(["INFO boot", "POISON-PILL marker", "OutOfMemoryError x"])
+    stream = [MIXED[0], poison, MIXED[1], MIXED[2]]
+    serial = AnalysisEngine(_sets(), ScoringConfig())
+    expected = [_events(serial.analyze_pipelined(d)) for d in stream]
+
+    from log_parser_tpu.runtime.quarantine import QuarantineTable
+
+    reg = FaultRegistry.parse("quarantine_raise@match=POISON-PILL")
+    faults.install(reg)
+    engine = _batched_engine(batch_max=len(stream))
+    engine.fallback_to_golden = True  # conftest disables it via env
+    engine.quarantine = QuarantineTable(strikes=1, ttl_s=600.0)
+    try:
+        pend = [engine.batcher._enqueue(d, None) for d in stream]
+        _drain(pend)
+        for p, want in zip(pend, expected):
+            # the healthy majority AND the golden-served culprit all match
+            # the clean serial stream exactly (device/golden parity)
+            assert p.error is None
+            assert _events(p.result) == want
+        stats = engine.batcher.stats()
+        assert engine.fallback_count == 1  # only the poison row fell back
+        assert stats["bisects"] >= 1
+        assert stats["bisectIsolated"] == 1
+        assert stats["demuxErrors"] == 0
+        assert engine.quarantine.stats()["active"] == 1
+
+        # the repeat is intercepted in submit(): served from golden with
+        # the keyed fault's fired counter pinned — proof the fingerprint
+        # never re-entered a shared batch or the device step
+        fired = reg.specs[0].fired
+        batched_before = stats["requestsBatched"]
+        repeat = engine.batcher.submit(poison)
+        assert _events(repeat) == expected[1]
+        assert reg.specs[0].fired == fired
+        assert engine.quarantine.stats()["servedGolden"] == 1
+        assert engine.batcher.stats()["requestsBatched"] == batched_before
+    finally:
+        engine.batcher.close()
+
+
+def test_bisect_abort_fault_degrades_to_whole_batch_fallback():
+    """An armed ``bisect`` fault vetoes the split: the flush degrades to
+    the pre-bisection behaviour (every member's fallback decision made
+    individually) — the chaos knob that measures what bisection buys."""
+    engine = _batched_engine(batch_max=len(MIXED))
+    engine.fallback_to_golden = True  # conftest disables it via env
+    try:
+        faults.install(
+            FaultRegistry.parse("device_raise@times=1,bisect_raise@times=1")
+        )
+        pend = [engine.batcher._enqueue(d, None) for d in MIXED]
+        _drain(pend)
+        for p in pend:
+            assert p.error is None and p.result is not None
+        assert engine.fallback_count == len(MIXED)
+        stats = engine.batcher.stats()
+        assert stats["bisects"] == 0
+        assert stats["bisectAborts"] == 1
     finally:
         engine.batcher.close()
 

@@ -1,0 +1,372 @@
+"""Poison-request quarantine, per-pattern breakers, and online shadow
+verification (runtime/quarantine.py + the engine wiring).
+
+The contract under test: ONE pathological request must not degrade the
+rest of the fleet. A fingerprint that keeps killing its device step is
+routed straight to the golden host path (never re-entering the device or
+a shared batch) until its TTL expires; a device-vs-golden score
+divergence surfaced by the shadow verifier contains itself to the
+divergent pattern's columns (host-regex override) instead of degrading
+the whole engine; and shadow sampling itself adds ZERO frequency drift —
+a rate-1.0 run is bit-identical to a no-shadow run.
+"""
+
+from __future__ import annotations
+
+import types
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.engine import ShadowVerifier
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.quarantine import (
+    PatternBreakerBoard,
+    QuarantineRejected,
+    QuarantineTable,
+    fingerprint,
+)
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom", regex="OutOfMemoryError", confidence=0.9
+                ),
+                make_pattern("conn", regex="Connection refused", confidence=0.7),
+            ]
+        )
+    ]
+
+
+def _pod(logs: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "q"}}, logs=logs)
+
+
+POISON = "INFO boot\nPOISON-PILL marker\njava OutOfMemoryError"
+HEALTHY = "INFO fine\ndial tcp: Connection refused\nINFO done"
+
+
+def _events(result):
+    return [
+        (e.line_number, e.matched_pattern.id, e.score) for e in result.events
+    ]
+
+
+# ----------------------------------------------------------- fingerprint
+
+
+class TestFingerprint:
+    def test_stable_and_content_sensitive(self):
+        assert fingerprint(POISON) == fingerprint(POISON)
+        assert fingerprint(POISON) != fingerprint(HEALTHY)
+        assert fingerprint("") == fingerprint("")
+
+    def test_normalization_matches_ingest(self):
+        # two different lone surrogates encode (errors="replace") to the
+        # same device batch, so they must share one fingerprint — the
+        # quarantine keys on what the DEVICE saw, like native/ingest.py
+        assert fingerprint("a\ud800b") == fingerprint("a\udfffb")
+
+    def test_shape_bucket_separates_padding_rungs(self):
+        # same leading bytes, line counts on different power-of-two rungs
+        # → different compiled program → different fingerprint identity
+        four = "\n".join(["x"] * 4)
+        five = "\n".join(["x"] * 5)
+        assert fingerprint(four) != fingerprint(five)
+
+
+# ------------------------------------------------------- QuarantineTable
+
+
+class TestQuarantineTable:
+    def test_strike_threshold_and_check(self):
+        t = QuarantineTable(strikes=2, ttl_s=300.0, clock=FakeClock())
+        fp = fingerprint(POISON)
+        assert t.strike(fp) is False  # first strike: tracked, not active
+        assert t.check(fp) is False
+        assert t.strike(fp) is True  # threshold crossed
+        assert t.check(fp) is True
+        s = t.stats()
+        assert s["strikes"] == 2
+        assert s["quarantined"] == 1
+        assert s["active"] == 1
+
+    def test_ttl_expiry_readmits_with_clean_slate(self):
+        clock = FakeClock()
+        t = QuarantineTable(strikes=2, ttl_s=10.0, clock=clock)
+        fp = fingerprint(POISON)
+        t.strike(fp)
+        t.strike(fp)
+        clock.advance(9.9)
+        assert t.check(fp) is True  # still inside the TTL
+        clock.advance(0.2)
+        assert t.check(fp) is False  # expired: dropped entirely
+        assert t.stats()["readmitted"] == 1
+        assert t.stats()["tracked"] == 0
+        # the slate is clean — one fresh strike must NOT re-quarantine
+        assert t.strike(fp) is False
+
+    def test_retry_after_counts_down(self):
+        clock = FakeClock()
+        t = QuarantineTable(strikes=1, ttl_s=300.0, clock=clock)
+        fp = fingerprint(POISON)
+        t.strike(fp)
+        clock.advance(100.0)
+        assert t.retry_after(fp) == pytest.approx(200.0)
+        assert t.retry_after("unknown") == 1.0  # floor for untracked fps
+
+    def test_lru_eviction_bounds_memory(self):
+        t = QuarantineTable(strikes=2, ttl_s=300.0, capacity=2, clock=FakeClock())
+        t.strike("fp-a")
+        t.strike("fp-b")
+        t.strike("fp-c")  # evicts fp-a (least recently struck)
+        s = t.stats()
+        assert s["tracked"] == 2
+        assert s["evicted"] == 1
+        # fp-a's strike history is gone: striking it again starts over
+        assert t.strike("fp-a") is False
+        # fp-c kept its first strike, so its second crosses the threshold
+        assert t.strike("fp-c") is True
+
+
+# --------------------------------------------------- PatternBreakerBoard
+
+
+class TestPatternBreakerBoard:
+    def test_trip_halfopen_close_cycle(self):
+        clock = FakeClock()
+        b = PatternBreakerBoard(cooldown_s=5.0, clock=clock)
+        assert b.trip("oom") is True
+        assert b.overridden_patterns() == {"oom"}
+        assert b.any_active() is True
+        assert b.probe_pending() is False
+        clock.advance(5.1)
+        # cool-down expiry: open → half-open, override lifts, probe forced
+        assert b.overridden_patterns() == set()
+        assert b.probe_pending() is True
+        # a clean comparison that SAW the pattern closes it
+        b.resolve(seen={"oom", "conn"}, diverged=set())
+        assert b.any_active() is False
+        s = b.stats()
+        assert (s["trips"], s["reopens"], s["closes"]) == (1, 0, 1)
+
+    def test_reopen_from_half_open(self):
+        clock = FakeClock()
+        b = PatternBreakerBoard(cooldown_s=5.0, clock=clock)
+        b.trip("oom")
+        clock.advance(5.1)
+        b.overridden_patterns()  # transitions to half-open
+        assert b.trip("oom") is True  # probe diverged again
+        s = b.stats()
+        assert s["reopens"] == 1
+        assert b.overridden_patterns() == {"oom"}
+
+    def test_resolve_ignores_unseen_patterns(self):
+        clock = FakeClock()
+        b = PatternBreakerBoard(cooldown_s=5.0, clock=clock)
+        b.trip("oom")
+        clock.advance(5.1)
+        b.overridden_patterns()
+        # a corpus that never exercises the pattern proves nothing
+        b.resolve(seen={"conn"}, diverged=set())
+        assert b.probe_pending() is True
+        assert b.stats()["closes"] == 0
+
+    def test_trip_while_open_is_idempotent(self):
+        b = PatternBreakerBoard(cooldown_s=5.0, clock=FakeClock())
+        assert b.trip("oom") is True
+        assert b.trip("oom") is False  # already open: refreshes, no count
+        assert b.stats()["trips"] == 1
+
+
+# ----------------------------------------------------- engine integration
+
+
+class TestEngineQuarantine:
+    def _engine(self, strikes=1, ttl_s=600.0):
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.fallback_to_golden = True
+        engine.quarantine = QuarantineTable(
+            strikes=strikes, ttl_s=ttl_s, clock=FakeClock()
+        )
+        return engine
+
+    def test_poison_strikes_then_repeat_never_reaches_device(self):
+        serial = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        want = _events(serial.analyze_pipelined(_pod(POISON)))
+        reg = FaultRegistry.parse("quarantine_raise@match=POISON-PILL")
+        faults.install(reg)
+        engine = self._engine(strikes=1)
+
+        r1 = engine.analyze_pipelined(_pod(POISON))
+        assert _events(r1) == want  # fallback result == device parity
+        assert engine.fallback_count == 1
+        assert engine.quarantine.stats()["active"] == 1
+        fired_after_strike = reg.specs[0].fired
+
+        # the repeat serves from golden WITHOUT touching the device step:
+        # the keyed fault sits at the device-step boundary, so its fired
+        # counter pinning is proof the request never got there
+        r2 = engine.analyze_pipelined(_pod(POISON))
+        assert _events(r2) == want
+        assert reg.specs[0].fired == fired_after_strike
+        assert engine.quarantine.stats()["servedGolden"] == 1
+        assert engine.fallback_count == 1  # no second fallback
+
+        # innocent traffic is untouched throughout
+        healthy = engine.analyze_pipelined(_pod(HEALTHY))
+        assert [e[1] for e in _events(healthy)] == ["conn"]
+        assert engine.fallback_count == 1
+
+    def test_ttl_expiry_readmits_to_device(self):
+        reg = FaultRegistry.parse("quarantine_raise@match=POISON-PILL@times=1")
+        faults.install(reg)
+        engine = self._engine(strikes=1, ttl_s=10.0)
+        engine.analyze_pipelined(_pod(POISON))  # strike → quarantined
+        assert engine.quarantine.stats()["active"] == 1
+        calls_quarantined = reg.specs[0].calls
+
+        engine.quarantine.clock.advance(11.0)
+        r = engine.analyze_pipelined(_pod(POISON))  # re-admitted: device path
+        assert r.events  # fault budget spent (times=1), device serves it
+        assert reg.specs[0].calls > calls_quarantined
+        assert engine.quarantine.stats()["readmitted"] == 1
+        assert engine.quarantine.stats()["active"] == 0
+
+    def test_below_threshold_stays_on_device(self):
+        faults.install(
+            FaultRegistry.parse("quarantine_raise@match=POISON-PILL@times=1")
+        )
+        engine = self._engine(strikes=2)
+        engine.analyze_pipelined(_pod(POISON))  # one strike of two
+        s = engine.quarantine.stats()
+        assert s["strikes"] == 1
+        assert s["active"] == 0
+        assert engine.quarantine.stats()["servedGolden"] == 0
+
+    def test_injected_backend_chaos_never_strikes(self):
+        # device_raise simulates BACKEND failure — quarantining the
+        # innocent request that happened to be in flight would be wrong
+        faults.install(FaultRegistry.parse("device_raise@times=1"))
+        engine = self._engine(strikes=1)
+        engine.analyze_pipelined(_pod(HEALTHY))
+        assert engine.fallback_count == 1
+        assert engine.quarantine.stats()["tracked"] == 0
+
+    def test_rejected_429_when_golden_also_fails(self, monkeypatch):
+        engine = self._engine(strikes=1, ttl_s=300.0)
+        fp = fingerprint(POISON)
+        engine.quarantine.strike(fp)
+
+        def _golden_down(data):
+            raise RuntimeError("golden down")
+
+        monkeypatch.setattr(engine, "_golden_serve", _golden_down)
+        with pytest.raises(QuarantineRejected) as ei:
+            engine.analyze_pipelined(_pod(POISON))
+        exc = ei.value
+        assert exc.status == 429
+        assert exc.fingerprint == fp
+        assert exc.retry_after_s >= 1.0
+        assert engine.quarantine.stats()["rejected"] == 1
+
+
+# ------------------------------------------------------ shadow verifier
+
+
+class TestShadowVerifier:
+    def test_rate_one_zero_divergence_zero_drift(self):
+        stream = [_pod(POISON), _pod(HEALTHY), _pod(POISON), _pod(HEALTHY)]
+        plain = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        want = [_events(plain.analyze_pipelined(d)) for d in stream]
+
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.enable_shadow(1.0, seed=0)
+        try:
+            got = [_events(engine.analyze_pipelined(d)) for d in stream]
+            assert engine.shadow.drain(timeout_s=60.0)
+            assert got == want  # shadowing never perturbs served scores
+            s = engine.shadow.stats()
+            assert s["sampled"] == len(stream)
+            assert s["compared"] == len(stream)
+            assert s["divergences"] == 0
+            assert s["errors"] == 0
+            # zero frequency drift: the cloned tracker never leaks a
+            # record back — both engines hold identical windowed state
+            assert (
+                engine.frequency._save_state() == plain.frequency._save_state()
+            )
+        finally:
+            engine.shadow.close()
+
+    def test_sampling_is_seed_deterministic(self):
+        def decisions(seed):
+            engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+            v = ShadowVerifier(engine, rate=0.5, seed=seed)
+            return [v.should_sample() for _ in range(64)]
+
+        assert decisions(7) == decisions(7)  # replayable under one seed
+        assert decisions(7) != decisions(8)  # and actually seed-driven
+        assert 0 < sum(decisions(7)) < 64  # a real Bernoulli stream
+
+    def test_full_queue_drops_instead_of_stalling(self):
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        v = ShadowVerifier(engine, rate=1.0, queue_max=4)  # never started
+        result = types.SimpleNamespace(events=[])
+        for _ in range(5):
+            v.submit(_pod(HEALTHY), {}, result)
+        assert v.stats()["dropped"] == 1
+        assert v.stats()["queueDepth"] == 4
+
+    def test_synthetic_divergence_breaker_ladder(self):
+        clock = FakeClock()
+        engine = AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+        engine.breakers = PatternBreakerBoard(cooldown_s=5.0, clock=clock)
+        faults.install(FaultRegistry.parse("shadow_raise@times=1"))
+        engine.enable_shadow(1.0, seed=0)
+        try:
+            r1 = engine.analyze_pipelined(_pod(POISON))
+            assert engine.shadow.drain(timeout_s=60.0)
+            s = engine.shadow.stats()
+            assert s["divergences"] == 1
+            assert s["lastDivergence"]["synthetic"] is True
+            tripped = s["lastDivergence"]["patterns"]
+            assert tripped == ["oom"]  # first matched pattern of the request
+            assert s["breakers"]["open"] == ["oom"]
+            assert engine.breakers.any_active()
+
+            # while OPEN the pattern serves from the exact host regex —
+            # scores must be indistinguishable from the device run
+            r2 = engine.analyze_pipelined(_pod(POISON))
+            assert engine.shadow.drain(timeout_s=60.0)
+            assert _events(r2) == _events(r1)
+            assert engine.shadow.stats()["divergences"] == 1  # no new ones
+
+            # cool-down: open → half-open; the forced probe runs clean on
+            # a request that exercises the pattern, closing the breaker
+            clock.advance(5.1)
+            r3 = engine.analyze_pipelined(_pod(POISON))
+            assert engine.shadow.drain(timeout_s=60.0)
+            assert _events(r3) == _events(r1)
+            s = engine.shadow.stats()
+            assert s["breakers"]["open"] == []
+            assert s["breakers"]["halfOpen"] == []
+            assert s["breakers"]["closes"] == 1
+            assert not engine.breakers.any_active()
+        finally:
+            engine.shadow.close()

@@ -55,6 +55,28 @@ docs/OPS.md "State durability & recovery"):
                              races a hot reload — zero failed requests,
                              the reload completes, epoch bumps.
 
+Poison group (``--group poison``; quarantine + bisection + shadow
+verification — docs/OPS.md "Poison-request triage" / "Shadow
+divergence"):
+
+- ``poison-batch-isolate``     ONE poison request inside a 16-request
+                               batched stream: bisection isolates it, the
+                               other 15 are served ON-DEVICE (zero
+                               fallbacks for them), the poison serves
+                               from golden, its fingerprint quarantines,
+                               and a repeat never reaches the device step
+                               (the keyed fault's fire counter is pinned).
+- ``poison-ttl-readmit``       a quarantined fingerprint is served
+                               golden without touching the device until
+                               ``--quarantine-ttl-s`` expires, then
+                               re-admitted to the device step with a
+                               clean slate.
+- ``shadow-divergence-breaker``  an injected ``shadow`` divergence flips
+                               /q/health to DEGRADED and opens the
+                               pattern's breaker; after the cool-down the
+                               half-open probe (forced shadow sample)
+                               closes it and health recovers.
+
 Distributed group (``--group distributed``; needs a jax build whose CPU
 backend supports multi-process collectives — reported SKIP otherwise):
 
@@ -68,7 +90,7 @@ backend supports multi-process collectives — reported SKIP otherwise):
                         processes down cleanly.
 
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|distributed|all]
+                                   [--group base|batcher|state|poison|distributed|all]
                                    [--keep-logs]
 """
 
@@ -308,12 +330,16 @@ def scenario_batch_device_fault(srv: Server):
     post(srv.url)  # warm: one device call burns after=1
     results = Burst(srv.url, 4).join(timeout=120)
     codes = sorted(s for s, _ in results)
-    # a whole-batch device failure serves every member from the golden
-    # host path — nobody sees a 500
+    # a transient device failure of the shared step never 500s anybody:
+    # bisection retries the halves on-device (a coalesced batch), or —
+    # if the faulted flush held a single request — that one serves from
+    # the golden host path
     assert codes == [200] * 4, codes
     _, trace = get(srv.url, "/trace/last")
-    assert trace["fallbackCount"] >= 1, trace["fallbackCount"]
-    assert trace["batcher"]["demuxErrors"] == 0, trace["batcher"]
+    b = trace["batcher"]
+    assert b["bisects"] + trace["fallbackCount"] >= 1, trace
+    assert trace["fallbackCount"] <= 1, trace["fallbackCount"]
+    assert b["demuxErrors"] == 0, b
 
 
 BATCHER_FLAGS = ["--batching", "on", "--batch-wait-ms", "200", "--batch-max", "8"]
@@ -337,6 +363,189 @@ BATCHER_SCENARIOS = [
             "LOG_PARSER_TPU_FAULT_SEED": "42",
         },
         scenario_batch_device_fault,
+    ),
+]
+
+
+# ------------------------------------------------------ poison scenarios
+
+
+POISON_LOGS = "INFO boot\nPOISON-PILL marker line\njava.lang.OutOfMemoryError: heap"
+
+
+def post_logs(url: str, logs: str, timeout: float = 240.0):
+    body = json.dumps(
+        {"pod": {"metadata": {"name": "chaos"}}, "logs": logs}
+    ).encode()
+    req = urllib.request.Request(
+        url + "/parse", data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _poll_trace(url: str, pred, timeout: float = 30.0) -> dict:
+    """Poll /trace/last until ``pred(trace)`` — shadow verification is
+    asynchronous, its counters land after the response."""
+    deadline = time.monotonic() + timeout
+    trace: dict = {}
+    while time.monotonic() < deadline:
+        _, trace = get(url, "/trace/last")
+        if pred(trace):
+            return trace
+        time.sleep(0.2)
+    raise AssertionError(f"trace never satisfied predicate: {trace}")
+
+
+def scenario_poison_batch_isolate(srv: Server):
+    """The acceptance scenario: ONE poison request inside a 16-request
+    batched stream causes zero failures for the other 15 (served
+    on-device after bisection), the poison fingerprint quarantines, and a
+    repeat never reaches the device step again."""
+    post(srv.url)  # warm: compile the R=1 batch program off the clock
+    results: list[int] = []
+    lock = threading.Lock()
+
+    def one(logs: str) -> None:
+        status, _, _ = post_logs(srv.url, logs)
+        with lock:
+            results.append(status)
+
+    threads = [
+        threading.Thread(target=one, args=(LOGS,)) for _ in range(15)
+    ] + [threading.Thread(target=one, args=(POISON_LOGS,))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(240)
+    assert all(not t.is_alive() for t in threads), "burst stuck"
+    assert results == [200] * 16, sorted(results)
+    _, trace = get(srv.url, "/trace/last")
+    b, q = trace["batcher"], trace["quarantine"]
+    # exactly the poison row fell back to golden; the healthy 15 were
+    # served on-device (a fallback for any of them would show here)
+    assert trace["fallbackCount"] == 1, trace["fallbackCount"]
+    assert b["bisects"] >= 1, b
+    assert b["bisectIsolated"] == 1, b
+    assert b["demuxErrors"] == 0, b
+    assert q["quarantined"] == 1 and q["active"] == 1, q
+    fired_before = trace["faults"]["fired"]["quarantine_raise"]
+    # the repeat is routed straight to golden: the keyed fault sits at
+    # the device-step boundary, so its fire counter CANNOT move
+    status, _, _ = post_logs(srv.url, POISON_LOGS)
+    assert status == 200, status
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["faults"]["fired"]["quarantine_raise"] == fired_before, (
+        trace["faults"]
+    )
+    assert trace["quarantine"]["servedGolden"] >= 1, trace["quarantine"]
+
+
+def scenario_poison_ttl_readmit(srv: Server):
+    """Quarantine TTL expiry: the fingerprint serves golden until the TTL
+    lapses, then re-admits to the device step with a clean slate."""
+    post(srv.url)  # warm
+    # strike 1 (--quarantine-strikes 1): fault fires, golden serves, the
+    # fingerprint quarantines
+    status, _, _ = post_logs(srv.url, POISON_LOGS)
+    assert status == 200, status
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["quarantine"]["quarantined"] == 1, trace["quarantine"]
+    assert trace["faults"]["fired"]["quarantine_raise"] == 1, trace["faults"]
+    # inside the TTL: served golden, the device step is never evaluated
+    calls_before = trace["faults"]["calls"]["quarantine_raise"]
+    status, _, _ = post_logs(srv.url, POISON_LOGS)
+    assert status == 200, status
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["faults"]["calls"]["quarantine_raise"] == calls_before, (
+        trace["faults"]
+    )
+    assert trace["quarantine"]["servedGolden"] >= 1, trace["quarantine"]
+    # past the TTL: re-admitted to the device step (the keyed fault is
+    # evaluated again — its budget is spent, so the request succeeds
+    # on-device)
+    time.sleep(2.4)
+    status, _, _ = post_logs(srv.url, POISON_LOGS)
+    assert status == 200, status
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["quarantine"]["readmitted"] == 1, trace["quarantine"]
+    assert trace["quarantine"]["active"] == 0, trace["quarantine"]
+    assert trace["faults"]["calls"]["quarantine_raise"] > calls_before, (
+        trace["faults"]
+    )
+
+
+def scenario_shadow_divergence_breaker(srv: Server):
+    """An injected shadow divergence (rate 1.0) must flip /q/health to
+    DEGRADED and open the pattern's breaker; the half-open probe after
+    the 1s cool-down closes it and health recovers."""
+    assert post(srv.url)[0] == 200  # warm comparison (fault after=1: clean)
+    _poll_trace(srv.url, lambda t: t.get("shadow", {}).get("compared", 0) >= 1)
+    assert post(srv.url)[0] == 200  # this one's comparison diverges
+    trace = _poll_trace(
+        srv.url, lambda t: t.get("shadow", {}).get("divergences", 0) >= 1
+    )
+    sh = trace["shadow"]
+    assert sh["divergences"] == 1, sh
+    assert sh["breakers"]["open"], sh["breakers"]
+    assert sh["breakers"]["trips"] == 1, sh["breakers"]
+    _, health = get(srv.url, "/q/health")
+    assert {"name": "shadow", "status": "DEGRADED"} in health.get("checks", []), (
+        health
+    )
+    # requests keep answering 200 while the divergent pattern serves from
+    # the exact host regex
+    assert post(srv.url)[0] == 200
+    # cool-down expiry → half-open → the forced shadow sample on the next
+    # request closes the breaker (fault budget spent: comparison is clean)
+    time.sleep(1.4)
+    assert post(srv.url)[0] == 200
+    trace = _poll_trace(
+        srv.url,
+        lambda t: t.get("shadow", {}).get("breakers", {}).get("closes", 0) >= 1,
+    )
+    br = trace["shadow"]["breakers"]
+    assert not br["open"] and not br["halfOpen"], br
+    _, health = get(srv.url, "/q/health")
+    assert {"name": "shadow", "status": "DEGRADED"} not in health.get(
+        "checks", []
+    ), health
+
+
+POISON_SCENARIOS = [
+    (
+        "poison-batch-isolate",
+        [
+            "--batching", "on", "--batch-wait-ms", "500", "--batch-max", "16",
+            "--quarantine-strikes", "1", "--quarantine-ttl-s", "600",
+        ],
+        {
+            "LOG_PARSER_TPU_FAULTS": "quarantine_raise@match=POISON-PILL",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_poison_batch_isolate,
+    ),
+    (
+        "poison-ttl-readmit",
+        ["--quarantine-strikes", "1", "--quarantine-ttl-s", "2"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "quarantine_raise@match=POISON-PILL@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_poison_ttl_readmit,
+    ),
+    (
+        "shadow-divergence-breaker",
+        ["--shadow-rate", "1.0"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "shadow_raise@times=1@after=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+            "LOG_PARSER_TPU_PATTERN_BREAKER_COOLDOWN_S": "1",
+        },
+        scenario_shadow_divergence_breaker,
     ),
 ]
 
@@ -688,7 +897,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="chaos_sweep")
     parser.add_argument("--only", help="run a single scenario by name")
     parser.add_argument(
-        "--group", choices=("base", "batcher", "state", "distributed", "all"),
+        "--group",
+        choices=("base", "batcher", "state", "poison", "distributed", "all"),
         default="base",
         help="which scenario group to sweep (default: base; the "
         "distributed group needs multi-process CPU collective support)",
@@ -708,6 +918,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(BATCHER_SCENARIOS)
     if args.group in ("state", "all"):
         single_server.extend(STATE_SCENARIOS)
+    if args.group in ("poison", "all"):
+        single_server.extend(POISON_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:

@@ -30,6 +30,16 @@ Three modes:
   payloads) on ``POST /patterns/reload`` and ``POST /frequency/restore``
   and every response must be 400/409/413 with the engine provably
   untouched: same bank object, same frequency stats, same reload epoch.
+- ``--ingest``: NOT a parity sweep — a robustness sweep over the parse
+  ingest path. An in-process ``ParseServer`` takes seeded hostile
+  ``POST /parse`` traffic — invalid-UTF-8 raw bodies, NUL bytes, lone
+  surrogates (``\\udXXX`` escapes survive json.loads unpaired),
+  control-character soup, binary-ish blobs, and multi-MiB single lines —
+  and every request must answer 200 or a structured 4xx JSON error,
+  never an unhandled 500; on every reject the engine must be provably
+  untouched (same bank object, same frequency stats). Runs with fallback
+  DISABLED, so a hostile input that faults the device step surfaces as a
+  500 finding instead of hiding behind golden.
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
        [--sharded | --pattern-sharded | --long | --admin | --quick]
@@ -92,6 +102,7 @@ def main() -> int:
     mode.add_argument("--pattern-sharded", action="store_true")
     mode.add_argument("--long", action="store_true")
     mode.add_argument("--admin", action="store_true")
+    mode.add_argument("--ingest", action="store_true")
     mode.add_argument(
         "--quick",
         action="store_true",
@@ -109,7 +120,17 @@ def main() -> int:
         start = _MODE_DEFAULTS["admin"][0]
         print(f"== quick sweep: admin seeds {start}..{start + 4}", flush=True)
         rc |= run_admin_sweep(start, start + 5)
+        start = _MODE_DEFAULTS["ingest"][0]
+        print(f"== quick sweep: ingest seeds {start}..{start + 4}", flush=True)
+        rc |= run_ingest_sweep(start, start + 5)
         return rc
+    if args.ingest:
+        start, end = _MODE_DEFAULTS["ingest"]
+        if args.start is not None:
+            start = args.start
+        if args.end is not None:
+            end = args.end
+        return run_ingest_sweep(start, end)
     if args.admin:
         start, end = _MODE_DEFAULTS["admin"]
         if args.start is not None:
@@ -143,6 +164,7 @@ _MODE_DEFAULTS = {
     "pattern-sharded": (9003, 9053),
     "long": (31006, 31056),
     "admin": (41000, 41050),
+    "ingest": (51000, 51050),
 }
 
 
@@ -288,6 +310,119 @@ def run_admin_sweep(start: int, end: int) -> int:
         server.shutdown()
         server.server_close()
     print(f"DONE admin seeds {start}..{end - 1} fails: {fails} "
+          f"({time.time() - t0:.0f}s)")
+    return 1 if fails else 0
+
+
+def _ingest_logs_cases(rng: "random.Random") -> list[str]:
+    """Seeded hostile log blobs for POST /parse — valid JSON strings whose
+    CONTENT is hostile to the ingest/encode path: NULs, lone surrogates,
+    control soup, binary-ish bytes, and one multi-MiB single line."""
+    n = rng.randrange(1, 6)
+    junk = "".join(chr(rng.randrange(0x20, 0x7F)) for _ in range(16))
+    return [
+        # content NUL bytes mid-line (needs_host NUL rule)
+        f"INFO {junk}\nbad\x00line\x00here\nINFO after" * n,
+        # lone surrogates: json.dumps escapes them, json.loads round-trips
+        # them unpaired — the str the engine sees cannot utf-8 encode
+        f"lead \ud800 trail\n{junk}\npair \udfff\ud800 reversed",
+        # control-character soup + carriage returns
+        "".join(chr(rng.randrange(0, 32)) for _ in range(64)) + "\n" + junk,
+        # binary-ish: every latin-1 code point, shuffled
+        "".join(map(chr, rng.sample(range(256), 256))) * n,
+        # multi-MiB single line, no newline (capped-width tail re-match)
+        junk * ((2 << 20) // len(junk)),
+        # empty and whitespace-only corpora
+        rng.choice(["", " ", "\n" * rng.randrange(1, 9), "\x00"]),
+    ]
+
+
+def run_ingest_sweep(start: int, end: int) -> int:
+    """Fuzz the parse ingest path of an in-process ParseServer: hostile
+    bodies must answer 200 or a STRUCTURED 4xx (JSON with an "error" key),
+    never an unhandled 500, and a reject must leave the engine untouched.
+    Fallback stays disabled (module env), so a device fault caused by
+    hostile input is a 500 finding, not a silent golden save."""
+    import json
+    import random
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.patterns import load_pattern_directory
+    from log_parser_tpu.runtime import AnalysisEngine
+    from log_parser_tpu.serve.http import make_server
+
+    pattern_dir = os.path.join(_REPO, "log_parser_tpu", "patterns", "builtin")
+    engine = AnalysisEngine(load_pattern_directory(pattern_dir), ScoringConfig())
+    server = make_server(engine, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/parse"
+
+    def post(body: bytes) -> tuple[int, bytes]:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def freq_stats() -> str:
+        return json.dumps(
+            engine.frequency.get_frequency_statistics(), sort_keys=True
+        )
+
+    base_bank = engine.bank
+    t0 = time.time()
+    fails: list[tuple[int, str]] = []
+    try:
+        for seed in range(start, end):
+            rng = random.Random(seed)
+            bodies: list[bytes] = [
+                # raw invalid UTF-8 / non-JSON bodies -> 400
+                bytes(rng.randrange(128, 256) for _ in range(rng.randrange(1, 64))),
+                b"\xff\xfe{" + bytes([rng.randrange(256)]) * 8,
+                b"[1,2,3]",                       # JSON, wrong shape
+                b'{"pod": null, "logs": "x"}',    # null pod -> 400
+            ] + [
+                json.dumps(
+                    {"pod": {"metadata": {"name": f"fuzz-{seed}"}}, "logs": logs}
+                ).encode("utf-8")
+                for logs in _ingest_logs_cases(rng)
+            ]
+            for body in bodies:
+                before = freq_stats()
+                try:
+                    status, payload = post(body)
+                    if status == 200:
+                        continue  # legitimate parse; state may evolve
+                    if not 400 <= status < 500:
+                        raise AssertionError(
+                            f"unstructured failure {status}: {body[:80]!r}"
+                        )
+                    err = json.loads(payload)
+                    if not isinstance(err, dict) or "error" not in err:
+                        raise AssertionError(
+                            f"4xx without structured error: {payload[:120]!r}"
+                        )
+                    if engine.bank is not base_bank:
+                        raise AssertionError("reject swapped the bank")
+                    if freq_stats() != before:
+                        raise AssertionError(
+                            f"reject mutated frequency state: {body[:80]!r}"
+                        )
+                except Exception as exc:  # noqa: BLE001 - recorded, sweep continues
+                    fails.append((seed, repr(exc)[:300]))
+                    print(f"SEED {seed} FAILED: {exc!r}", flush=True)
+            if seed % 10 == 0:
+                print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
+    finally:
+        server.shutdown()
+        server.server_close()
+    print(f"DONE ingest seeds {start}..{end - 1} fails: {fails} "
           f"({time.time() - t0:.0f}s)")
     return 1 if fails else 0
 

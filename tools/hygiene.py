@@ -16,7 +16,12 @@ Checks (all files tracked by git, minus excluded dirs):
      docs/OPS.md (flag drift from new PRs fails the gate, not a reader);
   8. every fault-injection site fired anywhere in log_parser_tpu/ appears
      in the docs/OPS.md fault-site table (a chaos point nobody can look
-     up is a chaos point nobody exercises).
+     up is a chaos point nobody exercises);
+  9. every counter key the runtime can emit on GET /trace/last (the dict
+     literals under any ``def stats`` in the package, plus the
+     ``payload["..."]`` blocks of serve/http.py) is documented in
+     docs/OPS.md (an observability counter nobody can look up during an
+     incident is noise, not signal).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -158,6 +163,49 @@ def check_fault_sites_documented(root: Path) -> list[str]:
     return problems
 
 
+def check_trace_counters_documented(root: Path) -> list[str]:
+    """Check 9: the /trace/last observability surface must be documented.
+    Keys are harvested from (a) string keys of dict literals inside any
+    ``def stats`` in the package — every stats() feeds /trace/last — and
+    (b) ``payload["..."]`` assignments in serve/http.py. Each key must
+    appear as a word somewhere in docs/OPS.md, so a new counter lands
+    with its doc line (or a past one regains its lost doc) or the gate
+    fails."""
+    import ast
+
+    pkg = root / "log_parser_tpu"
+    ops = root / "docs" / "OPS.md"
+    if not pkg.is_dir() or not ops.is_file():
+        return []
+    ops_text = ops.read_text()
+    keys: dict[str, Path] = {}
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # check 5 owns syntax reporting
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.FunctionDef) and node.name == "stats"):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Dict):
+                    continue
+                for k in sub.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.setdefault(k.value, path)
+    http_src = pkg / "serve" / "http.py"
+    if http_src.is_file():
+        for key in re.findall(r'payload\["(\w+)"\]', http_src.read_text()):
+            keys.setdefault(key, http_src)
+    return [
+        f"{path}: /trace/last counter {key!r} is not documented in docs/OPS.md"
+        for key, path in sorted(keys.items())
+        if not re.search(rf"\b{re.escape(key)}\b", ops_text)
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -180,6 +228,7 @@ def main() -> int:
         # repo-wide invariants, only meaningful on a full scan
         problems.extend(check_serve_flags_documented(root))
         problems.extend(check_fault_sites_documented(root))
+        problems.extend(check_trace_counters_documented(root))
 
     for p in problems:
         print(p)
