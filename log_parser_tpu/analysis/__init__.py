@@ -1,0 +1,26 @@
+"""Static analysis of pattern libraries and runtime invariants.
+
+Two consumers:
+
+- :mod:`tools.pattern_lint` / the reload ladder's pre-canary lint stage
+  (:mod:`log_parser_tpu.runtime.reload`) call
+  :func:`log_parser_tpu.analysis.lint.lint_pattern_sets` to vet a pattern
+  library *before* any engine is built — ReDoS shapes on the host
+  fallback path, tier prediction with the build's own reason codes,
+  cross-pattern subsumption, prefilter quality, schema hygiene;
+- :mod:`tools.conlint` (hygiene check 10) enforces the runtime's
+  concurrency invariants on the source tree itself.
+"""
+
+from log_parser_tpu.analysis.lint import LintReport, lint_pattern_sets
+from log_parser_tpu.analysis.rules import Finding, RULES
+from log_parser_tpu.analysis.tiers import TierPrediction, classify_regex
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "RULES",
+    "TierPrediction",
+    "classify_regex",
+    "lint_pattern_sets",
+]

@@ -1,0 +1,375 @@
+"""Pattern-library lint: orchestrates every static pass over a library.
+
+``lint_pattern_sets`` takes parsed :class:`PatternSet` models (NOT an
+engine — nothing here compiles a bank or touches a device) and runs:
+
+1. schema/metadata validation (ids, severities, confidences);
+2. tier classification of every distinct column regex
+   (:mod:`.tiers` — same entry points, same reason codes as the build);
+3. ReDoS shape detection on the host-fallback path (:mod:`.redos`);
+4. prefilter-quality scoring from the classifier's literal stats;
+5. cross-pattern subsumption over the primary DFAs (:mod:`.subsumption`).
+
+The report is consumed by ``tools/pattern_lint.py`` (CLI), the reload
+ladder's lint stage (runtime/reload.py — findings become the structured
+409 body under ``--lint-patterns=block``), and ``/trace/last``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from log_parser_tpu.analysis import redos, subsumption
+from log_parser_tpu.analysis.rules import Finding
+from log_parser_tpu.analysis.tiers import (
+    HOST,
+    SKIPPED,
+    TierPrediction,
+    classify_regex,
+)
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.patterns.loader import VALID_SEVERITIES
+from log_parser_tpu.patterns.regex.parser import (
+    RegexUnsupportedError,
+    parse_java_regex,
+)
+
+_MIN_LITERAL_LEN = 4
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]
+    tiers: dict[str, dict]  # pattern id -> primary tier prediction json
+    stats: dict
+
+    @property
+    def gating_findings(self) -> list[Finding]:
+        return [f for f in self.findings if f.gating]
+
+    @property
+    def gating(self) -> bool:
+        return bool(self.gating_findings)
+
+    def counts(self) -> dict:
+        out = {"error": 0, "warn": 0, "info": 0}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def summary(self) -> dict:
+        """Small envelope for /trace/last and the reload response."""
+        return {
+            "findings": len(self.findings),
+            **self.counts(),
+            "gating": self.gating,
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "tiers": self.tiers,
+            "stats": self.stats,
+            "summary": self.summary(),
+        }
+
+
+def _set_label(pattern_set: PatternSet, index: int) -> str:
+    meta = pattern_set.metadata
+    if meta is not None and meta.library_id:
+        return meta.library_id
+    return f"<set {index}>"
+
+
+def lint_pattern_sets(
+    sets: list[PatternSet],
+    *,
+    check_subsumption: bool = True,
+    max_product_states: int = subsumption.DEFAULT_MAX_PRODUCT_STATES,
+) -> LintReport:
+    findings: list[Finding] = []
+    tiers: dict[str, dict] = {}
+
+    # ---- schema / metadata --------------------------------------------
+    id_first_set: dict[str, str] = {}
+    # (pattern_id, set, regex, role) per distinct column key, build order
+    column_roles: dict[tuple[str, bool], list[tuple[str, str, str]]] = {}
+    primary_of: list[tuple[str, str, str]] = []  # (pattern_id, set, regex)
+
+    for idx, ps in enumerate(sets):
+        set_id = _set_label(ps, idx)
+        if ps.metadata is None or not ps.metadata.library_id:
+            findings.append(
+                Finding(
+                    rule="schema-no-library-id",
+                    detail="pattern set has no metadata.library_id",
+                    set_id=set_id,
+                )
+            )
+        for pat in ps.patterns or []:
+            pid = pat.id or ""
+            if not pid.strip():
+                findings.append(
+                    Finding(
+                        rule="schema-empty-id",
+                        detail="pattern has a blank id",
+                        set_id=set_id,
+                    )
+                )
+            elif pid in id_first_set:
+                findings.append(
+                    Finding(
+                        rule="schema-duplicate-id",
+                        detail=f"id also defined in {id_first_set[pid]}",
+                        pattern_id=pid,
+                        set_id=set_id,
+                    )
+                )
+            else:
+                id_first_set[pid] = set_id
+            severity = pat.severity or ""
+            if severity and severity.upper() not in VALID_SEVERITIES:
+                findings.append(
+                    Finding(
+                        rule="schema-unknown-severity",
+                        detail=f"severity {severity!r} is not one of "
+                        f"{sorted(VALID_SEVERITIES)}",
+                        pattern_id=pid,
+                        set_id=set_id,
+                    )
+                )
+            if pat.primary_pattern is None:
+                findings.append(
+                    Finding(
+                        rule="schema-missing-primary",
+                        detail="no primary_pattern",
+                        pattern_id=pid,
+                        set_id=set_id,
+                    )
+                )
+                continue
+            regex = pat.primary_pattern.regex or ""
+            if not regex:
+                findings.append(
+                    Finding(
+                        rule="schema-empty-regex",
+                        detail="primary_pattern.regex is empty",
+                        pattern_id=pid,
+                        set_id=set_id,
+                    )
+                )
+                continue
+            confidence = pat.primary_pattern.confidence
+            if not 0.0 < confidence <= 1.0:
+                findings.append(
+                    Finding(
+                        rule="schema-bad-confidence",
+                        detail=f"confidence {confidence!r} outside (0, 1]",
+                        pattern_id=pid,
+                        set_id=set_id,
+                    )
+                )
+            primary_of.append((pid, set_id, regex))
+            column_roles.setdefault((regex, False), []).append(
+                (pid, set_id, "primary")
+            )
+            for sec in pat.secondary_patterns or []:
+                if sec.regex:
+                    column_roles.setdefault((sec.regex, False), []).append(
+                        (pid, set_id, "secondary")
+                    )
+            for seq in pat.sequence_patterns or []:
+                for ev in seq.events or []:
+                    if ev.regex:
+                        column_roles.setdefault((ev.regex, False), []).append(
+                            (pid, set_id, "sequence")
+                        )
+
+    # ---- tier classification + ReDoS + prefilter, per distinct column --
+    predictions: dict[tuple[str, bool], TierPrediction] = {}
+    for (regex, ci), roles in column_roles.items():
+        pred = classify_regex(regex, ci)
+        predictions[(regex, ci)] = pred
+        pid, set_id, role = roles[0]
+        where = f"{role} regex" + (
+            f" (+{len(roles) - 1} more use(s))" if len(roles) > 1 else ""
+        )
+        if pred.tier == SKIPPED:
+            findings.append(
+                Finding(
+                    rule="schema-invalid-regex",
+                    detail=f"{where}: {pred.detail}",
+                    pattern_id=pid,
+                    set_id=set_id,
+                    regex=regex,
+                    code=pred.reason_code,
+                )
+            )
+            continue
+        if pred.tier == HOST:
+            findings.append(
+                Finding(
+                    rule="tier-host-fallback",
+                    detail=f"{where}: {pred.detail}",
+                    pattern_id=pid,
+                    set_id=set_id,
+                    regex=regex,
+                    code=pred.reason_code,
+                )
+            )
+            if pred.literal_count == 0:
+                findings.append(
+                    Finding(
+                        rule="prefilter-none-host",
+                        detail=f"{where}: no required literal extractable "
+                        "even with lenient widening",
+                        pattern_id=pid,
+                        set_id=set_id,
+                        regex=regex,
+                    )
+                )
+        else:
+            if pred.literal_count == 0:
+                findings.append(
+                    Finding(
+                        rule="prefilter-none-device",
+                        detail=f"{where}: no required literal extractable",
+                        pattern_id=pid,
+                        set_id=set_id,
+                        regex=regex,
+                    )
+                )
+        if 0 < pred.max_literal_len < _MIN_LITERAL_LEN:
+            findings.append(
+                Finding(
+                    rule="prefilter-short-literal",
+                    detail=f"{where}: longest required literal is "
+                    f"{pred.max_literal_len} byte(s)",
+                    pattern_id=pid,
+                    set_id=set_id,
+                    regex=regex,
+                )
+            )
+        findings.extend(
+            _redos_findings(regex, ci, pid, set_id, where)
+        )
+
+    for pid, _set_id, regex in primary_of:
+        pred = predictions.get((regex, False))
+        if pred is not None and pid not in tiers:
+            tiers[pid] = pred.to_json()
+
+    # ---- cross-pattern subsumption over primary DFAs -------------------
+    stats: dict = {
+        "patterns": sum(len(ps.patterns or []) for ps in sets),
+        "sets": len(sets),
+        "columns": len(column_roles),
+    }
+    if check_subsumption:
+        findings.extend(
+            _subsumption_findings(
+                primary_of, predictions, stats, max_product_states
+            )
+        )
+    return LintReport(findings=findings, tiers=tiers, stats=stats)
+
+
+def _redos_findings(
+    regex: str, ci: bool, pid: str, set_id: str, where: str
+) -> list[Finding]:
+    """ReDoS scan on the strict AST, or the lenient (widened) AST for
+    host-only shapes — widening only ever ADDS repeats, so a clean
+    lenient scan is clean for the true pattern too."""
+    node = None
+    try:
+        node = parse_java_regex(regex, ci)
+    except RegexUnsupportedError:
+        try:
+            node = parse_java_regex(regex, ci, lenient=True)
+        except (RegexUnsupportedError, ValueError):
+            return [
+                Finding(
+                    rule="redos-unanalyzable",
+                    detail=f"{where}: outside the analyzable dialect",
+                    pattern_id=pid,
+                    set_id=set_id,
+                    regex=regex,
+                )
+            ]
+    return [
+        Finding(
+            rule=rule,
+            detail=f"{where}: {detail}",
+            pattern_id=pid,
+            set_id=set_id,
+            regex=regex,
+        )
+        for rule, detail in redos.scan_redos(node)
+    ]
+
+
+def _subsumption_findings(
+    primary_of: list[tuple[str, str, str]],
+    predictions: dict[tuple[str, bool], TierPrediction],
+    stats: dict,
+    max_product_states: int,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    # identical primary regex on two pattern ids: trivially equal
+    # languages, no product BFS needed (the bank interns one column)
+    by_regex: dict[str, tuple[str, str]] = {}
+    entries: list[tuple[str, subsumption.CompiledDfa]] = []
+    no_dfa = 0
+    for pid, set_id, regex in primary_of:
+        prior = by_regex.get(regex)
+        if prior is not None:
+            findings.append(
+                Finding(
+                    rule="subsume-duplicate",
+                    detail=f"primary regex is identical to pattern "
+                    f"{prior[0]!r} in {prior[1]}",
+                    pattern_id=pid,
+                    set_id=set_id,
+                    regex=regex,
+                )
+            )
+            continue
+        by_regex[regex] = (pid, set_id)
+        pred = predictions.get((regex, False))
+        if pred is None or pred.dfa is None:
+            if pred is not None and pred.tier not in (HOST, SKIPPED):
+                no_dfa += 1  # device column whose DFA declined (rare)
+            continue
+        entries.append((pid, pred.dfa))
+    relations, undecided = subsumption.compare_all(
+        entries, max_product_states
+    )
+    set_of = {pid: set_id for pid, set_id, _ in primary_of}
+    for pid_a, pid_b, rel in relations:
+        if rel == subsumption.EQUAL:
+            findings.append(
+                Finding(
+                    rule="subsume-duplicate",
+                    detail=f"primary accepts exactly the same lines as "
+                    f"pattern {pid_b!r} in {set_of.get(pid_b, '?')}",
+                    pattern_id=pid_a,
+                    set_id=set_of.get(pid_a, ""),
+                )
+            )
+        else:
+            narrow, broad = (
+                (pid_a, pid_b) if rel == subsumption.A_IN_B else (pid_b, pid_a)
+            )
+            findings.append(
+                Finding(
+                    rule="subsume-shadowed",
+                    detail=f"every line this primary matches also fires "
+                    f"pattern {broad!r} in {set_of.get(broad, '?')}",
+                    pattern_id=narrow,
+                    set_id=set_of.get(narrow, ""),
+                )
+            )
+    stats["subsumptionCompared"] = len(entries)
+    stats["subsumptionUndecided"] = undecided
+    stats["subsumptionNoDfa"] = no_dfa
+    return findings

@@ -1,0 +1,207 @@
+"""Static ReDoS detection on the regex AST.
+
+The device tiers are immune to catastrophic backtracking (a DFA or bit
+program is linear per byte by construction), but the *host* paths are
+not: the golden engine, canary validation, shadow verification, and the
+quarantine re-serve all run Python ``re`` — a backtracking engine — over
+every pattern in the library. One hostile-or-unlucky pattern shape plus
+one adversarial log line is a denial of service on every one of those
+paths, so pattern shapes with superlinear backtracking are rejected at
+lint time, before the library reaches the reload ladder.
+
+Three rules, all standard static ReDoS heuristics on the parsed AST
+(no NFA simulation needed):
+
+- ``redos-nested-quantifier`` — an unbounded repeat whose body is, up to
+  nullable context, another variable repeat (``(a+)+``, ``(x*y?)*``):
+  a run of the inner atom can be split between the loops in
+  exponentially many ways.
+- ``redos-overlapping-alternation`` — an alternation under an unbounded
+  repeat where two branches can start with the same byte AND one branch
+  can be a prefix of a string the other matches (``(a|ab)*``): each
+  iteration has two viable parses.
+- ``redos-adjacent-overlap`` — two adjacent unbounded repeats over
+  overlapping byte sets (``.*.*``): O(n²) split points, flagged at warn
+  because it is superlinear but not exponential.
+
+Heuristics over-approximate reachability (an ambiguous subexpression
+that no suffix can ever force to backtrack is still flagged) — that is
+the right trade for a lint gate: the fix is a one-line rewrite.
+"""
+
+from __future__ import annotations
+
+from log_parser_tpu.patterns.regex.parser import (
+    Alt,
+    Assertion,
+    Cat,
+    Empty,
+    Lit,
+    Node,
+    Rep,
+)
+
+
+def _nullable(node: Node) -> bool:
+    if isinstance(node, (Empty, Assertion)):
+        return True
+    if isinstance(node, Lit):
+        return False
+    if isinstance(node, Cat):
+        return all(_nullable(p) for p in node.parts)
+    if isinstance(node, Alt):
+        return any(_nullable(o) for o in node.options)
+    if isinstance(node, Rep):
+        return node.lo == 0 or _nullable(node.child)
+    return False
+
+
+def _first_bytes(node: Node) -> frozenset[int]:
+    """Over-approximate set of bytes a match of ``node`` can start with."""
+    if isinstance(node, Lit):
+        return node.byteset
+    if isinstance(node, (Empty, Assertion)):
+        return frozenset()
+    if isinstance(node, Alt):
+        out: frozenset[int] = frozenset()
+        for opt in node.options:
+            out |= _first_bytes(opt)
+        return out
+    if isinstance(node, Rep):
+        return _first_bytes(node.child) if node.hi != 0 else frozenset()
+    if isinstance(node, Cat):
+        out = frozenset()
+        for part in node.parts:
+            out |= _first_bytes(part)
+            if not _nullable(part):
+                break
+        return out
+    return frozenset()
+
+
+def _variable(rep: Rep) -> bool:
+    """The repeat can consume a *variable* number of copies."""
+    return rep.hi is None or rep.hi > rep.lo
+
+
+def _pumpable_inner_rep(node: Node) -> Rep | None:
+    """A variable repeat reachable from ``node`` through nullable context
+    only — i.e. strings of the inner atom reach the outer loop with no
+    mandatory separator byte pinning the split points."""
+    if isinstance(node, Rep):
+        if _variable(node) and not _nullable(node.child):
+            return node
+        return _pumpable_inner_rep(node.child)
+    if isinstance(node, Alt):
+        for opt in node.options:
+            found = _pumpable_inner_rep(opt)
+            if found is not None:
+                return found
+        return None
+    if isinstance(node, Cat):
+        for i, part in enumerate(node.parts):
+            others = node.parts[:i] + node.parts[i + 1 :]
+            if all(_nullable(o) for o in others):
+                found = _pumpable_inner_rep(part)
+                if found is not None:
+                    return found
+        return None
+    return None
+
+
+def _overlapping_alt(node: Node) -> tuple[Node, Node] | None:
+    """Two branches of an alternation under ``node`` where one branch's
+    full language can prefix the other's (approximated: first bytes
+    intersect and the shorter branch's language is not forced apart by
+    its own next byte — we settle for the first-byte intersection plus
+    both branches non-nullable, which captures (a|ab)* and (a|a)* while
+    leaving disjoint-first alternations like (ERROR|FATAL) alone)."""
+    if isinstance(node, Alt):
+        opts = node.options
+        for i in range(len(opts)):
+            for j in range(i + 1, len(opts)):
+                a, b = opts[i], opts[j]
+                if _nullable(a) or _nullable(b):
+                    continue
+                if _first_bytes(a) & _first_bytes(b):
+                    return a, b
+        for opt in opts:
+            found = _overlapping_alt(opt)
+            if found is not None:
+                return found
+        return None
+    if isinstance(node, Cat):
+        for part in node.parts:
+            found = _overlapping_alt(part)
+            if found is not None:
+                return found
+        return None
+    if isinstance(node, Rep):
+        return _overlapping_alt(node.child)
+    return None
+
+
+def _unbounded_first(node: Node) -> frozenset[int] | None:
+    """If ``node`` is (or trivially wraps) an unbounded repeat, the byte
+    set its loop consumes; None otherwise."""
+    if isinstance(node, Rep) and node.hi is None:
+        return _first_bytes(node.child)
+    return None
+
+
+def scan_redos(node: Node) -> list[tuple[str, str]]:
+    """Walk the AST; return ``(rule_id, detail)`` tuples."""
+    findings: list[tuple[str, str]] = []
+    seen_rules: set[str] = set()
+
+    def add(rule: str, detail: str) -> None:
+        if rule not in seen_rules:  # one finding per rule per regex
+            seen_rules.add(rule)
+            findings.append((rule, detail))
+
+    def walk(n: Node) -> None:
+        if isinstance(n, Rep):
+            if n.hi is None or n.hi > 1:
+                inner = _pumpable_inner_rep(n.child)
+                if inner is not None:
+                    add(
+                        "redos-nested-quantifier",
+                        "unbounded repeat pumps an inner variable repeat "
+                        "through nullable-only context",
+                    )
+                if n.hi is None:
+                    overlap = _overlapping_alt(n.child)
+                    if overlap is not None:
+                        add(
+                            "redos-overlapping-alternation",
+                            "alternation branches with overlapping first "
+                            "bytes under an unbounded repeat",
+                        )
+            walk(n.child)
+            return
+        if isinstance(n, Cat):
+            prev_loop: frozenset[int] | None = None
+            for part in n.parts:
+                if isinstance(part, (Assertion, Empty)):
+                    continue  # zero-width: does not separate the loops
+                loop = _unbounded_first(part)
+                if (
+                    prev_loop is not None
+                    and loop is not None
+                    and prev_loop & loop
+                ):
+                    add(
+                        "redos-adjacent-overlap",
+                        "adjacent unbounded repeats over overlapping "
+                        "byte sets",
+                    )
+                prev_loop = loop
+                walk(part)
+            return
+        if isinstance(n, Alt):
+            for opt in n.options:
+                walk(opt)
+            return
+
+    walk(node)
+    return findings

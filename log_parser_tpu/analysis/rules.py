@@ -1,0 +1,147 @@
+"""Lint rule registry + finding model.
+
+Every finding cites a rule id from :data:`RULES`; the rule fixes the
+severity. ``error`` and ``warn`` findings *gate* (CLI exits nonzero,
+``--lint-patterns=block`` rejects the reload); ``info`` findings are
+advisory. The builtin bank must be clean of gating findings — hygiene
+check 10 enforces that, and the doc-drift check pins every rule id to a
+row in docs/PATTERNS.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+ERROR, WARN, INFO = "error", "warn", "info"
+
+# rule id -> (severity, description)
+RULES: dict[str, tuple[str, str]] = {
+    # ---- YAML schema / metadata ----------------------------------------
+    "schema-duplicate-id": (
+        ERROR,
+        "the same pattern id appears more than once across the library "
+        "(duplicates silently share one frequency counter)",
+    ),
+    "schema-unknown-severity": (
+        ERROR,
+        "severity is not a scoring-table value — it would silently "
+        "score at 1.0x, below INFO",
+    ),
+    "schema-invalid-regex": (
+        ERROR,
+        "the regex does not compile even on the host path — the "
+        "pattern can never match and is skipped at build time",
+    ),
+    "schema-empty-regex": (
+        ERROR,
+        "an empty regex matches every line",
+    ),
+    "schema-bad-confidence": (
+        WARN,
+        "primary confidence outside (0, 1] distorts every downstream "
+        "score factor",
+    ),
+    "schema-missing-primary": (
+        INFO,
+        "no primary_pattern: the pattern is carried but never matches",
+    ),
+    "schema-empty-id": (
+        INFO,
+        "blank pattern id: the pattern is excluded from frequency "
+        "tracking",
+    ),
+    "schema-no-library-id": (
+        INFO,
+        "pattern set has no metadata.library_id",
+    ),
+    # ---- ReDoS on the host fallback path -------------------------------
+    "redos-nested-quantifier": (
+        ERROR,
+        "an unbounded repeat directly pumps another variable repeat "
+        "(e.g. (a+)+) — exponential backtracking on the host re path",
+    ),
+    "redos-overlapping-alternation": (
+        ERROR,
+        "alternation with overlapping branches under an unbounded "
+        "repeat (e.g. (a|ab)*) — exponential backtracking on the host "
+        "re path",
+    ),
+    "redos-adjacent-overlap": (
+        WARN,
+        "two adjacent unbounded repeats over overlapping byte sets "
+        "(e.g. .*.*) — superlinear backtracking on the host re path",
+    ),
+    "redos-unanalyzable": (
+        INFO,
+        "regex compiles on the host but is outside the analyzable "
+        "dialect even with lenient widening — ReDoS rules not applied",
+    ),
+    # ---- device-compilability tiers ------------------------------------
+    "tier-host-fallback": (
+        INFO,
+        "regex lands on the host re tier; the reason code names the "
+        "construct that declined the automaton path",
+    ),
+    # ---- prefilter quality ---------------------------------------------
+    "prefilter-none-host": (
+        WARN,
+        "host-tier regex with NO extractable required literal: every "
+        "request pays a full host-re scan over every line",
+    ),
+    "prefilter-none-device": (
+        INFO,
+        "device-tier regex with no extractable literal cannot join the "
+        "Aho-Corasick prefilter on wide banks",
+    ),
+    "prefilter-short-literal": (
+        INFO,
+        "best required literal is under 4 bytes — weak prefilter "
+        "selectivity",
+    ),
+    # ---- cross-pattern subsumption -------------------------------------
+    "subsume-duplicate": (
+        ERROR,
+        "two patterns' primary regexes accept exactly the same language "
+        "(product-DFA equality) — one is redundant",
+    ),
+    "subsume-shadowed": (
+        INFO,
+        "one primary's language strictly contains another's: every line "
+        "the narrow pattern matches also fires the broad one",
+    ),
+}
+
+VALID_RULE_SEVERITIES = frozenset({ERROR, WARN, INFO})
+GATING_SEVERITIES = frozenset({ERROR, WARN})
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding; ``severity`` comes from the rule registry."""
+
+    rule: str
+    detail: str
+    pattern_id: str = ""
+    set_id: str = ""
+    regex: str = ""
+    code: str = ""  # reason code (patterns/regex/reasons.py) when relevant
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule][0]
+
+    @property
+    def gating(self) -> bool:
+        return self.severity in GATING_SEVERITIES
+
+    def to_json(self) -> dict:
+        out = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "detail": self.detail,
+        }
+        for key in ("pattern_id", "set_id", "regex", "code"):
+            value = getattr(self, key)
+            if value:
+                out[key] = value
+        return out

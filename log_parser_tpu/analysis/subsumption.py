@@ -1,0 +1,106 @@
+"""Cross-pattern subsumption via product-DFA language comparison.
+
+Two patterns whose primary regexes accept the *same* language are a
+copy-paste bug: both fire on every matching line, silently sharing (or
+splitting) frequency and double-reporting events. A strictly contained
+language is legitimate layering (a specific pattern refined by a broad
+one) but worth surfacing — the broad pattern fires on every line the
+specific one does.
+
+Comparison is exact over the compiled DFAs (patterns/regex/dfa.py): a
+line matches iff ``accept_end`` holds at end-of-input, so language
+comparison is a BFS over the product automaton tracking two one-way
+difference flags. One traversal answers both directions:
+
+- neither ``a\\b`` nor ``b\\a`` reachable → equal languages;
+- only one reachable → strict containment;
+- both → incomparable (the common case, reached fast).
+
+Pairs whose product exceeds ``max_product_states`` are reported as
+*undecided*, never silently dropped — the caller surfaces the count.
+DFAs here are containment matchers (unanchored prefix baked in), so
+"language" means "set of whole lines containing a match", exactly the
+engine's per-line semantics.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from log_parser_tpu.patterns.regex.dfa import CompiledDfa
+
+DEFAULT_MAX_PRODUCT_STATES = 20_000
+
+EQUAL = "equal"
+A_IN_B = "a-in-b"  # L(a) ⊊ L(b)
+B_IN_A = "b-in-a"
+INCOMPARABLE = "incomparable"
+UNDECIDED = "undecided"  # product-state budget exceeded
+
+
+def _product_classes(a: CompiledDfa, b: CompiledDfa) -> list[tuple[int, int]]:
+    """Distinct (byte_class_a, byte_class_b) pairs realized by some byte —
+    the product automaton's alphabet (usually far under 256)."""
+    pairs = {
+        (int(a.byte_class[byte]), int(b.byte_class[byte]))
+        for byte in range(256)
+    }
+    return sorted(pairs)
+
+
+def compare_dfas(
+    a: CompiledDfa,
+    b: CompiledDfa,
+    max_product_states: int = DEFAULT_MAX_PRODUCT_STATES,
+) -> str:
+    """Classify the relation between L(a) and L(b); see module docstring."""
+    classes = _product_classes(a, b)
+    start = (int(a.start), int(b.start))
+    seen = {start}
+    queue = deque([start])
+    a_minus_b = b_minus_a = False
+    while queue:
+        sa, sb = queue.popleft()
+        acc_a = bool(a.accept_end[sa])
+        acc_b = bool(b.accept_end[sb])
+        if acc_a and not acc_b:
+            a_minus_b = True
+        if acc_b and not acc_a:
+            b_minus_a = True
+        if a_minus_b and b_minus_a:
+            return INCOMPARABLE
+        for ca, cb in classes:
+            nxt = (int(a.trans[sa, ca]), int(b.trans[sb, cb]))
+            if nxt not in seen:
+                if len(seen) >= max_product_states:
+                    return UNDECIDED
+                seen.add(nxt)
+                queue.append(nxt)
+    if not a_minus_b and not b_minus_a:
+        return EQUAL
+    return A_IN_B if not a_minus_b else B_IN_A
+
+
+def compare_all(
+    entries: list[tuple[str, CompiledDfa]],
+    max_product_states: int = DEFAULT_MAX_PRODUCT_STATES,
+) -> tuple[list[tuple[str, str, str]], int]:
+    """Pairwise comparison of ``(label, dfa)`` entries.
+
+    Returns ``(relations, undecided_count)`` where ``relations`` holds
+    ``(label_a, label_b, relation)`` for every EQUAL/containment pair.
+    Identical-regex entries should be deduplicated by the caller first
+    (the bank interns them into one column anyway).
+    """
+    out: list[tuple[str, str, str]] = []
+    undecided = 0
+    for i in range(len(entries)):
+        label_a, dfa_a = entries[i]
+        for j in range(i + 1, len(entries)):
+            label_b, dfa_b = entries[j]
+            rel = compare_dfas(dfa_a, dfa_b, max_product_states)
+            if rel == UNDECIDED:
+                undecided += 1
+            elif rel != INCOMPARABLE:
+                out.append((label_a, label_b, rel))
+    return out, undecided

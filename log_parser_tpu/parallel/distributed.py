@@ -162,7 +162,7 @@ def broadcast_bytes(payload: bytes | None) -> bytes:
     BEFORE the first collective: an injected raise/hang here models a peer
     dying (or stalling) pre-broadcast — the one window where failure must
     not desync the follower group."""
-    faults.fire("broadcast")
+    faults.fire("broadcast")  # conlint: contained-by-caller (dispatch_with_retry / pre_swap)
     return transport().broadcast(payload)
 
 
@@ -212,8 +212,8 @@ class DistributedShardedEngine(ShardedEngine):
         emit a stale broadcast after its deadline."""
 
         def attempt(ctx):
-            faults.fire("follower")  # a follower stalling/failing the dispatch
-            faults.fire("broadcast")  # the coordinator-side transport itself
+            faults.fire("follower")  # conlint: contained-by-caller (dispatch_with_retry)
+            faults.fire("broadcast")  # conlint: contained-by-caller (dispatch_with_retry)
             ctx.enter_collective()
             transport().broadcast(payload)
 
@@ -343,7 +343,7 @@ class DistributedShardedEngine(ShardedEngine):
         t = transport()
 
         def attempt(ctx):
-            faults.fire("heartbeat")
+            faults.fire("heartbeat")  # conlint: contained-by-caller (dispatch_with_retry)
             ctx.enter_collective()
             t.broadcast(_PING)
             row = np.array([t.process_index(), 0], dtype=np.int64)

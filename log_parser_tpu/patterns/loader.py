@@ -21,18 +21,76 @@ from log_parser_tpu.models.pattern import PatternSet
 
 log = logging.getLogger(__name__)
 
+# Severity vocabulary of the scoring multipliers (golden/engine.py
+# SEVERITY_MULTIPLIERS); anything else would silently score at 1.0×, below
+# INFO — a typo'd CRITICAL must be a load error, not a quiet downgrade.
+# tests/test_patlint.py pins this set equal to the multiplier table's keys.
+VALID_SEVERITIES = frozenset({"CRITICAL", "HIGH", "MEDIUM", "LOW", "INFO"})
+
+
+class PatternValidationError(ValueError):
+    """A pattern set parsed but violates the schema.
+
+    ``findings`` is a list of ``{"rule", "pattern_id", "detail"}`` dicts so
+    HTTP surfaces (reload 409) and tools can report structure, not a blob.
+    """
+
+    def __init__(self, source: str, findings: list[dict]):
+        detail = "; ".join(
+            f"{f['rule']}({f['pattern_id']}): {f['detail']}" for f in findings
+        )
+        super().__init__(f"invalid pattern set {source}: {detail}")
+        self.source = source
+        self.findings = findings
+
+
+def validate_pattern_set(pattern_set: PatternSet, source: str = "<set>") -> None:
+    """Reject duplicate pattern ids and unknown severities at parse time.
+
+    Scoped to ONE set: patterns sharing an id across different sets share a
+    frequency counter by design (patterns/bank.py), so cross-set duplicates
+    are a lint finding (analysis/lint.py), not a load error.
+    """
+    findings: list[dict] = []
+    seen: set[str] = set()
+    for pat in pattern_set.patterns or []:
+        pid = pat.id or ""
+        if pid and pid in seen:
+            findings.append(
+                {
+                    "rule": "duplicate-id",
+                    "pattern_id": pid,
+                    "detail": "pattern id appears more than once in this set",
+                }
+            )
+        seen.add(pid)
+        if pat.severity and pat.severity.upper() not in VALID_SEVERITIES:
+            findings.append(
+                {
+                    "rule": "unknown-severity",
+                    "pattern_id": pid,
+                    "detail": f"severity {pat.severity!r} is not one of "
+                    f"{sorted(VALID_SEVERITIES)}",
+                }
+            )
+    if findings:
+        raise PatternValidationError(source, findings)
+
 
 def load_pattern_file(path: str) -> PatternSet:
     """Parse one YAML file into a :class:`PatternSet`.
 
-    Raises on malformed YAML — the directory walker catches and skips,
-    mirroring PatternService.java:77-85.
+    Raises on malformed YAML or schema violations (duplicate ids, unknown
+    severities) — the directory walker catches and skips, mirroring
+    PatternService.java:77-85.
     """
     with open(path, "r", encoding="utf-8") as fh:
         data = yaml.safe_load(fh)
     if not isinstance(data, dict):
         raise ValueError(f"pattern file {path!r} is not a YAML mapping")
-    return PatternSet.from_dict(data)
+    pattern_set = PatternSet.from_dict(data)
+    validate_pattern_set(pattern_set, source=path)
+    return pattern_set
 
 
 def _walk_yaml_files(directory: str) -> Iterable[str]:

@@ -43,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 
+from log_parser_tpu.patterns.regex import reasons
 from log_parser_tpu.patterns.regex.nfa import Nfa  # noqa: F401 (re-export convenience)
 from log_parser_tpu.patterns.regex.parser import (
     Alt,
@@ -60,7 +61,15 @@ ONE, PLUS, STAR, OPT = "one", "plus", "star", "opt"
 
 
 class BitUnsupportedError(ValueError):
-    """Regex shape outside the bit-parallel fragment."""
+    """Regex shape outside the bit-parallel fragment.
+
+    ``code`` is a stable reason code from :mod:`.reasons`, shared verbatim
+    with the static analyzer's tier classifier.
+    """
+
+    def __init__(self, message: str, code: str = reasons.BIT_UNSUPPORTED_NODE):
+        super().__init__(message)
+        self.code = code
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,14 +157,14 @@ def _expand(node: Node) -> list[list]:
         for opt in node.options:
             out.extend(_expand(opt))
             if len(out) > MAX_ALTERNATIVES:
-                raise BitUnsupportedError("alternative expansion too large")
+                raise BitUnsupportedError("alternative expansion too large", reasons.BIT_EXPANSION_TOO_LARGE)
         return out
     if isinstance(node, Cat):
         outs: list[list] = [[]]
         for part in node.parts:
             exp = _expand(part)
             if len(outs) * len(exp) > MAX_ALTERNATIVES:
-                raise BitUnsupportedError("alternative expansion too large")
+                raise BitUnsupportedError("alternative expansion too large", reasons.BIT_EXPANSION_TOO_LARGE)
             outs = [a + b for a, b in itertools.product(outs, exp)]
         return outs
     if isinstance(node, Rep):
@@ -168,16 +177,16 @@ def _expand(node: Node) -> list[list]:
                 return [[Item(bs, PLUS)]]
             if hi is None:  # {m,}: m-1 fixed + PLUS
                 if lo > MAX_BOUNDED_REPEAT:
-                    raise BitUnsupportedError("repeat bound too large")
+                    raise BitUnsupportedError("repeat bound too large", reasons.BIT_REPEAT_TOO_LARGE)
                 return [[Item(bs, ONE)] * (lo - 1) + [Item(bs, PLUS)]]
             if hi > MAX_BOUNDED_REPEAT:
-                raise BitUnsupportedError("repeat bound too large")
+                raise BitUnsupportedError("repeat bound too large", reasons.BIT_REPEAT_TOO_LARGE)
             return [[Item(bs, ONE)] * lo + [Item(bs, OPT)] * (hi - lo)]
         # multi-position child: expand bounded repeats as products
         if hi is None:
-            raise BitUnsupportedError("unbounded repeat of a group")
+            raise BitUnsupportedError("unbounded repeat of a group", reasons.BIT_UNBOUNDED_GROUP)
         if hi > 4:
-            raise BitUnsupportedError("group repeat bound too large")
+            raise BitUnsupportedError("group repeat bound too large", reasons.BIT_REPEAT_TOO_LARGE)
         child = _expand(node.child)
         out = []
         for n in range(lo, hi + 1):
@@ -185,12 +194,14 @@ def _expand(node: Node) -> list[list]:
             for _ in range(n):
                 pieces = [a + b for a, b in itertools.product(pieces, child)]
                 if len(pieces) > MAX_ALTERNATIVES:
-                    raise BitUnsupportedError("alternative expansion too large")
+                    raise BitUnsupportedError("alternative expansion too large", reasons.BIT_EXPANSION_TOO_LARGE)
             out.extend(pieces)
             if len(out) > MAX_ALTERNATIVES:
-                raise BitUnsupportedError("alternative expansion too large")
+                raise BitUnsupportedError("alternative expansion too large", reasons.BIT_EXPANSION_TOO_LARGE)
         return out
-    raise BitUnsupportedError(f"unsupported node {type(node).__name__}")
+    raise BitUnsupportedError(
+        f"unsupported node {type(node).__name__}", reasons.BIT_UNSUPPORTED_NODE
+    )
 
 
 def _attach(elements: list) -> BitAlternative:
@@ -209,7 +220,7 @@ def _attach(elements: list) -> BitAlternative:
         elif pending is None or pending == kind:
             pending = kind
         else:
-            raise BitUnsupportedError("conflicting adjacent assertions")
+            raise BitUnsupportedError("conflicting adjacent assertions", reasons.BIT_ASSERT_SHAPE)
         i += 1
 
     post: str | None = None
@@ -221,7 +232,7 @@ def _attach(elements: list) -> BitAlternative:
                 # must be trailing (possibly followed by more assertions)
                 rest = elements[i + 1 :]
                 if any(not isinstance(r, tuple) for r in rest):
-                    raise BitUnsupportedError("mid-pattern $")
+                    raise BitUnsupportedError("mid-pattern $", reasons.BIT_ASSERT_SHAPE)
                 post = "$"
                 i += 1
                 continue
@@ -229,9 +240,9 @@ def _attach(elements: list) -> BitAlternative:
                 # a mid-pattern line anchor can still be satisfiable when
                 # the prefix matches empty (e.g. "x*^ab"); the allow-mask
                 # machinery cannot express it, so route to an exact tier
-                raise BitUnsupportedError("mid-pattern ^")
+                raise BitUnsupportedError("mid-pattern ^", reasons.BIT_ASSERT_SHAPE)
             if pending is not None and pending != kind:
-                raise BitUnsupportedError("conflicting adjacent assertions")
+                raise BitUnsupportedError("conflicting adjacent assertions", reasons.BIT_ASSERT_SHAPE)
             pending = kind
             i += 1
             continue
@@ -262,7 +273,7 @@ def _attach(elements: list) -> BitAlternative:
                 i += 1  # drop the \w* item; nxt keeps no assertion
                 continue
             if item.skippable:
-                raise BitUnsupportedError("assertion before optional item")
+                raise BitUnsupportedError("assertion before optional item", reasons.BIT_ASSERT_SHAPE)
             item = dataclasses.replace(item, pre_assert=pending)
             pending = None
         items.append(item)
@@ -270,16 +281,16 @@ def _attach(elements: list) -> BitAlternative:
 
     if pending is not None:
         if post == "$":
-            raise BitUnsupportedError("assertion combined with $")
+            raise BitUnsupportedError("assertion combined with $", reasons.BIT_ASSERT_SHAPE)
         if pending not in ("b", "B"):
-            raise BitUnsupportedError("trailing anchor assertion")
+            raise BitUnsupportedError("trailing anchor assertion", reasons.BIT_ASSERT_SHAPE)
         post = pending  # trailing \b / \B
     if not items:
-        raise BitUnsupportedError("empty (assertion-only) alternative")
+        raise BitUnsupportedError("empty (assertion-only) alternative", reasons.BIT_EMPTY_MATCH)
     if len(items) > MAX_POSITIONS_PER_ALT:
-        raise BitUnsupportedError("alternative too long")
+        raise BitUnsupportedError("alternative too long", reasons.BIT_TOO_LONG)
     if all(it.skippable for it in items):
-        raise BitUnsupportedError("alternative matches the empty string")
+        raise BitUnsupportedError("alternative matches the empty string", reasons.BIT_EMPTY_MATCH)
     if post in ("b", "B"):
         # acceptance cascades back through a skippable suffix; the gate is
         # exact only when every accepting position consumed the byte whose
@@ -292,7 +303,7 @@ def compile_bitprog(node: Node) -> BitProgram:
     """AST → BitProgram, or raise :class:`BitUnsupportedError`."""
     alts = [_attach(el) for el in _expand(node)]
     if not alts:
-        raise BitUnsupportedError("no alternatives")
+        raise BitUnsupportedError("no alternatives", reasons.BIT_UNSUPPORTED_NODE)
     return BitProgram(alternatives=tuple(alts))
 
 
@@ -324,7 +335,7 @@ def _leading_variants(alt: BitAlternative) -> list[tuple[tuple, bool]]:
                 dataclasses.replace(first, kind=STAR, pre_assert=None),
             )
         else:  # skippable first items never carry pre_asserts (_attach)
-            raise BitUnsupportedError("leading assert on optional item")
+            raise BitUnsupportedError("leading assert on optional item", reasons.BIT_ASSERT_SHAPE)
         body = head + alt.items[1:]
         start_ok = (pa == "b") == wp  # virtual predecessor is non-word
         if start_ok:
@@ -335,7 +346,7 @@ def _leading_variants(alt: BitAlternative) -> list[tuple[tuple, bool]]:
     if not outs:
         # e.g. ^\B<word>: the assert is unsatisfiable at position 0 —
         # still a legal (never-matching) regex; keep it on a gated tier
-        raise BitUnsupportedError("unsatisfiable leading assert")
+        raise BitUnsupportedError("unsatisfiable leading assert", reasons.BIT_ASSERT_SHAPE)
     return outs
 
 
@@ -369,10 +380,10 @@ def _trailing_variants(
                     Item(part, ONE),
                 )
             else:
-                raise BitUnsupportedError("trailing assert after optional")
+                raise BitUnsupportedError("trailing assert after optional", reasons.BIT_ASSERT_SHAPE)
             splits.append((base, part <= WORD_BYTES))
     else:
-        raise BitUnsupportedError("word-ness-impure trailing cascade")
+        raise BitUnsupportedError("word-ness-impure trailing cascade", reasons.BIT_ASSERT_SHAPE)
     outs: list[tuple[tuple, str | None]] = []
     for base, wl in splits:
         follow = (NONWORD_BYTES if wl else WORD_BYTES) if post == "b" else (
@@ -382,7 +393,7 @@ def _trailing_variants(
         if (post == "b") == wl:  # virtual end-of-line byte is non-word
             outs.append((base, "$"))
     if not outs:
-        raise BitUnsupportedError("unsatisfiable trailing assert")
+        raise BitUnsupportedError("unsatisfiable trailing assert", reasons.BIT_ASSERT_SHAPE)
     return outs
 
 
@@ -412,18 +423,18 @@ def expand_asserts(prog: BitProgram) -> BitProgram:
             new_alts.append(alt)
             continue
         if any(it.pre_assert is not None for it in alt.items[1:]):
-            raise BitUnsupportedError("mid-pattern assert")
+            raise BitUnsupportedError("mid-pattern assert", reasons.BIT_ASSERT_SHAPE)
         for body, caret in _leading_variants(alt):
             for t_items, t_post in _trailing_variants(body, alt.post_assert):
                 if len(t_items) > MAX_POSITIONS_PER_ALT:
-                    raise BitUnsupportedError("expanded alternative too long")
+                    raise BitUnsupportedError("expanded alternative too long", reasons.BIT_TOO_LONG)
                 new_alts.append(
                     BitAlternative(
                         items=tuple(t_items), caret=caret, post_assert=t_post
                     )
                 )
                 if len(new_alts) > MAX_ALTERNATIVES:
-                    raise BitUnsupportedError("assert expansion too large")
+                    raise BitUnsupportedError("assert expansion too large", reasons.BIT_EXPANSION_TOO_LARGE)
     out = BitProgram(alternatives=tuple(new_alts))
     assert not has_asserts(out)
     return out

@@ -33,6 +33,10 @@ _BEGIN, _NONWORD, _WORD = 0, 1, 2
 class DfaLimitError(ValueError):
     """State count exceeded the cap — caller must fall back to host regex."""
 
+    # single decline cause, so the reason code is a class attribute; kept in
+    # sync with reasons.DFA_TOO_LARGE (asserted in tests/test_patlint.py)
+    code = "dfa-too-large"
+
 
 @dataclasses.dataclass
 class CompiledDfa:

@@ -89,13 +89,20 @@ class _Builder:
         raise TypeError(f"unknown AST node {node!r}")
 
     def _build_rep(self, node: Rep) -> tuple[int, int]:
+        from log_parser_tpu.patterns.regex import reasons
         from log_parser_tpu.patterns.regex.parser import RegexUnsupportedError
 
         lo, hi = node.lo, node.hi
         if hi is not None and hi > self.MAX_COUNTED:
-            raise RegexUnsupportedError(f"counted repetition max {hi} too large")
+            raise RegexUnsupportedError(
+                f"counted repetition max {hi} too large",
+                code=reasons.RX_REPEAT_TOO_LARGE,
+            )
         if lo > self.MAX_COUNTED:
-            raise RegexUnsupportedError(f"counted repetition min {lo} too large")
+            raise RegexUnsupportedError(
+                f"counted repetition min {lo} too large",
+                code=reasons.RX_REPEAT_TOO_LARGE,
+            )
 
         s = self.new_state()
         prev = s

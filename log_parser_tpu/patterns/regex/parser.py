@@ -25,6 +25,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Union
 
+from log_parser_tpu.patterns.regex import reasons
+
 MAX_BYTE = 0xFF
 
 WORD_BYTES = frozenset(
@@ -69,7 +71,16 @@ _POSIX_CONTENTS = {
 
 
 class RegexUnsupportedError(ValueError):
-    """Raised for Java regex constructs the automaton path cannot express."""
+    """Raised for Java regex constructs the automaton path cannot express.
+
+    ``code`` is a stable reason code from :mod:`.reasons`, shared verbatim
+    with the static analyzer's tier classifier so predicted and actual
+    decline reasons cannot drift.
+    """
+
+    def __init__(self, message: str, code: str = reasons.RX_SYNTAX):
+        super().__init__(message)
+        self.code = code
 
 
 # ----------------------------------------------------------------- AST nodes
@@ -146,8 +157,12 @@ class _Parser:
         self.lenient = lenient
         self._quoted_run = False  # last atom was a multi-char \Q..\E run
 
-    def fail(self, what: str) -> RegexUnsupportedError:
-        return RegexUnsupportedError(f"{what} at index {self.i} in {self.p!r}")
+    def fail(
+        self, what: str, code: str = reasons.RX_SYNTAX
+    ) -> RegexUnsupportedError:
+        return RegexUnsupportedError(
+            f"{what} at index {self.i} in {self.p!r}", code=code
+        )
 
     def peek(self) -> str | None:
         return self.p[self.i] if self.i < self.n else None
@@ -194,7 +209,10 @@ class _Parser:
                 # returns the run as one atom — quantifying it would
                 # repeat the WHOLE run. Decline to the host path, whose
                 # translation has the exact Java binding.
-                raise self.fail("quantifier after multi-char \\Q..\\E run")
+                raise self.fail(
+                    "quantifier after multi-char \\Q..\\E run",
+                    reasons.RX_QUOTED_QUANTIFIER,
+                )
             lo, hi = quant
             if isinstance(atom, Assertion):
                 # quantified assertions are meaningless; Java allows (\b)* etc.
@@ -241,7 +259,7 @@ class _Parser:
         nxt = self.peek()
         if nxt == "+":
             if not self.lenient:
-                raise self.fail("possessive quantifier")
+                raise self.fail("possessive quantifier", reasons.RX_POSSESSIVE)
             self.take()  # lenient: read as greedy (a language superset)
         elif nxt == "?":
             self.take()  # lazy — same language
@@ -289,7 +307,7 @@ class _Parser:
                 self.take()
                 if self.peek() in ("=", "!"):
                     if not self.lenient:
-                        raise self.fail("lookbehind")
+                        raise self.fail("lookbehind", reasons.RX_LOOKAROUND)
                     return self._lenient_zero_width()
                 # named group (?<name>...)
                 while self.peek() not in (">", None):
@@ -299,11 +317,11 @@ class _Parser:
                 self.take()
             elif nxt in ("=", "!"):
                 if not self.lenient:
-                    raise self.fail("lookahead")
+                    raise self.fail("lookahead", reasons.RX_LOOKAROUND)
                 return self._lenient_zero_width()
             elif nxt == ">":
                 if not self.lenient:
-                    raise self.fail("atomic group")
+                    raise self.fail("atomic group", reasons.RX_ATOMIC_GROUP)
                 # lenient: plain group (atomic language ⊆ greedy language)
                 self.take()
                 node = self.parse_alt()
@@ -320,14 +338,18 @@ class _Parser:
                 # reshape the language even for widening purposes
                 bad = "xuU" if self.lenient else "dmsuxU"
                 if any(f in flags for f in bad):
-                    raise self.fail(f"inline flags {flags!r}")
+                    raise self.fail(
+                        f"inline flags {flags!r}", reasons.RX_INLINE_FLAGS
+                    )
                 if self.peek() == ")":
                     # (?i) applies to the rest of the pattern
                     self.take()
                     self.ci = True
                     return Empty()
                 if self.peek() != ":":
-                    raise self.fail("bad inline flag group")
+                    raise self.fail(
+                        "bad inline flag group", reasons.RX_INLINE_FLAGS
+                    )
                 self.take()
                 saved = self.ci
                 self.ci = "i" in flags and "-" not in flags
@@ -365,17 +387,19 @@ class _Parser:
             return self._java_dollar()
         if ch == "G":
             if not self.lenient:
-                raise self.fail("\\G")
+                raise self.fail("\\G", reasons.RX_ESCAPE_UNSUPPORTED)
             return Empty()  # anchor dropped: widens
         if ch.isdigit():
             if not self.lenient:
-                raise self.fail("backreference")
+                raise self.fail("backreference", reasons.RX_BACKREFERENCE)
             while self.peek() is not None and self.peek().isdigit():
                 self.take()
             return self._lenient_any_run()
         if ch == "k":
             if not self.lenient:
-                raise self.fail("named backreference")
+                raise self.fail(
+                    "named backreference", reasons.RX_BACKREFERENCE
+                )
             if self.peek() == "<":
                 while self.peek() not in (">", None):
                     self.take()
@@ -393,7 +417,7 @@ class _Parser:
             return self._literal(chr(self._hex(4)))
         if ch == "0":
             if not self.lenient:
-                raise self.fail("octal escape")
+                raise self.fail("octal escape", reasons.RX_ESCAPE_UNSUPPORTED)
             digits = 0
             while digits < 3 and self.peek() is not None and self.peek() in "01234567":
                 self.take()
@@ -403,7 +427,9 @@ class _Parser:
             return self._quoted()
         if ch == "c":
             if not self.lenient:
-                raise self.fail("control escape")
+                raise self.fail(
+                    "control escape", reasons.RX_ESCAPE_UNSUPPORTED
+                )
             if self.peek() is not None:
                 self.take()
             return Lit(ALL_BYTES)
@@ -429,7 +455,7 @@ class _Parser:
 
     def _posix_contents(self) -> frozenset[int]:
         if self.peek() != "{":
-            raise self.fail("\\p without {")
+            raise self.fail("\\p without {", reasons.RX_ESCAPE_UNSUPPORTED)
         self.take()
         name = ""
         while self.peek() not in ("}", None):
@@ -438,7 +464,9 @@ class _Parser:
             raise self.fail("unterminated \\p{")
         self.take()
         if name not in _POSIX_CONTENTS:
-            raise self.fail(f"\\p{{{name}}}")
+            raise self.fail(
+                f"\\p{{{name}}}", reasons.RX_ESCAPE_UNSUPPORTED
+            )
         return _POSIX_CONTENTS[name]
 
     def _hex(self, digits: int) -> int:
@@ -485,9 +513,13 @@ class _Parser:
                 break
             first = False
             if ch == "[":
-                raise self.fail("nested character class")
+                raise self.fail(
+                    "nested character class", reasons.RX_CLASS_UNSUPPORTED
+                )
             if ch == "&" and self.p.startswith("&&", self.i):
-                raise self.fail("class intersection &&")
+                raise self.fail(
+                    "class intersection &&", reasons.RX_CLASS_INTERSECTION
+                )
             kind, value = self._class_member()
             if kind == "set":  # shorthand like \w — cannot anchor a range
                 add_byteset(value)
@@ -497,9 +529,13 @@ class _Parser:
                 self.take()
                 kind2, hi = self._class_member()
                 if kind2 != "byte":
-                    raise self.fail("bad range endpoint")
+                    raise self.fail(
+                        "bad range endpoint", reasons.RX_CLASS_UNSUPPORTED
+                    )
                 if hi < lo:
-                    raise self.fail("reversed range")
+                    raise self.fail(
+                        "reversed range", reasons.RX_CLASS_UNSUPPORTED
+                    )
                 for b in range(lo, hi + 1):
                     add_byteset(_fold_byte(b) if self.ci else frozenset({b}))
             else:
@@ -515,7 +551,10 @@ class _Parser:
         if ch != "\\":
             code = ord(ch)
             if code >= 128:
-                raise self.fail("non-ASCII in character class")
+                raise self.fail(
+                    "non-ASCII in character class",
+                    reasons.RX_CLASS_UNSUPPORTED,
+                )
             return "byte", code
         esc = self.take() if self.i < self.n else None
         if esc is None:
@@ -530,15 +569,22 @@ class _Parser:
         if esc == "u":
             code = self._hex(4)
             if code >= 128:
-                raise self.fail("non-ASCII in character class")
+                raise self.fail(
+                    "non-ASCII in character class",
+                    reasons.RX_CLASS_UNSUPPORTED,
+                )
             return "byte", code
         if esc in _SIMPLE_ESCAPES:
             return "byte", _SIMPLE_ESCAPES[esc]
         if esc == "b":
-            raise self.fail("\\b inside character class")
+            raise self.fail(
+                "\\b inside character class", reasons.RX_CLASS_UNSUPPORTED
+            )
         code = ord(esc)
         if code >= 128:
-            raise self.fail("non-ASCII in character class")
+            raise self.fail(
+                "non-ASCII in character class", reasons.RX_CLASS_UNSUPPORTED
+            )
         return "byte", code
 
 

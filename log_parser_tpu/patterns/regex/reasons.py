@@ -1,0 +1,99 @@
+"""Enumerated reason codes for regex-compilation declines.
+
+Every way a pattern can fall off a device tier — a parser reject
+(:class:`RegexUnsupportedError`), a determinization blowup
+(:class:`DfaLimitError`), a bit-program reject
+(:class:`BitUnsupportedError`) — carries one of these stable codes on the
+exception's ``code`` attribute. The static analyzer
+(:mod:`log_parser_tpu.analysis.tiers`) predicts tiers by catching the
+SAME exceptions from the SAME compile entry points, so a predicted
+reason and the build-time reason can never drift apart as free strings
+would: both cite one registry entry.
+
+Codes are grouped by the stage that emits them:
+
+- ``rx-*``  — the Java-dialect parser (parser.py) / NFA builder (nfa.py);
+  the pattern is host-only (``re`` fallback) unless noted;
+- ``dfa-*`` — subset construction (dfa.py / native builder);
+- ``bit-*`` — the bit-parallel program compiler (bitprog.py); the
+  pattern stays on an automaton tier, it just cannot ride the
+  gather-free bit engine.
+
+``docs/PATTERNS.md`` carries the operator-facing table; the hygiene gate
+(tools/hygiene.py) fails if a code exists here without a doc row.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------- parser declines
+RX_SYNTAX = "rx-syntax"
+RX_LOOKAROUND = "rx-lookaround"
+RX_BACKREFERENCE = "rx-backreference"
+RX_POSSESSIVE = "rx-possessive"
+RX_ATOMIC_GROUP = "rx-atomic-group"
+RX_CLASS_INTERSECTION = "rx-class-intersection"
+RX_CLASS_UNSUPPORTED = "rx-class-unsupported"
+RX_INLINE_FLAGS = "rx-inline-flags"
+RX_ESCAPE_UNSUPPORTED = "rx-escape-unsupported"
+RX_QUOTED_QUANTIFIER = "rx-quoted-quantifier"
+RX_REPEAT_TOO_LARGE = "rx-repeat-too-large"
+
+# ----------------------------------------------------------- DFA declines
+DFA_TOO_LARGE = "dfa-too-large"
+
+# ------------------------------------------------------ bit-tier declines
+BIT_EXPANSION_TOO_LARGE = "bit-expansion-too-large"
+BIT_REPEAT_TOO_LARGE = "bit-repeat-too-large"
+BIT_UNBOUNDED_GROUP = "bit-unbounded-group-repeat"
+BIT_ASSERT_SHAPE = "bit-assert-shape"
+BIT_EMPTY_MATCH = "bit-empty-match"
+BIT_TOO_LONG = "bit-alt-too-long"
+BIT_TOO_WIDE = "bit-too-wide"
+BIT_UNSUPPORTED_NODE = "bit-unsupported-node"
+
+# ------------------------------------------------------------ non-decline
+SUPPORTED = "supported"
+
+REASONS: dict[str, str] = {
+    RX_SYNTAX: "regex syntax error (unbalanced group, dangling "
+    "quantifier, bad escape, unterminated class)",
+    RX_LOOKAROUND: "lookahead/lookbehind has no finite-automaton "
+    "equivalent",
+    RX_BACKREFERENCE: "backreferences (numbered or named) are not "
+    "regular",
+    RX_POSSESSIVE: "possessive quantifier semantics are refused, not "
+    "approximated",
+    RX_ATOMIC_GROUP: "atomic group semantics are refused, not "
+    "approximated",
+    RX_CLASS_INTERSECTION: "character-class intersection (&&) is "
+    "unsupported",
+    RX_CLASS_UNSUPPORTED: "character-class shape outside the byte "
+    "dialect (nested class, non-ASCII member, \\b in class, bad range)",
+    RX_INLINE_FLAGS: "inline flags beyond (?i) reshape the language",
+    RX_ESCAPE_UNSUPPORTED: "escape outside the automaton dialect "
+    "(octal, control, \\G, unknown \\p{...})",
+    RX_QUOTED_QUANTIFIER: "quantifier after a multi-char \\Q..\\E run "
+    "binds differently in Java",
+    RX_REPEAT_TOO_LARGE: "counted repetition bound exceeds the NFA "
+    "state guard",
+    DFA_TOO_LARGE: "subset construction exceeded the DFA state cap",
+    BIT_EXPANSION_TOO_LARGE: "alternative/assert expansion exceeds the "
+    "bit-program cap",
+    BIT_REPEAT_TOO_LARGE: "bounded repeat too large for the bit "
+    "fragment",
+    BIT_UNBOUNDED_GROUP: "unbounded repeat of a multi-position group "
+    "is outside the bit fragment",
+    BIT_ASSERT_SHAPE: "assertion placement the bit engine cannot gate "
+    "exactly (mid-pattern anchor, assert on optional item, impure "
+    "cascade, unsatisfiable assert)",
+    BIT_EMPTY_MATCH: "alternative can match the empty string",
+    BIT_TOO_LONG: "alternative exceeds the per-alternative position "
+    "budget",
+    BIT_TOO_WIDE: "program exceeds the per-column position budget",
+    BIT_UNSUPPORTED_NODE: "AST node kind outside the bit fragment",
+    SUPPORTED: "no decline — the construct set is fully supported",
+}
+
+
+def describe(code: str) -> str:
+    return REASONS.get(code, "unknown reason code")

@@ -442,8 +442,8 @@ class MicroBatcher:
             # blob contains match= — the fused step dies exactly as a real
             # poison pill would, exercising bisection end to end
             for item in items:
-                faults.fire("quarantine", key=item.data.logs or "")
-            faults.fire("device")
+                faults.fire("quarantine", key=item.data.logs or "")  # conlint: contained-by-caller (watchdog.run)
+            faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
             return self.program.run(
                 lines, lens, nlin, om, ov, k_hint=engine._k_hint
             )

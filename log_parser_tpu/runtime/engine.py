@@ -414,6 +414,9 @@ class AnalysisEngine:
         self.reload_count = 0
         self.reload_failures = 0
         self.last_reload_error: str | None = None
+        # lint summary of the most recent reload attempt's candidate
+        # library (runtime/reload.py lint_stage) — /trace/last "lint"
+        self.last_lint: dict | None = None
         # observability (SURVEY.md §5.1/§5.5): per-phase timers and the full
         # factor breakdown of the most recent request
         self.last_trace: PhaseTrace | None = None
@@ -1172,7 +1175,7 @@ class AnalysisEngine:
         start = time.monotonic()
         trace = PhaseTrace()
         with trace.phase("ingest"):
-            faults.fire("ingest")
+            faults.fire("ingest")  # conlint: contained-by-caller (serve handler / batcher bisection)
             corpus = Corpus(data.logs or "", min_rows=self._corpus_min_rows())
             enc = corpus.encoded
 
@@ -1185,8 +1188,8 @@ class AnalysisEngine:
             # exercises the timeout/breaker exactly like a wedged backend;
             # the quarantine site is keyed by this request's content so a
             # match= spec can poison exactly one request
-            faults.fire("quarantine", key=data.logs or "")
-            faults.fire("device")
+            faults.fire("quarantine", key=data.logs or "")  # conlint: contained-by-caller (watchdog.run)
+            faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
             return self._run_device(enc, corpus.n_lines, om, ov)
 
         with trace.phase("device"):
@@ -1227,7 +1230,7 @@ class AnalysisEngine:
             freq_exists[slot] = self.frequency.has_entry(pid)
 
         with trace.phase("finalize"):
-            faults.fire("finalize")
+            faults.fire("finalize")  # conlint: contained-by-caller (serve handler / batcher bisection)
             fin = finalize_batch(
                 self.bank, self.tables, self.config, recs, corpus.n_lines,
                 freq_base, freq_exists,

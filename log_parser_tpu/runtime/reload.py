@@ -40,7 +40,11 @@ import yaml
 
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
-from log_parser_tpu.patterns.loader import load_pattern_directory
+from log_parser_tpu.patterns.loader import (
+    PatternValidationError,
+    load_pattern_directory,
+    validate_pattern_set,
+)
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.engine import AnalysisEngine
 
@@ -70,16 +74,23 @@ _MAX_LITERAL_LINES = 64
 
 class ReloadError(Exception):
     """A pattern reload rejected before the swap — the live engine is
-    untouched. ``stage`` is ``"build"``, ``"canary"``, or ``"swap"``."""
+    untouched. ``stage`` is ``"build"``, ``"lint"``, ``"canary"``, or
+    ``"swap"``. ``findings`` (lint/schema rejections) ride along into
+    the structured 409 body so the operator sees every violation, not
+    just the first."""
 
-    def __init__(self, stage: str, reason: str):
+    def __init__(self, stage: str, reason: str, findings: list[dict] | None = None):
         super().__init__(f"pattern reload failed at {stage}: {reason}")
         self.stage = stage
         self.reason = reason
+        self.findings = findings
 
     def to_json(self) -> dict:
-        return {"error": "reload rejected", "stage": self.stage,
-                "reason": self.reason}
+        out = {"error": "reload rejected", "stage": self.stage,
+               "reason": self.reason}
+        if self.findings:
+            out["findings"] = self.findings
+        return out
 
 
 def parse_yaml_sets(text: str) -> list[PatternSet]:
@@ -103,9 +114,17 @@ def parse_yaml_sets(text: str) -> list[PatternSet]:
     if not flat:
         raise ReloadError("build", "no pattern sets in body")
     try:
-        return [PatternSet.from_dict(d) for d in flat]
+        sets = [PatternSet.from_dict(d) for d in flat]
     except Exception as exc:
         raise ReloadError("build", f"invalid pattern set: {exc}") from exc
+    for i, pattern_set in enumerate(sets):
+        try:
+            validate_pattern_set(pattern_set, source=f"document {i}")
+        except PatternValidationError as exc:
+            raise ReloadError(
+                "build", str(exc), findings=exc.findings
+            ) from exc
+    return sets
 
 
 def canary_corpus(bank) -> str:
@@ -128,6 +147,42 @@ def canary_corpus(bank) -> str:
         lines.append(f"canary probe {text} end\n")
         emitted += 1
     return "".join(lines)
+
+
+def lint_stage(sets: list[PatternSet], mode: str, engine=None) -> dict | None:
+    """Pre-canary lint stage: static analysis of the candidate library
+    (log_parser_tpu/analysis/) BEFORE any engine is built.
+
+    ``mode``: ``"off"`` skips entirely; ``"warn"`` records findings (on
+    the engine's ``last_lint`` for /trace/last and in the success
+    envelope) but never rejects; ``"block"`` raises :class:`ReloadError`
+    at stage ``"lint"`` when any gating (error/warn-severity) finding
+    exists — the 409 body lists every finding. Returns the lint summary
+    dict (None when off)."""
+    if mode == "off":
+        return None
+    from log_parser_tpu.analysis import lint_pattern_sets
+
+    report = lint_pattern_sets(sets)
+    summary = report.summary()
+    if engine is not None:
+        engine.last_lint = summary
+    if report.gating and mode == "block":
+        gating = report.gating_findings
+        raise ReloadError(
+            "lint",
+            f"{len(gating)} gating lint finding(s): "
+            + ", ".join(sorted({f.rule for f in gating})),
+            findings=[f.to_json() for f in gating],
+        )
+    if report.gating:
+        log.warning(
+            "pattern lint found %d gating finding(s) (mode=warn, "
+            "proceeding): %s",
+            len(report.gating_findings),
+            sorted({f.rule for f in report.gating_findings}),
+        )
+    return summary
 
 
 def build_candidate(
@@ -202,9 +257,15 @@ class PatternReloader:
     internal lock: concurrent reload requests queue rather than racing
     two builds (the second sees the first's epoch in its response)."""
 
-    def __init__(self, engine: AnalysisEngine, pattern_dir: str | None = None):
+    def __init__(
+        self,
+        engine: AnalysisEngine,
+        pattern_dir: str | None = None,
+        lint_mode: str = "warn",  # off | warn | block (--lint-patterns)
+    ):
         self.engine = engine
         self.pattern_dir = pattern_dir
+        self.lint_mode = lint_mode
         self._lock = threading.Lock()
 
     def reload(
@@ -233,6 +294,7 @@ class PatternReloader:
                         raise ReloadError(
                             "build", f"no pattern sets loaded from {directory!r}"
                         )
+                lint = lint_stage(sets, self.lint_mode, engine=engine)
                 source = build_candidate(
                     sets, engine.config, engine_clock=engine.frequency.clock
                 )
@@ -259,13 +321,16 @@ class PatternReloader:
                 "pattern(s), %d canary event(s)",
                 epoch, len(sets), source.bank.n_patterns, validated,
             )
-            return {
+            envelope = {
                 "status": "reloaded",
                 "epoch": epoch,
                 "patternSets": len(sets),
                 "patterns": source.bank.n_patterns,
                 "canaryEvents": validated,
             }
+            if lint is not None:
+                envelope["lint"] = lint
+            return envelope
 
 
 class PatternWatcher:

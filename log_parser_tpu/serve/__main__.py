@@ -165,6 +165,13 @@ def main(argv: list[str] | None = None) -> int:
         "on change (canary-gated, runtime/reload.py); 0 disables "
         "(LOG_PARSER_TPU_WATCH_PATTERNS)",
     )
+    parser.add_argument(
+        "--lint-patterns", default=None, choices=("off", "warn", "block"),
+        help="static-analysis lint stage of the reload ladder "
+        "(log_parser_tpu/analysis/): 'warn' records findings on "
+        "/trace/last, 'block' rejects a reload with gating findings as "
+        "a structured 409; default warn (LOG_PARSER_TPU_LINT_PATTERNS)",
+    )
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
@@ -189,6 +196,7 @@ def main(argv: list[str] | None = None) -> int:
         (args.journal_fsync_ms, "LOG_PARSER_TPU_JOURNAL_FSYNC_MS"),
         (args.snapshot_every, "LOG_PARSER_TPU_SNAPSHOT_EVERY"),
         (args.watch_patterns, "LOG_PARSER_TPU_WATCH_PATTERNS"),
+        (args.lint_patterns, "LOG_PARSER_TPU_LINT_PATTERNS"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -348,7 +356,11 @@ def main(argv: list[str] | None = None) -> int:
     # directory (or takes inline YAML); --watch-patterns polls it
     from log_parser_tpu.runtime.reload import PatternReloader, PatternWatcher
 
-    server.reloader = PatternReloader(engine, config.pattern_directory)
+    server.reloader = PatternReloader(
+        engine,
+        config.pattern_directory,
+        lint_mode=os.environ.get("LOG_PARSER_TPU_LINT_PATTERNS", "warn"),
+    )
     watch_s = float(os.environ.get("LOG_PARSER_TPU_WATCH_PATTERNS", "0"))
     if watch_s > 0:
         server.watcher = PatternWatcher(

@@ -296,6 +296,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "failures": self.server.engine.reload_failures,
                 "lastError": self.server.engine.last_reload_error,
             }
+            last_lint = getattr(self.server.engine, "last_lint", None)
+            if last_lint is not None:
+                # static-analysis summary of the most recent reload
+                # candidate (docs/OPS.md "Lint-blocked reload")
+                payload["lint"] = last_lint
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats

@@ -1,0 +1,103 @@
+"""Known-bad input for tools/conlint.py (tests/test_conlint.py).
+
+Each ``bad_*`` function violates exactly one concurrency invariant the
+checker enforces; each ``ok_*`` function is a near-miss the checker must
+NOT flag. This file lives outside conlint's default scan scope
+(runtime/, serve/, parallel/) and is never imported by the runtime —
+it only needs to parse.
+"""
+
+import subprocess
+import threading
+import time
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.state_lock = threading.Lock()
+        self._scopes = threading.Semaphore(64)
+        self.faults = _FakeFaults()
+
+    def _request_scope(self):
+        return self._scopes
+
+
+class _FakeFaults:
+    def fire(self, site, key=""):
+        pass
+
+
+def bad_lock_order_nested(engine):
+    # request-scope entered while state_lock held -> conlint-lock-order
+    with engine.state_lock:
+        with engine._request_scope():
+            return 1
+
+
+def bad_lock_order_single_with(engine):
+    # same inversion in one with statement (items enter left-to-right)
+    with engine.state_lock, engine._request_scope():
+        return 1
+
+
+def bad_sleep_under_lock(engine):
+    with engine.state_lock:
+        time.sleep(0.1)  # -> conlint-blocking-under-lock
+
+
+def bad_join_under_lock(engine, worker):
+    engine.state_lock.acquire()
+    try:
+        worker.join(timeout=5.0)  # -> conlint-blocking-under-lock
+    finally:
+        engine.state_lock.release()
+
+
+def bad_wait_under_lock(engine, event):
+    with engine.state_lock:
+        event.wait()  # -> conlint-blocking-under-lock
+
+
+def bad_subprocess_under_lock(engine):
+    with engine.state_lock:
+        subprocess.run(["true"])  # -> conlint-blocking-under-lock
+
+
+def bad_uncontained_fire(engine):
+    engine.faults.fire("device")  # -> conlint-uncontained-fire
+    return 2
+
+
+def ok_scope_then_lock(engine):
+    # the documented order: quiesce gate first, then the lock
+    with engine._request_scope(), engine.state_lock:
+        return 1
+
+
+def ok_str_join_under_lock(engine, parts):
+    # str.join takes one iterable positional: not a thread join
+    with engine.state_lock:
+        return ",".join(parts)
+
+
+def ok_sleep_after_release(engine, worker):
+    engine.state_lock.acquire()
+    try:
+        pass
+    finally:
+        engine.state_lock.release()
+    time.sleep(0.01)
+    worker.join()
+
+
+def ok_contained_fire(engine):
+    try:
+        engine.faults.fire("device")
+    except RuntimeError:
+        return None
+    return 2
+
+
+def ok_waived_fire(engine):
+    engine.faults.fire("ingest")  # conlint: contained-by-caller (fixture)
+    return 3

@@ -1,0 +1,130 @@
+"""Concurrency-invariant linter (tools/conlint.py, hygiene check 10).
+
+Two-sided contract: the checker is CLEAN over the real tree (every
+waiver present and justified), and it FLAGS every violation seeded in
+``tests/fixtures/conlint_bad_fixture.py`` — inverted lock order,
+blocking calls under ``state_lock``, an uncontained ``faults.fire`` —
+while staying quiet on the fixture's near-miss ``ok_*`` functions.
+The checker imports nothing from the package, so these tests load it
+straight from its file path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+CONLINT = REPO / "tools" / "conlint.py"
+FIXTURE = REPO / "tests" / "fixtures" / "conlint_bad_fixture.py"
+
+_spec = importlib.util.spec_from_file_location("conlint", CONLINT)
+conlint = importlib.util.module_from_spec(_spec)
+sys.modules["conlint"] = conlint  # dataclasses resolves hints via sys.modules
+_spec.loader.exec_module(conlint)
+
+
+def _fixture_findings():
+    return conlint.check_file(str(FIXTURE))
+
+
+class TestRepoIsClean:
+    def test_default_scope_has_no_findings(self):
+        findings = conlint.check_paths(
+            [str(REPO / "log_parser_tpu" / d)
+             for d in ("runtime", "serve", "parallel")]
+        )
+        assert findings == [], [f"{f.file}:{f.line} {f.rule}" for f in findings]
+
+    def test_cli_exit_codes_and_json(self):
+        clean = subprocess.run(
+            [sys.executable, str(CONLINT), "--json"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        assert json.loads(clean.stdout) == []
+
+        bad = subprocess.run(
+            [sys.executable, str(CONLINT), "--json", str(FIXTURE)],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert bad.returncode == 1
+        findings = json.loads(bad.stdout)
+        assert findings and all(
+            set(f) == {"file", "line", "rule", "detail"} for f in findings
+        )
+
+
+class TestBadFixtureIsFlagged:
+    def test_every_seeded_violation_found(self):
+        by_rule: dict[str, list[int]] = {}
+        for f in _fixture_findings():
+            by_rule.setdefault(f.rule, []).append(f.line)
+        assert len(by_rule.get("conlint-lock-order", [])) == 2
+        assert len(by_rule.get("conlint-blocking-under-lock", [])) == 4
+        assert len(by_rule.get("conlint-uncontained-fire", [])) == 1
+
+    def test_findings_point_into_bad_functions_only(self):
+        source = FIXTURE.read_text().splitlines()
+        current = ""
+        owner_of: dict[int, str] = {}
+        for i, line in enumerate(source, 1):
+            if line.startswith("def "):
+                current = line.split("(")[0][4:]
+            owner_of[i] = current
+        for f in _fixture_findings():
+            assert owner_of[f.line].startswith("bad_"), (
+                f"{f.rule} at line {f.line} is inside "
+                f"{owner_of[f.line]!r}, expected a bad_* function"
+            )
+
+    @pytest.mark.parametrize(
+        "rule,detail_part",
+        [
+            ("conlint-lock-order", "while state_lock is held"),
+            ("conlint-blocking-under-lock", "time.sleep"),
+            ("conlint-blocking-under-lock", ".join(timeout=...)"),
+            ("conlint-blocking-under-lock", ".wait()"),
+            ("conlint-blocking-under-lock", "subprocess.run"),
+            ("conlint-uncontained-fire", "no containing try"),
+        ],
+    )
+    def test_details_name_the_operation(self, rule, detail_part):
+        assert any(
+            f.rule == rule and detail_part in f.detail
+            for f in _fixture_findings()
+        )
+
+
+class TestWaiverMechanism:
+    def test_waived_fire_site_is_suppressed(self):
+        # ok_waived_fire carries the waiver comment; the same call
+        # without it must be flagged — prove both directions
+        waived = FIXTURE.read_text()
+        assert "conlint: contained-by-caller" in waived
+        fire_lines = [
+            f.line for f in _fixture_findings()
+            if f.rule == "conlint-uncontained-fire"
+        ]
+        waiver_line = next(
+            i for i, ln in enumerate(waived.splitlines(), 1)
+            if "conlint: contained-by-caller" in ln
+        )
+        assert waiver_line not in fire_lines
+
+    def test_real_tree_waivers_name_their_container(self, tmp_path):
+        # every in-tree waiver must say where the containment lives
+        out = subprocess.run(
+            ["grep", "-rn", "conlint: contained-by-caller",
+             "log_parser_tpu"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        lines = [l for l in out.stdout.splitlines() if l]
+        assert lines, "expected waivered fire sites in the tree"
+        for line in lines:
+            assert "(" in line.split("contained-by-caller", 1)[1], line

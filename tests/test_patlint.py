@@ -1,0 +1,475 @@
+"""Pattern-library static analyzer (log_parser_tpu/analysis/).
+
+The contract under test, per ISSUE: the ReDoS rules flag every seeded
+pathological shape and stay quiet on the builtin-style regexes; the
+tier classifier's prediction matches the ACTUAL bank build column for
+column, with the same reason codes (same exceptions, same registry);
+subsumption answers containment exactly on known pairs; schema rules
+fire on seeded YAML defects; and the reload ladder's lint stage
+rejects under ``block`` while leaving the engine object-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from log_parser_tpu.analysis import classify_regex, lint_pattern_sets
+from log_parser_tpu.analysis import subsumption, tiers
+from log_parser_tpu.analysis.redos import scan_redos
+from log_parser_tpu.analysis.rules import RULES, VALID_RULE_SEVERITIES
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.ops.match import MatcherBanks
+from log_parser_tpu.patterns.bank import PatternBank
+from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+from log_parser_tpu.patterns.loader import (
+    PatternValidationError,
+    validate_pattern_set,
+)
+from log_parser_tpu.patterns.regex import reasons
+from log_parser_tpu.patterns.regex.dfa import DfaLimitError
+from log_parser_tpu.patterns.regex.parser import parse_java_regex
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.reload import PatternReloader, ReloadError
+from tests.helpers import make_pattern, make_pattern_set
+
+
+def _rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+def _yaml(sets) -> str:
+    return "\n---\n".join(
+        yaml.safe_dump(s.to_dict(drop_none=True)) for s in sets
+    )
+
+
+# ------------------------------------------------------------ registries
+
+
+class TestRegistries:
+    def test_every_rule_has_a_valid_severity(self):
+        for rule, (severity, description) in RULES.items():
+            assert severity in VALID_RULE_SEVERITIES, rule
+            assert description
+
+    def test_dfa_limit_code_matches_registry(self):
+        # dfa.py cannot import reasons (layering); the literal is pinned
+        assert DfaLimitError.code == reasons.DFA_TOO_LARGE
+
+    def test_bit_position_cap_mirrors_matcher_banks(self):
+        assert (
+            tiers.BIT_MAX_COLUMN_POSITIONS
+            == MatcherBanks.BITGLUSH_MAX_COLUMN_POSITIONS
+        )
+
+    def test_describe_known_and_unknown(self):
+        assert reasons.describe(reasons.RX_LOOKAROUND)
+        assert reasons.describe("no-such-code") == "unknown reason code"
+
+
+# ------------------------------------------------------- ReDoS detection
+
+
+REDOS_FLAGGED = [
+    ("(a+)+", "redos-nested-quantifier"),
+    ("(a*)*", "redos-nested-quantifier"),
+    ("([a-z]+)*X", "redos-nested-quantifier"),
+    ("(x*y?)*z", "redos-nested-quantifier"),
+    ("(a|ab)*c", "redos-overlapping-alternation"),
+    ("(a|a)*", "redos-overlapping-alternation"),
+    (".*.*x", "redos-adjacent-overlap"),
+    (r"\w+\w+", "redos-adjacent-overlap"),
+]
+
+REDOS_CLEAN = [
+    "(ab+c)+",
+    "(ERROR|FATAL|CRITICAL)",
+    r"^\s*at\s+[\w\.\$]+\(.*\)\s*$",
+    r"\b\w*Exception\b|\b\w*Error\b",
+    "OutOfMemoryError",
+    "a{2,5}",
+]
+
+
+class TestRedos:
+    @pytest.mark.parametrize("regex,rule", REDOS_FLAGGED)
+    def test_adversarial_corpus_is_flagged(self, regex, rule):
+        found = scan_redos(parse_java_regex(regex, False))
+        assert rule in {r for r, _ in found}, (regex, found)
+
+    @pytest.mark.parametrize("regex", REDOS_CLEAN)
+    def test_builtin_style_regexes_are_clean(self, regex):
+        assert scan_redos(parse_java_regex(regex, False)) == []
+
+    def test_gating_redos_rules_gate_through_lint(self):
+        sets = [
+            make_pattern_set([make_pattern("bad", regex="(a+)+!")], "lib")
+        ]
+        report = lint_pattern_sets(sets, check_subsumption=False)
+        assert report.gating
+        assert "redos-nested-quantifier" in _rules_of(report.gating_findings)
+
+
+class TestHostPathTimeBudget:
+    """Every regex that actually serves on the host ``re`` path must
+    finish a pathological line inside the budget. The builtin library
+    currently has zero host-tier columns — the loop must stay, so the
+    first PR that adds one inherits the budget check automatically."""
+
+    PATHOLOGICAL = "a" * 4096 + " " + "b" * 4096
+
+    def test_builtin_host_columns_within_budget(self):
+        bank = PatternBank(load_builtin_pattern_sets())
+        host_cols = [
+            c for c in bank.columns
+            if c.exact_seqs is None and c.dfa is None
+        ]
+        for col in host_cols:
+            start = time.monotonic()
+            col.host.search(self.PATHOLOGICAL)
+            assert time.monotonic() - start < 1.0, col.regex
+
+
+# -------------------------------------------------------- tier classifier
+
+
+class TestTierParity:
+    def test_prediction_matches_built_bank_column_for_column(self):
+        bank = PatternBank(load_builtin_pattern_sets())
+        assert bank.columns, "builtin bank built no columns"
+        mismatches = []
+        for col in bank.columns:
+            pred = classify_regex(col.regex, col.case_insensitive)
+            actual = (
+                tiers.SHIFTOR if col.exact_seqs is not None
+                else tiers.DFA if col.dfa is not None
+                else tiers.HOST
+            )
+            if pred.tier != actual:
+                mismatches.append((col.regex, pred.tier, actual))
+        assert mismatches == []
+
+    def test_supported_tiers_carry_supported_code(self):
+        pred = classify_regex("OutOfMemoryError")
+        assert pred.tier == tiers.SHIFTOR
+        assert pred.reason_code == reasons.SUPPORTED
+        assert pred.bit_capable
+
+    def test_host_reason_code_is_the_exceptions_code(self):
+        pred = classify_regex(r"(?<=foo)bar")
+        assert pred.tier == tiers.HOST
+        assert pred.reason_code == reasons.RX_LOOKAROUND
+        backref = classify_regex(r"(a)\1")
+        assert backref.tier == tiers.HOST
+        assert backref.reason_code == reasons.RX_BACKREFERENCE
+
+    def test_skipped_on_uncompilable(self):
+        pred = classify_regex("(unclosed")
+        assert pred.tier == tiers.SKIPPED
+        assert pred.reason_code == reasons.RX_SYNTAX
+
+    def test_prediction_json_shape(self):
+        out = classify_regex("ERROR|FATAL").to_json()
+        assert out["tier"] in (tiers.SHIFTOR, tiers.DFA)
+        assert set(out) >= {"regex", "tier", "reason", "bitCapable",
+                            "literals"}
+
+
+# ----------------------------------------------------------- subsumption
+
+
+def _dfa_of(regex: str):
+    pred = classify_regex(regex)
+    assert pred.dfa is not None, regex
+    return pred.dfa
+
+
+class TestSubsumption:
+    def test_equal_languages(self):
+        rel = subsumption.compare_dfas(_dfa_of("abc"), _dfa_of("ab[c]"))
+        assert rel == subsumption.EQUAL
+
+    def test_strict_containment_real_builtin_pair(self):
+        # any line containing OutOfMemoryError contains MemoryError
+        rel = subsumption.compare_dfas(
+            _dfa_of("OutOfMemoryError"), _dfa_of("MemoryError")
+        )
+        assert rel == subsumption.A_IN_B
+        assert subsumption.compare_dfas(
+            _dfa_of("MemoryError"), _dfa_of("OutOfMemoryError")
+        ) == subsumption.B_IN_A
+
+    def test_incomparable(self):
+        rel = subsumption.compare_dfas(_dfa_of("ERROR"), _dfa_of("WARN"))
+        assert rel == subsumption.INCOMPARABLE
+
+    def test_budget_exhaustion_is_undecided_not_wrong(self):
+        rel = subsumption.compare_dfas(
+            _dfa_of("ERROR"), _dfa_of("WARN"), max_product_states=1
+        )
+        assert rel == subsumption.UNDECIDED
+
+    def test_lint_reports_duplicate_and_shadow(self):
+        sets = [
+            make_pattern_set(
+                [
+                    make_pattern("jvm-oom", regex="OutOfMemoryError"),
+                    make_pattern("py-mem", regex="MemoryError"),
+                    make_pattern("oom-again", regex="OutOfMemoryError"),
+                ],
+                "lib",
+            )
+        ]
+        report = lint_pattern_sets(sets)
+        rules = _rules_of(report.findings)
+        assert "subsume-duplicate" in rules  # identical regex pair
+        assert "subsume-shadowed" in rules  # strict containment pair
+        assert report.stats["subsumptionUndecided"] == 0
+
+
+# --------------------------------------------------------- schema rules
+
+
+class TestSchemaRules:
+    def test_cross_set_duplicate_id_gates(self):
+        sets = [
+            make_pattern_set([make_pattern("dup", regex="AAA")], "lib-a"),
+            make_pattern_set([make_pattern("dup", regex="BBB")], "lib-b"),
+        ]
+        report = lint_pattern_sets(sets, check_subsumption=False)
+        assert "schema-duplicate-id" in _rules_of(report.gating_findings)
+
+    def test_unknown_severity_gates_lowercase_known_does_not(self):
+        bad = [make_pattern_set(
+            [make_pattern("p", severity="URGENT")], "lib")]
+        ok = [make_pattern_set(
+            [make_pattern("p", severity="high")], "lib")]
+        assert "schema-unknown-severity" in _rules_of(
+            lint_pattern_sets(bad, check_subsumption=False).gating_findings
+        )
+        assert "schema-unknown-severity" not in _rules_of(
+            lint_pattern_sets(ok, check_subsumption=False).findings
+        )
+
+    def test_empty_regex_and_invalid_regex_gate(self):
+        sets = [
+            make_pattern_set(
+                [
+                    make_pattern("empty", regex=""),
+                    make_pattern("broken", regex="(unclosed"),
+                ],
+                "lib",
+            )
+        ]
+        rules = _rules_of(
+            lint_pattern_sets(sets, check_subsumption=False).gating_findings
+        )
+        assert {"schema-empty-regex", "schema-invalid-regex"} <= rules
+
+    def test_bad_confidence_warns(self):
+        sets = [make_pattern_set(
+            [make_pattern("p", confidence=1.5)], "lib")]
+        report = lint_pattern_sets(sets, check_subsumption=False)
+        assert "schema-bad-confidence" in _rules_of(report.gating_findings)
+
+    def test_summary_counts(self):
+        sets = [make_pattern_set([make_pattern("p")], "lib")]
+        summary = lint_pattern_sets(sets, check_subsumption=False).summary()
+        assert set(summary) == {"findings", "error", "warn", "info",
+                                "gating"}
+        assert summary["gating"] is False
+
+
+class TestLoaderValidation:
+    def test_within_set_duplicate_id_is_a_parse_error(self):
+        ps = make_pattern_set(
+            [make_pattern("dup"), make_pattern("dup")], "lib"
+        )
+        with pytest.raises(PatternValidationError) as err:
+            validate_pattern_set(ps, source="lib.yaml")
+        assert err.value.source == "lib.yaml"
+        assert [f["rule"] for f in err.value.findings] == ["duplicate-id"]
+
+    def test_unknown_severity_is_a_parse_error(self):
+        ps = make_pattern_set([make_pattern("p", severity="WAT")], "lib")
+        with pytest.raises(PatternValidationError) as err:
+            validate_pattern_set(ps)
+        assert [f["rule"] for f in err.value.findings] == [
+            "unknown-severity"
+        ]
+
+    def test_case_insensitive_severity_accepted(self):
+        validate_pattern_set(
+            make_pattern_set([make_pattern("p", severity="critical")], "l")
+        )
+
+
+# ------------------------------------------------------- builtin library
+
+
+class TestBuiltinLibrary:
+    def test_builtin_is_gating_clean(self):
+        report = lint_pattern_sets(load_builtin_pattern_sets())
+        assert report.gating_findings == []
+        # and has real coverage: tiers were classified for every pattern
+        assert report.stats["patterns"] > 50
+        assert len(report.tiers) == report.stats["patterns"]
+        assert all(
+            t["tier"] in (tiers.SHIFTOR, tiers.DFA)
+            for t in report.tiers.values()
+        )
+
+
+# ------------------------------------------------- reload ladder gating
+
+
+def _sets_v1():
+    return [make_pattern_set(
+        [make_pattern("oom", regex="OutOfMemoryError")], "lib-v1")]
+
+
+def _sets_redos():
+    return [make_pattern_set(
+        [make_pattern("evil", regex="(a+)+!")], "lib-evil")]
+
+
+def _engine() -> AnalysisEngine:
+    return AnalysisEngine(_sets_v1(), ScoringConfig())
+
+
+class TestReloadLintGate:
+    def test_block_mode_rejects_and_engine_is_object_identical(self):
+        engine = _engine()
+        bank_before = engine.bank
+        epoch_before = engine.reload_epoch
+        reloader = PatternReloader(engine, lint_mode="block")
+        with pytest.raises(ReloadError) as err:
+            reloader.reload(yaml_text=_yaml(_sets_redos()))
+        assert err.value.stage == "lint"
+        body = err.value.to_json()
+        assert body["error"] == "reload rejected"
+        assert any(
+            f["rule"] == "redos-nested-quantifier" for f in body["findings"]
+        )
+        assert engine.bank is bank_before
+        assert engine.reload_epoch == epoch_before
+        # the attempt's lint summary is still exposed for /trace/last
+        assert engine.last_lint is not None
+        assert engine.last_lint["gating"] is True
+
+    def test_warn_mode_proceeds_and_reports(self):
+        engine = _engine()
+        envelope = PatternReloader(engine, lint_mode="warn").reload(
+            yaml_text=_yaml(_sets_redos())
+        )
+        assert envelope["status"] == "reloaded"
+        assert envelope["lint"]["gating"] is True
+        assert engine.last_lint == envelope["lint"]
+
+    def test_off_mode_has_no_lint_envelope(self):
+        engine = _engine()
+        envelope = PatternReloader(engine, lint_mode="off").reload(
+            yaml_text=_yaml(_sets_v1())
+        )
+        assert envelope["status"] == "reloaded"
+        assert "lint" not in envelope
+        assert engine.last_lint is None
+
+    def test_clean_reload_in_block_mode_succeeds(self):
+        engine = _engine()
+        envelope = PatternReloader(engine, lint_mode="block").reload(
+            yaml_text=_yaml(_sets_v1())
+        )
+        assert envelope["status"] == "reloaded"
+        assert envelope["lint"]["gating"] is False
+
+    def test_loader_schema_errors_reject_with_findings(self):
+        engine = _engine()
+        dup = [make_pattern_set(
+            [make_pattern("d", regex="A"), make_pattern("d", regex="B")],
+            "lib-dup",
+        )]
+        with pytest.raises(ReloadError) as err:
+            PatternReloader(engine, lint_mode="off").reload(
+                yaml_text=_yaml(dup)
+            )
+        assert err.value.stage == "build"
+        assert err.value.findings
+        assert err.value.findings[0]["rule"] == "duplicate-id"
+        assert engine.reload_epoch == 0
+
+    def test_trace_last_reports_lint_summary(self):
+        import json
+        import threading
+        import urllib.request
+
+        from log_parser_tpu.serve import make_server
+
+        engine = _engine()
+        PatternReloader(engine, lint_mode="warn").reload(
+            yaml_text=_yaml(_sets_redos())
+        )
+        server = make_server(engine, host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{server.server_address[1]}/trace/last"
+            with urllib.request.urlopen(url) as resp:
+                payload = json.loads(resp.read())
+        finally:
+            server.shutdown()
+        assert payload["lint"]["gating"] is True
+        assert payload["lint"]["findings"] >= 1
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestPatternLintCli:
+    def test_seeded_fixtures_flagged_with_exit_codes(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(
+            yaml.safe_dump(
+                make_pattern_set(
+                    [
+                        make_pattern("evil", regex="(a+)+!"),
+                        make_pattern("dup", regex="OutOfMemoryError"),
+                        make_pattern("dup", regex="Urgent",
+                                     severity="URGENT"),
+                        make_pattern("oom2", regex="OutOfMemoryError"),
+                    ],
+                    "lib-bad",
+                ).to_dict(drop_none=True)
+            )
+        )
+        cli = str(Path(__file__).resolve().parents[1]
+                  / "tools" / "pattern_lint.py")
+        proc = subprocess.run(
+            [_sys.executable, cli, "--json", str(bad)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        rules = {f["rule"] for f in report["findings"]}
+        assert {
+            "redos-nested-quantifier",
+            "schema-duplicate-id",
+            "schema-unknown-severity",
+            "subsume-duplicate",
+        } <= rules
+
+        missing = subprocess.run(
+            [_sys.executable, cli, str(tmp_path / "nope.yaml")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert missing.returncode == 2

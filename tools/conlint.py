@@ -1,0 +1,290 @@
+#!/usr/bin/env python3
+"""Concurrency-invariant linter for the runtime (hygiene check 10).
+
+The serving stack's thread-safety rests on three documented-by-convention
+invariants that nothing enforced until now. This checker enforces them
+statically (stdlib ``ast``, no imports of the checked code) across
+``log_parser_tpu/runtime/``, ``log_parser_tpu/serve/``, and
+``log_parser_tpu/parallel/``:
+
+``conlint-lock-order``
+    The request-scope quiescence gate (``_request_scope()``) must be
+    entered BEFORE ``state_lock`` (or its documented aliases
+    ``analyze_lock``/``self.lock = engine.state_lock``), never while the
+    lock is already held — the reload swap quiesces scopes while holding
+    the lock, so the inverted order deadlocks with a concurrent reload.
+
+``conlint-blocking-under-lock``
+    No blocking wait while holding ``state_lock``: ``time.sleep``,
+    thread-style ``.join()``, bare ``.wait()``, and ``subprocess.*``
+    calls stall every analyze/demux/swap on the box.
+
+``conlint-uncontained-fire``
+    Every ``faults.fire(...)`` call must sit lexically inside a ``try``
+    with an except handler in the same function, so an injected fault is
+    exercised WITH its containment. Sites whose containment is the
+    caller's by design carry a ``# conlint: contained-by-caller`` waiver
+    comment on the call line (the fault-site table in docs/OPS.md names
+    the containing path).
+
+The analysis is intra-procedural and lexical: a ``with`` statement's
+items are checked left-to-right (Python enters them in that order), and
+explicit ``state_lock.acquire()``/``release()`` pairs toggle the held
+state for the statements that follow in the same suite. Calls into
+helper functions are not traced — keep lock manipulation local, which
+is itself the convention this repo follows.
+
+Usage: ``python tools/conlint.py [--json] [PATH...]``; exits 1 on
+findings. The known-bad fixture ``tests/fixtures/conlint_bad_fixture.py``
+pins each rule against regressions (tests/test_conlint.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_SCAN_DIRS = (
+    os.path.join("log_parser_tpu", "runtime"),
+    os.path.join("log_parser_tpu", "serve"),
+    os.path.join("log_parser_tpu", "parallel"),
+)
+
+LOCK_NAMES = ("state_lock", "analyze_lock")
+SCOPE_NAME = "_request_scope"
+
+WAIVERS = {
+    "conlint-uncontained-fire": "contained-by-caller",
+    "conlint-blocking-under-lock": "allow-blocking",
+    "conlint-lock-order": "allow-lock-order",
+}
+
+RULES = {
+    "conlint-lock-order": "request-scope entered while state_lock held "
+    "(deadlocks against the reload swap's quiesce-under-lock)",
+    "conlint-blocking-under-lock": "blocking call while holding "
+    "state_lock stalls every request on the box",
+    "conlint-uncontained-fire": "faults.fire outside a containing try: "
+    "the injected fault escapes the path it is meant to exercise",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    file: str
+    line: int
+    rule: str
+    detail: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    return any(name in _expr_text(node) for name in LOCK_NAMES)
+
+
+def _is_scope_expr(node: ast.AST) -> bool:
+    return SCOPE_NAME in _expr_text(node)
+
+
+def _is_blocking_call(call: ast.Call) -> str | None:
+    """Name of the blocking operation, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        base = _expr_text(func.value)
+        if func.attr == "sleep" and base == "time":
+            return "time.sleep"
+        if base == "subprocess" or base.startswith("subprocess."):
+            return f"subprocess.{func.attr}"
+        if func.attr == "wait":
+            return ".wait()"
+        if func.attr == "join":
+            # str.join takes exactly one iterable positional; thread-style
+            # join takes none, a numeric timeout, or timeout= keyword
+            if not call.args and not call.keywords:
+                return ".join()"
+            if any(kw.arg == "timeout" for kw in call.keywords):
+                return ".join(timeout=...)"
+            if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, (int, float)):
+                return ".join(<seconds>)"
+    return None
+
+
+def _is_fire_call(call: ast.Call) -> bool:
+    func = call.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "fire"
+        and _expr_text(func.value).endswith("faults")
+    )
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Checks one function body. ``lock_depth`` counts state_lock
+    regions currently held; ``try_depth`` counts enclosing try-bodies
+    that have an except handler."""
+
+    def __init__(self, path: str, source_lines: list[str],
+                 findings: list[Finding]):
+        self.path = path
+        self.lines = source_lines
+        self.findings = findings
+        self.lock_depth = 0
+        self.try_depth = 0
+
+    # nested defs get their own checker via _check_tree; don't descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def _waived(self, line: int, rule: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            text = self.lines[line - 1]
+            return f"conlint: {WAIVERS[rule]}" in text
+        return False
+
+    def _report(self, node: ast.AST, rule: str, detail: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if not self._waived(line, rule):
+            self.findings.append(Finding(self.path, line, rule, detail))
+
+    def visit_With(self, node: ast.With) -> None:
+        entered_locks = 0
+        for item in node.items:
+            expr = item.context_expr
+            if _is_scope_expr(expr) and self.lock_depth + entered_locks > 0:
+                self._report(
+                    expr, "conlint-lock-order",
+                    f"{_expr_text(expr)} entered while state_lock is held",
+                )
+            if _is_lock_expr(expr):
+                entered_locks += 1
+            self.visit(expr)
+        self.lock_depth += entered_locks
+        for stmt in node.body:
+            self.visit(stmt)
+        self.lock_depth -= entered_locks
+
+    def visit_Try(self, node: ast.Try) -> None:
+        has_handler = bool(node.handlers)
+        if has_handler:
+            self.try_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if has_handler:
+            self.try_depth -= 1
+        for handler in node.handlers:
+            for stmt in handler.body:
+                self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        for stmt in node.finalbody:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # explicit acquire/release toggles the held state for the
+        # remainder of the suite (batcher's acquire ... try/finally
+        # release idiom); release may appear in a finally visited later,
+        # so depth is floored at zero
+        if isinstance(func, ast.Attribute) and _is_lock_expr(func.value):
+            if func.attr == "acquire":
+                self.lock_depth += 1
+            elif func.attr == "release":
+                self.lock_depth = max(0, self.lock_depth - 1)
+        if self.lock_depth > 0:
+            blocking = _is_blocking_call(node)
+            if blocking is not None and not _is_lock_expr(
+                getattr(func, "value", func)
+            ):
+                # lock.acquire()/cv.wait() ON the lock itself is the
+                # locking protocol, not a foreign blocking wait
+                self._report(
+                    node, "conlint-blocking-under-lock",
+                    f"{blocking} while holding state_lock",
+                )
+        if _is_fire_call(node) and self.try_depth == 0:
+            self._report(
+                node, "conlint-uncontained-fire",
+                f"{_expr_text(node)} has no containing try in this "
+                "function",
+            )
+        self.generic_visit(node)
+
+
+def _check_tree(path: str, tree: ast.AST, source: str,
+                findings: list[Finding]) -> None:
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            checker = _FunctionChecker(path, lines, findings)
+            for stmt in node.body:
+                checker.visit(stmt)
+
+
+def check_file(path: str, rel: str | None = None) -> list[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    findings: list[Finding] = []
+    _check_tree(rel or path, ast.parse(source, filename=path), source,
+                findings)
+    return findings
+
+
+def check_paths(paths: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in sorted(
+                (r, d, f) for r, d, f in os.walk(path)
+            ):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        full = os.path.join(root, name)
+                        findings.extend(
+                            check_file(full, os.path.relpath(full, REPO))
+                        )
+        else:
+            findings.extend(check_file(path, os.path.relpath(path, REPO)))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to check (default: runtime/, serve/, "
+        "parallel/)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON findings")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(REPO, d) for d in DEFAULT_SCAN_DIRS]
+    findings = check_paths(paths)
+    if args.json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f"{f.file}:{f.line}: {f.rule}: {f.detail}")
+        print(f"conlint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,13 @@ Checks (all files tracked by git, minus excluded dirs):
      literals under any ``def stats`` in the package, plus the
      ``payload["..."]`` blocks of serve/http.py) is documented in
      docs/OPS.md (an observability counter nobody can look up during an
-     incident is noise, not signal).
+     incident is noise, not signal);
+ 10. the static analyzers hold: tools/conlint.py is clean over
+     runtime/serve/parallel, tools/pattern_lint.py is gating-clean over
+     the builtin library, every pattern-lint rule id and regex reason
+     code has a row in docs/PATTERNS.md, and every conlint rule id has a
+     row in docs/OPS.md (an invariant nobody can look up is an invariant
+     nobody repairs).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -206,6 +212,91 @@ def check_trace_counters_documented(root: Path) -> list[str]:
     ]
 
 
+def _dict_keys_of(path: Path, name: str) -> list[str]:
+    """String keys of the module-level dict literal assigned to ``name``
+    in ``path`` — harvested via ast so hygiene never imports the package
+    (the analysis package pulls in the jax stack)."""
+    import ast
+
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []  # check 5 owns syntax reporting
+    consts: dict[str, str] = {}  # NAME = "literal" assignments seen so far
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        value = node.value
+        if (
+            isinstance(value, ast.Constant)
+            and isinstance(value.value, str)
+        ):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    consts[t.id] = value.value
+        if not any(isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            keys = []
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.append(k.value)
+                elif isinstance(k, ast.Name) and k.id in consts:
+                    keys.append(consts[k.id])
+            return keys
+    return []
+
+
+def check_static_analyzers(root: Path) -> list[str]:
+    """Check 10: run both static analyzers and pin their vocabularies to
+    the docs. ``conlint`` must be clean over its default scope and
+    ``pattern_lint --builtin`` gating-clean (a concurrency-invariant or
+    pattern-library regression fails the gate, not a 3am page); every
+    pattern-lint rule id and reason code needs its docs/PATTERNS.md row,
+    every conlint rule id its docs/OPS.md row."""
+    rules_src = root / "log_parser_tpu" / "analysis" / "rules.py"
+    reasons_src = root / "log_parser_tpu" / "patterns" / "regex" / "reasons.py"
+    conlint_src = root / "tools" / "conlint.py"
+    patterns_doc = root / "docs" / "PATTERNS.md"
+    ops_doc = root / "docs" / "OPS.md"
+    if not (rules_src.is_file() and conlint_src.is_file()):
+        return []
+    problems: list[str] = []
+
+    for tool, args, what in (
+        ("conlint.py", [], "concurrency-invariant findings"),
+        ("pattern_lint.py", ["--builtin"], "gating lint findings"),
+    ):
+        proc = subprocess.run(
+            [sys.executable, str(root / "tools" / tool), *args, "--json"],
+            cwd=root, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            cmd = " ".join(["python", f"tools/{tool}", *args])
+            problems.append(f"tools/{tool}: {what} (run `{cmd}` for the list)")
+
+    patterns_text = patterns_doc.read_text() if patterns_doc.is_file() else ""
+    for src, name in ((rules_src, "RULES"), (reasons_src, "REASONS")):
+        for key in _dict_keys_of(src, name):
+            if f"`{key}`" not in patterns_text:
+                problems.append(
+                    f"{src}: {name} entry {key!r} is not documented in "
+                    "docs/PATTERNS.md"
+                )
+    ops_text = ops_doc.read_text() if ops_doc.is_file() else ""
+    for key in _dict_keys_of(conlint_src, "RULES"):
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{conlint_src}: conlint rule {key!r} is not documented in "
+                "docs/OPS.md"
+            )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -229,6 +320,7 @@ def main() -> int:
         problems.extend(check_serve_flags_documented(root))
         problems.extend(check_fault_sites_documented(root))
         problems.extend(check_trace_counters_documented(root))
+        problems.extend(check_static_analyzers(root))
 
     for p in problems:
         print(p)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Pattern-library static analyzer CLI.
+
+Lints a pattern library WITHOUT building an engine (log_parser_tpu/
+analysis/): YAML schema hygiene, ReDoS shapes on the host fallback path,
+device-tier prediction with the build's own reason codes, prefilter
+quality, cross-pattern subsumption. The same pass gates ``/patterns/
+reload`` under ``--lint-patterns=block`` (docs/OPS.md) and hygiene
+check 10 runs it over the builtin bank.
+
+Usage:
+  python tools/pattern_lint.py PATH [PATH...]   # files and/or directories
+  python tools/pattern_lint.py --builtin        # the builtin bank
+  ... --json                                    # machine-readable report
+
+Exit codes: 0 = no gating (error/warn) findings; 1 = gating findings;
+2 = a path could not be loaded at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml  # noqa: E402
+
+from log_parser_tpu.analysis import lint_pattern_sets  # noqa: E402
+from log_parser_tpu.models.pattern import PatternSet  # noqa: E402
+from log_parser_tpu.patterns.loader import _walk_yaml_files  # noqa: E402
+
+BUILTIN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "log_parser_tpu", "patterns", "builtin",
+)
+
+
+def _load_sets(paths: list[str]) -> list[PatternSet]:
+    """Parse sets WITHOUT the loader's validation — lint reports schema
+    violations as findings instead of refusing to look at the file."""
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(_walk_yaml_files(path))
+        else:
+            files.append(path)
+    sets = []
+    for path in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = yaml.safe_load(fh)
+        if not isinstance(data, dict):
+            raise ValueError(f"{path}: not a YAML mapping")
+        sets.append(PatternSet.from_dict(data))
+    return sets
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="pattern YAML files/directories")
+    ap.add_argument(
+        "--builtin", action="store_true",
+        help="lint the builtin pattern bank",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--no-subsumption", action="store_true",
+        help="skip the product-DFA subsumption pass",
+    )
+    args = ap.parse_args(argv)
+
+    paths = list(args.paths)
+    if args.builtin:
+        paths.append(BUILTIN_DIR)
+    if not paths:
+        ap.error("no paths given (or use --builtin)")
+    try:
+        sets = _load_sets(paths)
+    except Exception as exc:  # unreadable/unparseable input: usage error
+        print(f"pattern_lint: cannot load library: {exc}", file=sys.stderr)
+        return 2
+
+    report = lint_pattern_sets(
+        sets, check_subsumption=not args.no_subsumption
+    )
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in report.findings:
+            where = "/".join(x for x in (f.set_id, f.pattern_id) if x)
+            rx = f" [{f.regex}]" if f.regex else ""
+            code = f" ({f.code})" if f.code else ""
+            print(f"{f.severity:5s} {f.rule:28s} {where}: {f.detail}{code}{rx}")
+        tiers = {}
+        for t in report.tiers.values():
+            tiers[t["tier"]] = tiers.get(t["tier"], 0) + 1
+        print(
+            f"pattern_lint: {report.stats['patterns']} pattern(s), "
+            f"{report.stats['columns']} column(s), tiers {tiers}, "
+            f"{report.summary()}"
+        )
+    return 1 if report.gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
