@@ -25,13 +25,36 @@ Prints exactly one JSON line:
 
 from __future__ import annotations
 
+import itertools
 import os
+import random
 import sys
 
 import bench_common  # noqa: F401  (sets LOG_PARSER_TPU_NO_FALLBACK=1 on import)
 
 N_LINES = int(sys.argv[sys.argv.index("--lines") + 1]) if "--lines" in sys.argv else 200_000
 NORTH_STAR_LINES_PER_SEC = 1_000_000.0
+# --repeat-ratio R: repeat-heavy corpus mode (bench_common.repeat_corpus)
+# — ~R of each request's lines are zipf template draws, the rest unique.
+# --line-cache-mb MB: serve through the exact-match line cache
+# (runtime/linecache.py); 0/absent = cache off. Together they make
+# cache-on vs cache-off a first-class BENCH_rNN comparison.
+REPEAT_RATIO = (
+    float(sys.argv[sys.argv.index("--repeat-ratio") + 1])
+    if "--repeat-ratio" in sys.argv
+    else None
+)
+LINE_CACHE_MB = (
+    float(sys.argv[sys.argv.index("--line-cache-mb") + 1])
+    if "--line-cache-mb" in sys.argv
+    else 0.0
+)
+# Distinct request payloads the repeat-mode stream cycles through. The
+# line cache is a CROSS-request tier: with a single fixed payload every
+# line (unique fillers included) becomes a hit after request #1 and the
+# ratio stops meaning anything. Rotating a pool keeps template lines
+# hitting while each payload's fillers miss on their first serving.
+REPEAT_POOL_REQUESTS = 8
 # --host-col: config-2 variant with one injected lookbehind pattern (a
 # host-only column). Guards the VERDICT r3 #3 cliff: with the literal
 # prefilter this must stay within ~2x of the clean number instead of
@@ -70,6 +93,10 @@ def main() -> None:
         if HOST_COL
         else "log_lines_scored_per_sec_per_chip"
     )
+    if REPEAT_RATIO is not None:
+        metric += f"_rr{int(round(REPEAT_RATIO * 100)):02d}"
+    if LINE_CACHE_MB > 0:
+        metric += "_lc"
     platform = bench_common.probe_backend(metric, "lines/s")
 
     from log_parser_tpu.config import ScoringConfig
@@ -107,15 +134,36 @@ def main() -> None:
     n_patterns = sum(len(s.patterns or []) for s in sets)
     engine = AnalysisEngine(sets, ScoringConfig())
     assert not engine.fallback_to_golden, "bench must never serve from golden"
-    logs = build_corpus(N_LINES)
-    data = PodFailureData(pod={"metadata": {"name": "bench"}}, logs=logs)
+    if LINE_CACHE_MB > 0:
+        engine.enable_line_cache(LINE_CACHE_MB)
+    if REPEAT_RATIO is not None:
+        rng = random.Random(0xC0FFEE)
+        pool = [
+            PodFailureData(
+                pod={"metadata": {"name": "bench"}},
+                logs=bench_common.repeat_corpus(
+                    N_LINES, REPEAT_RATIO, f"r{t}", rng
+                ),
+            )
+            for t in range(REPEAT_POOL_REQUESTS)
+        ]
+    else:
+        pool = [
+            PodFailureData(
+                pod={"metadata": {"name": "bench"}}, logs=build_corpus(N_LINES)
+            )
+        ]
+    _req = itertools.count()
+
+    def next_data() -> PodFailureData:
+        return pool[next(_req) % len(pool)]
 
     # warmup + serial measure under the shared wedge wrapper and timing
     # rule (bench_common.measured_phase): a backend that wedges after
     # the probe must yield the diagnostics exit, not a hang
     bounded = bench_common.bounded_runner(metric, "lines/s", platform)
     result, _, best = bench_common.measured_phase(
-        bounded, lambda: engine.analyze(data)
+        bounded, lambda: engine.analyze(next_data())
     )
     assert result.summary.significant_events > 0
     serial_rate = N_LINES / best
@@ -146,7 +194,7 @@ def main() -> None:
     # for the headline); the serial rate stays in the artifact for
     # comparability.
     def analyze_once() -> None:
-        r = engine.analyze_pipelined(data)
+        r = engine.analyze_pipelined(next_data())
         assert r.summary.significant_events > 0
 
     curve, campaign_error = bench_common.run_campaign(
@@ -163,6 +211,12 @@ def main() -> None:
     extra = {}
     if campaign_error is not None:
         extra["campaign_error"] = campaign_error
+    if REPEAT_RATIO is not None:
+        extra["repeat_ratio"] = REPEAT_RATIO
+        extra["pool_requests"] = len(pool)
+    if engine.line_cache is not None:
+        extra["line_cache_mb"] = LINE_CACHE_MB
+        extra["line_cache"] = engine.line_cache.stats()
     bench_common.emit(
         metric,
         headline["lines_per_sec"],

@@ -136,6 +136,80 @@ def timeit(fn, n: int = 3, warmup: int = 1) -> float:
     return min(ts)
 
 
+# ------------------------------------------------------- repeat-heavy mode
+# Shared by bench.py and bench_latency.py (``--repeat-ratio``): a
+# synthetic repeat-heavy stream for exercising the exact-match line cache
+# (runtime/linecache.py). Template lines are drawn zipf (weight 1/rank)
+# from a small pool — the shape of real fleet logs, where a handful of
+# templates dominate — and the remaining lines carry a unique tag so they
+# can never hit the cache.
+
+# Benign templates dominate the head ranks and the matching templates sit
+# at the tail — real fleet logs are overwhelmingly routine (the zipf head
+# is heartbeats and reconcile ticks), and a pool where every template
+# produced an event would let result-assembly cost (identical cache-on
+# and cache-off) drown the cube savings the mode exists to measure.
+REPEAT_TEMPLATES = (
+    "2026-07-29T07:00:00Z INFO reconcile tick status=ok",
+    "INFO steady-state heartbeat marker",
+    'GET /healthz 200 17b "kube-probe/1.29"',
+    "INFO syncing deployment default/web replicas=3",
+    "INFO volume mount ok pvc-data-0",
+    "INFO leader-election renewed lease",
+    "INFO configmap checksum unchanged",
+    "INFO endpoint slice updated 10.0.3.17:8080",
+    "INFO image already present on machine",
+    "INFO scheduled pod web-7f9c onto node-4",
+    "INFO readiness gate passed",
+    "INFO garbage collector scanned 312 objects",
+    "INFO certificate rotation not due",
+    "ERROR request failed with IllegalStateException",
+    "dial tcp 10.0.0.7:5432: Connection refused",
+    "java.lang.OutOfMemoryError: Java heap space",
+)
+
+_ZIPF_CUM: list[float] = []
+for _rank in range(len(REPEAT_TEMPLATES)):
+    _ZIPF_CUM.append((_ZIPF_CUM[-1] if _ZIPF_CUM else 0.0) + 1.0 / (_rank + 1))
+
+
+def zipf_template(u: float) -> str:
+    """Map uniform ``u`` in [0, 1) to a template with P(rank) ∝ 1/(rank+1)."""
+    x = u * _ZIPF_CUM[-1]
+    for rank, cum in enumerate(_ZIPF_CUM):
+        if x < cum:
+            return REPEAT_TEMPLATES[rank]
+    return REPEAT_TEMPLATES[-1]
+
+
+def hash01(x: int) -> float:
+    """Deterministic uniform [0, 1) from an integer — lets a corpus
+    builder stay a pure function of its indices (the latency sweep's
+    prewarm regenerates content by index and must see identical lines)."""
+    x = (x * 2654435761) & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 2246822519) & 0xFFFFFFFF
+    x ^= x >> 13
+    return x / 4294967296.0
+
+
+def repeat_corpus(n: int, ratio: float, tag: str, rng) -> str:
+    """``n`` lines, ~``ratio`` of them zipf template draws, the rest
+    unique filler stamped with ``tag``. Every ~997th filler still carries
+    a matching ERROR so the stream produces events at any ratio."""
+    rows = []
+    for i in range(n):
+        if rng.random() < ratio:
+            rows.append(zipf_template(rng.random()))
+        elif i % 997 == 701:
+            rows.append(
+                f"ERROR request failed with IllegalStateException uniq={tag}.{i}"
+            )
+        else:
+            rows.append(f"INFO unique filler {tag}.{i} status=ok")
+    return "\n".join(rows)
+
+
 def pin_platform(platform: str | None = None) -> None:
     """Pin the CURRENT process's JAX platform (the axon sitecustomize
     overrides the JAX_PLATFORMS env var at config level, so this must be

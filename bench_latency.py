@@ -53,9 +53,38 @@ CONCURRENCY = (
     if "--concurrency" in sys.argv
     else 1
 )
+# --repeat-ratio R: ~R of each micro-batch's lines become zipf template
+# draws (bench_common.REPEAT_TEMPLATES), the rest stay unique per (i, j).
+# --line-cache-mb MB: serve through the exact-match line cache
+# (runtime/linecache.py); 0/absent = cache off.
+REPEAT_RATIO = (
+    float(sys.argv[sys.argv.index("--repeat-ratio") + 1])
+    if "--repeat-ratio" in sys.argv
+    else None
+)
+LINE_CACHE_MB = (
+    float(sys.argv[sys.argv.index("--line-cache-mb") + 1])
+    if "--line-cache-mb" in sys.argv
+    else 0.0
+)
 
 
 def micro_batch(i: int, n: int) -> str:
+    if REPEAT_RATIO is not None:
+        # pure function of (i, j) via hash01 so the sweep prewarm, which
+        # regenerates content by index, sees identical lines and shapes
+        rows = []
+        for j in range(n):
+            u = i * 131 + j
+            if bench_common.hash01(u) < REPEAT_RATIO:
+                rows.append(
+                    bench_common.zipf_template(
+                        bench_common.hash01(u ^ 0x9E3779B9)
+                    )
+                )
+            else:
+                rows.append(f"INFO tick {i}.{j} status=ok")
+        return "\n".join(rows)
     rows = []
     for j in range(n):
         m = (i * 131 + j) % 97
@@ -70,13 +99,22 @@ def micro_batch(i: int, n: int) -> str:
     return "\n".join(rows)
 
 
+def metric_suffix() -> str:
+    s = ""
+    if REPEAT_RATIO is not None:
+        s += f"_rr{int(round(REPEAT_RATIO * 100)):02d}"
+    if LINE_CACHE_MB > 0:
+        s += "_lc"
+    return s
+
+
 def percentile(sorted_vals: list[float], q: float) -> float:
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
 
 
 def sweep_main() -> None:
-    metric = f"parse_agg_lines_per_s_c16_batched_{BATCH_LINES}line"
+    metric = f"parse_agg_lines_per_s_c16_batched_{BATCH_LINES}line" + metric_suffix()
     platform = bench_common.probe_backend(metric, "lines/s")
 
     from log_parser_tpu.config import ScoringConfig
@@ -85,6 +123,8 @@ def sweep_main() -> None:
     from log_parser_tpu.runtime import AnalysisEngine
 
     engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    if LINE_CACHE_MB > 0:
+        engine.enable_line_cache(LINE_CACHE_MB)
 
     def run_level(batching: bool, c: int, per_client: int) -> dict:
         per_thread: list[list[float]] = [[] for _ in range(c)]
@@ -194,6 +234,12 @@ def sweep_main() -> None:
             r for r in curve if r["batching"] == mode and r["concurrency"] == c
         )
 
+    extra = {}
+    if REPEAT_RATIO is not None:
+        extra["repeat_ratio"] = REPEAT_RATIO
+    if engine.line_cache is not None:
+        extra["line_cache_mb"] = LINE_CACHE_MB
+        extra["line_cache"] = engine.line_cache.stats()
     bench_common.emit(
         metric,
         level("on", 16)["lines_per_sec"],
@@ -205,6 +251,7 @@ def sweep_main() -> None:
         batch_max=SWEEP_BATCH_MAX,
         sweep=curve,
         batcher=batcher_stats,
+        **extra,
     )
 
 
@@ -214,7 +261,11 @@ def main() -> None:
     suffix = "_http" if USE_HTTP else ""
     if CONCURRENCY > 1:
         suffix += f"_c{CONCURRENCY}"
-    metric = f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch" + suffix
+    metric = (
+        f"parse_latency_p99_ms_{BATCH_LINES}line_microbatch"
+        + suffix
+        + metric_suffix()
+    )
     platform = bench_common.probe_backend(metric, "ms")
 
     from log_parser_tpu.config import ScoringConfig
@@ -223,6 +274,8 @@ def main() -> None:
     from log_parser_tpu.runtime import AnalysisEngine
 
     engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    if LINE_CACHE_MB > 0:
+        engine.enable_line_cache(LINE_CACHE_MB)
 
     if USE_HTTP:
         import urllib.request
@@ -339,6 +392,11 @@ def main() -> None:
         # the headline p99 covers the whole run — say so in the artifact
         phase_pcts["phase_sample_n"] = len(traces)
 
+    if REPEAT_RATIO is not None:
+        phase_pcts["repeat_ratio"] = REPEAT_RATIO
+    if engine.line_cache is not None:
+        phase_pcts["line_cache_mb"] = LINE_CACHE_MB
+        phase_pcts["line_cache"] = engine.line_cache.stats()
     bench_common.emit(
         metric,
         round(percentile(lat, 0.99), 3),

@@ -29,6 +29,17 @@ from log_parser_tpu.ops.encode import (
 )
 
 
+def normalize_blob(logs: str | None) -> bytes:
+    """THE ingest normalization: the one byte-level view of a request's
+    logs shared by every identity derived from content — the quarantine
+    fingerprint (runtime/quarantine.py) and the line-cache keys
+    (runtime/linecache.py). ``errors="replace"`` mirrors the per-line
+    device encode, so a line's slice of this blob equals the bytes the
+    match cube saw regardless of transport (HTTP / framed shim / gRPC all
+    deliver the same ``str``)."""
+    return (logs or "").encode("utf-8", errors="replace")
+
+
 class Corpus:
     """Sequence-of-lines view over a log blob + its encoded device batch.
 
@@ -145,6 +156,18 @@ class Corpus:
         return self._blob[self._starts[i] : self._ends[i]].decode(
             "utf-8", errors="replace"
         )
+
+    def line_key_bytes(self, i: int) -> bytes:
+        """Ingest-normalized bytes of line ``i`` — the line-cache key
+        material. Native path: a slice of the already-normalized blob
+        (zero extra passes); Python fallback: the same bytes via the
+        per-line encode (``errors="replace"`` matches
+        :func:`normalize_blob` character-for-character)."""
+        if self._lines is not None:
+            return self._lines[i].encode("utf-8", errors="replace")
+        if not 0 <= i < self.n_lines:
+            raise IndexError(i)
+        return self._blob[self._starts[i] : self._ends[i]]
 
     def __getitem__(self, key):
         if isinstance(key, slice):

@@ -344,6 +344,15 @@ class FusedMatchScore:
             lambda k, lines, lens, n: self._step(k, lines, lens, n, None),
             static_argnums=(0,),
         )
+        # cube-only programs (the line-cache residual path): no extraction,
+        # just the post-override bit matrix — extraction happens on the host
+        # from cached + fresh rows together (runtime/linecache.py)
+        self._jit_cube_ov = jax.jit(
+            lambda lines, lens, n, om, ov: self._cube_step(lines, lens, n, (om, ov))
+        )
+        self._jit_cube_plain = jax.jit(
+            lambda lines, lens, n: self._cube_step(lines, lens, n, None)
+        )
 
     # ------------------------------------------------------------- host entry
 
@@ -413,30 +422,63 @@ class FusedMatchScore:
                 return recs
         raise AssertionError("unreachable: K ladder capped at B*P")
 
+    def cube_rows(
+        self,
+        lines_u8: np.ndarray,
+        lengths: np.ndarray,
+        n_lines: int,
+        override_mask: np.ndarray | None = None,
+        override_val: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Post-override match-bit matrix [B, n_columns] for a (residual)
+        batch — the cacheable unit of the routing tier. Everything the
+        fused extraction derives is a pure function of these bits plus the
+        request's line count, so the line cache memoizes rows of THIS
+        matrix and replays extraction on the host."""
+        lines_bt = jnp.asarray(lines_u8)
+        lens = jnp.asarray(lengths)
+        n = jnp.asarray(n_lines, dtype=jnp.int32)
+        if override_mask is not None:
+            out = self._jit_cube_ov(
+                lines_bt, lens, n,
+                jnp.asarray(override_mask), jnp.asarray(override_val),
+            )
+        else:
+            out = self._jit_cube_plain(lines_bt, lens, n)
+        return np.asarray(out)
+
     # ---------------------------------------------------------- device program
 
-    def _step(self, K, lines_bt, lengths, n_lines, overrides):
+    def _cube_step(self, lines_bt, lengths, n_lines, overrides):
+        """The shared front half of :meth:`_step`: tiered match cube,
+        override splice, padding-row mask. Returns bool [B, n_columns]."""
         lines_tb = lines_bt.T  # device-side layout change (see dispatch)
-        bank, t = self.bank, self.t
         B = lengths.shape[0]
-        P = bank.n_patterns
         row_idx = jnp.arange(B, dtype=jnp.int32)
         valid = row_idx < n_lines
-
-        # ---- match cube (tiered: Shift-Or + DFA banks) --------------------
-        # the barrier stops XLA from fusing extraction work back into the
-        # scan loops: the compiled step alone measured 0.417 → 0.374 s on
-        # v5e config-2 shapes (direct _jit_plain timing; the end-to-end
-        # headline moves less — tunnel-sync noise is ±5% at that level)
         cube = jax.lax.optimization_barrier(
             self.matchers.cube(lines_tb, lengths)
         )
         if overrides is not None:
             om, ov = overrides
             cube = jnp.where(om, ov, cube)
-        # padding rows contribute nothing: empty-matching regexes (^$, \s*)
-        # would otherwise produce phantom hits on zero-length padding
-        cube = cube & valid[:, None]
+        return cube & valid[:, None]
+
+    def _step(self, K, lines_bt, lengths, n_lines, overrides):
+        bank, t = self.bank, self.t
+        B = lengths.shape[0]
+        P = bank.n_patterns
+        row_idx = jnp.arange(B, dtype=jnp.int32)
+
+        # ---- match cube (tiered: Shift-Or + DFA banks) --------------------
+        # the barrier (inside _cube_step) stops XLA from fusing extraction
+        # work back into the scan loops: the compiled step alone measured
+        # 0.417 → 0.374 s on v5e config-2 shapes (direct _jit_plain timing;
+        # the end-to-end headline moves less — tunnel-sync noise is ±5% at
+        # that level). Padding rows contribute nothing: empty-matching
+        # regexes (^$, \s*) would otherwise produce phantom hits on
+        # zero-length padding.
+        cube = self._cube_step(lines_bt, lengths, n_lines, overrides)
 
         if P == 0:
             z32 = jnp.zeros((K,), jnp.int32)

@@ -73,7 +73,9 @@ from typing import TYPE_CHECKING
 import numpy as np
 
 from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.ops.encode import _pad_rows
 from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.linecache import line_key, records_from_bits
 from log_parser_tpu.utils.trace import PhaseTrace
 
 if TYPE_CHECKING:  # import cycle: engine imports nothing from here at boot
@@ -382,6 +384,13 @@ class MicroBatcher:
         and with it frequency serial-equivalence — is untouched."""
         from log_parser_tpu.runtime.engine import is_device_error
 
+        if depth == 0 and self.engine.line_cache is not None:
+            resolved = self._cached_batch(items, self.engine.line_cache)
+            if resolved is not None:
+                return resolved
+            # residual device step failed: retry the WHOLE flush on the
+            # uncached vmapped path below, so bisection, per-row poison
+            # isolation, and quarantine striking behave exactly cache-off
         try:
             return self._device_batch(items)
         except Exception as exc:
@@ -409,6 +418,111 @@ class MicroBatcher:
             return self._resolve_records(
                 items[:mid], depth + 1
             ) + self._resolve_records(items[mid:], depth + 1)
+
+    def _cached_batch(self, items: list[_Pending], cache):
+        """Resolve one flush through the line cache: per-item lookups,
+        ONE compacted residual cube dispatch for the unique misses across
+        the WHOLE flush (the cross-request half of the dedup), host-side
+        override splice + extraction per item. Returns per-item records,
+        or None when the residual device step fails — the caller then
+        retries the flush wholesale on the uncached path.
+
+        A flush whose lines are all cache hits performs zero device
+        dispatches, and the keyed poison fault fires only for items that
+        actually contributed a residual row — a request served wholly
+        from cache can never strike quarantine."""
+        engine = self.engine
+        # flush-global unique map (content bytes -> slot), then one hash
+        # per unique line. Per unique slot: the (item, line) the encode
+        # would be sliced from; prefer a non-needs_host appearance — a
+        # truncated/replaced encode is width-dependent and must neither
+        # populate the cache nor serve another item's clean line.
+        slot_of: dict[bytes, int] = {}
+        uniq_src: list[tuple[int, int]] = []
+        per_item: list[np.ndarray] = []  # per item: line index -> slot
+        for r, item in enumerate(items):
+            corpus = item.corpus
+            enc = corpus.encoded
+            ls = np.empty(corpus.n_lines, dtype=np.int64)
+            for i in range(corpus.n_lines):
+                lb = corpus.line_key_bytes(i)
+                s = slot_of.get(lb)
+                if s is None:
+                    s = len(uniq_src)
+                    slot_of[lb] = s
+                    uniq_src.append((r, i))
+                else:
+                    sr, si = uniq_src[s]
+                    if (
+                        items[sr].corpus.encoded.needs_host[si]
+                        and not enc.needs_host[i]
+                    ):
+                        uniq_src[s] = (r, i)
+                ls[i] = s
+            per_item.append(ls)
+        U = len(uniq_src)
+        keys = [line_key(lb) for lb in slot_of]  # insertion == slot order
+        all_slots = (
+            np.concatenate(per_item) if per_item else np.zeros(0, dtype=np.int64)
+        )
+        counts = np.bincount(all_slots, minlength=max(U, 1))
+        packed = cache.lookup_packed(keys, counts=counts.tolist())
+        miss_slots = [s for s in range(U) if packed[s] is None]
+
+        fresh = None
+        if miss_slots:
+            u = len(miss_slots)
+            T = max(i.corpus.encoded.u8.shape[1] for i in items)
+            pad = _pad_rows(u, engine._corpus_min_rows())
+            res_u8 = np.zeros((pad, T), dtype=np.uint8)
+            res_len = np.zeros(pad, dtype=np.int32)
+            contributed = sorted({uniq_src[s][0] for s in miss_slots})
+            for j, s in enumerate(miss_slots):
+                r, i = uniq_src[s]
+                enc = items[r].corpus.encoded
+                res_u8[j, : enc.u8.shape[1]] = enc.u8[i]
+                res_len[j] = enc.lengths[i]
+
+            def _device_step():
+                for r in contributed:
+                    faults.fire("quarantine", key=items[r].data.logs or "")  # conlint: contained-by-caller (watchdog.run)
+                faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
+                return engine._run_cube(res_u8, res_len, u)
+
+            try:
+                fresh = engine.watchdog.run(_device_step)[:u]
+            except Exception:
+                return None
+            cache.note_residual(u, int(counts[miss_slots].sum()) - u)
+            keep = [
+                j
+                for j, s in enumerate(miss_slots)
+                if not items[uniq_src[s][0]].corpus.encoded.needs_host[
+                    uniq_src[s][1]
+                ]
+            ]
+            cache.populate_rows(
+                [keys[miss_slots[j]] for j in keep], fresh[keep]
+            )
+
+        bits_u = np.zeros((U, cache.n_columns), dtype=bool)
+        hit_slots = [s for s in range(U) if packed[s] is not None]
+        if hit_slots:
+            bits_u[hit_slots] = cache.unpack([packed[s] for s in hit_slots])
+        if fresh is not None:
+            bits_u[miss_slots] = fresh
+        out = []
+        for r, item in enumerate(items):
+            n = item.corpus.n_lines
+            if n:
+                bits = bits_u[per_item[r]]  # fan unique rows back out
+            else:
+                bits = np.zeros((0, cache.n_columns), dtype=bool)
+            if item.om is not None:
+                bits = np.where(item.om[:n], item.ov[:n], bits)
+            out.append(records_from_bits(bits, n, engine.bank, engine.tables))
+        engine._k_hint = max(r.n_matches for r in out)
+        return out
 
     def _device_batch(self, items: list[_Pending]):
         """Stack the bucket into one padded [R, B, T] batch, run the

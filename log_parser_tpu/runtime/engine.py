@@ -49,8 +49,15 @@ from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.ops.encode import _pad_rows
 from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
 from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.linecache import (
+    DEFAULT_LINE_CACHE_MB,
+    LineCache,
+    line_key,
+    records_from_bits,
+)
 from log_parser_tpu.ops.match import DfaBank, MatcherBanks
 from log_parser_tpu.patterns.bank import PatternBank
 from log_parser_tpu.runtime.finalize import FinalizedBatch, finalize_batch
@@ -433,6 +440,9 @@ class AnalysisEngine:
         # None until enable_batching() — transports then route analyze
         # calls through analyze_batched
         self.batcher = None
+        # exact-match line cache (runtime/linecache.py): None until
+        # enable_line_cache() — repeat lines then skip the match cube
+        self.line_cache = None
         # poison-request quarantine (runtime/quarantine.py): organic
         # device failures strike the request's fingerprint; at the
         # threshold repeats route straight to golden until TTL expiry
@@ -803,6 +813,13 @@ class AnalysisEngine:
             enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
         )
 
+    def _run_cube(self, lines_u8, lengths, n_rows: int) -> np.ndarray:
+        """Cube-only device program for the line-cache residual batch:
+        pre-override match bits for ``n_rows`` independent lines (no
+        extraction — that replays on the host from cached + fresh rows
+        together, runtime/linecache.py)."""
+        return self.fused.cube_rows(lines_u8, lengths, n_rows)
+
     # ------------------------------------------------------- golden fallback
 
     @property
@@ -974,6 +991,13 @@ class AnalysisEngine:
                     from log_parser_tpu.ops.fused import FusedBatchMatchScore
 
                     self.batcher.program = FusedBatchMatchScore(self.fused)
+                if self.line_cache is not None:
+                    # wholesale epoch invalidation INSIDE the quiesced
+                    # swap: no request is in flight, so no populate racing
+                    # the flush can resurrect an old library's bits — a
+                    # stale hit across a pattern swap is structurally
+                    # impossible (tests/test_linecache.py pins it)
+                    self.line_cache.flush(n_columns=self.bank.n_columns)
                 self.reload_epoch += 1
                 if self.journal is not None:
                     # the carry-over pruning above bypassed the tracker's
@@ -1014,6 +1038,20 @@ class AnalysisEngine:
             self, wait_ms=wait_ms, batch_max=batch_max
         ).start()
         return self.batcher
+
+    def enable_line_cache(self, mb: float = DEFAULT_LINE_CACHE_MB):
+        """Attach the exact-match line cache (runtime/linecache.py):
+        per-line pre-override match-bit rows keyed by the hash of the
+        ingest-normalized line bytes. Repeat lines skip the match cube;
+        novel lines go to the device as a compacted residual batch and
+        populate the cache on the way back. Single-device engines only —
+        the residual program is the full-bank cube (sharded/distributed
+        engines keep the uncached path; the serve layer gates the flag
+        exactly like micro-batching)."""
+        self.line_cache = LineCache(
+            self.bank.n_columns, int(float(mb) * 1024 * 1024)
+        )
+        return self.line_cache
 
     def enable_shadow(self, rate: float, seed: int | None = None):
         """Attach and start the online shadow verifier: ``rate`` of
@@ -1183,6 +1221,10 @@ class AnalysisEngine:
             overrides = self._overrides(corpus)
         om, ov = overrides if overrides is not None else (None, None)
 
+        cache = self.line_cache
+        if cache is not None:
+            return self._prepare_cached(data, start, trace, corpus, om, ov, cache)
+
         def _device_step():
             # chaos points INSIDE the watchdog worker: an injected hang
             # exercises the timeout/breaker exactly like a wedged backend;
@@ -1196,6 +1238,105 @@ class AnalysisEngine:
             recs = self.watchdog.run(_device_step)
         # capacity hint tracks the RAW device match count (the buffer the
         # device actually needs), before approx verification drops rows
+        self._k_hint = recs.n_matches
+        with trace.phase("verify"):
+            recs = self._verify_approx(corpus, recs)
+        return _Prepared(start, trace, corpus, recs, data)
+
+    def _prepare_cached(
+        self, data, start, trace, corpus, om, ov, cache: LineCache
+    ) -> "_Prepared":
+        """The routing-tier prepare path: per-line cache lookup, one
+        compacted residual cube dispatch for the unique misses, host-side
+        override splice + record extraction. A request whose lines are
+        ALL cache hits never reaches the device step at all — it cannot
+        trip the watchdog, cannot strike quarantine, and costs no device
+        dispatch. Parity with :meth:`_prepare` is exact: the cache holds
+        PRE-override bit rows (width-independent — zero padding is
+        automaton-neutral and ``needs_host`` lines are never populated),
+        the request's override cube is re-applied here, and
+        ``records_from_bits`` mirrors the device extraction bit-for-bit."""
+        enc = corpus.encoded
+        n = corpus.n_lines
+        with trace.phase("cache"):
+            # dedup to unique lines FIRST (bytes-keyed dict, C speed),
+            # then hash once per unique line: one device row per distinct
+            # novel line (the in-request half of the dedup; the batcher
+            # dedups across a whole flush the same way). Within one
+            # request duplicate content always shares one needs_host
+            # verdict (same bytes, same device width), so slot-level
+            # bookkeeping indexed at the first appearance is exact.
+            slot_of: dict[bytes, int] = {}
+            uniq_lines: list[int] = []
+            line_slot = np.empty(n, dtype=np.int64)
+            for i in range(n):
+                lb = corpus.line_key_bytes(i)
+                s = slot_of.get(lb)
+                if s is None:
+                    s = len(uniq_lines)
+                    slot_of[lb] = s
+                    uniq_lines.append(i)
+                line_slot[i] = s
+            U = len(uniq_lines)
+            keys = [line_key(lb) for lb in slot_of]  # insertion == slot order
+            counts = np.bincount(line_slot, minlength=max(U, 1))
+            packed = cache.lookup_packed(keys, counts=counts.tolist())
+            miss_slots = [s for s in range(U) if packed[s] is None]
+
+        fresh = None
+        if miss_slots:
+            miss_lines = [uniq_lines[s] for s in miss_slots]
+            u = len(miss_lines)
+            pad = _pad_rows(u, self._corpus_min_rows())
+            res_u8 = np.zeros((pad, enc.u8.shape[1]), dtype=np.uint8)
+            res_len = np.zeros(pad, dtype=np.int32)
+            res_u8[:u] = enc.u8[miss_lines]
+            res_len[:u] = enc.lengths[miss_lines]
+
+            def _device_step():
+                # same chaos points as the uncached path — the residual
+                # IS this request's device step, so a keyed poison spec
+                # fires (and strikes) exactly as before
+                faults.fire("quarantine", key=data.logs or "")  # conlint: contained-by-caller (watchdog.run)
+                faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
+                return self._run_cube(res_u8, res_len, u)
+
+            with trace.phase("device"):
+                fresh = self.watchdog.run(_device_step)[:u]
+            cache.note_residual(u, int(counts[miss_slots].sum()) - u)
+            # needs_host lines are excluded: their truncated/replaced
+            # encode is width-dependent, so their device bits are not a
+            # function of the line content alone (harmless to LOOK UP —
+            # their columns are fully overridden below — but never stored)
+            keep = [
+                j
+                for j, i in enumerate(miss_lines)
+                if not enc.needs_host[i]
+            ]
+            cache.populate_rows(
+                [keys[miss_slots[j]] for j in keep], fresh[keep]
+            )
+
+        with trace.phase("extract"):
+            if n:
+                bits_u = np.zeros((U, cache.n_columns), dtype=bool)
+                hit_slots = [s for s in range(U) if packed[s] is not None]
+                if hit_slots:
+                    bits_u[hit_slots] = cache.unpack(
+                        [packed[s] for s in hit_slots]
+                    )
+                if fresh is not None:
+                    bits_u[miss_slots] = fresh
+                bits = bits_u[line_slot]  # fan unique rows back out
+            else:
+                bits = np.zeros((0, cache.n_columns), dtype=bool)
+            if om is not None:
+                # the per-request override splice: host-only columns,
+                # needs_host lines, and OPEN-breaker patterns — applied on
+                # the host over cached and fresh rows alike, which is what
+                # makes a breaker trip an exact per-pattern invalidation
+                bits = np.where(om[:n], ov[:n], bits)
+            recs = records_from_bits(bits, n, self.bank, self.tables)
         self._k_hint = recs.n_matches
         with trace.phase("verify"):
             recs = self._verify_approx(corpus, recs)

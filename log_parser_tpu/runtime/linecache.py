@@ -1,0 +1,400 @@
+"""Routing tier in front of the match cube: the exact-match line cache.
+
+Real pod logs are overwhelmingly repeats of a small template set
+(CelerLog routes by shape so only novel lines pay full parsing; Logram's
+n-gram dictionaries are an O(1) membership test — PAPERS.md). The match
+cube is gather-bound at ~9 ns/element and pays per (row × automaton ×
+byte) (PERF.md §1), so the cheapest row is the one that never reaches
+the device. This module memoizes the per-line *device-side* result — the
+post-valid match-bit row of the cube, NOT final scores — keyed by the
+hash of the ingest-normalized line bytes (the same normalization the
+quarantine fingerprint uses, native/ingest.py ``normalize_blob``).
+
+What is cacheable, exactly: in ``FusedMatchScore._step`` everything
+downstream of the cube is a pure function of the post-override bit
+matrix plus the request's line count. The PRE-override bit row is a pure
+per-line function of (line bytes, bank identity): the automata consume
+exactly ``length`` bytes, zero padding is automaton-neutral, and lines
+flagged ``needs_host`` — whose truncated encode IS width-dependent — are
+excluded from population (their rows are fully host-overridden anyway).
+So the cache stores pre-override rows and the engine re-applies the
+request's override cube (host-only columns, breaker-overridden patterns,
+needs_host lines) on top at assembly time. That makes breaker handling
+exact *by construction*: a tripped pattern's columns are served from the
+host regex for cached and fresh rows alike — the per-pattern slice of
+every cached entry is invalidated the instant the breaker opens, without
+dropping the other patterns' bits.
+
+Cross-line factors (proximity distances, sequence chains, context
+windows) are NOT per-line — they are recomputed per request from the
+assembled bit matrix by :func:`records_from_bits`, a numpy mirror of the
+device extraction (same discovery order, same integer semantics), so
+cached requests produce bit-identical ``MatchRecords`` and the
+frequency-coupled factors replay on the host under ``state_lock``
+exactly as before.
+
+Novel lines flow to the device as a *compacted* residual batch —
+deduplicated by key within a request and within a batcher flush before
+padding, one device row per unique line — then populate the cache on the
+way back (``dedupFanout`` counts the rows that never had to exist).
+
+Invalidation: wholesale on ``reload_epoch`` bump (``apply_library``
+flushes under the quiesced swap, so no stale populate can race it) and
+functionally per-pattern on a shadow-verifier breaker trip via the
+override replay described above. Bounded: LRU by resident bytes
+(``--line-cache-mb``). Quarantine-compatible: a request served entirely
+from cache never reaches the device step, so it can never strike.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from log_parser_tpu.golden.engine import SEQUENCE_NEAR_WINDOW
+from log_parser_tpu.ops.fused import FusedStaticTables, MatchRecords, NO_HIT
+from log_parser_tpu.patterns.bank import (
+    CTX_ERROR,
+    CTX_EXCEPTION,
+    CTX_STACK,
+    CTX_WARN,
+    PatternBank,
+)
+
+DEFAULT_LINE_CACHE_MB = 64.0
+
+# per-entry bookkeeping estimate beyond key + packed row: OrderedDict
+# node, bytes objects' headers. Deliberately generous — the budget is an
+# operator-facing ceiling, and under-counting would let the cache outgrow
+# its flag.
+_ENTRY_OVERHEAD = 96
+
+
+def line_key(line_bytes: bytes) -> bytes:
+    """Cache key for one ingest-normalized line. blake2b-128 over the
+    exact content bytes: collisions are cryptographically negligible and
+    cache poisoning is impossible — there is no way to make line A serve
+    line B's bits without a preimage."""
+    return hashlib.blake2b(line_bytes, digest_size=16).digest()
+
+
+class LineCache:
+    """Bounded LRU of per-line pre-override match-bit rows.
+
+    Thread-safe: one lock acquisition per ``lookup_packed`` /
+    ``populate`` call (the batcher and concurrent pipelined requests
+    share one instance). Rows are stored bit-packed (``np.packbits``) —
+    a 600-column bank costs 75 bytes per resident line."""
+
+    def __init__(self, n_columns: int, budget_bytes: int):
+        self.lock = threading.Lock()
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._entries: OrderedDict[bytes, bytes] = OrderedDict()
+        self._set_columns(n_columns)
+        self.resident_bytes = 0
+        # counters (GET /trace/last "lineCache"; guarded by lock)
+        self.hits = 0
+        self.misses = 0
+        self.residual_rows = 0
+        self.dedup_fanout = 0
+        self.evictions = 0
+        self.epoch_flushes = 0
+
+    def _set_columns(self, n_columns: int) -> None:
+        self.n_columns = int(n_columns)
+        self._row_bytes = (self.n_columns + 7) // 8
+        self._entry_cost = 16 + self._row_bytes + _ENTRY_OVERHEAD
+
+    # ------------------------------------------------------------- data path
+
+    def lookup_packed(
+        self, keys: list[bytes], counts: list[int] | None = None
+    ) -> list[bytes | None]:
+        """Per-key packed bit rows (or None for misses), LRU touch +
+        hit/miss accounting in one lock acquisition. ``counts`` weights
+        each key by its line multiplicity — the hot paths dedup a request
+        to unique keys before looking up, but the counters keep describing
+        LINES (hit rate stays meaningful to an operator) while the
+        residual keeps describing device rows."""
+        packed: list[bytes | None] = []
+        with self.lock:
+            hits = misses = 0
+            for j, k in enumerate(keys):
+                row = self._entries.get(k)
+                w = counts[j] if counts is not None else 1
+                if row is None:
+                    misses += w
+                else:
+                    self._entries.move_to_end(k)
+                    hits += w
+                packed.append(row)
+            self.hits += hits
+            self.misses += misses
+        return packed
+
+    def unpack(self, packed: list[bytes]) -> np.ndarray:
+        """Batch-unpack packed rows to bool [len(packed), n_columns] in
+        one ``np.unpackbits`` call — the per-row variant is ~20x slower
+        on a repeat-heavy request (PERF.md §11)."""
+        if not packed:
+            return np.zeros((0, self.n_columns), dtype=bool)
+        buf = np.frombuffer(b"".join(packed), dtype=np.uint8)
+        return np.unpackbits(
+            buf.reshape(len(packed), self._row_bytes),
+            axis=1,
+            count=self.n_columns,
+        ).astype(bool)
+
+    def lookup(self, keys: list[bytes]) -> list[np.ndarray | None]:
+        """Per-key bit rows (bool [n_columns]) or None for misses —
+        convenience wrapper over :meth:`lookup_packed` for tests and
+        small callers; the engine/batcher hot paths stay packed."""
+        packed = self.lookup_packed(keys)
+        hit = [p for p in packed if p is not None]
+        rows = self.unpack(hit)
+        out: list[np.ndarray | None] = []
+        j = 0
+        for p in packed:
+            if p is None:
+                out.append(None)
+            else:
+                out.append(rows[j])
+                j += 1
+        return out
+
+    def populate_rows(self, keys: list[bytes], rows: np.ndarray) -> None:
+        """Insert freshly computed rows (bool [len(keys), n_columns]),
+        packed in one ``np.packbits`` call, evicting LRU entries past the
+        byte budget."""
+        if not keys:
+            return
+        packed = np.packbits(np.asarray(rows, dtype=bool), axis=1)
+        ready = [(k, packed[j].tobytes()) for j, k in enumerate(keys)]
+        self._insert(ready)
+
+    def populate(self, items: list[tuple[bytes, np.ndarray]]) -> None:
+        """Insert freshly computed (key, bool-row) pairs — convenience
+        wrapper over :meth:`populate_rows`."""
+        if items:
+            self.populate_rows(
+                [k for k, _ in items], np.stack([r for _, r in items])
+            )
+
+    def _insert(self, ready: list[tuple[bytes, bytes]]) -> None:
+        with self.lock:
+            for k, p in ready:
+                if k in self._entries:
+                    self._entries.move_to_end(k)
+                    continue
+                self._entries[k] = p
+                self.resident_bytes += self._entry_cost
+            while self.resident_bytes > self.budget_bytes and self._entries:
+                self._entries.popitem(last=False)
+                self.resident_bytes -= self._entry_cost
+                self.evictions += 1
+
+    def note_residual(self, rows: int, fanout: int) -> None:
+        """Account one residual dispatch: ``rows`` unique device rows
+        actually sent, ``fanout`` duplicate lines they fanned back out to."""
+        with self.lock:
+            self.residual_rows += rows
+            self.dedup_fanout += fanout
+
+    def flush(self, n_columns: int | None = None) -> None:
+        """Wholesale invalidation — the reload-epoch path. Called inside
+        ``apply_library``'s quiesced critical section, after every
+        in-flight populate has drained, so a stale hit across a pattern
+        swap is structurally impossible. ``n_columns`` re-binds the row
+        width when the new library changes the bank's column count."""
+        with self.lock:
+            self._entries.clear()
+            self.resident_bytes = 0
+            self.epoch_flushes += 1
+            if n_columns is not None and n_columns != self.n_columns:
+                self._set_columns(n_columns)
+
+    # ------------------------------------------------------- observability
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "budgetMb": round(self.budget_bytes / (1024 * 1024), 3),
+                "entries": len(self._entries),
+                "residentBytes": self.resident_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "residualRows": self.residual_rows,
+                "dedupFanout": self.dedup_fanout,
+                "evictions": self.evictions,
+                "epochFlushes": self.epoch_flushes,
+            }
+
+
+# --------------------------------------------------------- host extraction
+
+
+def _host_prev_next_dist(hits: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops/fused.py ``_prev_next_dist``: [B, S] bool hit
+    columns -> [B, S] int32 distance to the nearest hit on either side,
+    own row excluded, NO_HIT where none."""
+    B, S = hits.shape
+    col = np.arange(B, dtype=np.int64)[:, None]
+    prev_incl = np.maximum.accumulate(np.where(hits, col, -1), axis=0)
+    prev = np.concatenate(
+        [np.full((1, S), -1, dtype=np.int64), prev_incl[:-1]], axis=0
+    )
+    nxt_incl = np.flip(
+        np.minimum.accumulate(
+            np.flip(np.where(hits, col, int(NO_HIT)), axis=0), axis=0
+        ),
+        axis=0,
+    )
+    nxt = np.concatenate(
+        [nxt_incl[1:], np.full((1, S), int(NO_HIT), dtype=np.int64)], axis=0
+    )
+    d_prev = np.where(prev >= 0, col - prev, int(NO_HIT))
+    d_next = np.where(nxt < int(NO_HIT), nxt - col, int(NO_HIT))
+    return np.minimum(d_prev, d_next).astype(np.int32)
+
+
+def _host_sequence_flags(
+    sequences, t: FusedStaticTables, em: np.ndarray, idx: np.ndarray, n_lines: int
+) -> np.ndarray:
+    """numpy mirror of ops/fused.py ``sequence_flags_from_events`` at the
+    record rows ``idx`` only: last event within ±SEQUENCE_NEAR_WINDOW of
+    the primary via a prefix-count range-any, earlier events chained
+    strictly backwards via inclusive prefix-cummax of last-hit line."""
+    B = em.shape[0]
+    eidx = np.arange(B, dtype=np.int64)[:, None]
+    prev_incl = np.maximum.accumulate(np.where(em, eidx, -1), axis=0)
+    prefix = np.concatenate(
+        [np.zeros((1, em.shape[1]), dtype=np.int64), np.cumsum(em, axis=0)]
+    )
+    w = SEQUENCE_NEAR_WINDOW
+    outs = []
+    for seq in sequences:
+        if not seq.event_columns:
+            outs.append(np.zeros(idx.shape, dtype=bool))
+            continue
+        last_e = t.seq_col_pos[seq.event_columns[-1]]
+        lo = np.clip(idx - w, 0, B)
+        hi = np.clip(np.minimum(idx + w + 1, n_lines), 0, B)
+        ok = (prefix[hi, last_e] - prefix[lo, last_e]) > 0
+        cur = idx
+        for col in reversed(seq.event_columns[:-1]):
+            e = t.seq_col_pos[col]
+            g = np.where(cur >= 1, prev_incl[np.clip(cur - 1, 0, B - 1), e], -1)
+            ok = ok & (g >= 0)
+            cur = np.clip(g, 0, B - 1)
+        outs.append(ok)
+    return np.stack(outs, axis=1)
+
+
+def records_from_bits(
+    bits: np.ndarray,
+    n_lines: int,
+    bank: PatternBank,
+    tables: FusedStaticTables,
+) -> MatchRecords:
+    """The device extraction, replayed on the host from an assembled
+    post-override bit matrix ``bits`` [n_lines, n_columns] (cached rows +
+    residual rows + override splice). Mirrors ``FusedMatchScore._step``
+    downstream of the cube — same discovery order (line-major then
+    pattern: ``np.argwhere`` is row-major), same per-pattern slot layout
+    (``pat_sec``/``pat_seq``/``pat_ctx_shape``), same integer semantics —
+    so the returned records are bit-identical to what the device would
+    have produced for the full batch. Arrays are exact-size (K = M):
+    finalize_batch and _verify_approx slice ``[:n_matches]``, so no
+    padding rows are needed."""
+    B = int(n_lines)
+    P = bank.n_patterns
+    s_w = max(1, tables.s_max)
+    q_w = max(1, tables.q_max)
+
+    def _empty() -> MatchRecords:
+        return MatchRecords(
+            n_matches=0,
+            line=np.zeros(0, dtype=np.int32),
+            pattern=np.zeros(0, dtype=np.int32),
+            sec_dist=np.full((0, s_w), NO_HIT, dtype=np.int32),
+            seq_ok=np.zeros((0, q_w), dtype=bool),
+            ctx_counts=np.zeros((0, 5), dtype=np.int32),
+        )
+
+    if P == 0 or B == 0:
+        return _empty()
+
+    pm = bits[:, bank.primary_columns]  # [B, P]
+    matched = np.argwhere(pm)  # row-major == discovery order
+    m = len(matched)
+    if m == 0:
+        return _empty()
+    rec_line = matched[:, 0].astype(np.int32)
+    rec_pat = matched[:, 1].astype(np.int32)
+
+    # ---- proximity distances (per-pattern secondary slots) ----------------
+    rec_dist = np.full((m, s_w), NO_HIT, dtype=np.int32)
+    if len(tables.sec_cols):
+        dist = _host_prev_next_dist(bits[:, tables.sec_cols])  # [B, S_entries]
+        sec_idx = tables.pat_sec[rec_pat]  # [m, s_w]
+        rec_dist = np.where(
+            sec_idx >= 0,
+            dist[rec_line[:, None], np.maximum(sec_idx, 0)],
+            np.int32(NO_HIT),
+        ).astype(np.int32)
+
+    # ---- sequence flags (per-pattern sequence slots) ----------------------
+    rec_seq = np.zeros((m, q_w), dtype=bool)
+    if bank.sequences:
+        em = bits[:, np.asarray(tables.seq_event_cols, dtype=np.int64)]
+        flags = _host_sequence_flags(
+            bank.sequences, tables, em, rec_line.astype(np.int64), B
+        )  # [m, n_sequences]
+        q_idx = tables.pat_seq[rec_pat]  # [m, q_w]
+        rec_seq = np.where(
+            q_idx >= 0,
+            flags[np.arange(m)[:, None], np.maximum(q_idx, 0)],
+            False,
+        )
+
+    # ---- context window counts -------------------------------------------
+    err = bits[:, CTX_ERROR]
+    warn = bits[:, CTX_WARN] & ~err
+    stack = bits[:, CTX_STACK]
+    exc = bits[:, CTX_EXCEPTION]
+    flags4 = np.stack([err, warn, stack, exc], axis=1).astype(np.int64)  # [B, 4]
+    ps = np.concatenate(
+        [np.zeros((1, 4), dtype=np.int64), np.cumsum(flags4, axis=0)]
+    )
+    shape_ids = tables.pat_ctx_shape[rec_pat]  # [m]
+    rec_ctx = np.zeros((m, 5), dtype=np.int32)
+    rl = rec_line.astype(np.int64)
+    for u, (has_rules, before, after) in enumerate(tables.ctx_shapes):
+        sel = shape_ids == u
+        if not sel.any():
+            continue
+        li = rl[sel]
+        if not has_rules:
+            # context = the matched line only (AnalysisService.java:135-139)
+            counts = flags4[li]
+            total = np.ones(len(li), dtype=np.int64)
+        else:
+            lo = np.clip(li - before, 0, B)
+            hi = np.clip(np.minimum(li + 1 + after, n_lines), 0, B)
+            counts = ps[hi] - ps[lo]
+            total = hi - lo
+        rec_ctx[sel] = np.concatenate(
+            [counts, total[:, None]], axis=1
+        ).astype(np.int32)
+
+    return MatchRecords(
+        n_matches=m,
+        line=rec_line,
+        pattern=rec_pat,
+        sec_dist=rec_dist,
+        seq_ok=rec_seq,
+        ctx_counts=rec_ctx,
+    )

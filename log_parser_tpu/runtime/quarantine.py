@@ -44,6 +44,8 @@ import time
 from collections import OrderedDict
 from typing import Callable
 
+from log_parser_tpu.native.ingest import normalize_blob
+
 DEFAULT_STRIKES = 2
 DEFAULT_TTL_S = 300.0
 DEFAULT_CAPACITY = 4096
@@ -53,13 +55,13 @@ DEFAULT_BREAKER_COOLDOWN_S = 30.0
 def fingerprint(logs: str) -> str:
     """sha256 over the normalized log blob plus its shape bucket.
 
-    Normalization matches the ingest path (utf-8 with ``errors="replace"``
-    — native/ingest.py), so two byte-wise different payloads that encode
-    to the same device batch share a fingerprint. The power-of-two line
-    bucket keeps a prefix of a poison corpus (same bytes, different
-    padded shape → different compiled program) from aliasing the full
-    one."""
-    blob = (logs or "").encode("utf-8", errors="replace")
+    Normalization IS the ingest path's (``normalize_blob`` —
+    native/ingest.py, shared with the line cache), so two byte-wise
+    different payloads that encode to the same device batch share a
+    fingerprint. The power-of-two line bucket keeps a prefix of a poison
+    corpus (same bytes, different padded shape → different compiled
+    program) from aliasing the full one."""
+    blob = normalize_blob(logs)
     n_lines = blob.count(b"\n") + 1
     bucket = 1
     while bucket < n_lines:

@@ -114,6 +114,14 @@ def main(argv: list[str] | None = None) -> int:
         help="requests per coalesced device batch; a full bucket flushes "
         "immediately (LOG_PARSER_TPU_BATCH_MAX)",
     )
+    # exact-match line cache (docs/OPS.md "Line cache (routing tier)")
+    parser.add_argument(
+        "--line-cache-mb", type=float, default=None, metavar="MB",
+        help="resident-byte budget of the exact-match line cache: repeat "
+        "lines skip the match cube, novel lines run as a compacted "
+        "residual batch (runtime/linecache.py; single-device engine "
+        "only; 0 disables; default 64; LOG_PARSER_TPU_LINE_CACHE_MB)",
+    )
     # poison-request quarantine + online shadow verification
     # (docs/OPS.md "Poison-request triage" / "Shadow divergence")
     parser.add_argument(
@@ -183,6 +191,7 @@ def main(argv: list[str] | None = None) -> int:
         (args.batching, "LOG_PARSER_TPU_BATCHING"),
         (args.batch_wait_ms, "LOG_PARSER_TPU_BATCH_WAIT_MS"),
         (args.batch_max, "LOG_PARSER_TPU_BATCH_MAX"),
+        (args.line_cache_mb, "LOG_PARSER_TPU_LINE_CACHE_MB"),
         (args.quarantine_strikes, "LOG_PARSER_TPU_QUARANTINE_STRIKES"),
         (args.quarantine_ttl_s, "LOG_PARSER_TPU_QUARANTINE_TTL_S"),
         (args.shadow_rate, "LOG_PARSER_TPU_SHADOW_RATE"),
@@ -275,6 +284,22 @@ def main(argv: list[str] | None = None) -> int:
                 wait_ms,
                 batch_max,
             )
+
+    line_cache_mb = float(
+        os.environ.get("LOG_PARSER_TPU_LINE_CACHE_MB", "64") or 0
+    )
+    if line_cache_mb > 0:
+        if args.coordinator or args.sharded:
+            # the residual program is the full-bank single-device cube;
+            # sharded engines split patterns/lines across devices and
+            # keep the uncached path (same gate as --batching)
+            log.warning(
+                "--line-cache-mb is only supported on the single-device "
+                "engine; serving uncached"
+            )
+        else:
+            engine.enable_line_cache(line_cache_mb)
+            log.info("Line cache on: %.0f MB budget", line_cache_mb)
 
     if args.coordinator and args.process_id != 0:
         # followers own no network surface: they replay the coordinator's

@@ -274,6 +274,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # queue depth, batch sizes, flush reasons (docs/OPS.md
                 # "Micro-batching")
                 payload["batcher"] = batcher.stats()
+            line_cache = getattr(self.server.engine, "line_cache", None)
+            if line_cache is not None:
+                # routing-tier hit/residual/eviction counters (docs/OPS.md
+                # "Line cache (routing tier)")
+                payload["lineCache"] = line_cache.stats()
             mesh = getattr(self.server.engine, "mesh_health", None)
             if mesh is not None:
                 # follower liveness + degrade-to-local counters

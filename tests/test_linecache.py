@@ -1,0 +1,494 @@
+"""Exact-match line cache (runtime/linecache.py + the engine/batcher
+routing tier).
+
+The contract under test: caching per-line device bit rows changes
+THROUGHPUT, never semantics. Cache-on output — events, scores, frequency
+snapshots — is identical to cache-off on the same stream, batched and
+unbatched; a reload-epoch bump makes a stale hit structurally impossible;
+an open per-pattern breaker overrides cached bits exactly like fresh
+ones (per-pattern invalidation by construction); and a request served
+wholly from cache never reaches the device step, so it can neither
+strike quarantine nor trip the watchdog.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.native.ingest import Corpus, normalize_blob
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.linecache import LineCache, line_key
+from log_parser_tpu.runtime.quarantine import QuarantineTable
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom",
+                    regex="OutOfMemoryError",
+                    confidence=0.9,
+                    severity="CRITICAL",
+                    secondaries=[("GC overhead", 0.3, 10)],
+                    sequences=[(1.5, ["Full GC", "OutOfMemoryError"])],
+                    context=(2, 2),
+                ),
+                make_pattern("conn", regex="Connection refused", confidence=0.7),
+                make_pattern("fatal", regex="FATAL", confidence=0.8),
+            ]
+        )
+    ]
+
+
+def _pod(logs: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "lc"}}, logs=logs)
+
+
+# repeat-heavy stream over a small template set, including lines that
+# exercise every factor: secondary proximity, sequence chain, context
+REPEAT_TEMPLATES = [
+    "INFO steady-state heartbeat",
+    "Full GC pause",
+    "GC overhead limit reached",
+    "java.lang.OutOfMemoryError: heap",
+    "dial tcp 10.0.0.1: Connection refused",
+    "FATAL disk controller",
+]
+
+
+def _stream(n_requests: int = 6, lines_per: int = 12) -> list[PodFailureData]:
+    out = []
+    for r in range(n_requests):
+        lines = [
+            REPEAT_TEMPLATES[(r * 7 + i * 3) % len(REPEAT_TEMPLATES)]
+            for i in range(lines_per)
+        ]
+        # every third request carries one novel line (cache miss traffic)
+        if r % 3 == 0:
+            lines.append(f"WARN novel line {r}")
+        out.append(_pod("\n".join(lines)))
+    return out
+
+
+def _events(result):
+    return [
+        (e.line_number, e.matched_pattern.id, e.score) for e in result.events
+    ]
+
+
+def _ctx(result):
+    return [e.context for e in result.events]
+
+
+def _freq_counts(engine) -> dict:
+    return {k: len(v) for k, v in engine.frequency._save_state().items()}
+
+
+def _cached_engine(mb: float = 4.0) -> AnalysisEngine:
+    engine = AnalysisEngine(_sets(), ScoringConfig())
+    engine.enable_line_cache(mb)
+    return engine
+
+
+# ------------------------------------------------------------ LRU mechanics
+
+
+class TestLineCacheUnit:
+    def test_lookup_populate_and_counters(self):
+        cache = LineCache(n_columns=10, budget_bytes=1 << 20)
+        k1, k2 = line_key(b"alpha"), line_key(b"beta")
+        assert cache.lookup([k1, k2, k1]) == [None, None, None]
+        assert cache.stats()["misses"] == 3
+
+        row = np.zeros(10, dtype=bool)
+        row[3] = True
+        cache.populate([(k1, row)])
+        got = cache.lookup([k1, k2])
+        assert got[1] is None
+        np.testing.assert_array_equal(got[0], row)
+        s = cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 4 and s["entries"] == 1
+
+    def test_lru_eviction_bounded_by_resident_bytes(self):
+        cache = LineCache(n_columns=64, budget_bytes=2000)
+        rows = [(line_key(b"line-%d" % i), np.zeros(64, dtype=bool)) for i in range(100)]
+        cache.populate(rows)
+        s = cache.stats()
+        assert s["evictions"] > 0
+        assert s["residentBytes"] <= 2000
+        assert s["entries"] < 100
+        # the survivors are the most recently inserted (LRU order)
+        assert cache.lookup([rows[-1][0]])[0] is not None
+        assert cache.lookup([rows[0][0]])[0] is None
+
+    def test_flush_clears_and_rebinds_columns(self):
+        cache = LineCache(n_columns=16, budget_bytes=1 << 20)
+        cache.populate([(line_key(b"x"), np.ones(16, dtype=bool))])
+        cache.flush(n_columns=24)
+        s = cache.stats()
+        assert s["entries"] == 0
+        assert s["residentBytes"] == 0
+        assert s["epochFlushes"] == 1
+        assert cache.n_columns == 24
+        assert cache.lookup([line_key(b"x")]) == [None]
+
+
+# ----------------------------------------------------------- exact parity
+
+
+class TestParity:
+    def test_unbatched_stream_parity(self):
+        """The same request stream through a cache-off and a cache-on
+        engine: identical events, contexts, scores (exact), and frequency
+        snapshot counts — including requests served entirely from cache."""
+        stream = _stream()
+        off = AnalysisEngine(_sets(), ScoringConfig())
+        on = _cached_engine()
+        for data in stream:
+            r_off = off.analyze_pipelined(data)
+            r_on = on.analyze_pipelined(data)
+            assert _events(r_off) == _events(r_on)
+            assert _ctx(r_off) == _ctx(r_on)
+        assert _freq_counts(off) == _freq_counts(on)
+        s = on.line_cache.stats()
+        assert s["hits"] > 0 and s["residualRows"] > 0
+        assert on.fallback_count == 0
+
+    def test_all_hit_request_skips_device_entirely(self):
+        engine = _cached_engine()
+        data = _pod("\n".join(REPEAT_TEMPLATES))
+        engine.analyze_pipelined(data)
+        before = engine.line_cache.stats()
+        engine.analyze_pipelined(data)
+        after = engine.line_cache.stats()
+        assert after["residualRows"] == before["residualRows"]
+        assert after["hits"] == before["hits"] + len(REPEAT_TEMPLATES)
+        # no device phase in the trace: the request never dispatched
+        assert "device" not in engine.last_trace.as_dict()
+
+    def test_in_request_dedup_one_device_row_per_unique_line(self):
+        engine = _cached_engine()
+        logs = "\n".join(["java.lang.OutOfMemoryError: heap"] * 9 + ["INFO x"] * 3)
+        engine.analyze_pipelined(_pod(logs))
+        s = engine.line_cache.stats()
+        assert s["residualRows"] == 2  # 12 lines, 2 unique
+        assert s["dedupFanout"] == 10
+
+    def test_needs_host_lines_cached_request_parity(self):
+        """Non-ASCII lines (python-fallback encode → needs_host) ride the
+        override splice: parity holds and they are never populated — a
+        repeat still pays a residual row for them."""
+        logs = (
+            "INFO café latte ☃\n"
+            "java.lang.OutOfMemoryError: heap\n"
+            "INFO café latte ☃"
+        )
+        off = AnalysisEngine(_sets(), ScoringConfig())
+        on = _cached_engine()
+        assert _events(off.analyze_pipelined(_pod(logs))) == _events(
+            on.analyze_pipelined(_pod(logs))
+        )
+        first = on.line_cache.stats()["residualRows"]
+        assert _events(off.analyze_pipelined(_pod(logs))) == _events(
+            on.analyze_pipelined(_pod(logs))
+        )
+        # the ASCII line is a hit; the non-ASCII line misses again
+        assert on.line_cache.stats()["residualRows"] > first
+
+    def test_empty_and_trivial_logs(self):
+        off = AnalysisEngine(_sets(), ScoringConfig())
+        on = _cached_engine()
+        for logs in ("", "\n", "INFO only"):
+            assert _events(off.analyze_pipelined(_pod(logs))) == _events(
+                on.analyze_pipelined(_pod(logs))
+            )
+
+    def test_batched_stream_parity(self):
+        """Full-batch flushes through the cached path == the same stream
+        served serially by a cache-off engine — exact equality, with the
+        cross-flush dedup visible in the counters."""
+        stream = _stream(n_requests=4, lines_per=8)
+        serial = AnalysisEngine(_sets(), ScoringConfig())
+        expected = [_events(serial.analyze_pipelined(d)) for d in stream]
+
+        engine = _cached_engine()
+        engine.enable_batching(wait_ms=5000.0, batch_max=len(stream))
+        try:
+            pend = [engine.batcher._enqueue(d, None) for d in stream]
+            for p in pend:
+                assert p.done.wait(60.0)
+            for p, want in zip(pend, expected):
+                assert p.error is None
+                assert _events(p.result) == want
+            assert _freq_counts(serial) == _freq_counts(engine)
+            s = engine.line_cache.stats()
+            # cross-flush dedup: way fewer device rows than total lines
+            assert 0 < s["residualRows"] <= len(REPEAT_TEMPLATES) + 4
+            assert s["dedupFanout"] > 0
+            assert engine.fallback_count == 0
+        finally:
+            engine.batcher.close()
+
+    def test_batched_all_hit_flush_zero_device_rows(self):
+        engine = _cached_engine()
+        engine.enable_batching(wait_ms=5000.0, batch_max=2)
+        data = _pod("\n".join(REPEAT_TEMPLATES[:4]))
+        try:
+            engine.analyze_batched(data)  # populates (single-item flush)
+            base = engine.line_cache.stats()["residualRows"]
+            pend = [engine.batcher._enqueue(data, None) for _ in range(2)]
+            for p in pend:
+                assert p.done.wait(60.0)
+                assert p.error is None
+            assert engine.line_cache.stats()["residualRows"] == base
+        finally:
+            engine.batcher.close()
+
+
+# ----------------------------------------------------- epoch invalidation
+
+
+class TestInvalidation:
+    def test_reload_epoch_flush_makes_stale_hit_impossible(self):
+        """Swap the library under a warm cache: the new bank's results
+        must be what a cold cache-off engine produces — no bit row from
+        the old library may survive the swap."""
+        engine = _cached_engine()
+        logs = "INFO boot\njava.lang.OutOfMemoryError: heap\nNo space left on device"
+        engine.analyze_pipelined(_pod(logs))  # warm: oom matches
+        assert engine.line_cache.stats()["entries"] > 0
+
+        v2 = [
+            make_pattern_set(
+                [
+                    # same id, CHANGED regex: a stale cached row would
+                    # keep matching the old semantics
+                    make_pattern("oom", regex="No space left on device",
+                                 confidence=0.9, severity="CRITICAL"),
+                ],
+                "lib-v2",
+            )
+        ]
+        source = AnalysisEngine(v2, ScoringConfig())
+        engine.apply_library(source)
+        s = engine.line_cache.stats()
+        assert s["epochFlushes"] == 1
+        assert s["entries"] == 0
+
+        fresh = AnalysisEngine(v2, ScoringConfig())
+        r_on = engine.analyze_pipelined(_pod(logs))
+        r_off = fresh.analyze_pipelined(_pod(logs))
+        assert _events(r_on) == _events(r_off)
+        # the old regex must NOT fire: line 3 matches, line 2 does not
+        assert [e[0] for e in _events(r_on)] == [3]
+
+    def test_breaker_trip_overrides_cached_bits_per_pattern(self):
+        """Per-pattern invalidation by construction: an OPEN breaker's
+        columns are re-evaluated from the host regex over cached rows
+        too. Corrupt one pattern's cached bit and trip its breaker — the
+        corruption is contained the moment the breaker opens, while the
+        OTHER patterns' cached bits keep serving."""
+        engine = _cached_engine()
+        logs = "java.lang.OutOfMemoryError: heap\ndial tcp: Connection refused"
+        want = _events(engine.analyze_pipelined(_pod(logs)))
+        assert [e[1] for e in want] == ["oom", "conn"]
+
+        # simulate a divergent device result resident in the cache:
+        # clear the oom primary bit of the cached OOM line
+        cache = engine.line_cache
+        key = line_key(b"java.lang.OutOfMemoryError: heap")
+        oom_pat = [p.id for p in engine.bank.patterns].index("oom")
+        oom_col = int(engine.bank.primary_columns[oom_pat])
+        with cache.lock:
+            packed = np.frombuffer(cache._entries[key], dtype=np.uint8).copy()
+            row = np.unpackbits(packed, count=cache.n_columns).astype(bool)
+            row[oom_col] = False
+            cache._entries[key] = np.packbits(row).tobytes()
+
+        # corrupted bits ARE served (proves the hit path is live)
+        broken = _events(engine.analyze_pipelined(_pod(logs)))
+        assert [e[1] for e in broken] == ["conn"]
+
+        # breaker trip: oom's columns now come from the exact host regex
+        # on every request — cached rows included
+        engine.breakers.trip("oom")
+        healed = _events(engine.analyze_pipelined(_pod(logs)))
+        assert [(ln, pid) for ln, pid, _ in healed] == [
+            (ln, pid) for ln, pid, _ in want
+        ]
+        # conn kept serving from cache throughout
+        assert engine.line_cache.stats()["hits"] > 0
+
+
+# ------------------------------------------------- quarantine interaction
+
+
+class TestQuarantine:
+    def _engine(self):
+        engine = _cached_engine()
+        engine.fallback_to_golden = True
+        engine.quarantine = QuarantineTable(
+            strikes=1, ttl_s=600.0, clock=FakeClock()
+        )
+        return engine
+
+    def test_cache_hits_never_strike(self):
+        """Arm a keyed poison fault AFTER the cache is warm: the repeat
+        request is served entirely from cache, never reaches the device
+        step, and the fault's fired counter pins that. A novel request
+        sharing the key DOES pay a residual and strikes."""
+        engine = self._engine()
+        logs = "INFO boot\njava.lang.OutOfMemoryError: heap"
+        want = _events(engine.analyze_pipelined(_pod(logs)))  # warm, healthy
+
+        reg = FaultRegistry.parse("quarantine_raise@match=INFO boot")
+        faults.install(reg)
+        repeat = engine.analyze_pipelined(_pod(logs))
+        assert _events(repeat) == want
+        assert reg.specs[0].fired == 0  # device step never entered
+        assert engine.fallback_count == 0
+        assert engine.quarantine.stats()["strikes"] == 0
+
+        # novel content with the same fault key: residual dispatch fires
+        novel = engine.analyze_pipelined(_pod(logs + "\nWARN never seen"))
+        assert novel.events  # served from golden fallback
+        assert reg.specs[0].fired == 1
+        assert engine.fallback_count == 1
+        assert engine.quarantine.stats()["strikes"] == 1
+
+    def test_batched_cached_flush_poison_falls_back_to_bisection(self):
+        """A poisoned residual in a cached flush retries wholesale on the
+        uncached path, where bisection isolates the poison row — healthy
+        batchmates stay on-device, only the culprit strikes."""
+        engine = self._engine()
+        engine.enable_batching(wait_ms=5000.0, batch_max=2)
+        poison = _pod("POISON-PILL marker\nINFO filler")
+        healthy = _pod("dial tcp: Connection refused\nINFO filler")
+        faults.install(FaultRegistry.parse("quarantine_raise@match=POISON-PILL"))
+        try:
+            pend = [
+                engine.batcher._enqueue(d, None) for d in (poison, healthy)
+            ]
+            for p in pend:
+                assert p.done.wait(60.0)
+            assert pend[0].error is None and pend[1].error is None
+            assert [e[1] for e in _events(pend[1].result)] == ["conn"]
+            assert engine.fallback_count == 1  # poison only
+            assert engine.quarantine.stats()["quarantined"] == 1
+            assert engine.batcher.stats()["bisects"] >= 1
+        finally:
+            engine.batcher.close()
+
+
+# ------------------------------------------------------- one hash path
+
+
+class TestKeyStability:
+    def test_key_material_is_the_ingest_normalized_blob(self):
+        """``line_key_bytes`` slices the SAME normalization the quarantine
+        fingerprint hashes (normalize_blob) — no second normalization
+        pass, surrogates and all."""
+        logs = "plain ascii\ncafé ☃\nbad \ud800 surrogate"
+        corpus = Corpus(logs)
+        joined = b"\n".join(
+            corpus.line_key_bytes(i) for i in range(corpus.n_lines)
+        )
+        assert joined == normalize_blob(logs)
+
+    def test_key_stable_across_http_framed_grpc_ingest(self):
+        """One payload through all three transport codecs: HTTP JSON,
+        the framed shim's protobuf Envelope, and the gRPC ParseRequest —
+        every decode yields byte-identical per-line cache keys."""
+        from log_parser_tpu.shim import logparser_pb2 as pb
+
+        logs = "INFO café\njava.lang.OutOfMemoryError: heap\n☃ snow"
+
+        # HTTP: JSON body round-trip (serve/http.py reads payload["logs"])
+        http_logs = json.loads(json.dumps({"logs": logs}))["logs"]
+        # gRPC: ParseRequest proto round-trip
+        grpc_logs = pb.ParseRequest.FromString(
+            pb.ParseRequest(logs=logs).SerializeToString()
+        ).logs
+        # framed shim: Envelope-wrapped ParseRequest round-trip
+        env = pb.Envelope(
+            method="Parse",
+            payload=pb.ParseRequest(logs=logs).SerializeToString(),
+        )
+        framed_logs = pb.ParseRequest.FromString(
+            pb.Envelope.FromString(env.SerializeToString()).payload
+        ).logs
+
+        keys = []
+        for decoded in (http_logs, grpc_logs, framed_logs):
+            corpus = Corpus(decoded)
+            keys.append(
+                [
+                    line_key(corpus.line_key_bytes(i))
+                    for i in range(corpus.n_lines)
+                ]
+            )
+        assert keys[0] == keys[1] == keys[2]
+
+    def test_python_fallback_keys_match_native_blob_slices(self, monkeypatch):
+        """The python-fallback encode produces the same key bytes as the
+        native blob slices, so a warm cache survives either ingest path."""
+        import log_parser_tpu.native.ingest as ingest_mod
+
+        logs = "INFO a\njava.lang.OutOfMemoryError: heap\nINFO b"
+        native_corpus = Corpus(logs)
+        monkeypatch.setattr(ingest_mod, "get_lib", lambda: None)
+        fallback_corpus = Corpus(logs)
+        assert fallback_corpus._lines is not None  # really the fallback
+        for i in range(native_corpus.n_lines):
+            assert native_corpus.line_key_bytes(i) == fallback_corpus.line_key_bytes(i)
+
+
+# ----------------------------------------------------------- concurrency
+
+
+def test_concurrent_cached_requests_thread_safe():
+    """Pipelined requests sharing one cache race lookups against
+    populates; results must stay per-request correct."""
+    engine = _cached_engine()
+    stream = _stream(n_requests=8, lines_per=6)
+    serial = AnalysisEngine(_sets(), ScoringConfig())
+    expected = [_events(serial.analyze_pipelined(d)) for d in stream]
+
+    results: list = [None] * len(stream)
+
+    def worker(j):
+        results[j] = _events(engine.analyze_pipelined(stream[j]))
+
+    threads = [
+        threading.Thread(target=worker, args=(j,)) for j in range(len(stream))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # per-request events and scores are frequency-independent here only
+    # for line/pattern identity; frequency-coupled scores may differ by
+    # arrival order, so compare line/pattern sets per request
+    for got, want in zip(results, expected):
+        assert [(ln, pid) for ln, pid, _ in got] == [
+            (ln, pid) for ln, pid, _ in want
+        ]
+    assert _freq_counts(engine) == _freq_counts(serial)

@@ -77,6 +77,32 @@ divergence"):
                                half-open probe (forced shadow sample)
                                closes it and health recovers.
 
+Linecache group (``--group linecache``; routing-tier template cache —
+docs/OPS.md "Line cache (routing tier)"):
+
+- ``linecache-hit-under-reload-swap``  a burst of cache-hit requests
+                               races a hot pattern reload — zero failed
+                               requests, the swap flushes the cache
+                               exactly once (epochFlushes bumps), and
+                               the new epoch repopulates it.
+- ``linecache-eviction-under-load``  a cache budgeted far below the
+                               working set keeps serving exact results
+                               while evicting LRU lines and never
+                               exceeds its resident-byte ceiling.
+- ``linecache-breaker-partial-invalidation``  a shadow-divergence
+                               breaker trip while the stream is served
+                               from cache: the tripped pattern's
+                               columns re-evaluate from the exact host
+                               regex over CACHED rows (per-pattern
+                               invalidation by construction) and the
+                               other patterns keep hitting the cache.
+- ``linecache-shadow-parity``  rate-1.0 online shadow verification over
+                               a cache-served stream — every response,
+                               including all-hit requests that never
+                               touch the device, re-runs on the golden
+                               host path; zero divergences is the
+                               in-service cache-on ≡ cache-off proof.
+
 Distributed group (``--group distributed``; needs a jax build whose CPU
 backend supports multi-process collectives — reported SKIP otherwise):
 
@@ -90,7 +116,7 @@ backend supports multi-process collectives — reported SKIP otherwise):
                         processes down cleanly.
 
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|distributed|all]
+                                   [--group base|batcher|state|poison|linecache|distributed|all]
                                    [--keep-logs]
 """
 
@@ -550,6 +576,137 @@ POISON_SCENARIOS = [
 ]
 
 
+# --------------------------------------------------- linecache scenarios
+
+
+def scenario_linecache_reload_swap(srv: Server):
+    """A burst of cache-hit requests racing a hot pattern reload: zero
+    failed requests, the swap flushes the routing tier exactly once
+    (epochFlushes bumps), and the new epoch repopulates the cache — a
+    stale hit across the pattern swap is impossible."""
+    for _ in range(2):  # warm: miss+populate, then all-hit
+        assert post(srv.url)[0] == 200
+    _, trace = get(srv.url, "/trace/last")
+    lc = trace["lineCache"]
+    assert lc["entries"] > 0 and lc["hits"] > 0, lc
+    burst = Burst(srv.url, 8)
+    time.sleep(0.05)  # let the burst enqueue before the swap quiesces
+    status, body = post_raw(srv.url, "/patterns/reload", b"")
+    results = burst.join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    assert codes == [200] * 8, codes
+    assert status == 200 and body["epoch"] == 1, (status, body)
+    # the swapped banks serve the next request and repopulate the cache
+    status, body, _ = post(srv.url)
+    assert status == 200, status
+    assert body["summary"]["significantEvents"] >= 1, body["summary"]
+    _, trace = get(srv.url, "/trace/last")
+    lc = trace["lineCache"]
+    assert lc["epochFlushes"] == 1, lc
+    assert lc["entries"] > 0, lc
+    assert trace["reload"]["epoch"] == 1, trace["reload"]
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_linecache_eviction(srv: Server):
+    """A cache budgeted far below the working set must keep serving
+    exact results while evicting LRU lines, and its resident bytes must
+    never exceed the configured ceiling."""
+    for r in range(6):
+        logs = "\n".join(
+            f"INFO unique filler {r}.{i} status=ok" for i in range(40)
+        ) + "\njava.lang.OutOfMemoryError: heap"
+        status, body, _ = post_logs(srv.url, logs)
+        assert status == 200, status
+        assert body["summary"]["significantEvents"] >= 1, body["summary"]
+    _, trace = get(srv.url, "/trace/last")
+    lc = trace["lineCache"]
+    assert lc["evictions"] > 0, lc
+    assert lc["residentBytes"] <= EVICTION_BUDGET_MB * 1024 * 1024, lc
+    assert lc["entries"] > 0, lc
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_linecache_breaker_partial(srv: Server):
+    """A shadow-divergence breaker trip while the stream is served from
+    cache: the tripped pattern's columns re-evaluate from the exact host
+    regex over CACHED rows (per-pattern invalidation by construction —
+    the host override cube is spliced over cached and fresh bits alike),
+    requests stay 200 with the correct event, and the other patterns
+    keep hitting the cache."""
+    assert post(srv.url)[0] == 200  # miss+populate; comparison clean (after=1)
+    _poll_trace(srv.url, lambda t: t.get("shadow", {}).get("compared", 0) >= 1)
+    assert post(srv.url)[0] == 200  # all-hit; this comparison diverges
+    trace = _poll_trace(
+        srv.url, lambda t: t.get("shadow", {}).get("divergences", 0) >= 1
+    )
+    assert trace["shadow"]["breakers"]["open"], trace["shadow"]["breakers"]
+    hits_before = trace["lineCache"]["hits"]
+    # breaker open: the request still serves from cache (hits grow) and
+    # the divergent pattern's verdict comes from the exact host regex
+    status, body, _ = post(srv.url)
+    assert status == 200, status
+    assert body["summary"]["significantEvents"] >= 1, body["summary"]
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["lineCache"]["hits"] > hits_before, trace["lineCache"]
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_linecache_shadow_parity(srv: Server):
+    """Rate-1.0 online shadow verification over a cache-served stream —
+    every response, including the all-hit requests that never touch the
+    device, re-runs on the golden host path and compares events and
+    scores. Zero divergences IS the in-service cache-on ≡ cache-off
+    proof."""
+    for _ in range(6):
+        assert post(srv.url)[0] == 200
+    trace = _poll_trace(
+        srv.url, lambda t: t.get("shadow", {}).get("compared", 0) >= 6
+    )
+    assert trace["shadow"]["divergences"] == 0, trace["shadow"]
+    lc = trace["lineCache"]
+    # requests 2..6 are served wholly from cache (3 lines each)
+    assert lc["hits"] >= 15, lc
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+EVICTION_BUDGET_MB = 0.002  # ≈ 16 entries at the builtin bank's row width
+
+LINECACHE_SCENARIOS = [
+    (
+        "linecache-hit-under-reload-swap",
+        [
+            "--line-cache-mb", "64",
+            "--batching", "on", "--batch-wait-ms", "20", "--batch-max", "8",
+        ],
+        {},
+        scenario_linecache_reload_swap,
+    ),
+    (
+        "linecache-eviction-under-load",
+        ["--line-cache-mb", str(EVICTION_BUDGET_MB)],
+        {},
+        scenario_linecache_eviction,
+    ),
+    (
+        "linecache-breaker-partial-invalidation",
+        ["--line-cache-mb", "64", "--shadow-rate", "1.0"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "shadow_raise@times=1@after=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+            "LOG_PARSER_TPU_PATTERN_BREAKER_COOLDOWN_S": "600",
+        },
+        scenario_linecache_breaker_partial,
+    ),
+    (
+        "linecache-shadow-parity",
+        ["--line-cache-mb", "64", "--shadow-rate", "1.0"],
+        {},
+        scenario_linecache_shadow_parity,
+    ),
+]
+
+
 # ------------------------------------------------------- state scenarios
 
 
@@ -898,7 +1055,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--only", help="run a single scenario by name")
     parser.add_argument(
         "--group",
-        choices=("base", "batcher", "state", "poison", "distributed", "all"),
+        choices=(
+            "base", "batcher", "state", "poison", "linecache",
+            "distributed", "all",
+        ),
         default="base",
         help="which scenario group to sweep (default: base; the "
         "distributed group needs multi-process CPU collective support)",
@@ -920,6 +1080,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(STATE_SCENARIOS)
     if args.group in ("poison", "all"):
         single_server.extend(POISON_SCENARIOS)
+    if args.group in ("linecache", "all"):
+        single_server.extend(LINECACHE_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:
