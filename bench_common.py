@@ -121,6 +121,36 @@ last_relay_health: dict | None = None
 #: edge where the probe budget expires before the first attempt).
 last_fell_back: bool = False
 
+#: The ACTUAL device platform of this process after probe_backend()
+#: returned, read straight from the live device layer (never inferred
+#: from the requested label) — emit() stamps it as ``backend`` beside
+#: the bench's ``platform`` label so an artifact reader can always tell
+#: the two apart (BENCH_r04-r07 carried only the label, and the silent
+#: CPU landings had to be reconstructed from probe diagnostics).
+last_backend: str | None = None
+
+#: True iff the last probe_backend() call was served from the probe
+#: cache (the staged subprocess campaign was skipped); stamped beside
+#: ``backend`` for provenance.
+last_probe_cached: bool = False
+
+#: On-disk cache of the last SUCCESSFUL probe outcome, so a series of
+#: bench invocations (an A/B recording, refresh_artifacts.sh) dials the
+#: staged subprocess campaign once instead of per-bench. Bounded two
+#: ways: a TTL (below), and the rule that a cache hit still runs the
+#: full in-process _pin_and_verify — the cache can only skip the
+#: subprocess attempts, never the mislabel guard, so a tunnel that died
+#: since the cached probe invalidates the entry instead of mislabeling.
+#: Failures are never cached. Per-user for the same reason as the probe
+#: handoff record.
+_PROBE_CACHE_PATH = os.path.join(
+    tempfile.gettempdir(),
+    f"log_parser_tpu_probe_cache_{os.getuid()}.json",
+)
+PROBE_CACHE_TTL_S = float(
+    os.environ.get("LOG_PARSER_TPU_PROBE_CACHE_TTL", "600")
+)
+
 
 def timeit(fn, n: int = 3, warmup: int = 1) -> float:
     """Best-of-n wall time after warmup — THE timing rule shared by every
@@ -823,6 +853,40 @@ def _stamp_relay_health(budget_s: float = 120.0) -> None:
     }
 
 
+def _probe_cache_load(key: str) -> str | None:
+    """The cached platform for ``key`` (the explicit request or "auto"),
+    or None when absent, mismatched, unparseable, or past the TTL."""
+    if PROBE_CACHE_TTL_S <= 0:
+        return None
+    try:
+        with open(_PROBE_CACHE_PATH) as f:
+            doc = json.load(f)
+        if (
+            doc.get("key") == key
+            and isinstance(doc.get("platform"), str)
+            and 0 <= time.time() - float(doc.get("ts", 0)) < PROBE_CACHE_TTL_S
+        ):
+            return doc["platform"]
+    except (OSError, ValueError, TypeError):
+        pass
+    return None
+
+
+def _probe_cache_store(key: str, platform: str) -> None:
+    try:
+        with open(_PROBE_CACHE_PATH, "w") as f:
+            json.dump({"key": key, "platform": platform, "ts": time.time()}, f)
+    except OSError:
+        pass
+
+
+def _probe_cache_clear() -> None:
+    try:
+        os.unlink(_PROBE_CACHE_PATH)
+    except OSError:
+        pass
+
+
 def probe_backend(metric: str, unit: str) -> str:
     """Bring up a JAX backend for this bench, preferring the device.
 
@@ -841,11 +905,45 @@ def probe_backend(metric: str, unit: str) -> str:
     module docstring's contract).
     """
     global last_probe_diagnostics, last_fell_back, last_relay_health
+    global last_backend, last_probe_cached
     last_probe_diagnostics = []
     last_fell_back = False
     last_relay_health = None
+    last_backend = None
+    last_probe_cached = False
 
     explicit = os.environ.get("LOG_PARSER_TPU_PLATFORM")
+    cache_key = explicit or "auto"
+    cached = _probe_cache_load(cache_key)
+    if cached is not None:
+        # a recent invocation's campaign already proved this backend can
+        # come up — skip the staged subprocess dials, but the in-process
+        # verification below is NOT skippable: it is the mislabel guard,
+        # and a dead tunnel behind a fresh cache entry must invalidate
+        # the entry, not produce a mislabeled artifact
+        try:
+            _pin_and_verify(explicit or cached, 120.0)
+        except _PinWedged as exc:
+            last_probe_diagnostics.append(
+                {"outcome": "pin-wedged", "cached": True, "error": str(exc)}
+            )
+            print(f"# backend pin wedged (cached probe): {exc}", file=sys.stderr)
+            exit_null(metric, unit, explicit or cached, str(exc))
+        except RuntimeError as exc:
+            print(
+                f"# cached probe outcome stale ({exc}); re-dialing",
+                file=sys.stderr,
+            )
+            _probe_cache_clear()
+        else:
+            print(f"# backend ok: {cached} (cached probe)", file=sys.stderr)
+            last_probe_cached = True
+            last_backend = _device_platform()
+            if cached != "cpu":
+                _stamp_relay_health()
+                print(f"# relay health: {last_relay_health}", file=sys.stderr)
+            return cached
+
     deadline = time.monotonic() + PROBE_TIMEOUT_S
     attempt = 0
     while True:
@@ -897,6 +995,8 @@ def probe_backend(metric: str, unit: str) -> str:
                 break
             print(f"# backend ok: {platform} (attempt {attempt})", file=sys.stderr)
             last_probe_diagnostics = []
+            last_backend = _device_platform()
+            _probe_cache_store(cache_key, platform)
             if platform != "cpu":
                 _stamp_relay_health()
                 print(f"# relay health: {last_relay_health}", file=sys.stderr)
@@ -931,6 +1031,7 @@ def probe_backend(metric: str, unit: str) -> str:
             f"floor fallback landed on already-initialized {actual!r} "
             "backend; refusing to record it under a 'cpu' label",
         )
+    last_backend = actual
     return "cpu"
 
 
@@ -966,6 +1067,12 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float | None,
         "platform": platform,
     }
     doc.update(extra)
+    if last_backend is not None:
+        # the label says what the bench CLAIMS; ``backend`` says what
+        # the device layer actually was when the probe pinned it — plus
+        # whether the probe outcome came from the cache
+        doc["backend"] = last_backend
+        doc["probe_cached"] = last_probe_cached
     if last_relay_health is not None:
         doc["relay_health"] = last_relay_health
     if last_probe_diagnostics:

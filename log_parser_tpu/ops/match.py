@@ -950,6 +950,19 @@ class MatcherBanks:
         if self.multi_cluster is None:
             for g in self.multi_groups:
                 g._table()  # upload now, outside any jit trace (_table)
+        # opt-in Pallas union-DFA kernel (matchdfa_pallas.py): admitted
+        # here (table-size check is static) so cube() only re-checks the
+        # batch tile. Env read once for the same frozen-under-jit reason
+        # as bitglush_use_pallas above.
+        self._dfa_pallas_plan = None
+        self.multidfa_pallas_reason = "off"
+        if os.environ.get("LOG_PARSER_TPU_PALLAS_DFA") == "1":
+            from log_parser_tpu.ops.matchdfa_pallas import build_dfa_plan
+
+            plan, reason = build_dfa_plan(self.multi_groups)
+            self._dfa_pallas_plan = plan
+            self.multidfa_pallas_reason = reason
+        self.multidfa_use_pallas = self._dfa_pallas_plan is not None
         self.dfa_bank = DfaBank(
             [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
         )
@@ -966,6 +979,18 @@ class MatcherBanks:
     @property
     def multi_cols(self) -> list[int]:
         return [c for g in self.multi_groups for c in g.cols]
+
+    def dfa_kernel_active(self, B: int) -> bool:
+        """Host-side predicate: will cube() route the union groups
+        through the Pallas kernel for a B-row batch (modulo runtime
+        faults)? Used by the engine's kernel-tier counters — uses the
+        nominal-T admission, same as cube()'s tile re-check for typical
+        padded lengths."""
+        if not self.multidfa_use_pallas:
+            return False
+        from log_parser_tpu.ops.matchdfa_pallas import dfa_tile
+
+        return dfa_tile(self._dfa_pallas_plan, B) is not None
 
     @property
     def device_cols(self) -> list[int]:
@@ -1021,7 +1046,35 @@ class MatcherBanks:
                         False,
                     )
                 )
-        if self.multi_cluster is not None:
+        multi_pallas: list | None = None
+        if self.multi_groups and self.multidfa_use_pallas:
+            from log_parser_tpu.ops.matchdfa_pallas import (
+                dfa_tile,
+                multidfa_reported_pallas,
+            )
+
+            if dfa_tile(self._dfa_pallas_plan, B, lines_tb.shape[0]) is not None:
+                # any failure on this path — injected kernel fault or a
+                # real lowering error — drops the WHOLE batch back onto
+                # the XLA scan tier below, parity preserved
+                try:
+                    from log_parser_tpu.runtime import faults
+
+                    faults.fire("kernel")
+                    rep_bg = multidfa_reported_pallas(
+                        self._dfa_pallas_plan, lines_tb
+                    )
+                    multi_pallas = [
+                        rep_bg[:, i] != 0
+                        for i in range(len(self.multi_groups))
+                    ]
+                except Exception:
+                    self.multidfa_pallas_reason = "fault"
+            else:
+                self.multidfa_pallas_reason = "no_tile"
+        if multi_pallas is not None:
+            pass  # reported flags join multi_reps after the fused scan
+        elif self.multi_cluster is not None:
             cluster = self.multi_cluster
             steppers.append(
                 (cluster.pair_stepper(B, lengths), cluster, False)
@@ -1039,6 +1092,10 @@ class MatcherBanks:
                 (self.prefilter.anyhit_stepper(B, lengths), None, False)
             )
         if not steppers:
+            if multi_pallas is not None:
+                cube = self._multi_contribution(
+                    cube, lines_tb, lengths, multi_pallas
+                )
             return cube
 
         inits = tuple(s[0][0] for s in steppers)
@@ -1075,6 +1132,8 @@ class MatcherBanks:
             # tiers (a round-4 alternative-split experiment did exactly
             # that and was silently masked by .set — PERF.md §9b)
             cube = cube.at[:, jnp.asarray(np.asarray(cols))].max(out)
+        if multi_pallas is not None:
+            multi_reps.extend(multi_pallas)
         if multi_reps:
             cube = self._multi_contribution(cube, lines_tb, lengths, multi_reps)
         return cube

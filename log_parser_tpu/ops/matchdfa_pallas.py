@@ -1,0 +1,267 @@
+"""Pallas TPU kernel for the union multi-DFA reported-flag scan.
+
+The lax.scan implementation (ops/match.py ``MultiDfaBank`` /
+``MultiDfaCluster``) pays one ``[B]`` (or ``[B, G]``) flat-table gather
+per byte — and TPU gathers run on the scalar unit at ~9 ns/element
+(PERF.md §1/§4), which is the measured binding constraint of the multi
+tier. This kernel keeps the byte-precomposed transition table resident
+in VMEM and replaces the per-step gather with MXU one-hot matmuls
+vectorized across the batch tile:
+
+- the table is re-encoded densely as ``v' = next_state * 2 + reported``
+  (``next_state < 8192`` under the union state budget, so ``v' <= 16383``
+  fits two exact 8-bit matmul planes — TPU matmuls run at bfloat16
+  precision, 8-bit mantissa, the same plane split as bitglush_pallas.py)
+  and transposed to ``[256, S]`` so one transposed byte one-hot
+  (``[256, TILE]``, iota-over-sublanes compared against the byte row —
+  never materialized in HBM) contracts to the per-state transition row
+  ``[TILE, S]`` for every lane's byte in one MXU pass;
+- the state select is a lane-iota compare against the carried state
+  column (``[TILE, 1]``) summed over lanes — a vector select, not a
+  gather;
+- scan state (state, reported) stays in VMEM across a ``fori_loop`` over
+  the byte steps (the unrolled form blew the Mosaic compile past 9
+  minutes on the bitglush kernel at T=64; the loop form compiles in
+  seconds), with single-stride and pair-stride variants (the pair
+  variant mirrors the fused scan's byte-pair steps; both orders visit
+  every byte and are bit-identical);
+- groups ride the grid: ``grid = (G, B // TILE)`` with each group's
+  plane pair streamed per grid step, so one ``pallas_call`` advances the
+  whole union cluster.
+
+Padding is gate-free exactly like the scan tier: byte 0 of the packed
+table self-loops carrying the state's own report flag (content NULs
+never reach the device), so no length gating is needed and the reported
+OR past end-of-line is an idempotent re-OR. The exact flagged-row
+accept recovery (``_multi_contribution`` — out-word re-scan of flagged
+rows with the ``lax.cond`` dense fallback) deliberately stays on the
+XLA tier: it touches only the rare flagged rows, so the gather there is
+not on the hot path.
+
+Admission: the dense planes cost ``2 * 256 * S_pad * 4`` bytes of VMEM
+per group block. ``build_dfa_plan`` refuses banks whose padded state
+count blows the scoped-VMEM budget (Mosaic scopes ~16 MB; we budget
+12 MB and leave the rest for the byte tile, the one-hot, and the
+``[TILE, S_pad]`` temporaries), and ``dfa_tile`` re-checks at call time
+against the actual T and shrinks the batch tile before giving up —
+callers fall back to the XLA scan tier on ``None``. Mosaic-friendly
+dialect throughout: int32 only, logical shifts via
+``jax.lax.shift_right_logical``, no bool vectors (compare results are
+cast immediately), 128-aligned lane slices (``S_pad`` is rounded up to
+a lane multiple).
+
+Semantics are IDENTICAL to the scan tier's reported-flag carry —
+verified bit-exactly by tests/test_matchdfa_pallas.py (interpreter
+mode) and adjudicated on live TPU by tools/probe_kernels.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from log_parser_tpu.ops.bitglush_pallas import _SRL, _dotT, pick_tile
+
+# Smaller than bitglush's 512: the [TILE, S_pad] transition-row
+# temporaries scale with the tile, and the planes already claim most of
+# the budget at large S.
+DFA_TILE_B = 128
+DFA_VMEM_BUDGET = 12 * 1024 * 1024
+# T used for admission when the batch's padded length is not yet known
+# (host-side tier predicates); dfa_tile re-checks with the real T.
+_NOMINAL_T = 512
+
+_REPORT_BIT = 1 << 30  # MultiDfaBank._REPORT_BIT
+_STATE_MASK = _REPORT_BIT - 1
+
+# Tier reason codes surfaced in /trace/last (kernel block) and pinned to
+# docs/OPS.md rows by tools/hygiene.py. Keep keys snake_case words.
+REASONS = {
+    "ok": "kernel admitted; union groups run through the Pallas scan",
+    "off": "LOG_PARSER_TPU_PALLAS_DFA unset (default) — XLA scan tier",
+    "no_union_groups": "bank packed no union multi-DFA groups",
+    "table_too_large": "dense planes exceed the VMEM budget — XLA scan",
+    "no_tile": "no usable batch tile for this batch size — XLA scan",
+    "fault": "kernel path raised; whole batch fell back to the XLA scan",
+}
+
+
+@dataclass
+class DfaKernelPlan:
+    """Host-packed kernel operands for one bank's union groups."""
+
+    p0: np.ndarray  # [256, G * s_pad] float32: (state*2 + rep) & 0xFF
+    p1: np.ndarray  # [256, G * s_pad] float32: (state*2 + rep) >> 8
+    starts: np.ndarray  # [G, 2] int32: (start state, start reported)
+    s_pad: int
+    n_groups: int
+
+
+def _group_planes(group, s_pad: int) -> tuple[np.ndarray, np.ndarray]:
+    """Dense 8-bit plane pair [256, s_pad] of one group's precomposed
+    table, re-encoded v' = next_state * 2 + reported and transposed to
+    byte-major. Padding states carry v' = 0; they are unreachable (the
+    carried state never leaves [0, S))."""
+    pb = np.asarray(group._packed_byte_np, dtype=np.int64).reshape(-1, 256)
+    vp = ((pb & _STATE_MASK) * 2 + ((pb >> 30) & 1)).astype(np.int32)
+    p0 = np.zeros((256, s_pad), np.float32)
+    p1 = np.zeros((256, s_pad), np.float32)
+    p0[:, : vp.shape[0]] = (vp & 0xFF).T
+    p1[:, : vp.shape[0]] = (vp >> 8).T
+    return p0, p1
+
+
+def _vmem_estimate(s_pad: int, tile: int, T: int) -> int:
+    """Bytes of VMEM one grid step needs: byte tile + both planes + the
+    transposed one-hot + ~5 [tile, s_pad] f32/i32 temporaries (two plane
+    results, reassembled next, select mask, product) + carries/out."""
+    return 4 * (
+        T * tile + 2 * 256 * s_pad + 256 * tile + 5 * tile * s_pad + 2 * tile
+    )
+
+
+def build_dfa_plan(
+    groups, budget: int | None = None
+) -> tuple[DfaKernelPlan | None, str]:
+    """Pack a bank's union groups into kernel operands, or refuse with a
+    REASONS code. Admission here is table-size only (state counts are
+    static); the batch tile is re-admitted per call by dfa_tile."""
+    if budget is None:
+        budget = DFA_VMEM_BUDGET
+    if not groups:
+        return None, "no_union_groups"
+    s_max = max(g.n_states for g in groups)
+    s_pad = max(128, -(-s_max // 128) * 128)  # 128-aligned lane slices
+    if _vmem_estimate(s_pad, DFA_TILE_B, _NOMINAL_T) > budget:
+        return None, "table_too_large"
+    G = len(groups)
+    p0 = np.zeros((256, G * s_pad), np.float32)
+    p1 = np.zeros((256, G * s_pad), np.float32)
+    starts = np.zeros((G, 2), np.int32)
+    for gi, g in enumerate(groups):
+        a, b = _group_planes(g, s_pad)
+        p0[:, gi * s_pad : (gi + 1) * s_pad] = a
+        p1[:, gi * s_pad : (gi + 1) * s_pad] = b
+        starts[gi] = (g.start, int(g.start_reports))
+    return DfaKernelPlan(p0, p1, starts, s_pad, G), "ok"
+
+
+def dfa_tile(
+    plan: DfaKernelPlan,
+    B: int,
+    T: int | None = None,
+    budget: int | None = None,
+) -> int | None:
+    """Largest admissible batch tile for a B-row batch, shrinking until
+    the VMEM estimate fits; None when no tile works (caller falls back
+    to the XLA scan)."""
+    if budget is None:
+        budget = DFA_VMEM_BUDGET
+    T = _NOMINAL_T if T is None else T
+    limit = DFA_TILE_B
+    while True:
+        tile = pick_tile(B, limit)
+        if tile is None:
+            return None
+        if _vmem_estimate(plan.s_pad, tile, T) <= budget:
+            return tile
+        limit = tile - 8
+
+
+def _kernel(bytes_ref, p0_ref, p1_ref, start_ref, out_ref, *, T, stride):
+    tile = out_ref.shape[0]
+    s_pad = p0_ref.shape[1]
+    row256 = jax.lax.broadcasted_iota(jnp.int32, (256, tile), 0)
+    lane_s = jax.lax.broadcasted_iota(jnp.int32, (tile, s_pad), 1)
+    one = jnp.int32(1)
+
+    def step(t, s, rep):
+        b_row = bytes_ref[pl.ds(t, 1), :]  # [1, TILE]
+        ohT = (row256 == b_row).astype(jnp.float32)  # [256, TILE]
+        n0 = _dotT(ohT, p0_ref[:])  # [TILE, s_pad]
+        n1 = _dotT(ohT, p1_ref[:])
+        nxt = n0.astype(jnp.int32) | (n1.astype(jnp.int32) << 8)
+        sel = (lane_s == s).astype(jnp.int32)  # state one-hot per lane
+        v = jnp.sum(nxt * sel, axis=1, keepdims=True)  # [TILE, 1]
+        return _SRL(v, one), rep | (v & one)
+
+    if stride == 2:
+        n_steps = T // 2
+
+        def body(i, carry):
+            s, rep = step(2 * i, *carry)
+            return step(2 * i + 1, s, rep)
+
+    else:
+        n_steps = T
+
+        def body(i, carry):
+            return step(i, *carry)
+
+    init = (
+        jnp.full((tile, 1), start_ref[0, 0], jnp.int32),
+        jnp.full((tile, 1), start_ref[0, 1], jnp.int32),
+    )
+    s, rep = jax.lax.fori_loop(0, n_steps, body, init)
+    if stride == 2 and T % 2:
+        s, rep = step(T - 1, s, rep)
+    out_ref[:] = rep
+
+
+def multidfa_reported_pallas(
+    plan: DfaKernelPlan,
+    lines_tb: jax.Array,
+    stride: int = 2,
+    interpret: bool | None = None,
+    tile_b: int | None = None,
+    budget: int | None = None,
+) -> jax.Array:
+    """Run every union group's reported-flag scan in one Pallas call.
+
+    ``lines_tb``: uint8 [T, B]; returns int32 [B, G] 0/1 reported flags
+    in group order, bit-equal to finishing the scan tier's pair_stepper
+    carry. ``stride`` 2 mirrors the fused scan's byte-pair steps; 1 is
+    the single-stride variant (identical results, A/B'd by
+    tools/probe_kernels.py)."""
+    assert stride in (1, 2)
+    T, B = lines_tb.shape
+    if interpret is None:
+        # Mosaic needs real TPU hardware; everywhere else (CPU test
+        # meshes) the interpreter executes the same kernel semantics
+        interpret = jax.default_backend() != "tpu"
+    tile = dfa_tile(plan, B, T, budget=budget) if tile_b is None else tile_b
+    assert tile is not None, f"no usable tile for batch rows {B}"
+    G, s_pad = plan.n_groups, plan.s_pad
+    kernel = functools.partial(_kernel, T=T, stride=stride)
+    return pl.pallas_call(
+        kernel,
+        grid=(G, B // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (T, tile), lambda g, i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (256, s_pad), lambda g, i: (0, g), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (256, s_pad), lambda g, i: (0, g), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, 2), lambda g, i: (g, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, 1), lambda g, i: (i, g), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, G), jnp.int32),
+        interpret=interpret,
+    )(
+        lines_tb.astype(jnp.int32),
+        jnp.asarray(plan.p0),
+        jnp.asarray(plan.p1),
+        jnp.asarray(plan.starts),
+    )

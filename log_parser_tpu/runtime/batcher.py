@@ -563,6 +563,7 @@ class MicroBatcher:
             )
 
         recs_list = engine.watchdog.run(_device_step)
+        engine._note_kernel_dispatch(B)
         engine._k_hint = max(r.n_matches for r in recs_list)
         return recs_list[: len(items)]
 

@@ -303,6 +303,45 @@ class DeviceWatchdog:
         return result[0]
 
 
+class KernelTierStats:
+    """Counters for the Pallas union-DFA kernel tier (GET /trace/last
+    ``kernel`` block). One note per device dispatch — engine direct path,
+    line-cache residual cubes, and the micro-batcher's vmapped batches
+    all report here — so operators can see whether traffic actually
+    rides the kernel and why not when it doesn't (REASONS codes,
+    ops/matchdfa_pallas.py)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.reason = "off"
+        self.kernel_batches = 0
+        self.kernel_rows = 0
+        self.xla_batches = 0
+
+    def note(self, rows: int, active: bool, enabled: bool, reason: str):
+        with self._lock:
+            self.enabled = enabled
+            self.reason = reason
+            if not enabled:
+                return
+            if active:
+                self.kernel_batches += 1
+                self.kernel_rows += rows
+            else:
+                self.xla_batches += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "reason": self.reason,
+                "kernelBatches": self.kernel_batches,
+                "kernelRows": self.kernel_rows,
+                "xlaBatches": self.xla_batches,
+            }
+
+
 _NULL_LOCK = contextlib.nullcontext()
 
 
@@ -432,6 +471,8 @@ class AnalysisEngine:
         # how many requests this engine served from the golden host path
         # because the device layer failed (surfaced via GET /trace/last)
         self.fallback_count = 0
+        # Pallas union-DFA kernel tier accounting (GET /trace/last)
+        self.kernel_stats = KernelTierStats()
         # ... and how many were ROUTED there deliberately by admission
         # pressure (serve/admission.py ladder rung 2) — a separate counter,
         # because pressure routing is policy, not failure
@@ -808,17 +849,39 @@ class AnalysisEngine:
     def _corpus_min_rows(self) -> int:
         return 8
 
+    def _note_kernel_dispatch(self, batch_rows: int) -> None:
+        """Kernel-tier accounting for one device dispatch: did the union
+        groups ride the Pallas kernel for this cube batch size? A fault
+        fallback flips the matchers' reason to "fault" at trace time, so
+        the batch lands in xlaBatches."""
+        m = self._matchers
+        if m is None:
+            return
+        enabled = m.multidfa_use_pallas
+        active = (
+            enabled
+            and m.multidfa_pallas_reason == "ok"
+            and m.dfa_kernel_active(batch_rows)
+        )
+        self.kernel_stats.note(
+            batch_rows, active, enabled, m.multidfa_pallas_reason
+        )
+
     def _run_device(self, enc, n_lines: int, om, ov):
-        return self.fused.run(
+        out = self.fused.run(
             enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
         )
+        self._note_kernel_dispatch(enc.u8.shape[0])
+        return out
 
     def _run_cube(self, lines_u8, lengths, n_rows: int) -> np.ndarray:
         """Cube-only device program for the line-cache residual batch:
         pre-override match bits for ``n_rows`` independent lines (no
         extraction — that replays on the host from cached + fresh rows
         together, runtime/linecache.py)."""
-        return self.fused.cube_rows(lines_u8, lengths, n_rows)
+        out = self.fused.cube_rows(lines_u8, lengths, n_rows)
+        self._note_kernel_dispatch(lines_u8.shape[0])
+        return out
 
     # ------------------------------------------------------- golden fallback
 

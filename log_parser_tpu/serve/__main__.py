@@ -180,9 +180,20 @@ def main(argv: list[str] | None = None) -> int:
         "/trace/last, 'block' rejects a reload with gating findings as "
         "a structured 409; default warn (LOG_PARSER_TPU_LINT_PATTERNS)",
     )
+    parser.add_argument(
+        "--pallas-dfa", default=None, choices=("on", "off"),
+        help="route the union multi-DFA tier through the Pallas scan "
+        "kernel (ops/matchdfa_pallas.py); bit-identical to the XLA scan, "
+        "falls back per batch on admission or fault; default off "
+        "(LOG_PARSER_TPU_PALLAS_DFA)",
+    )
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
+    if args.pallas_dfa is not None:
+        os.environ["LOG_PARSER_TPU_PALLAS_DFA"] = (
+            "1" if args.pallas_dfa == "on" else "0"
+        )
     for flag, env_key in (
         (args.max_inflight, "LOG_PARSER_TPU_MAX_INFLIGHT"),
         (args.max_queue, "LOG_PARSER_TPU_MAX_QUEUE"),

@@ -279,6 +279,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # routing-tier hit/residual/eviction counters (docs/OPS.md
                 # "Line cache (routing tier)")
                 payload["lineCache"] = line_cache.stats()
+            kernel_stats = getattr(self.server.engine, "kernel_stats", None)
+            if kernel_stats is not None:
+                # Pallas union-DFA kernel tier: admission reason +
+                # per-dispatch routing counters (docs/OPS.md "Kernel tier")
+                payload["kernel"] = kernel_stats.stats()
             mesh = getattr(self.server.engine, "mesh_health", None)
             if mesh is not None:
                 # follower liveness + degrade-to-local counters

@@ -21,6 +21,16 @@ import pytest
 import bench_common
 
 
+@pytest.fixture(autouse=True)
+def isolated_probe_cache(monkeypatch, tmp_path):
+    """Every test gets its own (empty) probe-outcome cache file: a cache
+    entry left by a real bench run on this host must not let
+    probe_backend skip the campaign a test is asserting on."""
+    monkeypatch.setattr(
+        bench_common, "_PROBE_CACHE_PATH", str(tmp_path / "probe_cache.json")
+    )
+
+
 def _run_probe(platform: str | None) -> subprocess.CompletedProcess:
     env = dict(os.environ)
     env.pop("LOG_PARSER_TPU_PLATFORM", None)
@@ -370,3 +380,92 @@ def test_pin_platform_cpu_pins(monkeypatch):
         assert jax.config.jax_platforms == "cpu"
     finally:
         jax.config.update("jax_platforms", before)
+
+
+def _probe_success_env(monkeypatch):
+    """A probe_backend call whose campaign and pin are both stubbed to
+    instant success on "cpu" — the cache tests exercise the control
+    flow, not the subprocess dial."""
+    monkeypatch.setenv("LOG_PARSER_TPU_PLATFORM", "cpu")
+    monkeypatch.setattr(
+        bench_common,
+        "_one_attempt",
+        lambda timeout_s: ("cpu", {"outcome": "ok"}),
+    )
+    monkeypatch.setattr(
+        bench_common, "_pin_and_verify", lambda platform, timeout_s: None
+    )
+    monkeypatch.setattr(bench_common, "_device_platform", lambda: "cpu")
+
+
+def test_probe_cache_hit_skips_campaign(monkeypatch):
+    _probe_success_env(monkeypatch)
+    assert bench_common.probe_backend("m", "u") == "cpu"
+    assert bench_common.last_probe_cached is False
+    assert bench_common.last_backend == "cpu"
+
+    def boom(timeout_s):
+        raise AssertionError("campaign must not re-dial on a cache hit")
+
+    monkeypatch.setattr(bench_common, "_one_attempt", boom)
+    assert bench_common.probe_backend("m", "u") == "cpu"
+    assert bench_common.last_probe_cached is True
+    assert bench_common.last_backend == "cpu"
+
+
+def test_probe_cache_hit_still_verifies_in_process(monkeypatch):
+    """The cache skips only the subprocess campaign — a pin failure on
+    the cached platform invalidates the entry and re-runs the full
+    campaign (the mislabel guard is never skippable)."""
+    _probe_success_env(monkeypatch)
+    assert bench_common.probe_backend("m", "u") == "cpu"
+
+    pins: list[str] = []
+
+    def pin(platform, timeout_s):
+        pins.append(platform)
+        if len(pins) == 1:
+            raise RuntimeError("tunnel died since the cached probe")
+
+    dialed: list[int] = []
+
+    def attempt(timeout_s):
+        dialed.append(1)
+        return "cpu", {"outcome": "ok"}
+
+    monkeypatch.setattr(bench_common, "_pin_and_verify", pin)
+    monkeypatch.setattr(bench_common, "_one_attempt", attempt)
+    assert bench_common.probe_backend("m", "u") == "cpu"
+    assert dialed, "stale cache entry must re-run the campaign"
+    assert bench_common.last_probe_cached is False
+    assert not os.path.exists(bench_common._PROBE_CACHE_PATH) or (
+        bench_common._probe_cache_load("cpu") == "cpu"
+    )
+
+
+def test_probe_cache_ttl_bounds_staleness(monkeypatch):
+    bench_common._probe_cache_store("cpu", "cpu")
+    assert bench_common._probe_cache_load("cpu") == "cpu"
+    assert bench_common._probe_cache_load("auto") is None  # key mismatch
+    monkeypatch.setattr(bench_common, "PROBE_CACHE_TTL_S", 0.0)
+    assert bench_common._probe_cache_load("cpu") is None  # disabled
+    monkeypatch.setattr(bench_common, "PROBE_CACHE_TTL_S", 1e-9)
+    time.sleep(0.01)
+    assert bench_common._probe_cache_load("cpu") is None  # expired
+
+
+def test_emit_stamps_backend(monkeypatch, capsys):
+    import json
+
+    monkeypatch.setattr(bench_common, "last_backend", "cpu")
+    monkeypatch.setattr(bench_common, "last_probe_cached", True)
+    monkeypatch.setattr(bench_common, "last_relay_health", None)
+    monkeypatch.setattr(bench_common, "last_probe_diagnostics", [])
+    bench_common.emit("m", 1.0, "u", None, "cpu")
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["backend"] == "cpu"
+    assert doc["probe_cached"] is True
+
+    monkeypatch.setattr(bench_common, "last_backend", None)
+    bench_common.emit("m", 1.0, "u", None, "cpu")
+    assert "backend" not in json.loads(capsys.readouterr().out)

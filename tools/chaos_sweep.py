@@ -115,8 +115,17 @@ backend supports multi-process collectives — reported SKIP otherwise):
                         ``distributed``), and SIGTERM still shuts both
                         processes down cleanly.
 
+kernel group (--group kernel): the Pallas union-DFA kernel tier behind
+                        --pallas-dfa. One scenario pins the /trace/last
+                        ``kernel`` verdict block (admission reason +
+                        dispatch counters); the other arms a
+                        ``kernel_raise`` fault and proves the whole
+                        batch falls back to the XLA scan tier with
+                        parity preserved — clients never see the fault
+                        and the golden fallbackCount stays zero.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|distributed|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|distributed|all]
                                    [--keep-logs]
 """
 
@@ -707,6 +716,70 @@ LINECACHE_SCENARIOS = [
 ]
 
 
+# ------------------------------------------------------ kernel scenarios
+
+
+def scenario_kernel_tier_engaged(srv: Server):
+    """--pallas-dfa on: the trace surfaces the tier verdict. On hosts
+    where the union tier packs groups the kernel dispatches (or reports
+    a concrete admission reason); everywhere the responses stay
+    correct."""
+    for _ in range(3):
+        status, body, _ = post(srv.url)
+        assert status == 200, status
+        assert body["summary"]["significantEvents"] >= 1, body["summary"]
+    _, trace = get(srv.url, "/trace/last")
+    k = trace["kernel"]
+    assert k["reason"] in (
+        "ok", "no_union_groups", "table_too_large", "no_tile",
+    ), k
+    if k["enabled"] and k["reason"] == "ok":
+        assert k["kernelBatches"] >= 1, k
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+def scenario_kernel_fault_xla_fallback(srv: Server):
+    """An armed kernel fault must never surface to clients or trip the
+    golden fallback: cube() catches it at trace time and the WHOLE batch
+    rides the XLA scan tier — parity preserved, zero fallbackCount."""
+    for _ in range(3):
+        status, body, _ = post(srv.url)
+        assert status == 200, status
+        assert body["summary"]["significantEvents"] >= 1, body["summary"]
+    _, trace = get(srv.url, "/trace/last")
+    k = trace["kernel"]
+    if k["enabled"]:
+        # the fault fired during the first trace: the tier reports it
+        # and every dispatch lands on the XLA side of the counters
+        assert k["reason"] == "fault", k
+        assert k["kernelBatches"] == 0, k
+        assert k["xlaBatches"] >= 1, k
+        fired = trace.get("faults", {}).get("fired", {})
+        assert fired.get("kernel_raise", 0) >= 1, fired
+    else:  # no union groups on this host: the fire site is never reached
+        assert k["reason"] == "no_union_groups", k
+    assert trace["fallbackCount"] == 0, trace["fallbackCount"]
+
+
+KERNEL_SCENARIOS = [
+    (
+        "kernel-tier-engaged",
+        ["--pallas-dfa", "on"],
+        {},
+        scenario_kernel_tier_engaged,
+    ),
+    (
+        "kernel-fault-xla-fallback",
+        ["--pallas-dfa", "on"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "kernel_raise:1.0@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_kernel_fault_xla_fallback,
+    ),
+]
+
+
 # ------------------------------------------------------- state scenarios
 
 
@@ -1056,7 +1129,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--group",
         choices=(
-            "base", "batcher", "state", "poison", "linecache",
+            "base", "batcher", "state", "poison", "linecache", "kernel",
             "distributed", "all",
         ),
         default="base",
@@ -1082,6 +1155,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(POISON_SCENARIOS)
     if args.group in ("linecache", "all"):
         single_server.extend(LINECACHE_SCENARIOS)
+    if args.group in ("kernel", "all"):
+        single_server.extend(KERNEL_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:

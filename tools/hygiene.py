@@ -27,7 +27,10 @@ Checks (all files tracked by git, minus excluded dirs):
      the builtin library, every pattern-lint rule id and regex reason
      code has a row in docs/PATTERNS.md, and every conlint rule id has a
      row in docs/OPS.md (an invariant nobody can look up is an invariant
-     nobody repairs).
+     nobody repairs);
+ 11. every kernel-tier admission reason code (``REASONS`` in
+     ops/matchdfa_pallas.py — the /trace/last ``kernel.reason``
+     vocabulary) has a row in docs/OPS.md.
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -297,6 +300,23 @@ def check_static_analyzers(root: Path) -> list[str]:
     return problems
 
 
+def check_kernel_reasons_documented(root: Path) -> list[str]:
+    """Check 11: the kernel tier's admission reason codes (``REASONS``
+    in ops/matchdfa_pallas.py, surfaced as /trace/last
+    ``kernel.reason``) must each have a docs/OPS.md row — an operator
+    chasing a tier that silently fell back needs the lookup table."""
+    src = root / "log_parser_tpu" / "ops" / "matchdfa_pallas.py"
+    ops_doc = root / "docs" / "OPS.md"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    return [
+        f"{src}: kernel-tier reason {key!r} is not documented in docs/OPS.md"
+        for key in _dict_keys_of(src, "REASONS")
+        if f"`{key}`" not in ops_text
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -321,6 +341,7 @@ def main() -> int:
         problems.extend(check_fault_sites_documented(root))
         problems.extend(check_trace_counters_documented(root))
         problems.extend(check_static_analyzers(root))
+        problems.extend(check_kernel_reasons_documented(root))
 
     for p in problems:
         print(p)

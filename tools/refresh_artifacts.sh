@@ -63,10 +63,12 @@ if [ "$platform" = "tpu" ]; then
 fi
 run "config3_shard_overhead_mesh8_cpu" \
   python bench_mesh.py --devices 8 --lines 200000 --overhead
-# the §9 Pallas kernel verdict (VERDICT r4 #6): session-matched A/B on
-# the chainless bank; delete the kernel if pallas_over_xla >= ~1
+# the Pallas kernel verdicts (PERF.md §9 + §12): session-matched A/B of
+# BOTH kernel tiers (bitglush, union multi-DFA) against their XLA scan
+# baselines; the bitglush kernel gets deleted if its pallas_over_xla
+# comes back >= ~1 (VERDICT r4 #6)
 if [ "$platform" = "tpu" ]; then
-  run "pallas_ab_tpu" python tools/probe_pallas_ab.py
+  run "kernels_ab_tpu" python tools/probe_kernels.py
 fi
 run "config4_2k_${platform}"       python bench_bank.py --patterns 2000 --lines 65536
 run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines 65536
