@@ -15,6 +15,20 @@ headline value is the 16-client batching-ON throughput, vs_baseline the
 16-client OFF throughput, with the full curve in ``sweep``. Defaults to
 small 64-line corpora (where per-request dispatch overhead dominates
 and coalescing pays); ``--lines`` overrides.
+
+``--stream`` switches to the follow-mode time-to-first-detection
+scenario (ISSUE 9 acceptance): each corpus is replayed as a streaming
+session in ``--chunk-lines``-line chunks at a fixed ``--chunk-cadence-ms``
+arrival pace (default 5 ms; 0 = back-to-back compute-only), and TTFD is
+the wall time from replay start to the first ``emit`` frame — measured
+against blob-mode end-to-end latency on same-shaped corpora, where
+end-to-end charges blob mode the full replay window (collect-then-POST
+cannot fire until the tail has finished arriving) plus one-shot
+``analyze()``. The headline value is p50 TTFD, vs_baseline the blob-mode
+p50; the full percentiles, the TTFD/blob ratio, and the session counter
+block ride in the artifact. Combine with
+``--repeat-ratio``/``--line-cache-mb`` for the repeat-heavy tail-follow
+shape the streaming layer is built for.
 """
 
 from __future__ import annotations
@@ -66,6 +80,18 @@ LINE_CACHE_MB = (
     float(sys.argv[sys.argv.index("--line-cache-mb") + 1])
     if "--line-cache-mb" in sys.argv
     else 0.0
+)
+# --stream: follow-mode TTFD scenario (runtime/stream.py sessions)
+STREAM = "--stream" in sys.argv
+CHUNK_LINES = (
+    int(sys.argv[sys.argv.index("--chunk-lines") + 1])
+    if "--chunk-lines" in sys.argv
+    else 16
+)
+CHUNK_CADENCE_MS = (
+    float(sys.argv[sys.argv.index("--chunk-cadence-ms") + 1])
+    if "--chunk-cadence-ms" in sys.argv
+    else 5.0
 )
 
 
@@ -255,9 +281,150 @@ def sweep_main() -> None:
     )
 
 
+def stream_corpus(i: int) -> list[str]:
+    rows = micro_batch(i, BATCH_LINES).split("\n")
+    if REPEAT_RATIO is not None:
+        # the repeat-template pool is all noise by construction, so a
+        # --repeat-ratio corpus would never produce a detection and TTFD
+        # would be undefined — overlay the plain path's detection cycle
+        # (same ~2% density) on top of the repeat-heavy traffic
+        for j in range(len(rows)):
+            m = (i * 131 + j) % 97
+            if m == 11:
+                rows[j] = "java.lang.OutOfMemoryError: Java heap space"
+            elif m == 13:
+                rows[j] = "dial tcp 10.0.0.7:5432: Connection refused"
+    return rows
+
+
+def stream_main() -> None:
+    metric = (
+        f"stream_ttfd_p50_ms_{BATCH_LINES}line_chunk{CHUNK_LINES}"
+        + metric_suffix()
+    )
+    platform = bench_common.probe_backend(metric, "ms")
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+    from log_parser_tpu.runtime.stream import StreamManager
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    if LINE_CACHE_MB > 0:
+        engine.enable_line_cache(LINE_CACHE_MB)
+    mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+
+    def chunks_of(rows: list[str]) -> list[bytes]:
+        return [
+            ("\n".join(rows[k : k + CHUNK_LINES]) + "\n").encode()
+            for k in range(0, len(rows), CHUNK_LINES)
+        ]
+
+    n_chunks = (BATCH_LINES + CHUNK_LINES - 1) // CHUNK_LINES
+
+    def run_blob(i: int) -> None:
+        # blob mode can only fire once the whole tail has arrived: charge
+        # the full replay window (every chunk at the fixed cadence) before
+        # the one-shot analyze — that wait IS blob-mode end-to-end latency
+        # under the same arrival process the sessions see
+        if CHUNK_CADENCE_MS > 0:
+            time.sleep(n_chunks * CHUNK_CADENCE_MS / 1e3)
+        engine.analyze(
+            PodFailureData(
+                pod={"metadata": {"name": "stream"}},
+                logs="\n".join(stream_corpus(i)),
+            )
+        )
+
+    def run_stream(i: int) -> float | None:
+        """Replay corpus ``i`` as a follow-mode session at the fixed chunk
+        cadence; TTFD is first-byte-fed to first ``emit`` frame. Once the
+        first detection is out the tail is moot for this metric, so the
+        session closes (untimed) instead of draining the remaining
+        chunks."""
+        sess = mgr.open()
+        ttfd_ms = None
+        try:
+            t0 = time.perf_counter()
+            for chunk in chunks_of(stream_corpus(i)):
+                if CHUNK_CADENCE_MS > 0:
+                    time.sleep(CHUNK_CADENCE_MS / 1e3)
+                frames = sess.feed(chunk)
+                assert not any(f["type"] == "error" for f in frames), frames
+                if any(f["type"] == "emit" for f in frames):
+                    ttfd_ms = (time.perf_counter() - t0) * 1e3
+                    break
+        finally:
+            sess.close()
+        return ttfd_ms
+
+    bounded = bench_common.bounded_runner(metric, "ms", platform)
+
+    def warmup() -> None:
+        # compile both shape families before timing: the blob-mode
+        # full-corpus batch and the chunk-sized residual batches the
+        # session feed path realizes
+        for i in range(3):
+            run_blob(i)
+            run_stream(REQUESTS + i)
+
+    bounded(warmup, bench_common.PROBE_TIMEOUT_S, "warmup")
+
+    blob_ms: list[float] = []
+    ttfd_ms: list[float] = []
+    misses = 0
+    budget_s = max(bench_common.DRAIN_FLOOR_S, 10.0 * REQUESTS)
+
+    def timed_blob() -> None:
+        for i in range(3, REQUESTS + 3):
+            t0 = time.perf_counter()
+            run_blob(i)
+            blob_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def timed_stream() -> None:
+        nonlocal misses
+        # offset index range: same line population and repeat-template
+        # pool as the blob phase, but no request is byte-identical to one
+        # the cache just served whole
+        for i in range(REQUESTS + 3, 2 * REQUESTS + 3):
+            t = run_stream(i)
+            if t is None:
+                misses += 1
+            else:
+                ttfd_ms.append(t)
+
+    bounded(timed_blob, budget_s, "blob-mode baseline")
+    bounded(timed_stream, budget_s, "stream ttfd")
+    blob_ms.sort()
+    ttfd_ms.sort()
+    assert ttfd_ms, "no streaming session ever produced an emit frame"
+
+    p50_ttfd = round(percentile(ttfd_ms, 0.50), 3)
+    p50_blob = round(percentile(blob_ms, 0.50), 3)
+    extra: dict[str, object] = {
+        "n_requests": REQUESTS,
+        "chunk_lines": CHUNK_LINES,
+        "chunk_cadence_ms": CHUNK_CADENCE_MS,
+        "ttfd_ms": {"p50": p50_ttfd, "p99": round(percentile(ttfd_ms, 0.99), 3)},
+        "blob_ms": {"p50": p50_blob, "p99": round(percentile(blob_ms, 0.99), 3)},
+        "ttfd_over_blob_p50": round(p50_ttfd / p50_blob, 4),
+        "ttfd_misses": misses,
+        "stream": mgr.stats(),
+    }
+    if REPEAT_RATIO is not None:
+        extra["repeat_ratio"] = REPEAT_RATIO
+    if engine.line_cache is not None:
+        extra["line_cache_mb"] = LINE_CACHE_MB
+        extra["line_cache"] = engine.line_cache.stats()
+    bench_common.emit(metric, p50_ttfd, "ms", p50_blob, platform, **extra)
+
+
 def main() -> None:
     if SWEEP:
         return sweep_main()
+    if STREAM:
+        return stream_main()
     suffix = "_http" if USE_HTTP else ""
     if CONCURRENCY > 1:
         suffix += f"_c{CONCURRENCY}"

@@ -15,6 +15,8 @@ against the Python implementation in tests/test_native.py.
 
 from __future__ import annotations
 
+import codecs
+
 import numpy as np
 
 from log_parser_tpu.golden.javacompat import java_split_lines
@@ -38,6 +40,38 @@ def normalize_blob(logs: str | None) -> bytes:
     match cube saw regardless of transport (HTTP / framed shim / gRPC all
     deliver the same ``str``)."""
     return (logs or "").encode("utf-8", errors="replace")
+
+
+class StreamNormalizer:
+    """Chunk-boundary-safe ingest normalization: the streaming analogue of
+    :func:`normalize_blob` for byte tails that arrive in arbitrary splits.
+
+    A multi-byte UTF-8 sequence split across two chunks must decode to the
+    same characters as the joined blob — a naive per-chunk
+    ``chunk.decode("utf-8", errors="replace")`` would replace the dangling
+    prefix AND the orphaned continuation bytes, diverging from the blob
+    path. ``codecs`` incremental decoding holds the incomplete tail
+    sequence in the decoder and is split-invariant for ``errors="replace"``
+    (pinned by tests/test_stream.py); only ``flush()`` at end-of-stream
+    resolves a truncated trailing sequence, with the same replacement the
+    blob path produces for it.
+    """
+
+    def __init__(self) -> None:
+        self._decoder = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def feed(self, data: bytes) -> str:
+        """Decode a chunk, carrying any incomplete trailing UTF-8 sequence
+        into the next call. Returns the newly-completed text (may be
+        empty while a sequence straddles the boundary)."""
+        return self._decoder.decode(data, False)
+
+    def flush(self) -> str:
+        """End-of-stream: resolve a held incomplete sequence (truncated
+        trailing multi-byte → U+FFFD, same as the blob path) and reset."""
+        out = self._decoder.decode(b"", True)
+        self._decoder.reset()
+        return out
 
 
 class Corpus:

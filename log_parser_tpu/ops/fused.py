@@ -422,6 +422,17 @@ class FusedMatchScore:
                 return recs
         raise AssertionError("unreachable: K ladder capped at B*P")
 
+    def host_carry(self):
+        """Carried-scan-state entry point for streaming ingestion: a
+        :class:`~log_parser_tpu.ops.match.CubeHostCarry` whose ``feed``/
+        ``snapshot_bits`` advance this program's matcher tiers over one
+        growing line and return the cube row the device would produce —
+        union-DFA states, dense-DFA states, and Shift-Or bit registers
+        all resume across chunk boundaries instead of rescanning.  None
+        when a populated tier is not host-resumable (bitglush /
+        prefilter); callers then rescan the buffered tail per frame."""
+        return self.matchers.host_carry()
+
     def cube_rows(
         self,
         lines_u8: np.ndarray,

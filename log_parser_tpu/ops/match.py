@@ -1197,3 +1197,148 @@ class MatcherBanks:
             return cube
 
         return jax.lax.cond(n_rep <= K, sparse, dense, cube)
+
+    # ------------------------------------------------------------ host carry
+
+    def host_carry(self) -> "CubeHostCarry | None":
+        """Resumable host scanner over one growing line, bit-exact with
+        :meth:`cube` for device-eligible bytes (streaming follow-mode
+        carries it across chunk boundaries instead of rescanning the
+        partial tail line per chunk). None when a populated tier has no
+        exact host-resumable form — the bit-parallel bitglush chain and
+        the AC-prefilter verify stage are pair-scheduled device programs
+        whose per-pair state is not byte-resumable; sessions then rescan
+        the buffered tail from scratch per frame (exactness of the FINAL
+        frame never depends on the carry either way)."""
+        if self.bitglush is not None or self.prefilter is not None:
+            return None
+        if self.shiftor is not None and self.shiftor.host_carry() is None:
+            return None
+        return CubeHostCarry(self)
+
+
+class DfaHostCarry:
+    """Carried per-regex dense-DFA states for one growing line (host).
+
+    Walks the SAME transition/byte-class/accept tables the device bank
+    gathers from (numpy copies, materialized once). The pair-stride
+    device path precomposes two single steps through an identity padding
+    class, so a byte-at-a-time walk over the true bytes reaches the
+    identical final state — padding never moves a dense DFA."""
+
+    def __init__(self, bank: DfaBank):
+        r = max(1, bank.n_regexes)
+        self.n_regexes = bank.n_regexes
+        self._trans = np.asarray(bank.flat_trans).reshape(r, bank.smax, bank.cmax)
+        self._accept = np.asarray(bank.flat_accept).reshape(r, bank.smax)
+        self._bc = np.asarray(bank.byte_class)
+        self._start = np.asarray(bank.start)
+        self._r_idx = np.arange(r)
+        self.reset()
+
+    def reset(self) -> None:
+        self._s = self._start.copy()
+
+    def feed(self, data: bytes) -> None:
+        if not self.n_regexes:
+            return
+        trans, bc, r_idx = self._trans, self._bc, self._r_idx
+        s = self._s
+        for b in data:
+            if b == 0:  # padding-only byte: identity (encode bars content NULs)
+                continue
+            s = trans[r_idx, s, bc[:, b]]
+        self._s = s
+
+    def snapshot_bits(self) -> np.ndarray:
+        """bool [n_regexes]: accept-at-end per regex, as of the bytes fed."""
+        return self._accept[self._r_idx, self._s][: self.n_regexes]
+
+
+class MultiDfaHostCarry:
+    """Carried union multi-DFA state + exact hit words for one growing
+    line (host) — the single-row analogue of the group's ``word_stepper``
+    (state, out-word accumulation, accept-at-end OR in snapshot)."""
+
+    def __init__(self, group: MultiDfaBank):
+        self.group = group
+        self._packed = group._packed_byte_np
+        self._byte_rw = np.asarray(group.byte_rw)
+        self._out2 = np.asarray(group.out2)
+        self._accept_words = np.asarray(group.accept_words)
+        self.reset()
+
+    def reset(self) -> None:
+        self._s = self.group.start
+        self._h = np.zeros(self.group.n_words, dtype=np.uint32)
+
+    def feed(self, data: bytes) -> None:
+        s, h = self._s, self._h
+        packed, byte_rw, out2 = self._packed, self._byte_rw, self._out2
+        for b in data:
+            if b == 0:  # padding byte: word_stepper gates it off
+                continue
+            h = h | out2[s * 2 + int(byte_rw[b])]
+            s = int(packed[s * 256 + b]) & MultiDfaBank._STATE_MASK
+        self._s, self._h = s, h
+
+    def snapshot_bits(self) -> np.ndarray:
+        """bool [n_cols] for this group's columns, in ``group.cols`` order."""
+        hw = self._h | self._accept_words[self._s]
+        cols = np.arange(self.group.n_cols)
+        return ((hw[cols // 32] >> (cols % 32).astype(np.uint32)) & 1).astype(bool)
+
+
+class CubeHostCarry:
+    """Carried scan state for every host-resumable tier of one
+    MatcherBanks, over ONE growing line.
+
+    ``feed`` advances the Shift-Or registers, the dense-DFA state
+    vector, and each union group's (state, hit-words) carry by the new
+    bytes only; ``snapshot_bits`` materializes the cube row the device
+    would produce for the line as fed so far — pinned bit-identical to
+    ``MatcherBanks.cube`` by tests/test_stream.py. Host-only columns
+    stay False (the engine overrides them, same as the device cube)."""
+
+    def __init__(self, matchers):
+        self.matchers = matchers
+        self.n_columns = matchers.bank.n_columns
+        self._shiftor = (
+            matchers.shiftor.host_carry() if matchers.shiftor is not None else None
+        )
+        self._dfa = DfaHostCarry(matchers.dfa_bank) if matchers.dfa_cols else None
+        self._multi = [MultiDfaHostCarry(g) for g in matchers.multi_groups]
+        self.n_bytes = 0
+
+    def reset(self) -> None:
+        if self._shiftor is not None:
+            self._shiftor.reset()
+        if self._dfa is not None:
+            self._dfa.reset()
+        for m in self._multi:
+            m.reset()
+        self.n_bytes = 0
+
+    def feed(self, data: bytes) -> None:
+        if not data:
+            return
+        self.n_bytes += len(data)
+        if self._shiftor is not None:
+            self._shiftor.feed(data)
+        if self._dfa is not None:
+            self._dfa.feed(data)
+        for m in self._multi:
+            m.feed(data)
+
+    def snapshot_bits(self) -> np.ndarray:
+        out = np.zeros(self.n_columns, dtype=bool)
+        m = self.matchers
+        if self._shiftor is not None:
+            out[np.asarray(m.shiftor_cols, dtype=np.int64)] = (
+                self._shiftor.snapshot_bits()[: len(m.shiftor_cols)]
+            )
+        if self._dfa is not None:
+            out[np.asarray(m.dfa_cols, dtype=np.int64)] = self._dfa.snapshot_bits()
+        for g, mc in zip(m.multi_groups, self._multi):
+            out[np.asarray(g.cols, dtype=np.int64)] = mc.snapshot_bits()
+        return out

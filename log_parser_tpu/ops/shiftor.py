@@ -435,3 +435,116 @@ class ShiftOrBank:
             (pairs, ts),
         )
         return finish(carry)
+
+    # ----------------------------------------------------------- host carry
+
+    def host_carry(self) -> "ShiftOrHostCarry | None":
+        """Resumable host-side scanner over ONE line's bytes, bit-exact
+        with the device steppers (streaming follow-mode feeds a partial
+        tail line chunk by chunk and snapshots the would-be cube row
+        without rescanning from byte 0). None for banks whose padding
+        byte is not provably transparent — those would need per-position
+        gating that a byte-resumable carry cannot replay."""
+        if not self.pad0_transparent:
+            return None
+        return ShiftOrHostCarry(self)
+
+
+class ShiftOrHostCarry:
+    """Carried Shift-Or registers for one growing line (host, numpy).
+
+    Sink layout advances at byte-PAIR granularity — the sticky-sink
+    persistence term ``cand & (d | not_sink)`` is defined per pair, so a
+    byte-level replay could kill a candidate the device keeps. An odd
+    trailing byte is held in the carry and replayed as the device's
+    (byte, 0) pair inside :meth:`snapshot_bits`, which also applies the
+    same virtual padding pair as the device ``finish`` (extra padding
+    pairs are no-ops on a ``pad0_transparent`` bank, so padded width
+    does not matter — the width-independence the line cache relies on).
+    Bare layout accumulates complement-hits per byte exactly like
+    ``_ungated_hits_stepper``."""
+
+    def __init__(self, bank: ShiftOrBank):
+        self.bank = bank
+        c = bank._np
+        self._mask = c["mask"]
+        self._sc = c["start_clear"]
+        self._em = c["end_mask"]
+        self._cm = c["cont_mask"]
+        if bank.sinks:
+            self._c2 = np.asarray(bank.c2)
+            self._not_sink = np.asarray(bank.not_sink)
+            self._pad_m12 = np.asarray(bank.pad_m12)
+        self.reset()
+
+    def _s1(self, x: np.ndarray) -> np.ndarray:
+        sh = (x << 1).astype(np.uint32)
+        if self.bank.has_chains:
+            carry = np.concatenate([np.zeros(1, np.uint32), x[:-1] >> 31])
+            sh = sh | (carry & self._cm)
+        return sh
+
+    def _s2(self, x: np.ndarray) -> np.ndarray:
+        sh = (x << 2).astype(np.uint32)
+        if self.bank.has_chains:
+            carry = np.concatenate([np.zeros(1, np.uint32), x[:-1] >> 30])
+            sh = sh | (carry & (self._cm * np.uint32(3)))
+        return sh
+
+    def reset(self) -> None:
+        W = self.bank.n_words
+        self._d = np.full(W, 0xFFFFFFFF, dtype=np.uint32)
+        if not self.bank.sinks:
+            self._nh = np.full(W, 0xFFFFFFFF, dtype=np.uint32)
+        self._odd: int | None = None
+
+    def _pair(self, d: np.ndarray, b1: int, b2: int) -> np.ndarray:
+        m1 = self._mask[b1]
+        m2 = self._mask[b2]
+        m12 = (self._s1(m1) & self._sc) | m2
+        cand = (self._s2(d) & self._c2) | m12
+        return cand & (d | self._not_sink)
+
+    def feed(self, data: bytes) -> None:
+        if not data:
+            return
+        if self.bank.sinks:
+            buf = data
+            if self._odd is not None:
+                buf = bytes([self._odd]) + buf
+                self._odd = None
+            if len(buf) % 2:
+                self._odd = buf[-1]
+                buf = buf[:-1]
+            d = self._d
+            for i in range(0, len(buf), 2):
+                d = self._pair(d, buf[i], buf[i + 1])
+            self._d = d
+        else:
+            d, nh = self._d, self._nh
+            not_e = ~self._em
+            for b in data:
+                d = (self._s1(d) & self._sc) | self._mask[b]
+                nh = nh & (d | not_e)
+            self._d, self._nh = d, nh
+
+    def snapshot_bits(self) -> np.ndarray:
+        """bool [n_bank_columns]: the cube row the device would produce
+        if the line ended at the bytes fed so far. Non-destructive."""
+        bank = self.bank
+        if bank.sinks:
+            d = self._d
+            if self._odd is not None:
+                d = self._pair(d, self._odd, 0)
+            # the virtual padding pair the device finish applies
+            cand = (self._s2(d) & self._c2) | self._pad_m12
+            d = cand & (d | self._not_sink)
+            alive = (d[bank.snk_word] >> bank.snk_bit.astype(np.uint32)) & 1
+            out = np.zeros(max(1, len(bank.columns)), dtype=bool)
+            np.maximum.at(out, bank.snk_slot, alive == 0)
+            return out
+        hits = ~self._nh & self._em
+        seq_hit = (hits[bank.seq_word] >> bank.seq_bit.astype(np.uint32)) & 1
+        out = np.zeros(max(1, len(bank.columns)), dtype=bool)
+        np.maximum.at(out, bank.seq_slot, seq_hit.astype(bool))
+        return out

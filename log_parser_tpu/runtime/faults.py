@@ -32,7 +32,12 @@ Each spec is ``<site>_<action>[:<arg>][@mod=value]*``:
   ``snapshot`` (background snapshot write fails — the WAL is NOT
   truncated, nothing is lost), ``reload_build`` / ``reload_canary``
   (candidate library build / canary validation fails during a hot
-  reload — structured 409, the old banks keep serving). Any string
+  reload — structured 409, the old banks keep serving),
+  ``stream`` (per streaming chunk, keyed by the chunk's decoded text —
+  a raise kills ONE session with a structured ``error`` frame, never
+  the server; runtime/stream.py), ``stream_close`` (the streaming
+  finish sequence — a raise rolls back the session's frequency commit
+  before the error frame goes out). Any string
   works; sites are just names the code fires, see :func:`fire` call
   sites;
 - action: ``raise`` (raise :class:`InjectedFault`; at the ``device`` site
@@ -57,7 +62,9 @@ with the request's log content as the key) raises
 :class:`InjectedPoisonFault` — a *device-classified* fault that, unlike
 every other injected fault, also accrues a quarantine strike: it stands
 in for an organic poison pill, so the quarantine/bisection machinery
-must react to it exactly as to the real thing.
+must react to it exactly as to the real thing. Streaming sessions fire
+the same site per *chunk* with the chunk's decoded text as the key, so
+a ``match=`` spec kills exactly the session that ingests the marker.
 
 Seed: ``LOG_PARSER_TPU_FAULT_SEED`` (default 0). Probabilistic specs draw
 from one ``random.Random(seed)`` in evaluation order, so a single-threaded

@@ -122,6 +122,21 @@ def main(argv: list[str] | None = None) -> int:
         "residual batch (runtime/linecache.py; single-device engine "
         "only; 0 disables; default 64; LOG_PARSER_TPU_LINE_CACHE_MB)",
     )
+    # streaming follow-mode (docs/OPS.md "Streaming follow-mode")
+    parser.add_argument(
+        "--stream-emit-threshold", type=float, default=None, metavar="SCORE",
+        help="minimum provisional score before a streaming session emits "
+        "an event frame early (monotone-refinement contract: emitted "
+        "scores may firm up, retractions are explicit 'revised' frames; "
+        "default 0 emits everything; "
+        "LOG_PARSER_TPU_STREAM_EMIT_THRESHOLD)",
+    )
+    parser.add_argument(
+        "--stream-ttl-s", type=float, default=None, metavar="SECONDS",
+        help="idle streaming sessions are reaped (and their admission "
+        "slot released) after this long without a chunk; 0 disables "
+        "the reaper (default 300; LOG_PARSER_TPU_STREAM_TTL_S)",
+    )
     # poison-request quarantine + online shadow verification
     # (docs/OPS.md "Poison-request triage" / "Shadow divergence")
     parser.add_argument(
@@ -203,6 +218,8 @@ def main(argv: list[str] | None = None) -> int:
         (args.batch_wait_ms, "LOG_PARSER_TPU_BATCH_WAIT_MS"),
         (args.batch_max, "LOG_PARSER_TPU_BATCH_MAX"),
         (args.line_cache_mb, "LOG_PARSER_TPU_LINE_CACHE_MB"),
+        (args.stream_emit_threshold, "LOG_PARSER_TPU_STREAM_EMIT_THRESHOLD"),
+        (args.stream_ttl_s, "LOG_PARSER_TPU_STREAM_TTL_S"),
         (args.quarantine_strikes, "LOG_PARSER_TPU_QUARANTINE_STRIKES"),
         (args.quarantine_ttl_s, "LOG_PARSER_TPU_QUARANTINE_TTL_S"),
         (args.shadow_rate, "LOG_PARSER_TPU_SHADOW_RATE"),
@@ -382,6 +399,23 @@ def main(argv: list[str] | None = None) -> int:
     # sequence below runs — including the follower sentinel in distributed
     # mode, which therefore always lands AFTER the drain, never
     # mid-broadcast (the analyze lock covers the straggler case).
+    # streaming follow-mode sessions: same single-device gate as
+    # --batching / --line-cache-mb (the session residual program is the
+    # full-bank cube). The manager is created eagerly so the TTL reaper
+    # runs from boot, not from the first streaming request.
+    if args.coordinator or args.sharded:
+        server.stream_enabled = False
+        log.warning(
+            "streaming sessions are only supported on the single-device "
+            "engine; POST /parse/stream disabled"
+        )
+    else:
+        mgr = server.get_stream_manager()
+        log.info(
+            "Streaming on: emit threshold %.3g, session TTL %.0fs",
+            mgr.emit_threshold,
+            mgr.ttl_s,
+        )
     install_drain_handlers(
         server,
         server.admission,
@@ -418,6 +452,10 @@ def main(argv: list[str] | None = None) -> int:
         server.server_close()
         if server.watcher is not None:
             server.watcher.stop()
+        if server.stream_manager is not None:
+            # kill open sessions so their admission slots release before
+            # the gate's drain accounting is torn down
+            server.stream_manager.shutdown()
         if engine.batcher is not None:
             # flush anything still queued before the process exits
             engine.batcher.close()

@@ -81,6 +81,14 @@ class ParseServer(ThreadingHTTPServer):
         # the optional --watch-patterns poller, stopped with the server
         self.reloader = None
         self.watcher = None
+        # streaming follow-mode sessions (runtime/stream.py): lazily
+        # created on the first POST /parse/stream; serve/__main__.py
+        # flips stream_enabled off for sharded/distributed engines (the
+        # session layer's residual program is the single-device cube,
+        # same gate as --batching / --line-cache-mb)
+        self.stream_manager = None
+        self.stream_enabled = True
+        self._stream_lock = threading.Lock()
 
     def get_reloader(self):
         from log_parser_tpu.runtime.reload import PatternReloader
@@ -88,6 +96,19 @@ class ParseServer(ThreadingHTTPServer):
         if self.reloader is None:
             self.reloader = PatternReloader(self.engine)
         return self.reloader
+
+    def get_stream_manager(self):
+        if not self.stream_enabled:
+            return None
+        with self._stream_lock:
+            if self.stream_manager is None:
+                # ONE manager per engine across transports: a gRPC
+                # StreamParse session and an HTTP one share the registry,
+                # the admission budget, and the /trace/last counters
+                from log_parser_tpu.runtime.stream import shared_manager
+
+                self.stream_manager = shared_manager(self.engine)
+            return self.stream_manager
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -128,6 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if self.path == "/parse":
             return self._parse()
+        if self.path == "/parse/stream":
+            return self._parse_stream()
         if self.path == "/patterns/reload":
             return self._patterns_reload()
         if self.path == "/frequency/restore":
@@ -293,6 +316,11 @@ class _Handler(BaseHTTPRequestHandler):
             if journal is not None:
                 # WAL/snapshot counters (docs/OPS.md "State durability")
                 payload["journal"] = journal.stats()
+            stream_mgr = self.server.stream_manager
+            if stream_mgr is not None:
+                # follow-mode session counters (docs/OPS.md "Streaming
+                # follow-mode")
+                payload["stream"] = stream_mgr.stats()
             # poison-request ledger (docs/OPS.md "Poison-request triage")
             payload["quarantine"] = self.server.engine.quarantine.stats()
             shadow = getattr(self.server.engine, "shadow", None)
@@ -320,6 +348,105 @@ class _Handler(BaseHTTPRequestHandler):
             rows = [] if fin is None else fin.factor_rows(self.server.engine.bank)
             return self._send_json(200, json.dumps(rows).encode())
         self._send_json(404, b'{"error":"not found"}')
+
+    def _parse_stream(self) -> None:
+        """``POST /parse/stream``: chunked follow-mode ingestion. Each HTTP
+        request chunk (``Transfer-Encoding: chunked``, hand-decoded — the
+        stdlib handler never decodes request bodies) is one session chunk;
+        the response is NDJSON frames (``emit`` / ``revised`` / ``final`` /
+        ``error``, runtime/stream.py FRAME_TYPES) written full-duplex as
+        chunks arrive, so time-to-first-detection is one chunk deep, not
+        one blob deep. The zero-size chunk closes the session; the final
+        frame's result is bit-identical to one-shot ``POST /parse`` on the
+        concatenated body. A fixed-length body is treated as a single
+        chunk + close."""
+        try:
+            faults.fire("http")
+        except Exception:
+            log.exception("injected HTTP-transport fault")
+            return self._send_json(500, b'{"error":"Internal analysis failure"}')
+        mgr = self.server.get_stream_manager()
+        if mgr is None:
+            return self._send_json(
+                501, b'{"error":"streaming is not supported on this engine"}'
+            )
+        deadline_ms = None
+        header = self.headers.get("X-Request-Deadline-Ms")
+        if header is not None:
+            try:
+                deadline_ms = float(header)
+            except ValueError:
+                return self._send_json(
+                    400, b'{"error":"invalid X-Request-Deadline-Ms"}'
+                )
+        try:
+            sess = mgr.open(deadline_ms)
+        except AdmissionRejected as exc:
+            return self._send_json(
+                exc.status,
+                json.dumps({"error": "overloaded", "reason": exc.reason}).encode(),
+                headers={"Retry-After": str(exc.retry_after_s)},
+            )
+
+        def _write(frames: list[dict]) -> None:
+            for frame in frames:
+                self.wfile.write(json.dumps(frame).encode() + b"\n")
+            self.wfile.flush()
+
+        chunked = "chunked" in (
+            self.headers.get("Transfer-Encoding") or ""
+        ).lower()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            if chunked:
+                while not sess.closed:
+                    size_line = self.rfile.readline(130)
+                    try:
+                        size = int(size_line.split(b";")[0].strip() or b"x", 16)
+                    except ValueError:
+                        # garbage framing: a structured error frame, never
+                        # a wedged session or a half-open connection
+                        _write(
+                            [
+                                {
+                                    "type": "error",
+                                    "session": sess.session_id,
+                                    "reason": "bad-frame",
+                                    "message": "malformed chunk size line",
+                                }
+                            ]
+                        )
+                        sess.kill("bad-frame")
+                        break
+                    if size == 0:
+                        while self.rfile.readline(130).strip():
+                            pass  # discard trailers
+                        _write(sess.close())
+                        break
+                    data = self.rfile.read(size)
+                    self.rfile.read(2)  # chunk CRLF
+                    _write(sess.feed(data))
+            else:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                _write(sess.feed(body))
+                if not sess.closed:
+                    _write(sess.close())
+        except (BrokenPipeError, ConnectionResetError) as exc:
+            with self.server._drop_lock:
+                self.server.dropped_responses += 1
+            log.debug(
+                "stream client %s disconnected: %s", self.address_string(), exc
+            )
+        except Exception:
+            log.exception("stream session %s failed", sess.session_id)
+        finally:
+            if not sess.closed:
+                sess.kill("transport")
+            self.close_connection = True
 
     def _parse(self) -> None:
         try:

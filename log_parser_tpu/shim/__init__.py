@@ -5,7 +5,11 @@
 """
 
 from log_parser_tpu.shim.client import ShimClient
-from log_parser_tpu.shim.grpc_server import HAVE_GRPC, make_grpc_server
+from log_parser_tpu.shim.grpc_server import (
+    HAVE_GRPC,
+    make_grpc_server,
+    make_stream_stub,
+)
 from log_parser_tpu.shim.server import ShimServer, make_shim_server
 from log_parser_tpu.shim.service import LogParserService
 
@@ -16,4 +20,5 @@ __all__ = [
     "ShimServer",
     "make_grpc_server",
     "make_shim_server",
+    "make_stream_stub",
 ]

@@ -15,11 +15,14 @@ so environments without grpcio still get the framed transport.
 
 from __future__ import annotations
 
+import json
+
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
 SERVICE_NAME = "logparser.LogParser"
+STREAM_SERVICE_NAME = "logparser.LogParserStream"
 
 try:  # gate: grpcio is present in this image but is not a hard dependency
     import grpc
@@ -70,19 +73,73 @@ def _handlers(service: LogParserService):
     }
 
 
+def _stream_handlers(engine):
+    """The ``LogParserStream.StreamParse`` bidi handler: byte chunks in,
+    JSON frames out — the gRPC twin of ``POST /parse/stream``. Both
+    transports resolve :func:`~log_parser_tpu.runtime.stream.shared_manager`,
+    so their sessions share one admission budget, TTL reaper, and
+    ``/trace/last`` counter block."""
+    from log_parser_tpu.shim import logparser_stream_pb2 as spb
+
+    def stream_parse(request_iterator, context):
+        from log_parser_tpu.runtime.stream import shared_manager
+
+        mgr = shared_manager(engine)
+        try:
+            sess = mgr.open()
+        except AdmissionRejected as exc:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE
+                if exc.reason == "draining"
+                else grpc.StatusCode.RESOURCE_EXHAUSTED,
+                str(exc),
+            )
+        try:
+            for chunk in request_iterator:
+                if chunk.data:
+                    for frame in sess.feed(bytes(chunk.data)):
+                        yield spb.StreamFrame(json=json.dumps(frame))
+                if sess.closed:
+                    # the session died on a fault/poison error frame: the
+                    # frame already went out, end the RPC cleanly
+                    return
+                if chunk.close:
+                    break
+            # explicit close chunk or client half-close: either way the
+            # final frames (and any tail-line scoring) flush here
+            for frame in sess.close():
+                yield spb.StreamFrame(json=json.dumps(frame))
+        finally:
+            if not sess.closed:
+                # client vanished mid-stream (cancel / network drop)
+                sess.kill("disconnect")
+
+    return {
+        "StreamParse": grpc.stream_stream_rpc_method_handler(
+            stream_parse,
+            request_deserializer=spb.StreamChunk.FromString,
+            response_serializer=spb.StreamFrame.SerializeToString,
+        )
+    }
+
+
 def make_grpc_server(
     engine,
     host: str = "127.0.0.1",
     port: int = 9095,
     max_workers: int = 8,
     service: LogParserService | None = None,
+    stream: bool = True,
 ):
     """Build (server, bound_port). Raises RuntimeError without grpcio.
 
     Pass ``service`` to share one :class:`LogParserService` (and therefore
     ONE engine lock) with another transport — required when the framed shim
     serves the same engine, or the two transports would race on frequency
-    state through separate locks."""
+    state through separate locks. ``stream=False`` leaves the
+    ``LogParserStream`` service unregistered (UNIMPLEMENTED to callers) —
+    for sharded/distributed engines, whose session layer is gated off the
+    same way ``serve`` gates ``POST /parse/stream``."""
     if not HAVE_GRPC:
         raise RuntimeError(
             "grpcio is not installed; use the framed transport "
@@ -93,9 +150,14 @@ def make_grpc_server(
     if service is None:
         service = LogParserService(engine)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(SERVICE_NAME, _handlers(service)),)
-    )
+    handlers = [grpc.method_handlers_generic_handler(SERVICE_NAME, _handlers(service))]
+    if stream:
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                STREAM_SERVICE_NAME, _stream_handlers(engine)
+            )
+        )
+    server.add_generic_rpc_handlers(tuple(handlers))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise RuntimeError(f"could not bind gRPC server to {host}:{port}")
@@ -113,3 +175,15 @@ def make_channel_stubs(channel):
         )
         for name, req_t, resp_t, _attr in RPCS
     }
+
+
+def make_stream_stub(channel):
+    """Client-side ``StreamParse`` callable: pass an iterator of
+    StreamChunk, iterate StreamFrame back."""
+    from log_parser_tpu.shim import logparser_stream_pb2 as spb
+
+    return channel.stream_stream(
+        f"/{STREAM_SERVICE_NAME}/StreamParse",
+        request_serializer=spb.StreamChunk.SerializeToString,
+        response_deserializer=spb.StreamFrame.FromString,
+    )

@@ -1,0 +1,69 @@
+"""Message classes for ``proto/logparser_stream.proto`` — built by hand.
+
+``logparser_pb2.py`` ships as protoc output (a serialized-descriptor
+blob), but this image has no ``grpc_tools``/``protoc`` to regenerate it,
+so the streaming messages register their :class:`FileDescriptorProto`
+programmatically in the same default descriptor pool. The resulting
+classes are wire-identical to what protoc would generate from the
+``.proto`` (same package, field numbers, and types) — a JVM client
+generates its stubs from ``proto/logparser_stream.proto`` with protoc as
+usual and the bytes interoperate.
+
+Two messages only; the frame payload stays JSON (the exact NDJSON frame
+dicts of runtime/stream.py) so the schema evolves with FRAME_TYPES
+without a protoc round-trip on either side.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_FILE = "logparser_stream.proto"
+_PACKAGE = "logparser"
+
+
+def _file_descriptor_proto() -> descriptor_pb2.FileDescriptorProto:
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = _FILE
+    fdp.package = _PACKAGE
+    fdp.syntax = "proto3"
+
+    fld = descriptor_pb2.FieldDescriptorProto
+    chunk = fdp.message_type.add()
+    chunk.name = "StreamChunk"
+    f = chunk.field.add()
+    f.name, f.number = "data", 1
+    f.type, f.label = fld.TYPE_BYTES, fld.LABEL_OPTIONAL
+    f = chunk.field.add()
+    f.name, f.number = "close", 2
+    f.type, f.label = fld.TYPE_BOOL, fld.LABEL_OPTIONAL
+
+    frame = fdp.message_type.add()
+    frame.name = "StreamFrame"
+    f = frame.field.add()
+    f.name, f.number = "json", 1
+    f.type, f.label = fld.TYPE_STRING, fld.LABEL_OPTIONAL
+
+    svc = fdp.service.add()
+    svc.name = "LogParserStream"
+    m = svc.method.add()
+    m.name = "StreamParse"
+    m.input_type = f".{_PACKAGE}.StreamChunk"
+    m.output_type = f".{_PACKAGE}.StreamFrame"
+    m.client_streaming = True
+    m.server_streaming = True
+    return fdp
+
+
+_pool = descriptor_pool.Default()
+try:
+    _file_desc = _pool.FindFileByName(_FILE)
+except KeyError:
+    _file_desc = _pool.Add(_file_descriptor_proto())
+
+StreamChunk = message_factory.GetMessageClass(
+    _file_desc.message_types_by_name["StreamChunk"]
+)
+StreamFrame = message_factory.GetMessageClass(
+    _file_desc.message_types_by_name["StreamFrame"]
+)

@@ -1,0 +1,521 @@
+"""Streaming follow-mode (runtime/stream.py): the replay theorem and its
+reliability wiring.
+
+The correctness anchor is chunked-vs-oneshot bit parity — feeding a blob
+in N chunks of ANY split must close with final scores bit-identical to
+one-shot ``analyze()`` on the concatenated blob, across batched/unbatched
+engines and line cache on/off. Around it: the carried-scan-state tiers
+(``CubeHostCarry``) pinned bit-identical to ``MatcherBanks.cube`` per
+prefix, the monotone-refinement frame contract (emit, then explicit
+``revised`` — never a silent retraction), frequency serial-equivalence
+under 8 concurrent sessions, TTL reaping through the shared admission
+gate, hot-reload re-basing, the chunk-boundary UTF-8 normalizer
+(native/ingest.py ``StreamNormalizer``), and the gRPC twin transport.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.native.ingest import StreamNormalizer
+from log_parser_tpu.ops.encode import encode_lines
+from log_parser_tpu.ops.match import MatcherBanks
+from log_parser_tpu.patterns.bank import PatternBank
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.reload import VALIDATION_LOGS
+from log_parser_tpu.runtime.stream import FRAME_TYPES, StreamManager
+from log_parser_tpu.serve.admission import shared_gate
+
+from conftest import FakeClock
+from helpers import make_pattern, make_pattern_set
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern(
+                    "oom", regex="OutOfMemoryError", confidence=0.8,
+                    severity="HIGH",
+                    secondaries=[("GC overhead", 0.6, 10)], context=(1, 1),
+                ),
+                make_pattern(
+                    "crash", regex="CrashLoopBackOff", confidence=0.7,
+                    severity="MEDIUM",
+                ),
+                make_pattern(
+                    "refused", regex="connection refused", confidence=0.6,
+                    severity="LOW",
+                ),
+            ]
+        )
+    ]
+
+
+def _engine() -> AnalysisEngine:
+    return AnalysisEngine(_sets(), ScoringConfig(), clock=FakeClock())
+
+
+def _events(result_dict: dict) -> list[tuple]:
+    return [
+        (e["lineNumber"], e["matchedPattern"]["id"], e["score"])
+        for e in result_dict.get("events", [])
+    ]
+
+
+def _oneshot(engine: AnalysisEngine, blob: str, batched: bool) -> list[tuple]:
+    data = PodFailureData(logs=blob)
+    result = engine.analyze_batched(data) if batched else engine.analyze(data)
+    return _events(result.to_dict(drop_none=True))
+
+
+def _splits(rng: random.Random, data: bytes) -> list[bytes]:
+    cuts = sorted(
+        rng.randrange(len(data) + 1) for _ in range(rng.randrange(0, 9))
+    )
+    bounds = [0, *cuts, len(data)]
+    return [data[a:b] for a, b in zip(bounds, bounds[1:])]
+
+
+def _stream(mgr: StreamManager, chunks: list[bytes]) -> list[dict]:
+    sess = mgr.open()
+    frames: list[dict] = []
+    for c in chunks:
+        frames += sess.feed(c)
+        assert not sess.closed, frames[-1]
+    frames += sess.close()
+    assert sess.closed
+    return frames
+
+
+def _final_of(frames: list[dict]) -> dict:
+    assert all(f["type"] in FRAME_TYPES for f in frames)
+    finals = [f for f in frames if f["type"] == "final"]
+    assert len(finals) == 1 and frames[-1] is finals[0], [
+        f["type"] for f in frames
+    ]
+    return finals[0]
+
+
+# ------------------------------------------------------- replay theorem
+
+
+@pytest.mark.parametrize("cache", [False, True], ids=["nocache", "cache"])
+@pytest.mark.parametrize("batched", [False, True], ids=["unbatched", "batched"])
+def test_replay_theorem_randomized_splits(cache, batched):
+    """Any split of VALIDATION_LOGS (and a repeat of it — carried
+    frequency state) closes bit-identical to one-shot analyze() on the
+    reassembled blob, with the two engines' frequency trackers staying
+    serially equivalent request-for-request."""
+    engine, ref = _engine(), _engine()
+    for e in (engine, ref):
+        if cache:
+            e.enable_line_cache(8)
+        if batched:
+            e.enable_batching(wait_ms=1.0)
+    try:
+        mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+        rng = random.Random(901)
+        blob = VALIDATION_LOGS
+        for round_no in range(3):  # repeats: cache hits + frequency history
+            frames = _stream(mgr, _splits(rng, blob.encode()))
+            got = _events(_final_of(frames)["result"])
+            want = _oneshot(ref, blob, batched)
+            assert got == want, f"round {round_no}: {got} != {want}"
+            assert json.dumps(
+                engine.frequency.get_frequency_statistics(), sort_keys=True
+            ) == json.dumps(
+                ref.frequency.get_frequency_statistics(), sort_keys=True
+            )
+        assert shared_gate(engine).stats()["inflight"] == 0
+    finally:
+        for e in (engine, ref):
+            if e.batcher is not None:
+                e.batcher.close()
+
+
+def test_replay_theorem_hostile_bytes():
+    """Splits that land inside multi-byte UTF-8 sequences, on CRLF
+    boundaries, and inside invalid bytes still close identical to the
+    blob path (errors="replace" end to end)."""
+    engine, ref = _engine(), _engine()
+    mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+    blob_bytes = (
+        "café OutOfMemoryError 你好\r\n".encode()
+        + b"\xff\xfe connection refused\n"
+        + "tail CrashLoopBackOff \U0001f600".encode()[:-2]  # truncated emoji
+    )
+    blob = blob_bytes.decode("utf-8", errors="replace")
+    rng = random.Random(77)
+    for _ in range(3):
+        frames = _stream(mgr, _splits(rng, blob_bytes))
+        assert _events(_final_of(frames)["result"]) == _oneshot(ref, blob, False)
+    # byte-at-a-time is the worst split of all
+    frames = _stream(mgr, [bytes([b]) for b in blob_bytes])
+    assert _events(_final_of(frames)["result"]) == _oneshot(ref, blob, False)
+
+
+# ------------------------------------------------- carry == cube parity
+
+
+@pytest.fixture
+def multi_engaged(monkeypatch):
+    """Force the multi tier on hosts without the native library: the
+    MatcherBanks gate sees a library while the union builder takes the
+    Python construction (tests/test_matchdfa_pallas.py idiom)."""
+    import log_parser_tpu.native as native
+    import log_parser_tpu.native.dfabuild as dfabuild
+
+    monkeypatch.setattr(native, "get_lib", lambda: object())
+    monkeypatch.setattr(dfabuild, "get_lib", lambda: None)
+
+
+_CARRY_REGEXES = [
+    "OutOfMemoryError",
+    "exit code 137|Exit Code:\\s*137",
+    "segfault at [0-9a-f]+|Segmentation fault",
+    "a{2,4}b",
+    "status.*red",
+    "^start",
+    "foo$",
+]
+
+_CARRY_LINES = [
+    "",
+    "java.lang.OutOfMemoryError: heap",
+    "Exit Code:   137",
+    "segfault at deadbeef",
+    "aaaab",
+    "status is red",
+    "start here",
+    "restart",
+    "foox",
+    "xfoo",
+    "status red herring status is red",
+]
+
+
+def _carry_bank() -> PatternBank:
+    patterns = [
+        make_pattern(f"p{j}", regex=rx, confidence=0.5, severity="LOW")
+        for j, rx in enumerate(_CARRY_REGEXES)
+    ]
+    return PatternBank([make_pattern_set(patterns)])
+
+
+_TIER_KW = {
+    "dense": dict(
+        shiftor_min_columns=10**9, prefilter_min_columns=10**9,
+        multi_min_columns=10**9, bitglush_max_words=0,
+    ),
+    "shiftor": dict(
+        shiftor_min_columns=1, prefilter_min_columns=10**9,
+        multi_min_columns=10**9, bitglush_max_words=0,
+    ),
+    "multi": dict(
+        shiftor_min_columns=10**9, prefilter_min_columns=10**9,
+        multi_min_columns=2, bitglush_max_words=0,
+    ),
+}
+
+
+@pytest.mark.parametrize("tier", ["dense", "shiftor", "multi"])
+def test_carry_snapshot_matches_cube(tier, multi_engaged):
+    """CubeHostCarry fed any split of a line reports the same cube row
+    as the device scan — per PREFIX, not just at end of line: this is
+    the resumability property the streaming tail rides on."""
+    banks = MatcherBanks(_carry_bank(), **_TIER_KW[tier])
+    if tier == "multi":
+        assert banks.multi_groups, "multi tier must engage"
+    if tier == "shiftor":
+        assert banks.shiftor is not None, "shiftor tier must engage"
+    carry = banks.host_carry()
+    assert carry is not None
+
+    import jax.numpy as jnp
+
+    rng = random.Random(5)
+    for line in _CARRY_LINES:
+        data = line.encode()
+        prefixes = [data[:i] for i in range(len(data) + 1)]
+        enc = encode_lines([p.decode() for p in prefixes], 4096, 128, 8)
+        rows = np.asarray(
+            banks.cube(jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths))
+        )[: len(prefixes)]
+        for _ in range(3):
+            carry.reset()
+            fed = 0
+            np.testing.assert_array_equal(
+                carry.snapshot_bits(), rows[0], err_msg=f"{line!r} empty"
+            )
+            while fed < len(data):
+                step = rng.randrange(1, len(data) - fed + 1)
+                carry.feed(data[fed : fed + step])
+                fed += step
+                np.testing.assert_array_equal(
+                    carry.snapshot_bits(), rows[fed],
+                    err_msg=f"{line!r} prefix {fed} ({tier})",
+                )
+
+
+# ------------------------------------------- monotone-refinement frames
+
+
+def test_monotone_refinement_contract():
+    """Every score an event ever shows is announced: the first report is
+    an ``emit`` at/above the threshold, every change afterwards is a
+    ``revised`` frame whose previousScore chains exactly, retractions
+    are explicit, and the ledger's end state equals the final result —
+    a silent retraction or jump is impossible by construction."""
+    engine = _engine()
+    threshold = 0.3
+    mgr = StreamManager(
+        engine, emit_threshold=threshold, ttl_s=0, start_reaper=False
+    )
+    sess = mgr.open()
+    frames: list[dict] = []
+    for piece in [
+        b"INFO boot\n",
+        b"java.lang.OutOfMemoryError: heap\n",
+        b"INFO filler\n",
+        b"GC overhead limit exceeded\n",  # secondary: firms up the oom score
+        b"connection refused\n",
+    ]:
+        frames += sess.feed(piece)
+    frames += sess.close()
+    final = _final_of(frames)
+
+    trail: dict[tuple, float | None] = {}
+    for f in frames:
+        if f["type"] == "emit":
+            key = (f["line"], f["patternId"])
+            assert key not in trail, f"re-emit of {key}"
+            assert f["score"] >= threshold, f
+            trail[key] = f["score"]
+        elif f["type"] == "revised":
+            key = (f["line"], f["patternId"])
+            assert key in trail, f"revision of never-emitted {key}"
+            assert f["previousScore"] == trail[key], f
+            if f["score"] is None or f["score"] < threshold:
+                assert f["retracted"] is True, f
+            trail[key] = f["score"]
+    # seq numbers are strictly increasing: frame order is reconstructable
+    seqs = [f["seq"] for f in frames]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    # the ledger's last word per event == the final result, exactly
+    final_scores = {
+        (e["lineNumber"], e["matchedPattern"]["id"]): e["score"]
+        for e in final["result"].get("events", [])
+        if e["score"] >= threshold
+    }
+    live = {
+        k: v for k, v in trail.items() if v is not None and v >= threshold
+    }
+    assert live == final_scores
+    # the proximity secondary landed after the emit: a revision happened
+    assert any(
+        f["type"] == "revised" and f["patternId"] == "oom" for f in frames
+    ), [f["type"] for f in frames]
+
+
+# ------------------------------------- concurrent frequency equivalence
+
+
+def test_eight_concurrent_sessions_frequency_serial_equivalence():
+    """8 sessions feeding interleaved chunks on ONE engine: each final
+    matches a serial replay of the same blobs in close order on a fresh
+    engine, and the shared frequency tracker ends in exactly the serial
+    replay's state — streamed sessions commit once, at close, in their
+    close order."""
+    engine = _engine()
+    mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+    blobs = [
+        (
+            f"INFO pod-{i} boot\n"
+            + ("java.lang.OutOfMemoryError: heap\n" * (1 + i % 3))
+            + ("connection refused\n" if i % 2 else "CrashLoopBackOff\n")
+            + f"INFO pod-{i} done\n"
+        )
+        for i in range(8)
+    ]
+    order: list[int] = []
+    results: dict[int, list[tuple]] = {}
+    errors: list[BaseException] = []
+    close_lock = threading.Lock()  # close order == frequency commit order
+
+    def run(i: int) -> None:
+        try:
+            rng = random.Random(1000 + i)
+            sess = mgr.open()
+            frames: list[dict] = []
+            for c in _splits(rng, blobs[i].encode()):
+                frames += sess.feed(c)
+            with close_lock:
+                frames += sess.close()
+                order.append(i)
+            results[i] = _events(_final_of(frames)["result"])
+        except BaseException as exc:  # surface into the main thread
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    assert sorted(order) == list(range(8))
+
+    ref = _engine()
+    for i in order:
+        want = _oneshot(ref, blobs[i], False)
+        assert results[i] == want, f"session {i} (closed #{order.index(i)})"
+    assert json.dumps(
+        engine.frequency.get_frequency_statistics(), sort_keys=True
+    ) == json.dumps(ref.frequency.get_frequency_statistics(), sort_keys=True)
+    assert shared_gate(engine).stats()["inflight"] == 0
+    assert mgr.stats()["openSessions"] == 0
+
+
+# ------------------------------------------------- reliability wiring
+
+
+def test_ttl_reap_releases_admission_slot():
+    engine = _engine()
+    clk = FakeClock()
+    mgr = StreamManager(engine, ttl_s=30.0, clock=clk, start_reaper=False)
+    sess = mgr.open()
+    sess.feed(b"INFO dangling tail with no newline")
+    assert shared_gate(engine).stats()["inflight"] == 1
+    clk.advance(29.0)
+    assert mgr.reap_now() == 0  # not stale yet
+    clk.advance(2.0)
+    assert mgr.reap_now() == 1
+    assert sess.closed and sess.kill_reason == "ttl"
+    assert shared_gate(engine).stats()["inflight"] == 0
+    frames = sess.feed(b"more")  # dead sessions answer with an error frame
+    assert frames[-1]["type"] == "error" and frames[-1]["reason"] == "ttl"
+    st = mgr.stats()
+    assert st["sessionsReaped"] == 1 and st["openSessions"] == 0
+
+
+def test_hot_reload_rebases_open_session():
+    """apply_library landing between chunks: the session re-bases onto
+    the swapped banks on its next feed and still closes with a final
+    identical to one-shot analyze on the post-swap engine."""
+    engine = _engine()
+    mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+    sess = mgr.open()
+    sess.feed(b"java.lang.OutOfMemoryError: heap\n")
+    engine.apply_library(_engine())
+    sess.feed(b"connection refused\n")
+    frames = sess.close()
+    got = _events(_final_of(frames)["result"])
+    assert mgr.stats()["sessionsRebased"] == 1
+    ref = _engine()
+    want = _oneshot(
+        ref, "java.lang.OutOfMemoryError: heap\nconnection refused\n", False
+    )
+    assert got == want
+
+
+def test_manager_stats_keys_are_stable():
+    """The /trace/last ``stream`` block contract (docs/OPS.md table)."""
+    mgr = StreamManager(_engine(), ttl_s=0, start_reaper=False)
+    assert sorted(mgr.stats()) == sorted(
+        [
+            "openSessions", "sessionsOpened", "sessionsClosed",
+            "sessionsKilled", "sessionsReaped", "sessionsRebased",
+            "chunksIngested", "bytesIngested", "framesEmitted",
+            "framesRevised", "goldenContinuations", "poisonKills",
+        ]
+    )
+
+
+# ------------------------------------------- chunk-boundary normalizer
+
+
+def test_normalizer_split_invariance():
+    """Decoding chunk-by-chunk through StreamNormalizer equals decoding
+    the joined blob, for ANY split point — including splits inside
+    multi-byte sequences and inside invalid bytes."""
+    rng = random.Random(11)
+    samples = [
+        "café über 你好 \U0001f600\nplain\n".encode(),
+        b"\xff\xfe broken \xc3( mid\n",
+        "tail€".encode()[:-1],  # truncated trailing multi-byte
+        bytes(range(1, 256)),
+        b"",
+    ]
+    for data in samples:
+        want = data.decode("utf-8", errors="replace")
+        for _ in range(25):
+            chunks = _splits(rng, data)
+            norm = StreamNormalizer()
+            got = "".join(norm.feed(c) for c in chunks) + norm.flush()
+            assert got == want, (data, chunks)
+
+
+def test_normalizer_holds_dangling_prefix():
+    """The dangling half of a split sequence is HELD, not replaced — the
+    naive per-chunk decode would emit two U+FFFD here instead of the
+    blob path's single character."""
+    euro = "€".encode()  # 3 bytes
+    norm = StreamNormalizer()
+    assert norm.feed(b"x" + euro[:1]) == "x"
+    assert norm.feed(euro[1:]) == "€"
+    assert norm.flush() == ""
+
+
+def test_normalizer_truncated_trailing_multibyte_flush():
+    norm = StreamNormalizer()
+    assert norm.feed(b"caf\xc3") == "caf"
+    assert norm.flush() == "�"  # same replacement the blob path makes
+    assert norm.feed(b"ok") == "ok"  # reset: reusable after flush
+
+
+# ----------------------------------------------------- gRPC twin transport
+
+
+def test_grpc_stream_parity():
+    from log_parser_tpu.shim.grpc_server import HAVE_GRPC
+
+    if not HAVE_GRPC:
+        pytest.skip("grpcio not installed")
+    import grpc
+
+    from log_parser_tpu.shim import logparser_stream_pb2 as spb
+    from log_parser_tpu.shim import make_stream_stub
+    from log_parser_tpu.shim.grpc_server import make_grpc_server
+
+    engine = _engine()
+    server, port = make_grpc_server(engine, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = make_stream_stub(channel)
+        blob = (
+            "INFO boot\njava.lang.OutOfMemoryError: heap\n"
+            "GC overhead limit exceeded\nCrashLoopBackOff seen\n"
+        )
+        data = blob.encode()
+
+        def chunks():
+            for i in range(0, len(data), 7):
+                yield spb.StreamChunk(data=data[i : i + 7])
+            yield spb.StreamChunk(close=True)
+
+        frames = [json.loads(f.json) for f in stub(chunks())]
+        final = _final_of(frames)
+        want = _oneshot(_engine(), blob, False)
+        assert _events(final["result"]) == want
+        channel.close()
+    finally:
+        server.stop(grace=None)

@@ -124,8 +124,31 @@ kernel group (--group kernel): the Pallas union-DFA kernel tier behind
                         parity preserved — clients never see the fault
                         and the golden fallbackCount stays zero.
 
+Streaming group (``--group streaming``; follow-mode sessions —
+docs/OPS.md "Streaming follow-mode"):
+
+- ``stream-device-fault-golden``  an injected device fault mid-session
+                        flips the session to a golden continuation: it
+                        keeps emitting, closes with a ``final`` frame,
+                        and ``stream.goldenContinuations`` moves — the
+                        client never sees the fault.
+- ``stream-poison-kill``  a keyed poison chunk kills exactly its own
+                        SESSION (structured ``error`` frame, reason
+                        ``poison``, fingerprint struck) — the server and
+                        a parallel fresh session keep serving.
+- ``stream-reload-rebase``  a hot pattern reload lands while a session
+                        is open between chunks; the next chunk re-bases
+                        the session onto the new banks
+                        (``sessionsRebased`` bumps) and it still closes
+                        with a ``final`` frame.
+- ``stream-ttl-reap``   idle sessions under ``--stream-ttl-s 1`` are
+                        reaped while a concurrent parse burst runs —
+                        their admission slots release
+                        (``openSessions`` 0, gate ``inflight`` 0) and
+                        the server stays healthy.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|distributed|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|all]
                                    [--keep-logs]
 """
 
@@ -780,6 +803,185 @@ KERNEL_SCENARIOS = [
 ]
 
 
+# --------------------------------------------------- streaming scenarios
+
+
+class StreamClient:
+    """Raw-socket chunked-TE client for ``POST /parse/stream``. The
+    stdlib ``urllib`` can neither send chunked request bodies nor read a
+    response while the request is still being written, so follow-mode
+    needs a hand-rolled socket: send the headers, read the immediate
+    NDJSON response headers, then interleave chunk writes with frame
+    reads on one connection."""
+
+    def __init__(self, url: str):
+        host, _, port = url.removeprefix("http://").partition(":")
+        self.sock = socket.create_connection((host, int(port)), timeout=120)
+        self.sock.sendall(
+            b"POST /parse/stream HTTP/1.1\r\nHost: chaos\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            part = self.sock.recv(65536)
+            if not part:
+                raise AssertionError("stream closed before response headers")
+            buf += part
+        head, self._buf = buf.split(b"\r\n\r\n", 1)
+        self.status = int(head.split(b" ", 2)[1])
+        assert self.status == 200, f"stream open -> {self.status}"
+
+    def send(self, data: bytes) -> None:
+        self.sock.sendall(b"%x\r\n" % len(data) + data + b"\r\n")
+
+    def read_frames(self) -> list[dict]:
+        """Drain NDJSON frames to server EOF (the server closes the
+        connection after the terminal frame) and return them parsed."""
+        buf = self._buf
+        while True:
+            try:
+                part = self.sock.recv(65536)
+            except OSError:
+                break
+            if not part:
+                break
+            buf += part
+        self.sock.close()
+        return [json.loads(ln) for ln in buf.splitlines() if ln.strip()]
+
+    def finish(self) -> list[dict]:
+        self.sock.sendall(b"0\r\n\r\n")  # terminating chunk closes the session
+        return self.read_frames()
+
+    def abort(self) -> None:
+        self.sock.close()
+
+
+def _one_final(frames: list[dict]) -> dict:
+    bad = [f for f in frames if f["type"] == "error"]
+    assert not bad, bad
+    finals = [f for f in frames if f["type"] == "final"]
+    assert len(finals) == 1 and frames[-1] is finals[0], [
+        f["type"] for f in frames
+    ]
+    return finals[0]
+
+
+def scenario_stream_device_fault_golden(srv: Server):
+    """A device fault on a mid-session chunk must flip THAT session to a
+    golden continuation — later chunks keep scoring, the close still
+    produces a ``final`` frame, and the client never sees the fault."""
+    assert post(srv.url)[0] == 200  # burns the after=1 skip deterministically
+    c = StreamClient(srv.url)
+    c.send(b"INFO stream boot\n")  # device eval #2: the armed fault fires here
+    c.send(b"java.lang.OutOfMemoryError: heap\n")
+    final = _one_final(c.finish())
+    assert final["result"]["summary"]["significantEvents"] >= 1, final
+    _, trace = get(srv.url, "/trace/last")
+    st = trace["stream"]
+    assert st["goldenContinuations"] == 1, st
+    assert st["sessionsClosed"] == 1 and st["openSessions"] == 0, st
+    assert trace["faults"]["fired"]["device_raise"] == 1, trace["faults"]
+    assert post(srv.url)[0] == 200  # and the device path itself is fine
+
+
+def scenario_stream_poison_kill(srv: Server):
+    """A keyed poison chunk kills exactly its own session: a structured
+    ``error`` frame with reason ``poison``, while the server — and a
+    parallel fresh session — keep serving."""
+    assert post(srv.url)[0] == 200  # no marker in PAYLOAD: must not fire
+    c = StreamClient(srv.url)
+    c.send(b"INFO clean chunk\n")
+    c.send(b"POISON-PILL marker line\n")  # match= key: fires on this chunk only
+    frames = c.read_frames()  # the server ends the response after the kill
+    assert frames and frames[-1]["type"] == "error", frames
+    assert frames[-1]["reason"] == "poison", frames[-1]
+    c2 = StreamClient(srv.url)  # blast radius: one session, not the server
+    c2.send(b"java.lang.OutOfMemoryError: heap\n")
+    final = _one_final(c2.finish())
+    assert final["result"]["summary"]["significantEvents"] >= 1, final
+    assert post(srv.url)[0] == 200
+    _, trace = get(srv.url, "/trace/last")
+    st = trace["stream"]
+    assert st["poisonKills"] == 1 and st["sessionsKilled"] >= 1, st
+    assert st["openSessions"] == 0, st
+
+
+def scenario_stream_reload_rebase(srv: Server):
+    """A hot pattern reload landing between chunks of an open session:
+    the next chunk re-bases the session onto the swapped banks (the
+    reload never waits on idle sessions — quiesce counts active calls,
+    not open sessions) and the session still closes with a final."""
+    assert post(srv.url)[0] == 200
+    c = StreamClient(srv.url)
+    c.send(b"INFO stream warm\n")
+    status, body = post_raw(srv.url, "/patterns/reload", b"")
+    assert status == 200 and body["epoch"] == 1, (status, body)
+    c.send(b"java.lang.OutOfMemoryError: heap\n")  # first post-swap chunk
+    final = _one_final(c.finish())
+    assert final["result"]["summary"]["significantEvents"] >= 1, final
+    _, trace = get(srv.url, "/trace/last")
+    st = trace["stream"]
+    assert st["sessionsRebased"] >= 1, st
+    assert st["sessionsClosed"] == 1 and st["openSessions"] == 0, st
+    assert trace["reload"]["epoch"] == 1, trace["reload"]
+
+
+def scenario_stream_ttl_reap(srv: Server):
+    """Sessions abandoned mid-stream under --stream-ttl-s 1 are reaped
+    while concurrent blob traffic runs: their admission slots release
+    (gate inflight back to 0) and the server stays healthy."""
+    c1, c2 = StreamClient(srv.url), StreamClient(srv.url)
+    c1.send(b"INFO abandoned tail")
+    c2.send(b"INFO abandoned tail two")
+    burst = Burst(srv.url, 4)  # reap must land under live parse load
+    codes = sorted(s for s, _ in burst.join(timeout=120))
+    assert codes == [200] * 4, codes
+    trace = _poll_trace(
+        srv.url, lambda t: t.get("stream", {}).get("sessionsReaped", 0) >= 2
+    )
+    st = trace["stream"]
+    assert st["openSessions"] == 0, st
+    assert trace["admission"]["inflight"] == 0, trace["admission"]
+    c1.abort()
+    c2.abort()
+    assert post(srv.url)[0] == 200
+
+
+STREAMING_SCENARIOS = [
+    (
+        "stream-device-fault-golden",
+        [],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_raise:1.0@after=1@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_stream_device_fault_golden,
+    ),
+    (
+        "stream-poison-kill",
+        [],
+        {
+            "LOG_PARSER_TPU_FAULTS": "quarantine_raise:1.0@match=POISON-PILL",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_stream_poison_kill,
+    ),
+    (
+        "stream-reload-rebase",
+        [],
+        {},
+        scenario_stream_reload_rebase,
+    ),
+    (
+        "stream-ttl-reap",
+        ["--stream-ttl-s", "1"],
+        {},
+        scenario_stream_ttl_reap,
+    ),
+]
+
+
 # ------------------------------------------------------- state scenarios
 
 
@@ -1130,7 +1332,7 @@ def main(argv: list[str] | None = None) -> int:
         "--group",
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
-            "distributed", "all",
+            "streaming", "distributed", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1157,6 +1359,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(LINECACHE_SCENARIOS)
     if args.group in ("kernel", "all"):
         single_server.extend(KERNEL_SCENARIOS)
+    if args.group in ("streaming", "all"):
+        single_server.extend(STREAMING_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:

@@ -40,9 +40,23 @@ Three modes:
   untouched (same bank object, same frequency stats). Runs with fallback
   DISABLED, so a hostile input that faults the device step surfaces as a
   500 finding instead of hiding behind golden.
+- ``--stream``: adversarial-chunking sweep over the streaming session
+  layer (runtime/stream.py). Seeded corpora — CRLF endings, multi-byte
+  UTF-8, raw invalid bytes, NULs, control soup — are fed through
+  sessions under hostile chunkings (1-byte chunks, empty chunks, splits
+  inside UTF-8 sequences and inside ``\\r\\n``); every session must
+  produce only well-formed frames, end in exactly one terminal ``final``
+  (or structured ``error``) frame, release its admission slot, and the
+  final result must be bit-identical to one-shot ``analyze()`` on the
+  reassembled blob with serially-equivalent frequency state. A periodic
+  raw-socket pass sends garbage HTTP chunk framing at
+  ``POST /parse/stream`` and must get a structured ``bad-frame`` error
+  frame with the server still healthy — a wedged session/server is the
+  finding.
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
-       [--sharded | --pattern-sharded | --long | --admin | --quick]
+       [--sharded | --pattern-sharded | --long | --admin | --ingest |
+        --stream | --quick]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
 9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
 the documented records below; --end exclusive)
@@ -61,6 +75,9 @@ Record (round-5 engine, 2026-08-01 — native batched regex pipeline,
 pack-file cache, exact bitglush pricing, \\Q quoting): ALL FOUR full
 sweeps clean — default 8..199 (192 libraries), sharded 1004..1053,
 pattern-sharded 9003..9052, long 31006..31055.
+Record (round-9 engine, 2026-08-05 — streaming session layer): stream
+seeds 61000..61049 (50 corpora x 3 chunkings, periodic garbage-framing
+passes) clean.
 """
 
 from __future__ import annotations
@@ -103,6 +120,7 @@ def main() -> int:
     mode.add_argument("--long", action="store_true")
     mode.add_argument("--admin", action="store_true")
     mode.add_argument("--ingest", action="store_true")
+    mode.add_argument("--stream", action="store_true")
     mode.add_argument(
         "--quick",
         action="store_true",
@@ -123,7 +141,17 @@ def main() -> int:
         start = _MODE_DEFAULTS["ingest"][0]
         print(f"== quick sweep: ingest seeds {start}..{start + 4}", flush=True)
         rc |= run_ingest_sweep(start, start + 5)
+        start = _MODE_DEFAULTS["stream"][0]
+        print(f"== quick sweep: stream seeds {start}..{start + 4}", flush=True)
+        rc |= run_stream_sweep(start, start + 5)
         return rc
+    if args.stream:
+        start, end = _MODE_DEFAULTS["stream"]
+        if args.start is not None:
+            start = args.start
+        if args.end is not None:
+            end = args.end
+        return run_stream_sweep(start, end)
     if args.ingest:
         start, end = _MODE_DEFAULTS["ingest"]
         if args.start is not None:
@@ -165,6 +193,7 @@ _MODE_DEFAULTS = {
     "long": (31006, 31056),
     "admin": (41000, 41050),
     "ingest": (51000, 51050),
+    "stream": (61000, 61050),
 }
 
 
@@ -423,6 +452,213 @@ def run_ingest_sweep(start: int, end: int) -> int:
         server.shutdown()
         server.server_close()
     print(f"DONE ingest seeds {start}..{end - 1} fails: {fails} "
+          f"({time.time() - t0:.0f}s)")
+    return 1 if fails else 0
+
+
+def _stream_corpus(rng: "random.Random") -> bytes:
+    """Seeded hostile byte corpus for the stream sweep: LF/CRLF mixes,
+    multi-byte UTF-8, raw invalid bytes, NULs, control characters,
+    over-budget lines, and real matching lines — ending sometimes on a
+    dangling ``\\r`` or a truncated multi-byte sequence."""
+    parts: list[bytes] = []
+    for _ in range(rng.randrange(2, 14)):
+        kind = rng.randrange(7)
+        if kind == 0:
+            parts.append(b"java.lang.OutOfMemoryError: Java heap space")
+        elif kind == 1:
+            parts.append(
+                ("café über 你好 \U0001f600"
+                 * rng.randrange(1, 3)).encode()
+            )
+        elif kind == 2:  # invalid UTF-8 runs -> U+FFFD, split-invariantly
+            parts.append(
+                bytes(rng.randrange(128, 256)
+                      for _ in range(rng.randrange(1, 24)))
+            )
+        elif kind == 3:  # content NUL + control bytes (needs_host lines)
+            parts.append(b"bad\x00nul" + bytes([rng.randrange(1, 32)]) * 4)
+        elif kind == 4:
+            parts.append(
+                "".join(chr(rng.randrange(0x20, 0x7F))
+                        for _ in range(rng.randrange(0, 40))).encode()
+            )
+        elif kind == 5:  # may exceed the per-line device budget
+            parts.append(b"x" * rng.randrange(100, 5000))
+        else:
+            parts.append(b"OutOfMemoryError unable to create new native thread")
+        parts.append(rng.choice([b"\n", b"\r\n"]))
+    blob = b"".join(parts)
+    if rng.random() < 0.3:
+        blob = blob[: -rng.randrange(1, 3)]  # dangling tail / lone \r
+    if rng.random() < 0.25:
+        blob += "€".encode()[: rng.randrange(1, 3)]  # truncated sequence
+    return blob
+
+
+def _stream_chunkings(
+    rng: "random.Random", data: bytes
+) -> list[list[bytes]]:
+    """Adversarial chunkings of one corpus: byte-at-a-time, random chunks
+    with empties interspersed, and cuts placed exactly at every non-ASCII
+    byte and every ``\\r``/``\\n`` — guaranteed splits inside multi-byte
+    sequences and inside ``\\r\\n`` pairs."""
+    outs: list[list[bytes]] = []
+    if len(data) <= 400:
+        outs.append([data[i : i + 1] for i in range(len(data))])
+    chunks: list[bytes] = []
+    i = 0
+    while i < len(data):
+        if rng.random() < 0.15:
+            chunks.append(b"")
+        n = rng.randrange(1, 17)
+        chunks.append(data[i : i + n])
+        i += n
+    chunks.append(b"")
+    outs.append(chunks)
+    cuts = sorted(
+        {i for i, b in enumerate(data) if b >= 0x80 or b in (0x0D, 0x0A)}
+        | {0, len(data)}
+    )
+    outs.append([data[a:b] for a, b in zip(cuts, cuts[1:]) if a < b])
+    return outs
+
+
+def run_stream_sweep(start: int, end: int) -> int:
+    """Fuzz the streaming session layer under adversarial chunkings: every
+    session must produce only well-formed frames, terminate in exactly one
+    ``final`` (or structured ``error``) frame, release its admission slot,
+    and close bit-identical to one-shot ``analyze()`` on the reassembled
+    blob — with frequency state staying serially equivalent between the
+    streamed engine and a reference engine fed the same blobs. A periodic
+    raw-socket pass throws garbage HTTP chunk framing at
+    ``POST /parse/stream`` and must get a structured ``bad-frame`` error
+    with the server still answering ``/health`` — a wedged session or
+    server is the finding."""
+    import json
+    import random
+    import socket
+    import threading
+    import urllib.request
+
+    from tests.conftest import FakeClock
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pod import PodFailureData
+    from log_parser_tpu.patterns import load_pattern_directory
+    from log_parser_tpu.runtime import AnalysisEngine
+    from log_parser_tpu.runtime.stream import FRAME_TYPES
+    from log_parser_tpu.serve.admission import shared_gate
+    from log_parser_tpu.serve.http import make_server
+
+    pattern_dir = os.path.join(_REPO, "log_parser_tpu", "patterns", "builtin")
+    sets = load_pattern_directory(pattern_dir)
+    engine = AnalysisEngine(sets, ScoringConfig(), clock=FakeClock())
+    ref = AnalysisEngine(sets, ScoringConfig(), clock=FakeClock())
+    server = make_server(engine, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    mgr = server.get_stream_manager()
+    host, port = server.server_address[:2]
+
+    def events_of(result_dict: dict) -> list[tuple]:
+        return [
+            (e["lineNumber"], e["matchedPattern"]["id"], e["score"])
+            for e in result_dict.get("events", [])
+        ]
+
+    def run_session(chunks: list[bytes]) -> list[dict]:
+        sess = mgr.open()
+        frames: list[dict] = []
+        for c in chunks:
+            frames += sess.feed(c)
+            if sess.closed:
+                break
+        if not sess.closed:
+            frames += sess.close()
+        if not sess.closed:
+            raise AssertionError("session wedged: close() left it open")
+        return frames
+
+    def garbage_framing_pass() -> None:
+        with socket.create_connection((host, port), timeout=60) as sock:
+            sock.sendall(
+                b"POST /parse/stream HTTP/1.1\r\nHost: fuzz\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"3\r\nOOM\r\nZZZ\r\n"
+            )
+            raw = b""
+            while True:
+                part = sock.recv(65536)
+                if not part:
+                    break
+                raw += part
+        body = raw.split(b"\r\n\r\n", 1)[1]
+        err = [
+            json.loads(ln)
+            for ln in body.splitlines()
+            if ln.strip() and json.loads(ln).get("type") == "error"
+        ]
+        if not err or err[-1]["reason"] != "bad-frame":
+            raise AssertionError(f"garbage framing not contained: {body!r}")
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/health", timeout=60
+        ) as resp:
+            if resp.status != 200:
+                raise AssertionError("server unhealthy after garbage framing")
+
+    t0 = time.time()
+    fails: list[tuple[int, str]] = []
+    try:
+        for seed in range(start, end):
+            rng = random.Random(seed)
+            try:
+                data = _stream_corpus(rng)
+                blob = data.decode("utf-8", errors="replace")
+                for chunks in _stream_chunkings(rng, data):
+                    frames = run_session(chunks)
+                    for f in frames:
+                        if not isinstance(f, dict) or f.get("type") not in FRAME_TYPES:
+                            raise AssertionError(f"malformed frame: {f!r}")
+                    terminal = [f for f in frames if f["type"] in ("final", "error")]
+                    if len(terminal) != 1 or frames[-1] is not terminal[0]:
+                        raise AssertionError(
+                            f"bad termination: {[f['type'] for f in frames]}"
+                        )
+                    if terminal[0]["type"] == "error":
+                        continue  # structured failure is a legal outcome
+                    want = ref.analyze(
+                        PodFailureData(
+                            pod={"metadata": {"name": "fuzz-stream"}}, logs=blob
+                        )
+                    ).to_dict(drop_none=True)
+                    got = terminal[0]["result"]
+                    if events_of(got) != events_of(want):
+                        raise AssertionError(
+                            f"replay divergence: {events_of(got)} != "
+                            f"{events_of(want)}"
+                        )
+                ef = engine.frequency.get_frequency_statistics()
+                rf = ref.frequency.get_frequency_statistics()
+                if ef != rf:
+                    raise AssertionError(
+                        f"frequency stats diverge: {ef} != {rf}"
+                    )
+                if mgr.stats()["openSessions"] != 0:
+                    raise AssertionError("leaked open session")
+                if shared_gate(engine).stats()["inflight"] != 0:
+                    raise AssertionError("leaked admission slot")
+                if seed % 10 == 0:
+                    garbage_framing_pass()
+            except Exception as exc:  # noqa: BLE001 - recorded, sweep continues
+                fails.append((seed, repr(exc)[:300]))
+                print(f"SEED {seed} FAILED: {exc!r}", flush=True)
+            if seed % 10 == 0:
+                print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
+    finally:
+        server.shutdown()
+        server.server_close()
+        mgr.shutdown()
+    print(f"DONE stream seeds {start}..{end - 1} fails: {fails} "
           f"({time.time() - t0:.0f}s)")
     return 1 if fails else 0
 

@@ -30,7 +30,12 @@ Checks (all files tracked by git, minus excluded dirs):
      nobody repairs);
  11. every kernel-tier admission reason code (``REASONS`` in
      ops/matchdfa_pallas.py — the /trace/last ``kernel.reason``
-     vocabulary) has a row in docs/OPS.md.
+     vocabulary) has a row in docs/OPS.md;
+ 12. every streaming frame type (``FRAME_TYPES`` in runtime/stream.py —
+     the ``type`` field vocabulary of the NDJSON / gRPC frames a
+     follow-mode session emits) has a row in docs/OPS.md (an operator
+     reading a captured stream must be able to look up every frame
+     shape).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -317,6 +322,23 @@ def check_kernel_reasons_documented(root: Path) -> list[str]:
     ]
 
 
+def check_stream_frames_documented(root: Path) -> list[str]:
+    """Check 12: the streaming frame vocabulary (``FRAME_TYPES`` in
+    runtime/stream.py, the ``type`` field of every frame ``POST
+    /parse/stream`` and the gRPC ``StreamParse`` emit) must each have a
+    docs/OPS.md row — same contract-pinning as checks 10/11."""
+    src = root / "log_parser_tpu" / "runtime" / "stream.py"
+    ops_doc = root / "docs" / "OPS.md"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    return [
+        f"{src}: stream frame type {key!r} is not documented in docs/OPS.md"
+        for key in _dict_keys_of(src, "FRAME_TYPES")
+        if f"`{key}`" not in ops_text
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -342,6 +364,7 @@ def main() -> int:
         problems.extend(check_trace_counters_documented(root))
         problems.extend(check_static_analyzers(root))
         problems.extend(check_kernel_reasons_documented(root))
+        problems.extend(check_stream_frames_documented(root))
 
     for p in problems:
         print(p)

@@ -75,3 +75,7 @@ run "config4_10k_${platform}"      python bench_bank.py --patterns 10000 --lines
 run "config5_direct_${platform}"   python bench_latency.py
 run "config5_http_${platform}"     python bench_latency.py --http
 run "config5_http_c4_${platform}"  python bench_latency.py --http --concurrency 4
+# follow-mode TTFD vs blob-mode end-to-end on the repeat-heavy corpus
+# (ISSUE 9 acceptance shape; headline row of BENCH_r09)
+run "config5_stream_${platform}" \
+  python bench_latency.py --stream --repeat-ratio 0.9 --line-cache-mb 64
