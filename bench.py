@@ -132,6 +132,14 @@ def main() -> None:
             )
         ]
     n_patterns = sum(len(s.patterns or []) for s in sets)
+    # cold-start story (ROADMAP item 5): engine construction + first
+    # analyze = bank build + the XLA compile set. With the persistent
+    # compile cache warm the same wall-clock drops to a disk replay —
+    # compare boot_seconds across a cold/warm artifact pair and read the
+    # compile_cache hit/miss tally beside it.
+    import time as _time
+
+    _boot0 = _time.perf_counter()
     engine = AnalysisEngine(sets, ScoringConfig())
     assert not engine.fallback_to_golden, "bench must never serve from golden"
     if LINE_CACHE_MB > 0:
@@ -157,6 +165,12 @@ def main() -> None:
 
     def next_data() -> PodFailureData:
         return pool[next(_req) % len(pool)]
+
+    # first request pays the whole XLA compile set (or its disk replay):
+    # stamp it as the boot cost before the warmup loop hides it
+    _first = engine.analyze(next_data())
+    assert _first.summary.significant_events > 0
+    boot_seconds = _time.perf_counter() - _boot0
 
     # warmup + serial measure under the shared wedge wrapper and timing
     # rule (bench_common.measured_phase): a backend that wedges after
@@ -217,6 +231,10 @@ def main() -> None:
     if engine.line_cache is not None:
         extra["line_cache_mb"] = LINE_CACHE_MB
         extra["line_cache"] = engine.line_cache.stats()
+    from log_parser_tpu.utils import xlacache
+
+    extra["boot_seconds"] = round(boot_seconds, 3)
+    extra["compile_cache"] = xlacache.stats()
     bench_common.emit(
         metric,
         headline["lines_per_sec"],

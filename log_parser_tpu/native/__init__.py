@@ -23,9 +23,14 @@ _SO = _SRC.parent / "build" / "log_parser_native.so"
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+# WHY the fallback is running, recorded once at first get_lib() and
+# surfaced at GET /trace/last "native" (docs/OPS.md) — a GLIBCXX mismatch
+# on this host class used to require PERF.md archaeology to diagnose
+_load_error: str | None = None
 
 
 def _compile() -> bool:
+    global _load_error
     _SO.parent.mkdir(parents=True, exist_ok=True)
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
@@ -35,9 +40,11 @@ def _compile() -> bool:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired) as e:
         log.warning("native compile failed to launch: %s", e)
+        _load_error = f"compile failed to launch: {e}"
         return False
     if proc.returncode != 0:
         log.warning("native compile failed:\n%s", proc.stderr)
+        _load_error = f"compile failed: {proc.stderr.strip()[:500]}"
         return False
     return True
 
@@ -131,7 +138,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 def get_lib() -> ctypes.CDLL | None:
     """The bound native library, or None when unavailable."""
-    global _lib, _tried
+    global _lib, _tried, _load_error
     if _lib is not None or _tried:
         return _lib
     with _lock:
@@ -139,6 +146,7 @@ def get_lib() -> ctypes.CDLL | None:
             return _lib
         _tried = True
         if os.environ.get("LOG_PARSER_TPU_NO_NATIVE"):
+            _load_error = "disabled by LOG_PARSER_TPU_NO_NATIVE"
             return None
         try:
             # a prebuilt .so without source alongside (container runtime
@@ -151,18 +159,34 @@ def get_lib() -> ctypes.CDLL | None:
                 if stale and not _compile():
                     return None
             elif not _SO.exists():
+                _load_error = f"no prebuilt library at {_SO} and no source to build"
                 return None
             _lib = _bind(ctypes.CDLL(str(_SO)))
         except OSError as e:
+            # the GLIBCXX case lands here: the .so links a newer
+            # libstdc++ than the host ships (PERF.md §10)
             log.warning("native library unavailable: %s", e)
+            _load_error = f"load failed: {e}"
             _lib = None
         except AttributeError as e:
             # a prebuilt .so from an older source revision lacks newly
             # added symbols — fall back to pure Python, never crash
             log.warning("native library is stale (missing symbol): %s", e)
+            _load_error = f"stale library (missing symbol): {e}"
             _lib = None
     return _lib
 
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def stats() -> dict:
+    """GET /trace/last ``native`` block (docs/OPS.md): which ingest path
+    this process is running, and — when the scalar fallback is active —
+    the recorded reason the shared object refused to load."""
+    lib = get_lib()
+    return {
+        "available": lib is not None,
+        "loadError": _load_error,
+    }

@@ -74,11 +74,164 @@ class StreamNormalizer:
         return out
 
 
+def _split_offsets(flat: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
+    """Byte-level ``java_split_lines``: separators are ``\\n`` and
+    ``\\r\\n`` only (a lone ``\\r`` is content), trailing empty parts
+    dropped, no separator → the whole input even when empty. Valid UTF-8
+    never embeds 0x0A/0x0D inside a multi-byte sequence, so splitting the
+    encoded blob is character-for-character the str split. Returns
+    ``(starts, ends, n)`` with ``starts``/``ends`` int64 over ``flat``
+    (sized to the raw part count; only ``[:n]`` is meaningful)."""
+    seps = np.flatnonzero(flat == 0x0A)
+    nparts = len(seps) + 1
+    starts = np.empty(nparts, dtype=np.int64)
+    starts[0] = 0
+    starts[1:] = seps + 1
+    ends = np.empty(nparts, dtype=np.int64)
+    ends[-1] = len(flat)
+    if len(seps):
+        # \r\n: the \r belongs to the separator. The byte before a part's
+        # start is always \n, so a \r preceding a separator is necessarily
+        # this part's own content — no emptiness guard needed beyond sep>0.
+        crlf = (seps > 0) & (flat[np.maximum(seps - 1, 0)] == 0x0D)
+        ends[:-1] = seps - crlf
+    if nparts == 1:
+        return starts, ends, 1  # no separator — whole input, even if empty
+    nonempty = np.flatnonzero(ends > starts)
+    n = int(nonempty[-1]) + 1 if nonempty.size else 0
+    return starts, ends, n
+
+
+def _vectorized_encode(
+    flat: np.ndarray,
+    starts: np.ndarray,
+    ends: np.ndarray,
+    n: int,
+    max_line_bytes: int,
+    pad_to_multiple: int,
+    min_rows: int,
+) -> EncodedLines:
+    """``ops/encode.encode_lines`` bit-for-bit, from byte offsets instead
+    of a list[str]: same width/rows geometry, one range-scatter fill, and
+    per-line ``needs_host`` via segment reductions — no per-line Python.
+
+    ``needs_host`` parity note: the scalar path checks non-ASCII/NUL over
+    the first ``min(len, width)`` bytes only; here the reductions run over
+    the FULL line. Equivalent: they differ only when ``len > width``, and
+    those lines are flagged ``over_long`` regardless."""
+    if n == 0:
+        return EncodedLines(
+            u8=np.zeros((min_rows, pad_to_multiple), dtype=np.uint8),
+            lengths=np.zeros(min_rows, dtype=np.int32),
+            needs_host=np.zeros(min_rows, dtype=bool),
+            n_lines=0,
+        )
+    starts = starts[:n]
+    ends = ends[:n]
+    lengths64 = ends - starts
+    lengths = lengths64.astype(np.int32)
+    width = device_width(lengths, max_line_bytes, pad_to_multiple)
+    rows = _pad_rows(n, min_rows)
+
+    u8 = np.zeros((rows, width), dtype=np.uint8)
+    clamped = np.minimum(lengths64, width)
+    total = int(clamped.sum())
+    if total:
+        # one range-scatter: content byte p of the batch lands at output
+        # cell dest[p] = row(p)*width + offset(p) and reads src[p] =
+        # starts[row(p)] + offset(p). Both decompose into a per-LINE base
+        # repeated over the line's byte count plus one shared arange — two
+        # np.repeat + two adds, no per-byte row-id arithmetic. Indices stay
+        # int32 (halves the memory traffic of these 8-45MB temporaries)
+        # unless the blob or the padded batch overflows int32; chunked so a
+        # 1M-line corpus doesn't hold GB-scale index temporaries at once.
+        out = u8.reshape(-1)
+        cs = np.cumsum(clamped)
+        cum0 = cs - clamped  # exclusive prefix: content start per line
+        idt = (
+            np.int64
+            if max(len(flat), rows * width) > np.iinfo(np.int32).max
+            else np.int32
+        )
+        dest_base = (np.arange(n, dtype=np.int64) * width - cum0).astype(idt)
+        reps = clamped.astype(np.int64)
+        no_clamp = total == int(lengths64.sum())
+        if no_clamp:
+            # no line is truncated, so the content bytes are exactly the
+            # blob minus its separators (and the dropped trailing-empty
+            # region): ONE boolean compress replaces the per-byte source
+            # index construction + gather — ~2× cheaper at 10MB scale
+            keep = np.ones(len(flat), dtype=bool)
+            seps = np.flatnonzero(flat == 0x0A)
+            keep[seps] = False
+            sep_pos = seps[seps > 0]
+            crlf_r = sep_pos[flat[sep_pos - 1] == 0x0D] - 1
+            keep[crlf_r] = False
+            keep[int(ends[-1]) :] = False
+            content = flat[keep]
+        else:
+            src_base = (starts - cum0).astype(idt)
+        chunk_bytes = 16 << 20
+        bounds = np.searchsorted(
+            cs, np.arange(chunk_bytes, total + chunk_bytes, chunk_bytes)
+        )
+        lo = 0
+        for hi in np.minimum(bounds + 1, n).tolist():
+            if hi <= lo:
+                continue
+            base = int(cum0[lo])
+            t = int(cs[hi - 1]) - base
+            pos = np.arange(base, base + t, dtype=idt)
+            dest = np.repeat(dest_base[lo:hi], reps[lo:hi])
+            dest += pos
+            if no_clamp:
+                out[dest] = content[base : base + t]
+            else:
+                src = np.repeat(src_base[lo:hi], reps[lo:hi])
+                src += pos
+                out[dest] = flat[src]
+            lo = hi
+
+    host_flag = np.zeros(rows, dtype=bool)
+    if len(flat):
+        # per-line max/min over [start, end) in one reduceat each: the even
+        # segments are line content, the odd ones separators (discarded).
+        # A sentinel separator byte keeps every index < len and makes the
+        # empty-segment result (flatx[start] — a separator) harmlessly
+        # ASCII/non-NUL; empty lines are masked out anyway.
+        flatx = np.concatenate([flat, np.asarray([0x0A], dtype=np.uint8)])
+        inds = np.empty(2 * n, dtype=np.int64)
+        inds[0::2] = starts
+        inds[1::2] = ends
+        maxs = np.maximum.reduceat(flatx, inds)[0::2]
+        mins = np.minimum.reduceat(flatx, inds)[0::2]
+        host_flag[:n] = (lengths64 > 0) & ((maxs >= 0x80) | (mins == 0))
+
+    over_long = np.zeros(rows, dtype=bool)
+    over_long[:n] = (lengths > width) | (lengths > max_line_bytes)
+
+    full_lengths = np.zeros(rows, dtype=np.int32)
+    full_lengths[:n] = np.minimum(lengths, width)
+    return EncodedLines(
+        u8=u8,
+        lengths=full_lengths,
+        needs_host=host_flag | over_long,
+        n_lines=n,
+    )
+
+
 class Corpus:
     """Sequence-of-lines view over a log blob + its encoded device batch.
 
     Supports ``len``, integer indexing, and slicing (returns list[str]) so
     golden helpers (extract_context) accept it in place of list[str].
+
+    Without the native library the fallback is the numpy-vectorized path
+    above (split + fill + flags, zero per-line Python) — it keeps the same
+    blob/starts/ends backing as the native path, so ``line()`` /
+    ``line_key_bytes()`` stay O(1) slices either way. Only lone-surrogate
+    input (which cannot strict-encode) drops to the per-line scalar path,
+    exactly like the native branch does.
     """
 
     def __init__(
@@ -89,20 +242,26 @@ class Corpus:
         min_rows: int = 8,
     ):
         lib = get_lib()
+        self._lines: list[str] | None = None
         if lib is None:
-            lines = java_split_lines(logs)
-            self._lines: list[str] | None = lines
-            self._blob = None
-            self._starts = self._ends = None
-            self.n_lines = len(lines)
-            self.encoded = encode_lines(
-                lines, max_line_bytes, pad_to_multiple, min_rows
+            try:
+                blob = logs.encode("utf-8")
+            except UnicodeEncodeError:
+                self._scalar_init(logs, max_line_bytes, pad_to_multiple, min_rows)
+                return
+            self._blob = blob
+            flat = np.frombuffer(blob, dtype=np.uint8)
+            starts, ends, n = _split_offsets(flat)
+            self._starts = starts
+            self._ends = ends
+            self.n_lines = n
+            self.encoded = _vectorized_encode(
+                flat, starts, ends, n, max_line_bytes, pad_to_multiple, min_rows
             )
             return
 
         import ctypes
 
-        self._lines = None
         try:
             blob = logs.encode("utf-8")
         except UnicodeEncodeError:
@@ -110,14 +269,7 @@ class Corpus:
             # unpaired) cannot encode — take the pure-Python path, which
             # replaces per line and flags those lines for host re-match so
             # golden's str-level semantics still decide them
-            lines = java_split_lines(logs)
-            self._lines = lines
-            self._blob = None
-            self._starts = self._ends = None
-            self.n_lines = len(lines)
-            self.encoded = encode_lines(
-                lines, max_line_bytes, pad_to_multiple, min_rows
-            )
+            self._scalar_init(logs, max_line_bytes, pad_to_multiple, min_rows)
             return
         self._blob = blob
         # zero-copy view of the bytes object (blob outlives the calls via self)
@@ -174,7 +326,34 @@ class Corpus:
             n_lines=self.n_lines,
         )
 
+    def _scalar_init(
+        self, logs: str, max_line_bytes: int, pad_to_multiple: int, min_rows: int
+    ) -> None:
+        """The per-line scalar path — only for input that cannot
+        strict-encode (lone surrogates): ``line()`` must return the
+        ORIGINAL str so golden re-matching sees the surrogate, not its
+        replacement bytes."""
+        lines = java_split_lines(logs)
+        self._lines = lines
+        self._blob = None
+        self._starts = self._ends = None
+        self.n_lines = len(lines)
+        self.encoded = encode_lines(
+            lines, max_line_bytes, pad_to_multiple, min_rows
+        )
+
     # ------------------------------------------------------------- sequence
+
+    def key_view(self) -> tuple[bytes, np.ndarray, np.ndarray] | None:
+        """``(blob, starts, ends)`` backing byte-exact per-line access —
+        the vectorized keying fast lane (runtime/linecache.py
+        ``dedup_slots``) builds its per-line key material from these
+        without materializing a bytes object per line. None on the
+        scalar-lines path (lone surrogates), where callers must fall back
+        to ``line_key_bytes`` per line."""
+        if self._blob is None:
+            return None
+        return self._blob, self._starts, self._ends
 
     def __len__(self) -> int:
         return self.n_lines
@@ -193,10 +372,10 @@ class Corpus:
 
     def line_key_bytes(self, i: int) -> bytes:
         """Ingest-normalized bytes of line ``i`` — the line-cache key
-        material. Native path: a slice of the already-normalized blob
-        (zero extra passes); Python fallback: the same bytes via the
-        per-line encode (``errors="replace"`` matches
-        :func:`normalize_blob` character-for-character)."""
+        material. Native and vectorized-fallback paths: a slice of the
+        already-normalized blob (zero extra passes); scalar surrogate
+        path: the same bytes via the per-line encode (``errors="replace"``
+        matches :func:`normalize_blob` character-for-character)."""
         if self._lines is not None:
             return self._lines[i].encode("utf-8", errors="replace")
         if not 0 <= i < self.n_lines:

@@ -75,7 +75,11 @@ import numpy as np
 from log_parser_tpu.native.ingest import Corpus
 from log_parser_tpu.ops.encode import _pad_rows
 from log_parser_tpu.runtime import faults
-from log_parser_tpu.runtime.linecache import line_key, records_from_bits
+from log_parser_tpu.runtime.linecache import (
+    dedup_slots,
+    line_key,
+    records_from_bits,
+)
 from log_parser_tpu.utils.trace import PhaseTrace
 
 if TYPE_CHECKING:  # import cycle: engine imports nothing from here at boot
@@ -432,36 +436,59 @@ class MicroBatcher:
         actually contributed a residual row — a request served wholly
         from cache can never strike quarantine."""
         engine = self.engine
-        # flush-global unique map (content bytes -> slot), then one hash
-        # per unique line. Per unique slot: the (item, line) the encode
+        # per-item array-speed dedup (linecache.dedup_slots), then merge
+        # at the UNIQUE level into a flush-global map keyed by digest —
+        # the cache keys on digests already, so digest identity IS line
+        # identity here. Per unique slot: the (item, line) the encode
         # would be sliced from; prefer a non-needs_host appearance — a
         # truncated/replaced encode is width-dependent and must neither
-        # populate the cache nor serve another item's clean line.
-        slot_of: dict[bytes, int] = {}
+        # populate the cache nor serve another item's clean line. Within
+        # one item duplicate content shares one verdict (same bytes, same
+        # width), so the item-local representative is exact.
+        slot_of: dict[bytes, int] = {}  # digest -> flush-global slot
         uniq_src: list[tuple[int, int]] = []
+        keys: list[bytes] = []  # digest per slot; insertion == slot order
         per_item: list[np.ndarray] = []  # per item: line index -> slot
         for r, item in enumerate(items):
             corpus = item.corpus
             enc = corpus.encoded
-            ls = np.empty(corpus.n_lines, dtype=np.int64)
-            for i in range(corpus.n_lines):
-                lb = corpus.line_key_bytes(i)
-                s = slot_of.get(lb)
-                if s is None:
-                    s = len(uniq_src)
-                    slot_of[lb] = s
+            ded = dedup_slots(corpus)
+            if ded is None:
+                # lone-surrogate corpus: no contiguous byte view — build
+                # the item-local unique set with the per-line dict loop
+                local_of: dict[bytes, int] = {}
+                reps: list[int] = []
+                ls = np.empty(corpus.n_lines, dtype=np.int64)
+                for i in range(corpus.n_lines):
+                    lb = corpus.line_key_bytes(i)
+                    s = local_of.get(lb)
+                    if s is None:
+                        s = len(reps)
+                        local_of[lb] = s
+                        reps.append(i)
+                    ls[i] = s
+                local_keys = [line_key(lb) for lb in local_of]
+            else:
+                ls, rep_arr, local_keys, _ = ded
+                reps = rep_arr.tolist()
+            g_of_local = np.empty(max(len(reps), 1), dtype=np.int64)
+            for s_local, (k, i) in enumerate(zip(local_keys, reps)):
+                g = slot_of.get(k)
+                if g is None:
+                    g = len(uniq_src)
+                    slot_of[k] = g
                     uniq_src.append((r, i))
+                    keys.append(k)
                 else:
-                    sr, si = uniq_src[s]
+                    sr, si = uniq_src[g]
                     if (
                         items[sr].corpus.encoded.needs_host[si]
                         and not enc.needs_host[i]
                     ):
-                        uniq_src[s] = (r, i)
-                ls[i] = s
-            per_item.append(ls)
+                        uniq_src[g] = (r, i)
+                g_of_local[s_local] = g
+            per_item.append(g_of_local[ls] if len(ls) else ls)
         U = len(uniq_src)
-        keys = [line_key(lb) for lb in slot_of]  # insertion == slot order
         all_slots = (
             np.concatenate(per_item) if per_item else np.zeros(0, dtype=np.int64)
         )

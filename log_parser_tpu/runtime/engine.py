@@ -55,6 +55,7 @@ from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.linecache import (
     DEFAULT_LINE_CACHE_MB,
     LineCache,
+    dedup_slots,
     line_key,
     records_from_bits,
 )
@@ -1329,20 +1330,34 @@ class AnalysisEngine:
             # request duplicate content always shares one needs_host
             # verdict (same bytes, same device width), so slot-level
             # bookkeeping indexed at the first appearance is exact.
-            slot_of: dict[bytes, int] = {}
-            uniq_lines: list[int] = []
-            line_slot = np.empty(n, dtype=np.int64)
-            for i in range(n):
-                lb = corpus.line_key_bytes(i)
-                s = slot_of.get(lb)
-                if s is None:
-                    s = len(uniq_lines)
-                    slot_of[lb] = s
-                    uniq_lines.append(i)
-                line_slot[i] = s
-            U = len(uniq_lines)
-            keys = [line_key(lb) for lb in slot_of]  # insertion == slot order
-            counts = np.bincount(line_slot, minlength=max(U, 1))
+            ded = dedup_slots(corpus)
+            if ded is not None:
+                # array-speed lane: lexsort grouping over the contiguous
+                # byte view (same first-appearance slot order, same
+                # digests — linecache.dedup_slots pins the parity)
+                line_slot, rep_lines, keys, counts = ded
+                uniq_lines = rep_lines.tolist()
+                U = len(uniq_lines)
+                counts = (
+                    counts if U else np.zeros(1, dtype=np.int64)
+                )
+            else:
+                # lone-surrogate corpora have no contiguous byte view —
+                # keep the per-line dict loop
+                slot_of: dict[bytes, int] = {}
+                uniq_lines = []
+                line_slot = np.empty(n, dtype=np.int64)
+                for i in range(n):
+                    lb = corpus.line_key_bytes(i)
+                    s = slot_of.get(lb)
+                    if s is None:
+                        s = len(uniq_lines)
+                        slot_of[lb] = s
+                        uniq_lines.append(i)
+                    line_slot[i] = s
+                U = len(uniq_lines)
+                keys = [line_key(lb) for lb in slot_of]  # insertion == slot order
+                counts = np.bincount(line_slot, minlength=max(U, 1))
             packed = cache.lookup_packed(keys, counts=counts.tolist())
             miss_slots = [s for s in range(U) if packed[s] is None]
 
@@ -1442,24 +1457,34 @@ class AnalysisEngine:
 
         # record this batch's matches (after the read — ScoringService.java:84-88);
         # bulk per slot: one list extend instead of count Python calls
-        # inside the only lock every concurrent request shares
-        for slot, count in enumerate(fin.slot_batch_counts[: self.bank.n_freq_slots]):
+        # inside the only lock every concurrent request shares. Zero-count
+        # slots are skipped wholesale: record_pattern_matches(pid, 0)
+        # early-returns without creating an entry, so on hit-heavy traffic
+        # (few matched patterns per batch) this touches matched slots only
+        sbc = np.asarray(fin.slot_batch_counts[: self.bank.n_freq_slots])
+        for slot in np.flatnonzero(sbc).tolist():
             self.frequency.record_pattern_matches(
-                self.bank.freq_ids[slot], int(count)
+                self.bank.freq_ids[slot], int(sbc[slot])
             )
 
         # records are already in discovery order (line-major, then pattern)
         with trace.phase("assemble"):
+            # one bulk ndarray→Python conversion per column instead of
+            # three per-element __getitem__/int()/float() calls per event
+            # (``.tolist()`` yields the same Python ints/floats those
+            # casts produce, element for element)
             events: list[MatchedEvent] = []
-            for i in range(len(fin.scores)):
-                line_idx = int(fin.line[i])
-                pattern = self.bank.patterns[int(fin.pattern[i])]
+            patterns = self.bank.patterns
+            for line_idx, pat_i, score in zip(
+                fin.line.tolist(), fin.pattern.tolist(), fin.scores.tolist()
+            ):
+                pattern = patterns[pat_i]
                 events.append(
                     MatchedEvent(
                         line_number=line_idx + 1,
                         matched_pattern=pattern,
                         context=extract_context(corpus, line_idx, pattern),
-                        score=float(fin.scores[i]),
+                        score=score,
                     )
                 )
 

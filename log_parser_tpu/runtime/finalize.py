@@ -61,22 +61,39 @@ class FinalizedBatch:
         """One dict per match, JSON-ready. ``score`` = confidence ×
         severityMultiplier × chronological × proximity × temporal × context
         × (1 − frequencyPenalty), exactly (ScoringService.java:102-109)."""
+        # bulk ndarray→Python conversion: per-column .tolist() (and one
+        # fancy-index gather for the per-pattern columns) instead of ~10
+        # scalar __getitem__ + int()/float() casts per match. ``.tolist()``
+        # yields exactly the Python ints/floats the scalar casts produce.
+        pat = np.asarray(self.pattern, dtype=np.int64)
+        pat_l = pat.tolist()
+        pids = [bank.patterns[p].id for p in pat_l]
+        cols = zip(
+            self.line.tolist(),
+            pids,
+            np.asarray(bank.confidence, dtype=np.float64)[pat].tolist(),
+            np.asarray(bank.severity_multiplier, dtype=np.float64)[pat].tolist(),
+            self.chronological.tolist(),
+            self.proximity.tolist(),
+            self.temporal.tolist(),
+            self.context.tolist(),
+            self.frequency_penalty.tolist(),
+            self.scores.tolist(),
+        )
         return [
             {
-                "lineNumber": int(self.line[i]) + 1,
-                "patternId": bank.patterns[int(self.pattern[i])].id,
-                "confidence": float(bank.confidence[int(self.pattern[i])]),
-                "severityMultiplier": float(
-                    bank.severity_multiplier[int(self.pattern[i])]
-                ),
-                "chronological": float(self.chronological[i]),
-                "proximity": float(self.proximity[i]),
-                "temporal": float(self.temporal[i]),
-                "context": float(self.context[i]),
-                "frequencyPenalty": float(self.frequency_penalty[i]),
-                "score": float(self.scores[i]),
+                "lineNumber": ln + 1,
+                "patternId": pid,
+                "confidence": conf,
+                "severityMultiplier": sev,
+                "chronological": chrono,
+                "proximity": prox,
+                "temporal": temp,
+                "context": ctx,
+                "frequencyPenalty": fp,
+                "score": sc,
             }
-            for i in range(len(self.scores))
+            for ln, pid, conf, sev, chrono, prox, temp, ctx, fp, sc in cols
         ]
 
 

@@ -81,6 +81,99 @@ def line_key(line_bytes: bytes) -> bytes:
     return hashlib.blake2b(line_bytes, digest_size=16).digest()
 
 
+def dedup_slots(
+    corpus,
+) -> tuple[np.ndarray, np.ndarray, list[bytes], np.ndarray] | None:
+    """Vectorized request-level dedup: unique lines and the line→slot
+    fan-in in array speed instead of a per-line dict loop.
+
+    Returns ``(line_slot, rep_lines, keys, counts)`` where slots are
+    numbered by first appearance (bit-compatible with the scalar dict
+    loop it replaces), ``rep_lines[s]`` is the first line index of slot
+    ``s``, ``keys[s]`` its :func:`line_key` digest and ``counts[s]`` its
+    multiplicity. Returns ``None`` when the corpus has no contiguous
+    byte view (the lone-surrogate scalar path) — callers keep the dict
+    loop there.
+
+    Exactness: the comparison key is the encoded ``[width]`` u8 row
+    concatenated with the true byte length. For lines that fit the
+    device width the row IS the content (zero-padding is disambiguated
+    by the length word: equal lengths + equal prefix ⇒ equal bytes).
+    Lines longer than the width are ambiguous under truncation, so they
+    are re-grouped exactly on their blob slices — they can never collide
+    with a short line (lengths differ) and are rare by construction
+    (device_width covers the 99.5% quantile, ops/encode.py).
+    """
+    kv = corpus.key_view()
+    if kv is None:
+        return None
+    blob, starts, ends = kv
+    enc = corpus.encoded
+    n = int(enc.n_lines)
+    if n == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, [], z
+    # the offset arrays may carry dropped trailing-empty parts past n
+    starts = starts[:n]
+    ends = ends[:n]
+    width = enc.u8.shape[1]
+    lengths = (ends - starts).astype(np.int64)
+    # key row = u8 content ‖ true length, padded to an int64 boundary so
+    # the grouping sort runs over a handful of int64 columns (a memcmp
+    # sort over void rows is ~1.5× slower at this shape)
+    kw = -(-(width + 8) // 8) * 8
+    km = np.zeros((n, kw), dtype=np.uint8)
+    km[:, :width] = enc.u8[:n]
+    km[:, width : width + 8] = lengths.astype("<i8").reshape(n, 1).view(np.uint8)
+    v64 = km.view("<i8")
+    order = np.lexsort(v64.T[::-1])
+    srt = v64[order]
+    newrun = np.empty(n, dtype=bool)
+    newrun[0] = True
+    np.any(srt[1:] != srt[:-1], axis=1, out=newrun[1:])
+    gid_sorted = np.cumsum(newrun) - 1
+    group = np.empty(n, dtype=np.int64)
+    group[order] = gid_sorted
+    # lexsort is stable, so the first member of each run is the group's
+    # first appearance in line order
+    first_idx = order[np.flatnonzero(newrun)]
+    long_lines = np.flatnonzero(lengths > width)
+    if long_lines.size:
+        next_gid = int(first_idx.size)
+        exact: dict[bytes, int] = {}
+        s_l = starts.tolist()
+        e_l = ends.tolist()
+        for i in long_lines.tolist():
+            content = blob[s_l[i] : e_l[i]]
+            gid = exact.get(content)
+            if gid is None:
+                gid = next_gid
+                next_gid += 1
+                exact[content] = gid
+            group[i] = gid
+        # regrouping may have emptied gids and appended new ones: rebuild
+        # first-occurrence indices the general way
+        uniq_g, first = np.unique(group, return_index=True)
+        ord2 = np.argsort(first, kind="stable")
+        remap = np.empty(uniq_g.size, dtype=np.int64)
+        remap[ord2] = np.arange(uniq_g.size)
+        line_slot = remap[np.searchsorted(uniq_g, group)]
+        rep_lines = first[ord2]
+    else:
+        # renumber groups by first appearance so slot order matches the
+        # scalar dict loop byte-for-byte
+        ord2 = np.argsort(first_idx, kind="stable")
+        remap = np.empty(first_idx.size, dtype=np.int64)
+        remap[ord2] = np.arange(first_idx.size)
+        line_slot = remap[group]
+        rep_lines = first_idx[ord2]
+    s_l = starts[rep_lines].tolist()
+    e_l = ends[rep_lines].tolist()
+    keys = [line_key(blob[a:b]) for a, b in zip(s_l, e_l)]
+    counts = np.bincount(line_slot, minlength=rep_lines.size)
+    return line_slot, rep_lines, keys, counts
+
+
 class LineCache:
     """Bounded LRU of per-line pre-override match-bit rows.
 

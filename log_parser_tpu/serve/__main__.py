@@ -196,6 +196,14 @@ def main(argv: list[str] | None = None) -> int:
         "a structured 409; default warn (LOG_PARSER_TPU_LINT_PATTERNS)",
     )
     parser.add_argument(
+        "--compile-cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory: warm restarts "
+        "replay compiles from disk instead of re-running XLA "
+        "(utils/xlacache.py; default on at "
+        "~/.cache/log_parser_tpu/xla-cache, '0' disables; "
+        "LOG_PARSER_TPU_XLA_CACHE)",
+    )
+    parser.add_argument(
         "--pallas-dfa", default=None, choices=("on", "off"),
         help="route the union multi-DFA tier through the Pallas scan "
         "kernel (ops/matchdfa_pallas.py); bit-identical to the XLA scan, "
@@ -234,6 +242,7 @@ def main(argv: list[str] | None = None) -> int:
         (args.snapshot_every, "LOG_PARSER_TPU_SNAPSHOT_EVERY"),
         (args.watch_patterns, "LOG_PARSER_TPU_WATCH_PATTERNS"),
         (args.lint_patterns, "LOG_PARSER_TPU_LINT_PATTERNS"),
+        (args.compile_cache_dir, "LOG_PARSER_TPU_XLA_CACHE"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)

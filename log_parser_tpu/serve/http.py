@@ -41,8 +41,10 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from log_parser_tpu import native
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.runtime import faults
+from log_parser_tpu.utils import xlacache
 from log_parser_tpu.runtime.engine import AnalysisEngine
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
@@ -321,6 +323,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # follow-mode session counters (docs/OPS.md "Streaming
                 # follow-mode")
                 payload["stream"] = stream_mgr.stats()
+            # which ingest path this process runs, and why the native
+            # scanner refused to load when it did (docs/OPS.md "Which
+            # ingest am I running?")
+            payload["native"] = native.stats()
+            # persistent XLA compile cache wiring + hit/miss tally
+            # (docs/OPS.md "Compile cache")
+            payload["compileCache"] = xlacache.stats()
             # poison-request ledger (docs/OPS.md "Poison-request triage")
             payload["quarantine"] = self.server.engine.quarantine.stats()
             shadow = getattr(self.server.engine, "shadow", None)

@@ -42,6 +42,33 @@ import os
 log = logging.getLogger(__name__)
 
 _configured = False
+# process-lifetime counters fed by JAX's monitoring events (registered in
+# enable_persistent_cache); surfaced at GET /trace/last "compileCache"
+# (docs/OPS.md) and in the bench artifact's boot story
+_cache_dir: str | None = None
+_hits = 0
+_requests = 0
+_listener_registered = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    global _hits, _requests
+    if event == "/jax/compilation_cache/cache_hits":
+        _hits += 1
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        _requests += 1
+
+
+def stats() -> dict:
+    """GET /trace/last ``compileCache`` block (docs/OPS.md): whether the
+    persistent cache is wired, where, and this process's hit/miss tally
+    (misses = cacheable compile requests that went to XLA)."""
+    return {
+        "dir": _cache_dir,
+        "enabled": _cache_dir is not None,
+        "compileHits": _hits,
+        "compileMisses": max(0, _requests - _hits),
+    }
 
 
 def verify_cache_integrity(path: str) -> dict[str, int]:
@@ -121,6 +148,7 @@ def enable_persistent_cache() -> None:
             os.path.expanduser("~"), ".cache", "log_parser_tpu", "xla-cache"
         )
     )
+    global _cache_dir, _listener_registered
     try:
         import jax
 
@@ -132,5 +160,12 @@ def enable_persistent_cache() -> None:
         # paths (JAX's defaults skip sub-second compiles)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        if not _listener_registered:
+            # hit/miss telemetry rides JAX's own monitoring events — the
+            # compiler records one event per cacheable compile request
+            # and one per disk hit (jax/_src/compiler.py)
+            jax.monitoring.register_event_listener(_on_event)
+            _listener_registered = True
+        _cache_dir = path
     except Exception as exc:  # pragma: no cover - cache is best-effort
         log.info("persistent XLA cache unavailable: %s", exc)

@@ -456,9 +456,15 @@ class TestKeyStability:
         native_corpus = Corpus(logs)
         monkeypatch.setattr(ingest_mod, "get_lib", lambda: None)
         fallback_corpus = Corpus(logs)
-        assert fallback_corpus._lines is not None  # really the fallback
+        # the vectorized fallback is blob-backed like the native path;
+        # only the lone-surrogate scalar path keeps materialized strings
+        assert fallback_corpus._blob is not None
         for i in range(native_corpus.n_lines):
             assert native_corpus.line_key_bytes(i) == fallback_corpus.line_key_bytes(i)
+        # surrogate corpora take the scalar path and still agree per line
+        scalar_corpus = Corpus("INFO a\n\ud800INFO b")
+        assert scalar_corpus._lines is not None
+        assert scalar_corpus.line_key_bytes(0) == b"INFO a"
 
 
 # ----------------------------------------------------------- concurrency

@@ -46,6 +46,15 @@ run() { # run <artifact-stem> <cmd...>
 
 run "config2_${platform}"          python bench.py
 run "config2_hostcol_${platform}"  python bench.py --host-col
+# repeat-heavy cache-on/cache-off pair (BENCH_r10 headline shape): the
+# routing-tier aggregate the vectorized host path is meant to raise
+run "config2_rr90_lc64_${platform}" python bench.py --repeat-ratio 0.9 --line-cache-mb 64
+run "config2_rr90_${platform}"      python bench.py --repeat-ratio 0.9
+# host-phase profile (tools/profile_host.py): ingest/key/extract/
+# assemble/finalize in isolation, scalar vs vectorized lanes — the
+# PERF.md §14 phase table is read from these artifacts
+run "profile_host_${platform}"      python tools/profile_host.py
+run "profile_host_rr90_${platform}" python tools/profile_host.py --repeat-ratio 0.9
 run "config3_1m_singlechip_${platform}" python bench.py --lines 1000000
 # the full sharded DP program at corpus scale on the virtual 8-device
 # mesh. Runs on EVERY refresh round (bench_mesh.py pins itself to the
