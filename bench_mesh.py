@@ -17,6 +17,18 @@ chip), so this harness runs the FULL sharded step in one of two modes:
 Single-chip per-chip throughput rides in ``bench_results/config2_tpu``;
 the v5e-8 projection from it is documented in PERF.md §8.
 
+``--tenants N`` switches to the multi-tenant placement scenario
+(parallel/pattern_sharded.py TenantPlacement): N disjoint tenant engines
+round-robined across the mesh, interleaved round-robin traffic, metric
+``tenant_mesh_lines_per_sec``. Same virtual/real mode semantics.
+
+``--tenants N --tenant-residency`` instead drives N tenants through a
+``runtime/tenancy.py`` TenantRegistry whose byte budget is auto-sized to
+hold only N-1 banks (override with ``--tenant-budget-mb``), so the
+interleaved round-robin pays LRU evict + warm rebuild inline — metric
+``tenant_fleet_lines_per_sec``, the churn-inclusive fleet figure an
+operator sees when the tenant set outgrows ``--tenant-budget-mb``.
+
 Prints exactly one JSON line like every bench:
     {"metric": "dp_mesh_lines_per_sec", "value": N, "unit": "lines/s",
      "vs_baseline": value / 1e6, "platform": ..., ...}
@@ -44,6 +56,17 @@ N_LINES = (
 # concat — under it, not a bare x8).  At mesh=1 on a real chip the ratio
 # isolates program-structure overhead with zero real communication.
 OVERHEAD = "--overhead" in sys.argv
+N_TENANTS = (
+    int(sys.argv[sys.argv.index("--tenants") + 1])
+    if "--tenants" in sys.argv
+    else 0
+)
+RESIDENCY = "--tenant-residency" in sys.argv
+BUDGET_MB = (
+    float(sys.argv[sys.argv.index("--tenant-budget-mb") + 1])
+    if "--tenant-budget-mb" in sys.argv
+    else 0.0
+)
 MODE = os.environ.get("LOG_PARSER_TPU_MESH", "virtual")
 if MODE not in ("virtual", "real"):
     # a typo like "Virtual" must not silently select the real path
@@ -73,7 +96,204 @@ from bench import build_corpus  # noqa: E402  (same corpus as config 2)
 NORTH_STAR_LINES_PER_SEC = 1_000_000.0
 
 
+def tenant_main() -> None:
+    """Multi-tenant placement scenario: disjoint per-tenant banks pinned
+    round-robin across the mesh, interleaved round-robin traffic. Measures
+    AGGREGATE lines/s across all tenants — the fleet-serving figure, not a
+    per-tenant one."""
+    metric = "tenant_mesh_lines_per_sec"
+    platform = f"{'cpu-virtual' if MODE == 'virtual' else 'real'}-mesh{N_DEVICES}"
+    bounded = bench_common.bounded_runner(metric, "lines/s", lambda: platform)
+
+    visible_devices = 0
+    placements: dict = {}
+
+    def setup():
+        nonlocal platform, visible_devices
+        import jax
+
+        if MODE == "virtual":
+            jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+        visible_devices = len(devices)
+        if MODE == "real":
+            platform = f"{devices[0].platform}-mesh{N_DEVICES}"
+        if len(devices) < N_DEVICES:
+            bench_common.exit_null(
+                metric,
+                "lines/s",
+                platform,
+                f"need {N_DEVICES} devices, found {len(devices)} on "
+                f"{devices[0].platform}",
+            )
+
+        from log_parser_tpu.config import ScoringConfig
+        from log_parser_tpu.parallel import TenantPlacement
+        from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+        from log_parser_tpu.runtime import AnalysisEngine
+
+        placement = TenantPlacement(devices[:N_DEVICES])
+        engines = []
+        for t in range(N_TENANTS):
+            eng = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+            engines.append(placement.assign(eng, f"tenant{t}"))
+        placements.update(placement.stats()["placements"])
+        return engines
+
+    engines = bounded(setup, bench_common.PROBE_TIMEOUT_S, "device init")
+
+    from log_parser_tpu.models.pod import PodFailureData
+
+    per_tenant = max(1, N_LINES // N_TENANTS)
+    corpus = build_corpus(per_tenant)
+    datas = [
+        PodFailureData(
+            pod={"metadata": {"name": f"bench-tenant{t}"}}, logs=corpus
+        )
+        for t in range(N_TENANTS)
+    ]
+
+    def sweep():
+        result = None
+        # interleaved round-robin: each tenant's request runs on its own
+        # pinned device; on a real mesh the async dispatches overlap
+        for eng, data in zip(engines, datas):
+            result = eng.analyze(data)
+        return result
+
+    result, _, dt = bench_common.measured_phase(bounded, sweep)
+    assert result.summary.significant_events > 0
+    total = per_tenant * N_TENANTS
+    rate = total / dt
+
+    bench_common.emit(
+        metric,
+        round(rate, 1),
+        "lines/s",
+        round(rate / NORTH_STAR_LINES_PER_SEC, 4),
+        platform,
+        n_lines=total,
+        n_devices=N_DEVICES,
+        visible_devices=visible_devices,
+        mode=MODE,
+        n_tenants=N_TENANTS,
+        placements=placements,
+        n_events=result.summary.significant_events,
+    )
+
+
+def tenant_residency_main() -> None:
+    """Fleet-serving residency scenario: N tenant banks interleaved
+    round-robin through a TenantRegistry whose byte budget holds only
+    N-1 of them, so steady-state traffic pays LRU evict + warm rebuild
+    inline (every resolve of the round-robin tail evicts the head).
+    Measures AGGREGATE lines/s INCLUDING that churn — the worst-case
+    figure an operator sees when the tenant set outgrows
+    ``--tenant-budget-mb`` by one bank."""
+    import shutil
+    import tempfile
+
+    metric = "tenant_fleet_lines_per_sec"
+    platform = "cpu" if MODE == "virtual" else "real"
+    bounded = bench_common.bounded_runner(metric, "lines/s", lambda: platform)
+
+    state: dict = {}
+
+    def setup():
+        nonlocal platform
+        import jax
+
+        if MODE == "virtual":
+            jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
+
+        from log_parser_tpu.config import ScoringConfig
+        from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+        from log_parser_tpu.runtime import AnalysisEngine
+        from log_parser_tpu.runtime.tenancy import TenantRegistry
+
+        builtin_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "log_parser_tpu", "patterns", "builtin",
+        )
+        root = tempfile.mkdtemp(prefix="bench-tenants-")
+        for t in range(N_TENANTS):
+            shutil.copytree(builtin_dir, os.path.join(root, f"tenant{t}"))
+        default_engine = AnalysisEngine(
+            load_builtin_pattern_sets(), ScoringConfig()
+        )
+        # probe one bank (unlimited budget) to size the real budget at
+        # N-1 banks + half, guaranteeing churn without instant thrash of
+        # the tenant that was just resolved
+        probe = TenantRegistry(default_engine, root=root)
+        bank_mb = probe.resolve("tenant0").bank_bytes / 2**20
+        probe.shutdown()
+        budget_mb = BUDGET_MB or (N_TENANTS - 1 + 0.5) * bank_mb
+        reg = TenantRegistry(default_engine, root=root, budget_mb=budget_mb)
+        state["registry"] = reg
+        state["bank_mb"] = bank_mb
+        return reg
+
+    reg = bounded(setup, bench_common.PROBE_TIMEOUT_S, "device init")
+
+    from log_parser_tpu.models.pod import PodFailureData
+
+    per_tenant = max(1, N_LINES // N_TENANTS)
+    corpus = build_corpus(per_tenant)
+    datas = [
+        PodFailureData(
+            pod={"metadata": {"name": f"bench-tenant{t}"}}, logs=corpus
+        )
+        for t in range(N_TENANTS)
+    ]
+
+    def sweep():
+        result = None
+        # each resolve may evict the LRU tenant and rebuild the target's
+        # bank (warm through the compiled-DFA snapshot cache) before the
+        # request runs — churn is part of the measured figure on purpose
+        for t, data in enumerate(datas):
+            ctx = reg.resolve(f"tenant{t}")
+            result = ctx.engine.analyze(data)
+        return result
+
+    result, _, dt = bench_common.measured_phase(bounded, sweep)
+    assert result.summary.significant_events > 0
+    stats = reg.stats()
+    assert stats["evicted"] >= 1 and stats["rebuilds"] >= 1, (
+        "residency scenario must churn: " + repr(stats)
+    )
+    total = per_tenant * N_TENANTS
+    rate = total / dt
+
+    bench_common.emit(
+        metric,
+        round(rate, 1),
+        "lines/s",
+        round(rate / NORTH_STAR_LINES_PER_SEC, 4),
+        platform,
+        n_lines=total,
+        mode=MODE,
+        n_tenants=N_TENANTS,
+        bank_mb=round(state["bank_mb"], 3),
+        budget_mb=round(stats["budgetMb"], 3),
+        resident_tenants=stats["residentTenants"],
+        resident_bank_mb=stats["residentBankMb"],
+        resolved=stats["resolved"],
+        created=stats["created"],
+        evicted=stats["evicted"],
+        rebuilds=stats["rebuilds"],
+        n_events=result.summary.significant_events,
+    )
+
+
 def main() -> None:
+    if N_TENANTS and (RESIDENCY or BUDGET_MB):
+        tenant_residency_main()
+        return
+    if N_TENANTS:
+        tenant_main()
+        return
     metric = "dp_mesh_lines_per_sec"
     platform = f"{'cpu-virtual' if MODE == 'virtual' else 'real'}-mesh{N_DEVICES}"
 

@@ -23,12 +23,18 @@ with the coordinator broadcasting requests (imported lazily — it pulls in
 """
 
 from log_parser_tpu.parallel.mesh import make_mesh
-from log_parser_tpu.parallel.pattern_sharded import PatternShardedEngine
+from log_parser_tpu.parallel.pattern_sharded import (
+    PatternShardedEngine,
+    TenantPlacement,
+    pin_engine,
+)
 from log_parser_tpu.parallel.sharded import ShardedEngine, ShardedFusedStep
 
 __all__ = [
     "PatternShardedEngine",
     "ShardedEngine",
     "ShardedFusedStep",
+    "TenantPlacement",
     "make_mesh",
+    "pin_engine",
 ]

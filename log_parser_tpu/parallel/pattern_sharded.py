@@ -19,6 +19,13 @@ tensor-parallel trade for never materializing a [lines × 10k-pattern]
 cube on one chip.
 
 Composes with line sharding: a 2D fleet runs this engine per line shard.
+
+Tenant placement (multi-tenant fleets, runtime/tenancy.py) is the third
+partitioning axis: each tenant's bank is DISJOINT, so there is nothing to
+merge — :class:`TenantPlacement` round-robins whole tenant engines across
+the visible chips and pins each engine's device step there. One tenant's
+traffic then never contends for another tenant's chip, and a tenant bank
+rebuild recompiles only on its own device.
 """
 
 from __future__ import annotations
@@ -245,3 +252,59 @@ class PatternShardedEngine(AnalysisEngine):
             seq_ok=seq[order],
             ctx_counts=ctx[order],
         )
+
+
+def pin_engine(engine: AnalysisEngine, device) -> AnalysisEngine:
+    """Pin one engine's device step to ``device``: every fused dispatch
+    (and its compilation cache) lands on that chip via
+    ``jax.default_device``, while host phases (ingest, finalize, events)
+    stay wherever the caller runs them. Idempotent re-pin: wraps the
+    CURRENT step, so pinning twice just narrows to the newer device."""
+    inner = engine._run_device
+
+    def pinned(enc, n_lines, om, ov):
+        with jax.default_device(device):
+            return inner(enc, n_lines, om, ov)
+
+    engine._run_device = pinned
+    engine.placement_device = device
+    return engine
+
+
+class TenantPlacement:
+    """Tenant-placement mode: disjoint per-tenant banks, one chip each.
+
+    Unlike the pattern blocks above, tenant banks share NOTHING — no
+    merge, no global index rewrite — so placement is pure scheduling:
+    round-robin each new tenant engine onto the next device and pin its
+    device step there. The ``assign`` method matches the
+    ``engine_setup(engine, tenant_id)`` hook of
+    :class:`~log_parser_tpu.runtime.tenancy.TenantRegistry`, so a serving
+    fleet opts in with ``engine_setup=placement.assign`` (composed after
+    any per-tenant cache/batcher setup). ``bench_mesh.py --tenants N``
+    drives this mode end-to-end on a virtual or real mesh.
+    """
+
+    def __init__(self, devices: list | None = None):
+        self.devices = list(devices) if devices is not None else jax.devices()
+        if not self.devices:
+            raise ValueError("TenantPlacement needs at least one device")
+        self.assignments: dict[str, object] = {}
+        self._next = 0
+
+    def assign(self, engine: AnalysisEngine, tenant_id: str) -> AnalysisEngine:
+        """Place ``engine`` on the next device (round-robin). A tenant
+        re-assigned after eviction+rebuild lands back on ITS device, not
+        the rotation's next one — placement stays stable under churn."""
+        device = self.assignments.get(str(tenant_id))
+        if device is None:
+            device = self.devices[self._next % len(self.devices)]
+            self._next += 1
+            self.assignments[str(tenant_id)] = device
+        return pin_engine(engine, device)
+
+    def stats(self) -> dict:
+        return {
+            "devices": len(self.devices),
+            "placements": {t: str(d) for t, d in self.assignments.items()},
+        }

@@ -452,7 +452,7 @@ class MicroBatcher:
         for r, item in enumerate(items):
             corpus = item.corpus
             enc = corpus.encoded
-            ded = dedup_slots(corpus)
+            ded = dedup_slots(corpus, interner=engine.key_interner)
             if ded is None:
                 # lone-surrogate corpus: no contiguous byte view — build
                 # the item-local unique set with the per-line dict loop

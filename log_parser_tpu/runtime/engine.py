@@ -54,6 +54,7 @@ from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.linecache import (
     DEFAULT_LINE_CACHE_MB,
+    KeyInterner,
     LineCache,
     dedup_slots,
     line_key,
@@ -485,6 +486,7 @@ class AnalysisEngine:
         # exact-match line cache (runtime/linecache.py): None until
         # enable_line_cache() — repeat lines then skip the match cube
         self.line_cache = None
+        self.key_interner = None
         # poison-request quarantine (runtime/quarantine.py): organic
         # device failures strike the request's fingerprint; at the
         # threshold repeats route straight to golden until TTL expiry
@@ -1115,6 +1117,10 @@ class AnalysisEngine:
         self.line_cache = LineCache(
             self.bank.n_columns, int(float(mb) * 1024 * 1024)
         )
+        # two-level keying rides along: repeat lines resolve their
+        # digest by vectorized probe + memcmp instead of blake2b
+        # (content-pure, so reloads/breaker trips never touch it)
+        self.key_interner = KeyInterner()
         return self.line_cache
 
     def enable_shadow(self, rate: float, seed: int | None = None):
@@ -1330,7 +1336,7 @@ class AnalysisEngine:
             # request duplicate content always shares one needs_host
             # verdict (same bytes, same device width), so slot-level
             # bookkeeping indexed at the first appearance is exact.
-            ded = dedup_slots(corpus)
+            ded = dedup_slots(corpus, interner=self.key_interner)
             if ded is not None:
                 # array-speed lane: lexsort grouping over the contiguous
                 # byte view (same first-appearance slot order, same

@@ -81,8 +81,227 @@ def line_key(line_bytes: bytes) -> bytes:
     return hashlib.blake2b(line_bytes, digest_size=16).digest()
 
 
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def probe64(v64: np.ndarray, lengths: np.ndarray, width: int) -> np.ndarray:
+    """Vectorized 64-bit probe over :func:`dedup_slots`' int64 key-matrix
+    rows: an FNV-1a fold of each row's content-carrying words plus its
+    length, splitmix64-finalized. Width-independent for lines that fit
+    the device width (the padding past a line's last partial word is
+    zeros at every width, and padded-only words are skipped), so the
+    same line yields the same probe across requests with different
+    batch widths — the property the cross-request :class:`KeyInterner`
+    needs. Lines longer than ``width`` hash their truncated prefix; the
+    interner's memcmp verify keeps them exact (they land in collision
+    buckets instead of sharing an entry)."""
+    n = v64.shape[0]
+    wc_total = width // 8
+    u = v64[:, :wc_total].view(np.uint64)
+    # words that carry content; the fold skips the all-padding tail so
+    # probes do not depend on this batch's padded width
+    nw = np.minimum(-(-lengths // 8), wc_total)
+    h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
+    max_w = int(nw.max()) if n else 0
+    for j in range(max_w):
+        h = np.where(nw > j, (h ^ u[:, j]) * _FNV_PRIME, h)
+    h = (h ^ lengths.astype(np.uint64)) * _FNV_PRIME
+    h ^= h >> np.uint64(30)
+    h *= np.uint64(0xBF58476D1CE4E5B9)
+    h ^= h >> np.uint64(27)
+    h *= np.uint64(0x94D049BB133111EB)
+    h ^= h >> np.uint64(31)
+    return h
+
+
+DEFAULT_INTERNER_MB = 32.0
+
+# interned-content ceiling: 64 words = 512 bytes covers essentially every
+# real log line (device_width already sits at the 99.5% length quantile);
+# longer lines simply keep paying blake2b — exactness never depends on
+# the ceiling
+_INTERN_WORDS = 64
+# fixed per-entry cost: words row + probe + length + recency stamp +
+# digest bytes object + ndarray slot overheads
+_INTERN_ENTRY_BYTES = _INTERN_WORDS * 8 + 8 + 8 + 8 + 16 + _ENTRY_OVERHEAD
+
+
+class KeyInterner:
+    """Two-level cache keying (PERF.md §15): the per-unique-line
+    blake2b-128 fan-in is the keying lane's floor once ingest is
+    vectorized, and repeat traffic pays it again for lines whose digest
+    an earlier request already computed. The interner short-circuits
+    that: a vectorized :func:`probe64` per unique line, a single
+    ``searchsorted`` against the flat probe table, and a numpy
+    word-matrix equality check (the vectorized memcmp) — warm requests
+    recover their digests with ZERO per-line Python and zero
+    cryptographic hashing. Only first-touch lines (and the
+    cryptographically-negligible probe collisions) pay blake2b.
+
+    Poisoning stays impossible: a digest is only ever returned for
+    content whose padded word row AND true length compared equal to the
+    content blake2b was run on — the same (prefix, length) ⇒ equality
+    argument :func:`dedup_slots` rests on. Digests are pure functions of
+    line content, so entries survive pattern reloads and breaker trips;
+    the only bound is the byte budget, enforced by evicting the
+    least-recently-used half when full.
+    """
+
+    def __init__(self, budget_bytes: int = int(DEFAULT_INTERNER_MB * 2**20)):
+        self.lock = threading.Lock()
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.max_entries = max(64, self.budget_bytes // _INTERN_ENTRY_BYTES)
+        self._n = 0
+        self._probes = np.zeros(0, dtype=np.uint64)
+        self._words = np.zeros((0, _INTERN_WORDS), dtype=np.uint64)
+        self._lengths = np.zeros(0, dtype=np.int64)
+        self._stamp = np.zeros(0, dtype=np.int64)  # recency, for eviction
+        self._digests = np.zeros(0, dtype=object)
+        self._gen = 0
+        self._sorted: tuple[np.ndarray, np.ndarray] | None = None
+        self.probe_hits = 0
+        self.inserts = 0
+        self.collisions = 0
+        self.evictions = 0
+
+    def _sorted_view(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._sorted is None:
+            order = np.argsort(self._probes[: self._n], kind="stable")
+            self._sorted = (self._probes[order], order)
+        return self._sorted
+
+    def _grow(self, need: int) -> None:
+        cap = len(self._probes)
+        if need <= cap:
+            return
+        new = max(need, 256, cap * 2)
+        for name in ("_probes", "_lengths", "_stamp", "_digests"):
+            old = getattr(self, name)
+            buf = np.zeros(new, dtype=old.dtype)
+            buf[: self._n] = old[: self._n]
+            setattr(self, name, buf)
+        w = np.zeros((new, _INTERN_WORDS), dtype=np.uint64)
+        w[: self._n] = self._words[: self._n]
+        self._words = w
+
+    def _evict_half(self) -> None:
+        """Table full: keep the most-recently-used half. Coarser than a
+        per-entry LRU but keeps eviction a single vectorized compaction
+        instead of a per-insert OrderedDict walk."""
+        keep_n = self.max_entries // 2
+        if self._n <= keep_n:
+            return
+        keep = np.argpartition(self._stamp[: self._n], self._n - keep_n)[
+            self._n - keep_n:
+        ]
+        self.evictions += self._n - keep_n
+        for name in ("_probes", "_lengths", "_stamp", "_digests"):
+            arr = getattr(self, name)
+            arr[:keep_n] = arr[keep]
+            setattr(self, name, arr)
+        self._words[:keep_n] = self._words[keep]
+        self._n = keep_n
+        self._sorted = None
+
+    def digests(
+        self,
+        v64_rows: np.ndarray,
+        lengths: np.ndarray,
+        width: int,
+        blob,
+        starts,
+        ends,
+    ) -> list[bytes]:
+        """Digest per unique line, hashing only first-touch content.
+        ``v64_rows``/``lengths`` are :func:`dedup_slots`' int64 key-matrix
+        rows and true byte lengths for the unique lines;
+        ``starts``/``ends`` are plain lists indexing ``blob`` (the same
+        slices :func:`line_key` would hash)."""
+        n = v64_rows.shape[0]
+        if n == 0:
+            return []
+        probes = probe64(v64_rows, lengths, width)
+        wc = width // 8
+        u = v64_rows[:, : min(wc, _INTERN_WORDS)].view(np.uint64)
+        if wc >= _INTERN_WORDS:
+            batch_words = np.ascontiguousarray(u)
+            internable = lengths <= _INTERN_WORDS * 8
+        else:
+            batch_words = np.zeros((n, _INTERN_WORDS), dtype=np.uint64)
+            batch_words[:, :wc] = u
+            internable = np.ones(n, dtype=bool)
+        # comparing only the words any batch line can occupy is exact: an
+        # entry with content past that point has a larger length, and the
+        # length check fails first
+        wmax = max(1, min(_INTERN_WORDS, -(-int(lengths.max()) // 8)))
+        out = np.empty(n, dtype=object)
+        found = np.zeros(n, dtype=bool)
+        with self.lock:
+            self._gen += 1
+            present = np.zeros(n, dtype=bool)
+            if self._n:
+                sp, sid = self._sorted_view()
+                pos = np.minimum(
+                    np.searchsorted(sp, probes), self._n - 1
+                )
+                present = sp[pos] == probes
+                cand = np.flatnonzero(present & internable)
+                if cand.size:
+                    eid = sid[pos[cand]]
+                    ok = (self._lengths[eid] == lengths[cand]) & (
+                        self._words[eid, :wmax] == batch_words[cand, :wmax]
+                    ).all(axis=1)
+                    hit_rows = cand[ok]
+                    hit_eids = eid[ok]
+                    self._stamp[hit_eids] = self._gen
+                    self.probe_hits += len(hit_rows)
+                    out[hit_rows] = self._digests[hit_eids]
+                    found[hit_rows] = True
+                    # probe matched but content differs: a 64-bit
+                    # collision — those lines stay on blake2b forever
+                    self.collisions += int(ok.size - ok.sum())
+            miss_rows = np.flatnonzero(~found).tolist()
+            ins_rows: list[int] = []
+            batch_probes: set[int] = set()
+            for i in miss_rows:
+                out[i] = line_key(blob[starts[i] : ends[i]])
+                p = int(probes[i])
+                if internable[i] and not present[i] and p not in batch_probes:
+                    batch_probes.add(p)
+                    ins_rows.append(i)
+            if self._n + len(ins_rows) > self.max_entries:
+                self._evict_half()
+                ins_rows = ins_rows[: max(0, self.max_entries - self._n)]
+            if ins_rows:
+                self._grow(self._n + len(ins_rows))
+                ir = np.asarray(ins_rows, dtype=np.int64)
+                sl = slice(self._n, self._n + len(ins_rows))
+                self._probes[sl] = probes[ir]
+                self._words[sl] = batch_words[ir]
+                self._lengths[sl] = lengths[ir]
+                self._stamp[sl] = self._gen
+                self._digests[sl] = out[ir]
+                self._n += len(ins_rows)
+                self.inserts += len(ins_rows)
+                self._sorted = None
+        return out.tolist()
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "budgetMb": round(self.budget_bytes / 2**20, 3),
+                "entries": self._n,
+                "residentBytes": self._n * _INTERN_ENTRY_BYTES,
+                "probeHits": self.probe_hits,
+                "inserts": self.inserts,
+                "collisions": self.collisions,
+                "evictions": self.evictions,
+            }
+
+
 def dedup_slots(
-    corpus,
+    corpus, interner: "KeyInterner | None" = None
 ) -> tuple[np.ndarray, np.ndarray, list[bytes], np.ndarray] | None:
     """Vectorized request-level dedup: unique lines and the line→slot
     fan-in in array speed instead of a per-line dict loop.
@@ -169,7 +388,14 @@ def dedup_slots(
         rep_lines = first_idx[ord2]
     s_l = starts[rep_lines].tolist()
     e_l = ends[rep_lines].tolist()
-    keys = [line_key(blob[a:b]) for a, b in zip(s_l, e_l)]
+    if interner is not None and width % 8 == 0:
+        # two-level keying: vectorized probes + word-matrix-verified
+        # digest reuse; blake2b only for lines never seen before
+        keys = interner.digests(
+            v64[rep_lines], lengths[rep_lines], width, blob, s_l, e_l
+        )
+    else:
+        keys = [line_key(blob[a:b]) for a, b in zip(s_l, e_l)]
     counts = np.bincount(line_slot, minlength=rep_lines.size)
     return line_slot, rep_lines, keys, counts
 

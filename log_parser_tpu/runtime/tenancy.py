@@ -1,0 +1,505 @@
+"""Multi-tenant serving: per-tenant engines, state namespaces, quotas.
+
+ROADMAP item 3. Every stateful subsystem — pattern bank, frequency
+window, WAL, line cache, quarantine fingerprints, breaker boards,
+micro-batcher, shadow verifier, streaming sessions, the reload quiesce
+gate — already lives on :class:`~log_parser_tpu.runtime.engine.AnalysisEngine`.
+Tenancy therefore does NOT thread a tenant id through every call site;
+it resolves the id ONCE at the transport edge to a :class:`TenantContext`
+wrapping a dedicated engine, and everything downstream runs exactly the
+single-tenant code path. That is the isolation contract: a tenant's
+output is bit-identical to a dedicated single-tenant engine run of its
+traffic alone, by construction (pinned by tests/test_tenancy.py).
+
+What IS shared across tenants, deliberately:
+
+- the **admission gate** — one process-wide bounded semaphore
+  (serve/admission.py). Each tenant engine is pre-attached to the
+  default engine's gate, so every transport × every tenant admits
+  through the same in-flight/queue bounds; :class:`TenantQuota` refines
+  that gate per tenant (in-flight cap, queue share, lines/s bucket).
+- the **process** — one XLA runtime, one compile cache, one faults
+  registry. Per-tenant banks rebuild warm through patterns/libcache.py.
+
+Resolution: HTTP ``X-Tenant`` header; framed shim ``method@tenant``
+envelope suffix; gRPC ``x-tenant`` invocation metadata. A missing id
+maps to the default tenant (the engine the server booted with), so
+single-tenant deployments behave exactly as before this module existed.
+
+Residency: non-default tenants build lazily from ``root/<id>/`` and are
+LRU-resident under ``--tenant-budget-mb``; eviction only takes idle
+tenants (no in-flight work, no open stream sessions), snapshots their
+journal, and the next resolve rebuilds from the libcache snapshot.
+
+Fault sites (tools/chaos_sweep.py --group tenant): ``tenant_resolve``
+(resolution path), ``tenant_evict`` (residency eviction),
+``tenant_quota`` (quota enforcement, fired in serve/admission.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+
+import numpy as np
+
+from log_parser_tpu.runtime import faults
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TENANT = "default"
+
+# the tenancy chaos vocabulary (tools/chaos_sweep.py --group tenant);
+# tools/hygiene.py check 13 pins every key to a docs/OPS.md row AND to a
+# live faults.fire site, so the table can neither rot nor go undocumented
+FAULT_SITES = {
+    "tenant_resolve": "tenant id resolution (TenantRegistry.resolve)",
+    "tenant_evict": "LRU residency eviction (TenantRegistry)",
+    "tenant_quota": "per-tenant quota enforcement (serve/admission.py)",
+}
+
+# path-component safety: tenant ids name WAL directories and library
+# sub-directories, so they must never traverse ("..", "/", empty)
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+class TenantError(Exception):
+    """Tenant resolution refused: unknown tenant (404) or malformed id
+    (400). Transports map status onto their wire the same way they map
+    AdmissionRejected."""
+
+    def __init__(self, reason: str, status: int = 404):
+        super().__init__(reason)
+        self.reason = reason
+        self.status = status
+
+
+class TenantQuota:
+    """Per-tenant refinement of the shared admission gate: an in-flight
+    cap, a queue share, and a lines/s token bucket. Passive arithmetic
+    only — every mutation happens under the gate's condition variable
+    (serve/admission.py), so quota state needs no lock of its own and
+    never introduces a second lock order.
+
+    ``0`` disables a bound. The bucket debits at admission using the
+    request's declared line count; tokens are not refunded on failure
+    (a shed request still cost its arrival). Streaming sessions bypass
+    the bucket (their line count is unknown at open) but hold an
+    in-flight slot like any request.
+    """
+
+    def __init__(
+        self,
+        max_inflight: int = 0,
+        max_queued: int = 0,
+        lines_per_s: float = 0.0,
+        burst_s: float = 2.0,
+        clock=time.monotonic,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.max_queued = int(max_queued)
+        self.lines_per_s = float(lines_per_s)
+        self.clock = clock
+        self._capacity = max(self.lines_per_s * float(burst_s), self.lines_per_s)
+        self._tokens = self._capacity
+        self._stamp = clock()
+        # mutated under the gate's _cv, read unlocked for stats
+        self.inflight = 0
+        self.queued = 0
+        self.admitted = 0
+        self.lines_admitted = 0
+        self.shed_rate = 0
+        self.shed_inflight = 0
+        self.shed_queue = 0
+
+    def debit_lines(self, lines: int) -> float | None:
+        """Refill, then try to take ``lines`` tokens. Returns None when
+        admitted, else the seconds until the bucket could cover the
+        request (the Retry-After hint). Caller holds the gate's _cv."""
+        if self.lines_per_s <= 0 or lines <= 0:
+            return None
+        now = self.clock()
+        self._tokens = min(
+            self._capacity,
+            self._tokens + (now - self._stamp) * self.lines_per_s,
+        )
+        self._stamp = now
+        if self._tokens >= lines:
+            self._tokens -= lines
+            return None
+        want = min(float(lines), self._capacity)
+        return max((want - self._tokens) / self.lines_per_s, 0.05)
+
+    def stats(self) -> dict:
+        return {
+            "maxInflight": self.max_inflight,
+            "maxQueued": self.max_queued,
+            "linesPerS": self.lines_per_s,
+            "inflight": self.inflight,
+            "queued": self.queued,
+            "admitted": self.admitted,
+            "linesAdmitted": self.lines_admitted,
+            "shedRate": self.shed_rate,
+            "shedInflight": self.shed_inflight,
+            "shedQueue": self.shed_queue,
+        }
+
+
+def _bank_nbytes(bank) -> int:
+    """Resident-size estimate for one compiled bank: every numpy array
+    reachable one attribute level down from the bank and its columns
+    (DFA transition tables dominate). An LRU budget knob, not an
+    allocator — systematic under-count is fine as long as it is
+    monotone in bank size."""
+    total = 0
+    seen: set[int] = set()
+
+    def add(obj) -> None:
+        if obj is None or id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, np.ndarray):
+            nonlocal total
+            total += obj.nbytes
+
+    def scan(holder) -> None:
+        d = getattr(holder, "__dict__", None)
+        if not d:
+            return
+        for v in d.values():
+            add(v)
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    add(x)
+
+    scan(bank)
+    for col in getattr(bank, "columns", ()) or ():
+        scan(col)
+        dfa = getattr(col, "dfa", None)
+        if dfa is not None:
+            scan(dfa)
+    return total + 4096 * max(1, getattr(bank, "n_columns", 1))
+
+
+class TenantContext:
+    """One tenant's slice of the process: a dedicated engine (bank,
+    frequency, line cache, quarantine, breakers, batcher, shadow,
+    stream manager, journal) plus its quota and lazily-built reloader.
+    Handed out by :class:`TenantRegistry.resolve`; request paths hold
+    the context, never the tenant id."""
+
+    def __init__(self, tenant_id: str, engine, quota: TenantQuota,
+                 pattern_dir: str | None = None, lint_mode: str = "warn"):
+        self.tenant_id = tenant_id
+        self.engine = engine
+        self.quota = quota
+        self.pattern_dir = pattern_dir
+        self.lint_mode = lint_mode
+        self._reloader = None
+        self._reloader_lock = threading.Lock()
+        self.bank_bytes = _bank_nbytes(engine.bank)
+
+    def reloader(self):
+        """This tenant's reload ladder — quiesces only this tenant's
+        engine, so a reload here never stalls another tenant's traffic."""
+        with self._reloader_lock:
+            if self._reloader is None:
+                from log_parser_tpu.runtime.reload import PatternReloader
+
+                self._reloader = PatternReloader(
+                    self.engine,
+                    pattern_dir=self.pattern_dir,
+                    lint_mode=self.lint_mode,
+                )
+            return self._reloader
+
+    def note_reloaded(self) -> None:
+        """Re-estimate residency after a swap changed the bank."""
+        self.bank_bytes = _bank_nbytes(self.engine.bank)
+
+    def busy(self) -> bool:
+        """True while eviction would strand live work: in-flight or
+        queued requests, or open streaming sessions pinned to this
+        tenant's bank epoch."""
+        if self.quota.inflight > 0 or self.quota.queued > 0:
+            return True
+        mgr = getattr(self.engine, "stream_manager", None)
+        if mgr is not None and mgr.stats().get("openSessions", 0) > 0:
+            return True
+        return False
+
+    def close(self) -> None:
+        """Quiesce this tenant's moving parts for eviction/shutdown:
+        flush the batcher, stop the shadow verifier, kill stream
+        sessions, and fold the WAL into a final snapshot so the next
+        build warm-attaches the frequency state it left behind."""
+        eng = self.engine
+        mgr = getattr(eng, "stream_manager", None)
+        if mgr is not None:
+            mgr.shutdown()
+        if getattr(eng, "batcher", None) is not None:
+            eng.batcher.close()
+        if getattr(eng, "shadow", None) is not None:
+            eng.shadow.close()
+        journal = getattr(eng, "journal", None)
+        if journal is not None:
+            journal.snapshot_now()
+            journal.close()
+
+    def stats(self) -> dict:
+        return {
+            "bankBytes": int(self.bank_bytes),
+            "patterns": int(self.engine.bank.n_patterns),
+            "reloadEpoch": int(getattr(self.engine, "reload_epoch", 0)),
+            "quota": self.quota.stats(),
+        }
+
+
+class TenantRegistry:
+    """Tenant id → :class:`TenantContext`, with lazy builds and LRU
+    residency. The default tenant wraps the engine the server booted
+    with and is never evicted; non-default tenants build from
+    ``root/<id>/`` on first resolve (warm through patterns/libcache.py)
+    and compete for ``budget_mb`` of resident bank bytes.
+
+    ``engine_setup(engine, tenant_id)`` is the serve-layer hook that
+    mirrors the boot-time wiring (batching, line cache, journal at
+    ``state_root/<id>``, stream manager) onto each new tenant engine —
+    the registry itself stays policy-free. ``gate`` is the shared
+    admission controller pre-attached to every tenant engine so all
+    transports admit through one semaphore.
+    """
+
+    def __init__(
+        self,
+        default_engine,
+        *,
+        root: str | None = None,
+        budget_mb: float = 0.0,
+        gate=None,
+        engine_setup=None,
+        quota_factory=None,
+        lint_mode: str = "warn",
+        clock=time.monotonic,
+    ):
+        self.default_engine = default_engine
+        self.root = root
+        self.budget_bytes = int(float(budget_mb) * 1024 * 1024)
+        self.gate = gate
+        self.engine_setup = engine_setup
+        self.quota_factory = quota_factory or (lambda tid: TenantQuota())
+        self.lint_mode = lint_mode
+        self.clock = clock
+        self._lock = threading.RLock()
+        # LRU order: oldest-resolved first; default kept out of the map
+        self._contexts: dict[str, TenantContext] = {}
+        self._order: list[str] = []
+        self._evicted_ids: set[str] = set()
+        # first-touch builds in flight: tenant id -> completion event.
+        # Builds run OUTSIDE _lock (a bank compile takes seconds and must
+        # never stall another tenant's resolution); concurrent first
+        # touches of the same tenant coalesce on the event instead of
+        # compiling the bank twice.
+        self._building: dict[str, threading.Event] = {}
+        self.default_context = TenantContext(
+            DEFAULT_TENANT,
+            default_engine,
+            self.quota_factory(DEFAULT_TENANT),
+            pattern_dir=None,
+            lint_mode=lint_mode,
+        )
+        if gate is not None:
+            default_engine.admission_gate = gate
+        # counters (GET /trace/last `tenants` block)
+        self.resolved = 0
+        self.created = 0
+        self.evicted = 0
+        self.rebuilds = 0
+        self.unknown = 0
+        self.invalid = 0
+
+    # ------------------------------------------------------------ resolve
+
+    def resolve(self, tenant_id: str | None) -> TenantContext:
+        """Map a wire tenant id to its context, building on first use.
+        None/empty → default tenant (single-tenant back-compat)."""
+        faults.fire(  # conlint: contained-by-caller (transport error path)
+            "tenant_resolve", key=tenant_id or DEFAULT_TENANT
+        )
+        if not tenant_id or tenant_id == DEFAULT_TENANT:
+            with self._lock:
+                self.resolved += 1
+            return self.default_context
+        if not _ID_RE.match(tenant_id):
+            with self._lock:
+                self.invalid += 1
+            raise TenantError(f"invalid tenant id {tenant_id!r}", status=400)
+        while True:
+            with self._lock:
+                ctx = self._contexts.get(tenant_id)
+                if ctx is not None:
+                    self.resolved += 1
+                    self._order.remove(tenant_id)
+                    self._order.append(tenant_id)
+                    # an eviction deferred while every candidate was busy
+                    # retries here, as traffic flows
+                    self._evict_over_budget()
+                    return ctx
+                pending = self._building.get(tenant_id)
+                if pending is None:
+                    if self.root is None:
+                        self.unknown += 1
+                        raise TenantError(
+                            f"unknown tenant {tenant_id!r} (no --tenant-root)",
+                            404,
+                        )
+                    lib_dir = os.path.join(self.root, tenant_id)
+                    if not os.path.isdir(lib_dir):
+                        self.unknown += 1
+                        raise TenantError(f"unknown tenant {tenant_id!r}", 404)
+                    pending = threading.Event()
+                    self._building[tenant_id] = pending
+                    break  # this thread owns the build
+            # another thread is compiling this tenant's bank: wait for it
+            # and re-check the map (its failure makes us the next builder)
+            pending.wait()
+        try:
+            ctx = self._build(tenant_id, lib_dir)
+        except BaseException:
+            with self._lock:
+                self._building.pop(tenant_id, None)
+            pending.set()
+            raise
+        with self._lock:
+            self._contexts[tenant_id] = ctx
+            self._order.append(tenant_id)
+            self._building.pop(tenant_id, None)
+            self.resolved += 1
+            self.created += 1
+            if tenant_id in self._evicted_ids:
+                self.rebuilds += 1
+            self._evict_over_budget()
+        pending.set()
+        return ctx
+
+    def _build(self, tenant_id: str, lib_dir: str) -> TenantContext:
+        from log_parser_tpu.patterns.loader import load_pattern_directory
+        from log_parser_tpu.runtime.engine import AnalysisEngine
+
+        sets = load_pattern_directory(lib_dir)
+        if not sets:
+            raise TenantError(
+                f"tenant {tenant_id!r} has no pattern sets in {lib_dir!r}", 404
+            )
+        t0 = self.clock()
+        eng = AnalysisEngine(
+            sets, self.default_engine.config, clock=self.clock
+        )
+        if self.gate is not None:
+            # shared process-wide gate: shared_gate(tenant_engine) in any
+            # transport now returns this controller, not a fresh one
+            eng.admission_gate = self.gate
+        if self.engine_setup is not None:
+            self.engine_setup(eng, tenant_id)
+        ctx = TenantContext(
+            tenant_id, eng, self.quota_factory(tenant_id),
+            pattern_dir=lib_dir, lint_mode=self.lint_mode,
+        )
+        log.info(
+            "tenant %r built: %d pattern(s), ~%.1f MB bank, %.2fs",
+            tenant_id, eng.bank.n_patterns, ctx.bank_bytes / 2**20,
+            self.clock() - t0,
+        )
+        return ctx
+
+    # ----------------------------------------------------------- residency
+
+    def _resident_bytes(self) -> int:
+        return sum(c.bank_bytes for c in self._contexts.values())
+
+    def _evict_over_budget(self) -> None:
+        """LRU-evict idle non-default tenants until resident bank bytes
+        fit the budget. Busy tenants are skipped — an in-flight request
+        keeps its engine reference, and evicting under it would violate
+        the epoch pinning streaming relies on. Caller holds _lock."""
+        if self.budget_bytes <= 0:
+            return
+        while self._resident_bytes() > self.budget_bytes:
+            victim = None
+            # the MRU entry is always protected: it is the tenant whose
+            # resolve is running right now, and evicting it would close
+            # the journal/batcher under the request that just built it
+            for tid in self._order[:-1]:
+                ctx = self._contexts[tid]
+                if not ctx.busy():
+                    victim = tid
+                    break
+            if victim is None:
+                log.warning(
+                    "tenant budget exceeded (%.1f/%.1f MB) but every "
+                    "resident tenant is busy; deferring eviction",
+                    self._resident_bytes() / 2**20,
+                    self.budget_bytes / 2**20,
+                )
+                return
+            faults.fire("tenant_evict", key=victim)  # conlint: contained-by-caller (resolve -> transport error path)
+            ctx = self._contexts.pop(victim)
+            self._order.remove(victim)
+            self._evicted_ids.add(victim)
+            self.evicted += 1
+            log.info(
+                "tenant %r evicted (LRU, ~%.1f MB freed); next resolve "
+                "rebuilds from the library snapshot",
+                victim, ctx.bank_bytes / 2**20,
+            )
+            ctx.close()
+
+    # -------------------------------------------------------------- admin
+
+    def resident(self) -> list[str]:
+        with self._lock:
+            return [DEFAULT_TENANT] + list(self._order)
+
+    def context_if_resident(self, tenant_id: str) -> TenantContext | None:
+        with self._lock:
+            if not tenant_id or tenant_id == DEFAULT_TENANT:
+                return self.default_context
+            return self._contexts.get(tenant_id)
+
+    def shutdown(self) -> None:
+        """Close every non-default tenant (the default engine's parts are
+        torn down by the server's own shutdown sequence)."""
+        with self._lock:
+            ctxs = list(self._contexts.values())
+            self._contexts.clear()
+            self._order.clear()
+        for ctx in ctxs:
+            try:
+                ctx.close()
+            except Exception:
+                log.exception("tenant %r close failed", ctx.tenant_id)
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_tenant = {
+                DEFAULT_TENANT: self.default_context.stats(),
+                **{tid: c.stats() for tid, c in self._contexts.items()},
+            }
+            return {
+                "residentTenants": 1 + len(self._contexts),
+                "budgetMb": round(self.budget_bytes / 2**20, 3),
+                "residentBankMb": round(
+                    (self.default_context.bank_bytes + self._resident_bytes())
+                    / 2**20, 3,
+                ),
+                "resolved": self.resolved,
+                "created": self.created,
+                "evicted": self.evicted,
+                "rebuilds": self.rebuilds,
+                "unknown": self.unknown,
+                "invalid": self.invalid,
+                "perTenant": per_tenant,
+            }

@@ -210,6 +210,38 @@ def main(argv: list[str] | None = None) -> int:
         "falls back per batch on admission or fault; default off "
         "(LOG_PARSER_TPU_PALLAS_DFA)",
     )
+    # multi-tenant serving (docs/OPS.md "Multi-tenant serving")
+    parser.add_argument(
+        "--tenant-root", default=None, metavar="DIR",
+        help="root of per-tenant pattern libraries: DIR/<tenant>/ holds "
+        "tenant <tenant>'s YAML sets, built lazily on first X-Tenant "
+        "request (runtime/tenancy.py; single-device engine only; "
+        "LOG_PARSER_TPU_TENANT_ROOT)",
+    )
+    parser.add_argument(
+        "--tenant-budget-mb", type=float, default=None, metavar="MB",
+        help="resident byte budget across non-default tenant banks; over "
+        "budget the least-recently-used idle tenant is evicted (its "
+        "journal snapshots, its next request rebuilds warm from the "
+        "library snapshot cache); 0 = unbounded "
+        "(LOG_PARSER_TPU_TENANT_BUDGET_MB)",
+    )
+    parser.add_argument(
+        "--tenant-max-inflight", type=int, default=None,
+        help="per-tenant cap on concurrently-executing parses inside the "
+        "shared gate; 0 = unbounded (LOG_PARSER_TPU_TENANT_MAX_INFLIGHT)",
+    )
+    parser.add_argument(
+        "--tenant-max-queued", type=int, default=None,
+        help="per-tenant share of the shared wait queue; 0 = unbounded "
+        "(LOG_PARSER_TPU_TENANT_MAX_QUEUED)",
+    )
+    parser.add_argument(
+        "--tenant-lines-per-s", type=float, default=None,
+        help="per-tenant sustained log-line rate (token bucket, 2s "
+        "burst); a request over budget sheds 429 'tenant rate' with "
+        "Retry-After; 0 = unbounded (LOG_PARSER_TPU_TENANT_LINES_PER_S)",
+    )
     args = parser.parse_args(argv)
     if args.device_timeout is not None:
         os.environ["LOG_PARSER_TPU_DEVICE_TIMEOUT_S"] = str(args.device_timeout)
@@ -243,6 +275,11 @@ def main(argv: list[str] | None = None) -> int:
         (args.watch_patterns, "LOG_PARSER_TPU_WATCH_PATTERNS"),
         (args.lint_patterns, "LOG_PARSER_TPU_LINT_PATTERNS"),
         (args.compile_cache_dir, "LOG_PARSER_TPU_XLA_CACHE"),
+        (args.tenant_root, "LOG_PARSER_TPU_TENANT_ROOT"),
+        (args.tenant_budget_mb, "LOG_PARSER_TPU_TENANT_BUDGET_MB"),
+        (args.tenant_max_inflight, "LOG_PARSER_TPU_TENANT_MAX_INFLIGHT"),
+        (args.tenant_max_queued, "LOG_PARSER_TPU_TENANT_MAX_QUEUED"),
+        (args.tenant_lines_per_s, "LOG_PARSER_TPU_TENANT_LINES_PER_S"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -393,8 +430,79 @@ def main(argv: list[str] | None = None) -> int:
             ", torn tail quarantined" if journal.torn_tails else "",
         )
 
+    # tenant registry: X-Tenant (HTTP) / x-tenant (gRPC) / method@tenant
+    # (framed shim) resolve through one registry; each non-default tenant
+    # gets a dedicated engine mirroring this one's serving features, all
+    # admitting through the ONE shared gate
+    from log_parser_tpu.runtime.tenancy import TenantQuota, TenantRegistry
+    from log_parser_tpu.serve.admission import shared_gate
+
+    tenant_root = os.environ.get("LOG_PARSER_TPU_TENANT_ROOT") or None
+    if tenant_root and (args.coordinator or args.sharded):
+        # tenant engines are single-device AnalysisEngines; placing tenant
+        # banks across a mesh is parallel/pattern_sharded.py's
+        # tenant-placement mode, not the serve path
+        log.warning(
+            "--tenant-root is only supported on the single-device engine; "
+            "serving single-tenant"
+        )
+        tenant_root = None
+
+    def tenant_engine_setup(eng, tenant_id: str) -> None:
+        # mirror the default engine's serving features; env carries the
+        # flag values (the flag→env loop above ran before boot)
+        if os.environ.get(
+            "LOG_PARSER_TPU_BATCHING", "off"
+        ).strip().lower() == "on":
+            eng.enable_batching(
+                wait_ms=float(
+                    os.environ.get("LOG_PARSER_TPU_BATCH_WAIT_MS", "2")
+                ),
+                batch_max=int(os.environ.get("LOG_PARSER_TPU_BATCH_MAX", "8")),
+            )
+        mb = float(os.environ.get("LOG_PARSER_TPU_LINE_CACHE_MB", "64") or 0)
+        if mb > 0:
+            eng.enable_line_cache(mb)
+        if state_dir:
+            # namespaced WAL/snapshot dir: tenants/<id> under the default
+            # tenant's state dir, so recovery is per-tenant and a tenant
+            # eviction's final snapshot lands where its rebuild looks
+            eng.attach_journal(
+                os.path.join(state_dir, "tenants", tenant_id),
+                fsync_ms=float(
+                    os.environ.get("LOG_PARSER_TPU_JOURNAL_FSYNC_MS", "50")
+                ),
+                snapshot_every=int(
+                    os.environ.get("LOG_PARSER_TPU_SNAPSHOT_EVERY", "512")
+                ),
+            )
+
+    t_inflight = int(os.environ.get("LOG_PARSER_TPU_TENANT_MAX_INFLIGHT", "0") or 0)
+    t_queued = int(os.environ.get("LOG_PARSER_TPU_TENANT_MAX_QUEUED", "0") or 0)
+    t_lps = float(os.environ.get("LOG_PARSER_TPU_TENANT_LINES_PER_S", "0") or 0)
+    tenants = TenantRegistry(
+        engine,
+        root=tenant_root,
+        budget_mb=float(
+            os.environ.get("LOG_PARSER_TPU_TENANT_BUDGET_MB", "0") or 0
+        ),
+        gate=shared_gate(engine),
+        engine_setup=tenant_engine_setup,
+        quota_factory=lambda tid: TenantQuota(t_inflight, t_queued, t_lps),
+        lint_mode=os.environ.get("LOG_PARSER_TPU_LINT_PATTERNS", "warn"),
+    )
+    if tenant_root:
+        log.info(
+            "Multi-tenant serving: root %s, bank budget %s, quota "
+            "inflight=%d queued=%d lines/s=%.0f",
+            tenant_root,
+            "unbounded" if tenants.budget_bytes <= 0
+            else "%.0f MB" % (tenants.budget_bytes / 2**20),
+            t_inflight, t_queued, t_lps,
+        )
+
     try:
-        server = make_server(engine, args.host, args.port)
+        server = make_server(engine, args.host, args.port, tenants=tenants)
     except OSError:
         # followers are already blocked waiting for a broadcast; a
         # coordinator that dies without the shutdown sentinel would hang
@@ -461,6 +569,10 @@ def main(argv: list[str] | None = None) -> int:
         server.server_close()
         if server.watcher is not None:
             server.watcher.stop()
+        # tenant engines first: closes their batchers/stream sessions and
+        # folds each tenant WAL into a final snapshot, releasing any
+        # shared-gate slots their sessions held
+        server.tenants.shutdown()
         if server.stream_manager is not None:
             # kill open sessions so their admission slots release before
             # the gate's drain accounting is torn down

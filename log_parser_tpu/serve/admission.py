@@ -96,6 +96,7 @@ class AdmissionController:
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.shed_draining = 0
+        self.shed_tenant = 0
 
     @classmethod
     def from_env(cls, env=None) -> "AdmissionController":
@@ -115,7 +116,11 @@ class AdmissionController:
         return max(1, self._waiting + (1 if self._inflight else 0))
 
     def acquire(
-        self, deadline_ms: float | None = None, batchable: bool = False
+        self,
+        deadline_ms: float | None = None,
+        batchable: bool = False,
+        tenant=None,
+        lines: int = 0,
     ) -> str:
         """Admit or refuse one request. Returns the route — ``"device"``
         (free slot), ``"batched"`` (had to queue, but the transport's
@@ -123,10 +128,18 @@ class AdmissionController:
         device batch — a FIRST-CLASS outcome with full device service, not
         a degradation), or ``"host"`` (had to queue without batching:
         degrade to the host path) — or raises :class:`AdmissionRejected`.
-        Callers MUST pair a successful acquire with :meth:`release`.
+        Callers MUST pair a successful acquire with :meth:`release`
+        (passing the same ``tenant``).
 
         ``deadline_ms`` is this request's budget from arrival (header);
         None uses the configured default; 0/negative budget means none.
+
+        ``tenant`` is an optional :class:`~log_parser_tpu.runtime.tenancy.
+        TenantQuota` refining this shared gate per tenant: a lines/s
+        token bucket debited with ``lines``, an in-flight cap, and a
+        queue share — each shed as 429 before the request can crowd the
+        global bounds. Quota counters are mutated under ``_cv`` so they
+        need no lock of their own.
         """
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -134,19 +147,52 @@ class AdmissionController:
             self.clock() + deadline_ms / 1e3 if deadline_ms and deadline_ms > 0
             else None
         )
+        if tenant is not None:
+            from log_parser_tpu.runtime import faults
+
+            faults.fire("tenant_quota")  # conlint: contained-by-caller (transports map the escape like any analyze failure)
         with self._cv:
             if self._draining:
                 self.shed_draining += 1
                 raise AdmissionRejected("draining", self._retry_after(), 503)
+            if tenant is not None:
+                wait_s = tenant.debit_lines(lines)
+                if wait_s is not None:
+                    tenant.shed_rate += 1
+                    self.shed_tenant += 1
+                    raise AdmissionRejected(
+                        "tenant rate", max(1, int(wait_s + 0.999)), 429
+                    )
+                if (
+                    tenant.max_inflight > 0
+                    and tenant.inflight >= tenant.max_inflight
+                ):
+                    tenant.shed_inflight += 1
+                    self.shed_tenant += 1
+                    raise AdmissionRejected(
+                        "tenant inflight", self._retry_after(), 429
+                    )
             if self.max_inflight <= 0 or self._inflight < self.max_inflight:
                 # unbounded mode still counts in-flight so drain can wait
                 self._inflight += 1
                 self.admitted_device += 1
+                self._tenant_admit(tenant, lines)
                 return "device"
+            if tenant is not None and tenant.max_queued > 0 \
+                    and tenant.queued >= tenant.max_queued:
+                # queue share: one noisy tenant cannot occupy the whole
+                # global wait queue
+                tenant.shed_queue += 1
+                self.shed_tenant += 1
+                raise AdmissionRejected(
+                    "tenant queue", self._retry_after(), 429
+                )
             if self._waiting >= self.max_queue:
                 self.shed_queue_full += 1
                 raise AdmissionRejected("queue full", self._retry_after(), 429)
             self._waiting += 1
+            if tenant is not None:
+                tenant.queued += 1
             try:
                 while True:
                     if self._draining:
@@ -154,7 +200,11 @@ class AdmissionController:
                         raise AdmissionRejected(
                             "draining", self._retry_after(), 503
                         )
-                    if self._inflight < self.max_inflight:
+                    if self._inflight < self.max_inflight and (
+                        tenant is None
+                        or tenant.max_inflight <= 0
+                        or tenant.inflight < tenant.max_inflight
+                    ):
                         # queue head: starting past the deadline is dead
                         # work — shed instead
                         if deadline is not None and self.clock() >= deadline:
@@ -163,6 +213,7 @@ class AdmissionController:
                                 "deadline", self._retry_after(), 429
                             )
                         self._inflight += 1
+                        self._tenant_admit(tenant, lines)
                         if batchable:
                             # queued-then-batched: the wait bought this
                             # request a shared device batch, not the
@@ -183,10 +234,22 @@ class AdmissionController:
                     self._cv.wait(timeout)
             finally:
                 self._waiting -= 1
+                if tenant is not None:
+                    tenant.queued -= 1
 
-    def release(self) -> None:
+    @staticmethod
+    def _tenant_admit(tenant, lines: int) -> None:
+        # caller holds _cv
+        if tenant is not None:
+            tenant.inflight += 1
+            tenant.admitted += 1
+            tenant.lines_admitted += int(lines)
+
+    def release(self, tenant=None) -> None:
         with self._cv:
             self._inflight -= 1
+            if tenant is not None:
+                tenant.inflight -= 1
             self._cv.notify_all()
 
     # --------------------------------------------------------------- drain
@@ -238,6 +301,7 @@ class AdmissionController:
                 "shedQueueFull": self.shed_queue_full,
                 "shedDeadline": self.shed_deadline,
                 "shedDraining": self.shed_draining,
+                "shedTenant": self.shed_tenant,
             }
 
 

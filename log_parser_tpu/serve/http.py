@@ -47,6 +47,7 @@ from log_parser_tpu.runtime import faults
 from log_parser_tpu.utils import xlacache
 from log_parser_tpu.runtime.engine import AnalysisEngine
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
+from log_parser_tpu.runtime.tenancy import TenantError, TenantRegistry
 from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 
 log = logging.getLogger(__name__)
@@ -66,7 +67,12 @@ class ParseServer(ThreadingHTTPServer):
     # connection-refused before admission control ever sees the request
     request_queue_size = 128
 
-    def __init__(self, address: tuple[str, int], engine: AnalysisEngine):
+    def __init__(
+        self,
+        address: tuple[str, int],
+        engine: AnalysisEngine,
+        tenants: TenantRegistry | None = None,
+    ):
         super().__init__(address, _Handler)
         self.engine = engine
         # the engine's own state lock: admin routes and the analyze finish
@@ -74,6 +80,15 @@ class ParseServer(ThreadingHTTPServer):
         self.analyze_lock = engine.state_lock
         # ... and the engine's one admission gate, shared the same way
         self.admission = shared_gate(engine)
+        # tenant resolution (X-Tenant header → TenantContext). Always
+        # present: without --tenant-root only the default tenant resolves
+        # and non-default ids answer 404, so single-tenant deployments
+        # keep their exact pre-tenancy behavior.
+        self.tenants = (
+            tenants
+            if tenants is not None
+            else TenantRegistry(engine, gate=self.admission)
+        )
         # responses we failed to write because the client had already gone
         # away (GET /trace/last "droppedResponses")
         self.dropped_responses = 0
@@ -99,18 +114,22 @@ class ParseServer(ThreadingHTTPServer):
             self.reloader = PatternReloader(self.engine)
         return self.reloader
 
-    def get_stream_manager(self):
+    def get_stream_manager(self, ctx=None):
+        """The stream manager for ``ctx``'s engine (default engine when
+        ``ctx`` is None). ONE manager per engine across transports — a
+        gRPC StreamParse session and an HTTP one share the registry, the
+        admission budget, and the /trace/last counters; each tenant gets
+        its own manager so sessions pin to that tenant's bank epoch."""
         if not self.stream_enabled:
             return None
+        engine = self.engine if ctx is None else ctx.engine
         with self._stream_lock:
-            if self.stream_manager is None:
-                # ONE manager per engine across transports: a gRPC
-                # StreamParse session and an HTTP one share the registry,
-                # the admission budget, and the /trace/last counters
-                from log_parser_tpu.runtime.stream import shared_manager
+            from log_parser_tpu.runtime.stream import shared_manager
 
-                self.stream_manager = shared_manager(self.engine)
-            return self.stream_manager
+            mgr = shared_manager(engine)
+            if engine is self.engine:
+                self.stream_manager = mgr
+            return mgr
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -145,6 +164,27 @@ class _Handler(BaseHTTPRequestHandler):
                 exc,
             )
             self.close_connection = True
+
+    def _tenant(self):
+        """Resolve this request's ``X-Tenant`` header to its context, or
+        answer the error (400 malformed / 404 unknown / 500 on an
+        injected resolve fault) and return None. Requests without the
+        header run as the default tenant — the engine the server booted
+        with — so pre-tenancy clients are untouched."""
+        try:
+            return self.server.tenants.resolve(self.headers.get("X-Tenant"))
+        except TenantError as exc:
+            self._send_json(
+                exc.status,
+                json.dumps({"error": exc.reason}).encode(),
+            )
+            return None
+        except Exception:
+            log.exception("tenant resolution failed")
+            self._send_json(
+                500, b'{"error":"Internal tenant resolution failure"}'
+            )
+            return None
 
     # --------------------------------------------------------------- routes
 
@@ -182,25 +222,35 @@ class _Handler(BaseHTTPRequestHandler):
                 for v in ages.values()
             ):
                 return self._send_json(400, bad)
-            with self.server.analyze_lock:
+            ctx = self._tenant()
+            if ctx is None:
+                return
+            eng = ctx.engine
+            with eng.state_lock:
                 # a journal-backed tracker writes a barrier record here: a
                 # crash right after this response still recovers the
                 # restored state, not the pre-restore tail
-                self.server.engine.frequency.restore(ages)
-            journal = self.server.engine.journal
+                eng.frequency.restore(ages)
+            journal = eng.journal
             epoch = 0 if journal is None else journal.epoch
             return self._send_json(
                 200,
                 json.dumps({"status": "restored", "epoch": epoch}).encode(),
             )
         if self.path == "/frequency/reset":
-            with self.server.analyze_lock:
-                self.server.engine.frequency.reset_all_frequencies()
+            ctx = self._tenant()
+            if ctx is None:
+                return
+            with ctx.engine.state_lock:
+                ctx.engine.frequency.reset_all_frequencies()
             return self._send_json(200, b'{"status":"reset"}')
         if self.path.startswith("/frequency/reset/"):
             pattern_id = self.path[len("/frequency/reset/") :]
-            with self.server.analyze_lock:
-                self.server.engine.frequency.reset_pattern_frequency(pattern_id)
+            ctx = self._tenant()
+            if ctx is None:
+                return
+            with ctx.engine.state_lock:
+                ctx.engine.frequency.reset_pattern_frequency(pattern_id)
             return self._send_json(200, b'{"status":"reset"}')
         self._send_json(404, b'{"error":"not found"}')
 
@@ -208,7 +258,11 @@ class _Handler(BaseHTTPRequestHandler):
         """Canary-gated hot reload (runtime/reload.py). Empty body: re-read
         the configured pattern directory. Non-empty body: inline YAML
         pattern sets. Any build/canary failure is a structured 409 and the
-        live engine is untouched — in-flight requests never notice."""
+        live engine is untouched — in-flight requests never notice.
+
+        Tenant-scoped: ``X-Tenant`` picks whose library swaps. The quiesce
+        runs on that tenant's engine alone, so every other tenant's
+        traffic proceeds uninterrupted through the whole ladder."""
         from log_parser_tpu.runtime.reload import ReloadError
 
         try:
@@ -222,8 +276,13 @@ class _Handler(BaseHTTPRequestHandler):
             yaml_text = body.decode("utf-8") if body.strip() else None
         except UnicodeDecodeError:
             return self._send_json(400, b'{"error":"body is not UTF-8"}')
+        ctx = self._tenant()
+        if ctx is None:
+            return
+        default = ctx.engine is self.server.engine
+        reloader = self.server.get_reloader() if default else ctx.reloader()
         try:
-            envelope = self.server.get_reloader().reload(yaml_text=yaml_text)
+            envelope = reloader.reload(yaml_text=yaml_text)
         except ReloadError as exc:
             return self._send_json(409, json.dumps(exc.to_json()).encode())
         except Exception:
@@ -231,6 +290,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(
                 500, b'{"error":"Internal reload failure"}'
             )
+        ctx.note_reloaded()
         return self._send_json(200, json.dumps(envelope).encode())
 
     def do_GET(self) -> None:
@@ -268,13 +328,19 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             return self._send_json(200, b'{"status":"UP"}')
         if self.path == "/frequency/stats":
-            with self.server.analyze_lock:
-                stats = self.server.engine.frequency.get_frequency_statistics()
+            ctx = self._tenant()
+            if ctx is None:
+                return
+            with ctx.engine.state_lock:
+                stats = ctx.engine.frequency.get_frequency_statistics()
             return self._send_json(200, json.dumps(stats).encode())
         if self.path == "/frequency/snapshot":
-            with self.server.analyze_lock:
-                snap = self.server.engine.frequency.snapshot()
-            journal = self.server.engine.journal
+            ctx = self._tenant()
+            if ctx is None:
+                return
+            with ctx.engine.state_lock:
+                snap = ctx.engine.frequency.snapshot()
+            journal = ctx.engine.journal
             epoch = 0 if journal is None else journal.epoch
             # versioned envelope; POST /frequency/restore accepts it as-is
             return self._send_json(
@@ -304,6 +370,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # routing-tier hit/residual/eviction counters (docs/OPS.md
                 # "Line cache (routing tier)")
                 payload["lineCache"] = line_cache.stats()
+            interner = getattr(self.server.engine, "key_interner", None)
+            if interner is not None:
+                # two-level keying: probe hits are digests served without
+                # blake2b (docs/OPS.md "Line cache (routing tier)")
+                payload["interner"] = interner.stats()
             kernel_stats = getattr(self.server.engine, "kernel_stats", None)
             if kernel_stats is not None:
                 # Pallas union-DFA kernel tier: admission reason +
@@ -348,6 +419,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # static-analysis summary of the most recent reload
                 # candidate (docs/OPS.md "Lint-blocked reload")
                 payload["lint"] = last_lint
+            # tenant residency/quota counters (docs/OPS.md "Multi-tenant
+            # serving")
+            payload["tenants"] = self.server.tenants.stats()
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats
@@ -374,7 +448,10 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception:
             log.exception("injected HTTP-transport fault")
             return self._send_json(500, b'{"error":"Internal analysis failure"}')
-        mgr = self.server.get_stream_manager()
+        ctx = self._tenant()
+        if ctx is None:
+            return
+        mgr = self.server.get_stream_manager(ctx)
         if mgr is None:
             return self._send_json(
                 501, b'{"error":"streaming is not supported on this engine"}'
@@ -485,11 +562,19 @@ class _Handler(BaseHTTPRequestHandler):
                     400, b'{"error":"invalid X-Request-Deadline-Ms"}'
                 )
 
-        batcher = getattr(self.server.engine, "batcher", None)
+        ctx = self._tenant()
+        if ctx is None:
+            return
+        engine = ctx.engine
+        batcher = getattr(engine, "batcher", None)
+        n_lines = (data.logs.count("\n") + 1) if data.logs else 0
         arrival = time.monotonic()
         try:
             route = self.server.admission.acquire(
-                deadline_ms, batchable=batcher is not None
+                deadline_ms,
+                batchable=batcher is not None,
+                tenant=ctx.quota,
+                lines=n_lines,
             )
         except AdmissionRejected as exc:
             # shed (429) or draining (503) — either way tell the client
@@ -505,7 +590,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if route == "host":
                     # ladder rung 2: device slots saturated, this request
                     # queued — serve it from the cheaper golden host path
-                    result = self.server.engine.analyze_host_routed(data)
+                    result = engine.analyze_host_routed(data)
                 elif batcher is not None:
                     # micro-batching on: this request ("device" or
                     # queued-then-"batched") coalesces with concurrent
@@ -519,7 +604,7 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     if effective is not None:
                         effective -= (time.monotonic() - arrival) * 1e3
-                    result = self.server.engine.analyze_batched(
+                    result = engine.analyze_batched(
                         data, effective
                     )
                 else:
@@ -527,7 +612,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # overlaps the host finalize of in-flight ones; only
                     # the frequency-coupled finish phase serializes (on
                     # engine.state_lock)
-                    result = self.server.engine.analyze_pipelined(data)
+                    result = engine.analyze_pipelined(data)
             except QuarantineRejected as exc:
                 # a quarantined fingerprint the golden host path could not
                 # serve either — structured 429, try again after the TTL
@@ -551,7 +636,7 @@ class _Handler(BaseHTTPRequestHandler):
                     500, b'{"error":"Internal analysis failure"}'
                 )
         finally:
-            self.server.admission.release()
+            self.server.admission.release(tenant=ctx.quota)
         log.info(
             "Analysis complete for pod: %s. Found %d significant events.",
             data.pod_name,
@@ -560,5 +645,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, json.dumps(result.to_dict(drop_none=True)).encode())
 
 
-def make_server(engine: AnalysisEngine, host: str = "0.0.0.0", port: int = 8080) -> ParseServer:
-    return ParseServer((host, port), engine)
+def make_server(
+    engine: AnalysisEngine,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    tenants: TenantRegistry | None = None,
+) -> ParseServer:
+    return ParseServer((host, port), engine, tenants=tenants)

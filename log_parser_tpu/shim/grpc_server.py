@@ -33,11 +33,20 @@ except ImportError:  # pragma: no cover
     HAVE_GRPC = False
 
 
+def _tenant_of(context) -> str | None:
+    """Tenant id from ``x-tenant`` invocation metadata (the gRPC twin of
+    the HTTP ``X-Tenant`` header); absent metadata is the default tenant."""
+    for key, value in context.invocation_metadata() or ():
+        if key == "x-tenant":
+            return value or None
+    return None
+
+
 def _handlers(service: LogParserService):
     def wrap(fn):
         def unary(request, context):
             try:
-                return fn(request)
+                return fn(request, tenant_id=_tenant_of(context))
             except AdmissionRejected as exc:
                 # overload ladder: shed maps to RESOURCE_EXHAUSTED, a
                 # draining server to UNAVAILABLE — both carry the retry
@@ -73,18 +82,25 @@ def _handlers(service: LogParserService):
     }
 
 
-def _stream_handlers(engine):
+def _stream_handlers(service: LogParserService):
     """The ``LogParserStream.StreamParse`` bidi handler: byte chunks in,
     JSON frames out — the gRPC twin of ``POST /parse/stream``. Both
     transports resolve :func:`~log_parser_tpu.runtime.stream.shared_manager`,
     so their sessions share one admission budget, TTL reaper, and
-    ``/trace/last`` counter block."""
+    ``/trace/last`` counter block. ``x-tenant`` metadata pins the session
+    to that tenant's engine (and therefore its bank epoch) for its whole
+    life, exactly like the HTTP stream path."""
     from log_parser_tpu.shim import logparser_stream_pb2 as spb
+    from log_parser_tpu.runtime.tenancy import TenantError
 
     def stream_parse(request_iterator, context):
         from log_parser_tpu.runtime.stream import shared_manager
 
-        mgr = shared_manager(engine)
+        try:
+            ctx = service.tenants.resolve(_tenant_of(context))
+        except TenantError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        mgr = shared_manager(ctx.engine)
         try:
             sess = mgr.open()
         except AdmissionRejected as exc:
@@ -130,6 +146,7 @@ def make_grpc_server(
     max_workers: int = 8,
     service: LogParserService | None = None,
     stream: bool = True,
+    tenants=None,
 ):
     """Build (server, bound_port). Raises RuntimeError without grpcio.
 
@@ -148,13 +165,13 @@ def make_grpc_server(
     from concurrent import futures
 
     if service is None:
-        service = LogParserService(engine)
+        service = LogParserService(engine, tenants=tenants)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = [grpc.method_handlers_generic_handler(SERVICE_NAME, _handlers(service))]
     if stream:
         handlers.append(
             grpc.method_handlers_generic_handler(
-                STREAM_SERVICE_NAME, _stream_handlers(engine)
+                STREAM_SERVICE_NAME, _stream_handlers(service)
             )
         )
     server.add_generic_rpc_handlers(tuple(handlers))

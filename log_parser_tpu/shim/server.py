@@ -28,9 +28,9 @@ class ShimServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, address: tuple[str, int], engine):
+    def __init__(self, address: tuple[str, int], engine, tenants=None):
         super().__init__(address, _Handler)
-        self.service = LogParserService(engine)
+        self.service = LogParserService(engine, tenants=tenants)
         # dispatch: method name -> (request ctor, bound service method)
         self.dispatch = {
             name: (req_t, getattr(self.service, attr))
@@ -66,11 +66,15 @@ class _Handler(socketserver.BaseRequestHandler):
             envelope = pb.Envelope()
             try:
                 envelope.ParseFromString(frame)
-                entry = self.server.dispatch.get(envelope.method)
+                # tenancy rides the envelope as a method suffix
+                # ("Parse@acme") so the wire contract needs no new field;
+                # bare methods run as the default tenant
+                method, _, tenant = envelope.method.partition("@")
+                entry = self.server.dispatch.get(method)
                 if entry is None:
                     response = pb.Envelope(
                         method=envelope.method,
-                        error=f"unknown method {envelope.method!r}",
+                        error=f"unknown method {method!r}",
                     )
                 else:
                     req_t, fn = entry
@@ -78,7 +82,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     req.ParseFromString(envelope.payload)
                     response = pb.Envelope(
                         method=envelope.method,
-                        payload=fn(req).SerializeToString(),
+                        payload=fn(
+                            req, tenant_id=tenant or None
+                        ).SerializeToString(),
                     )
             except (AdmissionRejected, QuarantineRejected) as exc:
                 # expected under overload/drain (shed) or for a poison
@@ -100,5 +106,7 @@ class _Handler(socketserver.BaseRequestHandler):
             write_frame(sock, response.SerializeToString())
 
 
-def make_shim_server(engine, host: str = "127.0.0.1", port: int = 9090) -> ShimServer:
-    return ShimServer((host, port), engine)
+def make_shim_server(
+    engine, host: str = "127.0.0.1", port: int = 9090, tenants=None
+) -> ShimServer:
+    return ShimServer((host, port), engine, tenants=tenants)

@@ -15,6 +15,7 @@ import json
 
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.tenancy import TenantError, TenantRegistry
 from log_parser_tpu.serve.admission import shared_gate
 from log_parser_tpu.shim import logparser_pb2 as pb
 
@@ -33,48 +34,75 @@ class InvalidPodError(ValueError):
 # swallow their tracebacks (ADVICE.md r2).
 from log_parser_tpu.golden.engine import SnapshotValidationError  # noqa: E402
 
-CLIENT_ERRORS = (InvalidPodError, SnapshotValidationError, json.JSONDecodeError)
+CLIENT_ERRORS = (
+    InvalidPodError,
+    SnapshotValidationError,
+    json.JSONDecodeError,
+    TenantError,
+)
 
 
 class LogParserService:
-    """The six RPC bodies, protobuf-in/protobuf-out."""
+    """The six RPC bodies, protobuf-in/protobuf-out.
 
-    def __init__(self, engine):
+    Tenancy: every RPC takes an optional ``tenant_id`` resolved through
+    the shared :class:`~log_parser_tpu.runtime.tenancy.TenantRegistry`
+    (framed shim: ``method@tenant`` envelope suffix; gRPC: ``x-tenant``
+    metadata). None runs as the default tenant — the engine this service
+    wrapped — so tenant-unaware clients are untouched."""
+
+    def __init__(self, engine, tenants: TenantRegistry | None = None):
         self.engine = engine
         # the engine's own state lock — one lock across every transport
         self.lock = engine.state_lock
         # ... and the engine's one admission gate (serve/admission.py):
         # saturating the shim sheds on HTTP and vice versa
         self.admission = shared_gate(engine)
+        self.tenants = (
+            tenants
+            if tenants is not None
+            else TenantRegistry(engine, gate=self.admission)
+        )
+
+    def _ctx(self, tenant_id):
+        return self.tenants.resolve(tenant_id)
 
     # ----------------------------------------------------------------- parse
 
-    def parse(self, req: pb.ParseRequest) -> pb.ParseResponse:
+    def parse(
+        self, req: pb.ParseRequest, tenant_id: str | None = None
+    ) -> pb.ParseResponse:
         faults.fire("shim")
+        ctx = self._ctx(tenant_id)
+        engine = ctx.engine
         pod = json.loads(req.pod_json) if req.pod_json else None
         if pod is None:
             raise InvalidPodError()
         data = PodFailureData(pod=pod, logs=req.logs)
         # the shared gate may shed (AdmissionRejected propagates to the
         # transport: error envelope / RESOURCE_EXHAUSTED) or route this
-        # request to the host path under pressure
-        batcher = getattr(self.engine, "batcher", None)
-        route = self.admission.acquire(batchable=batcher is not None)
+        # request to the host path under pressure; the tenant quota
+        # refines it exactly as on the HTTP path
+        batcher = getattr(engine, "batcher", None)
+        n_lines = (req.logs.count("\n") + 1) if req.logs else 0
+        route = self.admission.acquire(
+            batchable=batcher is not None, tenant=ctx.quota, lines=n_lines
+        )
         try:
             if route == "host":
-                result = self.engine.analyze_host_routed(data)
+                result = engine.analyze_host_routed(data)
             elif batcher is not None:
                 # micro-batching on (framed shim AND gRPC run through this
                 # body): coalesce with concurrent arrivals under the
                 # gate's default deadline budget
-                result = self.engine.analyze_batched(
+                result = engine.analyze_batched(
                     data, self.admission.default_deadline_ms or None
                 )
             else:
                 # pipelined: only the finish phase takes self.lock (inside)
-                result = self.engine.analyze_pipelined(data)
+                result = engine.analyze_pipelined(data)
         finally:
-            self.admission.release()
+            self.admission.release(tenant=ctx.quota)
 
         resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
         for event in result.events:
@@ -118,41 +146,47 @@ class LogParserService:
 
     # ---------------------------------------------------- health + frequency
 
-    def health(self, req: pb.HealthRequest) -> pb.HealthResponse:
+    def health(
+        self, req: pb.HealthRequest, tenant_id: str | None = None
+    ) -> pb.HealthResponse:
         return pb.HealthResponse(status="UP")
 
     def frequency_stats(
-        self, req: pb.FrequencyStatsRequest
+        self, req: pb.FrequencyStatsRequest, tenant_id: str | None = None
     ) -> pb.FrequencyStatsResponse:
-        with self.lock:
-            stats = self.engine.frequency.get_frequency_statistics()
+        eng = self._ctx(tenant_id).engine
+        with eng.state_lock:
+            stats = eng.frequency.get_frequency_statistics()
         return pb.FrequencyStatsResponse(windowed_counts=stats)
 
     def frequency_reset(
-        self, req: pb.FrequencyResetRequest
+        self, req: pb.FrequencyResetRequest, tenant_id: str | None = None
     ) -> pb.FrequencyResetResponse:
-        with self.lock:
+        eng = self._ctx(tenant_id).engine
+        with eng.state_lock:
             if req.pattern_id:
-                self.engine.frequency.reset_pattern_frequency(req.pattern_id)
+                eng.frequency.reset_pattern_frequency(req.pattern_id)
             else:
-                self.engine.frequency.reset_all_frequencies()
+                eng.frequency.reset_all_frequencies()
         return pb.FrequencyResetResponse()
 
     def frequency_snapshot(
-        self, req: pb.FrequencySnapshotRequest
+        self, req: pb.FrequencySnapshotRequest, tenant_id: str | None = None
     ) -> pb.FrequencySnapshotResponse:
         resp = pb.FrequencySnapshotResponse()
-        with self.lock:
-            snap = self.engine.frequency.snapshot()
+        eng = self._ctx(tenant_id).engine
+        with eng.state_lock:
+            snap = eng.frequency.snapshot()
         for pid, ages in snap.items():
             resp.ages[pid].ages_seconds.extend(ages)
         return resp
 
     def frequency_restore(
-        self, req: pb.FrequencyRestoreRequest
+        self, req: pb.FrequencyRestoreRequest, tenant_id: str | None = None
     ) -> pb.FrequencyRestoreResponse:
-        with self.lock:
-            self.engine.frequency.restore(
+        eng = self._ctx(tenant_id).engine
+        with eng.state_lock:
+            eng.frequency.restore(
                 {pid: list(al.ages_seconds) for pid, al in req.ages.items()}
             )
         return pb.FrequencyRestoreResponse()

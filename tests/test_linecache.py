@@ -24,7 +24,12 @@ from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus, normalize_blob
 from log_parser_tpu.runtime import AnalysisEngine, faults
 from log_parser_tpu.runtime.faults import FaultRegistry
-from log_parser_tpu.runtime.linecache import LineCache, line_key
+from log_parser_tpu.runtime.linecache import (
+    KeyInterner,
+    LineCache,
+    dedup_slots,
+    line_key,
+)
 from log_parser_tpu.runtime.quarantine import QuarantineTable
 
 from conftest import FakeClock
@@ -498,3 +503,69 @@ def test_concurrent_cached_requests_thread_safe():
             (ln, pid) for ln, pid, _ in want
         ]
     assert _freq_counts(engine) == _freq_counts(serial)
+
+
+# ------------------------------------------- two-level keying (interner)
+
+
+class TestKeyInterner:
+    """dedup_slots with an interner must return digests bit-identical to
+    the blake2b path — cold, warm, across corpus shapes, past the
+    512-byte interning ceiling, and through eviction."""
+
+    def _parity(self, corpus, interner):
+        ref = dedup_slots(corpus)
+        got = dedup_slots(corpus, interner=interner)
+        assert ref is not None and got is not None
+        np.testing.assert_array_equal(ref[0], got[0])
+        np.testing.assert_array_equal(ref[1], got[1])
+        assert ref[2] == got[2]
+        np.testing.assert_array_equal(ref[3], got[3])
+
+    def test_cold_and_warm_parity(self):
+        lines = [
+            REPEAT_TEMPLATES[(i * 5) % len(REPEAT_TEMPLATES)]
+            for i in range(200)
+        ] + [f"novel line {i}" for i in range(40)]
+        corpus = Corpus("\n".join(lines))
+        interner = KeyInterner()
+        self._parity(corpus, interner)  # cold: every unique line inserts
+        cold = interner.stats()
+        assert cold["inserts"] > 0 and cold["collisions"] == 0
+        self._parity(corpus, interner)  # warm: pure probe hits
+        warm = interner.stats()
+        assert warm["inserts"] == cold["inserts"]
+        assert warm["probeHits"] >= cold["inserts"]
+        # a different corpus shape (other width bucket) stays exact
+        self._parity(Corpus("\n".join(lines + ["x" * 200])), interner)
+
+    def test_long_lines_stay_on_blake2b(self):
+        long = "L" + "x" * 600  # past the 64-word interning ceiling
+        corpus = Corpus("\n".join(["short line", long, "short line", long]))
+        interner = KeyInterner()
+        self._parity(corpus, interner)
+        self._parity(corpus, interner)
+        # the long line is never interned — it pays blake2b every pass
+        assert interner.stats()["entries"] <= 1
+
+    def test_eviction_keeps_parity(self):
+        # a budget of ~100 entries against 300 unique lines: every pass
+        # evicts, digests stay exact throughout
+        from log_parser_tpu.runtime.linecache import _INTERN_ENTRY_BYTES
+
+        interner = KeyInterner(budget_bytes=100 * _INTERN_ENTRY_BYTES)
+        for r in range(3):
+            lines = [f"round {r} line {i}" for i in range(300)]
+            self._parity(Corpus("\n".join(lines)), interner)
+        s = interner.stats()
+        assert s["evictions"] > 0
+        assert s["entries"] <= interner.max_entries
+
+    def test_engine_cache_path_uses_interner(self):
+        engine = _cached_engine()
+        data = _pod("\n".join(REPEAT_TEMPLATES))
+        engine.analyze_pipelined(data)
+        engine.analyze_pipelined(data)
+        s = engine.key_interner.stats()
+        assert s["inserts"] > 0
+        assert s["probeHits"] > 0

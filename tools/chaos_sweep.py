@@ -147,8 +147,29 @@ docs/OPS.md "Streaming follow-mode"):
                         (``openSessions`` 0, gate ``inflight`` 0) and
                         the server stays healthy.
 
+Tenant group (``--group tenant``; multi-tenant serving — docs/OPS.md
+"Multi-tenant serving"):
+
+- ``tenant-quota-shed``     one tenant's lines/s bucket empties under a
+                        run of requests — that tenant gets structured
+                        429s (``reason: tenant rate``, Retry-After ≥ 1)
+                        while the default tenant keeps answering 200;
+                        /trace/last pins ``admission.shedTenant`` and
+                        the tenant's ``quota.shedRate``.
+- ``tenant-evict-rebuild``  a bank budget sized for ~1.5 tenants forces
+                        LRU eviction when a second tenant arrives and a
+                        rebuild when the first returns — every request
+                        (including a concurrent default-tenant burst)
+                        still answers 200 and the ``tenants`` trace
+                        block shows ``evicted``/``rebuilds`` moving.
+- ``tenant-reload-isolated``  a hot pattern reload scoped to tenant A
+                        (``X-Tenant`` on ``POST /patterns/reload``)
+                        races a burst of tenant-B traffic — zero failed
+                        B requests, A's ``reloadEpoch`` bumps, B's and
+                        the default tenant's stay put.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|all]
                                    [--keep-logs]
 """
 
@@ -157,6 +178,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import signal
 import socket
 import subprocess
@@ -985,9 +1007,12 @@ STREAMING_SCENARIOS = [
 # ------------------------------------------------------- state scenarios
 
 
-def post_raw(url: str, path: str, data: bytes, timeout: float = 60.0):
+def post_raw(url: str, path: str, data: bytes, timeout: float = 60.0,
+             headers: dict | None = None):
     req = urllib.request.Request(
-        url + path, data=data, headers={"Content-Type": "application/json"}
+        url + path,
+        data=data,
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -1283,6 +1308,137 @@ DISTRIBUTED_SCENARIOS = [
 ]
 
 
+def _make_tenant_root(tmp: str, tenants=("acme", "globex")) -> str:
+    """A tenant library root: one sub-directory per tenant, each a copy
+    of the builtin pattern library (content identical on purpose — these
+    scenarios pin isolation mechanics, not per-tenant pattern authoring)."""
+    root = os.path.join(tmp, "tenants")
+    for tid in tenants:
+        shutil.copytree(PATTERN_DIR, os.path.join(root, tid))
+    return root
+
+
+def scenario_tenant_quota_shed():
+    """One tenant's lines/s bucket empties under a concurrent burst: the
+    over-quota requests get structured 429s with Retry-After while the
+    burst's head (and the default tenant) are served normally."""
+    with tempfile.TemporaryDirectory(prefix="chaos_tenant_") as tmp:
+        root = _make_tenant_root(tmp)
+        # PAYLOAD is 3 lines; lines/s 2 with the 2s burst window is a
+        # 4-token bucket — exactly one concurrent request fits
+        srv = Server(
+            "tenant-quota-shed",
+            ["--tenant-root", root, "--tenant-lines-per-s", "2"],
+            {},
+        )
+        try:
+            srv.wait_ready()
+            hdr = {"X-Tenant": "acme"}
+            # the burst also races first-touch resolution: one thread
+            # builds acme's bank, the rest coalesce on the build event
+            results = Burst(srv.url, 8, headers=hdr).join(timeout=180)
+            codes = [s for s, _ in results]
+            assert set(codes) <= {200, 429}, codes
+            assert codes.count(200) >= 1, codes
+            assert codes.count(429) >= 5, codes
+            for status, hdrs in results:
+                if status == 429:
+                    assert int(hdrs["Retry-After"]) >= 1, hdrs
+            # bucket still empty: a follow-up shows the structured body
+            status, body, _ = post(srv.url, hdr)
+            assert status == 429 and body["reason"] == "tenant rate", (
+                status, body,
+            )
+            # the default tenant's own bucket is untouched by acme's shed
+            assert post(srv.url)[0] == 200
+            _, trace = get(srv.url, "/trace/last")
+            assert trace["admission"]["shedTenant"] >= 5, trace["admission"]
+            quota = trace["tenants"]["perTenant"]["acme"]["quota"]
+            assert quota["shedRate"] >= 5, quota
+        finally:
+            srv.stop()
+
+
+def scenario_tenant_evict_rebuild():
+    """A bank budget sized for ~1.5 tenants: the second tenant's arrival
+    LRU-evicts the first, the first's return rebuilds it — all while a
+    concurrent default-tenant burst keeps answering 200 (builds happen
+    outside the registry lock, so nobody stalls behind a compile)."""
+    with tempfile.TemporaryDirectory(prefix="chaos_tenant_") as tmp:
+        root = _make_tenant_root(tmp)
+        # measure one bank's resident bytes off a probe server — the
+        # budget flag must land between 1x and 2x of a bank to force
+        # eviction on the second tenant without thrashing the first
+        probe = Server("tenant-evict-probe", ["--tenant-root", root], {})
+        try:
+            probe.wait_ready()
+            assert post(probe.url, {"X-Tenant": "acme"})[0] == 200
+            _, trace = get(probe.url, "/trace/last")
+            bank_mb = (
+                trace["tenants"]["perTenant"]["acme"]["bankBytes"] / 2**20
+            )
+        finally:
+            probe.stop()
+        srv = Server(
+            "tenant-evict-rebuild",
+            ["--tenant-root", root,
+             "--tenant-budget-mb", f"{bank_mb * 1.5:.4f}"],
+            {},
+        )
+        try:
+            srv.wait_ready()
+            assert post(srv.url, {"X-Tenant": "acme"})[0] == 200
+            burst = Burst(srv.url, 4)  # default-tenant load rides along
+            assert post(srv.url, {"X-Tenant": "globex"})[0] == 200  # evicts
+            assert post(srv.url, {"X-Tenant": "acme"})[0] == 200  # rebuilds
+            codes = sorted(s for s, _ in burst.join(timeout=180))
+            assert codes == [200] * 4, codes
+            _, trace = get(srv.url, "/trace/last")
+            t = trace["tenants"]
+            assert t["evicted"] >= 1, t
+            assert t["rebuilds"] >= 1, t
+            assert t["residentBankMb"] <= t["budgetMb"] + bank_mb + 1, t
+        finally:
+            srv.stop()
+
+
+def scenario_tenant_reload_isolated():
+    """A hot reload scoped to tenant A races a burst of tenant-B traffic:
+    the quiesce runs on A's engine alone, so every B request answers 200;
+    A's reloadEpoch bumps while B's and the default tenant's stay 0."""
+    with tempfile.TemporaryDirectory(prefix="chaos_tenant_") as tmp:
+        root = _make_tenant_root(tmp)
+        srv = Server("tenant-reload-isolated", ["--tenant-root", root], {})
+        try:
+            srv.wait_ready()
+            assert post(srv.url, {"X-Tenant": "acme"})[0] == 200
+            assert post(srv.url, {"X-Tenant": "globex"})[0] == 200
+            burst = Burst(srv.url, 6, headers={"X-Tenant": "globex"})
+            status, body = post_raw(
+                srv.url, "/patterns/reload", b"",
+                headers={"X-Tenant": "acme"},
+            )
+            codes = sorted(s for s, _ in burst.join(timeout=180))
+            assert codes == [200] * 6, codes
+            assert status == 200 and body["epoch"] == 1, (status, body)
+            _, trace = get(srv.url, "/trace/last")
+            per = trace["tenants"]["perTenant"]
+            assert per["acme"]["reloadEpoch"] == 1, per["acme"]
+            assert per["globex"]["reloadEpoch"] == 0, per["globex"]
+            assert per["default"]["reloadEpoch"] == 0, per["default"]
+        finally:
+            srv.stop()
+
+
+# tenant scenarios manage their own server lifecycle (the library root
+# must exist before the Server's flag list can reference it)
+TENANT_STANDALONE = [
+    ("tenant-quota-shed", scenario_tenant_quota_shed),
+    ("tenant-evict-rebuild", scenario_tenant_evict_rebuild),
+    ("tenant-reload-isolated", scenario_tenant_reload_isolated),
+]
+
+
 SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
@@ -1332,7 +1488,7 @@ def main(argv: list[str] | None = None) -> int:
         "--group",
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
-            "streaming", "distributed", "all",
+            "streaming", "distributed", "tenant", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1380,17 +1536,21 @@ def main(argv: list[str] | None = None) -> int:
                 failed += 1
                 rows.append((name, "FAIL", time.monotonic() - t0,
                              f"{exc} (log: {srv.log.name})"))
+    standalone = []
     if args.group in ("state", "all"):
-        for name, check in STATE_STANDALONE:
-            if args.only and name != args.only:
-                continue
-            t0 = time.monotonic()
-            try:
-                check()
-                rows.append((name, "PASS", time.monotonic() - t0, ""))
-            except Exception as exc:
-                failed += 1
-                rows.append((name, "FAIL", time.monotonic() - t0, str(exc)))
+        standalone.extend(STATE_STANDALONE)
+    if args.group in ("tenant", "all"):
+        standalone.extend(TENANT_STANDALONE)
+    for name, check in standalone:
+        if args.only and name != args.only:
+            continue
+        t0 = time.monotonic()
+        try:
+            check()
+            rows.append((name, "PASS", time.monotonic() - t0, ""))
+        except Exception as exc:
+            failed += 1
+            rows.append((name, "FAIL", time.monotonic() - t0, str(exc)))
     if args.group in ("distributed", "all"):
         for name, flags, env, check in DISTRIBUTED_SCENARIOS:
             if args.only and name != args.only:

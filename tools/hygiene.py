@@ -35,7 +35,13 @@ Checks (all files tracked by git, minus excluded dirs):
      the ``type`` field vocabulary of the NDJSON / gRPC frames a
      follow-mode session emits) has a row in docs/OPS.md (an operator
      reading a captured stream must be able to look up every frame
-     shape).
+     shape);
+ 13. the tenancy chaos vocabulary (``FAULT_SITES`` in runtime/tenancy.py)
+     is pinned in BOTH directions: every key has a docs/OPS.md row AND a
+     live ``faults.fire`` site somewhere in the package (check 8's
+     pattern cannot see fire calls that carry a waiver comment between
+     the paren and the site string, so the tenancy sites get their own
+     table-driven check).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -339,6 +345,45 @@ def check_stream_frames_documented(root: Path) -> list[str]:
     ]
 
 
+def check_tenancy_vocab_pinned(root: Path) -> list[str]:
+    """Check 13: the multi-tenant fault-site vocabulary (``FAULT_SITES``
+    in runtime/tenancy.py) must each have a docs/OPS.md row and a live
+    ``faults.fire`` call site in the package — pinning the table to the
+    docs and to reality. The fire-site scan tolerates a comment between
+    ``faults.fire(`` and the site string (conlint waivers live there),
+    which is exactly the shape check 8's stricter pattern skips."""
+    src = root / "log_parser_tpu" / "runtime" / "tenancy.py"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    problems: list[str] = []
+    for key in _dict_keys_of(src, "FAULT_SITES"):
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{src}: tenancy fault site {key!r} is not documented in "
+                "docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{src}: tenancy fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -365,6 +410,7 @@ def main() -> int:
         problems.extend(check_static_analyzers(root))
         problems.extend(check_kernel_reasons_documented(root))
         problems.extend(check_stream_frames_documented(root))
+        problems.extend(check_tenancy_vocab_pinned(root))
 
     for p in problems:
         print(p)

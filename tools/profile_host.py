@@ -76,6 +76,7 @@ def main() -> None:
     from log_parser_tpu.runtime import AnalysisEngine
     from log_parser_tpu.runtime.finalize import finalize_batch
     from log_parser_tpu.runtime.linecache import (
+        KeyInterner,
         dedup_slots,
         line_key,
         records_from_bits,
@@ -127,8 +128,49 @@ def main() -> None:
     report["key_scalar_s"] = round(t_min, 4)
     t_min, _ = timeit(lambda: dedup_slots(corpus), n=args.repeats)
     report["key_vec_s"] = round(t_min, 4)
+    # two-level keying: warm interner turns the per-unique-line blake2b
+    # into a vectorized probe64 + memcmp verify (first touch paid once in
+    # the warmup pass), the serving shape for repeat-heavy traffic
+    interner = KeyInterner()
+    dedup_slots(corpus, interner=interner)  # first touch: populate
+    t_min, _ = timeit(
+        lambda: dedup_slots(corpus, interner=interner), n=args.repeats
+    )
+    report["key_vec_interned_s"] = round(t_min, 4)
+    report["interner"] = interner.stats()
     line_slot, rep_lines, keys, counts = dedup_slots(corpus)
     report["unique_lines"] = len(keys)
+
+    # the digest sub-phase in isolation (the part the interner replaces;
+    # the lexsort dedup above it is shared by both lanes): per-unique
+    # blake2b vs warm probe64+verify digest recovery
+    kv = corpus.key_view()
+    blob, starts, ends = kv
+    nl = corpus.n_lines
+    starts, ends = starts[:nl], ends[:nl]
+    width = corpus.encoded.u8.shape[1]
+    lengths = (ends - starts).astype(np.int64)
+    kw = -(-(width + 8) // 8) * 8
+    km = np.zeros((nl, kw), dtype=np.uint8)
+    km[:, :width] = corpus.encoded.u8[:nl]
+    km[:, width : width + 8] = (
+        lengths.astype("<i8").reshape(nl, 1).view(np.uint8)
+    )
+    v64 = km.view("<i8")
+    s_l = starts[rep_lines].tolist()
+    e_l = ends[rep_lines].tolist()
+    t_min, _ = timeit(
+        lambda: [line_key(blob[a:b]) for a, b in zip(s_l, e_l)],
+        n=args.repeats,
+    )
+    report["digest_blake2b_s"] = round(t_min, 4)
+    t_min, _ = timeit(
+        lambda: interner.digests(
+            v64[rep_lines], lengths[rep_lines], width, blob, s_l, e_l
+        ),
+        n=args.repeats,
+    )
+    report["digest_interned_s"] = round(t_min, 4)
 
     # ---- extract + assemble: the cache-hit serving path ------------------
     sets = load_builtin_pattern_sets()
@@ -187,6 +229,9 @@ def main() -> None:
     )
     report["host_total_vec_s"] = round(
         report["ingest_vec_s"] + report["key_vec_s"], 4
+    )
+    report["host_total_interned_s"] = round(
+        report["ingest_vec_s"] + report["key_vec_interned_s"], 4
     )
     print(json.dumps(report))
 
