@@ -254,7 +254,12 @@ def tenant_residency_main() -> None:
         # request runs — churn is part of the measured figure on purpose
         for t, data in enumerate(datas):
             ctx = reg.resolve(f"tenant{t}")
-            result = ctx.engine.analyze(data)
+            try:
+                result = ctx.engine.analyze(data)
+            finally:
+                # release the resolve lease: a pinned context is
+                # eviction-proof, and this scenario MUST churn
+                ctx.unpin()
         return result
 
     result, _, dt = bench_common.measured_phase(bounded, sweep)

@@ -93,9 +93,10 @@ def probe64(v64: np.ndarray, lengths: np.ndarray, width: int) -> np.ndarray:
     zeros at every width, and padded-only words are skipped), so the
     same line yields the same probe across requests with different
     batch widths — the property the cross-request :class:`KeyInterner`
-    needs. Lines longer than ``width`` hash their truncated prefix; the
-    interner's memcmp verify keeps them exact (they land in collision
-    buckets instead of sharing an entry)."""
+    needs. Lines longer than ``width`` hash their truncated prefix — an
+    ambiguous key, which is why :meth:`KeyInterner.digests` never interns
+    them (the stored word row would be truncated too, so the memcmp
+    verify could not tell two same-length lines apart)."""
     n = v64.shape[0]
     wc_total = width // 8
     u = v64[:, :wc_total].view(np.uint64)
@@ -230,7 +231,12 @@ class KeyInterner:
         else:
             batch_words = np.zeros((n, _INTERN_WORDS), dtype=np.uint64)
             batch_words[:, :wc] = u
-            internable = np.ones(n, dtype=bool)
+            # rows longer than the device width are TRUNCATED in v64: two
+            # distinct lines sharing a width prefix (and length) would
+            # compare equal word-for-word and share one digest. They stay
+            # on blake2b — the same guard the wide branch applies at the
+            # interning ceiling.
+            internable = lengths <= width
         # comparing only the words any batch line can occupy is exact: an
         # entry with content past that point has a larger length, and the
         # length check fails first

@@ -28,7 +28,10 @@ single-tenant deployments behave exactly as before this module existed.
 
 Residency: non-default tenants build lazily from ``root/<id>/`` and are
 LRU-resident under ``--tenant-budget-mb``; eviction only takes idle
-tenants (no in-flight work, no open stream sessions), snapshots their
+tenants (no live request lease, no in-flight work, no open stream
+sessions — resolve() pins the context until the transport's release
+``finally``, so a request between resolution and admission still
+counts as busy), snapshots their
 journal, and the next resolve rebuilds from the libcache snapshot.
 
 Fault sites (tools/chaos_sweep.py --group tenant): ``tenant_resolve``
@@ -112,15 +115,22 @@ class TenantQuota:
         self.admitted = 0
         self.lines_admitted = 0
         self.shed_rate = 0
+        self.shed_oversize = 0
         self.shed_inflight = 0
         self.shed_queue = 0
 
     def debit_lines(self, lines: int) -> float | None:
         """Refill, then try to take ``lines`` tokens. Returns None when
         admitted, else the seconds until the bucket could cover the
-        request (the Retry-After hint). Caller holds the gate's _cv."""
+        request (the Retry-After hint) — ``inf`` when the request
+        declares more lines than the bucket can EVER hold, so the gate
+        sheds it as futile (413) instead of sending the client into a
+        permanent finite-Retry-After 429 loop. Caller holds the gate's
+        _cv."""
         if self.lines_per_s <= 0 or lines <= 0:
             return None
+        if lines > self._capacity:
+            return float("inf")
         now = self.clock()
         self._tokens = min(
             self._capacity,
@@ -130,8 +140,7 @@ class TenantQuota:
         if self._tokens >= lines:
             self._tokens -= lines
             return None
-        want = min(float(lines), self._capacity)
-        return max((want - self._tokens) / self.lines_per_s, 0.05)
+        return max((lines - self._tokens) / self.lines_per_s, 0.05)
 
     def stats(self) -> dict:
         return {
@@ -143,6 +152,7 @@ class TenantQuota:
             "admitted": self.admitted,
             "linesAdmitted": self.lines_admitted,
             "shedRate": self.shed_rate,
+            "shedOversize": self.shed_oversize,
             "shedInflight": self.shed_inflight,
             "shedQueue": self.shed_queue,
         }
@@ -200,6 +210,13 @@ class TenantContext:
         self.lint_mode = lint_mode
         self._reloader = None
         self._reloader_lock = threading.Lock()
+        # live request leases (resolve → transport finish). The quota's
+        # inflight/queued only exist from admission.acquire on; the pin
+        # covers the whole window so eviction can never close the engine
+        # under a request that holds the context but has not yet (or will
+        # never) pass the gate.
+        self._pins = 0
+        self._pins_lock = threading.Lock()
         self.bank_bytes = _bank_nbytes(engine.bank)
 
     def reloader(self):
@@ -220,10 +237,25 @@ class TenantContext:
         """Re-estimate residency after a swap changed the bank."""
         self.bank_bytes = _bank_nbytes(self.engine.bank)
 
+    def pin(self) -> "TenantContext":
+        """Lease this context for one request. :meth:`TenantRegistry.
+        resolve` pins every context it hands out; the transport unpins
+        when the request finishes (its release/cleanup ``finally``)."""
+        with self._pins_lock:
+            self._pins += 1
+        return self
+
+    def unpin(self) -> None:
+        with self._pins_lock:
+            self._pins -= 1
+
     def busy(self) -> bool:
-        """True while eviction would strand live work: in-flight or
-        queued requests, or open streaming sessions pinned to this
-        tenant's bank epoch."""
+        """True while eviction would strand live work: a resolved-but-
+        unreleased request lease, in-flight or queued requests, or open
+        streaming sessions pinned to this tenant's bank epoch."""
+        with self._pins_lock:
+            if self._pins > 0:
+                return True
         if self.quota.inflight > 0 or self.quota.queued > 0:
             return True
         mgr = getattr(self.engine, "stream_manager", None)
@@ -325,14 +357,21 @@ class TenantRegistry:
 
     def resolve(self, tenant_id: str | None) -> TenantContext:
         """Map a wire tenant id to its context, building on first use.
-        None/empty → default tenant (single-tenant back-compat)."""
+        None/empty → default tenant (single-tenant back-compat).
+
+        The returned context is PINNED: the caller must
+        :meth:`TenantContext.unpin` it when the request finishes (the
+        transports do so in the same ``finally`` that releases the
+        admission slot). The pin keeps eviction off the engine for the
+        whole request — the quota's inflight/queued counters only cover
+        the stretch after ``admission.acquire``."""
         faults.fire(  # conlint: contained-by-caller (transport error path)
             "tenant_resolve", key=tenant_id or DEFAULT_TENANT
         )
         if not tenant_id or tenant_id == DEFAULT_TENANT:
             with self._lock:
                 self.resolved += 1
-            return self.default_context
+            return self.default_context.pin()
         if not _ID_RE.match(tenant_id):
             with self._lock:
                 self.invalid += 1
@@ -344,6 +383,7 @@ class TenantRegistry:
                     self.resolved += 1
                     self._order.remove(tenant_id)
                     self._order.append(tenant_id)
+                    ctx.pin()  # before the evict pass: busy() must see it
                     # an eviction deferred while every candidate was busy
                     # retries here, as traffic flows
                     self._evict_over_budget()
@@ -381,6 +421,7 @@ class TenantRegistry:
             self.created += 1
             if tenant_id in self._evicted_ids:
                 self.rebuilds += 1
+            ctx.pin()
             self._evict_over_budget()
         pending.set()
         return ctx

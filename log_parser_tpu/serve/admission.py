@@ -63,10 +63,18 @@ class AdmissionRejected(Exception):
     RESOURCE_EXHAUSTED/UNAVAILABLE."""
 
     def __init__(self, reason: str, retry_after_s: int, status: int):
-        super().__init__(f"overloaded: {reason}; retry after {retry_after_s}s")
+        hint = (
+            f"retry after {retry_after_s}s"
+            if retry_after_s > 0
+            # 413-style futile shed: the same request can never fit, so
+            # promising a retry window would send the client into a loop
+            else "retrying will not help"
+        )
+        super().__init__(f"overloaded: {reason}; {hint}")
         self.reason = reason
         self.retry_after_s = retry_after_s
-        self.status = status  # HTTP mapping: 429 shed, 503 draining
+        self.status = status  # HTTP mapping: 429 shed, 503 draining,
+        # 413 request exceeds the tenant's burst capacity outright
 
 
 class AdmissionController:
@@ -138,7 +146,9 @@ class AdmissionController:
         TenantQuota` refining this shared gate per tenant: a lines/s
         token bucket debited with ``lines``, an in-flight cap, and a
         queue share — each shed as 429 before the request can crowd the
-        global bounds. Quota counters are mutated under ``_cv`` so they
+        global bounds (413 with no Retry-After when one request declares
+        more lines than the bucket's whole burst capacity: retrying it
+        is futile). Quota counters are mutated under ``_cv`` so they
         need no lock of their own.
         """
         if deadline_ms is None:
@@ -158,8 +168,15 @@ class AdmissionController:
             if tenant is not None:
                 wait_s = tenant.debit_lines(lines)
                 if wait_s is not None:
-                    tenant.shed_rate += 1
                     self.shed_tenant += 1
+                    if wait_s == float("inf"):
+                        # the request declares more lines than the bucket
+                        # can EVER hold: no Retry-After, 413 — a retry of
+                        # the same request is futile and the client must
+                        # know (split it or raise the tenant's burst)
+                        tenant.shed_oversize += 1
+                        raise AdmissionRejected("tenant burst", 0, 413)
+                    tenant.shed_rate += 1
                     raise AdmissionRejected(
                         "tenant rate", max(1, int(wait_s + 0.999)), 429
                     )

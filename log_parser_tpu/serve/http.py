@@ -170,9 +170,14 @@ class _Handler(BaseHTTPRequestHandler):
         answer the error (400 malformed / 404 unknown / 500 on an
         injected resolve fault) and return None. Requests without the
         header run as the default tenant — the engine the server booted
-        with — so pre-tenancy clients are untouched."""
+        with — so pre-tenancy clients are untouched.
+
+        The context comes back pinned (eviction-proof); the do_GET /
+        do_POST wrappers unpin it when the handler returns."""
         try:
-            return self.server.tenants.resolve(self.headers.get("X-Tenant"))
+            ctx = self.server.tenants.resolve(self.headers.get("X-Tenant"))
+            self._leases.append(ctx)
+            return ctx
         except TenantError as exc:
             self._send_json(
                 exc.status,
@@ -189,6 +194,24 @@ class _Handler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- routes
 
     def do_POST(self) -> None:
+        self._leases: list = []
+        try:
+            self._route_post()
+        finally:
+            # the request is answered: release the tenant lease so the
+            # context becomes evictable again
+            for ctx in self._leases:
+                ctx.unpin()
+
+    def do_GET(self) -> None:
+        self._leases = []
+        try:
+            self._route_get()
+        finally:
+            for ctx in self._leases:
+                ctx.unpin()
+
+    def _route_post(self) -> None:
         if self.path == "/parse":
             return self._parse()
         if self.path == "/parse/stream":
@@ -293,7 +316,7 @@ class _Handler(BaseHTTPRequestHandler):
         ctx.note_reloaded()
         return self._send_json(200, json.dumps(envelope).encode())
 
-    def do_GET(self) -> None:
+    def _route_get(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
             # draining: readiness fails (load balancers stop sending) but
             # liveness holds — in-flight work is still finishing
@@ -577,12 +600,18 @@ class _Handler(BaseHTTPRequestHandler):
                 lines=n_lines,
             )
         except AdmissionRejected as exc:
-            # shed (429) or draining (503) — either way tell the client
-            # when it is worth coming back
+            # shed (429) or draining (503): tell the client when it is
+            # worth coming back. A futile shed (413 `tenant burst` — the
+            # request exceeds the bucket's whole capacity) carries NO
+            # Retry-After: the same request can never be admitted.
             return self._send_json(
                 exc.status,
                 json.dumps({"error": "overloaded", "reason": exc.reason}).encode(),
-                headers={"Retry-After": str(exc.retry_after_s)},
+                headers=(
+                    {"Retry-After": str(exc.retry_after_s)}
+                    if exc.retry_after_s > 0
+                    else None
+                ),
             )
         try:
             log.info("Received analysis request for pod: %s", data.pod_name)

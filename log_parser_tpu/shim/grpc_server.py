@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
+from log_parser_tpu.runtime.tenancy import TenantError
 from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim.service import CLIENT_ERRORS, RPCS, LogParserService
 
@@ -42,6 +43,18 @@ def _tenant_of(context) -> str | None:
     return None
 
 
+def _tenant_code(exc: TenantError):
+    """Status for a refused tenant resolution: unknown tenant (404) is
+    NOT_FOUND — a typo or a not-yet-provisioned tenant — while a
+    malformed id (400) is INVALID_ARGUMENT, the same split the HTTP
+    transport answers."""
+    return (
+        grpc.StatusCode.NOT_FOUND
+        if exc.status == 404
+        else grpc.StatusCode.INVALID_ARGUMENT
+    )
+
+
 def _handlers(service: LogParserService):
     def wrap(fn):
         def unary(request, context):
@@ -61,6 +74,11 @@ def _handlers(service: LogParserService):
                 # poison fingerprint whose golden path also failed: same
                 # back-off semantics as a shed, scoped to one request
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+            except TenantError as exc:
+                # before the CLIENT_ERRORS clause (TenantError is in it):
+                # unknown tenant must surface as NOT_FOUND, not be
+                # flattened into INVALID_ARGUMENT with the malformed ids
+                context.abort(_tenant_code(exc), str(exc))
             except CLIENT_ERRORS as exc:
                 # client errors only: null pod, malformed JSON, invalid
                 # snapshot payloads. Internal bugs that surface as plain
@@ -91,7 +109,6 @@ def _stream_handlers(service: LogParserService):
     to that tenant's engine (and therefore its bank epoch) for its whole
     life, exactly like the HTTP stream path."""
     from log_parser_tpu.shim import logparser_stream_pb2 as spb
-    from log_parser_tpu.runtime.tenancy import TenantError
 
     def stream_parse(request_iterator, context):
         from log_parser_tpu.runtime.stream import shared_manager
@@ -99,36 +116,42 @@ def _stream_handlers(service: LogParserService):
         try:
             ctx = service.tenants.resolve(_tenant_of(context))
         except TenantError as exc:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-        mgr = shared_manager(ctx.engine)
+            context.abort(_tenant_code(exc), str(exc))
+        # the resolve lease holds until the RPC ends (generator close
+        # included), so eviction can never shut this tenant's stream
+        # manager down between resolution and the session open
         try:
-            sess = mgr.open()
-        except AdmissionRejected as exc:
-            context.abort(
-                grpc.StatusCode.UNAVAILABLE
-                if exc.reason == "draining"
-                else grpc.StatusCode.RESOURCE_EXHAUSTED,
-                str(exc),
-            )
-        try:
-            for chunk in request_iterator:
-                if chunk.data:
-                    for frame in sess.feed(bytes(chunk.data)):
-                        yield spb.StreamFrame(json=json.dumps(frame))
-                if sess.closed:
-                    # the session died on a fault/poison error frame: the
-                    # frame already went out, end the RPC cleanly
-                    return
-                if chunk.close:
-                    break
-            # explicit close chunk or client half-close: either way the
-            # final frames (and any tail-line scoring) flush here
-            for frame in sess.close():
-                yield spb.StreamFrame(json=json.dumps(frame))
+            mgr = shared_manager(ctx.engine)
+            try:
+                sess = mgr.open()
+            except AdmissionRejected as exc:
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE
+                    if exc.reason == "draining"
+                    else grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    str(exc),
+                )
+            try:
+                for chunk in request_iterator:
+                    if chunk.data:
+                        for frame in sess.feed(bytes(chunk.data)):
+                            yield spb.StreamFrame(json=json.dumps(frame))
+                    if sess.closed:
+                        # the session died on a fault/poison error frame:
+                        # the frame already went out, end the RPC cleanly
+                        return
+                    if chunk.close:
+                        break
+                # explicit close chunk or client half-close: either way
+                # the final frames (and any tail-line scoring) flush here
+                for frame in sess.close():
+                    yield spb.StreamFrame(json=json.dumps(frame))
+            finally:
+                if not sess.closed:
+                    # client vanished mid-stream (cancel / network drop)
+                    sess.kill("disconnect")
         finally:
-            if not sess.closed:
-                # client vanished mid-stream (cancel / network drop)
-                sess.kill("disconnect")
+            ctx.unpin()
 
     return {
         "StreamParse": grpc.stream_stream_rpc_method_handler(

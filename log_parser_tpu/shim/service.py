@@ -65,6 +65,10 @@ class LogParserService:
         )
 
     def _ctx(self, tenant_id):
+        """Resolve to a PINNED context — every RPC body unpins it in a
+        ``finally`` once the request is answered, so LRU eviction can
+        never close the engine under a request in flight (including the
+        stretch before admission.acquire)."""
         return self.tenants.resolve(tenant_id)
 
     # ----------------------------------------------------------------- parse
@@ -73,8 +77,14 @@ class LogParserService:
         self, req: pb.ParseRequest, tenant_id: str | None = None
     ) -> pb.ParseResponse:
         faults.fire("shim")
-        ctx = self._ctx(tenant_id)
-        engine = ctx.engine
+        tctx = self._ctx(tenant_id)
+        try:
+            return self._parse_leased(req, tctx)
+        finally:
+            tctx.unpin()
+
+    def _parse_leased(self, req: pb.ParseRequest, tctx) -> pb.ParseResponse:
+        engine = tctx.engine
         pod = json.loads(req.pod_json) if req.pod_json else None
         if pod is None:
             raise InvalidPodError()
@@ -86,7 +96,7 @@ class LogParserService:
         batcher = getattr(engine, "batcher", None)
         n_lines = (req.logs.count("\n") + 1) if req.logs else 0
         route = self.admission.acquire(
-            batchable=batcher is not None, tenant=ctx.quota, lines=n_lines
+            batchable=batcher is not None, tenant=tctx.quota, lines=n_lines
         )
         try:
             if route == "host":
@@ -102,7 +112,7 @@ class LogParserService:
                 # pipelined: only the finish phase takes self.lock (inside)
                 result = engine.analyze_pipelined(data)
         finally:
-            self.admission.release(tenant=ctx.quota)
+            self.admission.release(tenant=tctx.quota)
 
         resp = pb.ParseResponse(analysis_id=result.analysis_id or "")
         for event in result.events:
@@ -154,42 +164,58 @@ class LogParserService:
     def frequency_stats(
         self, req: pb.FrequencyStatsRequest, tenant_id: str | None = None
     ) -> pb.FrequencyStatsResponse:
-        eng = self._ctx(tenant_id).engine
-        with eng.state_lock:
-            stats = eng.frequency.get_frequency_statistics()
-        return pb.FrequencyStatsResponse(windowed_counts=stats)
+        tctx = self._ctx(tenant_id)
+        try:
+            eng = tctx.engine
+            with eng.state_lock:
+                stats = eng.frequency.get_frequency_statistics()
+            return pb.FrequencyStatsResponse(windowed_counts=stats)
+        finally:
+            tctx.unpin()
 
     def frequency_reset(
         self, req: pb.FrequencyResetRequest, tenant_id: str | None = None
     ) -> pb.FrequencyResetResponse:
-        eng = self._ctx(tenant_id).engine
-        with eng.state_lock:
-            if req.pattern_id:
-                eng.frequency.reset_pattern_frequency(req.pattern_id)
-            else:
-                eng.frequency.reset_all_frequencies()
-        return pb.FrequencyResetResponse()
+        tctx = self._ctx(tenant_id)
+        try:
+            eng = tctx.engine
+            with eng.state_lock:
+                if req.pattern_id:
+                    eng.frequency.reset_pattern_frequency(req.pattern_id)
+                else:
+                    eng.frequency.reset_all_frequencies()
+            return pb.FrequencyResetResponse()
+        finally:
+            tctx.unpin()
 
     def frequency_snapshot(
         self, req: pb.FrequencySnapshotRequest, tenant_id: str | None = None
     ) -> pb.FrequencySnapshotResponse:
         resp = pb.FrequencySnapshotResponse()
-        eng = self._ctx(tenant_id).engine
-        with eng.state_lock:
-            snap = eng.frequency.snapshot()
-        for pid, ages in snap.items():
-            resp.ages[pid].ages_seconds.extend(ages)
-        return resp
+        tctx = self._ctx(tenant_id)
+        try:
+            eng = tctx.engine
+            with eng.state_lock:
+                snap = eng.frequency.snapshot()
+            for pid, ages in snap.items():
+                resp.ages[pid].ages_seconds.extend(ages)
+            return resp
+        finally:
+            tctx.unpin()
 
     def frequency_restore(
         self, req: pb.FrequencyRestoreRequest, tenant_id: str | None = None
     ) -> pb.FrequencyRestoreResponse:
-        eng = self._ctx(tenant_id).engine
-        with eng.state_lock:
-            eng.frequency.restore(
-                {pid: list(al.ages_seconds) for pid, al in req.ages.items()}
-            )
-        return pb.FrequencyRestoreResponse()
+        tctx = self._ctx(tenant_id)
+        try:
+            eng = tctx.engine
+            with eng.state_lock:
+                eng.frequency.restore(
+                    {pid: list(al.ages_seconds) for pid, al in req.ages.items()}
+                )
+            return pb.FrequencyRestoreResponse()
+        finally:
+            tctx.unpin()
 
 
 # (method name, request type, response type) — the service surface, used by

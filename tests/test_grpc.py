@@ -75,6 +75,59 @@ def test_null_pod_is_invalid_argument(stubs):
     assert "Invalid PodFailureData" in err.value.details()
 
 
+def test_unknown_tenant_is_not_found(stubs):
+    """404-class tenant errors keep their identity on the wire: an
+    unknown tenant is NOT_FOUND (a typo or a not-yet-provisioned
+    tenant), not INVALID_ARGUMENT — the same split the HTTP transport
+    answers with 404 vs 400."""
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs["Parse"](
+            pb.ParseRequest(
+                pod_json=json.dumps({"metadata": {"name": "w"}}), logs="x"
+            ),
+            metadata=(("x-tenant", "ghost"),),
+        )
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "ghost" in err.value.details()
+
+
+def test_malformed_tenant_id_is_invalid_argument(stubs):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs["FrequencyStats"](
+            pb.FrequencyStatsRequest(), metadata=(("x-tenant", "../evil"),)
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_stream_unknown_tenant_is_not_found():
+    from log_parser_tpu.shim import logparser_stream_pb2 as spb
+    from log_parser_tpu.shim import make_stream_stub
+
+    import grpc
+
+    sets = [make_pattern_set([make_pattern("e", regex="ERROR")])]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    server, port = make_grpc_server(engine, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stub = make_stream_stub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                list(
+                    stub(
+                        iter([spb.StreamChunk(close=True)]),
+                        metadata=(("x-tenant", "ghost"),),
+                    )
+                )
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        server.stop(grace=None)
+
+
 def test_frequency_surface(stubs):
     stubs["Parse"](
         pb.ParseRequest(

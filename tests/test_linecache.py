@@ -548,6 +548,28 @@ class TestKeyInterner:
         # the long line is never interned — it pays blake2b every pass
         assert interner.stats()["entries"] <= 1
 
+    def test_truncated_rows_never_intern(self):
+        """Regression: under a narrow device width (< the 512-byte
+        interning ceiling), rows longer than the width are TRUNCATED in
+        the key matrix. Two distinct long lines sharing a width prefix
+        and a byte length must not share a digest — the second warm
+        pass used to probe-hit the first line's entry and serve its
+        blake2b key (and therefore its cached match bits)."""
+        shorts = [f"short {i:04d}" for i in range(600)]
+        prefix = "P" * 120
+        a = prefix + "A" * 40
+        b = prefix + "B" * 40  # differs only past the device width
+        corpus = Corpus("\n".join(shorts + [a, b]))
+        width = corpus.encoded.u8.shape[1]
+        assert width < len(a), "corpus must exercise the truncated branch"
+        interner = KeyInterner()
+        self._parity(corpus, interner)  # cold: both pay blake2b
+        self._parity(corpus, interner)  # warm: B must NOT reuse A's key
+        keys = dedup_slots(corpus, interner=interner)[2]
+        assert keys[-1] != keys[-2]
+        assert keys[-2] == line_key(a.encode())
+        assert keys[-1] == line_key(b.encode())
+
     def test_eviction_keeps_parity(self):
         # a budget of ~100 entries against 300 unique lines: every pass
         # evicts, digests stay exact throughout

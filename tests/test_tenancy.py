@@ -6,7 +6,10 @@ single-tenant engine run of its subsequence alone — unbatched, batched,
 and streaming, line cache on and off. Around it: per-tenant state
 non-bleed (frequency, line cache, quarantine), the quota 429 envelope
 (Retry-After + ``tenant rate``/``tenant inflight``/``tenant queue``
-reasons), tenant-scoped hot reload that provably never quiesces another
+reasons, plus the futile 413 ``tenant burst`` shed with NO Retry-After
+for requests larger than the bucket's whole capacity), the resolve
+lease (a pinned context is eviction-proof from resolution to the
+transport's release), tenant-scoped hot reload that provably never quiesces another
 tenant's engine, LRU eviction/rebuild under a bank budget, id
 validation, and the two-level line-cache keying parity pin
 (KeyInterner ≡ blake2b digests).
@@ -317,6 +320,31 @@ class TestQuota:
         assert q.stats()["shedQueue"] == 1
         gate.release(tenant=other)
 
+    def test_oversize_request_sheds_413_futile(self):
+        """A request declaring more lines than the bucket can EVER hold
+        (capacity = lines_per_s × burst) must not get a small finite
+        Retry-After — that used to send the client into a permanent 429
+        loop. It sheds 413 ``tenant burst`` with retry_after_s == 0."""
+        clk = _Clock()
+        gate = AdmissionController(clock=clk)
+        q = TenantQuota(lines_per_s=2.0, clock=clk)  # 4-token bucket
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.acquire(tenant=q, lines=5)
+        assert exc.value.reason == "tenant burst"
+        assert exc.value.status == 413
+        assert exc.value.retry_after_s == 0
+        assert "retrying will not help" in str(exc.value)
+        assert q.stats()["shedOversize"] == 1
+        assert q.stats()["shedRate"] == 0
+        # time cannot help: the same request is still futile much later
+        clk.t += 3600.0
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.acquire(tenant=q, lines=5)
+        assert exc.value.status == 413
+        # a request that fits the whole burst still admits normally
+        assert gate.acquire(tenant=q, lines=4) == "device"
+        gate.release(tenant=q)
+
     def test_streams_bypass_the_bucket(self):
         # a session open carries lines=0: the bucket never debits
         clk = _Clock()
@@ -345,22 +373,26 @@ def _post(url, payload, headers=None):
 
 
 class TestHTTPQuotaEnvelope:
+    def _serve(self, reg):
+        server = make_server(reg.default_engine, "127.0.0.1", 0, tenants=reg)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, f"http://127.0.0.1:{port}/parse"
+
     def test_429_with_retry_after(self, root):
-        # 2-token bucket for acme only: its 3-line request can NEVER fit,
-        # while globex and the default tenant are unbounded
+        # 4-token bucket for acme only: its 3-line request fits ONCE,
+        # then the drained bucket sheds with a real retry window, while
+        # globex and the default tenant are unbounded
         reg = _registry(
             root,
             quota_factory=lambda tid: TenantQuota(
-                lines_per_s=1.0 if tid == "acme" else 0.0
+                lines_per_s=2.0 if tid == "acme" else 0.0
             ),
         )
-        server = make_server(reg.default_engine, "127.0.0.1", 0, tenants=reg)
-        port = server.server_address[1]
-        thread = threading.Thread(target=server.serve_forever, daemon=True)
-        thread.start()
-        url = f"http://127.0.0.1:{port}/parse"
+        server, url = self._serve(reg)
         payload = {"pod": {"metadata": {"name": "q"}}, "logs": TRAFFIC[0]}
         try:
+            assert _post(url, payload, {"X-Tenant": "acme"})[0] == 200
             status, body, headers = _post(
                 url, payload, {"X-Tenant": "acme"}
             )
@@ -369,6 +401,31 @@ class TestHTTPQuotaEnvelope:
             assert int(headers["Retry-After"]) >= 1
             assert _post(url, payload, {"X-Tenant": "globex"})[0] == 200
             assert _post(url, payload)[0] == 200
+        finally:
+            server.shutdown()
+            server.server_close()
+            reg.shutdown()
+
+    def test_oversize_request_is_413_without_retry_after(self, root):
+        # 2-token bucket: acme's 3-line request can NEVER fit — the shed
+        # must say so (413, no Retry-After) instead of promising a
+        # retry window that will never help
+        reg = _registry(
+            root,
+            quota_factory=lambda tid: TenantQuota(
+                lines_per_s=1.0 if tid == "acme" else 0.0
+            ),
+        )
+        server, url = self._serve(reg)
+        payload = {"pod": {"metadata": {"name": "q"}}, "logs": TRAFFIC[0]}
+        try:
+            status, body, headers = _post(
+                url, payload, {"X-Tenant": "acme"}
+            )
+            assert status == 413, body
+            assert body == {"error": "overloaded", "reason": "tenant burst"}
+            assert "Retry-After" not in headers
+            assert _post(url, payload, {"X-Tenant": "globex"})[0] == 200
         finally:
             server.shutdown()
             server.server_close()
@@ -440,7 +497,9 @@ class TestResidency:
         try:
             first = reg.resolve("acme")
             assert _events(first.engine.analyze(_data(TRAFFIC[0])))
-            reg.resolve("globex")  # over budget: acme (LRU, idle) evicted
+            first.unpin()  # request finished: the resolve lease ends
+            # over budget: acme (LRU, idle) evicted
+            reg.resolve("globex").unpin()
             assert reg.evicted == 1
             assert reg.context_if_resident("acme") is None
             rebuilt = reg.resolve("acme")  # rebuilds (and evicts globex)
@@ -457,11 +516,34 @@ class TestResidency:
         reg = _registry(root, budget_mb=0.001)  # everything is over budget
         try:
             ctx = reg.resolve("acme")
+            ctx.unpin()  # lease released: quota state alone drives this
             ctx.quota.inflight = 1  # in-flight request holds the engine
-            reg.resolve("globex")
+            reg.resolve("globex").unpin()
             assert reg.context_if_resident("acme") is ctx  # deferred
             ctx.quota.inflight = 0
-            reg.resolve("globex")  # next resolve evicts the idle LRU
+            # next resolve evicts the idle LRU
+            reg.resolve("globex").unpin()
+            assert reg.context_if_resident("acme") is None
+        finally:
+            reg.shutdown()
+
+    def test_resolve_lease_pins_until_released(self, root):
+        """The resolve→acquire window (review finding): a request holds
+        its context from resolve() until the transport's release, with
+        quota.inflight/queued still zero. Another tenant's resolve in
+        that window must NOT evict and close() the engine out from
+        under it — the pin makes the context busy for its whole life."""
+        reg = _registry(root, budget_mb=0.001)  # everything is over budget
+        try:
+            ctx = reg.resolve("acme")  # pinned, no quota state yet
+            assert ctx.quota.inflight == 0 and ctx.quota.queued == 0
+            reg.resolve("globex").unpin()
+            # acme survived: its journal/batcher were not closed under
+            # the request that is still holding the context
+            assert reg.context_if_resident("acme") is ctx
+            assert _events(ctx.engine.analyze(_data(TRAFFIC[0])))
+            ctx.unpin()  # transport finished: lease ends, eviction may run
+            reg.resolve("globex").unpin()
             assert reg.context_if_resident("acme") is None
         finally:
             reg.shutdown()
