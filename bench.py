@@ -49,6 +49,18 @@ LINE_CACHE_MB = (
     if "--line-cache-mb" in sys.argv
     else 0.0
 )
+# --novel-ratio R: carve ~R of each repeat corpus into unseen
+# generated-template lines (bench_common.NOVEL_TEMPLATES) — guaranteed
+# cache misses shaped for the template miner. --miner: run the miner
+# (review mode, so the bank never changes mid-measure) against that miss
+# stream and embed its tap/cluster counters in the artifact; the
+# BENCH_r12 companions are the same command with and without it.
+NOVEL_RATIO = (
+    float(sys.argv[sys.argv.index("--novel-ratio") + 1])
+    if "--novel-ratio" in sys.argv
+    else 0.0
+)
+MINER = "--miner" in sys.argv
 # Distinct request payloads the repeat-mode stream cycles through. The
 # line cache is a CROSS-request tier: with a single fixed payload every
 # line (unique fillers included) becomes a hit after request #1 and the
@@ -97,6 +109,10 @@ def main() -> None:
         metric += f"_rr{int(round(REPEAT_RATIO * 100)):02d}"
     if LINE_CACHE_MB > 0:
         metric += "_lc"
+    if NOVEL_RATIO > 0:
+        metric += f"_nv{int(round(NOVEL_RATIO * 100)):02d}"
+    if MINER:
+        metric += "_miner"
     platform = bench_common.probe_backend(metric, "lines/s")
 
     from log_parser_tpu.config import ScoringConfig
@@ -144,13 +160,19 @@ def main() -> None:
     assert not engine.fallback_to_golden, "bench must never serve from golden"
     if LINE_CACHE_MB > 0:
         engine.enable_line_cache(LINE_CACHE_MB)
+    if MINER:
+        assert LINE_CACHE_MB > 0, "--miner rides the line cache"
+        # review mode: the worker drains/clusters (the cost under test)
+        # but never swaps the bank mid-measure
+        engine.enable_miner(mode="review")
     if REPEAT_RATIO is not None:
         rng = random.Random(0xC0FFEE)
         pool = [
             PodFailureData(
                 pod={"metadata": {"name": "bench"}},
                 logs=bench_common.repeat_corpus(
-                    N_LINES, REPEAT_RATIO, f"r{t}", rng
+                    N_LINES, REPEAT_RATIO, f"r{t}", rng,
+                    novel_ratio=NOVEL_RATIO,
                 ),
             )
             for t in range(REPEAT_POOL_REQUESTS)
@@ -231,6 +253,11 @@ def main() -> None:
     if engine.line_cache is not None:
         extra["line_cache_mb"] = LINE_CACHE_MB
         extra["line_cache"] = engine.line_cache.stats()
+    if NOVEL_RATIO > 0:
+        extra["novel_ratio"] = NOVEL_RATIO
+    if engine.miner is not None:
+        extra["miner"] = engine.miner.stats()
+        engine.miner.stop()
     from log_parser_tpu.utils import xlacache
 
     extra["boot_seconds"] = round(boot_seconds, 3)

@@ -223,13 +223,42 @@ def hash01(x: int) -> float:
     return x / 4294967296.0
 
 
-def repeat_corpus(n: int, ratio: float, tag: str, rng) -> str:
+# ``--novel-ratio`` (bench.py): unseen generated-template lines for the
+# template miner (log_parser_tpu/mining/). Each is a fixed token skeleton
+# with numeric wildcard slots — exactly the shape the clusterer groups —
+# and none appears in REPEAT_TEMPLATES or matches a builtin pattern, so
+# every draw is a guaranteed line-cache miss feeding the miner tap.
+NOVEL_TEMPLATES = (
+    "replication backlog drained on shard {a} after {b} entries",
+    "checkpoint upload finished for epoch {a} in {b} ms",
+    "frobnicator subsystem rebalanced queue {a} depth {b}",
+    "thermal governor stepped clock domain {a} to {b} mhz",
+)
+
+
+def novel_line(u: float, i: int) -> str:
+    """Map uniform ``u`` and a line index to a generated-template line:
+    the skeleton repeats, the slot values never do."""
+    tmpl = NOVEL_TEMPLATES[int(u * len(NOVEL_TEMPLATES)) % len(NOVEL_TEMPLATES)]
+    return tmpl.format(a=i % 8191, b=(i * 37) % 9973)
+
+
+def repeat_corpus(
+    n: int, ratio: float, tag: str, rng, novel_ratio: float = 0.0
+) -> str:
     """``n`` lines, ~``ratio`` of them zipf template draws, the rest
     unique filler stamped with ``tag``. Every ~997th filler still carries
-    a matching ERROR so the stream produces events at any ratio."""
+    a matching ERROR so the stream produces events at any ratio.
+
+    ``novel_ratio`` carves that fraction of lines into unseen
+    generated-template draws (:data:`NOVEL_TEMPLATES`) for miner benches;
+    the default 0.0 takes no extra RNG draws, so miner-off corpora are
+    bit-identical to pre-knob ones."""
     rows = []
     for i in range(n):
-        if rng.random() < ratio:
+        if novel_ratio and rng.random() < novel_ratio:
+            rows.append(novel_line(rng.random(), i))
+        elif rng.random() < ratio:
             rows.append(zipf_template(rng.random()))
         elif i % 997 == 701:
             rows.append(

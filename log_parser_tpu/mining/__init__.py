@@ -1,0 +1,38 @@
+"""Self-growing pattern library: online template mining from the
+line-cache miss stream.
+
+The loop (docs/ARCHITECTURE.md "Self-growing pattern library"):
+
+    miss tap (runtime/linecache.MissTap)
+      → online clusterer (templates.TemplateClusterer)
+      → synthesizer (synthesize.synthesize)
+      → admission (admit.vet_candidate / admit.admit_candidate)
+      → review parking or canary + quiesced swap
+
+Enabled per engine via ``AnalysisEngine.enable_miner`` (serve flag
+``--miner``); per-tenant state lives beside the tenant WAL.
+"""
+
+from log_parser_tpu.mining.admit import (
+    REJECT_REASONS,
+    Rejection,
+    admit_candidate,
+    vet_candidate,
+)
+from log_parser_tpu.mining.miner import FAULT_SITES, MODES, TemplateMiner
+from log_parser_tpu.mining.synthesize import candidate_yaml, synthesize
+from log_parser_tpu.mining.templates import TemplateClusterer, tokenize
+
+__all__ = [
+    "REJECT_REASONS",
+    "Rejection",
+    "admit_candidate",
+    "vet_candidate",
+    "FAULT_SITES",
+    "MODES",
+    "TemplateMiner",
+    "candidate_yaml",
+    "synthesize",
+    "TemplateClusterer",
+    "tokenize",
+]

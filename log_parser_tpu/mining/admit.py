@@ -1,0 +1,243 @@
+"""Admission pipeline for mined candidate patterns.
+
+A synthesized candidate is never trusted: it runs the SAME gates a
+hand-authored hot reload runs, plus one the reload ladder does not —
+an explicit exact subsumption check against every curated primary.
+Stages, in order (cheapest first):
+
+1. **compile/tier** — the candidate's regex must compile through the
+   bank's own entry points and land on a device tier with a byte-class
+   DFA (``classify_regex``); no DFA means the subsumption gate cannot
+   verify it, and an unverifiable candidate is rejected, not admitted;
+2. **subsumption** — product-DFA comparison (analysis/subsumption.py)
+   against every curated primary: a mined pattern whose language
+   equals, strictly contains, or is strictly contained by a curated one
+   is rejected with a structured reason — shadowing a curated pattern
+   silently is the one failure mode this subsystem must never have;
+3. **lint** — the full static-analysis pass (ReDoS heuristics, schema)
+   over the candidate set; any gating finding rejects;
+4. **canary + swap** (auto mode / review approval only) — the reload
+   ladder's candidate build and device-vs-golden canary over the merged
+   library, then the atomic quiesced ``apply_library`` swap.
+
+Every rejection carries a stable reason code from :data:`REJECT_REASONS`
+(tools/hygiene.py check 14 pins each code to a docs/PATTERNS.md row),
+surfaces on ``/trace/last`` under ``miner.rejected``, and leaves the
+serving bank object-identical — pinned by tests/test_mining.py and the
+``tools/chaos_sweep.py --group miner`` drill.
+"""
+
+from __future__ import annotations
+
+from log_parser_tpu.analysis import subsumption
+from log_parser_tpu.analysis.lint import lint_pattern_sets
+from log_parser_tpu.analysis.tiers import classify_regex
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.runtime import faults
+
+# rejection-reason vocabulary (stable codes; check 14 pins each to a
+# docs/PATTERNS.md row the same way check 13 pins tenancy FAULT_SITES)
+REJECT_REASONS: dict[str, str] = {
+    "mined-compile": "candidate regex failed the bank's compile entry points",
+    "mined-tier": "candidate regex landed off the DFA-capable device tiers, "
+    "so exact subsumption verification is impossible",
+    "mined-duplicate-id": "a pattern with the candidate's id is already in "
+    "the serving library",
+    "mined-duplicate": "candidate language equals a curated pattern's "
+    "(product-DFA EQUAL)",
+    "mined-shadows-curated": "candidate language strictly contains a curated "
+    "pattern's — admitting it would shadow the curated pattern",
+    "mined-shadowed": "candidate language is strictly contained in a curated "
+    "pattern's — every mined match already fires the curated pattern",
+    "mined-undecided": "product-DFA budget exceeded before the relation was "
+    "decided; undecidable candidates are rejected, never admitted",
+    "mined-lint": "the static-analysis pass raised a gating finding",
+    "mined-canary": "candidate build or device-vs-golden canary failed",
+    "mined-swap": "the quiesced library swap failed or timed out (for "
+    "example racing a concurrent curated reload); retried, not admitted",
+    "mined-fault": "admission raised unexpectedly (injected miner_admit "
+    "fault or a real defect); the candidate is rejected, the bank "
+    "untouched",
+}
+
+# transient rejections the miner may retry on a later pump; everything
+# else is a terminal verdict for that template
+RETRYABLE_REASONS = frozenset({"mined-swap"})
+
+
+class Rejection(Exception):
+    """Structured admission rejection — reason ∈ :data:`REJECT_REASONS`."""
+
+    def __init__(self, reason: str, detail: str, findings: list | None = None):
+        assert reason in REJECT_REASONS, reason
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+        self.detail = detail
+        self.findings = findings or []
+
+    def to_json(self) -> dict:
+        out = {"reason": self.reason, "detail": self.detail}
+        if self.findings:
+            out["findings"] = self.findings
+        return out
+
+
+def _candidate_pattern(candidate: PatternSet):
+    pats = candidate.patterns or []
+    if len(pats) != 1 or pats[0].primary_pattern is None:
+        raise Rejection(
+            "mined-compile",
+            "candidate set must carry exactly one primary-bearing pattern",
+        )
+    return pats[0]
+
+
+def vet_candidate(
+    engine,
+    candidate: PatternSet,
+    *,
+    max_product_states: int = subsumption.DEFAULT_MAX_PRODUCT_STATES,
+) -> dict:
+    """Stages 1-3 (compile/tier, subsumption, lint) — everything short of
+    touching the serving library. Raises :class:`Rejection`; returns the
+    candidate's tier prediction summary on success. ``review`` mode runs
+    exactly this before parking a candidate."""
+    try:
+        faults.fire("miner_admit")
+        pat = _candidate_pattern(candidate)
+        regex = pat.primary_pattern.regex
+
+        # ---- stage 1: the bank's own compile entry points -------------
+        pred = classify_regex(regex)
+        if pred.tier == "skipped":
+            raise Rejection(
+                "mined-compile",
+                f"{pred.reason_code}: {pred.detail}",
+            )
+        if pred.dfa is None:
+            raise Rejection(
+                "mined-tier",
+                f"tier {pred.tier} ({pred.reason_code}): no byte-class DFA "
+                "to verify subsumption against",
+            )
+
+        # ---- stage 2: exact subsumption vs every curated primary ------
+        live_ids = {
+            p.id
+            for ps in engine.bank.pattern_sets
+            for p in ps.patterns or []
+        }
+        if pat.id in live_ids:
+            raise Rejection(
+                "mined-duplicate-id", f"pattern id {pat.id!r} already serves"
+            )
+        for ps in engine.bank.pattern_sets:
+            for cur in ps.patterns or []:
+                if cur.primary_pattern is None or not cur.primary_pattern.regex:
+                    continue
+                cur_rx = cur.primary_pattern.regex
+                if cur_rx == regex:
+                    raise Rejection(
+                        "mined-duplicate",
+                        f"regex is byte-identical to curated {cur.id!r}",
+                    )
+                cur_pred = classify_regex(cur_rx)
+                if cur_pred.dfa is None:
+                    # a host-tier curated pattern has no DFA to compare;
+                    # the byte-identity check above is the only exact
+                    # statement available (documented limitation)
+                    continue
+                rel = subsumption.compare_dfas(
+                    pred.dfa,
+                    cur_pred.dfa,
+                    max_product_states=max_product_states,
+                )
+                if rel == subsumption.EQUAL:
+                    raise Rejection(
+                        "mined-duplicate",
+                        f"language equals curated {cur.id!r}",
+                    )
+                if rel == subsumption.B_IN_A:
+                    raise Rejection(
+                        "mined-shadows-curated",
+                        f"language strictly contains curated {cur.id!r}",
+                    )
+                if rel == subsumption.A_IN_B:
+                    raise Rejection(
+                        "mined-shadowed",
+                        f"language strictly contained in curated {cur.id!r}",
+                    )
+                if rel == subsumption.UNDECIDED:
+                    raise Rejection(
+                        "mined-undecided",
+                        f"budget exceeded comparing against {cur.id!r}",
+                    )
+
+        # ---- stage 3: the lint gate (ReDoS + schema) ------------------
+        # subsumption is off here: stage 2 just answered it exactly for
+        # the only new pattern, and re-walking every curated pair per
+        # candidate would be O(library²) for nothing
+        report = lint_pattern_sets([candidate], check_subsumption=False)
+        if report.gating:
+            raise Rejection(
+                "mined-lint",
+                "; ".join(
+                    f"{f.rule}: {f.detail}" for f in report.gating_findings
+                ),
+                findings=[f.to_json() for f in report.gating_findings],
+            )
+        return {"tier": pred.tier, "bitCapable": pred.bit_capable}
+    except Rejection:
+        raise
+    except Exception as exc:  # noqa: BLE001 — injected miner_admit fault or a
+        # real admission defect: either way the verdict is a structured
+        # rejection, never an escaped exception (the miner thread and the
+        # HTTP review surface both rely on this containment)
+        raise Rejection("mined-fault", repr(exc)[:300]) from exc
+
+
+def admit_candidate(
+    engine,
+    candidate: PatternSet,
+    *,
+    timeout_s: float = 30.0,
+    max_product_states: int = subsumption.DEFAULT_MAX_PRODUCT_STATES,
+) -> dict:
+    """The full ladder: vet, then candidate build + canary over the
+    merged library, then the atomic quiesced swap. Raises
+    :class:`Rejection`; returns the admission envelope on success."""
+    from log_parser_tpu.runtime.reload import (
+        ReloadError,
+        build_candidate,
+        canary_validate,
+    )
+
+    vet = vet_candidate(
+        engine, candidate, max_product_states=max_product_states
+    )
+    merged = list(engine.bank.pattern_sets) + [candidate]
+    try:
+        source = build_candidate(
+            merged, engine.config, engine_clock=engine.frequency.clock
+        )
+        canary_events = canary_validate(source)
+    except ReloadError as exc:
+        raise Rejection(
+            "mined-canary", f"{exc.stage}: {exc.reason}"
+        ) from exc
+    except Rejection:
+        raise
+    except Exception as exc:  # noqa: BLE001 — same containment as vet
+        raise Rejection("mined-fault", repr(exc)[:300]) from exc
+    try:
+        epoch = engine.apply_library(source, timeout_s=timeout_s)
+    except (TimeoutError, RuntimeError) as exc:
+        raise Rejection("mined-swap", str(exc)) from exc
+    pat = _candidate_pattern(candidate)
+    return {
+        "status": "admitted",
+        "id": pat.id,
+        "epoch": epoch,
+        "canaryEvents": canary_events,
+        **vet,
+    }

@@ -1,0 +1,308 @@
+"""The template miner: background consumer of the line-cache miss tap.
+
+One :class:`TemplateMiner` per engine (the default engine and every
+resident tenant engine own one — state namespaced beside the tenant's
+WAL under ``state_dir/mined/``). The worker thread drains the
+:class:`~log_parser_tpu.runtime.linecache.MissTap`, feeds the online
+clusterer, and pushes each newly-stable template through synthesis and
+the admission pipeline:
+
+- ``review`` (default): candidates that pass the vet gates (compile,
+  subsumption, lint) are parked as YAML in ``state_dir/mined/pending/``
+  and surfaced on ``GET /patterns/mined``; an operator approves or
+  rejects via ``POST /patterns/mined`` — approval runs the full canary
+  ladder and the quiesced swap.
+- ``auto``: vetted candidates go straight through canary + swap, and
+  shadow verification is forced on (``DEFAULT_SHADOW_RATE`` when the
+  operator has not enabled it) so every admitted mined id is
+  continuously re-verified against the golden host path — the
+  "Lost in Translation" guard rail (docs/PATTERNS.md).
+- ``off``: the miner clusters and reports but never synthesizes.
+
+The worker is fully contained: admission rejections are counters, any
+other exception (including the injected ``miner`` fault site) bumps
+``errors`` and the loop continues — a miner defect can degrade mining,
+never parsing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import Counter, deque
+
+import yaml
+
+from log_parser_tpu.mining.admit import (
+    RETRYABLE_REASONS,
+    Rejection,
+    admit_candidate,
+    vet_candidate,
+)
+from log_parser_tpu.mining.synthesize import candidate_yaml, synthesize
+from log_parser_tpu.mining.templates import TemplateClusterer
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.linecache import DEFAULT_TAP_CAPACITY, MissTap
+
+log = logging.getLogger(__name__)
+
+MODES = ("off", "review", "auto")
+DEFAULT_SHADOW_RATE = 0.05
+_MAX_SWAP_RETRIES = 5
+_DRAIN_BATCH = 512
+
+# chaos vocabulary — tools/hygiene.py check 14 pins every key here to a
+# docs/OPS.md row AND a live faults.fire call site, exactly like check 13
+# does for the tenancy sites
+FAULT_SITES: dict[str, str] = {
+    "miner": "miner worker loop, once per pump — a hang wedges the "
+    "worker (the tap fills and drops; the hot path never notices), a "
+    "raise bumps miner.errors and the loop continues",
+    "miner_admit": "candidate admission, before the vet gates — raise "
+    "becomes a structured mined-fault rejection, the bank untouched",
+}
+
+
+class TemplateMiner:
+    """Owns the tap, the clusterer, the pending-candidate store, and the
+    worker thread for ONE engine."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        mode: str = "review",
+        sample: float = 1.0,
+        min_support: int = 8,
+        state_dir: str | None = None,
+        capacity: int = DEFAULT_TAP_CAPACITY,
+        poll_s: float = 0.25,
+        shadow_rate: float = DEFAULT_SHADOW_RATE,
+        stability: int = 4,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"miner mode must be one of {MODES}, got {mode!r}")
+        self.engine = engine
+        self.mode = mode
+        self.poll_s = float(poll_s)
+        self.shadow_rate = float(shadow_rate)
+        self.tap = MissTap(capacity=capacity, sample=sample)
+        self.clusterer = TemplateClusterer(
+            min_support=min_support, stability=stability
+        )
+        self.pending_dir = (
+            os.path.join(state_dir, "mined", "pending") if state_dir else None
+        )
+        self.lock = threading.Lock()
+        self._pending: dict[str, dict] = {}  # id -> {yaml, template, support, tier}
+        self._retry: deque[tuple[PatternSet, int]] = deque()
+        self.promoted = 0
+        self.admitted = 0
+        self.errors = 0
+        self._rejected: Counter[str] = Counter()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._load_pending()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "TemplateMiner":
+        self._thread = threading.Thread(
+            target=self._run, name="template-miner", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self.tap.close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)  # a fault-wedged worker is daemon; don't hang shutdown
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.pump(timeout=self.poll_s)
+
+    # ------------------------------------------------------------ pipeline
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """One synchronous mining cycle: drain → cluster → promote →
+        synthesize → admit/park. The worker thread calls this in a loop;
+        tests and tools/mine_report.py call it directly for determinism.
+        Returns the number of miss lines consumed. Never raises."""
+        try:
+            faults.fire("miner")
+            items = self.tap.drain(max_items=_DRAIN_BATCH, timeout=timeout)
+            for line_bytes, count in items:
+                self.clusterer.observe(line_bytes, count)
+            if self.mode != "off":
+                self._retry_swaps()
+                for cluster in self.clusterer.promotable():
+                    with self.lock:
+                        self.promoted += 1
+                    self._handle_candidate(synthesize(cluster))
+            return len(items)
+        except Exception:  # noqa: BLE001 — the miner must never take the
+            # process (or the serving path) down; the fault site above
+            # and any real defect land here as a counter
+            with self.lock:
+                self.errors += 1
+            log.exception("miner pump failed")
+            return 0
+
+    def _handle_candidate(self, candidate: PatternSet) -> None:
+        pid = (candidate.patterns or [None])[0].id
+        try:
+            if self.mode == "auto":
+                result = admit_candidate(self.engine, candidate)
+                self._note_admitted(result)
+            else:  # review: vet, then park for the operator
+                vet = vet_candidate(self.engine, candidate)
+                self._park(candidate, vet)
+        except Rejection as exc:
+            self._note_rejected(exc, candidate)
+        except Exception:  # noqa: BLE001 — same containment as pump
+            with self.lock:
+                self.errors += 1
+            log.exception("candidate %s failed out of band", pid)
+
+    def _retry_swaps(self) -> None:
+        """Transient (mined-swap) rejections re-enter admission on later
+        pumps, bounded by _MAX_SWAP_RETRIES attempts each."""
+        for _ in range(len(self._retry)):
+            candidate, attempts = self._retry.popleft()
+            try:
+                self._note_admitted(admit_candidate(self.engine, candidate))
+            except Rejection as exc:
+                if exc.reason in RETRYABLE_REASONS and attempts + 1 < _MAX_SWAP_RETRIES:
+                    self._retry.append((candidate, attempts + 1))
+                else:
+                    self._note_rejected(exc, candidate, retryable=False)
+
+    def _note_admitted(self, result: dict) -> None:
+        with self.lock:
+            self.admitted += 1
+        if self.mode == "auto" and self.engine.shadow is None:
+            # forced-on shadow verification for mined ids: every admitted
+            # generated pattern keeps being re-checked against the golden
+            # host path; a divergence trips its breaker and the pattern
+            # serves from host truth while the operator triages
+            self.engine.enable_shadow(self.shadow_rate)
+        log.info("miner admitted %s (epoch %s)", result.get("id"), result.get("epoch"))
+
+    def _note_rejected(
+        self, exc: Rejection, candidate: PatternSet, retryable: bool = True
+    ) -> None:
+        if retryable and exc.reason in RETRYABLE_REASONS:
+            self._retry.append((candidate, 1))
+            return
+        with self.lock:
+            self._rejected[exc.reason] += 1
+        log.info("miner rejected candidate: %s", exc)
+
+    # ------------------------------------------------------- review surface
+
+    def _park(self, candidate: PatternSet, vet: dict) -> None:
+        pid = (candidate.patterns or [None])[0].id
+        text = candidate_yaml(candidate)
+        entry = {
+            "id": pid,
+            "yaml": text,
+            "template": (candidate.patterns[0].remediation or {}).get("template", ""),
+            "support": (candidate.patterns[0].remediation or {}).get("support", 0),
+            **vet,
+        }
+        with self.lock:
+            self._pending[pid] = entry
+        if self.pending_dir:
+            os.makedirs(self.pending_dir, exist_ok=True)
+            path = os.path.join(self.pending_dir, f"{pid}.yaml")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            os.replace(tmp, path)
+
+    def _load_pending(self) -> None:
+        """Rehydrate parked candidates across restarts (review workflow:
+        a pending candidate survives like the WAL beside it does)."""
+        if not self.pending_dir or not os.path.isdir(self.pending_dir):
+            return
+        for name in sorted(os.listdir(self.pending_dir)):
+            if not name.endswith(".yaml"):
+                continue
+            path = os.path.join(self.pending_dir, name)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+                ps = PatternSet.from_dict(yaml.safe_load(text))
+                pat = (ps.patterns or [None])[0]
+                if pat is None or not pat.id:
+                    continue
+                self._pending[pat.id] = {
+                    "id": pat.id,
+                    "yaml": text,
+                    "template": (pat.remediation or {}).get("template", ""),
+                    "support": (pat.remediation or {}).get("support", 0),
+                }
+            except Exception:  # noqa: BLE001 — a corrupt pending file is
+                # skipped, not fatal (same posture as the pattern loader)
+                log.exception("skipping unreadable pending candidate %s", path)
+
+    def pending_list(self) -> list[dict]:
+        with self.lock:
+            return [
+                {k: v for k, v in e.items() if k != "yaml"}
+                for e in self._pending.values()
+            ]
+
+    def pending_yaml(self, candidate_id: str) -> str | None:
+        with self.lock:
+            e = self._pending.get(candidate_id)
+            return e["yaml"] if e else None
+
+    def approve(self, candidate_id: str, timeout_s: float = 30.0) -> dict:
+        """Operator approval: the parked candidate runs the FULL ladder
+        (vet again against the current library — it may have changed
+        since parking — then canary + quiesced swap). Raises KeyError for
+        an unknown id, :class:`Rejection` with the structured reason on
+        any gate failure (the HTTP surface maps it to a 409)."""
+        text = self.pending_yaml(candidate_id)
+        if text is None:
+            raise KeyError(candidate_id)
+        candidate = PatternSet.from_dict(yaml.safe_load(text))
+        result = admit_candidate(self.engine, candidate, timeout_s=timeout_s)
+        self._note_admitted(result)
+        self.discard(candidate_id)
+        return result
+
+    def discard(self, candidate_id: str) -> bool:
+        with self.lock:
+            found = self._pending.pop(candidate_id, None) is not None
+        if self.pending_dir:
+            try:
+                os.unlink(os.path.join(self.pending_dir, f"{candidate_id}.yaml"))
+            except FileNotFoundError:
+                pass
+        return found
+
+    # ------------------------------------------------------- observability
+
+    def stats(self) -> dict:
+        tap = self.tap.stats()
+        cl = self.clusterer.stats()
+        with self.lock:
+            return {
+                "mode": self.mode,
+                **tap,
+                **cl,
+                "promoted": self.promoted,
+                "admitted": self.admitted,
+                "rejected": dict(self._rejected),
+                "pending": len(self._pending),
+                "retrying": len(self._retry),
+                "errors": self.errors,
+            }

@@ -1,0 +1,117 @@
+"""Candidate PatternSet synthesis from a stable template cluster.
+
+"Lost in Translation" (PAPERS.md) is the standing caution: machine-
+generated regexes must be *narrow by construction* and semantically
+verified before anything serves them. The synthesizer therefore emits
+only a restricted dialect:
+
+- fixed tokens are emitted as escaped literals (metacharacters
+  backslash-escaped; a token carrying non-printable or non-ASCII bytes
+  is demoted to a wildcard slot rather than risk an escape outside the
+  automaton dialect);
+- wildcard slots are **bounded** character classes (``\\S{1,64}``),
+  never ``.*`` — a mined pattern can never match across token
+  boundaries it did not see;
+- token separators are bounded whitespace runs (``\\s{1,8}``).
+
+The bounds keep every synthesized regex inside the byte-class DFA
+tier's NFA budget (analysis/tiers.py), which the admission pipeline
+*requires*: no DFA means no exact subsumption check against the curated
+library, and an unverifiable candidate is rejected, not admitted.
+
+The emitted :class:`PatternSet` is flagged ``generated: true`` on the
+pattern (provenance — docs/PATTERNS.md "Generated patterns"), carries
+the template and support in ``remediation`` for reviewers, and defaults
+to ``severity: INFO`` / ``confidence: 0.5`` — a mined pattern states
+"this template exists", not "this template is critical"; an operator
+promotes severity by editing the YAML like any hand-authored pattern.
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from log_parser_tpu.mining.templates import (
+    WILDCARD,
+    Cluster,
+    render,
+    template_id,
+)
+from log_parser_tpu.models.pattern import (
+    Pattern,
+    PatternSet,
+    PatternSetMetadata,
+    PrimaryPattern,
+)
+
+# bounded wildcard/separator fragments — never unbounded, never `.*`
+WILDCARD_RE = r"\S{1,64}"
+SEPARATOR_RE = r"\s{1,8}"
+
+DEFAULT_SEVERITY = "INFO"
+DEFAULT_CONFIDENCE = 0.5
+
+# escaped inside literal tokens; every other printable-ASCII char is
+# literal in the Java dialect outside a class
+_META = set("\\^$.|?*+()[]{}")
+
+
+def _escape_token(token: str) -> str | None:
+    """Escaped-literal regex for one fixed token, or None when the token
+    carries bytes outside printable ASCII (demoted to a wildcard by the
+    caller — an exotic escape is exactly the kind of generated regex
+    that fails semantic review)."""
+    out: list[str] = []
+    for ch in token:
+        if not (0x21 <= ord(ch) <= 0x7E):
+            return None
+        out.append("\\" + ch if ch in _META else ch)
+    return "".join(out)
+
+
+def template_regex(template: tuple) -> str:
+    """Bounded-dialect regex for one token template."""
+    parts: list[str] = []
+    for tok in template:
+        frag = None if tok is WILDCARD else _escape_token(tok)
+        parts.append(WILDCARD_RE if frag is None else frag)
+    return SEPARATOR_RE.join(parts)
+
+
+def synthesize(cluster: Cluster) -> PatternSet:
+    """One candidate PatternSet for one stable cluster."""
+    pid = template_id(cluster.template)
+    text = render(cluster.template)
+    regex = template_regex(cluster.template)
+    pattern = Pattern(
+        id=pid,
+        name=f"Mined template: {text[:80]}",
+        severity=DEFAULT_SEVERITY,
+        primary_pattern=PrimaryPattern(
+            regex=regex, confidence=DEFAULT_CONFIDENCE
+        ),
+        remediation={
+            "source": "template-miner",
+            "template": text,
+            "support": cluster.support,
+        },
+        generated=True,
+    )
+    return PatternSet(
+        metadata=PatternSetMetadata(
+            library_id=f"mined.{pid}",
+            name="Mined candidate",
+            version="1",
+            description=f"mined from {cluster.support} cache-miss lines",
+        ),
+        patterns=[pattern],
+    )
+
+
+def candidate_yaml(candidate: PatternSet) -> str:
+    """Round-trippable YAML for one candidate — the exact bytes the
+    review workflow parks in ``state_dir/<tenant>/mined/pending/`` and
+    the loader reads back on approval."""
+    return yaml.safe_dump(
+        candidate.to_dict(drop_none=True), sort_keys=False
+    )

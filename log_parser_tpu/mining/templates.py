@@ -1,0 +1,202 @@
+"""Online template clusterer over the line-cache miss stream.
+
+Logram (PAPERS.md) shows that token-position dictionaries make online
+log-template discovery cheap: most log lines are a fixed token skeleton
+with a few variable slots. This module groups ingest-normalized miss
+lines into such templates — a list of fixed tokens and ``<*>`` wildcard
+slots — with Drain-style position-wise similarity merging, and promotes
+a cluster to candidate status only once it has both **support** (enough
+distinct observations) and **stability** (the template stopped changing,
+so later merges would not widen the synthesized regex).
+
+Everything here is defensive by construction: lines are decoded with
+``errors="replace"``, truncated at a byte ceiling, and tokenized to a
+bounded token count, so hostile input (NULs, 1 MB lines, invalid UTF-8,
+metacharacter soup — tools/fuzz_sweep.py --miner) can cost at most a
+bounded amount of work and can never raise out of :meth:`observe`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+
+# hostile-input ceilings: a line longer than this is truncated before
+# tokenizing (the template of a 1 MB line's head is as good as the whole),
+# and a line with more tokens than the cap is ignored (no real log
+# template has 48+ positions; unbounded positions would also blow the
+# synthesized regex past the NFA repeat guard)
+MAX_LINE_BYTES = 4096
+MAX_TOKENS = 48
+
+# a token carrying a digit is masked to a wildcard before clustering
+# (Logram/Drain preprocessing): ids, counters, timestamps never belong
+# to the fixed skeleton, and masking them early keeps one template from
+# splintering into thousands of single-support clusters
+_DIGIT_RE = re.compile(r"\d")
+
+WILDCARD = None  # slot marker inside a template tuple
+
+
+def tokenize(line_bytes: bytes) -> tuple:
+    """Ingest-normalized line bytes -> bounded template-key token tuple.
+
+    Tokens are whitespace-separated; digit-bearing tokens are masked to
+    :data:`WILDCARD` immediately. Returns ``()`` for blank lines and for
+    lines past the token cap (both unminable)."""
+    text = line_bytes[:MAX_LINE_BYTES].decode("utf-8", errors="replace")
+    toks = text.split()
+    if not toks or len(toks) > MAX_TOKENS:
+        return ()
+    return tuple(
+        WILDCARD if _DIGIT_RE.search(t) else t for t in toks
+    )
+
+
+def template_id(template: tuple) -> str:
+    """Stable candidate id for one template: ``mined-<blake2b-12hex>`` of
+    the rendered template text — deterministic across processes, so a
+    re-mined template maps to the same pattern id (and the same pending
+    file) every time."""
+    return "mined-" + hashlib.blake2b(
+        render(template).encode("utf-8", errors="replace"), digest_size=6
+    ).hexdigest()
+
+
+def render(template: tuple) -> str:
+    """Human-readable template text (``<*>`` for wildcard slots)."""
+    return " ".join("<*>" if t is WILDCARD else t for t in template)
+
+
+class Cluster:
+    """One template cluster: the merged token template plus its support
+    and stability bookkeeping."""
+
+    __slots__ = ("template", "support", "since_change", "promoted")
+
+    def __init__(self, template: tuple):
+        self.template = template
+        self.support = 0  # lines observed (weighted by multiplicity)
+        self.since_change = 0  # observations since the template last changed
+        self.promoted = False  # handed to the synthesizer already
+
+    def fixed_tokens(self) -> list[str]:
+        return [t for t in self.template if t is not WILDCARD]
+
+    def to_json(self) -> dict:
+        return {
+            "id": template_id(self.template),
+            "template": render(self.template),
+            "support": self.support,
+            "sinceChange": self.since_change,
+            "promoted": self.promoted,
+        }
+
+
+class TemplateClusterer:
+    """Online, bounded, thread-compatible template clustering.
+
+    ``observe`` buckets lines by token count, merges into the most
+    similar existing cluster when at least ``sim_threshold`` of positions
+    agree (wildcard positions count as agreeing — an established slot
+    absorbs any token), else opens a new cluster. Differing positions
+    become wildcards on merge, which resets the cluster's stability
+    clock. Cluster count is bounded by ``max_clusters``; once full, novel
+    templates are counted in ``discarded`` instead of evicting support
+    the promoter is still accumulating.
+    """
+
+    def __init__(
+        self,
+        *,
+        min_support: int = 8,
+        sim_threshold: float = 0.55,
+        stability: int = 4,
+        max_clusters: int = 512,
+    ):
+        self.lock = threading.Lock()
+        self.min_support = max(1, int(min_support))
+        self.sim_threshold = float(sim_threshold)
+        self.stability = max(0, int(stability))
+        self.max_clusters = max(1, int(max_clusters))
+        self._by_len: dict[int, list[Cluster]] = {}
+        self._n = 0
+        self.observed = 0
+        self.skipped = 0  # blank / over-cap lines
+        self.discarded = 0  # novel templates past max_clusters
+
+    def observe(self, line_bytes: bytes, count: int = 1) -> None:
+        template = tokenize(line_bytes)
+        with self.lock:
+            if not template:
+                self.skipped += 1
+                return
+            self.observed += int(count)
+            bucket = self._by_len.setdefault(len(template), [])
+            best, best_sim = None, -1.0
+            for c in bucket:
+                same = sum(
+                    1
+                    for a, b in zip(c.template, template)
+                    if a is WILDCARD or a == b
+                )
+                sim = same / len(template)
+                if sim > best_sim:
+                    best, best_sim = c, sim
+            if best is not None and best_sim >= self.sim_threshold:
+                merged = tuple(
+                    a if (a is WILDCARD or a == b) else WILDCARD
+                    for a, b in zip(best.template, template)
+                )
+                if merged != best.template:
+                    best.template = merged
+                    best.since_change = 0
+                    best.promoted = False  # widened: re-earn stability
+                else:
+                    best.since_change += 1
+                best.support += int(count)
+                return
+            if self._n >= self.max_clusters:
+                self.discarded += 1
+                return
+            c = Cluster(template)
+            c.support = int(count)
+            bucket.append(c)
+            self._n += 1
+
+    def promotable(self) -> list[Cluster]:
+        """Clusters ready for synthesis: supported, stable, not yet
+        promoted, and carrying at least one fixed token long enough to
+        seed a literal probe. Marks them promoted so one stable template
+        is synthesized exactly once (until a merge widens it again)."""
+        out: list[Cluster] = []
+        with self.lock:
+            for bucket in self._by_len.values():
+                for c in bucket:
+                    if c.promoted or c.support < self.min_support:
+                        continue
+                    if c.since_change < self.stability:
+                        continue
+                    if not any(len(t) >= 4 for t in c.fixed_tokens()):
+                        continue
+                    c.promoted = True
+                    out.append(c)
+        return out
+
+    def snapshot(self) -> list[dict]:
+        with self.lock:
+            return [
+                c.to_json()
+                for bucket in self._by_len.values()
+                for c in bucket
+            ]
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "clusters": self._n,
+                "observed": self.observed,
+                "skipped": self.skipped,
+                "discarded": self.discarded,
+            }

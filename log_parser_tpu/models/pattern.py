@@ -77,6 +77,12 @@ class Pattern(Model):
     ``remediation`` is carried opaquely (any YAML value): the parser never
     reads it, but pattern files include remediation info
     (PatternService.java:25-26) and it must survive round-tripping.
+
+    ``generated`` marks provenance: ``True`` means the pattern was
+    synthesized by the template miner (mining/synthesize.py), not
+    hand-authored. Mined ids get shadow verification forced on in auto
+    admission mode (docs/PATTERNS.md "Generated patterns"); scoring is
+    identical either way.
     """
 
     id: str = ""
@@ -87,6 +93,7 @@ class Pattern(Model):
     sequence_patterns: list[SequencePattern] | None = None
     context_extraction: ContextExtraction | None = None
     remediation: Any = None
+    generated: bool = False
 
 
 @dataclasses.dataclass

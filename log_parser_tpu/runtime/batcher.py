@@ -496,6 +496,17 @@ class MicroBatcher:
         packed = cache.lookup_packed(keys, counts=counts.tolist())
         miss_slots = [s for s in range(U) if packed[s] is None]
 
+        miner = engine.miner
+        if miner is not None:
+            # miss-stream tap: one non-blocking bounded-queue offer per
+            # unique novel line (sampling + drop accounting live in the
+            # tap); mining happens on the miner thread, never here
+            for s in miss_slots:
+                r, i = uniq_src[s]
+                miner.tap.offer(
+                    items[r].corpus.line_key_bytes(i), int(counts[s])
+                )
+
         fresh = None
         if miss_slots:
             u = len(miss_slots)

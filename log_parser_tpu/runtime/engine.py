@@ -529,6 +529,9 @@ class AnalysisEngine:
         shadow_rate = float(os.environ.get("LOG_PARSER_TPU_SHADOW_RATE", "0") or 0)
         if shadow_rate > 0:
             self.enable_shadow(shadow_rate)
+        # template miner (mining/): background consumer of the line-cache
+        # miss stream; None until enable_miner()
+        self.miner = None
         # chaos: pick up LOG_PARSER_TPU_FAULTS once per process (no-op
         # when unset or when a test installed a registry explicitly)
         faults.ensure_env()
@@ -1137,6 +1140,54 @@ class AnalysisEngine:
         self.shadow = ShadowVerifier(self, rate, seed=seed).start()
         return self.shadow
 
+    def enable_miner(
+        self,
+        *,
+        mode: str = "review",
+        sample: float = 1.0,
+        min_support: int = 8,
+        state_dir: str | None = None,
+        capacity: int | None = None,
+        shadow_rate: float | None = None,
+        stability: int = 4,
+        autostart: bool = True,
+    ):
+        """Attach the template miner (mining/): line-cache misses feed a
+        sampled bounded tap, a background thread clusters them into
+        token templates, and stable templates become candidate patterns
+        behind the admission pipeline (``--mined-patterns``). Requires
+        the line cache — without a miss stream there is nothing to mine
+        (the serve layer gates the flag accordingly). ``autostart=False``
+        leaves the worker unstarted so tests and tools drive
+        :meth:`TemplateMiner.pump` deterministically."""
+        from log_parser_tpu.mining.miner import TemplateMiner
+        from log_parser_tpu.runtime.linecache import DEFAULT_TAP_CAPACITY
+
+        if self.line_cache is None:
+            raise RuntimeError("enable_miner requires enable_line_cache first")
+        if self.miner is not None:
+            self.miner.stop()
+        if capacity is None:
+            capacity = int(
+                os.environ.get(
+                    "LOG_PARSER_TPU_MINER_TAP_CAPACITY", str(DEFAULT_TAP_CAPACITY)
+                )
+            )
+        kwargs = {} if shadow_rate is None else {"shadow_rate": shadow_rate}
+        self.miner = TemplateMiner(
+            self,
+            mode=mode,
+            sample=sample,
+            min_support=min_support,
+            state_dir=state_dir,
+            capacity=capacity,
+            stability=stability,
+            **kwargs,
+        )
+        if autostart:
+            self.miner.start()
+        return self.miner
+
     def analyze_batched(
         self, data: PodFailureData, deadline_ms: float | None = None
     ) -> AnalysisResult:
@@ -1371,6 +1422,15 @@ class AnalysisEngine:
         if miss_slots:
             miss_lines = [uniq_lines[s] for s in miss_slots]
             u = len(miss_lines)
+            miner = self.miner
+            if miner is not None:
+                # miss-stream tap: one non-blocking bounded-queue offer
+                # per unique novel line (sampling + drop accounting live
+                # in the tap); the mining work itself happens on the
+                # miner thread, never here
+                cts = counts[miss_slots]
+                for j, i in enumerate(miss_lines):
+                    miner.tap.offer(corpus.line_key_bytes(i), int(cts[j]))
             pad = _pad_rows(u, self._corpus_min_rows())
             res_u8 = np.zeros((pad, enc.u8.shape[1]), dtype=np.uint8)
             res_len = np.zeros(pad, dtype=np.int32)

@@ -50,7 +50,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -555,6 +555,100 @@ class LineCache:
                 "dedupFanout": self.dedup_fanout,
                 "evictions": self.evictions,
                 "epochFlushes": self.epoch_flushes,
+            }
+
+
+# ------------------------------------------------------------ miss-stream tap
+
+DEFAULT_TAP_CAPACITY = 4096
+
+
+class MissTap:
+    """Sampled, bounded, drop-counted feed of line-cache misses to the
+    template miner (:mod:`log_parser_tpu.mining`).
+
+    The hot path calls :meth:`offer` once per unique miss line — one lock
+    acquisition appending the ingest-normalized line bytes to a bounded
+    deque. Nothing ever blocks and nothing is retried: when the queue is
+    full the line is counted in ``dropped`` and forgotten. The miner is
+    an optimization; the parse path is the product, so saturation must
+    cost one counter bump, never latency.
+
+    Sampling is a deterministic stride over the offer sequence number
+    (``sample=0.25`` keeps every 4th offer), so a chaos drill or test
+    replays bit-identically without an RNG on the hot path; skipped
+    offers are counted in ``sampledOut``.
+
+    The consumer (:meth:`drain`) waits on an event with a timeout: the
+    miner thread wakes promptly under traffic and idles cheaply without
+    polling the lock.
+    """
+
+    def __init__(
+        self, capacity: int = DEFAULT_TAP_CAPACITY, sample: float = 1.0
+    ):
+        self.lock = threading.Lock()
+        self.capacity = max(1, int(capacity))
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        self._q: deque[tuple[bytes, int]] = deque()
+        self._seq = 0  # offers seen, pre-sampling (stride numerator)
+        self._kept = 0  # offers past the sampler so far
+        self.tapped = 0
+        self.dropped = 0
+        self.sampled_out = 0
+        self._event = threading.Event()
+        self._closed = False
+
+    def offer(self, line_bytes: bytes, count: int = 1) -> bool:
+        """Non-blocking hot-path enqueue of one miss line (``count`` = its
+        multiplicity in the request). Returns True iff enqueued."""
+        with self.lock:
+            if self._closed:
+                return False
+            self._seq += 1
+            want = int(self._seq * self.sample)
+            if want <= self._kept:
+                self.sampled_out += 1
+                return False
+            self._kept = want
+            if len(self._q) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._q.append((bytes(line_bytes), int(count)))
+            self.tapped += 1
+        self._event.set()
+        return True
+
+    def drain(
+        self, max_items: int = 512, timeout: float | None = 0.25
+    ) -> list[tuple[bytes, int]]:
+        """Consumer side: up to ``max_items`` queued (line_bytes, count)
+        pairs, waiting up to ``timeout`` seconds for the first one."""
+        if timeout and not self._event.is_set():
+            self._event.wait(timeout)
+        out: list[tuple[bytes, int]] = []
+        with self.lock:
+            while self._q and len(out) < max_items:
+                out.append(self._q.popleft())
+            if not self._q:
+                self._event.clear()
+        return out
+
+    def close(self) -> None:
+        with self.lock:
+            self._closed = True
+            self._q.clear()
+        self._event.set()
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "capacity": self.capacity,
+                "sample": self.sample,
+                "queued": len(self._q),
+                "tapped": self.tapped,
+                "dropped": self.dropped,
+                "sampledOut": self.sampled_out,
             }
 
 

@@ -274,6 +274,10 @@ class TenantContext:
             mgr.shutdown()
         if getattr(eng, "batcher", None) is not None:
             eng.batcher.close()
+        if getattr(eng, "miner", None) is not None:
+            # parked candidates stay durable under the tenant's state
+            # dir; the rebuilt tenant's miner rehydrates them
+            eng.miner.stop()
         if getattr(eng, "shadow", None) is not None:
             eng.shadow.close()
         journal = getattr(eng, "journal", None)

@@ -122,6 +122,33 @@ def main(argv: list[str] | None = None) -> int:
         "residual batch (runtime/linecache.py; single-device engine "
         "only; 0 disables; default 64; LOG_PARSER_TPU_LINE_CACHE_MB)",
     )
+    # template miner (docs/OPS.md "Template miner")
+    parser.add_argument(
+        "--miner", choices=("on", "off"), default=None,
+        help="mine templates from the line-cache miss stream "
+        "(log_parser_tpu/mining/; requires --line-cache-mb > 0; "
+        "single-device engine only; default off; LOG_PARSER_TPU_MINER)",
+    )
+    parser.add_argument(
+        "--miner-sample", type=float, default=None, metavar="RATE",
+        help="fraction of unique cache-miss lines offered to the miner "
+        "tap; deterministic stride sampling, never blocks the hot path "
+        "(default 1.0; LOG_PARSER_TPU_MINER_SAMPLE)",
+    )
+    parser.add_argument(
+        "--miner-min-support", type=int, default=None,
+        help="miss lines a template cluster must absorb before it is "
+        "synthesized into a candidate (default 8; "
+        "LOG_PARSER_TPU_MINER_MIN_SUPPORT)",
+    )
+    parser.add_argument(
+        "--mined-patterns", default=None, choices=("off", "review", "auto"),
+        help="what happens to lint-clean mined candidates: 'review' parks "
+        "them for GET/POST /patterns/mined, 'auto' admits through canary "
+        "+ quiesced swap with shadow verification forced on, 'off' "
+        "clusters without synthesizing; default review "
+        "(LOG_PARSER_TPU_MINED_PATTERNS)",
+    )
     # streaming follow-mode (docs/OPS.md "Streaming follow-mode")
     parser.add_argument(
         "--stream-emit-threshold", type=float, default=None, metavar="SCORE",
@@ -258,6 +285,10 @@ def main(argv: list[str] | None = None) -> int:
         (args.batch_wait_ms, "LOG_PARSER_TPU_BATCH_WAIT_MS"),
         (args.batch_max, "LOG_PARSER_TPU_BATCH_MAX"),
         (args.line_cache_mb, "LOG_PARSER_TPU_LINE_CACHE_MB"),
+        (args.miner, "LOG_PARSER_TPU_MINER"),
+        (args.miner_sample, "LOG_PARSER_TPU_MINER_SAMPLE"),
+        (args.miner_min_support, "LOG_PARSER_TPU_MINER_MIN_SUPPORT"),
+        (args.mined_patterns, "LOG_PARSER_TPU_MINED_PATTERNS"),
         (args.stream_emit_threshold, "LOG_PARSER_TPU_STREAM_EMIT_THRESHOLD"),
         (args.stream_ttl_s, "LOG_PARSER_TPU_STREAM_TTL_S"),
         (args.quarantine_strikes, "LOG_PARSER_TPU_QUARANTINE_STRIKES"),
@@ -430,6 +461,48 @@ def main(argv: list[str] | None = None) -> int:
             ", torn tail quarantined" if journal.torn_tails else "",
         )
 
+    # template miner: background consumer of the line-cache miss stream
+    # (log_parser_tpu/mining/); per-tenant miners are wired below in
+    # tenant_engine_setup with the SAME env-carried knobs
+    miner_on = (
+        os.environ.get("LOG_PARSER_TPU_MINER", "off").strip().lower() == "on"
+    )
+    miner_sample = float(os.environ.get("LOG_PARSER_TPU_MINER_SAMPLE", "1.0"))
+    miner_support = int(
+        os.environ.get("LOG_PARSER_TPU_MINER_MIN_SUPPORT", "8")
+    )
+    miner_mode = (
+        os.environ.get("LOG_PARSER_TPU_MINED_PATTERNS", "review")
+        .strip()
+        .lower()
+    )
+    if miner_on:
+        if args.coordinator or args.sharded:
+            log.warning(
+                "--miner rides the line cache and is only supported on "
+                "the single-device engine; mining disabled"
+            )
+            miner_on = False
+        elif engine.line_cache is None:
+            log.warning(
+                "--miner requires --line-cache-mb > 0 (the miss stream "
+                "IS the cache miss stream); mining disabled"
+            )
+            miner_on = False
+        else:
+            engine.enable_miner(
+                mode=miner_mode,
+                sample=miner_sample,
+                min_support=miner_support,
+                state_dir=state_dir,
+            )
+            log.info(
+                "Template miner on: mode %s, sample %.3g, min support %d",
+                miner_mode,
+                miner_sample,
+                miner_support,
+            )
+
     # tenant registry: X-Tenant (HTTP) / x-tenant (gRPC) / method@tenant
     # (framed shim) resolve through one registry; each non-default tenant
     # gets a dedicated engine mirroring this one's serving features, all
@@ -463,6 +536,19 @@ def main(argv: list[str] | None = None) -> int:
         mb = float(os.environ.get("LOG_PARSER_TPU_LINE_CACHE_MB", "64") or 0)
         if mb > 0:
             eng.enable_line_cache(mb)
+            if miner_on:
+                # per-tenant miner: own tap/clusterer/pending store, state
+                # namespaced beside the tenant WAL (tenants/<id>/mined/)
+                eng.enable_miner(
+                    mode=miner_mode,
+                    sample=miner_sample,
+                    min_support=miner_support,
+                    state_dir=(
+                        os.path.join(state_dir, "tenants", tenant_id)
+                        if state_dir
+                        else None
+                    ),
+                )
         if state_dir:
             # namespaced WAL/snapshot dir: tenants/<id> under the default
             # tenant's state dir, so recovery is per-tenant and a tenant
@@ -580,6 +666,10 @@ def main(argv: list[str] | None = None) -> int:
         if engine.batcher is not None:
             # flush anything still queued before the process exits
             engine.batcher.close()
+        if getattr(engine, "miner", None) is not None:
+            # parked candidates are already durable on disk; this just
+            # stops the worker and closes the tap
+            engine.miner.stop()
         if engine.shadow is not None:
             engine.shadow.close()
         if journal is not None:

@@ -218,6 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._parse_stream()
         if self.path == "/patterns/reload":
             return self._patterns_reload()
+        if self.path == "/patterns/mined":
+            return self._mined_post()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
@@ -316,6 +318,71 @@ class _Handler(BaseHTTPRequestHandler):
         ctx.note_reloaded()
         return self._send_json(200, json.dumps(envelope).encode())
 
+    def _mined_get(self) -> None:
+        """``GET /patterns/mined``: the review queue — parked candidates
+        (id, template, support, tier; the YAML itself stays on disk) plus
+        the miner's live counters. Tenant-scoped: ``X-Tenant`` picks whose
+        miner answers; 404 when mining is off for that engine."""
+        ctx = self._tenant()
+        if ctx is None:
+            return
+        miner = getattr(ctx.engine, "miner", None)
+        if miner is None:
+            return self._send_json(404, b'{"error":"miner disabled"}')
+        return self._send_json(
+            200,
+            json.dumps(
+                {"pending": miner.pending_list(), "stats": miner.stats()}
+            ).encode(),
+        )
+
+    def _mined_post(self) -> None:
+        """``POST /patterns/mined`` with ``{"id": ..., "action":
+        "approve"|"reject"}``. Approve re-runs the FULL admission ladder
+        (the curated library may have changed since parking) — a gate
+        failure is a structured 409 carrying the rejection reason, and the
+        candidate stays parked for triage. Reject discards the parked
+        candidate."""
+        from log_parser_tpu.mining.admit import Rejection
+
+        bad = b'{"error":"expected {id, action: approve|reject}"}'
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > _ADMIN_MAX_BODY:
+                return self._send_json(413, _TOO_LARGE)
+            body = json.loads(self.rfile.read(length) if length else b"{}")
+        except ValueError:
+            return self._send_json(400, bad)
+        if (
+            not isinstance(body, dict)
+            or not isinstance(body.get("id"), str)
+            or body.get("action") not in ("approve", "reject")
+        ):
+            return self._send_json(400, bad)
+        ctx = self._tenant()
+        if ctx is None:
+            return
+        miner = getattr(ctx.engine, "miner", None)
+        if miner is None:
+            return self._send_json(404, b'{"error":"miner disabled"}')
+        if body["action"] == "reject":
+            found = miner.discard(body["id"])
+            if not found:
+                return self._send_json(404, b'{"error":"unknown candidate"}')
+            return self._send_json(200, b'{"status":"rejected"}')
+        try:
+            result = miner.approve(body["id"])
+        except KeyError:
+            return self._send_json(404, b'{"error":"unknown candidate"}')
+        except Rejection as exc:
+            return self._send_json(409, json.dumps(exc.to_json()).encode())
+        except Exception:
+            log.exception("mined-candidate approval failed")
+            return self._send_json(
+                500, b'{"error":"Internal approval failure"}'
+            )
+        return self._send_json(200, json.dumps(result).encode())
+
     def _route_get(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
             # draining: readiness fails (load balancers stop sending) but
@@ -369,6 +436,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(
                 200, json.dumps({"epoch": epoch, "ages": snap}).encode()
             )
+        if self.path == "/patterns/mined":
+            return self._mined_get()
         if self.path == "/trace/last":
             trace = self.server.engine.last_trace
             payload = {"phasesMs": {}, "totalMs": 0.0} if trace is None else {
@@ -426,6 +495,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload["compileCache"] = xlacache.stats()
             # poison-request ledger (docs/OPS.md "Poison-request triage")
             payload["quarantine"] = self.server.engine.quarantine.stats()
+            miner = getattr(self.server.engine, "miner", None)
+            if miner is not None:
+                # template-miner loop: tap/cluster/admission counters
+                # (docs/OPS.md "Template miner")
+                payload["miner"] = miner.stats()
             shadow = getattr(self.server.engine, "shadow", None)
             if shadow is not None:
                 # online device-vs-golden verification + per-pattern

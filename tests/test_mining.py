@@ -1,0 +1,494 @@
+"""Self-growing pattern library (log_parser_tpu/mining/).
+
+The contracts under test:
+
+- the miss tap is bounded, sampled, and non-blocking — saturation is a
+  drop counter, never hot-path latency;
+- the clusterer converges repeated miss lines into token templates and
+  promotes only supported, stable, probe-worthy ones;
+- the synthesizer emits only the bounded dialect (escaped literals,
+  ``\\S{1,64}`` wildcards, never ``.*``) flagged ``generated: true``;
+- the admission gate rejects — with a structured, pinned reason — any
+  candidate whose language equals, strictly contains, or is strictly
+  contained by a curated pattern's (BOTH directions pinned), and a
+  rejection leaves the serving bank object-identical;
+- the closed loop works end to end: novel templates stream through
+  miss → cluster → synthesize → vet → canary → quiesced swap in auto
+  mode, and the admitted pattern scores bit-identically to its
+  hand-authored YAML equivalent (``generated`` is provenance, not
+  semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.mining.admit import (
+    REJECT_REASONS,
+    Rejection,
+    vet_candidate,
+)
+from log_parser_tpu.mining.synthesize import (
+    SEPARATOR_RE,
+    WILDCARD_RE,
+    candidate_yaml,
+    synthesize,
+    template_regex,
+)
+from log_parser_tpu.mining.templates import (
+    WILDCARD,
+    Cluster,
+    TemplateClusterer,
+    template_id,
+    tokenize,
+)
+from log_parser_tpu.models.pattern import PatternSet
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.linecache import MissTap
+
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _pod(lines: list[str]) -> PodFailureData:
+    return PodFailureData(
+        pod={"metadata": {"name": "mine"}}, logs="\n".join(lines)
+    )
+
+
+def _curated_sets(regex: str = "OutOfMemoryError"):
+    return [
+        make_pattern_set(
+            [make_pattern("curated-1", regex=regex, confidence=0.8)],
+            library_id="curated",
+        )
+    ]
+
+
+def _miner_engine(
+    curated_regex: str = "OutOfMemoryError",
+    mode: str = "auto",
+    **kw,
+) -> AnalysisEngine:
+    engine = AnalysisEngine(_curated_sets(curated_regex), ScoringConfig())
+    engine.enable_line_cache(4)
+    engine.enable_miner(
+        mode=mode, min_support=3, stability=0, autostart=False, **kw
+    )
+    return engine
+
+
+def _cluster(text: str, support: int = 8) -> Cluster:
+    c = Cluster(tokenize(text.encode()))
+    c.support = support
+    return c
+
+
+# ------------------------------------------------------------------ miss tap
+
+
+class TestMissTap:
+    def test_bounded_and_drop_counted(self):
+        tap = MissTap(capacity=3)
+        for i in range(5):
+            tap.offer(b"line %d" % i)
+        s = tap.stats()
+        assert s["tapped"] == 3 and s["dropped"] == 2 and s["queued"] == 3
+        got = tap.drain(timeout=0)
+        assert [c for _, c in got] == [1, 1, 1]
+        assert tap.stats()["queued"] == 0
+
+    def test_stride_sampling_is_deterministic(self):
+        a, b = MissTap(sample=0.25), MissTap(sample=0.25)
+        for tap in (a, b):
+            for i in range(100):
+                tap.offer(b"x%d" % i)
+        assert a.stats() == b.stats()
+        assert a.stats()["tapped"] == 25
+        assert a.stats()["sampledOut"] == 75
+        assert [x for x, _ in a.drain(max_items=100, timeout=0)] == [
+            x for x, _ in b.drain(max_items=100, timeout=0)
+        ]
+
+    def test_closed_tap_refuses(self):
+        tap = MissTap()
+        tap.close()
+        assert tap.offer(b"late") is False
+        assert tap.drain(timeout=0) == []
+
+
+# ----------------------------------------------------------------- clusterer
+
+
+class TestClusterer:
+    def test_digit_tokens_mask_to_wildcards(self):
+        assert tokenize(b"worker 17 started at t=3") == (
+            "worker", WILDCARD, "started", "at", WILDCARD,
+        )
+        assert tokenize(b"") == ()
+        assert tokenize(b"t " * 100) == ()  # over the token cap
+
+    def test_merge_widens_and_resets_stability(self):
+        cl = TemplateClusterer(min_support=2, stability=1)
+        cl.observe(b"conn reset by peer alpha")
+        cl.observe(b"conn reset by peer beta")
+        cl.observe(b"conn reset by peer beta")
+        snap = cl.snapshot()
+        assert len(snap) == 1
+        assert snap[0]["template"] == "conn reset by peer <*>"
+        # the merge that introduced <*> reset the stability clock; the
+        # third (template-stable) observation re-earned it
+        assert [c.template for c in cl.promotable()] == [
+            ("conn", "reset", "by", "peer", WILDCARD)
+        ]
+
+    def test_promotable_needs_support_and_fixed_token(self):
+        cl = TemplateClusterer(min_support=3, stability=0)
+        cl.observe(b"abcd efgh ijkl mnop")  # support 1 < 3
+        # all-wildcard (5-token, so it can't absorb the 4-token group):
+        # never promotable regardless of support
+        cl.observe(b"x1 y2 z3 w4 v5")
+        cl.observe(b"x6 y7 z8 w9 v10")
+        cl.observe(b"x11 y12 z13 w14 v15")
+        assert cl.promotable() == []
+        cl.observe(b"abcd efgh ijkl mnop")
+        cl.observe(b"abcd efgh ijkl mnop")
+        assert [template_id(c.template) for c in cl.promotable()] == [
+            template_id(("abcd", "efgh", "ijkl", "mnop"))
+        ]
+        # promoted exactly once
+        assert cl.promotable() == []
+
+    def test_cluster_cap_discards_instead_of_evicting(self):
+        cl = TemplateClusterer(min_support=1, stability=0, max_clusters=2)
+        cl.observe(b"aaaa bbbb")
+        cl.observe(b"cccc dddd")
+        cl.observe(b"eeee ffff")  # at cap: discarded, support intact
+        s = cl.stats()
+        assert s["clusters"] == 2 and s["discarded"] == 1
+
+
+# --------------------------------------------------------------- synthesizer
+
+
+class TestSynthesize:
+    def test_bounded_dialect_only(self):
+        c = _cluster("frobnicate queue q7 depth d9")
+        regex = template_regex(c.template)
+        assert ".*" not in regex
+        assert regex == (
+            f"frobnicate{SEPARATOR_RE}queue{SEPARATOR_RE}{WILDCARD_RE}"
+            f"{SEPARATOR_RE}depth{SEPARATOR_RE}{WILDCARD_RE}"
+        )
+
+    def test_metacharacters_escaped_and_exotics_demoted(self):
+        # metachar-bearing fixed tokens are escaped literals
+        assert template_regex(("a+b", "(x)")) == (
+            rf"a\+b{SEPARATOR_RE}\(x\)"
+        )
+        # non-printable-ASCII tokens demote to a bounded wildcard
+        assert template_regex(("café",)) == WILDCARD_RE
+
+    def test_candidate_shape_and_yaml_round_trip(self):
+        cand = synthesize(_cluster("gc pause exceeded budget", support=11))
+        pat = cand.patterns[0]
+        assert pat.generated is True
+        assert pat.severity == "INFO"
+        assert pat.remediation["support"] == 11
+        assert pat.id == template_id(("gc", "pause", "exceeded", "budget"))
+        again = PatternSet.from_dict(yaml.safe_load(candidate_yaml(cand)))
+        assert again.patterns[0].generated is True
+        assert again.patterns[0].primary_pattern.regex == (
+            pat.primary_pattern.regex
+        )
+
+
+# ------------------------------------------------------- the subsumption gate
+
+
+class TestSubsumptionGate:
+    """A mined pattern may never shadow or duplicate a curated one —
+    pinned in BOTH containment directions with structured reasons."""
+
+    def test_mined_equal_curated_rejected(self):
+        # same language, different bytes (the byte-identity fast path
+        # must not be the only thing standing)
+        engine = _miner_engine(r"(?:FooBarBazQux)\s{1,8}happened")
+        cand = synthesize(_cluster("FooBarBazQux happened"))
+        with pytest.raises(Rejection) as exc:
+            vet_candidate(engine, cand)
+        assert exc.value.reason == "mined-duplicate"
+        assert "curated-1" in exc.value.detail
+
+    def test_mined_contains_curated_rejected(self):
+        # mined "FooBarBazQux <*>" strictly contains the curated
+        # language -> admitting it would shadow the curated pattern
+        engine = _miner_engine(r"FooBarBazQux\s{1,8}happened")
+        cand = synthesize(_cluster("FooBarBazQux h4ppened"))
+        assert cand.patterns[0].primary_pattern.regex == (
+            rf"FooBarBazQux{SEPARATOR_RE}{WILDCARD_RE}"
+        )
+        with pytest.raises(Rejection) as exc:
+            vet_candidate(engine, cand)
+        assert exc.value.reason == "mined-shadows-curated"
+
+    def test_curated_contains_mined_rejected(self):
+        # mined "FooBarBazQux happened" is strictly inside the curated
+        # wildcard language -> every mined match already fires curated
+        engine = _miner_engine(rf"FooBarBazQux\s{{1,8}}\S{{1,64}}")
+        cand = synthesize(_cluster("FooBarBazQux happened"))
+        with pytest.raises(Rejection) as exc:
+            vet_candidate(engine, cand)
+        assert exc.value.reason == "mined-shadowed"
+
+    def test_duplicate_id_and_incomparable_admit(self):
+        engine = _miner_engine("OutOfMemoryError")
+        cand = synthesize(_cluster("totally unrelated template line"))
+        # incomparable languages vet clean...
+        vet = vet_candidate(engine, cand)
+        assert vet["tier"] in ("shiftor", "dfa")
+        # ...but a live id collision rejects
+        dup = synthesize(_cluster("totally unrelated template line"))
+        dup.patterns[0].id = "curated-1"
+        with pytest.raises(Rejection) as exc:
+            vet_candidate(engine, dup)
+        assert exc.value.reason == "mined-duplicate-id"
+
+    def test_rejection_reasons_are_pinned_vocabulary(self):
+        # every raise site uses a code from REJECT_REASONS (the
+        # Rejection constructor asserts it); the vocabulary itself is
+        # pinned to docs/PATTERNS.md by hygiene check 14
+        assert {"mined-duplicate", "mined-shadows-curated",
+                "mined-shadowed", "mined-undecided"} <= set(REJECT_REASONS)
+        with pytest.raises(AssertionError):
+            Rejection("not-a-reason", "nope")
+
+    def test_rejection_leaves_bank_object_identical(self):
+        engine = _miner_engine(r"FooBarBazQux\s{1,8}happened")
+        bank = engine.bank
+        epoch = engine.reload_epoch
+        engine.analyze(_pod([f"FooBarBazQux h4ppened{i}" for i in range(3)]))
+        engine.miner.pump()
+        stats = engine.miner.stats()
+        assert stats["rejected"].get("mined-shadows-curated", 0) >= 1, stats
+        assert stats["admitted"] == 0 and stats["errors"] == 0, stats
+        assert engine.bank is bank
+        assert engine.reload_epoch == epoch
+        engine.miner.stop()
+
+
+# ------------------------------------------------------------ the closed loop
+
+
+NOVEL = [
+    "replication backlog drained on shard {i} after {j} entries",
+    "checkpoint upload finished for epoch {i} in {j} ms",
+    "thermal governor stepped clock domain {i} to {j} mhz",
+]
+
+
+def _novel_lines(r: int) -> list[str]:
+    return [
+        t.format(i=r * 10 + k, j=r * 7 + k) for t in NOVEL for k in range(3)
+    ]
+
+
+class TestClosedLoop:
+    def test_auto_mode_mines_and_admits_three_templates(self):
+        engine = _miner_engine(mode="auto")
+        engine.analyze(_pod(_novel_lines(0) + ["OutOfMemoryError hit"]))
+        engine.miner.pump()
+        stats = engine.miner.stats()
+        assert stats["admitted"] == 3, stats
+        assert stats["errors"] == 0 and not stats["rejected"], stats
+        assert engine.reload_epoch == 3
+        mined_ids = sorted(
+            p.id
+            for ps in engine.bank.pattern_sets
+            for p in ps.patterns
+            if p.generated
+        )
+        assert len(mined_ids) == 3 and all(
+            i.startswith("mined-") for i in mined_ids
+        )
+        # auto mode forces shadow verification on for the mined ids
+        assert engine.shadow is not None
+        # the mined library now scores fresh template instances (new
+        # slot values -> genuinely novel lines)
+        r = engine.analyze(_pod(_novel_lines(9)))
+        assert {e.matched_pattern.id for e in r.events} == set(mined_ids)
+        # post-admission steady state: repeats of an already-seen
+        # corpus are pure cache hits — miss (and tap) traffic ~0
+        engine.analyze(_pod(_novel_lines(9)))
+        misses = engine.line_cache.stats()["misses"]
+        tapped = engine.miner.tap.stats()["tapped"]
+        engine.analyze(_pod(_novel_lines(9)))
+        assert engine.line_cache.stats()["misses"] == misses
+        assert engine.miner.tap.stats()["tapped"] == tapped
+        engine.miner.stop()
+
+    def test_admitted_scores_bit_identical_to_hand_authored(self):
+        engine = _miner_engine(mode="auto")
+        engine.analyze(_pod(_novel_lines(0)))
+        engine.miner.pump()
+        assert engine.miner.stats()["admitted"] == 3
+        # hand-author the YAML equivalents: the exact bytes the miner
+        # would park, minus the provenance flag
+        hand_sets = []
+        for ps in engine.bank.pattern_sets:
+            for p in ps.patterns:
+                if not p.generated:
+                    continue
+                d = yaml.safe_load(
+                    candidate_yaml(
+                        PatternSet(metadata=ps.metadata, patterns=[p])
+                    )
+                )
+                del d["patterns"][0]["generated"]
+                hand_sets.append(PatternSet.from_dict(d))
+        assert len(hand_sets) == 3
+        assert not any(p.generated for hs in hand_sets for p in hs.patterns)
+        hand = AnalysisEngine(_curated_sets() + hand_sets, ScoringConfig())
+        # neutralize the mined engine's mining-phase frequency history;
+        # from identical state, generated-vs-hand-authored must be
+        # invisible to scoring
+        engine.frequency.reset_all_frequencies()
+        probe = _pod(_novel_lines(7) + ["OutOfMemoryError again"])
+        r_mined = engine.analyze(probe)
+        r_hand = hand.analyze(probe)
+        assert [
+            (e.line_number, e.matched_pattern.id, e.score)
+            for e in r_mined.events
+        ] == [
+            (e.line_number, e.matched_pattern.id, e.score)
+            for e in r_hand.events
+        ]
+        assert r_mined.summary.to_dict() == r_hand.summary.to_dict()
+        engine.miner.stop()
+
+    def test_review_mode_parks_then_approve_admits(self, tmp_path):
+        engine = _miner_engine(mode="review", state_dir=str(tmp_path))
+        engine.analyze(_pod(_novel_lines(0)))
+        engine.miner.pump()
+        stats = engine.miner.stats()
+        assert stats["pending"] == 3 and stats["admitted"] == 0, stats
+        assert engine.reload_epoch == 0  # review never touches the bank
+        pending = engine.miner.pending_list()
+        assert {e["tier"] for e in pending} <= {"shiftor", "dfa"}
+        on_disk = sorted(os.listdir(tmp_path / "mined" / "pending"))
+        assert on_disk == sorted(e["id"] + ".yaml" for e in pending)
+        # a fresh miner (restart) rehydrates the parked queue
+        engine2 = _miner_engine(mode="review", state_dir=str(tmp_path))
+        assert {e["id"] for e in engine2.miner.pending_list()} == {
+            e["id"] for e in pending
+        }
+        engine2.miner.stop()
+        # approval runs the FULL ladder and the swap
+        result = engine.miner.approve(pending[0]["id"])
+        assert result["status"] == "admitted" and result["epoch"] == 1
+        assert engine.miner.stats()["pending"] == 2
+        assert not (tmp_path / "mined" / "pending"
+                    / (pending[0]["id"] + ".yaml")).exists()
+        with pytest.raises(KeyError):
+            engine.miner.approve("mined-nope")
+        engine.miner.stop()
+
+    def test_miner_fault_is_contained(self):
+        engine = _miner_engine(mode="auto")
+        faults.install(FaultRegistry.parse("miner_admit_raise@times=3"))
+        engine.analyze(_pod(_novel_lines(0)))
+        engine.miner.pump()
+        stats = engine.miner.stats()
+        assert stats["rejected"].get("mined-fault") == 3, stats
+        assert stats["errors"] == 0 and stats["admitted"] == 0, stats
+        faults.install(FaultRegistry.parse("miner_raise@times=1"))
+        assert engine.miner.pump() == 0  # contained: a counter, no raise
+        assert engine.miner.stats()["errors"] == 1
+        engine.miner.stop()
+
+
+# ------------------------------------------------------------ review surface
+
+
+class TestMinedHTTP:
+    def _server(self, engine):
+        from log_parser_tpu.serve.http import make_server
+
+        server = make_server(engine, "127.0.0.1", 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+    def _req(self, url, path, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(url + path, data=data)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_review_api_roundtrip(self):
+        engine = _miner_engine(mode="review")
+        engine.analyze(_pod(_novel_lines(0)))
+        engine.miner.pump()
+        server, url = self._server(engine)
+        try:
+            status, body = self._req(url, "/patterns/mined")
+            assert status == 200 and len(body["pending"]) == 3
+            assert body["stats"]["mode"] == "review"
+            ids = [e["id"] for e in body["pending"]]
+            status, body = self._req(
+                url, "/patterns/mined", {"id": ids[0], "action": "approve"}
+            )
+            assert status == 200 and body["status"] == "admitted"
+            status, body = self._req(
+                url, "/patterns/mined", {"id": ids[1], "action": "reject"}
+            )
+            assert status == 200 and body["status"] == "rejected"
+            status, body = self._req(url, "/patterns/mined")
+            assert status == 200 and [e["id"] for e in body["pending"]] == [
+                ids[2]
+            ]
+            status, body = self._req(
+                url, "/patterns/mined", {"id": "mined-nope", "action": "approve"}
+            )
+            assert status == 404
+            status, body = self._req(
+                url, "/patterns/mined", {"id": ids[2]}
+            )
+            assert status == 400
+            # /trace/last surfaces the miner block
+            status, trace = self._req(url, "/trace/last")
+            assert status == 200 and trace["miner"]["admitted"] == 1
+        finally:
+            server.shutdown()
+            server.server_close()
+            engine.miner.stop()
+
+    def test_miner_disabled_404(self):
+        engine = AnalysisEngine(_curated_sets(), ScoringConfig())
+        server, url = self._server(engine)
+        try:
+            assert self._req(url, "/patterns/mined")[0] == 404
+            assert self._req(
+                url, "/patterns/mined", {"id": "x", "action": "reject"}
+            )[0] == 404
+        finally:
+            server.shutdown()
+            server.server_close()

@@ -168,8 +168,25 @@ Tenant group (``--group tenant``; multi-tenant serving — docs/OPS.md
                         B requests, A's ``reloadEpoch`` bumps, B's and
                         the default tenant's stay put.
 
+Miner group (``--group miner``; template miner — docs/OPS.md "Template
+miner"):
+
+- ``miner-tap-overflow``    a wedged miner worker (``miner_hang:inf``)
+                        under a 4-slot tap — the bounded queue fills,
+                        ``miner.dropped`` climbs on /trace/last, and the
+                        hot path never notices (every request 200).
+- ``miner-reject-identity``  a candidate rejected at the vet gates
+                        (byte-identical to a curated regex) leaves the
+                        serving bank OBJECT-identical and the reload
+                        epoch untouched.
+- ``miner-reload-race``     mined admission racing a concurrent curated
+                        reload under the quiesce gate — a clean
+                        retryable ``mined-swap``, curated reload lands
+                        first, the candidate re-admits on a later pump
+                        against the post-reload library.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|all]
                                    [--keep-logs]
 """
 
@@ -1439,6 +1456,157 @@ TENANT_STANDALONE = [
 ]
 
 
+def scenario_miner_tap_overflow(srv: Server):
+    """A wedged miner worker (miner_hang:inf) under a tiny tap capacity:
+    the bounded queue fills, further novel lines become DROPS — counted
+    on /trace/last, invisible to the hot path (every request still 200,
+    nothing blocks behind the dead consumer)."""
+    for r in range(6):
+        lines = "\n".join(
+            f"chaosnovel{r}x{i} widget rebalance pass={r}.{i}" for i in range(12)
+        )
+        status, body, _ = post_logs(srv.url, lines)
+        assert status == 200, (status, body)
+    trace = _poll_trace(
+        srv.url, lambda t: t.get("miner", {}).get("dropped", 0) >= 1
+    )
+    m = trace["miner"]
+    assert m["queued"] <= 4, m  # capacity env below
+    assert m["tapped"] <= 4, m  # nothing drained: worker is wedged
+    assert m["clusters"] == 0, m  # the consumer really is dead
+    # the hot path after saturation: still instant 200s
+    assert post_logs(srv.url, "one more\nplain line")[0] == 200
+
+
+MINER_SCENARIOS = [
+    (
+        "miner-tap-overflow",
+        ["--miner", "on"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "miner_hang:inf",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+            "LOG_PARSER_TPU_MINER_TAP_CAPACITY": "4",
+        },
+        scenario_miner_tap_overflow,
+    ),
+]
+
+
+def _miner_engine(curated_regex: str, mode: str = "auto"):
+    """In-process engine + miner for the standalone drills: one curated
+    pattern, line cache on, worker NOT started (pump() is driven
+    explicitly so every step is deterministic)."""
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.models.pattern import (
+        Pattern, PatternSet, PatternSetMetadata, PrimaryPattern,
+    )
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    sets = [
+        PatternSet(
+            metadata=PatternSetMetadata(library_id="curated", name="curated"),
+            patterns=[
+                Pattern(
+                    id="curated-1",
+                    name="curated",
+                    severity="HIGH",
+                    primary_pattern=PrimaryPattern(
+                        regex=curated_regex, confidence=0.8
+                    ),
+                )
+            ],
+        )
+    ]
+    engine = AnalysisEngine(sets, ScoringConfig())
+    engine.enable_line_cache(4)
+    engine.enable_miner(
+        mode=mode, min_support=3, stability=0, autostart=False
+    )
+    return engine, sets
+
+
+def _miner_pod(lines: list[str]):
+    from log_parser_tpu.models.pod import PodFailureData
+
+    return PodFailureData(pod={"metadata": {"name": "chaos"}}, logs="\n".join(lines))
+
+
+def scenario_miner_reject_identity():
+    """A vet-rejected candidate must leave the serving bank OBJECT-
+    identical — not rebuilt-equal, the same object — and the reload epoch
+    untouched. The curated pattern's regex is byte-identical to what the
+    synthesizer will emit, so admission rejects at the duplicate gate."""
+    engine, _ = _miner_engine(
+        r"FooBarBazQux\s{1,8}happened\s{1,8}at\s{1,8}\S{1,64}"
+    )
+    bank_before = engine.bank
+    epoch_before = engine.reload_epoch
+    engine.analyze(_miner_pod(
+        [f"FooBarBazQux happened at t{i}" for i in range(4)]
+    ))
+    engine.miner.pump()
+    stats = engine.miner.stats()
+    assert stats["rejected"].get("mined-duplicate") == 1, stats
+    assert stats["admitted"] == 0 and stats["errors"] == 0, stats
+    assert engine.bank is bank_before, "rejection rebuilt the bank"
+    assert engine.reload_epoch == epoch_before, engine.reload_epoch
+    engine.miner.stop()
+
+
+def scenario_miner_reload_race():
+    """Mined admission racing a concurrent curated reload: while the
+    quiesce gate is held by the curated swap, admission's apply_library
+    raises — a retryable mined-swap, never an error or a torn bank. The
+    curated reload lands first; the mined candidate re-admits on a later
+    pump against the POST-reload library."""
+    from log_parser_tpu.runtime.reload import build_candidate
+
+    engine, sets = _miner_engine("OutOfMemoryError")
+    engine.analyze(_miner_pod(
+        [f"zorblatt collector compacted tier t{i} fine" for i in range(4)]
+    ))
+    # hold the quiesce gate exactly the way an in-progress curated
+    # reload does, then pump: admission must fail CLEANLY into retry
+    with engine._quiesce_cv:
+        engine._swap_pending = True
+    try:
+        engine.miner.pump()
+    finally:
+        with engine._quiesce_cv:
+            engine._swap_pending = False
+            engine._quiesce_cv.notify_all()
+    stats = engine.miner.stats()
+    assert stats["retrying"] == 1 and stats["admitted"] == 0, stats
+    assert stats["errors"] == 0, stats
+    # the curated reload wins the race...
+    engine.apply_library(
+        build_candidate(sets, engine.config, engine_clock=engine.frequency.clock)
+    )
+    assert engine.reload_epoch == 1
+    # ...and the retry admits against the post-reload library
+    engine.miner.pump()
+    stats = engine.miner.stats()
+    assert stats["admitted"] == 1 and stats["retrying"] == 0, stats
+    assert stats["errors"] == 0 and not stats["rejected"], stats
+    ids = {p.id for ps in engine.bank.pattern_sets for p in ps.patterns}
+    assert "curated-1" in ids and any(i.startswith("mined-") for i in ids), ids
+    # the merged library serves: both curated and mined fire
+    r = engine.analyze(_miner_pod(
+        ["zorblatt collector compacted tier t9 fine", "OutOfMemoryError"]
+    ))
+    got = {e.matched_pattern.id for e in r.events}
+    assert "curated-1" in got and any(i.startswith("mined-") for i in got), got
+    engine.miner.stop()
+
+
+# in-process drills: object identity and deterministic gate-holding need
+# the engine in OUR process, not behind HTTP
+MINER_STANDALONE = [
+    ("miner-reject-identity", scenario_miner_reject_identity),
+    ("miner-reload-race", scenario_miner_reload_race),
+]
+
+
 SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
@@ -1488,7 +1656,7 @@ def main(argv: list[str] | None = None) -> int:
         "--group",
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
-            "streaming", "distributed", "tenant", "all",
+            "streaming", "distributed", "tenant", "miner", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1517,6 +1685,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(KERNEL_SCENARIOS)
     if args.group in ("streaming", "all"):
         single_server.extend(STREAMING_SCENARIOS)
+    if args.group in ("miner", "all"):
+        single_server.extend(MINER_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:
@@ -1541,6 +1711,8 @@ def main(argv: list[str] | None = None) -> int:
         standalone.extend(STATE_STANDALONE)
     if args.group in ("tenant", "all"):
         standalone.extend(TENANT_STANDALONE)
+    if args.group in ("miner", "all"):
+        standalone.extend(MINER_STANDALONE)
     for name, check in standalone:
         if args.only and name != args.only:
             continue

@@ -53,10 +53,19 @@ Three modes:
   ``POST /parse/stream`` and must get a structured ``bad-frame`` error
   frame with the server still healthy — a wedged session/server is the
   finding.
+- ``--miner``: NOT a parity sweep — a robustness sweep over the template
+  miner (log_parser_tpu/mining/). Seeded hostile miss lines — invalid
+  UTF-8, NULs, 1 MB single lines, regex-metacharacter soup, control
+  bytes — go through the REAL pipeline (tap offer → pump → cluster →
+  synthesize → vet) at ``min_support=1``: the miner must never raise
+  (``errors`` stays 0), the serving bank must stay object-identical in
+  review mode, and every regex the synthesizer emits must re-parse
+  through the bank's own compile entry points (``compile_java_regex``,
+  ``classify_regex`` off the skipped tier).
 
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
        [--sharded | --pattern-sharded | --long | --admin | --ingest |
-        --stream | --quick]
+        --stream | --miner | --quick]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
 9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
 the documented records below; --end exclusive)
@@ -121,6 +130,7 @@ def main() -> int:
     mode.add_argument("--admin", action="store_true")
     mode.add_argument("--ingest", action="store_true")
     mode.add_argument("--stream", action="store_true")
+    mode.add_argument("--miner", action="store_true")
     mode.add_argument(
         "--quick",
         action="store_true",
@@ -144,7 +154,17 @@ def main() -> int:
         start = _MODE_DEFAULTS["stream"][0]
         print(f"== quick sweep: stream seeds {start}..{start + 4}", flush=True)
         rc |= run_stream_sweep(start, start + 5)
+        start = _MODE_DEFAULTS["miner"][0]
+        print(f"== quick sweep: miner seeds {start}..{start + 4}", flush=True)
+        rc |= run_miner_sweep(start, start + 5)
         return rc
+    if args.miner:
+        start, end = _MODE_DEFAULTS["miner"]
+        if args.start is not None:
+            start = args.start
+        if args.end is not None:
+            end = args.end
+        return run_miner_sweep(start, end)
     if args.stream:
         start, end = _MODE_DEFAULTS["stream"]
         if args.start is not None:
@@ -194,6 +214,7 @@ _MODE_DEFAULTS = {
     "admin": (41000, 41050),
     "ingest": (51000, 51050),
     "stream": (61000, 61050),
+    "miner": (71000, 71024),
 }
 
 
@@ -751,6 +772,110 @@ def run_sweep(mode: str, start: int, end: int) -> int:
         if seed % 20 == 0:
             print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
     print(f"DONE {mode} seeds {start}..{end - 1} fails: {fails} "
+          f"({time.time() - t0:.0f}s)")
+    return 1 if fails else 0
+
+
+def _miner_hostile_lines(rng: "random.Random") -> list[bytes]:
+    """Seeded hostile miss lines: everything a real corrupted log stream
+    or an adversarial tenant could push through the line cache."""
+    meta = b".*+?()[]{}|\\^$"
+    cases = [
+        # invalid UTF-8 runs
+        bytes(rng.randrange(128, 256) for _ in range(rng.randrange(1, 200))),
+        # NUL-riddled line
+        b"abc\x00def \x00\x00 ghi" * rng.randrange(1, 8),
+        # 1 MB single line (tokenizer must truncate, never choke)
+        bytes([rng.randrange(33, 127)]) * (1 << 20),
+        # regex metacharacter soup — the synthesizer must escape or demote
+        bytes(rng.choice(meta) for _ in range(rng.randrange(4, 120))),
+        # metachar tokens with whitespace structure (clusterable!)
+        b" ".join(
+            bytes(rng.choice(meta) for _ in range(rng.randrange(1, 12)))
+            for _ in range(rng.randrange(2, 10))
+        ),
+        # control-character soup
+        bytes(rng.randrange(0, 32) for _ in range(rng.randrange(1, 100))),
+        # plausible template line with hostile slot values
+        b"evict shard \xff\xfe\x00 after "
+        + bytes([rng.randrange(256)]) * rng.randrange(1, 30),
+        # whitespace-only and empty
+        b" \t \t " * rng.randrange(1, 5),
+        b"",
+        # very many tokens (over MAX_TOKENS -> skipped, not mined)
+        b"tok " * rng.randrange(40, 200),
+    ]
+    rng.shuffle(cases)
+    return cases
+
+
+def run_miner_sweep(start: int, end: int) -> int:
+    """Fuzz the template miner (log_parser_tpu/mining/): hostile miss
+    lines through the real tap → pump → cluster → synthesize → vet
+    pipeline at ``min_support=1``. Findings: the miner raised (``errors``
+    moved), the serving bank changed in review mode, or a synthesized
+    regex failed the bank's own compile entry points."""
+    import random
+
+    from log_parser_tpu.analysis.tiers import classify_regex
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.golden.javacompat import compile_java_regex
+    from log_parser_tpu.mining.synthesize import synthesize, template_regex
+    from log_parser_tpu.mining.templates import TemplateClusterer
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    from helpers import make_pattern, make_pattern_set
+
+    engine = AnalysisEngine(
+        [make_pattern_set([
+            make_pattern("oom", regex="OutOfMemoryError", confidence=0.9),
+            make_pattern("conn", regex="Connection refused", confidence=0.7),
+        ])],
+        ScoringConfig(),
+    )
+    engine.enable_line_cache(4)
+    engine.enable_miner(
+        mode="review", min_support=1, stability=0, autostart=False
+    )
+    base_bank = engine.bank
+    t0 = time.time()
+    fails: list[tuple[int, str]] = []
+    for seed in range(start, end):
+        rng = random.Random(seed)
+        try:
+            lines = _miner_hostile_lines(rng)
+            # the real pipeline: offer -> pump (cluster/synthesize/vet)
+            for line in lines:
+                engine.miner.tap.offer(line)
+            engine.miner.pump()
+            stats = engine.miner.stats()
+            if stats["errors"]:
+                raise AssertionError(f"miner raised internally: {stats}")
+            if engine.bank is not base_bank:
+                raise AssertionError("review-mode miner swapped the bank")
+            # independent synthesis check: EVERY promotable hostile
+            # cluster's regex must re-parse through the bank's own
+            # compile entry points
+            cl = TemplateClusterer(min_support=1, stability=0)
+            for line in lines:
+                cl.observe(line)
+            for cluster in cl.promotable():
+                regex = template_regex(cluster.template)
+                compile_java_regex(regex)  # raises on a bad emit
+                pred = classify_regex(regex)
+                if pred.tier == "skipped":
+                    raise AssertionError(
+                        f"synthesized regex off every tier "
+                        f"({pred.reason_code}): {regex[:120]!r}"
+                    )
+                synthesize(cluster)  # full candidate must build too
+        except Exception as exc:  # noqa: BLE001 - recorded, sweep continues
+            fails.append((seed, repr(exc)[:300]))
+            print(f"SEED {seed} FAILED: {exc!r}", flush=True)
+        if seed % 10 == 0:
+            print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
+    engine.miner.stop()
+    print(f"DONE miner seeds {start}..{end - 1} fails: {fails} "
           f"({time.time() - t0:.0f}s)")
     return 1 if fails else 0
 

@@ -41,7 +41,18 @@ Checks (all files tracked by git, minus excluded dirs):
      live ``faults.fire`` site somewhere in the package (check 8's
      pattern cannot see fire calls that carry a waiver comment between
      the paren and the site string, so the tenancy sites get their own
-     table-driven check).
+     table-driven check);
+ 14. the template-miner vocabularies (log_parser_tpu/mining/) are
+     pinned: every admission rejection-reason code (``REJECT_REASONS``
+     in mining/admit.py — the /trace/last ``miner.rejected``
+     vocabulary) has a docs/PATTERNS.md row; every miner fault site
+     (``FAULT_SITES`` in mining/miner.py) has a docs/OPS.md row AND a
+     live ``faults.fire`` call site (check 13's idiom); every
+     ``--miner*``/``--mined-*`` serve flag has a docs/OPS.md table row
+     (stricter than check 7's substring: a backtick-quoted row); and
+     every key of the /trace/last ``miner`` block (the miner's
+     ``stats()`` dict) has a backtick-quoted docs/OPS.md entry
+     (stricter than check 9's word match).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -384,6 +395,110 @@ def check_tenancy_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_miner_vocab_pinned(root: Path) -> list[str]:
+    """Check 14: the template-miner vocabularies must be pinned the way
+    check 13 pins tenancy's. Rejection-reason codes (``REJECT_REASONS``
+    in mining/admit.py) are the triage vocabulary an operator reads off
+    ``/trace/last`` ``miner.rejected`` — each needs its
+    docs/PATTERNS.md row. Miner fault sites (``FAULT_SITES`` in
+    mining/miner.py) each need a docs/OPS.md row and a live
+    ``faults.fire`` call site (the comment-tolerant scan, since the
+    miner's fire calls carry conlint waivers). The miner serve flags
+    and the /trace/last ``miner`` block keys are held to the stricter
+    backtick-row standard (checks 7/9 would pass on an incidental
+    substring)."""
+    import ast
+
+    admit_src = root / "log_parser_tpu" / "mining" / "admit.py"
+    miner_src = root / "log_parser_tpu" / "mining" / "miner.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    patterns_doc = root / "docs" / "PATTERNS.md"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not admit_src.is_file() or not miner_src.is_file():
+        return []
+    problems: list[str] = []
+    patterns_text = patterns_doc.read_text() if patterns_doc.is_file() else ""
+    for key in _dict_keys_of(admit_src, "REJECT_REASONS"):
+        if f"`{key}`" not in patterns_text:
+            problems.append(
+                f"{admit_src}: rejection reason {key!r} is not documented "
+                "in docs/PATTERNS.md"
+            )
+    ops_text = ops_doc.read_text() if ops_doc.is_file() else ""
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    for key in _dict_keys_of(miner_src, "FAULT_SITES"):
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{miner_src}: miner fault site {key!r} is not documented "
+                "in docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{miner_src}: miner fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--mine[rd][a-z0-9-]*)"', serve_src.read_text()
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: miner serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    # the /trace/last ``miner`` block: string keys of every dict literal
+    # under the mining package's stats() methods (the miner merges the
+    # tap's and clusterer's stats into its own payload)
+    stats_keys: dict[str, Path] = {}
+    for path in sorted((pkg / "mining").rglob("*.py")):
+        if excluded(path):
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # check 5 owns syntax reporting
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.FunctionDef) and node.name == "stats"):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            stats_keys.setdefault(k.value, path)
+    tap_src = root / "log_parser_tpu" / "runtime" / "linecache.py"
+    if tap_src.is_file():
+        tree = ast.parse(tap_src.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "MissTap":
+                for fn in ast.walk(node):
+                    if isinstance(fn, ast.FunctionDef) and fn.name == "stats":
+                        for sub in ast.walk(fn):
+                            if isinstance(sub, ast.Dict):
+                                for k in sub.keys:
+                                    if isinstance(k, ast.Constant) and isinstance(
+                                        k.value, str
+                                    ):
+                                        stats_keys.setdefault(k.value, tap_src)
+    for key, path in sorted(stats_keys.items()):
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{path}: /trace/last miner counter {key!r} has no "
+                "backtick-quoted docs/OPS.md entry"
+            )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -411,6 +526,7 @@ def main() -> int:
         problems.extend(check_kernel_reasons_documented(root))
         problems.extend(check_stream_frames_documented(root))
         problems.extend(check_tenancy_vocab_pinned(root))
+        problems.extend(check_miner_vocab_pinned(root))
 
     for p in problems:
         print(p)

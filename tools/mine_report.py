@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Offline template-mining report CLI.
+
+Runs the online miner's tokenizer + clusterer (log_parser_tpu/mining/)
+over log files WITHOUT an engine or a serving process: the same
+Logram-style token-position templates the live miner would grow from
+the line-cache miss stream, reported as a table (or candidate YAML) so
+an operator can preview what ``--miner`` would mine from a corpus
+before turning it on — or mine a cold corpus that never hits a server.
+
+Usage:
+  python tools/mine_report.py FILE [FILE...]       # log files
+  cat app.log | python tools/mine_report.py -      # stdin
+  ... --min-support 20                             # promotion threshold
+  ... --yaml                                       # candidate YAML for
+                                                   # promotable clusters
+  ... --json                                       # machine-readable
+
+Exit codes: 0 = ran (even with zero clusters); 2 = a path could not be
+read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from log_parser_tpu.mining.synthesize import (  # noqa: E402
+    candidate_yaml,
+    synthesize,
+    template_regex,
+)
+from log_parser_tpu.mining.templates import (  # noqa: E402
+    TemplateClusterer,
+    template_id,
+)
+
+
+def _feed(clusterer: TemplateClusterer, stream) -> int:
+    n = 0
+    for raw in stream:
+        line = raw.rstrip(b"\r\n")
+        if not line.strip():
+            continue
+        clusterer.observe(line)
+        n += 1
+    return n
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mine_report")
+    parser.add_argument(
+        "paths", nargs="+", help="log files, or '-' for stdin"
+    )
+    parser.add_argument(
+        "--min-support", type=int, default=8,
+        help="miss lines a cluster must absorb to be promotable "
+        "(the live miner's --miner-min-support; default 8)",
+    )
+    parser.add_argument(
+        "--top", type=int, default=40,
+        help="clusters to show, by support (default 40)",
+    )
+    parser.add_argument(
+        "--yaml", action="store_true",
+        help="emit candidate PatternSet YAML for every promotable "
+        "cluster (what the live miner would park for review)",
+    )
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    # stability=0: an offline corpus is one frozen batch — there is no
+    # "later pump" for a template to hold still through, so promotability
+    # is support alone
+    clusterer = TemplateClusterer(
+        min_support=args.min_support, stability=0
+    )
+    lines = 0
+    for path in args.paths:
+        try:
+            if path == "-":
+                lines += _feed(clusterer, sys.stdin.buffer)
+            else:
+                with open(path, "rb") as fh:
+                    lines += _feed(clusterer, fh)
+        except OSError as exc:
+            print(f"error: {path}: {exc}", file=sys.stderr)
+            return 2
+
+    # promotable() applies the live miner's full promotion rule (support,
+    # stability, a probe-worthy fixed token) and marks the clusters, so
+    # the snapshot below carries the same promoted flag an operator would
+    # see on /trace/last
+    promotable = clusterer.promotable()
+    clusters = sorted(clusterer.snapshot(), key=lambda c: -c["support"])
+
+    if args.yaml:
+        for c in promotable:
+            print("---")
+            print(candidate_yaml(synthesize(c)), end="")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "lines": lines,
+                    "stats": clusterer.stats(),
+                    "clusters": clusters[: args.top],
+                    "promotable": [
+                        template_id(c.template) for c in promotable
+                    ],
+                },
+                indent=2,
+            )
+        )
+        return 0
+
+    stats = clusterer.stats()
+    print(
+        f"{lines} lines -> {stats['clusters']} clusters "
+        f"({stats['skipped']} skipped, {stats['discarded']} discarded at "
+        f"cap); {len(promotable)} promotable at support "
+        f">= {args.min_support}"
+    )
+    for c in clusters[: args.top]:
+        mark = "*" if c["promoted"] else " "
+        print(f"{mark} {c['support']:8d}  {c['id']}  {c['template']}")
+    if promotable:
+        print("\npromotable candidate regexes:")
+        for c in promotable:
+            print(f"  {template_id(c.template)}  {template_regex(c.template)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
