@@ -36,6 +36,7 @@ A_IN_B = "a-in-b"  # L(a) ⊊ L(b)
 B_IN_A = "b-in-a"
 INCOMPARABLE = "incomparable"
 UNDECIDED = "undecided"  # product-state budget exceeded
+DIFFERENT = "different"  # multi-DFA output bisimulation found a mismatch
 
 
 def _product_classes(a: CompiledDfa, b: CompiledDfa) -> list[tuple[int, int]]:
@@ -79,6 +80,54 @@ def compare_dfas(
     if not a_minus_b and not b_minus_a:
         return EQUAL
     return A_IN_B if not a_minus_b else B_IN_A
+
+
+def compare_multi_dfas(
+    a,
+    b,
+    max_product_states: int = DEFAULT_MAX_PRODUCT_STATES,
+) -> str:
+    """Exact output bisimulation between two union multi-DFAs
+    (patterns/regex/multidfa.py ``CompiledMultiDfa``) over the same
+    pattern list: EQUAL iff every reachable product state agrees on the
+    end-of-input ``accept_words`` AND on the ``out2`` row read for every
+    outgoing byte (the row index depends on the byte's word-ness, which
+    both byte-class partitions refine, so the pair agrees per byte).
+
+    Pointwise output agreement is exactly the congruence partition
+    refinement preserves, so this is the differential pin for the
+    minimizer (tests/test_dfa_minimize.py): a correct minimization always
+    passes, and any merge of observably distinct states is caught at the
+    first reachable witness. DIFFERENT on disagreement, UNDECIDED past
+    the product budget."""
+    import numpy as np
+
+    if a.n_patterns != b.n_patterns or a.n_words != b.n_words:
+        return DIFFERENT
+    # product alphabet: distinct (class_a, class_b) pairs + the shared
+    # word-ness of the bytes realizing each (both partitions refine
+    # WORD_BYTES membership, so word-ness is a function of the pair)
+    pairs: dict[tuple[int, int], int] = {}
+    for byte in range(256):
+        key = (int(a.byte_class[byte]), int(b.byte_class[byte]))
+        pairs.setdefault(key, int(a.cls_is_word[key[0]]))
+    start = (int(a.start), int(b.start))
+    seen = {start}
+    queue = deque([start])
+    while queue:
+        sa, sb = queue.popleft()
+        if not np.array_equal(a.accept_words[sa], b.accept_words[sb]):
+            return DIFFERENT
+        for (ca, cb), rw in pairs.items():
+            if not np.array_equal(a.out2[sa * 2 + rw], b.out2[sb * 2 + rw]):
+                return DIFFERENT
+            nxt = (int(a.trans[sa, ca]), int(b.trans[sb, cb]))
+            if nxt not in seen:
+                if len(seen) >= max_product_states:
+                    return UNDECIDED
+                seen.add(nxt)
+                queue.append(nxt)
+    return EQUAL
 
 
 def compare_all(

@@ -240,6 +240,16 @@ class MultiDfaBank:
             md.out2.reshape(S, 2, md.n_words).any(axis=(1, 2))
             | md.accept_words.any(axis=1)
         )
+        # class-level tables kept host-side for the Pallas kernel's
+        # byte-class-compressed planes (matchdfa_pallas._group_planes);
+        # n_states_unmin feeds the plan's geometry report, and the
+        # compiled automaton rides along (arrays shared, not copied) so
+        # admission tooling can snapshot re-partitioned groups
+        self._md = md
+        self._trans_np = md.trans
+        self._byte_class_np = md.byte_class
+        self._reports_np = reports
+        self.n_states_unmin = md.n_states_unmin or S
         packed = md.trans.astype(np.int64) | (
             reports.astype(np.int64)[md.trans] << 30
         )
@@ -885,6 +895,7 @@ class MatcherBanks:
         )
 
         self.multi_groups: list[MultiDfaBank] = []
+        self._multi_entries: list[list[tuple[int, str, bool]]] = []
         if use_multi:
             from log_parser_tpu.patterns.regex.multidfa import pack_union_groups
 
@@ -901,6 +912,13 @@ class MatcherBanks:
                 )
                 self.multi_groups = [
                     MultiDfaBank(md, keys) for keys, md in groups
+                ]
+                # per-group (key, regex, ci) in bit order — the kernel
+                # plan builder re-splits groups from these when the
+                # packed geometry exceeds the VMEM budget
+                emap = {e[0]: e for e in entries}
+                self._multi_entries = [
+                    [emap[k] for k in keys] for keys, _ in groups
                 ]
                 taken = set(take)
                 dense_cols = [k for k, _, _ in rejected_entries] + [
@@ -933,6 +951,28 @@ class MatcherBanks:
             self.prefilter_cols = [g for g, _ in pref_selected]
 
         self.dfa_cols = dense_cols
+        # opt-in Pallas union-DFA kernel (matchdfa_pallas.py): admitted
+        # BEFORE the cluster build because an admissible plan may
+        # RE-PARTITION the union groups (cheapest admissible split under
+        # the VMEM budget) — the cluster, the scan-tier fallbacks, and
+        # the kernel planes must all see the same group list. Env read
+        # once for the same frozen-under-jit reason as
+        # bitglush_use_pallas above.
+        self._dfa_pallas_plan = None
+        self.multidfa_pallas_reason = "off"
+        if os.environ.get("LOG_PARSER_TPU_PALLAS_DFA") == "1":
+            from log_parser_tpu.ops.matchdfa_pallas import build_dfa_plan
+
+            plan, reason = build_dfa_plan(
+                self.multi_groups,
+                entries=self._multi_entries or None,
+                max_states=self.MULTI_STATE_BUDGET,
+            )
+            self._dfa_pallas_plan = plan
+            self.multidfa_pallas_reason = reason
+            if plan is not None:
+                self.multi_groups = list(plan.groups)
+        self.multidfa_use_pallas = self._dfa_pallas_plan is not None
         # built once: cube() runs under jit, and constructing the cluster
         # there would re-run the table concatenation and bake a duplicate
         # copy of the fused table into every compiled executable.
@@ -950,19 +990,6 @@ class MatcherBanks:
         if self.multi_cluster is None:
             for g in self.multi_groups:
                 g._table()  # upload now, outside any jit trace (_table)
-        # opt-in Pallas union-DFA kernel (matchdfa_pallas.py): admitted
-        # here (table-size check is static) so cube() only re-checks the
-        # batch tile. Env read once for the same frozen-under-jit reason
-        # as bitglush_use_pallas above.
-        self._dfa_pallas_plan = None
-        self.multidfa_pallas_reason = "off"
-        if os.environ.get("LOG_PARSER_TPU_PALLAS_DFA") == "1":
-            from log_parser_tpu.ops.matchdfa_pallas import build_dfa_plan
-
-            plan, reason = build_dfa_plan(self.multi_groups)
-            self._dfa_pallas_plan = plan
-            self.multidfa_pallas_reason = reason
-        self.multidfa_use_pallas = self._dfa_pallas_plan is not None
         self.dfa_bank = DfaBank(
             [bank.columns[i].dfa for i in self.dfa_cols], stride=stride
         )
@@ -991,6 +1018,15 @@ class MatcherBanks:
         from log_parser_tpu.ops.matchdfa_pallas import dfa_tile
 
         return dfa_tile(self._dfa_pallas_plan, B) is not None
+
+    @property
+    def dfa_kernel_geometry(self) -> dict | None:
+        """The admitted plan's geometry report (states before/after
+        minimization, byte classes, plane bytes, chosen split) for the
+        engine's /trace/last kernel block; None when no plan."""
+        if self._dfa_pallas_plan is None:
+            return None
+        return self._dfa_pallas_plan.geometry
 
     @property
     def device_cols(self) -> list[int]:

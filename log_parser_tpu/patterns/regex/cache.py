@@ -34,7 +34,9 @@ from log_parser_tpu.patterns.regex.dfa import CompiledDfa, compile_regex_to_dfa
 log = logging.getLogger(__name__)
 
 # bump to invalidate every entry when the compiler's output changes shape
-COMPILER_VERSION = 2
+# v3: compile_regex_to_dfa minimizes (minimize.py) — v2 entries would
+# serve stale unminimized automata under the new kernel-admission math
+COMPILER_VERSION = 3
 
 # ------------------------------------------------------- raw entry format
 # Entries are a homegrown raw binary, not npz: np.savez routes every

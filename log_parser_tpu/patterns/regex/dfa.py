@@ -183,12 +183,16 @@ def compile_regex_to_dfa(
     case_insensitive: bool = False,
     max_states: int = 4096,
     node: Node | None = None,
+    minimize: bool = True,
 ) -> CompiledDfa:
     """Java regex → packed DFA with ``find()`` substring semantics.
 
     Uses the native (C++) subset construction when available — it also
     minimizes, shrinking the packed device tables — with the Python builder
-    as fallback. Raises :class:`RegexUnsupportedError` (dialect) or
+    as fallback; ``minimize`` applies the partition-refinement shrink
+    (minimize.py) on the Python path so both builders hand back minimal
+    automata (the ``max_states`` cap is checked on the raw construction
+    either way). Raises :class:`RegexUnsupportedError` (dialect) or
     :class:`DfaLimitError` (state blowup); both mean "host fallback".
     ``node``: an already-parsed AST for this exact (regex, flags) pair,
     so boot paths that parsed for literal/sequence extraction don't pay
@@ -214,4 +218,9 @@ def compile_regex_to_dfa(
             n_states=trans.shape[0],
             n_classes=trans.shape[1],
         )
-    return compile_nfa_to_dfa(nfa, regex=regex, max_states=max_states)
+    dfa = compile_nfa_to_dfa(nfa, regex=regex, max_states=max_states)
+    if minimize:
+        from log_parser_tpu.patterns.regex.minimize import minimize_dfa
+
+        dfa = minimize_dfa(dfa)
+    return dfa

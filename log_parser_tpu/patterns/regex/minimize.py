@@ -1,0 +1,172 @@
+"""DFA minimization: Moore/Hopcroft partition refinement + byte-class merge.
+
+The Pallas kernel tier stores the union automaton as dense VMEM-resident
+transition planes, so every state and every byte class is paid in bytes
+and MXU FLOPs (ops/matchdfa_pallas.py). The subset construction in
+dfa.py/multidfa.py is run-of-the-mill non-minimal: distinct (NFA-subset,
+left-context) pairs often have identical forward behaviour — same output
+words, same acceptance, transitions into the same blocks — and merging
+them is a pure table shrink with zero semantic change. Measured on the
+builtin bank's union groups this plus byte-class re-merge takes the
+largest group's kernel planes from 13.1 MB to ~2 MB (PERF.md §16).
+
+Algorithm: signature partition refinement (Moore's algorithm, the
+n·log n Hopcroft variant's simpler O(n·C·iters) cousin) vectorized over
+numpy — the initial partition groups states by their full observable
+output signature, then each round re-partitions by (block, successor
+blocks per class) rows via ``np.unique(axis=0)`` until the block count
+is stable. Convergence on the builtin groups is 26–62 rounds at
+~0.04–1.2 s per group, amortized by the on-disk caches.
+
+Two invariants the rest of the stack depends on:
+
+- **stable numbering** — blocks are renumbered by first-occurrence of a
+  member state, so minimization is deterministic and the single-DFA
+  MATCHED sink (state 0, dfa.py) keeps id 0: it is the first state, its
+  block is renumbered 0, and absorbing+accepting is preserved by
+  congruence.
+- **word-ness survives the class merge** — for the union automaton two
+  byte classes may share a transition column yet differ in word-char
+  membership, and ``out2`` row selection reads the incoming byte's
+  word-ness (``state*2 + rw``), so ``cls_is_word`` participates in the
+  column signature. The single-regex DFA resolved assertions at
+  construction, so its classes merge on transition columns alone.
+
+Correctness is pinned differentially (tests/test_dfa_minimize.py):
+exact product walks (analysis/subsumption.py) against the unminimized
+automaton plus randomized byte-walk sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from log_parser_tpu.patterns.regex.dfa import CompiledDfa
+from log_parser_tpu.patterns.regex.multidfa import CompiledMultiDfa
+
+
+def _refine(trans: np.ndarray, out_sig: np.ndarray) -> tuple[np.ndarray, int]:
+    """Coarsest partition of states refining ``out_sig`` and closed under
+    transitions. ``trans``: int [S, C]; ``out_sig``: int [S, K] observable
+    outputs. Returns (block id per state, block count)."""
+    S = trans.shape[0]
+    if S == 0:
+        return np.zeros(0, dtype=np.int64), 0
+    _, block = np.unique(out_sig, axis=0, return_inverse=True)
+    block = block.astype(np.int64).ravel()
+    n = int(block.max()) + 1
+    while True:
+        rows = np.concatenate([block[:, None], block[trans]], axis=1)
+        _, block = np.unique(rows, axis=0, return_inverse=True)
+        block = block.astype(np.int64).ravel()
+        n2 = int(block.max()) + 1
+        if n2 == n:
+            return block, n
+        n = n2
+
+
+def _stable_renumber(
+    block: np.ndarray, n: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Renumber blocks by first occurrence so minimization is
+    deterministic. Returns (renumbered block ids, representative member
+    per block — the lowest original id in each)."""
+    S = block.shape[0]
+    first = np.full(n, S, dtype=np.int64)
+    np.minimum.at(first, block, np.arange(S, dtype=np.int64))
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n, dtype=np.int64)
+    return rank[block], first[order]
+
+
+def _merge_classes(
+    trans: np.ndarray, byte_class: np.ndarray, extra: np.ndarray | None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Merge byte classes with identical transition columns (and identical
+    ``extra`` per-class columns, e.g. word-ness). Returns
+    (trans [S, C'], byte_class [256], representative old class per new,
+    C')."""
+    C = trans.shape[1]
+    cols = trans.T.astype(np.int64)
+    if extra is not None:
+        cols = np.concatenate([cols, extra.astype(np.int64)], axis=1)
+    _, cmap = np.unique(cols, axis=0, return_inverse=True)
+    cmap = cmap.astype(np.int64).ravel()
+    n = int(cmap.max()) + 1 if C else 0
+    cmap, creps = _stable_renumber(cmap, n)
+    return (
+        np.ascontiguousarray(trans[:, creps]),
+        cmap[byte_class].astype(np.int32),
+        creps,
+        n,
+    )
+
+
+def minimize_multi_dfa(md: CompiledMultiDfa) -> CompiledMultiDfa:
+    """Language-preserving shrink of a union multi-DFA: state partition
+    refinement over the full observable signature (both word-ness out2
+    rows + end-of-input accept words) followed by a word-ness-preserving
+    byte-class re-merge. ``n_states_unmin`` records the pre-minimization
+    count for the kernel-geometry report."""
+    S = md.n_states
+    if S == 0:
+        return md
+    unmin = md.n_states_unmin or S
+    out_sig = np.concatenate(
+        [
+            md.out2.reshape(S, 2 * md.n_words).astype(np.int64),
+            md.accept_words.astype(np.int64),
+        ],
+        axis=1,
+    )
+    block, n = _refine(md.trans, out_sig)
+    block, reps = _stable_renumber(block, n)
+    trans = np.ascontiguousarray(block[md.trans[reps]].astype(np.int32))
+    out2 = np.ascontiguousarray(
+        md.out2.reshape(S, 2, md.n_words)[reps].reshape(n * 2, md.n_words)
+    )
+    accept_words = np.ascontiguousarray(md.accept_words[reps])
+    trans, byte_class, creps, n_classes = _merge_classes(
+        trans, md.byte_class, md.cls_is_word[:, None]
+    )
+    return CompiledMultiDfa(
+        trans=trans,
+        byte_class=byte_class,
+        cls_is_word=np.ascontiguousarray(md.cls_is_word[creps]),
+        out2=out2,
+        accept_words=accept_words,
+        start=int(block[md.start]),
+        n_states=n,
+        n_classes=n_classes,
+        n_patterns=md.n_patterns,
+        n_words=md.n_words,
+        n_states_unmin=unmin,
+    )
+
+
+def minimize_dfa(dfa: CompiledDfa) -> CompiledDfa:
+    """Language-preserving shrink of a single-regex DFA (accept-at-end
+    observable only). The MATCHED sink keeps id 0 — see module docstring."""
+    S = dfa.n_states
+    if S == 0:
+        return dfa
+    out_sig = dfa.accept_end.astype(np.int64)[:, None]
+    block, n = _refine(dfa.trans, out_sig)
+    block, reps = _stable_renumber(block, n)
+    trans = np.ascontiguousarray(block[dfa.trans[reps]].astype(np.int32))
+    accept_end = np.ascontiguousarray(dfa.accept_end[reps])
+    trans, byte_class, _, n_classes = _merge_classes(
+        trans, dfa.byte_class, None
+    )
+    return dataclasses.replace(
+        dfa,
+        trans=trans,
+        byte_class=byte_class,
+        accept_end=accept_end,
+        start=int(block[dfa.start]),
+        n_states=n,
+        n_classes=n_classes,
+    )

@@ -75,6 +75,9 @@ class CompiledMultiDfa:
     n_classes: int
     n_patterns: int
     n_words: int
+    # pre-minimization state count (0 = unknown/not minimized) — surfaced
+    # in the kernel-plan geometry so plane shrink is visible, not silent
+    n_states_unmin: int = 0
 
     def matches(self, data: bytes) -> np.ndarray:
         """Reference executor: bool [n_patterns] containment flags."""
@@ -174,13 +177,17 @@ def _bits_of(finals_in: frozenset[int], final_bit: dict[int, int], n_words: int)
 
 
 def compile_union_nfas(
-    nfas: list[Nfa], max_states: int = 8192
+    nfas: list[Nfa], max_states: int = 8192, minimize: bool = True
 ) -> CompiledMultiDfa:
     """Determinize the union of ``nfas`` with per-pattern output bits.
 
     Uses the native (C++) union builder when available — it also minimizes
     (signature-partition Moore refinement), shrinking the packed tables —
-    with this module's Python construction as the fallback."""
+    with this module's Python construction as the fallback. ``minimize``
+    applies partition-refinement minimization + byte-class re-merge
+    (minimize.py) to the result; the ``max_states`` budget is always
+    checked on the UNMINIMIZED construction, so group packing decisions
+    don't depend on the minimizer."""
     merged, finals = _merge_nfas(nfas)
     n_patterns = len(nfas)
 
@@ -195,7 +202,7 @@ def compile_union_nfas(
         raise MultiDfaLimitError(f"union DFA exceeded {max_states} states")
     if built is not None:
         trans, byte_class, cls_word, out2, accept_words, start = built
-        return CompiledMultiDfa(
+        md = CompiledMultiDfa(
             trans=trans,
             byte_class=byte_class,
             cls_is_word=cls_word,
@@ -207,7 +214,13 @@ def compile_union_nfas(
             n_patterns=n_patterns,
             n_words=max(1, -(-n_patterns // 32)),
         )
-    return _compile_union_python(merged, finals, n_patterns, max_states)
+    else:
+        md = _compile_union_python(merged, finals, n_patterns, max_states)
+    if minimize:
+        from log_parser_tpu.patterns.regex.minimize import minimize_multi_dfa
+
+        md = minimize_multi_dfa(md)
+    return md
 
 
 def _compile_union_python(
@@ -290,14 +303,16 @@ def _compile_union_python(
 
 
 def compile_union_regexes(
-    entries: list[tuple[str, bool]], max_states: int = 8192
+    entries: list[tuple[str, bool]],
+    max_states: int = 8192,
+    minimize: bool = True,
 ) -> CompiledMultiDfa:
     """``entries``: (regex, case_insensitive) in bit order."""
     nfas = [
         build_nfa(parse_java_regex(rx, ci), unanchored_prefix=False)
         for rx, ci in entries
     ]
-    return compile_union_nfas(nfas, max_states=max_states)
+    return compile_union_nfas(nfas, max_states=max_states, minimize=minimize)
 
 
 # Regexes with unbounded gaps (``.*`` bridges, open-ended counted reps)
@@ -313,6 +328,7 @@ def pack_union_groups(
     entries: list[tuple[object, str, bool]],
     max_states: int = 8192,
     max_group: int = 64,
+    minimize: bool = True,
 ):
     """Greedily pack ``(key, regex, case_insensitive)`` entries into union
     groups under the state budget.
@@ -324,6 +340,11 @@ def pack_union_groups(
     where groups are ``(keys, CompiledMultiDfa)`` with bit *i* of the
     automaton = ``keys[i]``, and rejected entries exceeded the budget even
     alone (caller keeps them on another tier).
+
+    Trial builds skip minimization (the ``max_states`` packing budget is
+    defined over the raw subset construction, and minimizing every trial
+    would multiply boot cost); each SEALED group is minimized once, so
+    group membership is identical with or without ``minimize``.
     """
     pending = sorted(entries, key=lambda e: bool(_GAP.search(e[1])))
     groups: list[tuple[list[object], CompiledMultiDfa]] = []
@@ -337,7 +358,9 @@ def pack_union_groups(
             trial = cur + pending[:chunk]
             try:
                 b = compile_union_regexes(
-                    [(rx, ci) for _, rx, ci in trial], max_states=max_states
+                    [(rx, ci) for _, rx, ci in trial],
+                    max_states=max_states,
+                    minimize=False,
                 )
             except MultiDfaLimitError:
                 if chunk == 1:
@@ -354,5 +377,11 @@ def pack_union_groups(
             chunk *= 2
         if cur:
             assert built is not None
+            if minimize:
+                from log_parser_tpu.patterns.regex.minimize import (
+                    minimize_multi_dfa,
+                )
+
+                built = minimize_multi_dfa(built)
             groups.append(([k for k, _, _ in cur], built))
     return groups, rejected

@@ -317,14 +317,23 @@ class KernelTierStats:
         self._lock = threading.Lock()
         self.enabled = False
         self.reason = "off"
+        self.geometry: dict | None = None
         self.kernel_batches = 0
         self.kernel_rows = 0
         self.xla_batches = 0
 
-    def note(self, rows: int, active: bool, enabled: bool, reason: str):
+    def note(
+        self,
+        rows: int,
+        active: bool,
+        enabled: bool,
+        reason: str,
+        geometry: dict | None = None,
+    ):
         with self._lock:
             self.enabled = enabled
             self.reason = reason
+            self.geometry = geometry
             if not enabled:
                 return
             if active:
@@ -338,6 +347,7 @@ class KernelTierStats:
             return {
                 "enabled": self.enabled,
                 "reason": self.reason,
+                "geometry": self.geometry,
                 "kernelBatches": self.kernel_batches,
                 "kernelRows": self.kernel_rows,
                 "xlaBatches": self.xla_batches,
@@ -866,11 +876,15 @@ class AnalysisEngine:
         enabled = m.multidfa_use_pallas
         active = (
             enabled
-            and m.multidfa_pallas_reason == "ok"
+            and m.multidfa_pallas_reason not in ("fault", "no_tile")
             and m.dfa_kernel_active(batch_rows)
         )
         self.kernel_stats.note(
-            batch_rows, active, enabled, m.multidfa_pallas_reason
+            batch_rows,
+            active,
+            enabled,
+            m.multidfa_pallas_reason,
+            m.dfa_kernel_geometry,
         )
 
     def _run_device(self, enc, n_lines: int, om, ov):

@@ -1,0 +1,157 @@
+"""Differential pin for the DFA minimizer (patterns/regex/minimize.py).
+
+Minimization must be a pure size optimization: the language (single
+DFA) and the pointwise per-pattern output behaviour (union multi-DFA)
+of every automaton are IDENTICAL before and after the shrink. Both
+directions are pinned differentially — exact equivalence through the
+product-automaton walkers in analysis/subsumption.py on small automata,
+plus byte-walk sampling through the reference executors on everything
+(including randomized fuzz libraries), so a bad merge is caught at the
+first reachable witness rather than in a kernel parity failure three
+layers up. Structural invariants ride along: the single-DFA MATCHED
+sink stays state 0 (match.py packs bit 30 off its absorbing row),
+renumbering is deterministic, minimization is idempotent, and
+``n_states_unmin`` provenance survives for the kernel-plan geometry.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from log_parser_tpu.analysis.subsumption import (
+    EQUAL,
+    UNDECIDED,
+    compare_dfas,
+    compare_multi_dfas,
+)
+from log_parser_tpu.patterns.regex.dfa import compile_nfa_to_dfa
+from log_parser_tpu.patterns.regex.minimize import (
+    minimize_dfa,
+    minimize_multi_dfa,
+)
+from log_parser_tpu.patterns.regex.multidfa import compile_union_regexes
+from log_parser_tpu.patterns.regex.nfa import build_nfa
+from log_parser_tpu.patterns.regex.parser import parse_java_regex
+from tests.test_multidfa import LINES, REGEXES
+
+
+def _raw_single(rx: str, ci: bool = False):
+    """Unminimized single DFA with find() semantics (the exact automaton
+    compile_regex_to_dfa minimizes on the Python path)."""
+    nfa = build_nfa(parse_java_regex(rx, ci), unanchored_prefix=True)
+    return compile_nfa_to_dfa(nfa, regex=rx)
+
+
+def _sample_lines(rng: random.Random, n: int = 120) -> list[bytes]:
+    alphabet = "abE R:137fostdx.FGCpnic"
+    return [
+        "".join(
+            rng.choice(alphabet) for _ in range(rng.randrange(0, 48))
+        ).encode()
+        for _ in range(n)
+    ] + [ln.encode() for ln in LINES]
+
+
+# ------------------------------------------------------------- union DFAs
+
+
+def test_union_minimize_output_bisimulation_equal():
+    raw = compile_union_regexes(REGEXES, minimize=False)
+    mini = minimize_multi_dfa(raw)
+    assert mini.n_states <= raw.n_states
+    assert mini.n_classes <= raw.n_classes
+    assert mini.n_states_unmin == raw.n_states
+    assert compare_multi_dfas(raw, mini) == EQUAL
+
+
+def test_union_minimize_byte_walk_parity():
+    raw = compile_union_regexes(REGEXES, minimize=False)
+    mini = minimize_multi_dfa(raw)
+    for data in _sample_lines(random.Random(3)):
+        np.testing.assert_array_equal(
+            raw.matches(data), mini.matches(data), err_msg=repr(data)
+        )
+
+
+def test_union_minimize_shrinks_shared_suffixes():
+    """Distinct alternation branches with a common tail are exactly what
+    subset construction duplicates and minimization merges — the shrink
+    must be real, not a no-op rename."""
+    regexes = [("abcdefgh|xbcdefgh|ybcdefgh", False), ("zzcdefgh", False)]
+    raw = compile_union_regexes(regexes, minimize=False)
+    mini = minimize_multi_dfa(raw)
+    assert mini.n_states < raw.n_states
+    assert compare_multi_dfas(raw, mini) == EQUAL
+
+
+def test_union_minimize_deterministic_and_idempotent():
+    raw = compile_union_regexes(REGEXES, minimize=False)
+    a = minimize_multi_dfa(raw)
+    b = minimize_multi_dfa(raw)
+    np.testing.assert_array_equal(a.trans, b.trans)
+    np.testing.assert_array_equal(a.byte_class, b.byte_class)
+    np.testing.assert_array_equal(a.out2, b.out2)
+    np.testing.assert_array_equal(a.accept_words, b.accept_words)
+    assert a.start == b.start
+    again = minimize_multi_dfa(a)
+    assert again.n_states == a.n_states
+    assert again.n_classes == a.n_classes
+    np.testing.assert_array_equal(again.trans, a.trans)
+
+
+def test_union_fuzz_libraries():
+    """Randomized regex libraries over the supported dialect: every
+    library's union automaton must survive minimization with byte-walk
+    parity, and with product-walk equality whenever the product fits."""
+    frags = [
+        "ERROR", "FATAL", "panic: ", "a{2,4}b", "st[aeiou]rt", "foo$",
+        "^start", "exit code 137", "x?", "no such host", "\\bGC\\b",
+        "s.gfault", "re(d|try)", "[0-9a-f]{4}",
+    ]
+    rng = random.Random(17)
+    lines = _sample_lines(rng)
+    for _ in range(8):
+        k = rng.randrange(2, 7)
+        lib = [(rng.choice(frags), rng.random() < 0.3) for _ in range(k)]
+        raw = compile_union_regexes(lib, minimize=False)
+        mini = minimize_multi_dfa(raw)
+        verdict = compare_multi_dfas(raw, mini)
+        assert verdict in (EQUAL, UNDECIDED), (lib, verdict)
+        for data in lines:
+            np.testing.assert_array_equal(
+                raw.matches(data), mini.matches(data),
+                err_msg=f"{lib} on {data!r}",
+            )
+
+
+# ------------------------------------------------------------ single DFAs
+
+
+def test_single_minimize_language_equal():
+    for rx, ci in REGEXES:
+        raw = _raw_single(rx, ci)
+        mini = minimize_dfa(raw)
+        assert mini.n_states <= raw.n_states, rx
+        assert compare_dfas(raw, mini) == EQUAL, rx
+
+
+def test_single_minimize_matches_parity():
+    rng = random.Random(5)
+    lines = _sample_lines(rng)
+    for rx, ci in REGEXES:
+        raw = _raw_single(rx, ci)
+        mini = minimize_dfa(raw)
+        for data in lines:
+            assert raw.matches(data) == mini.matches(data), (rx, data)
+
+
+def test_single_minimize_keeps_matched_sink_at_zero():
+    """match.py's packed-word layout and the sticky-report invariant both
+    lean on state 0 being the absorbing accepting sink; minimization must
+    renumber around it, never through it."""
+    for rx in ("ERROR", "status.*red", "a{2,4}b"):
+        mini = minimize_dfa(_raw_single(rx))
+        assert bool(mini.accept_end[0])
+        assert (np.asarray(mini.trans[0]) == 0).all()

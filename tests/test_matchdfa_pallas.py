@@ -110,7 +110,7 @@ def test_kernel_parity_both_strides():
     ref_np = _numpy_reported(groups, np.asarray(lines_tb))
     np.testing.assert_array_equal(ref, ref_np)
     plan, reason = mdp.build_dfa_plan(groups)
-    assert reason == "ok" and plan is not None
+    assert reason in mdp.ADMITTED and plan is not None
     for stride in (2, 1):
         out = np.asarray(
             mdp.multidfa_reported_pallas(
@@ -193,10 +193,78 @@ def test_kernel_under_vmap_batched():
 # ------------------------------------------------------------- admission
 
 
-def test_oversized_table_refused():
+def _group_banks_with_entries(max_states: int = 400, max_group: int = 6):
+    """Like ``_group_banks`` but keeps the GLOBAL entry keys on the banks
+    and returns the per-group entries the split planner needs."""
+    entries = [(j, rx, ci) for j, (rx, ci) in enumerate(REGEXES)]
+    groups, _rej = pack_union_groups(
+        entries, max_states=max_states, max_group=max_group
+    )
+    emap = {e[0]: e for e in entries}
+    banks = [MultiDfaBank(md, keys) for keys, md in groups]
+    return banks, [[emap[k] for k in keys] for keys, _ in groups]
+
+
+def test_oversized_table_refused_without_entries():
     groups = _group_banks()
     plan, reason = mdp.build_dfa_plan(groups, budget=64 * 1024)
     assert plan is None and reason == "table_too_large"
+
+
+def test_oversized_table_refused_when_singletons_inadmissible():
+    """Entries enable re-splitting, but no split can beat the per-group
+    VMEM floor (~736 KB at the nominal tile) under a 64 KB budget — the
+    planner must refuse rather than loop."""
+    banks, gents = _group_banks_with_entries()
+    plan, reason = mdp.build_dfa_plan(banks, budget=64 * 1024, entries=gents)
+    assert plan is None and reason == "table_too_large"
+
+
+def test_admission_split_repartitions():
+    """A budget above the per-group floor but below the packed fixture
+    cost forces the admissible re-partition path: more groups, the same
+    columns in the same order, and bit parity on the split plan. The
+    fixture regexes ride ONE union group here (large ``max_group``) so
+    its padded planes overflow 900 KB while the split halves fit."""
+    banks, gents = _group_banks_with_entries(max_states=4096, max_group=64)
+    assert len(banks) == 1
+    plan, reason = mdp.build_dfa_plan(banks, budget=900 * 1024, entries=gents)
+    assert plan is not None and reason == "split"
+    assert plan.geometry["split"]
+    assert len(plan.groups) > len(banks)
+    assert [k for b in plan.groups for k in b.cols] == [
+        k for b in banks for k in b.cols
+    ]
+    lines_tb, _ = _encode_tb(LINES)
+    ref = _scan_reported(plan.groups, lines_tb)
+    np.testing.assert_array_equal(
+        ref, _numpy_reported(plan.groups, np.asarray(lines_tb))
+    )
+    out = np.asarray(mdp.multidfa_reported_pallas(plan, lines_tb, interpret=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.slow
+def test_builtin_bank_admits_under_production_budget():
+    """The PR's acceptance criterion, pinned: the builtin bank's union
+    groups (python pack, disk-cached by the tool) admit under the
+    production 12 MB VMEM budget. Mirrors hygiene check 15 in-process."""
+    import importlib.util
+    import pathlib
+    import sys as _sys
+
+    tool = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools"
+        / "check_dfa_admission.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_dfa_admission", tool)
+    mod = importlib.util.module_from_spec(spec)
+    _sys.modules["check_dfa_admission"] = mod
+    spec.loader.exec_module(mod)
+    report = mod.run_admission()
+    assert report["admitted"], report
+    assert report["geometry"]["vmemPerStep"] <= mdp.DFA_VMEM_BUDGET
 
 
 def test_no_tile_for_unaligned_batch():
@@ -207,8 +275,15 @@ def test_no_tile_for_unaligned_batch():
 
 
 def test_vmem_estimate_monotone():
-    assert mdp._vmem_estimate(256, 128, 64) < mdp._vmem_estimate(512, 128, 64)
-    assert mdp._vmem_estimate(256, 64, 64) < mdp._vmem_estimate(256, 128, 64)
+    assert mdp._vmem_estimate(256, 16, 128, 64) < mdp._vmem_estimate(
+        512, 16, 128, 64
+    )
+    assert mdp._vmem_estimate(256, 8, 128, 64) < mdp._vmem_estimate(
+        256, 16, 128, 64
+    )
+    assert mdp._vmem_estimate(256, 16, 64, 64) < mdp._vmem_estimate(
+        256, 16, 128, 64
+    )
 
 
 # ------------------------------------------------- MatcherBanks integration
@@ -238,7 +313,10 @@ def test_cube_parity_kernel_tier(multi_engaged, monkeypatch):
     assert off.multidfa_pallas_reason == "off"
     monkeypatch.setenv("LOG_PARSER_TPU_PALLAS_DFA", "1")
     on = MatcherBanks(bank, **_KW)
-    assert on.multidfa_use_pallas and on.multidfa_pallas_reason == "ok"
+    assert on.multidfa_use_pallas
+    assert on.multidfa_pallas_reason in mdp.ADMITTED
+    assert on.dfa_kernel_geometry is not None
+    assert on.dfa_kernel_geometry["states"] <= on.dfa_kernel_geometry["statesUnmin"]
     enc = encode_lines(LINES, 4096, 128, 8)
     lt, ln = jnp.asarray(enc.u8.T), jnp.asarray(enc.lengths)
     got = np.asarray(on.cube(lt, ln))
@@ -313,24 +391,31 @@ def test_engine_kernel_stats_counters():
         "kernelBatches": 0,
         "kernelRows": 0,
         "xlaBatches": 0,
+        "geometry": None,
     }
-    ks.note(128, active=True, enabled=True, reason="ok")
-    ks.note(64, active=False, enabled=True, reason="fault")
+    geom = {"nGroups": 2, "sPad": 128}
+    ks.note(128, active=True, enabled=True, reason="byte_classed",
+            geometry=geom)
+    ks.note(64, active=False, enabled=True, reason="fault", geometry=geom)
     ks.note(32, active=False, enabled=False, reason="off")  # not counted
     s = ks.stats()
     assert s["kernelBatches"] == 1 and s["kernelRows"] == 128
     assert s["xlaBatches"] == 1
     assert s["enabled"] is False and s["reason"] == "off"
+    assert s["geometry"] is None  # last note carried no plan geometry
 
 
 def test_reason_codes_documented():
     """Every runtime reason the tier can report is a REASONS key (the
     hygiene gate pins REASONS keys to docs/OPS.md rows)."""
     assert set(mdp.REASONS) >= {
-        "ok",
+        "byte_classed",
+        "split",
         "off",
         "no_union_groups",
         "table_too_large",
         "no_tile",
         "fault",
     }
+    assert "ok" not in mdp.REASONS  # replaced by the admission provenance
+    assert mdp.ADMITTED == {"byte_classed", "split"}

@@ -1,0 +1,169 @@
+"""Admission oracle: does the builtin bank admit to the Pallas DFA kernel?
+
+PR 8's union-DFA kernel refused the builtin bank for five rounds
+(13.1 MB of raw transition planes vs the 12 MB VMEM budget, PERF.md
+§12) and nothing pinned that regression — the kernel tier could only be
+observed refusing at runtime. This tool IS the pin: it packs the
+builtin pattern bank's union groups exactly as MatcherBanks does
+(native builder when available, python subset construction otherwise),
+runs ``build_dfa_plan`` with per-group entries under the production
+VMEM budget, prints one JSON verdict (reason + plane geometry), and
+exits nonzero unless the plan admits (REASONS ``byte_classed`` /
+``split``). Hygiene check 15 runs it on every full scan and
+tests/test_matchdfa_pallas.py pins it as a slow test.
+
+The python union pack costs ~2 minutes cold on a native-less host, so
+the MINIMIZED packed groups are cached under the shared cache tree
+(``~/.cache/log_parser_tpu/union``, honoring ``LOG_PARSER_TPU_CACHE``)
+keyed on the compiler version + the exact column entries; warm runs
+take seconds (the admission split itself re-runs every time — it is
+the thing under test). ``--force`` ignores the cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _builtin_entries() -> list[tuple[int, str, bool]]:
+    """(column index, regex, case_insensitive) for every regex column of
+    the builtin bank — the same candidate set MatcherBanks offers the
+    union tier (tools/probe_kernels.py uses the identical rebuild)."""
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+    from log_parser_tpu.runtime import AnalysisEngine
+
+    engine = AnalysisEngine(load_builtin_pattern_sets(), ScoringConfig())
+    return [
+        (i, c.regex, c.case_insensitive)
+        for i, c in enumerate(engine.matchers.bank.columns)
+        if getattr(c, "regex", None)
+    ]
+
+
+def _cache_file(key: str):
+    from log_parser_tpu.patterns.regex.cache import cache_subdir
+
+    d = cache_subdir("union")
+    return None if d is None else d / f"admission-{key}.npz"
+
+
+def _save_groups(path, groups) -> None:
+    from log_parser_tpu.patterns.regex.cache import atomic_publish
+
+    arrs: dict[str, np.ndarray] = {"n_groups": np.int64(len(groups))}
+    for gi, (keys, md) in enumerate(groups):
+        arrs[f"g{gi}_keys"] = np.asarray(keys, np.int64)
+        arrs[f"g{gi}_trans"] = md.trans
+        arrs[f"g{gi}_byte_class"] = md.byte_class
+        arrs[f"g{gi}_cls_is_word"] = md.cls_is_word
+        arrs[f"g{gi}_out2"] = md.out2
+        arrs[f"g{gi}_accept_words"] = md.accept_words
+        arrs[f"g{gi}_start"] = np.int64(md.start)
+        arrs[f"g{gi}_unmin"] = np.int64(md.n_states_unmin)
+    atomic_publish(path.parent, path.name, lambda f: np.savez(f, **arrs))
+
+
+def _load_groups(path):
+    from log_parser_tpu.patterns.regex.multidfa import CompiledMultiDfa
+
+    try:
+        with np.load(path) as z:
+            out = []
+            for gi in range(int(z["n_groups"])):
+                keys = [int(k) for k in z[f"g{gi}_keys"]]
+                trans = z[f"g{gi}_trans"]
+                md = CompiledMultiDfa(
+                    trans=trans,
+                    byte_class=z[f"g{gi}_byte_class"],
+                    cls_is_word=z[f"g{gi}_cls_is_word"],
+                    out2=z[f"g{gi}_out2"],
+                    accept_words=z[f"g{gi}_accept_words"],
+                    start=int(z[f"g{gi}_start"]),
+                    n_states=trans.shape[0],
+                    n_classes=trans.shape[1],
+                    n_patterns=len(keys),
+                    n_words=z[f"g{gi}_out2"].shape[1],
+                    n_states_unmin=int(z[f"g{gi}_unmin"]),
+                )
+                out.append((keys, md))
+            return out
+    except Exception:
+        return None  # corrupt/stale cache: rebuild (never wrong)
+
+
+def run_admission(budget: int | None = None, force: bool = False) -> dict:
+    """Pack (or load) the builtin union groups and adjudicate kernel
+    admission. Returns the JSON-able verdict dict."""
+    from log_parser_tpu.ops.match import MatcherBanks, MultiDfaBank
+    from log_parser_tpu.ops.matchdfa_pallas import ADMITTED, build_dfa_plan
+    from log_parser_tpu.patterns.regex.cache import COMPILER_VERSION
+    from log_parser_tpu.patterns.regex.multidfa import pack_union_groups
+
+    t0 = time.time()
+    entries = _builtin_entries()
+    max_states = MatcherBanks.MULTI_STATE_BUDGET
+    max_group = MatcherBanks.MULTI_MAX_GROUP
+    h = hashlib.sha256()
+    h.update(f"v{COMPILER_VERSION}|ms={max_states}|mg={max_group}".encode())
+    for i, rx, ci in entries:
+        h.update(f"|{i}|{int(ci)}|{rx}".encode())
+    path = _cache_file(h.hexdigest()[:24])
+    groups = None
+    if not force and path is not None and path.exists():
+        groups = _load_groups(path)
+    cached = groups is not None
+    if groups is None:
+        groups, _rejected = pack_union_groups(
+            entries, max_states=max_states, max_group=max_group
+        )
+        if path is not None:
+            _save_groups(path, groups)
+    emap = {e[0]: e for e in entries}
+    banks = [MultiDfaBank(md, keys) for keys, md in groups]
+    group_entries = [[emap[k] for k in keys] for keys, _ in groups]
+    plan, reason = build_dfa_plan(
+        banks, budget=budget, entries=group_entries, max_states=max_states
+    )
+    return {
+        "reason": reason,
+        "admitted": reason in ADMITTED,
+        "geometry": None if plan is None else plan.geometry,
+        "regexColumns": len(entries),
+        "unionPackCached": cached,
+        "elapsedS": round(time.time() - t0, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="builtin-bank Pallas DFA kernel admission verdict"
+    )
+    ap.add_argument(
+        "--force",
+        action="store_true",
+        help="ignore the cached union pack and rebuild from the regexes",
+    )
+    ap.add_argument(
+        "--budget",
+        type=int,
+        default=None,
+        help="VMEM budget override in bytes (default: production 12 MB)",
+    )
+    args = ap.parse_args()
+    report = run_admission(budget=args.budget, force=args.force)
+    print(json.dumps(report))
+    sys.exit(0 if report["admitted"] else 1)
+
+
+if __name__ == "__main__":
+    main()

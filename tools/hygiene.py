@@ -52,7 +52,13 @@ Checks (all files tracked by git, minus excluded dirs):
      (stricter than check 7's substring: a backtick-quoted row); and
      every key of the /trace/last ``miner`` block (the miner's
      ``stats()`` dict) has a backtick-quoted docs/OPS.md entry
-     (stricter than check 9's word match).
+     (stricter than check 9's word match);
+ 15. the builtin bank ADMITS to the Pallas union-DFA kernel:
+     tools/check_dfa_admission.py must report an ADMITTED reason
+     (``byte_classed``/``split``) under the production VMEM budget — a
+     pattern or compiler change that regresses the verdict to
+     ``table_too_large`` fails the gate, not a silent runtime fallback
+     (the union pack is disk-cached, so warm runs cost seconds).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -499,6 +505,40 @@ def check_miner_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_kernel_admission(root: Path) -> list[str]:
+    """Check 15: the PR that shrank the union DFA under the VMEM budget
+    (Hopcroft minimization + byte-class planes + admissible splits) is
+    pinned here — tools/check_dfa_admission.py packs the builtin bank's
+    union groups and must come back with an ADMITTED verdict. Runs as a
+    subprocess (check 10's idiom) so hygiene itself never imports the
+    jax stack; the tool's union-pack disk cache keeps warm runs cheap."""
+    import json
+    import os
+
+    tool = root / "tools" / "check_dfa_admission.py"
+    if not tool.is_file():
+        return []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(tool)],
+        cwd=root, capture_output=True, text=True, env=env,
+    )
+    if proc.returncode == 0:
+        return []
+    try:
+        reason = json.loads(proc.stdout.splitlines()[-1]).get("reason")
+    except Exception:
+        reason = None
+    detail = (
+        f"verdict {reason!r}" if reason
+        else f"tool failed (rc={proc.returncode}): {proc.stderr.strip()[-300:]}"
+    )
+    return [
+        f"{tool}: builtin bank no longer admits to the union-DFA kernel — "
+        f"{detail} (run `python tools/check_dfa_admission.py` to reproduce)"
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -527,6 +567,7 @@ def main() -> int:
         problems.extend(check_stream_frames_documented(root))
         problems.extend(check_tenancy_vocab_pinned(root))
         problems.extend(check_miner_vocab_pinned(root))
+        problems.extend(check_kernel_admission(root))
 
     for p in problems:
         print(p)

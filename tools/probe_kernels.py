@@ -130,12 +130,18 @@ REPACK_MAX_STATES = 2048
 
 
 def _union_groups(matchers, max_states: int | None = None):
-    """The engine's union groups; on hosts where the tier policy left
-    them empty (no native builder), or when a ``max_states`` re-pack is
-    requested, rebuild through the Python union construction over the
-    same regex columns so the kernel A/B runs."""
+    """The engine's union groups plus their per-group entries (the
+    admission planner needs entries to re-split oversized groups); on
+    hosts where the tier policy left them empty (no native builder), or
+    when a ``max_states`` re-pack is requested, rebuild through the
+    Python union construction over the same regex columns so the kernel
+    A/B runs."""
     if max_states is None and matchers.multi_groups:
-        return matchers.multi_groups, False
+        return (
+            matchers.multi_groups,
+            getattr(matchers, "_multi_entries", None) or None,
+            False,
+        )
     from log_parser_tpu.ops.match import MatcherBanks, MultiDfaBank
     from log_parser_tpu.patterns.regex.multidfa import pack_union_groups
 
@@ -145,13 +151,18 @@ def _union_groups(matchers, max_states: int | None = None):
         if getattr(c, "regex", None)
     ]
     if not entries:
-        return [], False
+        return [], None, False
     groups, _rejected = pack_union_groups(
         entries,
         max_states=max_states or MatcherBanks.MULTI_STATE_BUDGET,
         max_group=MatcherBanks.MULTI_MAX_GROUP,
     )
-    return [MultiDfaBank(md, keys) for keys, md in groups], True
+    emap = {e[0]: e for e in entries}
+    return (
+        [MultiDfaBank(md, keys) for keys, md in groups],
+        [[emap[k] for k in keys] for keys, _ in groups],
+        True,
+    )
 
 
 def _probe_multidfa(matchers, lines_tb, lens, repeats: int) -> dict:
@@ -166,20 +177,32 @@ def _probe_multidfa(matchers, lines_tb, lens, repeats: int) -> dict:
         multidfa_reported_pallas,
     )
 
-    groups, forced = _union_groups(matchers)
+    from log_parser_tpu.ops.match import MatcherBanks
+
+    groups, group_entries, forced = _union_groups(matchers)
     if not groups:
         return {"skipped": "no union groups (no regex columns to pack)"}
-    plan, reason = build_dfa_plan(groups)
+    plan, reason = build_dfa_plan(
+        groups,
+        entries=group_entries,
+        max_states=MatcherBanks.MULTI_STATE_BUDGET,
+    )
     repacked = None
     if plan is None and reason == "table_too_large":
-        # the bank's 8192-state groups legitimately fail admission —
-        # re-pack tighter so the kernel is measured on admissible groups
-        groups, forced = _union_groups(matchers, REPACK_MAX_STATES)
+        # admission failed even with the entry-level re-split (or no
+        # entries survived to split on) — re-pack tighter as a backstop
+        # so the kernel is still measured on admissible groups
+        groups, group_entries, forced = _union_groups(
+            matchers, REPACK_MAX_STATES
+        )
         if groups:
-            plan, reason = build_dfa_plan(groups)
+            plan, reason = build_dfa_plan(groups, entries=group_entries)
             repacked = REPACK_MAX_STATES
     if plan is None:
         return {"skipped": f"kernel admission refused: {reason}"}
+    # the plan may have re-split groups for admission — the XLA baseline
+    # must scan the SAME automata the kernel runs, so adopt plan.groups
+    groups = list(plan.groups)
     B = int(lens.shape[0])
     T = int(lines_tb.shape[0])
     tile = dfa_tile(plan, B, T)
@@ -189,6 +212,8 @@ def _probe_multidfa(matchers, lines_tb, lens, repeats: int) -> dict:
         "n_groups": plan.n_groups,
         "s_pad": plan.s_pad,
         "tile_b": tile,
+        "admission_reason": reason,
+        "geometry": plan.geometry,
         "forced_python_union": forced,
         "repacked_max_states": repacked,
     }
