@@ -262,6 +262,12 @@ def main() -> None:
 
     extra["boot_seconds"] = round(boot_seconds, 3)
     extra["compile_cache"] = xlacache.stats()
+    obs = getattr(engine, "obs", None)
+    if obs is not None:
+        # the same Prometheus exposition GET /metrics serves, snapshotted
+        # at campaign end — the artifact carries the full counter state
+        # the run produced, not just the headline
+        extra["metrics"] = obs.registry.render()
     bench_common.emit(
         metric,
         headline["lines_per_sec"],

@@ -1,0 +1,287 @@
+"""Fleet observability plane: one :class:`Obs` bundle per engine family
+ties together the metrics registry (:mod:`.registry`), the request-trace
+ring (:mod:`.ring`), SLO burn accounting (:mod:`.slo`) and on-demand
+device profiling (:mod:`.profiler`).
+
+The bundle is rooted at the engine (``engine.obs``) rather than being a
+process singleton: every transport (HTTP, framed shim, gRPC, streaming)
+already holds the engine, tenant engines share the primary's bundle
+under their own ``tenant`` label, and each test engine gets fresh
+zeroed counters instead of cross-test pollution. Configuration comes
+from the same env vars the serve flags mirror, read once per bundle."""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+from log_parser_tpu.obs.profiler import (  # noqa: F401  (re-export)
+    DeviceProfiler,
+    ProfilerBusy,
+    ProfilerUnavailable,
+)
+from log_parser_tpu.obs.registry import (  # noqa: F401  (re-export)
+    METRICS,
+    Registry,
+    samples_from_stats,
+)
+from log_parser_tpu.obs.ring import DEFAULT_CAPACITY, DEFAULT_SLOW_MS, TraceRing
+from log_parser_tpu.obs.slo import (
+    DEFAULT_BURN_THRESHOLD,
+    DEFAULT_WINDOWS_S,
+    SloTracker,
+)
+
+# finer low end than the request histogram: cache-hit phases are sub-ms
+PHASE_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+# engine-attribute samples every engine collector emits; subsystems with
+# their own stats() dicts keep their spec next to that method instead
+# (serve/admission.py, runtime/{batcher,linecache,stream,tenancy}.py)
+_QUARANTINE_SAMPLES = (
+    ("active", "logparser_quarantine_active", {}),
+    ("servedGolden", "logparser_quarantine_served_golden_total", {}),
+)
+_SHADOW_SAMPLES = (
+    ("divergences", "logparser_shadow_divergences_total", {}),
+)
+_MINER_SAMPLES = (
+    ("tapped", "logparser_miner_tapped_total", {}),
+    ("admitted", "logparser_miner_admitted_total", {}),
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class Obs:
+    """Registry + trace ring + SLO tracker + profiler for one engine
+    family. Cheap to construct (no threads, no jax imports)."""
+
+    def __init__(self, clock=time.monotonic):
+        self.registry = Registry()
+        self.ring = TraceRing(
+            capacity=int(
+                _env_float("LOG_PARSER_TPU_TRACE_RING", DEFAULT_CAPACITY)
+            ),
+            slow_ms=_env_float("LOG_PARSER_TPU_TRACE_SLOW_MS", DEFAULT_SLOW_MS),
+        )
+        windows = tuple(
+            float(w)
+            for w in os.environ.get("LOG_PARSER_TPU_SLO_WINDOWS_S", "").split(",")
+            if w.strip()
+        ) or DEFAULT_WINDOWS_S
+        self.slo = SloTracker(
+            p99_ms=_env_float("LOG_PARSER_TPU_SLO_P99_MS", 0.0),
+            availability=_env_float("LOG_PARSER_TPU_SLO_AVAILABILITY", 0.0),
+            windows_s=windows,
+            burn_threshold=_env_float(
+                "LOG_PARSER_TPU_SLO_BURN", DEFAULT_BURN_THRESHOLD
+            ),
+            clock=clock,
+        )
+        self.profiler = DeviceProfiler(on_complete=self._profile_done)
+        self.clock = clock
+        reg = self.registry
+        self.requests_total = reg.counter(
+            "logparser_requests_total",
+            ("transport", "route", "status", "tenant"),
+            max_series=256,
+        )
+        self.request_seconds = reg.histogram(
+            "logparser_request_seconds", ("route",)
+        )
+        self.phase_seconds = reg.histogram(
+            "logparser_phase_seconds", ("tenant", "phase", "route"),
+            buckets=PHASE_BUCKETS, max_series=256,
+        )
+        self.slow_requests = reg.counter(
+            "logparser_slow_requests_total", ("route",)
+        )
+        self.dropped = reg.counter(
+            "logparser_dropped_responses_total", ("transport",)
+        )
+        self.profile_captures = reg.counter("logparser_profile_captures_total")
+        reg.register_collector("slo", self.slo.samples)
+
+    def _profile_done(self) -> None:
+        self.profile_captures.inc()
+
+    # ------------------------------------------------------- identity
+
+    @staticmethod
+    def new_request_id() -> str:
+        return uuid.uuid4().hex[:16]
+
+    @staticmethod
+    def clean_request_id(raw: str | None) -> str | None:
+        """Sanitize an inbound X-Request-Id: printable, bounded, no
+        header/label injection."""
+        if not raw:
+            return None
+        rid = "".join(c for c in raw.strip() if c.isprintable())[:128]
+        return rid or None
+
+    # ------------------------------------------------------- hot path
+
+    def note_served(self, trace, start: float, tenant: str,
+                    outcome: str = "ok", n_lines: int | None = None,
+                    error: str | None = None) -> None:
+        """One engine-served request: phase histograms + ring entry.
+        Called from ``_finish`` (and the fallback path) with the
+        request's :class:`PhaseTrace`."""
+        route = getattr(trace, "route", "device") or "device"
+        request_id = getattr(trace, "request_id", None) or self.new_request_id()
+        total_ms = (self.clock() - start) * 1e3
+        phases = trace.as_dict()
+        observe = self.phase_seconds.observe
+        for phase, seconds in phases.items():
+            observe(seconds, tenant=tenant, phase=phase, route=route)
+        entry = {
+            "requestId": request_id,
+            "tenant": tenant,
+            "route": route,
+            "outcome": outcome,
+            "totalMs": round(total_ms, 3),
+            "phasesMs": {k: round(v * 1e3, 3) for k, v in phases.items()},
+        }
+        if n_lines is not None:
+            entry["lines"] = n_lines
+        if error is not None:
+            entry["error"] = error
+        if self.ring.record(entry):
+            self.slow_requests.inc(route=route)
+
+    def note_request(self, transport: str, route: str, status: int,
+                     tenant: str, duration_s: float,
+                     request_id: str | None = None,
+                     detail: str | None = None) -> None:
+        """One transport-level request outcome: totals, latency, SLO.
+        Ring entries for non-200 outcomes (200s were already recorded by
+        the engine with full phase detail)."""
+        self.requests_total.inc(
+            transport=transport, route=route, status=str(status),
+            tenant=tenant,
+        )
+        self.request_seconds.observe(duration_s, route=route)
+        self.slo.note(ok=status < 500, duration_ms=duration_s * 1e3)
+        if status != 200:
+            entry = {
+                "requestId": request_id or self.new_request_id(),
+                "tenant": tenant,
+                "route": route,
+                "outcome": f"http_{status}" if transport == "http"
+                else f"{transport}_{status}",
+                "totalMs": round(duration_s * 1e3, 3),
+                "phasesMs": {},
+            }
+            if detail:
+                entry["error"] = detail
+            if self.ring.record(entry):
+                self.slow_requests.inc(route=route)
+
+    def note_dropped(self, transport: str) -> None:
+        """A computed response the transport could not write back —
+        the one counter shared by HTTP, framed shim and gRPC."""
+        self.dropped.inc(transport=transport)
+
+    @property
+    def dropped_responses(self) -> int:
+        return int(self.dropped.total())
+
+    # ----------------------------------------------------- collectors
+
+    def add_engine_collector(self, engine) -> None:
+        """Scrape-time view over one engine's counters and its enabled
+        subsystems' ``stats()`` dicts (line cache, interner, batcher,
+        kernel tier, quarantine, shadow, miner)."""
+
+        def collect():
+            tenant = getattr(engine, "obs_tenant", "default")
+            labels = {"tenant": tenant}
+            out = [
+                ("logparser_fallback_total", labels,
+                 getattr(engine, "fallback_count", 0)),
+                ("logparser_host_routed_total", labels,
+                 getattr(engine, "host_routed_count", 0)),
+                ("logparser_reload_epoch", labels,
+                 getattr(engine, "reload_epoch", 0)),
+            ]
+            watchdog = getattr(engine, "watchdog", None)
+            if watchdog is not None:
+                out.append((
+                    "logparser_device_circuit_open", labels,
+                    1.0 if watchdog.circuit_open else 0.0,
+                ))
+            kernel = getattr(engine, "kernel_stats", None)
+            if kernel is not None:
+                ks = kernel.stats()
+                out.extend([
+                    ("logparser_kernel_batches_total",
+                     {**labels, "tier": "kernel"}, ks.get("kernelBatches", 0)),
+                    ("logparser_kernel_batches_total",
+                     {**labels, "tier": "xla"}, ks.get("xlaBatches", 0)),
+                    ("logparser_kernel_rows_total", labels,
+                     ks.get("kernelRows", 0)),
+                ])
+            quarantine = getattr(engine, "quarantine", None)
+            if quarantine is not None:
+                out.extend(samples_from_stats(
+                    quarantine.stats(), _QUARANTINE_SAMPLES, labels
+                ))
+            shadow = getattr(engine, "shadow", None)
+            if shadow is not None:
+                out.extend(samples_from_stats(
+                    shadow.stats(), _SHADOW_SAMPLES, labels
+                ))
+            miner = getattr(engine, "miner", None)
+            if miner is not None:
+                out.extend(samples_from_stats(
+                    miner.stats(), _MINER_SAMPLES, labels
+                ))
+            cache = getattr(engine, "line_cache", None)
+            if cache is not None:
+                from log_parser_tpu.runtime import linecache as lc
+
+                out.extend(samples_from_stats(
+                    cache.stats(), lc.CACHE_METRIC_SAMPLES, labels
+                ))
+            interner = getattr(engine, "key_interner", None)
+            if interner is not None:
+                from log_parser_tpu.runtime import linecache as lc
+
+                out.extend(samples_from_stats(
+                    interner.stats(), lc.INTERNER_METRIC_SAMPLES, labels
+                ))
+            batcher = getattr(engine, "batcher", None)
+            if batcher is not None:
+                from log_parser_tpu.runtime import batcher as bt
+
+                out.extend(samples_from_stats(
+                    batcher.stats(), bt.METRIC_SAMPLES, labels
+                ))
+            return out
+
+        self.registry.register_collector(f"engine-{id(engine)}", collect)
+
+    def remove_engine_collector(self, engine) -> None:
+        self.registry.unregister_collector(f"engine-{id(engine)}")
+
+    def add_stats_collector(self, key: str, stats_fn, spec,
+                            labels: dict | None = None) -> None:
+        """Generic scrape-time bridge: ``stats_fn()`` dict through a
+        ``(stats_key, metric, extra_labels)`` spec (admission gate,
+        stream manager, tenant registry)."""
+
+        def collect():
+            return samples_from_stats(stats_fn(), spec, labels)
+
+        self.registry.register_collector(key, collect)

@@ -1,0 +1,95 @@
+"""On-demand ``jax.profiler`` capture: ``POST /debug/profile`` arms a
+single background capture thread that traces the live process for N
+seconds into a state-dir subdirectory — the capture path for the owed
+live-TPU re-baseline sessions (ROADMAP item 1) without restarting the
+server. Single-flight: a second request while one is armed gets 409."""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("log_parser_tpu.obs")
+
+MAX_CAPTURE_S = 120.0
+
+
+class ProfilerUnavailable(RuntimeError):
+    """No capture directory configured (server started without
+    ``--state-dir``)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight."""
+
+
+class DeviceProfiler:
+    def __init__(self, base_dir: str | None = None, on_complete=None):
+        self.base_dir = base_dir
+        self.on_complete = on_complete
+        self._lock = threading.Lock()
+        self._active: str | None = None
+        self.captures = 0
+        self.last_dir: str | None = None
+        self.last_error: str | None = None
+
+    def configure(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+
+    def start(self, seconds: float) -> str:
+        """Arm one capture; returns the capture directory immediately
+        while the trace runs on a daemon thread."""
+        seconds = float(seconds)
+        if not (0 < seconds <= MAX_CAPTURE_S):
+            raise ValueError(
+                f"seconds must be in (0, {MAX_CAPTURE_S:g}], got {seconds!r}"
+            )
+        if not self.base_dir:
+            raise ProfilerUnavailable(
+                "profiling requires --state-dir (no capture directory)"
+            )
+        with self._lock:
+            if self._active is not None:
+                raise ProfilerBusy(f"capture already running: {self._active}")
+            capture_dir = os.path.join(
+                self.base_dir, time.strftime("%Y%m%dT%H%M%S")
+            )
+            os.makedirs(capture_dir, exist_ok=True)
+            self._active = capture_dir
+        threading.Thread(
+            target=self._capture, args=(capture_dir, seconds),
+            name="obs-profiler", daemon=True,
+        ).start()
+        return capture_dir
+
+    def _capture(self, capture_dir: str, seconds: float) -> None:
+        try:
+            from log_parser_tpu.utils.trace import profiler_trace
+
+            with profiler_trace(capture_dir):
+                time.sleep(seconds)
+            with self._lock:
+                self.captures += 1
+                self.last_dir = capture_dir
+                self.last_error = None
+            if self.on_complete is not None:
+                self.on_complete()
+        except Exception as exc:  # profiler availability is best-effort
+            log.exception("profile capture failed: %s", capture_dir)
+            with self._lock:
+                self.last_error = f"{type(exc).__name__}: {exc}"
+        finally:
+            with self._lock:
+                self._active = None
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "configured": bool(self.base_dir),
+                "active": self._active,
+                "captures": self.captures,
+                "lastDir": self.last_dir,
+                "lastError": self.last_error,
+            }

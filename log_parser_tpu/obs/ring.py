@@ -1,0 +1,65 @@
+"""Bounded request-trace ring: the rolling memory `/trace/last` never
+had. Every request — served, fallback, host-routed or shed — lands here
+as one small dict keyed by its propagated request id, and requests whose
+wall time crosses ``--trace-slow-ms`` are additionally retained in a
+separate slow ring so a latency incident survives the next thousand
+fast requests. ``GET /trace/recent?n=`` reads both, newest first."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+DEFAULT_CAPACITY = 256
+DEFAULT_SLOW_MS = 500.0
+SLOW_CAPACITY = 64
+
+
+class TraceRing:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 slow_ms: float = DEFAULT_SLOW_MS,
+                 slow_capacity: int = SLOW_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self.slow_ms = float(slow_ms)
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._slow: deque[dict] = deque(maxlen=max(1, int(slow_capacity)))
+        self._seq = 0
+        self.slow_captured = 0
+
+    def record(self, entry: dict) -> bool:
+        """Append one request entry; returns True when it was also
+        captured as slow. The caller supplies ``totalMs``."""
+        slow = float(entry.get("totalMs") or 0.0) >= self.slow_ms
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+            if slow:
+                entry["slow"] = True
+                self._slow.append(entry)
+                self.slow_captured += 1
+        return slow
+
+    def recent(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            items = list(self._ring)
+        items.reverse()
+        return items if n is None else items[: max(0, int(n))]
+
+    def slow_recent(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            items = list(self._slow)
+        items.reverse()
+        return items if n is None else items[: max(0, int(n))]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "slowMs": self.slow_ms,
+                "retained": len(self._ring),
+                "slowRetained": len(self._slow),
+                "recorded": self._seq,
+                "slowCaptured": self.slow_captured,
+            }

@@ -1,0 +1,133 @@
+"""SLO burn accounting: rolling multi-window availability and latency
+objectives in the style of the SRE multi-window multi-burn-rate alert.
+
+The tracker buckets outcomes into one-second cells and answers, per
+window, "what fraction of the error budget is this window burning?".
+``burn == 1.0`` means the budget is being spent exactly as fast as the
+objective allows; a sustained burn above the threshold on EVERY
+configured window (short window for recency, long for significance)
+flips the ``slo`` condition on ``/q/health`` to DEGRADED. Objectives
+are off until ``--slo-p99-ms`` / ``--slo-availability`` set them."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+DEFAULT_WINDOWS_S = (60.0, 300.0)
+DEFAULT_BURN_THRESHOLD = 2.0
+# latency objective is a p99: 1% of requests may run over the target
+LATENCY_QUANTILE_BUDGET = 0.01
+
+
+class SloTracker:
+    def __init__(self, p99_ms: float = 0.0, availability: float = 0.0,
+                 windows_s=DEFAULT_WINDOWS_S,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+                 clock=time.monotonic):
+        self.p99_ms = float(p99_ms)
+        self.availability = float(availability)
+        self.windows_s = tuple(
+            sorted(float(w) for w in windows_s if float(w) > 0)
+        ) or DEFAULT_WINDOWS_S
+        self.burn_threshold = float(burn_threshold)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # second -> [total, errors, slow]; bounded by the longest window
+        self._cells: dict[int, list[int]] = {}
+        self._horizon = int(max(self.windows_s)) + 2
+
+    @property
+    def enabled(self) -> bool:
+        return self.p99_ms > 0 or self.availability > 0
+
+    def note(self, ok: bool, duration_ms: float) -> None:
+        if not self.enabled:
+            return
+        now = int(self.clock())
+        with self._lock:
+            cell = self._cells.get(now)
+            if cell is None:
+                cell = self._cells[now] = [0, 0, 0]
+                if len(self._cells) > self._horizon:
+                    floor = now - self._horizon
+                    for sec in [s for s in self._cells if s < floor]:
+                        del self._cells[sec]
+            cell[0] += 1
+            if not ok:
+                cell[1] += 1
+            if self.p99_ms > 0 and duration_ms > self.p99_ms:
+                cell[2] += 1
+
+    def _window_counts(self, window_s: float) -> tuple[int, int, int]:
+        now = self.clock()
+        floor = now - window_s
+        total = errors = slow = 0
+        with self._lock:
+            for sec, (t, e, s) in self._cells.items():
+                if floor <= sec <= now:
+                    total += t
+                    errors += e
+                    slow += s
+        return total, errors, slow
+
+    def burn_rates(self) -> dict[str, dict[str, float]]:
+        """{objective: {window-label: burn}} for configured objectives."""
+        out: dict[str, dict[str, float]] = {}
+        for window in self.windows_s:
+            total, errors, slow = self._window_counts(window)
+            label = f"{int(window)}s"
+            if self.availability > 0:
+                budget = max(1e-9, 1.0 - self.availability)
+                frac = errors / total if total else 0.0
+                out.setdefault("availability", {})[label] = frac / budget
+            if self.p99_ms > 0:
+                frac = slow / total if total else 0.0
+                out.setdefault("latency", {})[label] = (
+                    frac / LATENCY_QUANTILE_BUDGET
+                )
+        return out
+
+    def degraded_objectives(self) -> list[str]:
+        """Objectives burning above threshold on EVERY window."""
+        return [
+            objective
+            for objective, rates in self.burn_rates().items()
+            if rates and all(
+                burn >= self.burn_threshold for burn in rates.values()
+            )
+        ]
+
+    def health(self) -> dict | None:
+        """The ``/q/health`` check row, or None while no objective is
+        configured."""
+        if not self.enabled:
+            return None
+        burning = self.degraded_objectives()
+        rates = {
+            objective: {w: round(b, 3) for w, b in rates.items()}
+            for objective, rates in self.burn_rates().items()
+        }
+        return {
+            "name": "slo",
+            "status": "DEGRADED" if burning else "UP",
+            "burning": burning,
+            "burnRates": rates,
+            "objectives": {
+                **({"p99Ms": self.p99_ms} if self.p99_ms > 0 else {}),
+                **(
+                    {"availability": self.availability}
+                    if self.availability > 0 else {}
+                ),
+            },
+        }
+
+    def samples(self):
+        """Registry collector feed: one gauge per objective × window."""
+        for objective, rates in self.burn_rates().items():
+            for window, burn in rates.items():
+                yield (
+                    "logparser_slo_burn_rate",
+                    {"objective": objective, "window": window},
+                    burn,
+                )

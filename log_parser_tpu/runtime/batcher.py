@@ -168,11 +168,18 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout_s)
 
-    def submit(self, data: "PodFailureData", deadline_ms: float | None = None):
+    def submit(
+        self,
+        data: "PodFailureData",
+        deadline_ms: float | None = None,
+        request_id: str | None = None,
+    ):
         """Blocking analyze-through-the-batcher: prepare on THIS thread,
         coalesce on the scheduler, return this request's result (or raise
         its per-request error). Semantics match ``analyze_pipelined``
-        request-for-request.
+        request-for-request. ``request_id`` rides the request's
+        PhaseTrace through the flush so the obs ring can attribute the
+        shared device step back to the inbound X-Request-Id.
 
         The whole call sits inside the engine's request scope: a pattern
         reload that arrives after this request enqueued waits for its
@@ -183,11 +190,18 @@ class MicroBatcher:
             # flush their batchmates share — straight to the host path
             fp = self.engine._quarantine_check(data)
             if fp is not None:
+                start = time.monotonic()
                 with self.engine.state_lock:
-                    return self.engine._serve_quarantined(data, fp)
-            pending = self._enqueue(data, deadline_ms)
+                    result = self.engine._serve_quarantined(data, fp)
+                self.engine._note_golden(
+                    start, "batched", request_id, "quarantined"
+                )
+                return result
+            pending = self._enqueue(data, deadline_ms, request_id)
             if pending is None:  # closed: serve unbatched, same contract
-                return self.engine.analyze_pipelined(data)
+                return self.engine.analyze_pipelined(
+                    data, request_id=request_id
+                )
             pending.done.wait()
             if pending.error is not None:
                 raise pending.error
@@ -195,13 +209,15 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- enqueue
 
-    def _enqueue(self, data, deadline_ms) -> _Pending | None:
+    def _enqueue(self, data, deadline_ms, request_id=None) -> _Pending | None:
         """Prepare (ingest + overrides) on the caller thread and queue the
         request into its shape bucket. Returns None when closed. A prepare
         failure takes the engine's normal fallback/propagate path — under
         ``state_lock``, exactly like ``_analyze``'s prepare except-arm."""
         start = time.monotonic()
         trace = PhaseTrace()
+        trace.route = "batched"
+        trace.request_id = request_id
         try:
             with trace.phase("ingest"):
                 faults.fire("ingest")
@@ -213,7 +229,10 @@ class MicroBatcher:
                 overrides = self.engine._overrides(corpus)
         except Exception as exc:
             with self.engine.state_lock:
-                result = self.engine._serve_fallback(data, exc)
+                result = self.engine._serve_fallback(
+                    data, exc,
+                    request_id=request_id, start=start, route="batched",
+                )
             done = _Pending(data, start, trace, None, None, None, None, -1)
             done.result = result
             done.done.set()
@@ -343,7 +362,11 @@ class MicroBatcher:
                 # bug propagates to this caller alone
                 try:
                     with engine.state_lock:
-                        item.result = engine._serve_fallback(item.data, recs)
+                        item.result = engine._serve_fallback(
+                            item.data, recs,
+                            request_id=item.trace.request_id,
+                            start=item.start, route="batched",
+                        )
                 except BaseException as per_req:  # noqa: BLE001
                     item.error = per_req
                 finally:
@@ -366,7 +389,11 @@ class MicroBatcher:
                         item.result = engine._finish(prepared)
                     except Exception as exc:
                         engine.frequency._load_state(saved_freq)
-                        item.result = engine._serve_fallback(item.data, exc)
+                        item.result = engine._serve_fallback(
+                            item.data, exc,
+                            request_id=item.trace.request_id,
+                            start=item.start, route="batched",
+                        )
                 finally:
                     engine.state_lock.release()
             except BaseException as exc:  # noqa: BLE001 - delivered to caller
@@ -611,6 +638,8 @@ class MicroBatcher:
         with self._cv:
             return {
                 "waitMs": self.wait_s * 1e3,
+                # sampled by the obs engine collector through
+                # METRIC_SAMPLES below — keep key renames in sync
                 "batchMax": self.batch_max,
                 "queueDepth": sum(len(q) for q in self._queues.values()),
                 "buckets": sorted(
@@ -628,3 +657,12 @@ class MicroBatcher:
                 "bisectAborts": self.bisect_aborts,
                 "bisectIsolated": self.bisect_isolated,
             }
+
+
+# /metrics view over MicroBatcher.stats() — read by the obs engine
+# collector at scrape time (log_parser_tpu/obs), never a second tally
+METRIC_SAMPLES = (
+    ("queueDepth", "logparser_batch_queue_depth", {}),
+    ("requestsBatched", "logparser_requests_batched_total", {}),
+    ("batchesFlushed", "logparser_batches_flushed_total", {}),
+)

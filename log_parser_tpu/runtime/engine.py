@@ -49,6 +49,7 @@ from log_parser_tpu.models.analysis import AnalysisResult, MatchedEvent
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.native.ingest import Corpus
+from log_parser_tpu.obs import Obs
 from log_parser_tpu.ops.encode import _pad_rows
 from log_parser_tpu.ops.fused import FusedMatchScore, FusedStaticTables
 from log_parser_tpu.runtime import faults
@@ -480,6 +481,14 @@ class AnalysisEngine:
         self.last_trace: PhaseTrace | None = None
         self.trace_history: deque[PhaseTrace] = deque(maxlen=512)
         self.last_finalized: FinalizedBatch | None = None
+        # observability plane (log_parser_tpu/obs): metrics registry +
+        # request-trace ring + SLO tracker + profiler, rooted here so
+        # every transport reaches one bundle through the engine it
+        # already holds. Tenant engines REPLACE this with the primary's
+        # bundle (runtime/tenancy.py) under their own tenant label.
+        self.obs = Obs()
+        self.obs_tenant = "default"
+        self.obs.add_engine_collector(self)
         # how many requests this engine served from the golden host path
         # because the device layer failed (surfaced via GET /trace/last)
         self.fallback_count = 0
@@ -1094,20 +1103,26 @@ class AnalysisEngine:
 
     # --------------------------------------------------------------- analyze
 
-    def analyze(self, data: PodFailureData) -> AnalysisResult:
+    def analyze(
+        self, data: PodFailureData, request_id: str | None = None
+    ) -> AnalysisResult:
         """Sequential analyze — the single-caller entry point (tests,
         benches, the golden-parity harness). Transport front-ends that
-        serve concurrent requests use :meth:`analyze_pipelined`."""
-        return self._analyze(data, _NULL_LOCK)
+        serve concurrent requests use :meth:`analyze_pipelined`.
+        ``request_id``: the propagated trace id (X-Request-Id) this
+        request carries through the obs trace ring."""
+        return self._analyze(data, _NULL_LOCK, request_id)
 
-    def analyze_pipelined(self, data: PodFailureData) -> AnalysisResult:
+    def analyze_pipelined(
+        self, data: PodFailureData, request_id: str | None = None
+    ) -> AnalysisResult:
         """Thread-safe analyze: ingest + device execution (the prepare
         phase, which touches no shared mutable state) runs OUTSIDE
         ``state_lock``, so request N+1's ingest/device work overlaps
         request N's host finalize — the frequency read-before-record
         boundary is the only true serialization point (SURVEY.md §5.2;
         the reference serializes nothing and data-races instead)."""
-        return self._analyze(data, self.state_lock)
+        return self._analyze(data, self.state_lock, request_id)
 
     def enable_batching(self, wait_ms: float = 2.0, batch_max: int = 8):
         """Attach and start the cross-request micro-batching scheduler
@@ -1203,7 +1218,10 @@ class AnalysisEngine:
         return self.miner
 
     def analyze_batched(
-        self, data: PodFailureData, deadline_ms: float | None = None
+        self,
+        data: PodFailureData,
+        deadline_ms: float | None = None,
+        request_id: str | None = None,
     ) -> AnalysisResult:
         """Thread-safe analyze through the micro-batcher: this request may
         share its device step with concurrent callers, with per-request
@@ -1213,33 +1231,62 @@ class AnalysisEngine:
         this request's batch flush earlier."""
         batcher = self.batcher
         if batcher is None:
-            return self.analyze_pipelined(data)
-        return batcher.submit(data, deadline_ms)
+            return self.analyze_pipelined(data, request_id=request_id)
+        return batcher.submit(data, deadline_ms, request_id=request_id)
 
-    def analyze_host_routed(self, data: PodFailureData) -> AnalysisResult:
+    def analyze_host_routed(
+        self, data: PodFailureData, request_id: str | None = None
+    ) -> AnalysisResult:
         """Serve one request from the golden host path because the
         admission gate routed it there under pressure (ladder rung 2,
         serve/admission.py) — NOT because anything failed. Same frequency
         state, same rollback-on-failure invariant as the error fallback,
         separate counter."""
+        start = time.monotonic()
         with self._request_scope(), self.state_lock:
             self.host_routed_count += 1
-            return self._golden_serve(data)
+            result = self._golden_serve(data)
+        self._note_golden(start, "host", request_id, "ok")
+        return result
 
-    def _analyze(self, data: PodFailureData, lock) -> AnalysisResult:
+    def _note_golden(
+        self, start: float, route: str, request_id: str | None,
+        outcome: str, error: str | None = None,
+    ) -> None:
+        """Ring entry for a golden-host-served request (host-routed,
+        quarantined, fallback) — no device phases to report, but the
+        request id and wall time still belong in the obs ring."""
+        trace = PhaseTrace()
+        trace.route = route
+        trace.request_id = request_id
+        self.obs.note_served(
+            trace, start, self.obs_tenant, outcome=outcome, error=error
+        )
+
+    def _analyze(
+        self, data: PodFailureData, lock, request_id: str | None = None
+    ) -> AnalysisResult:
         with self._request_scope():
-            return self._analyze_in_scope(data, lock)
+            return self._analyze_in_scope(data, lock, request_id)
 
-    def _analyze_in_scope(self, data: PodFailureData, lock) -> AnalysisResult:
+    def _analyze_in_scope(
+        self, data: PodFailureData, lock, request_id: str | None = None
+    ) -> AnalysisResult:
+        start = time.monotonic()
         fp = self._quarantine_check(data)
         if fp is not None:
             with lock:
-                return self._serve_quarantined(data, fp)
+                result = self._serve_quarantined(data, fp)
+            self._note_golden(start, "device", request_id, "quarantined")
+            return result
         try:
             prepared = self._prepare(data)
         except Exception as exc:
             with lock:
-                return self._serve_fallback(data, exc)
+                return self._serve_fallback(
+                    data, exc, request_id=request_id, start=start
+                )
+        prepared.trace.request_id = request_id
         # lock WAIT is a traced phase: under concurrency the finish
         # phases serialize here, and a latency decomposition that omits
         # the wait would misattribute it to HTTP/tunnel transport.
@@ -1257,7 +1304,11 @@ class AnalysisEngine:
                 return self._finish(prepared)
             except Exception as exc:
                 self.frequency._load_state(saved_freq)
-                return self._serve_fallback(data, exc)
+                return self._serve_fallback(
+                    data, exc,
+                    request_id=request_id, start=prepared.start,
+                    route=prepared.trace.route,
+                )
         finally:
             lock.__exit__(None, None, None)
 
@@ -1308,7 +1359,14 @@ class AnalysisEngine:
             return False
         return True
 
-    def _serve_fallback(self, data: PodFailureData, exc: Exception) -> AnalysisResult:
+    def _serve_fallback(
+        self,
+        data: PodFailureData,
+        exc: Exception,
+        request_id: str | None = None,
+        start: float | None = None,
+        route: str = "device",
+    ) -> AnalysisResult:
         """Serve ``data`` from the golden host path if ``exc`` is a device
         failure and the fallback is enabled; re-raise otherwise. Caller
         holds the lock (frequency state is read and mutated here)."""
@@ -1338,7 +1396,12 @@ class AnalysisEngine:
         # device-side observability does not describe this request
         self.last_trace = None
         self.last_finalized = None
-        return self._golden_serve(data)
+        result = self._golden_serve(data)
+        self._note_golden(
+            start if start is not None else time.monotonic(),
+            route, request_id, "fallback", error=type(exc).__name__,
+        )
+        return result
 
     def _prepare(self, data: PodFailureData) -> "_Prepared":
         """Ingest + overrides + the device batch: everything before the
@@ -1581,6 +1644,12 @@ class AnalysisEngine:
         # appends are thread-safe under concurrent _finish callers
         self.trace_history.append(trace)
         self.last_finalized = fin
+        # per-phase histograms + the trace-ring entry for this request —
+        # fed from the SAME PhaseTrace /trace/last exposes, so the two
+        # surfaces can never disagree
+        self.obs.note_served(
+            trace, start, self.obs_tenant, n_lines=corpus.n_lines
+        )
         if shadow_state is not None:
             shadow.submit(prepared.data, shadow_state, result)
         return result

@@ -558,6 +558,21 @@ class LineCache:
             }
 
 
+# /metrics views over LineCache.stats() / KeyInterner.stats() — read by
+# the obs engine collector at scrape time (log_parser_tpu/obs), so the
+# exposition and /trace/last can never disagree on these counters
+CACHE_METRIC_SAMPLES = (
+    ("hits", "logparser_line_cache_hits_total", {}),
+    ("misses", "logparser_line_cache_misses_total", {}),
+    ("evictions", "logparser_line_cache_evictions_total", {}),
+    ("residentBytes", "logparser_line_cache_resident_bytes", {}),
+)
+INTERNER_METRIC_SAMPLES = (
+    ("probeHits", "logparser_interner_probe_hits_total", {}),
+    ("inserts", "logparser_interner_inserts_total", {}),
+)
+
+
 # ------------------------------------------------------------ miss-stream tap
 
 DEFAULT_TAP_CAPACITY = 4096

@@ -847,6 +847,14 @@ class StreamManager:
 
 _shared_lock = threading.Lock()
 
+# /metrics view over StreamManager.stats() — registered against the
+# engine's obs bundle in shared_manager() (log_parser_tpu/obs)
+METRIC_SAMPLES = (
+    ("openSessions", "logparser_stream_sessions", {}),
+    ("chunksIngested", "logparser_stream_chunks_total", {}),
+    ("framesEmitted", "logparser_stream_frames_total", {}),
+)
+
 
 def shared_manager(engine) -> StreamManager:
     """ONE manager per engine, shared across transports — the streaming
@@ -875,4 +883,10 @@ def shared_manager(engine) -> StreamManager:
                 ),
             )
             engine.stream_manager = mgr
+            obs = getattr(engine, "obs", None)
+            if obs is not None:
+                obs.add_stats_collector(
+                    f"stream-{id(mgr)}", mgr.stats, METRIC_SAMPLES,
+                    labels={"tenant": getattr(engine, "obs_tenant", "default")},
+                )
         return mgr

@@ -284,6 +284,12 @@ class TenantContext:
         if journal is not None:
             journal.snapshot_now()
             journal.close()
+        obs = getattr(eng, "obs", None)
+        if obs is not None:
+            # an evicted engine must stop feeding the shared registry —
+            # a stale collector would pin the engine in memory and keep
+            # emitting dead samples
+            obs.remove_engine_collector(eng)
 
     def stats(self) -> dict:
         return {
@@ -292,6 +298,15 @@ class TenantContext:
             "reloadEpoch": int(getattr(self.engine, "reload_epoch", 0)),
             "quota": self.quota.stats(),
         }
+
+
+# /metrics view over TenantRegistry.stats() — registered against the
+# default engine's obs bundle at construction (log_parser_tpu/obs)
+METRIC_SAMPLES = (
+    ("residentTenants", "logparser_tenants_resident", {}),
+    ("created", "logparser_tenant_builds_total", {}),
+    ("evicted", "logparser_tenant_evictions_total", {}),
+)
 
 
 class TenantRegistry:
@@ -356,6 +371,9 @@ class TenantRegistry:
         self.rebuilds = 0
         self.unknown = 0
         self.invalid = 0
+        obs = getattr(default_engine, "obs", None)
+        if obs is not None:
+            obs.add_stats_collector("tenants", self.stats, METRIC_SAMPLES)
 
     # ------------------------------------------------------------ resolve
 
@@ -447,6 +465,15 @@ class TenantRegistry:
             # shared process-wide gate: shared_gate(tenant_engine) in any
             # transport now returns this controller, not a fresh one
             eng.admission_gate = self.gate
+        # one observability plane per fleet: the tenant engine swaps its
+        # private bundle for the primary's, labeled by tenant, so one
+        # /metrics scrape covers every resident engine
+        primary_obs = getattr(self.default_engine, "obs", None)
+        if primary_obs is not None:
+            eng.obs.remove_engine_collector(eng)
+            eng.obs = primary_obs
+            eng.obs_tenant = tenant_id
+            primary_obs.add_engine_collector(eng)
         if self.engine_setup is not None:
             self.engine_setup(eng, tenant_id)
         ctx = TenantContext(

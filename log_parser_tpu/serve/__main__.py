@@ -183,6 +183,30 @@ def main(argv: list[str] | None = None) -> int:
         "off the hot path and compared at 1e-9; divergence trips a "
         "per-pattern breaker (0 disables; LOG_PARSER_TPU_SHADOW_RATE)",
     )
+    # observability plane (docs/OPS.md "Observability")
+    parser.add_argument(
+        "--trace-ring", type=int, default=None, metavar="N",
+        help="capacity of the bounded request-trace ring behind "
+        "GET /trace/recent (default 256; LOG_PARSER_TPU_TRACE_RING)",
+    )
+    parser.add_argument(
+        "--trace-slow-ms", type=float, default=None, metavar="MS",
+        help="requests at or above this total latency are also captured "
+        "in the slow-request ring (default 500; "
+        "LOG_PARSER_TPU_TRACE_SLOW_MS)",
+    )
+    parser.add_argument(
+        "--slo-p99-ms", type=float, default=None, metavar="MS",
+        help="latency objective: p99 of served requests should stay "
+        "under this; burn-rate over the multi-window accounting flips "
+        "/q/health DEGRADED (0 disables; LOG_PARSER_TPU_SLO_P99_MS)",
+    )
+    parser.add_argument(
+        "--slo-availability", type=float, default=None, metavar="FRACTION",
+        help="availability objective, e.g. 0.999: non-5xx fraction of "
+        "requests; burn-rate over budget flips /q/health DEGRADED "
+        "(0 disables; LOG_PARSER_TPU_SLO_AVAILABILITY)",
+    )
     parser.add_argument(
         "--faults", default=None, metavar="SPEC",
         help="fault-injection DSL, e.g. 'device_hang:2@after=3' "
@@ -294,6 +318,10 @@ def main(argv: list[str] | None = None) -> int:
         (args.quarantine_strikes, "LOG_PARSER_TPU_QUARANTINE_STRIKES"),
         (args.quarantine_ttl_s, "LOG_PARSER_TPU_QUARANTINE_TTL_S"),
         (args.shadow_rate, "LOG_PARSER_TPU_SHADOW_RATE"),
+        (args.trace_ring, "LOG_PARSER_TPU_TRACE_RING"),
+        (args.trace_slow_ms, "LOG_PARSER_TPU_TRACE_SLOW_MS"),
+        (args.slo_p99_ms, "LOG_PARSER_TPU_SLO_P99_MS"),
+        (args.slo_availability, "LOG_PARSER_TPU_SLO_AVAILABILITY"),
         (args.faults, "LOG_PARSER_TPU_FAULTS"),
         (args.fault_seed, "LOG_PARSER_TPU_FAULT_SEED"),
         (args.broadcast_timeout, "LOG_PARSER_TPU_BROADCAST_TIMEOUT_S"),
@@ -460,6 +488,9 @@ def main(argv: list[str] | None = None) -> int:
             journal.replayed,
             ", torn tail quarantined" if journal.torn_tails else "",
         )
+        # on-demand device profiling (POST /debug/profile) captures into a
+        # state-dir subdirectory; without --state-dir the route answers 503
+        engine.obs.profiler.configure(os.path.join(state_dir, "profiles"))
 
     # template miner: background consumer of the line-cache miss stream
     # (log_parser_tpu/mining/); per-tenant miners are wired below in

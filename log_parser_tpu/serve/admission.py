@@ -324,6 +324,24 @@ class AdmissionController:
 
 _ATTACH_LOCK = threading.Lock()
 
+# /metrics view over AdmissionController.stats() — registered against
+# the engine's obs bundle in shared_gate() and read at scrape time, so
+# the ladder counters have exactly one home (log_parser_tpu/obs)
+METRIC_SAMPLES = (
+    ("admittedDevice", "logparser_admission_total", {"outcome": "device"}),
+    ("admittedHost", "logparser_admission_total", {"outcome": "host"}),
+    ("admittedBatched", "logparser_admission_total", {"outcome": "batched"}),
+    ("shedQueueFull", "logparser_admission_total",
+     {"outcome": "shed_queue_full"}),
+    ("shedDeadline", "logparser_admission_total",
+     {"outcome": "shed_deadline"}),
+    ("shedDraining", "logparser_admission_total",
+     {"outcome": "shed_draining"}),
+    ("shedTenant", "logparser_admission_total", {"outcome": "shed_tenant"}),
+    ("inflight", "logparser_inflight", {}),
+    ("queued", "logparser_admission_queued", {}),
+)
+
 
 def shared_gate(engine) -> AdmissionController:
     """The engine-wide admission gate, created on first use (env-config)
@@ -334,6 +352,9 @@ def shared_gate(engine) -> AdmissionController:
         if gate is None:
             gate = AdmissionController.from_env()
             engine.admission_gate = gate
+            obs = getattr(engine, "obs", None)
+            if obs is not None:
+                obs.add_stats_collector("admission", gate.stats, METRIC_SAMPLES)
         return gate
 
 

@@ -39,10 +39,12 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu import native
 from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.obs.profiler import ProfilerBusy, ProfilerUnavailable
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.utils import xlacache
 from log_parser_tpu.runtime.engine import AnalysisEngine
@@ -89,10 +91,9 @@ class ParseServer(ThreadingHTTPServer):
             if tenants is not None
             else TenantRegistry(engine, gate=self.admission)
         )
-        # responses we failed to write because the client had already gone
-        # away (GET /trace/last "droppedResponses")
-        self.dropped_responses = 0
-        self._drop_lock = threading.Lock()
+        # observability plane (log_parser_tpu/obs): one bundle, rooted at
+        # the engine, shared by every transport and tenant engine
+        self.obs = engine.obs
         # hot pattern reload (runtime/reload.py): set by serve/__main__.py
         # (or lazily on the first POST /patterns/reload); the watcher is
         # the optional --watch-patterns poller, stopped with the server
@@ -106,6 +107,13 @@ class ParseServer(ThreadingHTTPServer):
         self.stream_manager = None
         self.stream_enabled = True
         self._stream_lock = threading.Lock()
+
+    @property
+    def dropped_responses(self) -> int:
+        """Responses we failed to write because the client had already
+        gone away (GET /trace/last "droppedResponses") — a view over the
+        registry's cross-transport drop counter, not a second tally."""
+        return self.obs.dropped_responses
 
     def get_reloader(self):
         from log_parser_tpu.runtime.reload import PatternReloader
@@ -143,9 +151,18 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(
         self, status: int, payload: bytes, headers: dict[str, str] | None = None
     ) -> None:
+        self._send_body(status, payload, "application/json", headers)
+
+    def _send_body(
+        self,
+        status: int,
+        payload: bytes,
+        content_type: str,
+        headers: dict[str, str] | None = None,
+    ) -> None:
         try:
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(payload)))
             for key, value in (headers or {}).items():
                 self.send_header(key, value)
@@ -153,11 +170,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(payload)
         except (BrokenPipeError, ConnectionResetError) as exc:
             # the client hung up first (its own timeout, or a shed it did
-            # not wait for). Not a server fault: count it, keep the worker
-            # thread's stderr free of ThreadingHTTPServer's default
-            # traceback spew.
-            with self.server._drop_lock:
-                self.server.dropped_responses += 1
+            # not wait for). Not a server fault: count it in the shared
+            # cross-transport drop counter, keep the worker thread's
+            # stderr free of ThreadingHTTPServer's default traceback spew.
+            self.server.obs.note_dropped("http")
             log.debug(
                 "client %s disconnected before the response: %s",
                 self.address_string(),
@@ -220,6 +236,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._patterns_reload()
         if self.path == "/patterns/mined":
             return self._mined_post()
+        if self.path == "/debug/profile":
+            return self._debug_profile()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
@@ -412,6 +430,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # the divergent pattern(s) serve from the host regex until
                 # a clean half-open probe (docs/OPS.md "Shadow divergence")
                 checks.append({"name": "shadow", "status": "DEGRADED"})
+            slo = self.server.obs.slo.health()
+            if slo is not None and slo["status"] != "UP":
+                # SLO burn: an objective is spending its error budget
+                # faster than the threshold on every configured window
+                # (docs/OPS.md "Observability" — SLO runbook)
+                checks.append(slo)
             if checks:
                 return self._send_json(
                     200, json.dumps({"status": "UP", "checks": checks}).encode()
@@ -449,9 +473,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload["deviceCircuitOpen"] = (
                 self.server.engine.watchdog.circuit_open
             )
-            with self.server._drop_lock:
-                payload["droppedResponses"] = self.server.dropped_responses
+            # a view over the registry's cross-transport drop counter
+            payload["droppedResponses"] = self.server.dropped_responses
             payload["admission"] = self.server.admission.stats()
+            # trace-ring occupancy (GET /trace/recent reads the entries)
+            payload["traceRing"] = self.server.obs.ring.stats()
             batcher = getattr(self.server.engine, "batcher", None)
             if batcher is not None:
                 # queue depth, batch sizes, flush reasons (docs/OPS.md
@@ -523,6 +549,29 @@ class _Handler(BaseHTTPRequestHandler):
             if fault_stats is not None:
                 payload["faults"] = fault_stats
             return self._send_json(200, json.dumps(payload).encode())
+        if self.path == "/metrics":
+            # Prometheus text exposition: owned hot-path instruments plus
+            # scrape-time collectors over every subsystem's stats() — the
+            # same variables /trace/last reads (docs/OPS.md
+            # "Observability")
+            return self._send_body(
+                200,
+                self.server.obs.registry.render().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        if self.path.startswith("/trace/recent"):
+            query = urllib.parse.urlparse(self.path).query
+            params = urllib.parse.parse_qs(query)
+            try:
+                n = int(params.get("n", ["50"])[0])
+            except ValueError:
+                return self._send_json(400, b'{"error":"n must be an integer"}')
+            ring = self.server.obs.ring
+            return self._send_json(200, json.dumps({
+                "requests": ring.recent(n),
+                "slow": ring.slow_recent(n),
+                "ring": ring.stats(),
+            }).encode())
         if self.path == "/debug/factors":
             fin = self.server.engine.last_finalized
             rows = [] if fin is None else fin.factor_rows(self.server.engine.bank)
@@ -619,8 +668,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if not sess.closed:
                     _write(sess.close())
         except (BrokenPipeError, ConnectionResetError) as exc:
-            with self.server._drop_lock:
-                self.server.dropped_responses += 1
+            self.server.obs.note_dropped("http")
             log.debug(
                 "stream client %s disconnected: %s", self.address_string(), exc
             )
@@ -631,23 +679,88 @@ class _Handler(BaseHTTPRequestHandler):
                 sess.kill("transport")
             self.close_connection = True
 
+    def _debug_profile(self) -> None:
+        # on-demand jax.profiler capture: {"seconds": N} -> 202 with the
+        # capture directory; single-flight, so a concurrent start is a 409
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > _ADMIN_MAX_BODY:
+                return self._send_json(413, _TOO_LARGE)
+            payload = json.loads(self.rfile.read(length) if length else b"{}")
+            seconds = float(payload.get("seconds", 5)) if isinstance(
+                payload, dict
+            ) else None
+        except (ValueError, TypeError):
+            seconds = None
+        if seconds is None:
+            return self._send_json(
+                400, b'{"error":"expected {\\"seconds\\": N}"}'
+            )
+        try:
+            capture_dir = self.server.obs.profiler.start(seconds)
+        except ProfilerBusy as exc:
+            return self._send_json(
+                409, json.dumps({"error": str(exc)}).encode()
+            )
+        except ProfilerUnavailable as exc:
+            return self._send_json(
+                503, json.dumps({"error": str(exc)}).encode()
+            )
+        except ValueError as exc:
+            return self._send_json(
+                400, json.dumps({"error": str(exc)}).encode()
+            )
+        return self._send_json(
+            202,
+            json.dumps(
+                {"status": "capturing", "seconds": seconds, "dir": capture_dir}
+            ).encode(),
+        )
+
     def _parse(self) -> None:
+        obs = self.server.obs
+        # honor a caller-supplied correlation id, mint one otherwise; the
+        # same id is echoed back and threaded through admission -> batcher
+        # flush -> device dispatch so /trace/recent can stitch the hops
+        rid = obs.clean_request_id(self.headers.get("X-Request-Id"))
+        if rid is None:
+            rid = obs.new_request_id()
+        started = time.monotonic()
+        tenant = "default"
+        route = "device"
+
+        def reply(status, body, *, detail=None, headers=None):
+            hdrs = dict(headers) if headers else {}
+            hdrs["X-Request-Id"] = rid
+            obs.note_request(
+                "http",
+                route,
+                status,
+                tenant,
+                time.monotonic() - started,
+                request_id=rid,
+                detail=detail,
+            )
+            return self._send_json(status, body, headers=hdrs)
+
         try:
             faults.fire("http")
         except Exception:
             log.exception("injected HTTP-transport fault")
-            return self._send_json(500, b'{"error":"Internal analysis failure"}')
+            return reply(
+                500, b'{"error":"Internal analysis failure"}', detail="fault"
+            )
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b""
             payload = json.loads(body) if body else None
         except (ValueError, json.JSONDecodeError):
-            return self._send_json(400, _INVALID)
+            return reply(400, _INVALID, detail="invalid body")
 
         data = PodFailureData.from_dict(payload) if isinstance(payload, dict) else None
         # Parse.java:45-49 — null data or null pod is a 400
         if data is None or data.pod is None:
-            return self._send_json(400, _INVALID)
+            return reply(400, _INVALID, detail="invalid body")
 
         deadline_ms = None  # None -> the gate's configured default
         header = self.headers.get("X-Request-Deadline-Ms")
@@ -655,13 +768,16 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 deadline_ms = float(header)
             except ValueError:
-                return self._send_json(
-                    400, b'{"error":"invalid X-Request-Deadline-Ms"}'
+                return reply(
+                    400,
+                    b'{"error":"invalid X-Request-Deadline-Ms"}',
+                    detail="invalid deadline",
                 )
 
         ctx = self._tenant()
         if ctx is None:
             return
+        tenant = ctx.tenant_id
         engine = ctx.engine
         batcher = getattr(engine, "batcher", None)
         n_lines = (data.logs.count("\n") + 1) if data.logs else 0
@@ -678,9 +794,11 @@ class _Handler(BaseHTTPRequestHandler):
             # worth coming back. A futile shed (413 `tenant burst` — the
             # request exceeds the bucket's whole capacity) carries NO
             # Retry-After: the same request can never be admitted.
-            return self._send_json(
+            route = "admission"
+            return reply(
                 exc.status,
                 json.dumps({"error": "overloaded", "reason": exc.reason}).encode(),
+                detail=exc.reason,
                 headers=(
                     {"Retry-After": str(exc.retry_after_s)}
                     if exc.retry_after_s > 0
@@ -693,13 +811,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if route == "host":
                     # ladder rung 2: device slots saturated, this request
                     # queued — serve it from the cheaper golden host path
-                    result = engine.analyze_host_routed(data)
+                    result = engine.analyze_host_routed(data, request_id=rid)
                 elif batcher is not None:
                     # micro-batching on: this request ("device" or
                     # queued-then-"batched") coalesces with concurrent
                     # arrivals into one shared device batch. Pass the
                     # REMAINING deadline budget — time already burned
                     # waiting for admission must pull the flush earlier.
+                    route = "batched"  # the metrics label matches the ring
                     effective = (
                         deadline_ms
                         if deadline_ms is not None
@@ -708,18 +827,18 @@ class _Handler(BaseHTTPRequestHandler):
                     if effective is not None:
                         effective -= (time.monotonic() - arrival) * 1e3
                     result = engine.analyze_batched(
-                        data, effective
+                        data, effective, request_id=rid
                     )
                 else:
                     # pipelined: ingest + device work of this request
                     # overlaps the host finalize of in-flight ones; only
                     # the frequency-coupled finish phase serializes (on
                     # engine.state_lock)
-                    result = engine.analyze_pipelined(data)
+                    result = engine.analyze_pipelined(data, request_id=rid)
             except QuarantineRejected as exc:
                 # a quarantined fingerprint the golden host path could not
                 # serve either — structured 429, try again after the TTL
-                return self._send_json(
+                return reply(
                     exc.status,
                     json.dumps(
                         {
@@ -728,6 +847,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "fingerprint": exc.fingerprint,
                         }
                     ).encode(),
+                    detail="quarantined",
                     headers={"Retry-After": str(exc.retry_after_s)},
                 )
             except Exception:
@@ -735,8 +855,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # (runtime/engine.py is_device_error) — answer with a JSON
                 # 500 instead of dropping the connection mid-request
                 log.exception("Analysis failed for pod: %s", data.pod_name)
-                return self._send_json(
-                    500, b'{"error":"Internal analysis failure"}'
+                return reply(
+                    500, b'{"error":"Internal analysis failure"}', detail="error"
                 )
         finally:
             self.server.admission.release(tenant=ctx.quota)
@@ -745,7 +865,7 @@ class _Handler(BaseHTTPRequestHandler):
             data.pod_name,
             result.summary.significant_events if result.summary else 0,
         )
-        self._send_json(200, json.dumps(result.to_dict(drop_none=True)).encode())
+        reply(200, json.dumps(result.to_dict(drop_none=True)).encode())
 
 
 def make_server(

@@ -43,6 +43,16 @@ def _tenant_of(context) -> str | None:
     return None
 
 
+def _request_id_of(context) -> str | None:
+    """Correlation id from ``x-request-id`` invocation metadata — the gRPC
+    twin of the HTTP ``X-Request-Id`` header; the service mints one when
+    absent."""
+    for key, value in context.invocation_metadata() or ():
+        if key == "x-request-id":
+            return value or None
+    return None
+
+
 def _tenant_code(exc: TenantError):
     """Status for a refused tenant resolution: unknown tenant (404) is
     NOT_FOUND — a typo or a not-yet-provisioned tenant — while a
@@ -56,10 +66,29 @@ def _tenant_code(exc: TenantError):
 
 
 def _handlers(service: LogParserService):
-    def wrap(fn):
+    def wrap(fn, is_parse=False):
         def unary(request, context):
             try:
-                return fn(request, tenant_id=_tenant_of(context))
+                if is_parse:
+                    # Parse carries the correlation id + transport label so
+                    # the request lands in the shared trace ring and the
+                    # requests_total{transport="grpc"} series
+                    result = fn(
+                        request,
+                        tenant_id=_tenant_of(context),
+                        request_id=_request_id_of(context),
+                        transport="grpc",
+                    )
+                else:
+                    result = fn(request, tenant_id=_tenant_of(context))
+                if is_parse and not context.is_active():
+                    # the caller cancelled / vanished while we computed:
+                    # the response write is moot — same dropped-responses
+                    # signal the HTTP and framed transports count
+                    obs = getattr(service.engine, "obs", None)
+                    if obs is not None:
+                        obs.note_dropped("grpc")
+                return result
             except AdmissionRejected as exc:
                 # overload ladder: shed maps to RESOURCE_EXHAUSTED, a
                 # draining server to UNAVAILABLE — both carry the retry
@@ -92,7 +121,7 @@ def _handlers(service: LogParserService):
 
     return {
         name: grpc.unary_unary_rpc_method_handler(
-            wrap(getattr(service, attr)),
+            wrap(getattr(service, attr), is_parse=(attr == "parse")),
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,
         )

@@ -70,8 +70,20 @@ class _Handler(socketserver.BaseRequestHandler):
                 # ("Parse@acme") so the wire contract needs no new field;
                 # bare methods run as the default tenant
                 method, _, tenant = envelope.method.partition("@")
-                entry = self.server.dispatch.get(method)
-                if entry is None:
+                if method == "Metrics":
+                    # Prometheus text exposition over the framed transport:
+                    # the same registry render the HTTP /metrics serves, so
+                    # shim-only deployments scrape without a second port
+                    obs = getattr(self.server.engine, "obs", None)
+                    response = pb.Envelope(
+                        method=envelope.method,
+                        payload=(
+                            obs.registry.render().encode()
+                            if obs is not None
+                            else b""
+                        ),
+                    )
+                elif (entry := self.server.dispatch.get(method)) is None:
                     response = pb.Envelope(
                         method=envelope.method,
                         error=f"unknown method {method!r}",
@@ -103,7 +115,16 @@ class _Handler(socketserver.BaseRequestHandler):
             except Exception as exc:  # contained per request
                 log.exception("shim call failed")
                 response = pb.Envelope(method=envelope.method, error=str(exc))
-            write_frame(sock, response.SerializeToString())
+            try:
+                write_frame(sock, response.SerializeToString())
+            except OSError:
+                # client hung up before the answer went out — same signal
+                # the HTTP layer counts as a dropped response
+                obs = getattr(self.server.engine, "obs", None)
+                if obs is not None:
+                    obs.note_dropped("shim")
+                log.warning("shim client gone before response write")
+                return
 
 
 def make_shim_server(

@@ -12,11 +12,13 @@ on the same engine-wide lock, shared with the HTTP front-end.
 from __future__ import annotations
 
 import json
+import time
 
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.quarantine import QuarantineRejected
 from log_parser_tpu.runtime.tenancy import TenantError, TenantRegistry
-from log_parser_tpu.serve.admission import shared_gate
+from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 from log_parser_tpu.shim import logparser_pb2 as pb
 
 
@@ -74,16 +76,60 @@ class LogParserService:
     # ----------------------------------------------------------------- parse
 
     def parse(
-        self, req: pb.ParseRequest, tenant_id: str | None = None
+        self,
+        req: pb.ParseRequest,
+        tenant_id: str | None = None,
+        request_id: str | None = None,
+        transport: str = "shim",
     ) -> pb.ParseResponse:
-        faults.fire("shim")
-        tctx = self._ctx(tenant_id)
+        obs = getattr(self.engine, "obs", None)
+        if obs is not None:
+            request_id = obs.clean_request_id(request_id) or obs.new_request_id()
+        started = time.monotonic()
+        # holder lets _parse_leased report the admitted route back out so
+        # the finally arm labels the request correctly on every outcome
+        holder = {"route": "device"}
+        status = 200
+        detail = None
         try:
-            return self._parse_leased(req, tctx)
+            faults.fire("shim")
+            tctx = self._ctx(tenant_id)
+            try:
+                return self._parse_leased(req, tctx, request_id, holder)
+            finally:
+                tctx.unpin()
+        except AdmissionRejected as exc:
+            holder["route"] = "admission"
+            status, detail = exc.status, exc.reason
+            raise
+        except QuarantineRejected as exc:
+            status, detail = exc.status, "quarantined"
+            raise
+        except CLIENT_ERRORS as exc:
+            status, detail = 400, type(exc).__name__
+            raise
+        except Exception as exc:
+            status, detail = 500, type(exc).__name__
+            raise
         finally:
-            tctx.unpin()
+            if obs is not None:
+                obs.note_request(
+                    transport,
+                    holder["route"],
+                    status,
+                    tenant_id or "default",
+                    time.monotonic() - started,
+                    request_id=request_id,
+                    detail=detail,
+                )
 
-    def _parse_leased(self, req: pb.ParseRequest, tctx) -> pb.ParseResponse:
+    def _parse_leased(
+        self,
+        req: pb.ParseRequest,
+        tctx,
+        request_id: str | None = None,
+        holder: dict | None = None,
+    ) -> pb.ParseResponse:
         engine = tctx.engine
         pod = json.loads(req.pod_json) if req.pod_json else None
         if pod is None:
@@ -98,19 +144,27 @@ class LogParserService:
         route = self.admission.acquire(
             batchable=batcher is not None, tenant=tctx.quota, lines=n_lines
         )
+        if holder is not None:
+            holder["route"] = (
+                "host"
+                if route == "host"
+                else ("batched" if batcher is not None else "device")
+            )
         try:
             if route == "host":
-                result = engine.analyze_host_routed(data)
+                result = engine.analyze_host_routed(data, request_id=request_id)
             elif batcher is not None:
                 # micro-batching on (framed shim AND gRPC run through this
                 # body): coalesce with concurrent arrivals under the
                 # gate's default deadline budget
                 result = engine.analyze_batched(
-                    data, self.admission.default_deadline_ms or None
+                    data,
+                    self.admission.default_deadline_ms or None,
+                    request_id=request_id,
                 )
             else:
                 # pipelined: only the finish phase takes self.lock (inside)
-                result = engine.analyze_pipelined(data)
+                result = engine.analyze_pipelined(data, request_id=request_id)
         finally:
             self.admission.release(tenant=tctx.quota)
 

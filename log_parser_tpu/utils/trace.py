@@ -32,6 +32,12 @@ class PhaseTrace:
     def __init__(self) -> None:
         self.phases: dict[str, float] = {}
         self._lock = threading.Lock()
+        # request identity for the obs trace ring (log_parser_tpu/obs):
+        # the propagated X-Request-Id and the route that served it.
+        # Write-once by the thread that creates/submits the request,
+        # before any cross-thread handoff — no lock needed.
+        self.request_id: str | None = None
+        self.route: str = "device"
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -59,7 +65,12 @@ class PhaseTrace:
             return dict(self.phases)
 
     def __repr__(self) -> str:
-        parts = ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in self.phases.items())
+        # same guard as total/as_dict: the batcher's scheduler thread
+        # mutates phases while a submitter may be formatting this
+        with self._lock:
+            parts = ", ".join(
+                f"{k}={v * 1e3:.2f}ms" for k, v in self.phases.items()
+            )
         return f"PhaseTrace({parts})"
 
 

@@ -202,7 +202,7 @@ def test_internal_valueerror_is_internal_not_client_error():
     server, port = make_grpc_server(engine, host="127.0.0.1", port=0)
     server.start()
     try:
-        engine.analyze_pipelined = lambda data: (_ for _ in ()).throw(
+        engine.analyze_pipelined = lambda data, **kw: (_ for _ in ()).throw(
             ValueError("internal shape mismatch")
         )
         with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:

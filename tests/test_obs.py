@@ -1,0 +1,441 @@
+"""Observability plane (log_parser_tpu/obs/): metrics registry contract
+(cardinality bounds, bucket edges, concurrency, Prometheus exposition
+conformance), the request-trace ring, SLO burn accounting, and the HTTP /
+shim integration — request-id propagation through a batched flush, the
+`/metrics` scrape, and bit-for-bit agreement between `/trace/last` and
+the registry (no dual bookkeeping)."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.obs import Obs
+from log_parser_tpu.obs.registry import Registry, samples_from_stats
+from log_parser_tpu.obs.ring import TraceRing
+from log_parser_tpu.obs.slo import SloTracker
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.serve import make_server
+
+from helpers import make_pattern, make_pattern_set
+
+
+# --------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_counter_inc_and_total(self):
+        reg = Registry()
+        c = reg.counter("logparser_requests_total",
+                        ("transport", "route", "status", "tenant"))
+        c.inc(transport="http", route="device", status="200", tenant="a")
+        c.inc(2, transport="http", route="device", status="200", tenant="a")
+        c.inc(transport="shim", route="batched", status="200", tenant="b")
+        assert c.value(transport="http", route="device", status="200",
+                       tenant="a") == 3
+        assert c.total() == 4
+
+    def test_unknown_metric_name_rejected(self):
+        reg = Registry()
+        with pytest.raises(ValueError):
+            reg.counter("logparser_not_in_vocabulary_total")
+
+    def test_factories_are_idempotent_not_kind_confusable(self):
+        reg = Registry()
+        c1 = reg.counter("logparser_fallback_total", ("tenant",))
+        assert reg.counter("logparser_fallback_total", ("tenant",)) is c1
+        with pytest.raises(ValueError):
+            reg.gauge("logparser_fallback_total", ("tenant",))
+
+    def test_cardinality_bound_folds_to_overflow(self):
+        reg = Registry()
+        c = reg.counter("logparser_requests_total",
+                        ("transport", "route", "status", "tenant"),
+                        max_series=4)
+        for i in range(10):
+            c.inc(transport="http", route="device", status="200",
+                  tenant=f"t{i}")
+        # 4 real series kept; 6 increments folded into one overflow series
+        keys = [k for k, _ in c.series()]
+        assert len(keys) == 5
+        assert ("_overflow",) * 4 in keys
+        assert c.value(transport="_overflow", route="_overflow",
+                       status="_overflow", tenant="_overflow") == 6
+        assert c.total() == 10  # folding never loses counts
+        assert reg.total("logparser_metric_series_overflow_total") == 6
+
+    def test_histogram_bucket_edges_inclusive_le(self):
+        reg = Registry()
+        h = reg.histogram("logparser_request_seconds", ("route",),
+                          buckets=(0.1, 1.0))
+        # exactly on an edge counts into that bucket (Prometheus `le`)
+        h.observe(0.1, route="device")
+        h.observe(0.05, route="device")
+        h.observe(0.5, route="device")
+        h.observe(9.0, route="device")
+        counts, total, n = h.snapshot(route="device")
+        # cumulative per Prometheus `le`: 0.1 lands IN the 0.1 bucket
+        assert counts == [2, 3, 4]  # le=0.1, le=1.0, le=+Inf
+        assert n == 4
+        assert total == pytest.approx(9.65)
+
+    def test_concurrent_hammer_loses_nothing(self):
+        reg = Registry()
+        c = reg.counter("logparser_requests_total",
+                        ("transport", "route", "status", "tenant"))
+        h = reg.histogram("logparser_request_seconds", ("route",))
+
+        def hammer(tenant):
+            for _ in range(1000):
+                c.inc(transport="http", route="device", status="200",
+                      tenant=tenant)
+                h.observe(0.01, route="device")
+
+        threads = [threading.Thread(target=hammer, args=(f"t{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.total() == 8000
+        _, _, n = h.snapshot(route="device")
+        assert n == 8000
+
+    def test_collector_backed_series_and_bad_collector_contained(self):
+        reg = Registry()
+        spec = (("fallbackCount", "logparser_fallback_total", {}),)
+        reg.register_collector(
+            "eng", lambda: samples_from_stats(
+                {"fallbackCount": 7}, spec, {"tenant": "default"}))
+        reg.register_collector("bad", lambda: 1 / 0)
+        text = reg.render()  # the broken collector must not kill the scrape
+        assert 'logparser_fallback_total{tenant="default"} 7' in text
+        assert reg.collected_value(
+            "logparser_fallback_total", tenant="default") == 7
+        reg.unregister_collector("eng")
+        assert reg.collected_value(
+            "logparser_fallback_total", tenant="default") is None
+
+
+EXPOSITION_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+(?:inf)?$"
+)
+
+
+class TestExposition:
+    def test_render_conformance(self):
+        reg = Registry()
+        c = reg.counter("logparser_requests_total",
+                        ("transport", "route", "status", "tenant"))
+        c.inc(transport="http", route="device", status="200",
+              tenant='we"ird\\ten\nant')
+        h = reg.histogram("logparser_request_seconds", ("route",),
+                          buckets=(0.1, 1.0))
+        h.observe(0.05, route="device")
+        g = reg.gauge("logparser_inflight")
+        g.set(3)
+        text = reg.render()
+        assert text.endswith("\n")  # exposition ends with a newline
+        lines = text.splitlines()
+        seen_types = {}
+        for line in lines:
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                if line.startswith("# TYPE"):
+                    _, _, name, kind = line.split(" ")
+                    seen_types[name] = kind
+                continue
+            assert EXPOSITION_LINE.match(line), line
+        assert seen_types["logparser_requests_total"] == "counter"
+        assert seen_types["logparser_request_seconds"] == "histogram"
+        assert seen_types["logparser_inflight"] == "gauge"
+        # label escaping: backslash, quote and newline per the text format
+        assert 'tenant="we\\"ird\\\\ten\\nant"' in text
+        # histogram series: cumulative buckets + +Inf + _sum + _count
+        assert 'logparser_request_seconds_bucket{route="device",le="0.1"} 1' in text
+        assert 'logparser_request_seconds_bucket{route="device",le="1.0"} 1' in text
+        assert 'logparser_request_seconds_bucket{route="device",le="+Inf"} 1' in text
+        assert 'logparser_request_seconds_count{route="device"} 1' in text
+        # un-labeled gauge renders bare
+        assert "logparser_inflight 3" in text
+
+
+# ------------------------------------------------------------- trace ring
+
+
+class TestTraceRing:
+    def test_eviction_order_newest_first(self):
+        ring = TraceRing(capacity=4, slow_ms=10_000)
+        for i in range(6):
+            ring.record({"requestId": f"r{i}", "totalMs": 1.0})
+        ids = [e["requestId"] for e in ring.recent(10)]
+        assert ids == ["r5", "r4", "r3", "r2"]  # r0/r1 evicted
+        assert [e["requestId"] for e in ring.recent(2)] == ["r5", "r4"]
+        stats = ring.stats()
+        assert stats["recorded"] == 6 and stats["retained"] == 4
+
+    def test_slow_capture_survives_main_ring_churn(self):
+        ring = TraceRing(capacity=2, slow_ms=100)
+        assert ring.record({"requestId": "slow-1", "totalMs": 250.0}) is True
+        for i in range(5):
+            assert ring.record(
+                {"requestId": f"fast-{i}", "totalMs": 1.0}) is False
+        assert "slow-1" not in [e["requestId"] for e in ring.recent(10)]
+        [slow] = ring.slow_recent(10)
+        assert slow["requestId"] == "slow-1" and slow["slow"] is True
+        assert ring.stats()["slowCaptured"] == 1
+
+
+# -------------------------------------------------------------------- SLO
+
+
+class TestSloTracker:
+    def test_disabled_without_objectives(self):
+        slo = SloTracker()
+        assert not slo.enabled
+        assert slo.health() is None
+
+    def test_availability_burn_degrades_and_recovers(self):
+        now = [1000.0]
+        slo = SloTracker(availability=0.9, windows_s=(10, 60),
+                         clock=lambda: now[0])
+        for _ in range(10):
+            slo.note(ok=False, duration_ms=5.0)
+        health = slo.health()
+        assert health["status"] == "DEGRADED"
+        assert health["burning"] == ["availability"]
+        # 100% errors against a 10% budget: burn 10x on every window
+        assert health["burnRates"]["availability"]["10s"] == pytest.approx(10.0)
+        # healthy traffic + time passing ages the errors out of the short
+        # window first — multi-window AND means no longer degraded
+        now[0] += 15
+        for _ in range(10):
+            slo.note(ok=True, duration_ms=5.0)
+        assert slo.health()["status"] == "UP"
+
+    def test_one_bad_second_does_not_flip_long_window(self):
+        now = [1000.0]
+        slo = SloTracker(availability=0.99, windows_s=(2, 300),
+                         clock=lambda: now[0])
+        slo.note(ok=False, duration_ms=5.0)
+        # long window needs sustained burn: pad it with healthy history
+        now[0] -= 200
+        for _ in range(200):
+            slo.note(ok=True, duration_ms=5.0)
+        now[0] += 200
+        health = slo.health()
+        assert health["status"] == "UP", health
+
+    def test_latency_objective_counts_slow_fraction(self):
+        now = [50.0]
+        slo = SloTracker(p99_ms=100, windows_s=(10,), clock=lambda: now[0])
+        for _ in range(50):
+            slo.note(ok=True, duration_ms=10.0)
+        for _ in range(50):
+            slo.note(ok=True, duration_ms=500.0)
+        health = slo.health()
+        # 50% slow against the 1% tail budget: burn 50x
+        assert health["burnRates"]["latency"]["10s"] == pytest.approx(50.0)
+        assert health["burning"] == ["latency"]
+
+    def test_samples_feed_burn_gauge(self):
+        slo = SloTracker(availability=0.9, windows_s=(60,))
+        slo.note(ok=False, duration_ms=1.0)
+        samples = list(slo.samples())
+        assert samples, "expected logparser_slo_burn_rate samples"
+        name, labels, value = samples[0]
+        assert name == "logparser_slo_burn_rate"
+        assert labels == {"objective": "availability", "window": "60s"}
+        assert value == pytest.approx(10.0)
+
+
+# --------------------------------------------------- request-id plumbing
+
+
+class TestRequestIds:
+    def test_clean_request_id(self):
+        assert Obs.clean_request_id(None) is None
+        assert Obs.clean_request_id("  ") is None
+        assert Obs.clean_request_id("abc-123") == "abc-123"
+        assert Obs.clean_request_id("bad\x00id\nhere") == "badidhere"
+        assert Obs.clean_request_id("x" * 500) == "x" * 128
+        rid = Obs.new_request_id()
+        assert re.fullmatch(r"[0-9a-f]{16}", rid)
+
+
+# --------------------------------------------------------- HTTP contract
+
+
+@pytest.fixture(scope="module")
+def obs_server():
+    patterns = [
+        make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                     severity="CRITICAL", context=(1, 1)),
+        make_pattern("err", regex=r"\bERROR\b", confidence=0.5, severity="LOW"),
+    ]
+    engine = AnalysisEngine([make_pattern_set(patterns, "lib")], ScoringConfig())
+    engine.enable_batching(wait_ms=1.0, batch_max=4)
+    server = make_server(engine, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", engine
+    server.shutdown()
+    engine.batcher.close()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, resp.read().decode(), dict(resp.headers)
+
+
+PAYLOAD = {
+    "pod": {"metadata": {"name": "web-1"}},
+    "logs": "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after",
+}
+
+
+class TestHttpObservability:
+    def test_request_id_echo_and_batched_flush_propagation(self, obs_server):
+        url, engine = obs_server
+        status, _, headers = _post(
+            url + "/parse", PAYLOAD, headers={"X-Request-Id": "my-rid-1"})
+        assert status == 200
+        assert headers["X-Request-Id"] == "my-rid-1"
+        # the id rode admission -> batcher enqueue -> coalesced device
+        # flush -> finalize, and lands in the ring as route "batched"
+        _, body, _ = _get(url + "/trace/recent?n=5")
+        recent = json.loads(body)
+        entry = next(e for e in recent["requests"]
+                     if e["requestId"] == "my-rid-1")
+        assert entry["route"] == "batched"
+        assert entry["outcome"] == "ok"
+        assert entry["phasesMs"], "phase breakdown missing"
+        assert entry["totalMs"] > 0
+
+    def test_request_id_minted_when_absent(self, obs_server):
+        url, _ = obs_server
+        status, _, headers = _post(url + "/parse", PAYLOAD)
+        assert status == 200
+        assert re.fullmatch(r"[0-9a-f]{16}", headers["X-Request-Id"])
+
+    def test_metrics_scrape_is_valid_exposition(self, obs_server):
+        url, _ = obs_server
+        _post(url + "/parse", PAYLOAD)
+        status, text, headers = _get(url + "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert EXPOSITION_LINE.match(line), line
+        assert 'le="+Inf"' in text
+        assert re.search(
+            r'logparser_requests_total\{transport="http",route="batched",'
+            r'status="200",tenant="default"\} \d+', text)
+
+    def test_trace_last_and_registry_agree(self, obs_server):
+        url, engine = obs_server
+        _post(url + "/parse", PAYLOAD)
+        _, body, _ = _get(url + "/trace/last")
+        trace = json.loads(body)
+        reg = engine.obs.registry
+        # collector-backed series read the SAME stats dicts /trace/last
+        # serves — agreement is by construction, checked bit-for-bit
+        assert trace["fallbackCount"] == reg.collected_value(
+            "logparser_fallback_total", tenant="default")
+        assert trace["batcher"]["requestsBatched"] == reg.collected_value(
+            "logparser_requests_batched_total", tenant="default")
+        assert trace["admission"]["admittedDevice"] == reg.collected_value(
+            "logparser_admission_total", outcome="device")
+        assert trace["droppedResponses"] == engine.obs.dropped_responses
+        assert trace["traceRing"] == engine.obs.ring.stats()
+
+    def test_trace_recent_bad_n_is_400(self, obs_server):
+        url, _ = obs_server
+        try:
+            urllib.request.urlopen(url + "/trace/recent?n=bogus")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_non_200_outcomes_recorded_with_status_label(self, obs_server):
+        url, engine = obs_server
+        status, _, headers = _post(
+            url + "/parse", {"pod": None},
+            headers={"X-Request-Id": "bad-req-1"})
+        assert status == 400
+        assert headers["X-Request-Id"] == "bad-req-1"
+        assert engine.obs.requests_total.value(
+            transport="http", route="device", status="400",
+            tenant="default") >= 1
+        _, body, _ = _get(url + "/trace/recent?n=10")
+        entry = next(e for e in json.loads(body)["requests"]
+                     if e["requestId"] == "bad-req-1")
+        assert entry["outcome"] == "http_400"
+
+    def test_profile_route_unconfigured_is_503(self, obs_server):
+        url, _ = obs_server
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/debug/profile", data=b'{"seconds": 1}'))
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+
+    def test_profile_route_bad_seconds_is_400(self, obs_server, tmp_path):
+        url, engine = obs_server
+        engine.obs.profiler.configure(str(tmp_path))
+        try:
+            for bad in (b'{"seconds": 0}', b'{"seconds": 1e9}', b"[]"):
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        url + "/debug/profile", data=bad))
+                    raise AssertionError("expected 400")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400, bad
+        finally:
+            engine.obs.profiler.base_dir = None
+
+
+# --------------------------------------------------------- shim contract
+
+
+def test_shim_metrics_frame():
+    from log_parser_tpu.shim import ShimClient, make_shim_server
+    from log_parser_tpu.shim import logparser_pb2 as pb
+
+    engine = AnalysisEngine(
+        [make_pattern_set([make_pattern("oom", regex="OutOfMemoryError")])],
+        ScoringConfig(),
+    )
+    server = make_shim_server(engine, host="127.0.0.1", port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with ShimClient("127.0.0.1", server.server_address[1]) as c:
+            c.parse({"metadata": {"name": "p"}},
+                    "java.lang.OutOfMemoryError: heap")
+            env = c.call("Metrics", pb.HealthRequest())
+            assert not env.error
+            text = env.payload.decode()
+            assert "# TYPE logparser_requests_total counter" in text
+            assert re.search(
+                r'logparser_requests_total\{transport="shim",[^}]*'
+                r'status="200",tenant="default"\} 1', text)
+    finally:
+        server.shutdown()

@@ -123,7 +123,7 @@ class TestAnalysisFailure:
             [make_pattern_set([make_pattern("e", regex="ERROR")], "lib")],
             ScoringConfig(),
         )
-        engine.analyze_pipelined = lambda data: (_ for _ in ()).throw(TypeError("bug"))
+        engine.analyze_pipelined = lambda data, **kw: (_ for _ in ()).throw(TypeError("bug"))
         server = make_server(engine, host="127.0.0.1", port=0)
         port = server.server_address[1]
         threading.Thread(target=server.serve_forever, daemon=True).start()

@@ -186,7 +186,7 @@ miner"):
                         against the post-reload library.
 
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|all]
                                    [--keep-logs]
 """
 
@@ -1492,6 +1492,128 @@ MINER_SCENARIOS = [
 ]
 
 
+# ------------------------------------------------- observability scenarios
+#
+# Obs group (``--group obs``; the fleet observability plane — docs/OPS.md
+# "Observability"): /metrics stays live and monotone while the device
+# path is faulting; the slow-request ring captures the faulted request by
+# its propagated id; sustained availability burn flips the /q/health
+# ``slo`` check DEGRADED and it recovers once the error cells age out of
+# every window.
+
+
+def get_text(url: str, path: str):
+    """Raw-text GET — /metrics is Prometheus exposition, not JSON."""
+    with urllib.request.urlopen(url + path, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _metric_total(text: str, name: str) -> float | None:
+    """Sum every sample of one metric family across its label sets."""
+    total, found = 0.0, False
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        head = line.split(" ", 1)[0]
+        if head.split("{", 1)[0] == name:
+            total += float(line.rsplit(" ", 1)[1])
+            found = True
+    return total if found else None
+
+
+def scenario_obs_metrics_monotone(srv: Server):
+    status, text = get_text(srv.url, "/metrics")
+    assert status == 200, status
+    assert "# TYPE logparser_requests_total counter" in text, "missing TYPE"
+    before = _metric_total(text, "logparser_requests_total") or 0.0
+    statuses = [post(srv.url)[0] for _ in range(8)]
+    assert statuses == [200] * 8, statuses  # faults fall back to golden
+    status, text = get_text(srv.url, "/metrics")
+    assert status == 200, "metrics endpoint died under device faults"
+    assert 'le="+Inf"' in text, "histogram without +Inf bucket"
+    after = _metric_total(text, "logparser_requests_total")
+    assert after is not None and after >= before + 8, (before, after)
+    fallbacks = _metric_total(text, "logparser_fallback_total")
+    assert fallbacks and fallbacks >= 1, f"seeded p=0.5 never fired: {fallbacks}"
+    # registry and /trace/last read the same counters — no dual books
+    _, trace = get(srv.url, "/trace/last")
+    assert trace["fallbackCount"] == fallbacks, (trace["fallbackCount"], fallbacks)
+
+
+def scenario_obs_slow_ring_capture(srv: Server):
+    # request 1 eats the injected 0.5 s device stall (plus first-compile
+    # time) — far over the 250 ms bar; its propagated id must land in the
+    # slow ring and survive later fast traffic
+    status, _, hdrs = post(srv.url, headers={"X-Request-Id": "slowpoke-1"})
+    assert status == 200, status
+    assert hdrs.get("X-Request-Id") == "slowpoke-1", hdrs
+    for _ in range(3):
+        assert post(srv.url)[0] == 200
+    _, recent = get(srv.url, "/trace/recent?n=10")
+    slow_ids = [e["requestId"] for e in recent["slow"]]
+    assert "slowpoke-1" in slow_ids, slow_ids
+    assert recent["ring"]["slowCaptured"] >= 1, recent["ring"]
+    assert len(recent["requests"]) == 4, recent["requests"]
+
+
+def scenario_obs_slo_burn_flip(srv: Server):
+    # 6 injected transport 500s in one second: error frac 1.0 against a
+    # 0.1 budget burns 10x on both (2 s / 4 s) windows -> DEGRADED
+    statuses = [post(srv.url)[0] for _ in range(6)]
+    assert statuses == [500] * 6, statuses
+    _, health = get(srv.url, "/q/health")
+    slo = next(c for c in health.get("checks", []) if c["name"] == "slo")
+    assert slo["status"] == "DEGRADED", slo
+    assert "availability" in slo["burning"], slo
+    # fault spec is exhausted (@times=6): traffic is healthy again; the
+    # error cells age out of the 4 s window and the check recovers
+    deadline = time.monotonic() + 15
+    recovered = False
+    while time.monotonic() < deadline:
+        assert post(srv.url)[0] == 200
+        _, health = get(srv.url, "/q/health")
+        checks = health.get("checks", [])
+        slo = next((c for c in checks if c["name"] == "slo"), None)
+        if slo is None or slo["status"] == "UP":
+            recovered = True
+            break
+        time.sleep(0.5)
+    assert recovered, f"slo check never recovered: {health}"
+
+
+OBS_SCENARIOS = [
+    (
+        "obs-metrics-monotone",
+        # cache off so every request reaches the faulted device site
+        ["--line-cache-mb", "0"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_raise:0.5",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_obs_metrics_monotone,
+    ),
+    (
+        "obs-slow-ring-capture",
+        ["--trace-slow-ms", "250"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_slow:0.5@times=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_obs_slow_ring_capture,
+    ),
+    (
+        "obs-slo-burn-flip",
+        ["--slo-availability", "0.9"],
+        {
+            "LOG_PARSER_TPU_SLO_WINDOWS_S": "2,4",
+            "LOG_PARSER_TPU_FAULTS": "http_raise:1.0@times=6",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_obs_slo_burn_flip,
+    ),
+]
+
+
 def _miner_engine(curated_regex: str, mode: str = "auto"):
     """In-process engine + miner for the standalone drills: one curated
     pattern, line cache on, worker NOT started (pump() is driven
@@ -1611,7 +1733,9 @@ SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
         "device-raise",
-        [],
+        # cache off: identical chaos payloads are full line-cache hits
+        # after the first request, which would skip the device site
+        ["--line-cache-mb", "0"],
         {
             "LOG_PARSER_TPU_FAULTS": "device_raise:0.5",
             "LOG_PARSER_TPU_FAULT_SEED": "42",
@@ -1656,7 +1780,7 @@ def main(argv: list[str] | None = None) -> int:
         "--group",
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
-            "streaming", "distributed", "tenant", "miner", "all",
+            "streaming", "distributed", "tenant", "miner", "obs", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1687,6 +1811,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(STREAMING_SCENARIOS)
     if args.group in ("miner", "all"):
         single_server.extend(MINER_SCENARIOS)
+    if args.group in ("obs", "all"):
+        single_server.extend(OBS_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:

@@ -539,6 +539,40 @@ def check_kernel_admission(root: Path) -> list[str]:
     ]
 
 
+def check_obs_vocab_pinned(root: Path) -> list[str]:
+    """Check 16: the observability vocabulary must be pinned the way
+    checks 9/12/14 pin their surfaces. Every metric name in the
+    ``METRICS`` literal (log_parser_tpu/obs/registry.py) is a dashboard
+    and alert-rule contract — each needs a backtick-quoted row in
+    docs/OPS.md so a rename shows up as a doc diff, not a silently
+    broken scrape. The obs serve flags (``--trace-*`` / ``--slo-*``)
+    are held to the same backtick-row standard."""
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops_doc = root / "docs" / "OPS.md"
+    if not registry_src.is_file():
+        return []
+    problems: list[str] = []
+    ops_text = ops_doc.read_text() if ops_doc.is_file() else ""
+    for name in _dict_keys_of(registry_src, "METRICS"):
+        if f"`{name}`" not in ops_text:
+            problems.append(
+                f"{registry_src}: metric {name!r} has no backtick-quoted "
+                "docs/OPS.md row"
+            )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--trace-[a-z0-9-]+|--slo-[a-z0-9-]+)"',
+            serve_src.read_text(),
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: observability serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -568,6 +602,7 @@ def main() -> int:
         problems.extend(check_tenancy_vocab_pinned(root))
         problems.extend(check_miner_vocab_pinned(root))
         problems.extend(check_kernel_admission(root))
+        problems.extend(check_obs_vocab_pinned(root))
 
     for p in problems:
         print(p)
