@@ -32,6 +32,11 @@ from log_parser_tpu.obs.slo import (
     DEFAULT_WINDOWS_S,
     SloTracker,
 )
+from log_parser_tpu.obs.spans import (  # noqa: F401  (re-export)
+    DEFAULT_SPAN_CAPACITY,
+    SPANS,
+    SpanStore,
+)
 
 # finer low end than the request histogram: cache-hit phases are sub-ms
 PHASE_BUCKETS = (
@@ -53,6 +58,70 @@ _MINER_SAMPLES = (
     ("tapped", "logparser_miner_tapped_total", {}),
     ("admitted", "logparser_miner_admitted_total", {}),
 )
+_JOURNAL_SAMPLES = (
+    ("epoch", "logparser_journal_epoch", {}),
+)
+
+# bounded reason classes for logparser_native_loaded, matched against
+# the load-failure string native.stats() records (native/__init__.py
+# sets _load_error exactly once) — the label stays low-cardinality no
+# matter what the dlopen error text says
+_NATIVE_REASONS = (
+    ("disabled", "disabled"),
+    ("compile failed", "compile_failed"),
+    ("no prebuilt library", "no_library"),
+    ("load failed", "load_failed"),
+    ("stale library", "stale"),
+)
+
+
+def native_load_reason(stats: dict) -> str:
+    """Map native.stats() onto the bounded ``reason`` label vocabulary
+    (ok / not_loaded / disabled / compile_failed / no_library /
+    load_failed / stale / other)."""
+    if stats.get("available"):
+        return "ok"
+    err = stats.get("loadError")
+    if not err:
+        return "not_loaded"
+    for prefix, reason in _NATIVE_REASONS:
+        if err.startswith(prefix):
+            return reason
+    return "other"
+
+
+def _native_samples():
+    """`logparser_native_loaded` — the GLIBCXX triage that used to live
+    only on /trace/last, now scrapeable (lazy import: get_lib is warmed
+    by boot, a scrape never triggers a compile)."""
+    from log_parser_tpu import native
+
+    st = native.stats()
+    return [(
+        "logparser_native_loaded",
+        {"reason": native_load_reason(st)},
+        1.0 if st.get("available") else 0.0,
+    )]
+
+
+def _compile_cache_samples():
+    from log_parser_tpu.utils import xlacache
+
+    st = xlacache.stats()
+    return [
+        ("logparser_compile_cache_events_total", {"kind": "hit"},
+         st.get("compileHits", 0)),
+        ("logparser_compile_cache_events_total", {"kind": "miss"},
+         st.get("compileMisses", 0)),
+    ]
+
+
+def _fault_samples():
+    from log_parser_tpu.runtime import faults
+
+    st = faults.stats()
+    armed = 0 if st is None else len(st.get("fired", {}))
+    return [("logparser_faults_armed", {}, armed)]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -89,6 +158,14 @@ class Obs:
             clock=clock,
         )
         self.profiler = DeviceProfiler(on_complete=self._profile_done)
+        self.spans = SpanStore(
+            capacity=int(
+                _env_float("LOG_PARSER_TPU_TRACE_SPANS", DEFAULT_SPAN_CAPACITY)
+            ),
+            sample=_env_float("LOG_PARSER_TPU_TRACE_SAMPLE", 1.0),
+            slow_ms=self.ring.slow_ms,
+        )
+        self.span_dump_path: str | None = None
         self.clock = clock
         reg = self.registry
         self.requests_total = reg.counter(
@@ -110,7 +187,37 @@ class Obs:
             "logparser_dropped_responses_total", ("transport",)
         )
         self.profile_captures = reg.counter("logparser_profile_captures_total")
+        self.device_dispatches = reg.counter(
+            "logparser_device_dispatches_total", ("tenant", "tier"),
+            max_series=128,
+        )
+        self.device_padded_rows = reg.counter(
+            "logparser_device_padded_rows_total", ("tenant",)
+        )
+        self.device_dummy_rows = reg.counter(
+            "logparser_device_dummy_rows_total", ("tenant",)
+        )
+        self.device_waste = reg.gauge(
+            "logparser_device_dummy_waste_ratio", ("tenant",)
+        )
+        self.device_flops = reg.counter(
+            "logparser_device_flops_total", ("tenant",)
+        )
+        self.device_hbm_bytes = reg.counter(
+            "logparser_device_hbm_bytes_total", ("tenant",)
+        )
         reg.register_collector("slo", self.slo.samples)
+        reg.register_collector("spans", self._span_samples)
+        reg.register_collector("native", _native_samples)
+        reg.register_collector("compilecache", _compile_cache_samples)
+        reg.register_collector("faults", _fault_samples)
+
+    def _span_samples(self):
+        st = self.spans.stats()
+        return [
+            ("logparser_trace_spans_total", {}, st["committed"]),
+            ("logparser_trace_spans_dropped_total", {}, st["droppedTraces"]),
+        ]
 
     def _profile_done(self) -> None:
         self.profile_captures.inc()
@@ -159,6 +266,22 @@ class Obs:
             entry["error"] = error
         if self.ring.record(entry):
             self.slow_requests.inc(route=route)
+        # the span root is built from the SAME clock delta and phases
+        # dict as the ring entry + phase histograms above, so the three
+        # surfaces reconcile exactly, not approximately
+        attrs = {"route": route, "outcome": outcome}
+        if n_lines is not None:
+            attrs["lines"] = n_lines
+        if error is not None:
+            attrs["error"] = error
+        extra = getattr(trace, "span_attrs", None)
+        if extra:
+            attrs.update(extra)
+        self.spans.end_trace(
+            request_id, duration_s=total_ms / 1e3, tenant=tenant,
+            attrs=attrs, phases=phases,
+            links=list(getattr(trace, "links", ()) or ()),
+        )
 
     def note_request(self, transport: str, route: str, status: int,
                      tenant: str, duration_s: float,
@@ -187,6 +310,37 @@ class Obs:
                 entry["error"] = detail
             if self.ring.record(entry):
                 self.slow_requests.inc(route=route)
+            # non-200s never reach note_served, so their trace (and any
+            # staged admission child) must be finished here — otherwise
+            # a shed request would orphan its staged spans
+            attrs = {"route": route, "outcome": entry["outcome"],
+                     "transport": transport, "status": status}
+            if detail:
+                attrs["error"] = detail
+            self.spans.end_trace(
+                entry["requestId"], duration_s=duration_s, tenant=tenant,
+                attrs=attrs,
+            )
+
+    def note_dispatch(self, tenant: str, tier: str, padded_rows: int = 0,
+                      dummy_rows: int = 0, waste: float | None = None,
+                      flops: float | None = None,
+                      hbm_bytes: float | None = None) -> None:
+        """Per-dispatch device-utilization accounting: every device
+        step (direct, batched flush, line-cache residual) folds its
+        cost into the per-tenant ``logparser_device_*`` families so
+        roofline math is a scrape, not a bench run."""
+        self.device_dispatches.inc(tenant=tenant, tier=tier)
+        if padded_rows:
+            self.device_padded_rows.inc(padded_rows, tenant=tenant)
+        if dummy_rows:
+            self.device_dummy_rows.inc(dummy_rows, tenant=tenant)
+        if waste is not None:
+            self.device_waste.set(waste, tenant=tenant)
+        if flops:
+            self.device_flops.inc(flops, tenant=tenant)
+        if hbm_bytes:
+            self.device_hbm_bytes.inc(hbm_bytes, tenant=tenant)
 
     def note_dropped(self, transport: str) -> None:
         """A computed response the transport could not write back —
@@ -232,6 +386,36 @@ class Obs:
                     ("logparser_kernel_rows_total", labels,
                      ks.get("kernelRows", 0)),
                 ])
+                geometry = ks.get("geometry") or {}
+                if geometry:
+                    out.extend([
+                        ("logparser_kernel_plan_vmem_bytes", labels,
+                         geometry.get("vmemPerStep", 0)),
+                        ("logparser_kernel_plan_groups", labels,
+                         geometry.get("nGroups", 0)),
+                        ("logparser_kernel_plan_plane_bytes", labels,
+                         geometry.get("planeBytes", 0)),
+                    ])
+            journal = getattr(engine, "journal", None)
+            if journal is not None:
+                out.extend(samples_from_stats(
+                    journal.stats(), _JOURNAL_SAMPLES, labels
+                ))
+            last_lint = getattr(engine, "last_lint", None)
+            if last_lint:
+                for severity in ("error", "warn", "info"):
+                    if severity in last_lint:
+                        out.append((
+                            "logparser_lint_findings",
+                            {**labels, "severity": severity},
+                            last_lint[severity],
+                        ))
+            mesh = getattr(engine, "mesh_health", None)
+            if mesh is not None:
+                out.append((
+                    "logparser_mesh_degraded", labels,
+                    0.0 if mesh.stats().get("mode") == "distributed" else 1.0,
+                ))
             quarantine = getattr(engine, "quarantine", None)
             if quarantine is not None:
                 out.extend(samples_from_stats(

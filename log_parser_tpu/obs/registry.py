@@ -115,6 +115,95 @@ METRICS = {
         "counter", "Chunks ingested across streaming sessions."),
     "logparser_stream_frames_total": (
         "counter", "Frames emitted across streaming sessions."),
+    # --------------------------------------------------- span store
+    "logparser_trace_spans_total": (
+        "counter", "Causal traces committed to the span store."),
+    "logparser_trace_spans_dropped_total": (
+        "counter", "Traces discarded by span sampling (children cleaned)."),
+    # ------------------------------------- device utilization (roofline)
+    "logparser_device_dispatches_total": (
+        "counter", "Device dispatches by tenant and execution tier."),
+    "logparser_device_padded_rows_total": (
+        "counter", "Padded line rows shipped to the device (incl. waste)."),
+    "logparser_device_dummy_rows_total": (
+        "counter", "Dummy pow2-padding request slots dispatched (waste)."),
+    "logparser_device_dummy_waste_ratio": (
+        "gauge", "Dummy-slot waste fraction of the last batched dispatch."),
+    "logparser_device_flops_total": (
+        "counter", "XLA cost-analysis FLOPs accumulated over dispatches."),
+    "logparser_device_hbm_bytes_total": (
+        "counter", "XLA cost-analysis bytes accessed over dispatches."),
+    # --------------------------------------- plan geometry + load state
+    "logparser_kernel_plan_vmem_bytes": (
+        "gauge", "Admitted union-DFA plan VMEM bytes per grid step."),
+    "logparser_kernel_plan_groups": (
+        "gauge", "Union-DFA groups in the admitted kernel plan."),
+    "logparser_kernel_plan_plane_bytes": (
+        "gauge", "Transition-plane bytes resident per kernel grid step."),
+    "logparser_native_loaded": (
+        "gauge", "1 when the native C++ scanner loaded; reason label "
+        "carries the bounded load-failure class."),
+    "logparser_compile_cache_events_total": (
+        "counter", "Persistent XLA compile-cache events by kind (hit/miss)."),
+    "logparser_journal_epoch": (
+        "gauge", "Frequency-WAL snapshot epoch by tenant."),
+    "logparser_lint_findings": (
+        "gauge", "Findings in the last pattern-lint run by severity."),
+    "logparser_faults_armed": (
+        "gauge", "Fault-injection sites armed via LOG_PARSER_TPU_FAULTS."),
+    "logparser_mesh_degraded": (
+        "gauge", "1 while distributed serving is degraded to local."),
+}
+
+# /trace/last payload block -> covering /metrics families. Hygiene
+# check 16 harvests every ``payload["..."]`` key assigned in
+# serve/http.py and fails when a block is missing here or maps to a
+# name outside METRICS — so a new trace block cannot ship invisible to
+# scrapers again (the PR-10 native block did exactly that).
+TRACE_BLOCKS = {
+    "phasesMs": ("logparser_phase_seconds",),
+    "totalMs": ("logparser_request_seconds",),
+    "fallbackCount": ("logparser_fallback_total",),
+    "hostRoutedCount": ("logparser_host_routed_total",),
+    "deviceCircuitOpen": ("logparser_device_circuit_open",),
+    "droppedResponses": ("logparser_dropped_responses_total",),
+    "admission": ("logparser_admission_total", "logparser_inflight",
+                  "logparser_admission_queued"),
+    "traceRing": ("logparser_slow_requests_total",),
+    "spans": ("logparser_trace_spans_total",
+              "logparser_trace_spans_dropped_total"),
+    "batcher": ("logparser_batch_queue_depth",
+                "logparser_requests_batched_total",
+                "logparser_batches_flushed_total"),
+    "lineCache": ("logparser_line_cache_hits_total",
+                  "logparser_line_cache_misses_total",
+                  "logparser_line_cache_evictions_total",
+                  "logparser_line_cache_resident_bytes"),
+    "interner": ("logparser_interner_probe_hits_total",
+                 "logparser_interner_inserts_total"),
+    "kernel": ("logparser_kernel_batches_total",
+               "logparser_kernel_rows_total",
+               "logparser_kernel_plan_vmem_bytes",
+               "logparser_kernel_plan_groups",
+               "logparser_kernel_plan_plane_bytes"),
+    "distributed": ("logparser_mesh_degraded",),
+    "journal": ("logparser_journal_epoch",),
+    "stream": ("logparser_stream_sessions",
+               "logparser_stream_chunks_total",
+               "logparser_stream_frames_total"),
+    "native": ("logparser_native_loaded",),
+    "compileCache": ("logparser_compile_cache_events_total",),
+    "quarantine": ("logparser_quarantine_active",
+                   "logparser_quarantine_served_golden_total"),
+    "miner": ("logparser_miner_tapped_total",
+              "logparser_miner_admitted_total"),
+    "shadow": ("logparser_shadow_divergences_total",),
+    "reload": ("logparser_reload_epoch",),
+    "lint": ("logparser_lint_findings",),
+    "tenants": ("logparser_tenants_resident",
+                "logparser_tenant_builds_total",
+                "logparser_tenant_evictions_total"),
+    "faults": ("logparser_faults_armed",),
 }
 
 # request latency: sub-ms cache hits through multi-second cold compiles

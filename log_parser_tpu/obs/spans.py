@@ -1,0 +1,337 @@
+"""Causal span store: the *why* behind the trace ring's *how much*.
+
+PR 14's flat ring records one dict per request, but the fleet's
+interesting latency is structural: the micro-batcher coalesces N
+requests into ONE fused device flush, stream sessions spread one
+request across many chunks, and tenant engines share one admission
+gate. :class:`SpanStore` records bounded causal trees — a request span
+with admission/enqueue/phase children, a *flush* span carrying
+span-links to every coalesced request trace (fan-in the flat ring
+cannot express), dispatch spans carrying device-utilization attributes
+(tier, plan geometry, padded rows, dummy-slot waste), long-lived
+stream-session spans with per-chunk children, and tenancy/broadcast
+lifecycle spans.
+
+Design rules:
+
+- **Trace id == request id.** The propagated ``X-Request-Id`` plumbing
+  from PR 14 is reused verbatim; stream sessions use their session id;
+  flush spans mint their own trace and LINK (not parent) the member
+  requests, because a flush belongs to several traces at once.
+- **Stage, then commit.** Child spans are staged per trace id in a
+  bounded dict; :meth:`end_trace` builds the root, attaches children
+  and commits the whole tree iff the trace is sampled
+  (``--trace-sample``, deterministic on the trace id), slow
+  (``--trace-slow-ms``, always-on), or forced (flush/tenancy spans are
+  rare and always kept). A dropped sample pops its staged children too
+  — no orphans, ever.
+- **Reconcile by construction.** The request root is built inside
+  ``Obs.note_served`` from the *same* ``PhaseTrace`` dict and the same
+  clock delta the ring entry and the phase histograms use, so
+  ``/metrics``, ``/trace/recent`` and ``/trace/spans`` can never
+  disagree about a duration.
+
+Export: ``GET /trace/spans`` serves :meth:`traces` (self-contained
+JSON) and :meth:`dump` writes an OTLP-compatible JSON file
+(``resourceSpans`` shape) to the state dir.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import zlib
+from collections import deque
+
+DEFAULT_SPAN_CAPACITY = 256
+DEFAULT_STAGING_CAPACITY = 512
+DEFAULT_SAMPLE = 1.0
+
+# Span-name vocabulary. hygiene check 17 pins every key to a
+# backtick-quoted row of the docs/OPS.md span table; code may only
+# record spans under these names (SpanStore rejects unknown ones), so
+# the operator table can never drift from what actually serves.
+SPANS = {
+    "request": "one-shot parse: transport receipt to response handoff",
+    "phase": "one PhaseTrace phase replayed as a child span (attr phase)",
+    "admission": "admission-gate + tenant-quota acquire verdict",
+    "enqueue": "micro-batcher enqueue: submit until flush pickup",
+    "flush": "coalesced batch flush; links every member request trace",
+    "dispatch": "one device dispatch: tier, plan geometry, utilization",
+    "demux": "flush demux: per-request verify + finalize fan-out",
+    "session": "stream session lifetime: open to close or kill",
+    "chunk": "one stream chunk: bytes fed to frames emitted",
+    "rebase": "stream session re-based onto a hot-reloaded library",
+    "broadcast": "coordinator-to-follower mesh broadcast for one trace",
+    "tenant_build": "tenant bank build (first touch or post-evict)",
+    "tenant_evict": "tenant eviction: flush, close streams, fold WAL",
+}
+
+
+def _span_id(trace_id: str) -> str:
+    """Deterministic 8-byte root span id for a trace id, so a link to
+    another trace's root can be minted WITHOUT looking that trace up
+    (the linked trace may not even be committed yet)."""
+    return hashlib.blake2b(trace_id.encode("utf-8", "replace"),
+                           digest_size=8).hexdigest()
+
+
+def _otlp_trace_id(trace_id: str) -> str:
+    """16-byte OTLP trace id derived from the wire trace id (which is
+    free-form: inbound X-Request-Id survives cleaning at ≤128 chars)."""
+    return hashlib.blake2b(trace_id.encode("utf-8", "replace"),
+                           digest_size=16).hexdigest()
+
+
+def _link(trace_id: str) -> dict:
+    """A span-link to another trace's root span."""
+    return {"traceId": trace_id, "spanId": _span_id(trace_id)}
+
+
+class SpanStore:
+    """Process-wide bounded causal-span store (one per Obs bundle;
+    tenant engines share the primary's, like the ring)."""
+
+    def __init__(self, capacity: int = DEFAULT_SPAN_CAPACITY,
+                 sample: float = DEFAULT_SAMPLE,
+                 slow_ms: float = 500.0,
+                 staging_capacity: int = DEFAULT_STAGING_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self.sample = min(1.0, max(0.0, float(sample)))
+        self.slow_ms = float(slow_ms)
+        self.staging_capacity = max(1, int(staging_capacity))
+        self._lock = threading.Lock()
+        self._traces: deque[dict] = deque(maxlen=self.capacity)
+        self._staging: dict[str, list[dict]] = {}
+        self._seq = 0
+        self._span_seq = 0
+        self.committed = 0
+        self.dropped_traces = 0
+        self.staging_evicted = 0
+        self.span_count = 0
+
+    # ----------------------------------------------------- sampling
+
+    def sampled(self, trace_id: str) -> bool:
+        """Deterministic head sampling on the trace id: the same id
+        gives the same verdict on every process and every surface, so
+        a replayed request is reproducibly kept or reproducibly cheap."""
+        if self.sample >= 1.0:
+            return True
+        if self.sample <= 0.0:
+            return False
+        h = zlib.crc32(trace_id.encode("utf-8", "replace")) & 0xFFFFFFFF
+        return (h % 10000) < int(self.sample * 10000)
+
+    # ------------------------------------------------------ staging
+
+    def _new_span(self, name: str, parent: str | None, t0: float,
+                  duration_s: float, attrs: dict | None,
+                  links: list | None) -> dict:
+        if name not in SPANS:
+            raise ValueError(f"unknown span name: {name!r}")
+        self._span_seq += 1
+        span = {
+            "spanId": f"{self._span_seq:016x}",
+            "name": name,
+            "startUnixNano": int(t0 * 1e9),
+            "durationMs": round(duration_s * 1e3, 6),
+        }
+        if parent:
+            span["parentSpanId"] = parent
+        if attrs:
+            span["attrs"] = dict(attrs)
+        if links:
+            span["links"] = [
+                ln if isinstance(ln, dict) else _link(ln) for ln in links
+            ]
+        return span
+
+    def annotate(self, trace_id: str, name: str, duration_s: float,
+                 attrs: dict | None = None, links: list | None = None,
+                 t0: float | None = None) -> None:
+        """Stage one completed child span under ``trace_id``; it is
+        attached (parented to the root) when the trace ends. Bounded:
+        the oldest staged trace is evicted whole when the staging dict
+        would exceed its capacity, so an abandoned trace id can never
+        grow the store."""
+        if t0 is None:
+            t0 = time.time() - duration_s
+        with self._lock:
+            span = self._new_span(name, _span_id(trace_id), t0,
+                                  duration_s, attrs, links)
+            bucket = self._staging.get(trace_id)
+            if bucket is None:
+                while len(self._staging) >= self.staging_capacity:
+                    self._staging.pop(next(iter(self._staging)))
+                    self.staging_evicted += 1
+                bucket = self._staging[trace_id] = []
+            bucket.append(span)
+
+    # ------------------------------------------------------- commit
+
+    def end_trace(self, trace_id: str, duration_s: float,
+                  tenant: str = "default", name: str = "request",
+                  attrs: dict | None = None,
+                  phases: dict | None = None,
+                  links: list | None = None,
+                  force: bool = False,
+                  t0: float | None = None) -> bool:
+        """Finish a trace: build its root span, replay ``phases`` (the
+        request's PhaseTrace dict, seconds per phase) as sequential
+        ``phase`` children, attach every staged child, and commit the
+        tree iff sampled/slow/forced. Staged children are popped
+        either way — a dropped sample never orphans a child span.
+        Returns True when the trace was committed."""
+        if name not in SPANS:
+            raise ValueError(f"unknown span name: {name!r}")
+        total_ms = duration_s * 1e3
+        keep = force or total_ms >= self.slow_ms or self.sampled(trace_id)
+        if t0 is None:
+            t0 = time.time() - duration_s
+        with self._lock:
+            staged = self._staging.pop(trace_id, None)
+            if not keep:
+                self.dropped_traces += 1
+                return False
+            self._span_seq += 1
+            root = {
+                "spanId": _span_id(trace_id),
+                "name": name,
+                "startUnixNano": int(t0 * 1e9),
+                "durationMs": round(total_ms, 6),
+            }
+            if attrs:
+                root["attrs"] = dict(attrs)
+            if links:
+                root["links"] = [
+                    ln if isinstance(ln, dict) else _link(ln)
+                    for ln in links
+                ]
+            spans = [root]
+            offset = 0.0
+            for pname, seconds in (phases or {}).items():
+                spans.append(self._new_span(
+                    "phase", root["spanId"], t0 + offset, seconds,
+                    {"phase": pname}, None,
+                ))
+                offset += seconds
+            if staged:
+                spans.extend(staged)
+            self._seq += 1
+            self._traces.append({
+                "traceId": trace_id,
+                "otlpTraceId": _otlp_trace_id(trace_id),
+                "seq": self._seq,
+                "tenant": tenant,
+                "name": name,
+                "slow": total_ms >= self.slow_ms,
+                "totalMs": round(total_ms, 3),
+                "spans": spans,
+            })
+            self.committed += 1
+            self.span_count += len(spans)
+        return True
+
+    # ------------------------------------------------------- reads
+
+    def traces(self, n: int | None = None) -> list[dict]:
+        """Committed traces, newest first (the /trace/spans payload)."""
+        with self._lock:
+            items = list(self._traces)
+        items.reverse()
+        return items if n is None else items[: max(0, int(n))]
+
+    def find(self, trace_id: str) -> dict | None:
+        with self._lock:
+            for tr in reversed(self._traces):
+                if tr["traceId"] == trace_id:
+                    return tr
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "sample": self.sample,
+                "slowMs": self.slow_ms,
+                "retained": len(self._traces),
+                "committed": self.committed,
+                "droppedTraces": self.dropped_traces,
+                "staged": len(self._staging),
+                "stagingEvicted": self.staging_evicted,
+                "spanCount": self.span_count,
+            }
+
+    # ------------------------------------------------------- export
+
+    @staticmethod
+    def _otlp_value(v) -> dict:
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    def export_otlp(self) -> dict:
+        """The committed store as one OTLP/JSON ``resourceSpans``
+        document (ExportTraceServiceRequest shape) — importable by any
+        OTLP-speaking backend without a collector in the loop."""
+        spans_out = []
+        for tr in self.traces():
+            tid = tr["otlpTraceId"]
+            for span in tr["spans"]:
+                start = span["startUnixNano"]
+                end = start + int(span["durationMs"] * 1e6)
+                item = {
+                    "traceId": tid,
+                    "spanId": span["spanId"],
+                    "name": span["name"],
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": str(start),
+                    "endTimeUnixNano": str(end),
+                }
+                if span.get("parentSpanId"):
+                    item["parentSpanId"] = span["parentSpanId"]
+                attrs = dict(span.get("attrs") or {})
+                attrs.setdefault("tenant", tr["tenant"])
+                attrs.setdefault("trace.wire_id", tr["traceId"])
+                item["attributes"] = [
+                    {"key": k, "value": self._otlp_value(v)}
+                    for k, v in attrs.items()
+                ]
+                if span.get("links"):
+                    item["links"] = [
+                        {"traceId": _otlp_trace_id(ln["traceId"]),
+                         "spanId": ln["spanId"]}
+                        for ln in span["links"]
+                    ]
+                spans_out.append(item)
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": "log_parser_tpu"},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "log_parser_tpu.obs.spans"},
+                    "spans": spans_out,
+                }],
+            }],
+        }
+
+    def dump(self, path: str) -> str:
+        """Write the OTLP document to ``path`` (tmp + rename so a
+        crashed dump never leaves a torn file). Returns the path."""
+        doc = self.export_otlp()
+        tmp = f"{path}.tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, separators=(",", ":"))
+        os.replace(tmp, path)
+        return path

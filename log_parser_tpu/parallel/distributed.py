@@ -205,11 +205,16 @@ class DistributedShardedEngine(ShardedEngine):
 
     # ----------------------------------------------------- bounded dispatch
 
-    def _dispatch_broadcast(self, payload: bytes, label: str = "broadcast") -> None:
+    def _dispatch_broadcast(
+        self, payload: bytes, label: str = "broadcast",
+        trace_id: str | None = None,
+    ) -> None:
         """One bounded, retried coordinator→follower broadcast. The fault
         sites and the cancellation check both sit BEFORE
         ``enter_collective``, so an abandoned (hung) attempt can never
-        emit a stale broadcast after its deadline."""
+        emit a stale broadcast after its deadline. When ``trace_id`` is
+        given the dispatch stages a ``broadcast`` child span on that
+        trace, so mesh fan-out attributes to its originating request."""
 
         def attempt(ctx):
             faults.fire("follower")  # conlint: contained-by-caller (dispatch_with_retry)
@@ -217,21 +222,33 @@ class DistributedShardedEngine(ShardedEngine):
             ctx.enter_collective()
             transport().broadcast(payload)
 
+        recorder = None
+        if trace_id is not None:
+            spans = self.obs.spans
+
+            def recorder(duration_s, attrs):
+                spans.annotate(trace_id, "broadcast", duration_s, attrs=attrs)
+
         dispatch_with_retry(
-            attempt, self.retry_policy, self.mesh_health, label=label
+            attempt, self.retry_policy, self.mesh_health, label=label,
+            recorder=recorder,
         )
 
     # ------------------------------------------------------------- analyze
 
-    def analyze(self, data: PodFailureData):
+    def analyze(self, data: PodFailureData, request_id: str | None = None):
         if self._is_multiprocess() and self._is_coordinator():
             health = self.mesh_health
             if not health.degraded:
+                # the trace id rides the broadcast payload so follower-side
+                # work (logs, frames) can attribute to the originating
+                # request; followers tolerate the extra key
                 payload = json.dumps(
-                    {"pod": data.pod, "logs": data.logs, "events": data.events}
+                    {"pod": data.pod, "logs": data.logs,
+                     "events": data.events, "rid": request_id}
                 ).encode("utf-8")
                 try:
-                    self._dispatch_broadcast(payload)
+                    self._dispatch_broadcast(payload, trace_id=request_id)
                 except MeshUnavailable as exc:
                     # the retry budget (or a wedge) already updated health;
                     # make the flip explicit even below the dead_after
@@ -240,9 +257,9 @@ class DistributedShardedEngine(ShardedEngine):
                     log.error("degrading to local serving: %s", exc)
             if health.degraded:
                 return self._analyze_degraded(data)
-        return super().analyze(data)
+        return super().analyze(data, request_id=request_id)
 
-    def analyze_pipelined(self, data: PodFailureData):
+    def analyze_pipelined(self, data: PodFailureData, request_id: str | None = None):
         """Multi-process requests cannot pipeline: each request is a
         broadcast + lockstep SPMD dispatch on every process, so two
         concurrent prepare phases would interleave their broadcasts and
@@ -256,8 +273,8 @@ class DistributedShardedEngine(ShardedEngine):
         if self._is_multiprocess():
             with self._request_scope():
                 with self.state_lock:
-                    return self.analyze(data)
-        return super().analyze_pipelined(data)
+                    return self.analyze(data, request_id=request_id)
+        return super().analyze_pipelined(data, request_id=request_id)
 
     # ----------------------------------------------------- degrade-to-local
 
@@ -292,7 +309,7 @@ class DistributedShardedEngine(ShardedEngine):
                 )
         return self._local_step_cache
 
-    def _run_device(self, enc, n_lines: int, om, ov):
+    def _run_device(self, enc, n_lines: int, om, ov, trace=None):
         # batch rows are padded to a multiple of the GLOBAL mesh size
         # (_corpus_min_rows), which the local device count divides — the
         # local shard_map sees the same shapes, just fewer shards
@@ -310,7 +327,7 @@ class DistributedShardedEngine(ShardedEngine):
                 om = np.zeros((B, C), dtype=bool)
                 ov = np.zeros((B, C), dtype=bool)
             return step(enc.u8, enc.lengths, om, ov, n_lines, k_hint=self._k_hint)
-        return super()._run_device(enc, n_lines, om, ov)
+        return super()._run_device(enc, n_lines, om, ov, trace=trace)
 
     def _analyze_degraded(self, data: PodFailureData):
         """Serve one request without the followers: local SPMD step when

@@ -157,7 +157,7 @@ class PatternShardedEngine(AnalysisEngine):
         take = np.asarray(cols)
         return np.ascontiguousarray(om[:, take]), np.ascontiguousarray(ov[:, take])
 
-    def _run_device(self, enc, n_lines: int, om, ov):
+    def _run_device(self, enc, n_lines: int, om, ov, trace=None):
         """Fan every block out asynchronously — one fused program per
         device — and only then start the blocking reads, so device work
         overlaps (wall-clock ≈ slowest block, not the sum). Blocks whose

@@ -193,19 +193,40 @@ def dispatch_with_retry(
     health: "MeshHealth | None" = None,
     label: str = "broadcast",
     sleep=time.sleep,
+    recorder=None,
 ):
     """Bounded attempts of ``fn(ctx)`` with backoff between them. Retries
     ONLY pre-collective timeouts; an in-collective timeout wedges the mesh
     (see module docstring) and exceptions propagate unretried. Raises
-    :class:`MeshUnavailable` when the budget is spent."""
+    :class:`MeshUnavailable` when the budget is spent.
+
+    ``recorder`` (optional) is called once with ``(duration_s, attrs)``
+    after the dispatch resolves — the span hook that attributes mesh
+    work to its originating request trace (``broadcast`` spans,
+    obs/spans.py). Recorder failures never fail a dispatch."""
+    t0 = time.monotonic()
+
+    def _record(outcome: str, attempts: int) -> None:
+        if recorder is None:
+            return
+        try:
+            recorder(
+                time.monotonic() - t0,
+                {"label": label, "outcome": outcome, "attempts": attempts},
+            )
+        except Exception:  # pragma: no cover - observability is best-effort
+            log.exception("%s: dispatch recorder failed", label)
+
     last: BroadcastTimeout | None = None
+    attempts = 0
     for attempt in range(policy.retries + 1):
         if attempt:
             if health is not None:
                 health.record_retry()
             sleep(policy.delay_for(attempt))
+        attempts = attempt + 1
         try:
-            return bounded_call(fn, policy.timeout_s, label=label)
+            result = bounded_call(fn, policy.timeout_s, label=label)
         except BroadcastTimeout as exc:
             last = exc
             if health is not None:
@@ -218,6 +239,10 @@ def dispatch_with_retry(
             log.warning(
                 "%s: %s (attempt %d/%d)", label, exc, attempt + 1, policy.retries + 1
             )
+        else:
+            _record("ok", attempts)
+            return result
+    _record("exhausted", attempts)
     raise MeshUnavailable(f"{label}: retry budget exhausted: {last}") from last
 
 

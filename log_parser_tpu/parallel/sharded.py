@@ -366,7 +366,7 @@ class ShardedEngine(AnalysisEngine):
         # row padding must be divisible by the mesh size for shard_map
         return max(8, self.mesh.devices.size)
 
-    def _run_device(self, enc, n_lines: int, om, ov):
+    def _run_device(self, enc, n_lines: int, om, ov, trace=None):
         B = enc.u8.shape[0]
         C = self.bank.n_columns
         if om is None:  # the SPMD program's in_specs always take overrides

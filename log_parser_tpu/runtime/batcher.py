@@ -149,6 +149,11 @@ class MicroBatcher:
         self.bisects = 0
         self.bisect_aborts = 0
         self.bisect_isolated = 0
+        # the flush trace id dispatch spans attach to — scheduler-thread
+        # only (set around _resolve_records; bisection retries run on
+        # the same thread, so their dispatch spans land on the same
+        # flush trace)
+        self._active_flush: str | None = None
 
     # ---------------------------------------------------------------- API
 
@@ -217,7 +222,11 @@ class MicroBatcher:
         start = time.monotonic()
         trace = PhaseTrace()
         trace.route = "batched"
-        trace.request_id = request_id
+        # always a concrete id: the flush span links its member traces
+        # by this value, and a span-link must resolve even when the
+        # client sent no X-Request-Id (obs/spans.py mints link span ids
+        # deterministically from the trace id, so no lookup is needed)
+        trace.request_id = request_id or self.engine.obs.new_request_id()
         try:
             with trace.phase("ingest"):
                 faults.fire("ingest")
@@ -231,7 +240,8 @@ class MicroBatcher:
             with self.engine.state_lock:
                 result = self.engine._serve_fallback(
                     data, exc,
-                    request_id=request_id, start=start, route="batched",
+                    request_id=trace.request_id, start=start,
+                    route="batched",
                 )
             done = _Pending(data, start, trace, None, None, None, None, -1)
             done.result = result
@@ -321,7 +331,7 @@ class MicroBatcher:
                 else:
                     self.flush_wait += 1
             try:
-                self._flush(items)
+                self._flush(items, reason)
             except BaseException:  # pragma: no cover - must never kill the loop
                 import logging
 
@@ -332,12 +342,27 @@ class MicroBatcher:
 
     # --------------------------------------------------------------- flush
 
-    def _flush(self, items: list[_Pending]) -> None:
+    def _flush(self, items: list[_Pending], reason: str = "wait") -> None:
         engine = self.engine
+        spans = engine.obs.spans
+        # the flush is its own trace: it belongs to N request traces at
+        # once, so it LINKS every member request (and every member
+        # back-links it through trace.links) instead of parenting under
+        # any single one — the fan-in the flat trace ring cannot express
+        flush_id = engine.obs.new_request_id()
+        flush_t0 = time.monotonic()
         now = time.monotonic()
         for item in items:
-            item.trace.add("batch_wait", now - item.enqueued_at)
+            wait_s = now - item.enqueued_at
+            item.trace.add("batch_wait", wait_s)
+            item.trace.links.append(flush_id)
+            item.trace.span_attrs.update({"flush": flush_id})
+            spans.annotate(
+                item.trace.request_id, "enqueue", wait_s,
+                attrs={"flush": flush_id, "reason": reason},
+            )
         t0 = time.perf_counter()
+        self._active_flush = flush_id
         try:
             # chaos at the flush boundary: batcher_slow delays the whole
             # batch; batcher_raise fails it into per-request fallback
@@ -347,15 +372,21 @@ class MicroBatcher:
             # pre-device failure (injected batcher fault, stacking bug):
             # every request takes the per-request fallback decision
             resolved = [exc] * len(items)
+        finally:
+            self._active_flush = None
         dt = time.perf_counter() - t0
         for item in items:
             item.trace.add("device", dt)
+        demux_t0 = time.perf_counter()
+        demux_errs = 0
         # demux in enqueue order: the frequency evolution equals a serial
         # stream's (read-before-record per request, under state_lock).
         # ``resolved`` holds per-item device records OR the exception that
         # survived bisection for that row — failures stay per-request.
+        fallbacks = 0
         for item, recs in zip(items, resolved):
             if isinstance(recs, BaseException):
+                fallbacks += 1
                 # this row's (sub-)batch faulted: the engine's normal
                 # fallback/propagate decision, individually — a device
                 # error serves golden (and strikes quarantine), a logic
@@ -399,9 +430,31 @@ class MicroBatcher:
             except BaseException as exc:  # noqa: BLE001 - delivered to caller
                 with self._cv:
                     self.demux_errors += 1
+                demux_errs += 1
                 item.error = exc
             finally:
                 item.done.set()
+        spans.annotate(
+            flush_id, "demux", time.perf_counter() - demux_t0,
+            attrs={"requests": len(items), "errors": demux_errs,
+                   "fallbacks": fallbacks},
+        )
+        # commit the flush trace whole (force=True: flushes are rare
+        # relative to requests and are the one place fan-in causality
+        # lives — sampling must never drop them)
+        spans.end_trace(
+            flush_id,
+            duration_s=time.monotonic() - flush_t0,
+            tenant=engine.obs_tenant,
+            name="flush",
+            attrs={
+                "members": len(items),
+                "reason": reason,
+                "bucket": items[0].corpus.encoded.u8.shape[0],
+            },
+            links=[item.trace.request_id for item in items],
+            force=True,
+        )
 
     # ----------------------------------------------------------- bisection
 
@@ -554,10 +607,19 @@ class MicroBatcher:
                 faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
                 return engine._run_cube(res_u8, res_len, u)
 
+            t0 = time.perf_counter()
             try:
                 fresh = engine.watchdog.run(_device_step)[:u]
-            except Exception:
+            except Exception as exc:
+                self._dispatch_span(time.perf_counter() - t0, {
+                    "rows": pad, "width": T, "lines": u,
+                    "residual": True, "error": type(exc).__name__,
+                })
                 return None
+            self._dispatch_span(time.perf_counter() - t0, {
+                "rows": pad, "width": T, "lines": u, "residual": True,
+                "wasteRatio": round((pad - u) / pad, 4) if pad else 0.0,
+            })
             cache.note_residual(u, int(counts[miss_slots].sum()) - u)
             keep = [
                 j
@@ -627,10 +689,36 @@ class MicroBatcher:
                 lines, lens, nlin, om, ov, k_hint=engine._k_hint
             )
 
-        recs_list = engine.watchdog.run(_device_step)
-        engine._note_kernel_dispatch(B)
+        t0 = time.perf_counter()
+        try:
+            recs_list = engine.watchdog.run(_device_step)
+        except BaseException as exc:
+            # a faulted dispatch still records its span — carrying the
+            # fault site — before bisection splits the batch; each
+            # retried sub-batch lands as another dispatch span on the
+            # same flush trace
+            self._dispatch_span(time.perf_counter() - t0, {
+                "rows": B, "width": T, "batchSlots": R,
+                "dummySlots": R - len(items),
+                "error": type(exc).__name__,
+            })
+            raise
+        attrs = engine._note_kernel_dispatch(
+            B, width=T, batch_slots=R, dummy_slots=R - len(items)
+        ) or {"rows": B, "width": T}
+        self._dispatch_span(time.perf_counter() - t0, attrs)
         engine._k_hint = max(r.n_matches for r in recs_list)
         return recs_list[: len(items)]
+
+    def _dispatch_span(self, duration_s: float, attrs: dict) -> None:
+        """Stage one device-dispatch child span under the active flush
+        trace (no-op for unbatched callers — their dispatch attrs ride
+        the request trace via ``_run_device``/``_run_cube`` instead)."""
+        fid = self._active_flush
+        if fid is not None:
+            self.engine.obs.spans.annotate(
+                fid, "dispatch", duration_s, attrs=attrs
+            )
 
     # ------------------------------------------------------- observability
 

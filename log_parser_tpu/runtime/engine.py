@@ -494,6 +494,11 @@ class AnalysisEngine:
         self.fallback_count = 0
         # Pallas union-DFA kernel tier accounting (GET /trace/last)
         self.kernel_stats = KernelTierStats()
+        # XLA cost-analysis cache for device-utilization accounting:
+        # (rows, width) -> {"flops","bytes"} | None, filled by a
+        # background lowering so the serving path never stalls on it
+        self._cost_cache: dict[tuple, dict | None] = {}
+        self._cost_lock = threading.Lock()
         # ... and how many were ROUTED there deliberately by admission
         # pressure (serve/admission.py ladder rung 2) — a separate counter,
         # because pressure routing is policy, not failure
@@ -874,42 +879,143 @@ class AnalysisEngine:
     def _corpus_min_rows(self) -> int:
         return 8
 
-    def _note_kernel_dispatch(self, batch_rows: int) -> None:
-        """Kernel-tier accounting for one device dispatch: did the union
-        groups ride the Pallas kernel for this cube batch size? A fault
-        fallback flips the matchers' reason to "fault" at trace time, so
-        the batch lands in xlaBatches."""
+    def _note_kernel_dispatch(self, batch_rows: int, width: int | None = None,
+                              n_rows: int | None = None,
+                              batch_slots: int | None = None,
+                              dummy_slots: int | None = None) -> dict | None:
+        """Kernel-tier + device-utilization accounting for one device
+        dispatch: did the union groups ride the Pallas kernel for this
+        cube batch size, and what did the dispatch cost (padded rows,
+        dummy-slot waste, transition-plane bytes, cost-analysis FLOPs) —
+        folded into the per-tenant ``logparser_device_*`` families so
+        roofline math is a scrape, not a bench run. A fault fallback
+        flips the matchers' reason to "fault" at trace time, so the
+        batch lands in xlaBatches. Returns the dispatch attributes the
+        span store records (``dispatch`` span vocabulary, obs/spans.py),
+        or None pre-boot."""
         m = self._matchers
         if m is None:
-            return
+            return None
         enabled = m.multidfa_use_pallas
         active = (
             enabled
             and m.multidfa_pallas_reason not in ("fault", "no_tile")
             and m.dfa_kernel_active(batch_rows)
         )
+        geometry = m.dfa_kernel_geometry
         self.kernel_stats.note(
             batch_rows,
             active,
             enabled,
             m.multidfa_pallas_reason,
-            m.dfa_kernel_geometry,
+            geometry,
         )
+        tier = "kernel" if active else "xla"
+        attrs: dict = {"tier": tier, "rows": batch_rows,
+                       "kernelReason": m.multidfa_pallas_reason}
+        if width is not None:
+            attrs["width"] = width
+        slots = batch_slots or 1
+        dummies = dummy_slots or 0
+        padded_rows = batch_rows * slots
+        dummy_rows = batch_rows * dummies
+        if batch_slots is not None:
+            attrs["batchSlots"] = slots
+            attrs["dummySlots"] = dummies
+            waste = dummies / slots if slots else 0.0
+        elif n_rows is not None and batch_rows:
+            # unbatched: the waste is the row padding past the real lines
+            waste = (batch_rows - n_rows) / batch_rows
+        else:
+            waste = None
+        if n_rows is not None:
+            attrs["lines"] = n_rows
+        if waste is not None:
+            attrs["wasteRatio"] = round(waste, 4)
+        if geometry:
+            if geometry.get("planeBytes") is not None:
+                attrs["planeBytes"] = geometry["planeBytes"]
+            if geometry.get("vmemPerStep") is not None:
+                attrs["vmemPerStep"] = geometry["vmemPerStep"]
+        cost = self._dispatch_cost(batch_rows, width) if width else None
+        flops = hbm = None
+        if cost:
+            flops = cost.get("flops")
+            hbm = cost.get("bytes")
+            if flops:
+                attrs["flops"] = flops
+            if hbm:
+                attrs["hbmBytes"] = hbm
+        self.obs.note_dispatch(
+            self.obs_tenant, tier, padded_rows=padded_rows,
+            dummy_rows=dummy_rows, waste=waste, flops=flops, hbm_bytes=hbm,
+        )
+        return attrs
 
-    def _run_device(self, enc, n_lines: int, om, ov):
+    def _dispatch_cost(self, rows: int, width: int) -> dict | None:
+        """``jax.jit(...).lower().cost_analysis()`` FLOPs/bytes for the
+        cube step at one (rows, width) shape — computed ONCE per shape
+        on a background thread (lowering costs hundreds of ms; the
+        serving path must never pay it), then folded into every later
+        dispatch of that shape. None while pending or when the backend
+        exposes no cost model."""
+        key = (int(rows), int(width))
+        with self._cost_lock:
+            if key in self._cost_cache:
+                return self._cost_cache[key]
+            self._cost_cache[key] = None  # pending marker
+
+        def _lower():
+            cost = None
+            try:
+                import jax.numpy as jnp
+
+                lines = jnp.zeros(key, dtype=jnp.uint8)
+                lens = jnp.zeros((key[0],), dtype=jnp.int32)
+                n = jnp.asarray(key[0], dtype=jnp.int32)
+                ca = self.fused._jit_cube_plain.lower(
+                    lines, lens, n
+                ).cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                cost = {
+                    "flops": float(ca.get("flops", 0.0) or 0.0),
+                    "bytes": float(ca.get("bytes accessed", 0.0) or 0.0),
+                }
+            except Exception:
+                cost = None
+            with self._cost_lock:
+                self._cost_cache[key] = cost
+
+        threading.Thread(
+            target=_lower, name="dispatch-cost", daemon=True
+        ).start()
+        return None
+
+    def _run_device(self, enc, n_lines: int, om, ov, trace=None):
         out = self.fused.run(
             enc.u8, enc.lengths, n_lines, om, ov, k_hint=self._k_hint
         )
-        self._note_kernel_dispatch(enc.u8.shape[0])
+        attrs = self._note_kernel_dispatch(
+            enc.u8.shape[0], width=enc.u8.shape[1], n_rows=n_lines
+        )
+        if trace is not None and attrs:
+            trace.span_attrs.update(attrs)
         return out
 
-    def _run_cube(self, lines_u8, lengths, n_rows: int) -> np.ndarray:
+    def _run_cube(self, lines_u8, lengths, n_rows: int,
+                  trace=None) -> np.ndarray:
         """Cube-only device program for the line-cache residual batch:
         pre-override match bits for ``n_rows`` independent lines (no
         extraction — that replays on the host from cached + fresh rows
         together, runtime/linecache.py)."""
         out = self.fused.cube_rows(lines_u8, lengths, n_rows)
-        self._note_kernel_dispatch(lines_u8.shape[0])
+        attrs = self._note_kernel_dispatch(
+            lines_u8.shape[0], width=lines_u8.shape[1], n_rows=n_rows
+        )
+        if trace is not None and attrs:
+            attrs = {**attrs, "residual": True}
+            trace.span_attrs.update(attrs)
         return out
 
     # ------------------------------------------------------- golden fallback
@@ -1430,7 +1536,7 @@ class AnalysisEngine:
             # match= spec can poison exactly one request
             faults.fire("quarantine", key=data.logs or "")  # conlint: contained-by-caller (watchdog.run)
             faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
-            return self._run_device(enc, corpus.n_lines, om, ov)
+            return self._run_device(enc, corpus.n_lines, om, ov, trace=trace)
 
         with trace.phase("device"):
             recs = self.watchdog.run(_device_step)
@@ -1520,7 +1626,7 @@ class AnalysisEngine:
                 # fires (and strikes) exactly as before
                 faults.fire("quarantine", key=data.logs or "")  # conlint: contained-by-caller (watchdog.run)
                 faults.fire("device")  # conlint: contained-by-caller (watchdog.run)
-                return self._run_cube(res_u8, res_len, u)
+                return self._run_cube(res_u8, res_len, u, trace=trace)
 
             with trace.phase("device"):
                 fresh = self.watchdog.run(_device_step)[:u]

@@ -162,6 +162,7 @@ class StreamSession:
         self.closed = False
         self.kill_reason: str | None = None
         self._seq = 0
+        self._chunks = 0  # fed chunks, for the session span
         # (line_idx, pattern_id) -> last reported score, for events that
         # crossed the emit threshold: the monotone-refinement ledger
         self._ledger: dict[tuple[int, str], float] = {}
@@ -224,12 +225,48 @@ class StreamSession:
                 return
             self.closed = True
             self.kill_reason = reason
+        self._commit_session_span(reason)
         if self.manager is not None:
             self.manager._discard(self, reason)
 
     def _touch(self) -> None:
         self.last_active = (
             self.manager.clock() if self.manager else time.monotonic()
+        )
+
+    # ----------------------------------------------------------- span hooks
+
+    def _note_chunk_span(self, t0: float, n_bytes: int, n_frames: int,
+                         error: str | None = None) -> None:
+        """Stage one per-chunk child span under the session's trace
+        (trace id == session id, so mesh/demux work keyed by the session
+        attributes here too)."""
+        attrs = {"bytes": n_bytes, "frames": n_frames, "mode": self.mode}
+        if error:
+            attrs["error"] = error
+        self.engine.obs.spans.annotate(
+            self.session_id, "chunk", time.perf_counter() - t0, attrs=attrs
+        )
+
+    def _commit_session_span(self, outcome: str) -> None:
+        """Commit the session's long-lived span; the chunk/rebase
+        children staged under the session id attach here. force=True:
+        sessions are rare relative to requests and the only place
+        per-chunk causality lives — sampling must never drop them."""
+        eng = self.engine
+        eng.obs.spans.end_trace(
+            self.session_id,
+            duration_s=time.monotonic() - self._start,
+            tenant=eng.obs_tenant,
+            name="session",
+            attrs={
+                "outcome": outcome,
+                "chunks": self._chunks,
+                "frames": self._seq,
+                "lines": len(self._lines),
+                "mode": self.mode,
+            },
+            force=True,
         )
 
     # --------------------------------------------------------------- feeding
@@ -246,17 +283,25 @@ class StreamSession:
                     )
                 ]
             self._touch()
+            t0 = time.perf_counter()
             try:
                 with self.engine._request_scope():
-                    return self._feed_in_scope(bytes(chunk))
+                    frames = self._feed_in_scope(bytes(chunk))
+                self._chunks += 1
+                self._note_chunk_span(t0, len(chunk), len(frames))
+                return frames
             except StreamError as err:
                 frame = self._error_frame(err)
+                # stage the chunk span BEFORE kill commits the session
+                # trace, so the fatal chunk attaches to the tree
+                self._note_chunk_span(t0, len(chunk), 1, error=err.reason)
                 self.kill(err.reason)
                 return [frame]
             except Exception as exc:  # wedged sessions are forbidden
                 frame = self._frame(
                     "error", reason="internal", message=repr(exc)
                 )
+                self._note_chunk_span(t0, len(chunk), 1, error="internal")
                 self.kill("internal")
                 return [frame]
 
@@ -504,6 +549,7 @@ class StreamSession:
         itself already completed, this is the re-base half of the
         drain-or-rebase contract."""
         eng = self.engine
+        t0 = time.perf_counter()
         self._epoch = eng.reload_epoch
         self._carry = eng.fused.host_carry()
         if self._carry is not None:
@@ -535,6 +581,11 @@ class StreamSession:
                         )
                     )
                 self._tail_fed = max(target, 0)
+        eng.obs.spans.annotate(
+            self.session_id, "rebase", time.perf_counter() - t0,
+            attrs={"epoch": self._epoch, "lines": len(self._lines),
+                   "mode": self.mode},
+        )
         if self.manager is not None:
             self.manager._note_rebase()
 
@@ -560,6 +611,7 @@ class StreamSession:
                     frames = self._close_in_scope()
                 self.closed = True
                 self.kill_reason = None
+                self._commit_session_span("closed")
                 if self.manager is not None:
                     self.manager._discard(self, "closed")
                 return frames

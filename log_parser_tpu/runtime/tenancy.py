@@ -458,6 +458,7 @@ class TenantRegistry:
                 f"tenant {tenant_id!r} has no pattern sets in {lib_dir!r}", 404
             )
         t0 = self.clock()
+        wt0 = time.monotonic()
         eng = AnalysisEngine(
             sets, self.default_engine.config, clock=self.clock
         )
@@ -485,6 +486,22 @@ class TenantRegistry:
             tenant_id, eng.bank.n_patterns, ctx.bank_bytes / 2**20,
             self.clock() - t0,
         )
+        if primary_obs is not None:
+            # lifecycle spans are rare and force-committed; the trace id
+            # is deterministic per tenant so rebuild-after-evict shows as
+            # repeated tenant_build/tenant_evict trees for one id
+            primary_obs.spans.end_trace(
+                f"tenant:{tenant_id}",
+                duration_s=time.monotonic() - wt0,
+                tenant=tenant_id,
+                name="tenant_build",
+                attrs={
+                    "patterns": eng.bank.n_patterns,
+                    "bankBytes": ctx.bank_bytes,
+                    "rebuild": tenant_id in self._evicted_ids,
+                },
+                force=True,
+            )
         return ctx
 
     # ----------------------------------------------------------- residency
@@ -527,7 +544,19 @@ class TenantRegistry:
                 "rebuilds from the library snapshot",
                 victim, ctx.bank_bytes / 2**20,
             )
+            t0 = time.monotonic()
             ctx.close()
+            obs = getattr(self.default_engine, "obs", None)
+            if obs is not None:
+                obs.spans.end_trace(
+                    f"tenant:{victim}",
+                    duration_s=time.monotonic() - t0,
+                    tenant=victim,
+                    name="tenant_evict",
+                    attrs={"bankBytes": ctx.bank_bytes,
+                           "residentBytes": self._resident_bytes()},
+                    force=True,
+                )
 
     # -------------------------------------------------------------- admin
 

@@ -196,6 +196,18 @@ def main(argv: list[str] | None = None) -> int:
         "LOG_PARSER_TPU_TRACE_SLOW_MS)",
     )
     parser.add_argument(
+        "--trace-sample", type=float, default=None, metavar="FRACTION",
+        help="head-sampling rate for the causal span store behind "
+        "GET /trace/spans: deterministic on the trace id; slow requests "
+        "(--trace-slow-ms) and flush/session/tenancy spans are always "
+        "kept (default 1.0; LOG_PARSER_TPU_TRACE_SAMPLE)",
+    )
+    parser.add_argument(
+        "--trace-spans", type=int, default=None, metavar="N",
+        help="capacity of the bounded causal span store "
+        "(default 256; LOG_PARSER_TPU_TRACE_SPANS)",
+    )
+    parser.add_argument(
         "--slo-p99-ms", type=float, default=None, metavar="MS",
         help="latency objective: p99 of served requests should stay "
         "under this; burn-rate over the multi-window accounting flips "
@@ -320,6 +332,8 @@ def main(argv: list[str] | None = None) -> int:
         (args.shadow_rate, "LOG_PARSER_TPU_SHADOW_RATE"),
         (args.trace_ring, "LOG_PARSER_TPU_TRACE_RING"),
         (args.trace_slow_ms, "LOG_PARSER_TPU_TRACE_SLOW_MS"),
+        (args.trace_sample, "LOG_PARSER_TPU_TRACE_SAMPLE"),
+        (args.trace_spans, "LOG_PARSER_TPU_TRACE_SPANS"),
         (args.slo_p99_ms, "LOG_PARSER_TPU_SLO_P99_MS"),
         (args.slo_availability, "LOG_PARSER_TPU_SLO_AVAILABILITY"),
         (args.faults, "LOG_PARSER_TPU_FAULTS"),
@@ -491,6 +505,9 @@ def main(argv: list[str] | None = None) -> int:
         # on-demand device profiling (POST /debug/profile) captures into a
         # state-dir subdirectory; without --state-dir the route answers 503
         engine.obs.profiler.configure(os.path.join(state_dir, "profiles"))
+        # shutdown writes the span store as OTLP/JSON here, so the last
+        # window of causal trees survives the process
+        engine.obs.span_dump_path = os.path.join(state_dir, "spans.otlp.json")
 
     # template miner: background consumer of the line-cache miss stream
     # (log_parser_tpu/mining/); per-tenant miners are wired below in
@@ -708,6 +725,12 @@ def main(argv: list[str] | None = None) -> int:
             # shutdown must never need replay on the next boot
             journal.snapshot_now()
             journal.close()
+        if engine.obs.span_dump_path:
+            try:
+                engine.obs.spans.dump(engine.obs.span_dump_path)
+                log.info("Span store dumped to %s", engine.obs.span_dump_path)
+            except OSError:
+                log.exception("span dump failed")
         if args.coordinator:
             # under the analyze lock: a daemon handler thread may still be
             # mid-broadcast inside analyze(); interleaving the shutdown

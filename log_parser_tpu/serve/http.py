@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu import native
 from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.obs import SPANS
 from log_parser_tpu.obs.profiler import ProfilerBusy, ProfilerUnavailable
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.utils import xlacache
@@ -478,6 +479,9 @@ class _Handler(BaseHTTPRequestHandler):
             payload["admission"] = self.server.admission.stats()
             # trace-ring occupancy (GET /trace/recent reads the entries)
             payload["traceRing"] = self.server.obs.ring.stats()
+            # causal span store occupancy (GET /trace/spans reads the
+            # trees; docs/OPS.md "Span tracing & utilization accounting")
+            payload["spans"] = self.server.obs.spans.stats()
             batcher = getattr(self.server.engine, "batcher", None)
             if batcher is not None:
                 # queue depth, batch sizes, flush reasons (docs/OPS.md
@@ -571,6 +575,22 @@ class _Handler(BaseHTTPRequestHandler):
                 "requests": ring.recent(n),
                 "slow": ring.slow_recent(n),
                 "ring": ring.stats(),
+            }).encode())
+        if self.path.startswith("/trace/spans"):
+            # self-contained causal trees: request -> flush(link) ->
+            # dispatch -> finalize, plus session/tenancy lifecycles
+            # (docs/OPS.md "Span tracing & utilization accounting")
+            query = urllib.parse.urlparse(self.path).query
+            params = urllib.parse.parse_qs(query)
+            try:
+                n = int(params.get("n", ["50"])[0])
+            except ValueError:
+                return self._send_json(400, b'{"error":"n must be an integer"}')
+            spans = self.server.obs.spans
+            return self._send_json(200, json.dumps({
+                "traces": spans.traces(n),
+                "store": spans.stats(),
+                "vocabulary": sorted(SPANS),
             }).encode())
         if self.path == "/debug/factors":
             fin = self.server.engine.last_finalized
@@ -794,6 +814,12 @@ class _Handler(BaseHTTPRequestHandler):
             # worth coming back. A futile shed (413 `tenant burst` — the
             # request exceeds the bucket's whole capacity) carries NO
             # Retry-After: the same request can never be admitted.
+            # the staged admission child attaches when reply()'s
+            # note_request commits this shed request's trace
+            obs.spans.annotate(
+                rid, "admission", time.monotonic() - arrival,
+                attrs={"verdict": exc.reason, "tenant": tenant},
+            )
             route = "admission"
             return reply(
                 exc.status,
@@ -805,6 +831,10 @@ class _Handler(BaseHTTPRequestHandler):
                     else None
                 ),
             )
+        obs.spans.annotate(
+            rid, "admission", time.monotonic() - arrival,
+            attrs={"verdict": route, "tenant": tenant},
+        )
         try:
             log.info("Received analysis request for pod: %s", data.pod_name)
             try:

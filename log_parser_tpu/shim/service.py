@@ -141,9 +141,27 @@ class LogParserService:
         # refines it exactly as on the HTTP path
         batcher = getattr(engine, "batcher", None)
         n_lines = (req.logs.count("\n") + 1) if req.logs else 0
-        route = self.admission.acquire(
-            batchable=batcher is not None, tenant=tctx.quota, lines=n_lines
-        )
+        obs = getattr(engine, "obs", None)
+        arrival = time.monotonic()
+        try:
+            route = self.admission.acquire(
+                batchable=batcher is not None, tenant=tctx.quota,
+                lines=n_lines,
+            )
+        except AdmissionRejected as exc:
+            # the staged admission child attaches when parse()'s
+            # note_request commits the shed request's trace
+            if obs is not None and request_id:
+                obs.spans.annotate(
+                    request_id, "admission", time.monotonic() - arrival,
+                    attrs={"verdict": exc.reason, "tenant": tctx.tenant_id},
+                )
+            raise
+        if obs is not None and request_id:
+            obs.spans.annotate(
+                request_id, "admission", time.monotonic() - arrival,
+                attrs={"verdict": route, "tenant": tctx.tenant_id},
+            )
         if holder is not None:
             holder["route"] = (
                 "host"

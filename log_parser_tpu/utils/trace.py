@@ -38,6 +38,14 @@ class PhaseTrace:
         # before any cross-thread handoff — no lock needed.
         self.request_id: str | None = None
         self.route: str = "device"
+        # span-store carriers (obs/spans.py): the batcher's scheduler
+        # thread appends the flush back-link and dispatch attributes
+        # here; Obs.note_served folds them into the committed request
+        # span. list.append / dict.update are single-bytecode atomic
+        # and the reader runs strictly after demux hands the request
+        # back, so no lock is needed.
+        self.links: list = []
+        self.span_attrs: dict = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):

@@ -1,0 +1,419 @@
+"""Causal span store (obs/spans.py): vocabulary, deterministic
+sampling, staging (no-orphan) invariants, the batched flush tree with
+links both ways, the stream session span across a hot-reload re-base,
+duration reconciliation against PhaseTrace (span trees, the trace ring
+and the phase histograms must never disagree), and the
+``GET /trace/spans`` HTTP surface."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.obs.spans import SPANS, SpanStore, _span_id
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.stream import StreamManager
+from log_parser_tpu.serve import make_server
+
+from helpers import make_pattern, make_pattern_set
+
+
+def _engine() -> AnalysisEngine:
+    patterns = [
+        make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                     severity="CRITICAL", context=(1, 1)),
+        make_pattern("err", regex=r"\bERROR\b", confidence=0.5,
+                     severity="LOW"),
+    ]
+    return AnalysisEngine(
+        [make_pattern_set(patterns, "lib")], ScoringConfig()
+    )
+
+
+LOGS = "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after"
+
+
+def _data() -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "web-1"}}, logs=LOGS)
+
+
+def _wait(pred, timeout: float = 15.0):
+    """Poll ``pred`` (flush/session traces commit on scheduler threads,
+    a beat after the request responses return)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+    raise AssertionError("span predicate never held")
+
+
+def _names(trace: dict) -> list[str]:
+    return [s["name"] for s in trace["spans"]]
+
+
+# -------------------------------------------------------------- store
+
+
+class TestSpanStore:
+    def test_unknown_span_name_rejected(self):
+        store = SpanStore()
+        with pytest.raises(ValueError):
+            store.annotate("rid-1", "warp", 0.001)
+        with pytest.raises(ValueError):
+            store.end_trace("rid-1", 0.001, name="warp")
+
+    def test_sampling_is_deterministic_on_the_trace_id(self):
+        # the same id gives the same verdict on every store instance, so
+        # a replayed request is reproducibly kept or reproducibly cheap
+        a, b = SpanStore(sample=0.37), SpanStore(sample=0.37)
+        ids = [f"rid-{i:03d}" for i in range(256)]
+        verdicts = [a.sampled(t) for t in ids]
+        assert verdicts == [b.sampled(t) for t in ids]
+        assert 0 < sum(verdicts) < len(ids)  # neither degenerate
+
+    def test_dropped_sample_pops_staged_children(self):
+        store = SpanStore(sample=0.0, slow_ms=1e9)
+        store.annotate("rid-1", "admission", 0.001)
+        assert store.stats()["staged"] == 1
+        assert store.end_trace("rid-1", 0.010) is False
+        st = store.stats()
+        assert st["staged"] == 0, "dropped sample orphaned a staged span"
+        assert st["committed"] == 0 and st["droppedTraces"] == 1
+        # forced traces (flush/session/tenancy) still commit at sample 0
+        store.annotate("fl-1", "dispatch", 0.002)
+        assert store.end_trace("fl-1", 0.010, name="flush", force=True)
+        st = store.stats()
+        assert st["committed"] == 1 and st["staged"] == 0
+
+    def test_slow_trace_always_kept(self):
+        store = SpanStore(sample=0.0, slow_ms=5.0)
+        assert store.end_trace("rid-slow", 0.006) is True
+        assert store.find("rid-slow")["slow"] is True
+
+    def test_committed_bound_and_staging_eviction(self):
+        store = SpanStore(capacity=2, staging_capacity=2)
+        for i in range(4):
+            store.end_trace(f"r{i}", 0.001, force=True)
+        st = store.stats()
+        assert st["retained"] == 2 and st["committed"] == 4
+        assert [t["traceId"] for t in store.traces()] == ["r3", "r2"]
+        # staging evicts the OLDEST trace whole, never single spans
+        for i in range(3):
+            store.annotate(f"s{i}", "chunk", 0.001)
+        st = store.stats()
+        assert st["staged"] == 2 and st["stagingEvicted"] == 1
+
+    def test_phase_children_reconcile_exactly(self):
+        # phase children are built from the PhaseTrace dict itself, so
+        # their summed durations equal the phase total by construction
+        store = SpanStore()
+        phases = {"ingest": 0.001205, "device": 0.044011, "finalize": 3.1e-4}
+        assert store.end_trace("rid-1", 0.0482, phases=phases, force=True)
+        tr = store.find("rid-1")
+        kids = [s for s in tr["spans"] if s["name"] == "phase"]
+        assert [k["attrs"]["phase"] for k in kids] == list(phases)
+        for kid, seconds in zip(kids, phases.values()):
+            assert kid["durationMs"] == round(seconds * 1e3, 6)
+        slack = abs(sum(k["durationMs"] for k in kids)
+                    - sum(phases.values()) * 1e3)
+        assert slack < 1e-6
+        # sequential offsets: each child starts where the previous ended
+        # (modulo float->nano rounding of the shared t0)
+        for prev, nxt in zip(kids, kids[1:]):
+            want = prev["startUnixNano"] + prev["durationMs"] * 1e6
+            assert abs(nxt["startUnixNano"] - want) <= 1_000
+
+    def test_links_resolve_without_lookup_and_export_otlp(self):
+        store = SpanStore()
+        # the member links the flush BEFORE the flush trace commits —
+        # root span ids are deterministic on the trace id, so a link
+        # mints without looking the other trace up
+        assert store.end_trace("rid-1", 0.01, links=["flush-1"], force=True)
+        store.annotate("flush-1", "dispatch", 0.002, attrs={"tier": "xla"})
+        assert store.end_trace("flush-1", 0.02, name="flush",
+                               links=["rid-1"], force=True)
+        rid = store.find("rid-1")
+        assert rid["spans"][0]["links"] == [
+            {"traceId": "flush-1", "spanId": _span_id("flush-1")}
+        ]
+        assert store.find("flush-1")["spans"][0]["links"][0]["spanId"] == (
+            rid["spans"][0]["spanId"]
+        )
+        doc = store.export_otlp()
+        spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert {s["name"] for s in spans} == {"request", "flush", "dispatch"}
+        for s in spans:
+            assert len(s["traceId"]) == 32  # OTLP ids, not wire ids
+            assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+            keys = {kv["key"] for kv in s["attributes"]}
+            assert "trace.wire_id" in keys and "tenant" in keys
+        linked = next(s for s in spans if s["name"] == "flush")
+        assert len(linked["links"][0]["traceId"]) == 32
+
+    def test_dump_writes_importable_json(self, tmp_path):
+        store = SpanStore()
+        store.end_trace("rid-1", 0.01, force=True)
+        path = store.dump(str(tmp_path / "spans.otlp.json"))
+        with open(path) as fh:
+            assert "resourceSpans" in json.load(fh)
+
+    def test_vocabulary_is_closed(self):
+        store = SpanStore()
+        for name in SPANS:
+            store.annotate(f"t-{name}", name, 0.001)  # every name records
+        assert store.stats()["staged"] == len(SPANS)
+
+
+# ------------------------------------------------- batched flush tree
+
+
+class TestBatchedFlushTree:
+    def test_flush_links_every_member_and_members_link_back(self):
+        engine = _engine()
+        engine.enable_batching(wait_ms=250.0, batch_max=4)
+        rids = ["rid-a", "rid-b", "rid-c"]
+        barrier = threading.Barrier(len(rids))
+        errs: list[BaseException] = []
+
+        def one(rid):
+            try:
+                barrier.wait()
+                engine.analyze_batched(_data(), request_id=rid)
+            except BaseException as exc:  # noqa: BLE001 - surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=one, args=(r,)) for r in rids]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert not errs, errs
+            spans = engine.obs.spans
+
+            def flush_of(n):
+                return next(
+                    (t for t in spans.traces() if t["name"] == "flush"
+                     and len(t["spans"][0].get("links") or []) >= n),
+                    None,
+                )
+
+            flush = _wait(lambda: flush_of(2))
+            linked = {ln["traceId"] for ln in flush["spans"][0]["links"]}
+            # one flush, >= 2 coalesced members, every member linked
+            assert len(linked & set(rids)) >= 2, (linked, rids)
+            assert flush["spans"][0]["attrs"]["members"] == len(linked)
+            assert "demux" in _names(flush), _names(flush)
+            assert "dispatch" in _names(flush), _names(flush)
+            for rid in linked & set(rids):
+                req = _wait(lambda r=rid: spans.find(r))
+                root = req["spans"][0]
+                assert root["name"] == "request"
+                assert root["attrs"]["route"] == "batched"
+                assert root["attrs"]["flush"] == flush["traceId"]
+                # ... and the back-link closes the cycle
+                assert any(
+                    ln["traceId"] == flush["traceId"]
+                    for ln in root.get("links") or []
+                ), root
+                names = _names(req)
+                assert "enqueue" in names and "phase" in names, names
+            assert spans.stats()["staged"] == 0
+        finally:
+            engine.batcher.close()
+
+
+# ------------------------------------------------ stream session span
+
+
+class TestStreamSessionSpan:
+    def test_session_span_survives_hot_reload_rebase(self):
+        engine = _engine()
+        mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+        sess = mgr.open()
+        sess.feed(b"java.lang.OutOfMemoryError: heap\n")
+        engine.apply_library(_engine())  # hot reload between chunks
+        sess.feed(b"INFO after\n")  # re-bases, then ingests
+        sess.close()
+        assert mgr.stats()["sessionsRebased"] == 1
+        tr = engine.obs.spans.find(sess.session_id)
+        assert tr is not None and tr["name"] == "session"
+        root = tr["spans"][0]
+        assert root["attrs"]["outcome"] == "closed"
+        assert root["attrs"]["chunks"] == 2
+        names = _names(tr)
+        assert names.count("chunk") == 2, names
+        rebase = next(s for s in tr["spans"] if s["name"] == "rebase")
+        assert rebase["attrs"]["epoch"] >= 1
+        assert engine.obs.spans.stats()["staged"] == 0
+
+    def test_killed_session_still_commits_its_tree(self):
+        engine = _engine()
+        mgr = StreamManager(engine, ttl_s=0, start_reaper=False)
+        sess = mgr.open()
+        sess.feed(b"INFO boot\n")
+        sess.kill("ttl")
+        tr = engine.obs.spans.find(sess.session_id)
+        assert tr is not None
+        assert tr["spans"][0]["attrs"]["outcome"] == "ttl"
+        assert "chunk" in _names(tr)
+
+
+# ------------------------------------------- sampling, engine-level
+
+
+class TestSamplingEndToEnd:
+    def test_sample_zero_drops_request_without_orphans(self):
+        engine = _engine()
+        engine.obs.spans.sample = 0.0
+        engine.obs.spans.slow_ms = 1e9  # slow path out of reach
+        engine.analyze_pipelined(_data(), request_id="rid-drop")
+        st = engine.obs.spans.stats()
+        assert engine.obs.spans.find("rid-drop") is None
+        assert st["droppedTraces"] >= 1 and st["staged"] == 0
+        # the ring still recorded it — sampling bounds span cost, not
+        # request accounting
+        assert any(
+            e["requestId"] == "rid-drop" for e in engine.obs.ring.recent(10)
+        )
+
+
+# ----------------------------------------------------- reconciliation
+
+
+class TestReconciliation:
+    def test_span_tree_agrees_with_trace_ring(self):
+        engine = _engine()
+        engine.analyze_pipelined(_data(), request_id="rid-recon")
+        entry = next(e for e in engine.obs.ring.recent(10)
+                     if e["requestId"] == "rid-recon")
+        tr = engine.obs.spans.find("rid-recon")
+        assert tr is not None
+        # both surfaces were built from the same clock delta and the
+        # same PhaseTrace dict inside note_served: <= 1 ms slack is the
+        # acceptance bar, equality-modulo-rounding is the reality
+        assert abs(tr["totalMs"] - entry["totalMs"]) <= 1.0
+        span_phases = {
+            s["attrs"]["phase"]: s["durationMs"]
+            for s in tr["spans"] if s["name"] == "phase"
+        }
+        assert set(span_phases) == set(entry["phasesMs"])
+        for name, ms in entry["phasesMs"].items():
+            assert abs(span_phases[name] - ms) <= 0.001, name
+        slack = abs(sum(span_phases.values())
+                    - sum(entry["phasesMs"].values()))
+        assert slack <= 1.0
+
+
+# ------------------------------------------------------- HTTP surface
+
+
+@pytest.fixture(scope="module")
+def spans_server():
+    engine = _engine()
+    engine.enable_batching(wait_ms=250.0, batch_max=4)
+    server = make_server(engine, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", engine
+    server.shutdown()
+    engine.batcher.close()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestHttpTraceSpans:
+    def test_batched_replay_yields_complete_causal_tree(self, spans_server):
+        url, engine = spans_server
+        rids = ["http-rid-1", "http-rid-2", "http-rid-3"]
+        barrier = threading.Barrier(len(rids))
+        statuses: dict[str, int] = {}
+
+        def one(rid):
+            barrier.wait()
+            statuses[rid], _, _ = _post(
+                url + "/parse",
+                {"pod": {"metadata": {"name": "web-1"}}, "logs": LOGS},
+                headers={"X-Request-Id": rid},
+            )
+
+        threads = [threading.Thread(target=one, args=(r,)) for r in rids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert statuses == {r: 200 for r in rids}, statuses
+
+        def tree():
+            _, body = _get(url + "/trace/spans?n=64")
+            flushes = [
+                t for t in body["traces"] if t["name"] == "flush"
+                and {ln["traceId"] for ln in t["spans"][0]["links"]}
+                & set(rids)
+            ]
+            complete = [
+                f for f in flushes
+                if "dispatch" in _names(f) and "demux" in _names(f)
+            ]
+            return (body, complete[0]) if complete else None
+
+        body, flush = _wait(lambda: tree())
+        # the acceptance tree: request -> flush(link) -> dispatch ->
+        # finalize, readable off one GET
+        member = next(
+            ln["traceId"] for ln in flush["spans"][0]["links"]
+            if ln["traceId"] in rids
+        )
+        req = next(t for t in body["traces"] if t["traceId"] == member)
+        names = _names(req)
+        assert "admission" in names and "enqueue" in names, names
+        assert any(
+            ln["traceId"] == flush["traceId"]
+            for ln in req["spans"][0].get("links") or []
+        )
+        # cross-surface reconciliation over HTTP: /trace/spans vs
+        # /trace/recent for the same request id, <= 1 ms slack
+        _, recent = _get(url + "/trace/recent?n=20")
+        entry = next(e for e in recent["requests"]
+                     if e["requestId"] == member)
+        assert abs(req["totalMs"] - entry["totalMs"]) <= 1.0
+        # the vocabulary rides the payload so a dashboard can label
+        # spans without importing the package
+        assert set(body["vocabulary"]) == set(SPANS)
+
+    def test_trace_spans_bad_n_is_400(self, spans_server):
+        url, _ = spans_server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url + "/trace/spans?n=bogus")
+        assert exc.value.code == 400
+
+    def test_trace_last_spans_block_matches_store(self, spans_server):
+        url, engine = spans_server
+        _, trace = _get(url + "/trace/last")
+        want = engine.obs.spans.stats()
+        got = trace["spans"]
+        # counters move between the two reads under concurrent tests;
+        # the shape and the bounds are the contract
+        assert sorted(got) == sorted(want)
+        assert got["capacity"] == want["capacity"]
+        assert got["sample"] == want["sample"]

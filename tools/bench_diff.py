@@ -1,0 +1,163 @@
+"""Diff two bench artifacts (``BENCH_r*.json`` / ``bench_results/*.json``)
+and render a per-metric verdict table.
+
+The refresh loop (tools/refresh_artifacts.sh) stamps one JSON artifact
+per bench stem; the PR ladder keeps one ``BENCH_r<NN>.json`` per round.
+Both carry the same envelope — ``{"n", "cmd", "rc", "parsed": {...}}`` —
+but different stems expose different metric blocks (the rr90 headline has
+``line_cache``/``boot_seconds``; the stream stem has ``ttfd_ms``; the
+earliest rounds have nothing but ``value``). This tool diffs whatever the
+TWO artifacts share and says nothing about the rest, so any OLD/NEW pair
+of the same stem compares cleanly:
+
+    python tools/bench_diff.py BENCH_r13.json BENCH_r14.json
+    python tools/bench_diff.py bench_results/config2_rr90_lc64_cpu.json \
+        /tmp/fresh.json --threshold 3 --json
+
+Direction is inferred per metric: ``*_per_sec`` and hit counters are
+higher-is-better; ``*_ms`` / ``*_seconds`` / miss counters are
+lower-is-better. A delta inside ``--threshold`` percent is ``ok``
+(within noise); outside it the row reads ``improved`` or ``regressed``.
+
+Exit code is 0 unless ``--strict`` is given, in which case any
+``regressed`` row exits 1 — the refresh script runs this advisorily
+(a slow machine is not a broken bench), CI may opt into --strict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# (dotted path under parsed, higher_is_better). Paths absent from either
+# artifact are skipped — the table only ever shows shared metrics.
+SCALAR_ROWS = (
+    ("value", None),  # direction inferred from parsed.metric
+    ("serial_lines_per_sec", True),
+    ("boot_seconds", False),
+    ("ttfd_over_blob_p50", False),
+    ("ttfd_misses", False),
+    ("line_cache.hits", True),
+    ("line_cache.misses", False),
+    ("line_cache.evictions", False),
+    ("line_cache.residentBytes", False),
+    ("compile_cache.compileHits", True),
+    ("compile_cache.compileMisses", False),
+)
+
+# lower-is-better name fragments, for parsed.metric and curve columns
+_LOWER_HINTS = ("ttfd", "_ms", "_seconds", "latency", "p50", "p99")
+
+
+def load_parsed(path: str) -> dict:
+    """Return the ``parsed`` block; tolerate a bare parsed-level dict so
+    a bench's raw stdout line diffs as well as the stamped envelope."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _dig(d: dict, dotted: str):
+    cur = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _verdict(old: float, new: float, higher_better: bool, threshold: float):
+    """(pct_delta, verdict) — pct is signed NEW-vs-OLD in the metric's own
+    direction (positive = better), so the table reads uniformly."""
+    if old == 0:
+        return (None, "ok" if new == 0 else "changed")
+    raw = (new - old) / abs(old) * 100.0
+    pct = raw if higher_better else -raw
+    if abs(pct) <= threshold:
+        return (pct, "ok")
+    return (pct, "improved" if pct > 0 else "regressed")
+
+
+def diff(old: dict, new: dict, threshold: float) -> list[dict]:
+    rows = []
+    for dotted, higher in SCALAR_ROWS:
+        a, b = _dig(old, dotted), _dig(new, dotted)
+        if a is None or b is None:
+            continue
+        if higher is None:
+            metric = str(new.get("metric") or old.get("metric") or "")
+            higher = not any(h in metric for h in _LOWER_HINTS)
+            dotted = f"value ({metric})" if metric else dotted
+        pct, verdict = _verdict(a, b, higher, threshold)
+        rows.append({"metric": dotted, "old": a, "new": b,
+                     "pct": pct, "verdict": verdict})
+    # ttfd_ms block (stream stem): percentile dict, lower is better
+    ot, nt = old.get("ttfd_ms"), new.get("ttfd_ms")
+    if isinstance(ot, dict) and isinstance(nt, dict):
+        for q in sorted(set(ot) & set(nt)):
+            if isinstance(ot[q], (int, float)) and isinstance(nt[q], (int, float)):
+                pct, verdict = _verdict(ot[q], nt[q], False, threshold)
+                rows.append({"metric": f"ttfd_ms.{q}", "old": ot[q],
+                             "new": nt[q], "pct": pct, "verdict": verdict})
+    # throughput curve: match rows on concurrency; unmatched rows are
+    # dropped (a curve re-shaped between rounds is not a regression)
+    oc = {r.get("concurrency"): r for r in old.get("throughput_curve") or []}
+    nc = {r.get("concurrency"): r for r in new.get("throughput_curve") or []}
+    for c in sorted(set(oc) & set(nc) - {None}):
+        for col, higher in (("lines_per_sec", True), ("p50_ms", False),
+                            ("p99_ms", False)):
+            a, b = oc[c].get(col), nc[c].get(col)
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+                pct, verdict = _verdict(a, b, higher, threshold)
+                rows.append({"metric": f"curve[c={c}].{col}", "old": a,
+                             "new": b, "pct": pct, "verdict": verdict})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench artifacts with a +/-threshold verdict")
+    ap.add_argument("old", help="baseline artifact (JSON)")
+    ap.add_argument("new", help="candidate artifact (JSON)")
+    ap.add_argument("--threshold", type=float, default=3.0, metavar="PCT",
+                    help="noise band in percent (default 3)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the row list as JSON instead of a table")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any row regressed (default: advisory)")
+    args = ap.parse_args(argv)
+
+    try:
+        old, new = load_parsed(args.old), load_parsed(args.new)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"bench_diff: cannot load artifacts: {exc}", file=sys.stderr)
+        return 2
+    rows = diff(old, new, args.threshold)
+    regressed = sum(1 for r in rows if r["verdict"] == "regressed")
+    improved = sum(1 for r in rows if r["verdict"] == "improved")
+    summary = {"rows": rows, "compared": len(rows), "regressed": regressed,
+               "improved": improved, "threshold_pct": args.threshold,
+               "old": args.old, "new": args.new}
+
+    if args.as_json:
+        print(json.dumps(summary, indent=2))
+    elif not rows:
+        print("bench_diff: no shared numeric metrics between the two "
+              "artifacts (different stems?)")
+    else:
+        w = max(len(r["metric"]) for r in rows)
+        print(f"{'metric':<{w}}  {'old':>14}  {'new':>14}  {'delta':>9}  verdict")
+        for r in rows:
+            pct = "n/a" if r["pct"] is None else f"{r['pct']:+8.2f}%"
+            print(f"{r['metric']:<{w}}  {r['old']:>14,.1f}  "
+                  f"{r['new']:>14,.1f}  {pct:>9}  {r['verdict']}")
+        print(f"-- {len(rows)} compared, {improved} improved, "
+              f"{regressed} regressed (threshold ±{args.threshold}%)")
+    return 1 if (args.strict and regressed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,8 +185,21 @@ miner"):
                         first, the candidate re-admits on a later pump
                         against the post-reload library.
 
+Spans group (``--group spans``; causal span tracing — docs/OPS.md "Span
+tracing & utilization accounting"):
+
+- ``spans-fault-site``   a device fault under micro-batching — the
+                        faulted dispatch records its span carrying the
+                        failure attr, the same flush trace still closes
+                        with its demux span, and flush/request traces
+                        keep linking each other both ways.
+- ``spans-sample-drop``  ``--trace-sample 0`` with the slow bar lifted
+                        out of reach — request traces are dropped,
+                        force-kept flush traces still commit, and the
+                        staging dict drains to zero (no orphans).
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|all]
                                    [--keep-logs]
 """
 
@@ -1614,6 +1627,115 @@ OBS_SCENARIOS = [
 ]
 
 
+# Spans group (``--group spans``; causal span tracing — docs/OPS.md
+# "Span tracing & utilization accounting"): a faulted device dispatch
+# records its span — carrying the fault site — on the flush trace
+# before bisection retries, and the sampling drop path never orphans a
+# staged child span while force-kept flush traces still commit.
+
+
+def _poll_spans(url: str, pred, timeout: float = 30.0) -> dict:
+    """Poll GET /trace/spans until ``pred(body)`` — the flush trace
+    commits on the scheduler thread a beat after the member responses
+    return, so assertions on it must wait it out."""
+    deadline = time.monotonic() + timeout
+    body = {}
+    while time.monotonic() < deadline:
+        status, body = get(url, "/trace/spans?n=64")
+        assert status == 200, status
+        if pred(body):
+            return body
+        time.sleep(0.25)
+    raise AssertionError(f"span predicate never held: {body}")
+
+
+def scenario_spans_fault_site(srv: Server):
+    post(srv.url)  # warm: one device call burns the fault's after=1
+    results = Burst(srv.url, 4).join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    assert codes == [200] * 4, codes  # bisection/golden absorbed the fault
+
+    def _faulted_flush_closed(body):
+        flushes = [t for t in body["traces"] if t["name"] == "flush"]
+        return any(
+            "error" in (s.get("attrs") or {})
+            for t in flushes for s in t["spans"] if s["name"] == "dispatch"
+        ) and all(
+            any(s["name"] == "demux" for s in t["spans"]) for t in flushes
+        )
+
+    body = _poll_spans(srv.url, _faulted_flush_closed)
+    flushes = [t for t in body["traces"] if t["name"] == "flush"]
+    # the faulted dispatch recorded its span with the failure attr, and
+    # the SAME flush trace still closed with its demux span — a fault is
+    # a recorded child of the tree, never a hole in it
+    faulted = [
+        t for t in flushes
+        if any(
+            "error" in (s.get("attrs") or {})
+            for s in t["spans"] if s["name"] == "dispatch"
+        )
+    ]
+    assert faulted, [t["name"] for t in body["traces"]]
+    assert any(s["name"] == "demux" for s in faulted[0]["spans"]), faulted[0]
+    # causality survives the fault: flush roots still link member request
+    # traces, and a member request back-links a flush trace
+    linked = {
+        ln["traceId"]
+        for t in flushes for ln in (t["spans"][0].get("links") or [])
+    }
+    assert linked, flushes
+    requests = [t for t in body["traces"] if t["name"] == "request"]
+    flush_ids = {t["traceId"] for t in flushes}
+    assert any(
+        ln["traceId"] in flush_ids
+        for t in requests for ln in (t["spans"][0].get("links") or [])
+    ), requests
+    assert body["store"]["staged"] == 0, body["store"]
+
+
+def scenario_spans_sample_drop(srv: Server):
+    post(srv.url)  # warm compile off the clock
+    results = Burst(srv.url, 4).join(timeout=120)
+    codes = sorted(s for s, _ in results)
+    assert codes == [200] * 4, codes
+    # flush traces are rare and force-kept: they commit at sample 0
+    body = _poll_spans(
+        srv.url, lambda b: any(t["name"] == "flush" for t in b["traces"])
+    )
+    names = [t["name"] for t in body["traces"]]
+    # ... while every request trace was head-sampled away (slow bar
+    # lifted out of reach so the always-on slow path cannot rescue them)
+    assert "request" not in names, names
+    store = body["store"]
+    assert store["droppedTraces"] >= 5, store
+    # the drop popped each request's staged enqueue/admission children
+    # with it — a dropped sample never orphans a staged span
+    assert store["staged"] == 0, store
+
+
+SPANS_SCENARIOS = [
+    (
+        "spans-fault-site",
+        # cache off: identical chaos payloads would be full line-cache
+        # hits after the warm post and the flush would never reach the
+        # faulted device dispatch
+        BATCHER_FLAGS + ["--line-cache-mb", "0"],
+        {
+            "LOG_PARSER_TPU_FAULTS": "device_raise@times=1@after=1",
+            "LOG_PARSER_TPU_FAULT_SEED": "42",
+        },
+        scenario_spans_fault_site,
+    ),
+    (
+        "spans-sample-drop",
+        BATCHER_FLAGS + ["--trace-sample", "0", "--trace-slow-ms", "60000"],
+        {},
+        scenario_spans_sample_drop,
+    ),
+]
+
+
 def _miner_engine(curated_regex: str, mode: str = "auto"):
     """In-process engine + miner for the standalone drills: one curated
     pattern, line cache on, worker NOT started (pump() is driven
@@ -1780,7 +1902,8 @@ def main(argv: list[str] | None = None) -> int:
         "--group",
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
-            "streaming", "distributed", "tenant", "miner", "obs", "all",
+            "streaming", "distributed", "tenant", "miner", "obs", "spans",
+            "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1813,6 +1936,8 @@ def main(argv: list[str] | None = None) -> int:
         single_server.extend(MINER_SCENARIOS)
     if args.group in ("obs", "all"):
         single_server.extend(OBS_SCENARIOS)
+    if args.group in ("spans", "all"):
+        single_server.extend(SPANS_SCENARIOS)
     if single_server:
         for name, flags, env, check in single_server:
             if args.only and name != args.only:

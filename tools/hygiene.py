@@ -58,7 +58,18 @@ Checks (all files tracked by git, minus excluded dirs):
      (``byte_classed``/``split``) under the production VMEM budget — a
      pattern or compiler change that regresses the verdict to
      ``table_too_large`` fails the gate, not a silent runtime fallback
-     (the union pack is disk-cached, so warm runs cost seconds).
+     (the union pack is disk-cached, so warm runs cost seconds);
+ 16. the observability vocabulary is pinned: every ``METRICS`` family
+     and every ``--trace-*``/``--slo-*`` serve flag has a
+     backtick-quoted docs/OPS.md row, and collector coverage holds in
+     both directions — every GET /trace/last payload block has a
+     ``TRACE_BLOCKS`` entry naming its covering registry families,
+     every entry names a block /trace/last still emits, and every
+     family it names exists in ``METRICS``;
+ 17. the causal-span vocabulary (``SPANS`` in obs/spans.py — the
+     ``GET /trace/spans`` / OTLP span-name contract) and the
+     ``logparser_device_*`` utilization families each have a
+     backtick-quoted docs/OPS.md row.
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -539,6 +550,91 @@ def check_kernel_admission(root: Path) -> list[str]:
     ]
 
 
+def _trace_blocks_of(path: Path) -> dict[str, tuple[str, ...]]:
+    """The ``TRACE_BLOCKS`` literal of obs/registry.py as a plain dict —
+    string keys mapped to their tuple-of-metric-family values, harvested
+    via ast (same no-import rule as ``_dict_keys_of``)."""
+    import ast
+
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return {}
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "TRACE_BLOCKS"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        out: dict[str, tuple[str, ...]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            fams = tuple(
+                e.value
+                for e in (v.elts if isinstance(v, (ast.Tuple, ast.List)) else [])
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+            out[k.value] = fams
+        return out
+    return {}
+
+
+def _trace_payload_keys(http_src: Path) -> list[str]:
+    """Every key of the GET /trace/last payload: the dict literal that
+    initializes ``payload`` plus every ``payload["..."] = ...``
+    assignment in serve/http.py."""
+    import ast
+
+    try:
+        tree = ast.parse(http_src.read_text())
+    except SyntaxError:
+        return []
+    keys: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id == "payload"
+                and isinstance(node.value, ast.Dict)
+            ):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        if k.value not in keys:
+                            keys.append(k.value)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "payload"
+                and isinstance(t.slice, ast.Constant)
+                and isinstance(t.slice.value, str)
+            ):
+                if t.slice.value not in keys:
+                    keys.append(t.slice.value)
+    # the IfExp form `payload = {...} if trace is None else {...}` hides
+    # its dicts one level down; harvest those too
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "payload" for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if (
+                            isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and k.value not in keys
+                        ):
+                            keys.append(k.value)
+    return keys
+
+
 def check_obs_vocab_pinned(root: Path) -> list[str]:
     """Check 16: the observability vocabulary must be pinned the way
     checks 9/12/14 pin their surfaces. Every metric name in the
@@ -546,15 +642,23 @@ def check_obs_vocab_pinned(root: Path) -> list[str]:
     and alert-rule contract — each needs a backtick-quoted row in
     docs/OPS.md so a rename shows up as a doc diff, not a silently
     broken scrape. The obs serve flags (``--trace-*`` / ``--slo-*``)
-    are held to the same backtick-row standard."""
+    are held to the same backtick-row standard. Collector coverage is
+    pinned in both directions: every GET /trace/last payload block must
+    have a ``TRACE_BLOCKS`` entry naming the registry families that
+    cover it (a trace block an alert rule cannot see is an incident
+    nobody is paged for), every ``TRACE_BLOCKS`` key must still exist
+    on /trace/last, and every family it names must be a ``METRICS``
+    entry."""
     registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
     serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    http_src = root / "log_parser_tpu" / "serve" / "http.py"
     ops_doc = root / "docs" / "OPS.md"
     if not registry_src.is_file():
         return []
     problems: list[str] = []
     ops_text = ops_doc.read_text() if ops_doc.is_file() else ""
-    for name in _dict_keys_of(registry_src, "METRICS"):
+    metrics = set(_dict_keys_of(registry_src, "METRICS"))
+    for name in sorted(metrics):
         if f"`{name}`" not in ops_text:
             problems.append(
                 f"{registry_src}: metric {name!r} has no backtick-quoted "
@@ -569,6 +673,70 @@ def check_obs_vocab_pinned(root: Path) -> list[str]:
                 problems.append(
                     f"{serve_src}: observability serve flag {flag} has no "
                     "backtick-quoted docs/OPS.md row"
+                )
+    blocks = _trace_blocks_of(registry_src)
+    if blocks and http_src.is_file():
+        payload_keys = _trace_payload_keys(http_src)
+        for key in payload_keys:
+            if key not in blocks:
+                problems.append(
+                    f"{http_src}: /trace/last block {key!r} has no "
+                    "TRACE_BLOCKS entry naming its covering registry "
+                    "families"
+                )
+        for key, fams in blocks.items():
+            if key not in payload_keys:
+                problems.append(
+                    f"{registry_src}: TRACE_BLOCKS entry {key!r} maps a "
+                    "block GET /trace/last no longer emits"
+                )
+            if not fams:
+                problems.append(
+                    f"{registry_src}: TRACE_BLOCKS entry {key!r} names no "
+                    "registry families"
+                )
+            for fam in fams:
+                if fam not in metrics:
+                    problems.append(
+                        f"{registry_src}: TRACE_BLOCKS entry {key!r} names "
+                        f"unknown registry family {fam!r}"
+                    )
+    return problems
+
+
+def check_span_vocab_pinned(root: Path) -> list[str]:
+    """Check 17: the causal-span vocabulary (``SPANS`` in obs/spans.py —
+    every span name ``GET /trace/spans`` and the OTLP dump can emit)
+    must each have a backtick-quoted docs/OPS.md row: an operator
+    walking a causal tree during an incident needs the lookup table.
+    The device-utilization families (``logparser_device_*``) are pinned
+    by name here as well — check 16 already demands a row for every
+    METRICS entry, but these carry the per-dispatch cost semantics the
+    span runbook leans on, so losing one must point at the span docs.
+    (The span serve flags ``--trace-sample``/``--trace-spans`` match
+    check 16's ``--trace-*`` pattern and are pinned there.)"""
+    spans_src = root / "log_parser_tpu" / "obs" / "spans.py"
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    ops_doc = root / "docs" / "OPS.md"
+    if not spans_src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    names = _dict_keys_of(spans_src, "SPANS")
+    if not names:
+        problems.append(f"{spans_src}: SPANS vocabulary is empty or unparsable")
+    for name in names:
+        if f"`{name}`" not in ops_text:
+            problems.append(
+                f"{spans_src}: span name {name!r} has no backtick-quoted "
+                "docs/OPS.md row"
+            )
+    if registry_src.is_file():
+        for fam in _dict_keys_of(registry_src, "METRICS"):
+            if fam.startswith("logparser_device_") and f"`{fam}`" not in ops_text:
+                problems.append(
+                    f"{registry_src}: device-utilization family {fam!r} has "
+                    "no backtick-quoted docs/OPS.md row"
                 )
     return problems
 
@@ -603,6 +771,7 @@ def main() -> int:
         problems.extend(check_miner_vocab_pinned(root))
         problems.extend(check_kernel_admission(root))
         problems.extend(check_obs_vocab_pinned(root))
+        problems.extend(check_span_vocab_pinned(root))
 
     for p in problems:
         print(p)

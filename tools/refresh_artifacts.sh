@@ -25,10 +25,22 @@ run() { # run <artifact-stem> <cmd...>
   out=$("$@" 2>"bench_results/${stem}.stderr.tmp"); rc=$?
   out=$(printf '%s\n' "$out" | tail -n 1)
   if [ "$rc" -eq 0 ] && [ -n "$out" ]; then
+    # keep the artifact this run replaces so bench_diff can report the
+    # round-over-round movement below
+    if [ -f "bench_results/${stem}.json" ]; then
+      cp -f "bench_results/${stem}.json" "bench_results/${stem}.prev.tmp"
+    fi
     printf '%s\n' "$out" > "bench_results/${stem}.json"
     mv -f "bench_results/${stem}.stderr.tmp" "bench_results/${stem}.stderr"
     rm -f "bench_results/${stem}.failed.json" "bench_results/${stem}.failed.stderr"
     echo "   -> $out" >&2
+    # advisory diff against the previous round's artifact: a slow machine
+    # is not a broken bench, so the verdict never fails the refresh
+    if [ -f "bench_results/${stem}.prev.tmp" ]; then
+      python tools/bench_diff.py "bench_results/${stem}.prev.tmp" \
+        "bench_results/${stem}.json" >&2 || true
+      rm -f "bench_results/${stem}.prev.tmp"
+    fi
   else
     mv -f "bench_results/${stem}.stderr.tmp" "bench_results/${stem}.failed.stderr"
     # a failed bench may still have printed the {"value": null}
