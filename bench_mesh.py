@@ -28,6 +28,9 @@ hold only N-1 banks (override with ``--tenant-budget-mb``), so the
 interleaved round-robin pays LRU evict + warm rebuild inline — metric
 ``tenant_fleet_lines_per_sec``, the churn-inclusive fleet figure an
 operator sees when the tenant set outgrows ``--tenant-budget-mb``.
+``--tenant-migrations K`` additionally live-migrates the first K tenants
+between two registries (runtime/migrate.py) inside every measured pass,
+folding migration churn into the same fleet figure.
 
 Prints exactly one JSON line like every bench:
     {"metric": "dp_mesh_lines_per_sec", "value": N, "unit": "lines/s",
@@ -66,6 +69,16 @@ BUDGET_MB = (
     float(sys.argv[sys.argv.index("--tenant-budget-mb") + 1])
     if "--tenant-budget-mb" in sys.argv
     else 0.0
+)
+# --tenant-migrations K: in the residency scenario, live-migrate the
+# first K tenants between two registries (runtime/migrate.py LocalTarget)
+# inside every measured pass, so the fleet figure INCLUDES migration
+# churn — quiesce, bundle export, warm re-verify, frequency restore —
+# the way an operator draining nodes mid-traffic would see it
+N_MIGRATIONS = (
+    int(sys.argv[sys.argv.index("--tenant-migrations") + 1])
+    if "--tenant-migrations" in sys.argv
+    else 0
 )
 MODE = os.environ.get("LOG_PARSER_TPU_MESH", "virtual")
 if MODE not in ("virtual", "real"):
@@ -232,6 +245,24 @@ def tenant_residency_main() -> None:
         reg = TenantRegistry(default_engine, root=root, budget_mb=budget_mb)
         state["registry"] = reg
         state["bank_mb"] = bank_mb
+        if N_MIGRATIONS:
+            from log_parser_tpu.runtime.migrate import LocalTarget, Migrator
+
+            # a peer registry over the SAME library root (the bank
+            # content-hash verify requires identical config) — tenants
+            # ping-pong between the two, each hop a full protocol run
+            peer = TenantRegistry(
+                default_engine, root=root, budget_mb=budget_mb
+            )
+            mig_a = Migrator(
+                reg, state_root=tempfile.mkdtemp(prefix="bench-mig-a-")
+            )
+            mig_b = Migrator(
+                peer, state_root=tempfile.mkdtemp(prefix="bench-mig-b-")
+            )
+            state["sides"] = [(reg, mig_a), (peer, mig_b)]
+            state["side_of"] = {}  # tenant id -> index into sides
+            state["migrations"] = 0
         return reg
 
     reg = bounded(setup, bench_common.PROBE_TIMEOUT_S, "device init")
@@ -248,18 +279,40 @@ def tenant_residency_main() -> None:
     ]
 
     def sweep():
+        from log_parser_tpu.runtime.migrate import LocalTarget
+
         result = None
         # each resolve may evict the LRU tenant and rebuild the target's
         # bank (warm through the compiled-DFA snapshot cache) before the
         # request runs — churn is part of the measured figure on purpose
         for t, data in enumerate(datas):
-            ctx = reg.resolve(f"tenant{t}")
+            tid = f"tenant{t}"
+            if N_MIGRATIONS:
+                side = state["side_of"].get(tid, 0)
+                owner_reg = state["sides"][side][0]
+            else:
+                owner_reg = reg
+            ctx = owner_reg.resolve(tid)
             try:
                 result = ctx.engine.analyze(data)
             finally:
                 # release the resolve lease: a pinned context is
                 # eviction-proof, and this scenario MUST churn
                 ctx.unpin()
+            if N_MIGRATIONS and t < N_MIGRATIONS:
+                # live-migrate the tenant to the other registry: a full
+                # protocol pass (quiesce, export, stage + bank-hash
+                # verify, cutover, frequency restore) inside the
+                # measured window; the next pass migrates it back
+                side = state["side_of"].get(tid, 0)
+                dst = 1 - side
+                src_mig = state["sides"][side][1]
+                dst_mig = state["sides"][dst][1]
+                src_mig.migrate(
+                    tid, LocalTarget(dst_mig, url=f"local://side{dst}")
+                )
+                state["side_of"][tid] = dst
+                state["migrations"] += 1
         return result
 
     result, _, dt = bench_common.measured_phase(bounded, sweep)
@@ -289,6 +342,12 @@ def tenant_residency_main() -> None:
         evicted=stats["evicted"],
         rebuilds=stats["rebuilds"],
         n_events=result.summary.significant_events,
+        **(
+            {"migrations": state["migrations"],
+             "migrations_per_pass": N_MIGRATIONS}
+            if N_MIGRATIONS
+            else {}
+        ),
     )
 
 

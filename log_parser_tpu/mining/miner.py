@@ -226,6 +226,33 @@ class TemplateMiner:
                 fh.write(text)
             os.replace(tmp, path)
 
+    def adopt_pending(self, entries) -> int:
+        """Re-park candidate entries exported by a tenant migration
+        (runtime/migrate.py): insert each parked candidate and persist
+        its yaml under this miner's pending dir so the review workflow
+        continues on the new owner. Entries without an id or yaml are
+        skipped; an existing id is left alone (the local copy already
+        survived a restart). Returns how many were adopted."""
+        adopted = 0
+        for entry in entries or ():
+            pid = str(entry.get("id") or "")
+            text = entry.get("yaml")
+            if not pid or not text:
+                continue
+            with self.lock:
+                if pid in self._pending:
+                    continue
+                self._pending[pid] = dict(entry)
+            adopted += 1
+            if self.pending_dir:
+                os.makedirs(self.pending_dir, exist_ok=True)
+                path = os.path.join(self.pending_dir, f"{pid}.yaml")
+                tmp = path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(text)
+                os.replace(tmp, path)
+        return adopted
+
     def _load_pending(self) -> None:
         """Rehydrate parked candidates across restarts (review workflow:
         a pending candidate survives like the WAL beside it does)."""

@@ -153,6 +153,18 @@ METRICS = {
         "gauge", "Fault-injection sites armed via LOG_PARSER_TPU_FAULTS."),
     "logparser_mesh_degraded": (
         "gauge", "1 while distributed serving is degraded to local."),
+    # ---------------------------------------------- migration + drain
+    "logparser_migration_total": (
+        "counter",
+        "Tenant-migration protocol outcomes by role and disposition "
+        "(completed/aborted/staged/activated/recovered_*/session_*/"
+        "drain_*)."),
+    "logparser_migration_active": (
+        "gauge", "Tenant migrations currently running the protocol."),
+    "logparser_migration_forwards": (
+        "gauge", "Tenants 307-forwarded to another process post-cutover."),
+    "logparser_migration_draining": (
+        "gauge", "1 while the drain supervisor is evacuating this process."),
 }
 
 # /trace/last payload block -> covering /metrics families. Hygiene
@@ -204,6 +216,10 @@ TRACE_BLOCKS = {
                 "logparser_tenant_builds_total",
                 "logparser_tenant_evictions_total"),
     "faults": ("logparser_faults_armed",),
+    "migration": ("logparser_migration_total",
+                  "logparser_migration_active",
+                  "logparser_migration_forwards",
+                  "logparser_migration_draining"),
 }
 
 # request latency: sub-ms cache hits through multi-second cold compiles

@@ -68,6 +68,11 @@ SPANS = {
     "broadcast": "coordinator-to-follower mesh broadcast for one trace",
     "tenant_build": "tenant bank build (first touch or post-evict)",
     "tenant_evict": "tenant eviction: flush, close streams, fold WAL",
+    "migration": "one tenant migration end-to-end (source side)",
+    "migrate_export": "quiesce + WAL fold + bundle export (source)",
+    "migrate_import": "bundle verify, warm stage and activate (target)",
+    "migrate_cutover": "ownership commit: forward install + handoff",
+    "drain": "one drain-supervisor pass: migrate-or-close every tenant",
 }
 
 

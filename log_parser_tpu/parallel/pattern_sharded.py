@@ -303,6 +303,21 @@ class TenantPlacement:
             self.assignments[str(tenant_id)] = device
         return pin_engine(engine, device)
 
+    def move(self, tenant_id: str, device=None) -> object:
+        """Re-place a tenant: the next build of its engine pins to
+        ``device`` (or the rotation's next chip). Placement moves are
+        MIGRATIONS, not bare re-pins — the caller runs
+        ``Migrator.migrate(tenant_id, LocalTarget(...))`` (runtime/
+        migrate.py) so the tenant's frequency history, parked candidates
+        and open sessions travel with it; this method only records where
+        the rebuilt engine must land."""
+        tid = str(tenant_id)
+        if device is None:
+            device = self.devices[self._next % len(self.devices)]
+            self._next += 1
+        self.assignments[tid] = device
+        return device
+
     def stats(self) -> dict:
         return {
             "devices": len(self.devices),

@@ -1082,12 +1082,15 @@ class AnalysisEngine:
         *,
         fsync_ms: float = 50.0,
         snapshot_every: int = 512,
+        wall=None,
     ):
         """Make frequency state durable: recover snapshot + journal tail
         from ``state_dir``, swap in a journaling tracker, start group-fsync
         and snapshot maintenance, and write the boot-baseline snapshot.
         Registers a best-effort ``atexit`` flush for non-serve embeddings
-        (the serve path additionally flushes on SIGTERM drain)."""
+        (the serve path additionally flushes on SIGTERM drain).
+        ``wall`` (tests) overrides the journal's wall clock so replayed
+        ages are deterministic."""
         import atexit
 
         from log_parser_tpu.runtime.journal import (
@@ -1095,8 +1098,9 @@ class AnalysisEngine:
             FrequencyJournal,
         )
 
+        kw = {} if wall is None else {"wall": wall}
         journal = FrequencyJournal(
-            state_dir, fsync_ms=fsync_ms, snapshot_every=snapshot_every
+            state_dir, fsync_ms=fsync_ms, snapshot_every=snapshot_every, **kw
         )
         tracker = DurableFrequencyTracker(
             self.config, self.frequency.clock, journal

@@ -1,0 +1,1213 @@
+"""Crash-safe tenant live migration + the health-driven drain supervisor.
+
+A tenant used to have exactly two states in this process: resident or
+evicted. Eviction (runtime/tenancy.py) folds the WAL and drops the
+engine, but the tenant's *traffic* has nowhere to go — rolling restarts,
+SLO-driven placement and drain-before-upgrade all need a third verb:
+**move a live tenant to another serving process without dropping its
+requests or forking its frequency history**. This module composes the
+primitives that already exist — the reload quiesce gate
+(``AnalysisEngine._request_scope``), the namespaced CRC-framed WAL
+(runtime/journal.py), the warm bank rebuild (patterns/libcache.py), the
+streaming carry (``host_carry()``) — into that verb.
+
+Protocol (one migration ``mid``, every step a CRC-framed, fsync'd record
+in a per-migration journal under ``<state>/_migrate/``):
+
+source ``<mid>.src.wal``::
+
+    BEGIN → QUIESCE → EXPORT(sha) → IMPORT_ACK → CUTOVER → COMPLETE
+                                               ^^^^^^^
+                                    the single commit point
+
+target ``<mid>.dst.wal``::
+
+    STAGE(sha) → STAGED → ACTIVATE → APPLIED
+
+Ownership invariant — *exactly one owner at every instant, across
+``kill -9`` on either side at any record boundary*:
+
+- the source serves the tenant until its CUTOVER record is durable;
+  after CUTOVER it 307-forwards the tenant (``Location`` +
+  ``Retry-After``) until callers re-resolve;
+- the target refuses to apply an import until its ACTIVATE record is
+  durable; a staged-but-not-activated import is **discarded on boot**
+  (covering the window where the target acked but the source died
+  before CUTOVER — the source recovers as owner, so the target's copy
+  must die);
+- a source journal that ends before CUTOVER recovers to ABORT: the
+  source still owns the tenant, nothing moved;
+- a source journal that ends at CUTOVER (no COMPLETE) recovers by
+  re-installing the forward and — given a target — resuming the
+  import/activate from the still-on-disk bundle. The bundle file is
+  deleted only at COMPLETE/ABORT, so resumption never needs the dead
+  process's memory.
+
+The exported bundle is versioned JSON with a sha256 sidecar: the bank's
+content hash (``patterns/libcache.library_key`` — the target rebuilds
+the bank warm from its own config and *verifies* it hashes identically),
+the frequency snapshot (portable ages) + journal epoch, parked mined
+candidates, and open-stream session carries. Frequency restore rides
+``DurableFrequencyTracker.restore`` (a journaled barrier), so the
+migrated state is durable on the target the instant it is applied and
+scores replay bit-identically to the no-migration run
+(tests/test_migrate.py pins the full crash × transport matrix).
+
+On top sits :class:`DrainSupervisor` (``--drain`` admin + SIGTERM):
+flip the admission gate (readiness 503, ``/q/health`` shows a DRAINING
+check), migrate every resident tenant out under ``--drain-deadline-s``
+— re-basing or explicitly error-framing open stream sessions rather
+than waiting forever — then finalize *every* resident engine (fold each
+tenant WAL, flush each batcher, dump the OTLP span file) and let
+shutdown complete. An optional health watch triggers the same drain
+when SLO burn or the device breaker crosses a threshold
+(``--drain-on-burn``).
+
+Fault sites (tools/chaos_sweep.py --group migrate; tools/hygiene.py
+check 18 pins them): ``migrate_export`` (bundle export, source),
+``migrate_import`` (bundle verify/stage, target), ``migrate_cutover``
+(the commit point, source — a fault here aborts with the source still
+owner).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.journal import _atomic_write
+from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
+
+log = logging.getLogger(__name__)
+
+BUNDLE_VERSION = 1
+
+# the migration chaos vocabulary — tools/hygiene.py check 18 pins every
+# key to a docs/OPS.md row AND to a live faults.fire site
+FAULT_SITES = {
+    "migrate_export": "bundle export under quiesce (source, Migrator)",
+    "migrate_import": "bundle verify + warm stage (target, stage_import)",
+    "migrate_cutover": "ownership commit point (source, pre-CUTOVER)",
+}
+
+# frame header shared with runtime/journal.py: payload length + CRC32
+_FRAME = struct.Struct("<II")
+_MAX_PAYLOAD = 64 << 20
+
+MIGRATE_DIR = "_migrate"
+
+# source-side protocol order (the crash-matrix axis in tests)
+SOURCE_RECORDS = ("begin", "quiesce", "export", "import_ack", "cutover",
+                  "complete")
+TARGET_RECORDS = ("stage", "staged", "activate", "applied")
+
+
+class MigrationError(Exception):
+    """A refused or aborted migration. ``status`` maps onto HTTP
+    (409 protocol conflict, 400 bad request, 404 unknown tenant)."""
+
+    def __init__(self, reason: str, status: int = 409):
+        super().__init__(reason)
+        self.reason = reason
+        self.status = status
+
+
+class MigrationCrash(RuntimeError):
+    """Raised by the ``crash_after`` test hook immediately after the
+    named record became durable — and before ANY cleanup. Because every
+    journal append is fsync'd and no abort record is written, the
+    process state this leaves behind is byte-for-byte what ``kill -9``
+    at that boundary leaves behind (the same rationale as
+    ``FrequencyJournal.abandon``)."""
+
+
+class MigrationJournal:
+    """Append-only CRC-framed record log for ONE migration. Every
+    append is write+flush+fsync — migration records are rare and each
+    one is a protocol state transition, so durability-per-record is the
+    point, not a cost."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fp = open(path, "ab")
+
+    def append(self, kind: str, **fields) -> None:
+        payload = dict(fields)
+        payload["k"] = kind
+        raw = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        frame = _FRAME.pack(len(raw), zlib.crc32(raw)) + raw
+        self._fp.write(frame)
+        self._fp.flush()
+        os.fsync(self._fp.fileno())
+
+    def close(self) -> None:
+        fp, self._fp = self._fp, None
+        if fp is not None:
+            try:
+                fp.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    @staticmethod
+    def replay(path: str) -> list[dict]:
+        """Whole frames only. A torn tail (a crash mid-append) is
+        quarantined to ``.torn`` and truncated away, exactly like the
+        frequency WAL: the record that tore never became durable, so
+        the protocol state is the last whole record."""
+        if not os.path.exists(path):
+            return []
+        with open(path, "rb") as f:
+            raw = f.read()
+        out: list[dict] = []
+        off = 0
+        while off + _FRAME.size <= len(raw):
+            length, crc = _FRAME.unpack_from(raw, off)
+            start = off + _FRAME.size
+            if length > _MAX_PAYLOAD or start + length > len(raw):
+                break
+            payload = raw[start:start + length]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                out.append(json.loads(payload.decode("utf-8")))
+            except ValueError:
+                break
+            off = start + length
+        if off < len(raw):
+            try:
+                with open(path + ".torn", "ab") as f:
+                    f.write(raw[off:])
+                with open(path, "r+b") as f:
+                    f.truncate(off)
+            except OSError:  # pragma: no cover - quarantine is best-effort
+                log.exception("failed to quarantine torn migration journal")
+        return out
+
+
+def canonical_bundle_bytes(bundle: dict) -> bytes:
+    """The hashed wire form: key-sorted compact JSON. Source and target
+    canonicalize independently, so the sha survives any transport
+    re-encoding in between."""
+    return json.dumps(
+        bundle, sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+
+
+@contextlib.contextmanager
+def _quiesced(engine, timeout_s: float):
+    """The reload quiesce gate, reused verbatim for migration: block new
+    top-level requests, wait for in-flight ones to drain, hold the gate
+    for the export, release on exit. Mirrors ``apply_library``'s
+    critical section without swapping anything."""
+    deadline = time.monotonic() + timeout_s
+    with engine._quiesce_cv:
+        if engine._swap_pending:
+            raise MigrationError("a reload or migration is already quiescing")
+        engine._swap_pending = True
+        try:
+            while engine._active_requests > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise MigrationError(
+                        f"migration quiesce timed out after {timeout_s:g}s "
+                        f"({engine._active_requests} request(s) in flight)"
+                    )
+                engine._quiesce_cv.wait(remaining)
+        except BaseException:
+            engine._swap_pending = False
+            engine._quiesce_cv.notify_all()
+            raise
+    try:
+        yield
+    finally:
+        with engine._quiesce_cv:
+            engine._swap_pending = False
+            engine._quiesce_cv.notify_all()
+
+
+class LocalTarget:
+    """In-process migration target: drives the destination
+    :class:`Migrator` directly. This is the placement-move form of the
+    protocol (``TenantPlacement.move`` composes with it) and the only
+    target kind that can ADOPT live stream sessions — the session
+    object re-bases onto the destination engine mid-session instead of
+    being error-framed."""
+
+    can_adopt_sessions = True
+
+    def __init__(self, migrator: "Migrator", url: str = "local://peer"):
+        self.migrator = migrator
+        self.url = url
+
+    def stage(self, bundle: dict, sha: str) -> dict:
+        return self.migrator.stage_import(bundle, sha)
+
+    def activate(self, mid: str) -> dict:
+        return self.migrator.activate(mid)
+
+    def adopt_session(self, tenant_id: str, sess) -> bool:
+        from log_parser_tpu.runtime.stream import shared_manager
+
+        # internal resolution: on a round-trip the destination may still
+        # hold its stale outbound forward until activation clears it
+        ctx = self.migrator.registry.resolve(tenant_id, ignore_forward=True)
+        try:
+            shared_manager(ctx.engine).adopt(sess)
+            return True
+        except Exception:
+            log.exception("session adopt failed; falling back to close")
+            return False
+        finally:
+            ctx.unpin()
+
+
+class HttpTarget:
+    """Cross-process migration target: drives the destination's
+    ``/admin/migrate/import`` + ``/admin/migrate/activate`` endpoints.
+    Live stream sessions cannot ride an HTTP connection to another
+    process, so they are closed with an explicit ``error`` frame naming
+    this target (the drain-or-rebase contract's bounded branch)."""
+
+    can_adopt_sessions = False
+
+    def __init__(self, url: str, timeout_s: float = 60.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + path, data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode("utf-8", "replace")[:512]
+            raise MigrationError(
+                f"target {self.url}{path} -> {exc.code}: {detail}"
+            ) from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise MigrationError(
+                f"target {self.url}{path} unreachable: {exc}"
+            ) from exc
+
+    def stage(self, bundle: dict, sha: str) -> dict:
+        return self._post("/admin/migrate/import",
+                          {"bundle": bundle, "sha": sha})
+
+    def activate(self, mid: str) -> dict:
+        return self._post("/admin/migrate/activate", {"mid": mid})
+
+    def adopt_session(self, tenant_id: str, sess) -> bool:
+        return False
+
+
+# /metrics view over Migrator.stats() — registered against the default
+# engine's obs bundle at construction (log_parser_tpu/obs); hygiene
+# check 18 pins the logparser_migration_* families to OPS.md rows
+METRIC_SAMPLES = (
+    ("completed", "logparser_migration_total", {"outcome": "completed"}),
+    ("aborted", "logparser_migration_total", {"outcome": "aborted"}),
+    ("staged", "logparser_migration_total", {"outcome": "staged"}),
+    ("activated", "logparser_migration_total", {"outcome": "activated"}),
+    ("recoveredResumed", "logparser_migration_total",
+     {"outcome": "recovered_resumed"}),
+    ("recoveredDiscarded", "logparser_migration_total",
+     {"outcome": "recovered_discarded"}),
+    ("sessionsMoved", "logparser_migration_total",
+     {"outcome": "session_moved"}),
+    ("sessionsClosed", "logparser_migration_total",
+     {"outcome": "session_closed"}),
+    ("active", "logparser_migration_active", {}),
+    ("forwards", "logparser_migration_forwards", {}),
+)
+
+
+class Migrator:
+    """Both halves of the migration protocol for one process: the
+    source side (:meth:`migrate`), the target side
+    (:meth:`stage_import` / :meth:`activate`), and boot-time
+    :meth:`recover` that drives every partially-run journal back to a
+    single-owner state.
+
+    ``crash_after`` (tests only): a set of record kinds; the protocol
+    raises :class:`MigrationCrash` immediately after appending a listed
+    record — no cleanup, no abort record — simulating ``kill -9`` at
+    exactly that boundary."""
+
+    def __init__(
+        self,
+        registry,
+        *,
+        state_root: str,
+        node_url: str = "",
+        quiesce_timeout_s: float = 30.0,
+        clock=time.monotonic,
+        crash_after=None,
+    ):
+        self.registry = registry
+        self.dir = os.path.join(str(state_root), MIGRATE_DIR)
+        os.makedirs(self.dir, exist_ok=True)
+        self.node_url = node_url
+        self.quiesce_timeout_s = float(quiesce_timeout_s)
+        self.clock = clock
+        self.crash_after = frozenset(crash_after or ())
+        self._lock = threading.Lock()
+        self._migrating: set[str] = set()  # tenant ids with a live protocol
+        self._staged: dict[str, dict] = {}  # mid -> bundle (target side)
+        self._dst_journals: dict[str, MigrationJournal] = {}
+        self._seq = len(os.listdir(self.dir))
+        # counters (GET /trace/last `migration` block + /metrics)
+        self.started = 0
+        self.completed = 0
+        self.aborted = 0
+        self.staged = 0
+        self.activated = 0
+        self.recovered_resumed = 0
+        self.recovered_discarded = 0
+        self.sessions_moved = 0
+        self.sessions_closed = 0
+        obs = getattr(registry.default_engine, "obs", None)
+        if obs is not None:
+            obs.add_stats_collector("migrate", self.stats, METRIC_SAMPLES)
+
+    # ------------------------------------------------------------- helpers
+
+    def _crash(self, kind: str) -> None:
+        if kind in self.crash_after:
+            raise MigrationCrash(f"injected crash after {kind!r} record")
+
+    def _spans(self):
+        obs = getattr(self.registry.default_engine, "obs", None)
+        return None if obs is None else obs.spans
+
+    def _src_path(self, mid: str) -> str:
+        return os.path.join(self.dir, f"{mid}.src.wal")
+
+    def _dst_path(self, mid: str) -> str:
+        return os.path.join(self.dir, f"{mid}.dst.wal")
+
+    def _bundle_path(self, mid: str) -> str:
+        return os.path.join(self.dir, f"{mid}.bundle.json")
+
+    def _read_bundle(self, mid: str) -> dict:
+        path = self._bundle_path(mid)
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            with open(path + ".sum", "r", encoding="utf-8") as f:
+                want = f.read().strip()
+        except OSError:
+            want = None
+        if want is not None and hashlib.sha256(raw).hexdigest() != want:
+            raise MigrationError(f"bundle {mid!r} failed its sha256 sidecar")
+        return json.loads(raw.decode("utf-8"))
+
+    def _drop_bundle(self, mid: str) -> None:
+        for suffix in ("", ".sum"):
+            try:
+                os.remove(self._bundle_path(mid) + suffix)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- source
+
+    def migrate(
+        self,
+        tenant_id: str,
+        target,
+        *,
+        retry_after_s: int = 5,
+        timeout_s: float | None = None,
+        mid: str | None = None,
+    ) -> dict:
+        """Run the full source side of the protocol for ``tenant_id``.
+        Returns a summary dict; raises :class:`MigrationError` on any
+        pre-CUTOVER failure (the tenant stays owned here, an ABORT
+        record closes the journal). Failures *after* CUTOVER leave a
+        resumable journal — ownership has already moved."""
+        if not tenant_id or tenant_id == DEFAULT_TENANT:
+            raise MigrationError("cannot migrate the default tenant", 400)
+        timeout_s = self.quiesce_timeout_s if timeout_s is None else timeout_s
+        with self._lock:
+            if tenant_id in self._migrating:
+                raise MigrationError(
+                    f"tenant {tenant_id!r} is already migrating"
+                )
+            self._migrating.add(tenant_id)
+        ctx = None
+        try:
+            if self.registry.forward_for(tenant_id) is not None:
+                raise MigrationError(
+                    f"tenant {tenant_id!r} has already been migrated", 409
+                )
+            ctx = self.registry.context_if_resident(tenant_id)
+            if ctx is not None:
+                ctx.pin()
+            else:
+                # a cold tenant still migrates: build it warm from disk so
+                # its folded state travels (resolve pins for us)
+                ctx = self.registry.resolve(tenant_id)
+            return self._migrate_pinned(
+                tenant_id, ctx, target, retry_after_s, timeout_s, mid
+            )
+        finally:
+            with self._lock:
+                self._migrating.discard(tenant_id)
+
+    def _migrate_pinned(self, tenant_id, ctx, target, retry_after_s,
+                        timeout_s, mid) -> dict:
+        with self._lock:
+            self._seq += 1
+            mid = mid or f"m{self._seq:06d}-{tenant_id}"
+        t0 = time.monotonic()
+        self.started += 1
+        jr = MigrationJournal(self._src_path(mid))
+        eng = ctx.engine
+        spans = self._spans()
+        trace = f"migrate:{mid}"
+        try:
+            jr.append("begin", mid=mid, tenant=tenant_id, target=target.url)
+            self._crash("begin")
+            with _quiesced(eng, timeout_s):
+                jr.append("quiesce")
+                self._crash("quiesce")
+                et0 = time.perf_counter()
+                bundle, sha = self._export_bundle(mid, tenant_id, eng)
+                jr.append("export", sha=sha)
+                self._crash("export")
+                if spans is not None:
+                    spans.annotate(
+                        trace, "migrate_export", time.perf_counter() - et0,
+                        attrs={"sha": sha[:12],
+                               "bytes": len(canonical_bundle_bytes(bundle))},
+                    )
+            it0 = time.perf_counter()
+            ack = target.stage(bundle, sha)
+            if not isinstance(ack, dict) or ack.get("sha") != sha:
+                raise MigrationError(
+                    f"target acked the wrong bundle hash: {ack!r}"
+                )
+            jr.append("import_ack", sha=sha)
+            self._crash("import_ack")
+            if spans is not None:
+                spans.annotate(trace, "migrate_import",
+                               time.perf_counter() - it0,
+                               attrs={"target": target.url})
+            ct0 = time.perf_counter()
+            faults.fire("migrate_cutover")  # conlint: contained-by-caller (aborts pre-cutover; the source keeps serving)
+            jr.append("cutover", location=target.url,
+                      retryAfterS=int(retry_after_s))
+            self._crash("cutover")
+        except MigrationCrash:
+            raise
+        except MigrationError as exc:
+            self._abort(jr, mid, tenant_id, ctx, exc, t0)
+            raise
+        except BaseException as exc:
+            self._abort(jr, mid, tenant_id, ctx, exc, t0)
+            raise MigrationError(f"migration aborted: {exc!r}") from exc
+        # ---- past the commit point: ownership has moved. Everything
+        # below must converge even if it fails here — recover() finishes
+        # the same steps from the journal + bundle.
+        self.registry.set_forward(tenant_id, target.url, int(retry_after_s))
+        ctx.unpin()
+        moved, closed = self._hand_off_sessions(tenant_id, eng, target)
+        if spans is not None:
+            spans.annotate(trace, "migrate_cutover",
+                           time.perf_counter() - ct0,
+                           attrs={"location": target.url,
+                                  "sessionsMoved": moved,
+                                  "sessionsClosed": closed})
+        target.activate(mid)
+        detached = self.registry.detach(tenant_id)
+        if detached is not None:
+            detached.close()
+        jr.append("complete")
+        jr.close()
+        self._drop_bundle(mid)
+        self.completed += 1
+        if spans is not None:
+            spans.end_trace(
+                trace, duration_s=time.monotonic() - t0, tenant=tenant_id,
+                name="migration",
+                attrs={"outcome": "completed", "target": target.url,
+                       "sessionsMoved": moved, "sessionsClosed": closed},
+                force=True,
+            )
+        return {
+            "mid": mid,
+            "tenant": tenant_id,
+            "target": target.url,
+            "outcome": "completed",
+            "sessionsMoved": moved,
+            "sessionsClosed": closed,
+        }
+
+    def _abort(self, jr, mid, tenant_id, ctx, exc, t0) -> None:
+        """Pre-CUTOVER failure: the source keeps the tenant. Durable
+        ABORT record, bundle dropped, context unpinned — the engine
+        serves on exactly as if the migration never started."""
+        try:
+            jr.append("abort", reason=repr(exc)[:512])
+        except OSError:  # pragma: no cover - abort is best-effort
+            pass
+        jr.close()
+        self._drop_bundle(mid)
+        ctx.unpin()
+        self.aborted += 1
+        log.warning("migration %s of %r aborted: %r", mid, tenant_id, exc)
+        spans = self._spans()
+        if spans is not None:
+            spans.end_trace(
+                f"migrate:{mid}", duration_s=time.monotonic() - t0,
+                tenant=tenant_id, name="migration",
+                attrs={"outcome": "aborted", "reason": repr(exc)[:128]},
+                force=True,
+            )
+
+    def _export_bundle(self, mid, tenant_id, eng) -> tuple[dict, str]:
+        """Build + atomically persist the migration bundle. Caller holds
+        the quiesce gate: no request is in flight, so the WAL fold, the
+        frequency snapshot and the session carries are one consistent
+        cut of the tenant's state."""
+        from log_parser_tpu.patterns.bank import CONTEXT_REGEXES
+        from log_parser_tpu.patterns.libcache import library_key
+
+        faults.fire("migrate_export")  # conlint: contained-by-caller (migrate() aborts pre-cutover)
+        journal = getattr(eng, "journal", None)
+        epoch = 0
+        if journal is not None:
+            # fold the WAL into a sealed snapshot: the bundle's ages and
+            # the on-disk state dir now agree, so either side of a crash
+            # recovers the same frequency history
+            journal.snapshot_now()
+            journal.flush()
+            epoch = journal.epoch
+        with eng.state_lock:
+            ages = eng.frequency.snapshot()
+        pending = []
+        miner = getattr(eng, "miner", None)
+        if miner is not None:
+            with miner.lock:
+                pending = [dict(e) for e in miner._pending.values()]
+        carries = []
+        mgr = getattr(eng, "stream_manager", None)
+        if mgr is not None:
+            with mgr._lock:
+                sessions = list(mgr._sessions.values())
+            carries = [s.export_carry() for s in sessions]
+        bundle = {
+            "version": BUNDLE_VERSION,
+            "mid": mid,
+            "tenant": tenant_id,
+            "libraryKey": library_key(eng.bank.pattern_sets, CONTEXT_REGEXES),
+            "frequency": {"ages": ages, "epoch": epoch},
+            "pending": pending,
+            "sessions": carries,
+        }
+        raw = canonical_bundle_bytes(bundle)
+        _atomic_write(self._bundle_path(mid), raw)
+        return bundle, hashlib.sha256(raw).hexdigest()
+
+    def _hand_off_sessions(self, tenant_id, eng, target) -> tuple[int, int]:
+        """Post-CUTOVER session disposition: a live session either MOVES
+        (LocalTarget adopts the object and re-bases it onto the new
+        engine) or is closed with an explicit ``error`` frame naming the
+        new owner — it never pins the old process open."""
+        mgr = getattr(eng, "stream_manager", None)
+        if mgr is None:
+            return 0, 0
+        with mgr._lock:
+            sessions = list(mgr._sessions.values())
+        moved = closed = 0
+        for sess in sessions:
+            if target.can_adopt_sessions and target.adopt_session(
+                tenant_id, sess
+            ):
+                moved += 1
+            else:
+                sess.kill(
+                    "migrated",
+                    message=(
+                        f"tenant {tenant_id!r} migrated to {target.url}; "
+                        "re-resolve and reconnect there"
+                    ),
+                )
+                closed += 1
+        self.sessions_moved += moved
+        self.sessions_closed += closed
+        return moved, closed
+
+    # ------------------------------------------------------------- target
+
+    def stage_import(self, bundle: dict, sha: str) -> dict:
+        """Target half, step one: verify the bundle hash, warm-build the
+        tenant bank and verify its content hash matches the source's,
+        persist the bundle, ack. NOTHING is applied yet — a staged
+        import that never activates is discarded on boot."""
+        if not isinstance(bundle, dict):
+            raise MigrationError("bundle must be a JSON object", 400)
+        mid = str(bundle.get("mid") or "")
+        tenant_id = str(bundle.get("tenant") or "")
+        if not mid or not tenant_id:
+            raise MigrationError("bundle missing mid/tenant", 400)
+        jr = MigrationJournal(self._dst_path(mid))
+        jr.append("stage", mid=mid, tenant=tenant_id, sha=sha)
+        self._crash("stage")
+        t0 = time.perf_counter()
+        try:
+            faults.fire("migrate_import")  # conlint: contained-by-caller (the source aborts pre-cutover on a failed stage)
+            if bundle.get("version") != BUNDLE_VERSION:
+                raise MigrationError(
+                    f"unsupported bundle version {bundle.get('version')!r}",
+                    400,
+                )
+            raw = canonical_bundle_bytes(bundle)
+            have = hashlib.sha256(raw).hexdigest()
+            if have != sha:
+                raise MigrationError(
+                    f"bundle hash mismatch: want {sha[:12]}…, got {have[:12]}…"
+                )
+            self._verify_bank(tenant_id, bundle.get("libraryKey"))
+            _atomic_write(self._bundle_path(mid), raw)
+            jr.append("staged", sha=sha)
+            self._crash("staged")
+        except MigrationCrash:
+            raise
+        except MigrationError as exc:
+            jr.append("discard", reason=exc.reason[:512])
+            jr.close()
+            raise
+        except BaseException as exc:
+            jr.append("discard", reason=repr(exc)[:512])
+            jr.close()
+            raise MigrationError(f"stage failed: {exc!r}") from exc
+        with self._lock:
+            self._staged[mid] = bundle
+            self._dst_journals[mid] = jr
+        self.staged += 1
+        spans = self._spans()
+        if spans is not None:
+            spans.end_trace(
+                f"migrate:{mid}:dst", duration_s=time.perf_counter() - t0,
+                tenant=tenant_id, name="migrate_import",
+                attrs={"phase": "staged", "sha": sha[:12]}, force=True,
+            )
+        return {"mid": mid, "tenant": tenant_id, "sha": sha}
+
+    def _verify_bank(self, tenant_id: str, want_key) -> None:
+        """Rebuild the tenant bank warm (patterns/libcache) and check it
+        hashes to the same library the source served — a config drift
+        between the two processes would silently change scores, so it
+        fails the stage instead."""
+        from log_parser_tpu.patterns.bank import CONTEXT_REGEXES
+        from log_parser_tpu.patterns.libcache import library_key
+
+        # ignore_forward: on a round-trip the target may still hold its
+        # own stale outbound forward for this tenant; verification is an
+        # internal resolution, not traffic routing
+        ctx = self.registry.resolve(tenant_id, ignore_forward=True)
+        try:
+            have_key = library_key(
+                ctx.engine.bank.pattern_sets, CONTEXT_REGEXES
+            )
+            if want_key and have_key and want_key != have_key:
+                raise MigrationError(
+                    f"bank content hash mismatch for {tenant_id!r}: the "
+                    "target's pattern config differs from the source's"
+                )
+        finally:
+            ctx.unpin()
+
+    def activate(self, mid: str) -> dict:
+        """Target half, step two (runs only after the source's CUTOVER
+        is durable): write ACTIVATE, apply the bundle — frequency
+        restore through the journaled barrier, parked candidates,
+        session carries — then APPLIED. Idempotent per journal: a crash
+        between ACTIVATE and APPLIED re-applies on boot."""
+        with self._lock:
+            bundle = self._staged.pop(mid, None)
+            jr = self._dst_journals.pop(mid, None)
+        if bundle is None:
+            raise MigrationError(f"no staged import {mid!r}", 404)
+        if jr is None:  # pragma: no cover - staged and journal travel together
+            jr = MigrationJournal(self._dst_path(mid))
+        jr.append("activate")
+        self._crash("activate")
+        self._apply_bundle(bundle)
+        jr.append("applied")
+        jr.close()
+        self._drop_bundle(mid)
+        self.activated += 1
+        spans = self._spans()
+        if spans is not None:
+            spans.end_trace(
+                f"migrate:{mid}:dst", duration_s=0.0,
+                tenant=str(bundle.get("tenant")), name="migrate_import",
+                attrs={"phase": "activated"}, force=True,
+            )
+        return {"mid": mid, "tenant": bundle.get("tenant"),
+                "outcome": "activated"}
+
+    def _apply_bundle(self, bundle: dict) -> None:
+        tenant_id = str(bundle.get("tenant"))
+        # a round-trip (A -> B -> A) lands here with A still holding its
+        # own stale forward from the outbound leg; becoming the owner
+        # supersedes it — clear before resolve, which would otherwise
+        # answer 307 for a tenant this process now owns
+        self.registry.clear_forward(tenant_id)
+        ctx = self.registry.resolve(tenant_id)
+        try:
+            eng = ctx.engine
+            ages = (bundle.get("frequency") or {}).get("ages") or {}
+            with eng.state_lock:
+                # DurableFrequencyTracker.restore appends a journal
+                # barrier: the migrated history is durable in THIS
+                # tenant's WAL the moment it lands
+                eng.frequency.restore(
+                    {str(pid): [float(a) for a in ages_list]
+                     for pid, ages_list in ages.items()}
+                )
+            journal = getattr(eng, "journal", None)
+            if journal is not None:
+                journal.flush()
+            miner = getattr(eng, "miner", None)
+            if miner is not None and bundle.get("pending"):
+                miner.adopt_pending(bundle["pending"])
+            carries = bundle.get("sessions") or ()
+            if carries:
+                from log_parser_tpu.runtime.stream import shared_manager
+
+                mgr = shared_manager(eng)
+                for carry in carries:
+                    sid = str(carry.get("sessionId") or "")
+                    with mgr._lock:
+                        live = sid in mgr._sessions
+                    if live:
+                        # the live object already moved over (LocalTarget
+                        # adoption keeps its id); the carry is that
+                        # session's crash-recovery shadow — restoring it
+                        # too would double the session and its admission
+                        # slot
+                        continue
+                    try:
+                        mgr.adopt_carry(carry)
+                    except Exception:
+                        log.exception(
+                            "session carry %r failed to restore; the "
+                            "client must reconnect",
+                            carry.get("sessionId"),
+                        )
+        finally:
+            ctx.unpin()
+
+    # ----------------------------------------------------------- recovery
+
+    def recover(self, targets: dict | None = None) -> dict:
+        """Boot-time convergence: walk every migration journal in the
+        state dir and drive it to a terminal, single-owner state.
+
+        - source journal without CUTOVER → ABORT (we still own the
+          tenant; the half-written bundle is dropped);
+        - source journal with CUTOVER but no COMPLETE → re-install the
+          forward; with a reachable target (``targets`` maps target URL
+          → target object) re-stage + activate from the on-disk bundle
+          and COMPLETE, else leave it pending-but-forwarded;
+        - source journal with COMPLETE → re-install the forward
+          (forwards live in the journal, nowhere else);
+        - target journal without ACTIVATE → DISCARD the staged bundle
+          (the source recovered as owner);
+        - target journal with ACTIVATE but no APPLIED → re-apply the
+          bundle (restore is a full-state barrier, so replay-after-
+          partial-apply converges), then APPLIED.
+        """
+        summary = {"forwards": [], "resumed": [], "discarded": [],
+                   "pending": []}
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return summary
+        for name in names:
+            path = os.path.join(self.dir, name)
+            if name.endswith(".src.wal"):
+                self._recover_source(path, targets or {}, summary)
+            elif name.endswith(".dst.wal"):
+                self._recover_target(path, summary)
+        return summary
+
+    def _recover_source(self, path, targets, summary) -> None:
+        records = MigrationJournal.replay(path)
+        if not records:
+            return
+        kinds = [r.get("k") for r in records]
+        meta = records[0]
+        mid = str(meta.get("mid") or os.path.basename(path).split(".")[0])
+        tenant_id = str(meta.get("tenant") or "")
+        if "abort" in kinds:
+            return
+        cutover = next((r for r in records if r.get("k") == "cutover"), None)
+        if cutover is None:
+            # crash anywhere before the commit point: the tenant never
+            # left. Seal the journal with ABORT; the next resolve serves
+            # from the (still-folded) local state.
+            jr = MigrationJournal(path)
+            jr.append("abort", reason="recovered: no cutover record")
+            jr.close()
+            self._drop_bundle(mid)
+            self.recovered_discarded += 1
+            summary["discarded"].append(mid)
+            log.info(
+                "migration %s recovered to ABORT (no cutover); tenant %r "
+                "stays owned here", mid, tenant_id,
+            )
+            return
+        location = str(cutover.get("location") or "")
+        retry_after = int(cutover.get("retryAfterS") or 5)
+        if tenant_id:
+            self.registry.set_forward(tenant_id, location, retry_after)
+            summary["forwards"].append(tenant_id)
+        if "complete" in kinds:
+            return
+        # CUTOVER durable, COMPLETE missing: ownership moved but the
+        # handoff didn't finish. Resume it if we can reach the target.
+        target = targets.get(location)
+        if target is None:
+            summary["pending"].append(mid)
+            log.warning(
+                "migration %s is past cutover but incomplete and no target "
+                "for %r was supplied; tenant %r stays forwarded",
+                mid, location, tenant_id,
+            )
+            return
+        try:
+            bundle = self._read_bundle(mid)
+            sha = hashlib.sha256(canonical_bundle_bytes(bundle)).hexdigest()
+            target.stage(bundle, sha)
+            target.activate(mid)
+        except (MigrationError, OSError, ValueError) as exc:
+            summary["pending"].append(mid)
+            log.error("migration %s resume failed: %s", mid, exc)
+            return
+        detached = self.registry.detach(tenant_id)
+        if detached is not None:
+            detached.close()
+        jr = MigrationJournal(path)
+        jr.append("complete")
+        jr.close()
+        self._drop_bundle(mid)
+        self.recovered_resumed += 1
+        summary["resumed"].append(mid)
+
+    def _recover_target(self, path, summary) -> None:
+        records = MigrationJournal.replay(path)
+        if not records:
+            return
+        kinds = [r.get("k") for r in records]
+        meta = records[0]
+        mid = str(meta.get("mid") or os.path.basename(path).split(".")[0])
+        if "applied" in kinds or "discard" in kinds:
+            return
+        if "activate" not in kinds:
+            # staged (acked or not) but never activated: the source may
+            # have recovered as owner — this copy must die
+            jr = MigrationJournal(path)
+            jr.append("discard", reason="recovered: never activated")
+            jr.close()
+            self._drop_bundle(mid)
+            self.recovered_discarded += 1
+            summary["discarded"].append(mid)
+            log.info("staged import %s discarded on boot (never activated)",
+                     mid)
+            return
+        # ACTIVATE durable, APPLIED missing: finish the apply. restore()
+        # is a full-state barrier, so a partial first attempt converges.
+        try:
+            bundle = self._read_bundle(mid)
+        except (MigrationError, OSError, ValueError) as exc:
+            summary["pending"].append(mid)
+            log.error("activated import %s lost its bundle: %s", mid, exc)
+            return
+        self._apply_bundle(bundle)
+        jr = MigrationJournal(path)
+        jr.append("applied")
+        jr.close()
+        self._drop_bundle(mid)
+        self.recovered_resumed += 1
+        summary["resumed"].append(mid)
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            active = len(self._migrating)
+            staged_now = len(self._staged)
+        return {
+            "started": self.started,
+            "completed": self.completed,
+            "aborted": self.aborted,
+            "staged": self.staged,
+            "activated": self.activated,
+            "recoveredResumed": self.recovered_resumed,
+            "recoveredDiscarded": self.recovered_discarded,
+            "sessionsMoved": self.sessions_moved,
+            "sessionsClosed": self.sessions_closed,
+            "active": active,
+            "stagedNow": staged_now,
+            "forwards": self.registry.forward_count(),
+        }
+
+
+# /metrics view over DrainSupervisor.stats()
+DRAIN_METRIC_SAMPLES = (
+    ("draining", "logparser_migration_draining", {}),
+    ("tenantsClosed", "logparser_migration_total",
+     {"outcome": "drain_closed"}),
+    ("tenantsMigrated", "logparser_migration_total",
+     {"outcome": "drain_migrated"}),
+)
+
+
+class DrainSupervisor:
+    """Migrate-everything-out-then-stop, under a bounded deadline.
+
+    Triggered by the ``/admin/drain`` endpoint, by SIGTERM (wired as
+    ``install_drain_handlers``'s ``on_drained`` hook), or by the
+    optional health watch (SLO burn / device breaker). One pass:
+
+    1. flip the shared admission gate (readiness 503; ``/q/health``
+       reports a DRAINING check) — new work is refused while in-flight
+       migrations complete;
+    2. for every resident non-default tenant, migrate to
+       ``target`` under what remains of ``deadline_s``; with no target
+       (or past the deadline, or on a failed migration) fall back to a
+       bounded local close: open stream sessions get an explicit
+       ``error`` frame — never an indefinite hang — and the tenant's
+       WAL folds;
+    3. finalize EVERY remaining engine: fold each tenant WAL, flush
+       each batcher, flush the default journal, dump the OTLP span
+       file. (Pre-PR-16 shutdown finalized only the default engine;
+       tests/test_migrate.py pins the multi-tenant fix.)
+    """
+
+    def __init__(
+        self,
+        registry,
+        migrator: Migrator | None = None,
+        *,
+        gate=None,
+        target=None,
+        deadline_s: float = 30.0,
+        retry_after_s: int = 5,
+        span_dump_path: str | None = None,
+        clock=time.monotonic,
+    ):
+        self.registry = registry
+        self.migrator = migrator
+        self.gate = gate
+        self.target = target
+        self.deadline_s = float(deadline_s)
+        self.retry_after_s = int(retry_after_s)
+        self.span_dump_path = span_dump_path
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._draining = False
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
+        # counters (GET /trace/last `migration` block)
+        self.drains = 0
+        self.tenants_migrated = 0
+        self.tenants_closed = 0
+        self.sessions_closed = 0
+        obs = getattr(registry.default_engine, "obs", None)
+        if obs is not None:
+            obs.add_stats_collector("drain", self.stats,
+                                    DRAIN_METRIC_SAMPLES)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    # --------------------------------------------------------------- drain
+
+    def drain(self, reason: str = "admin") -> dict:
+        """One full drain pass (idempotent: a second call while draining
+        returns immediately). Never raises — SIGTERM must always reach
+        shutdown."""
+        with self._lock:
+            if self._draining:
+                return {"alreadyDraining": True}
+            self._draining = True
+            self.drains += 1
+        t0 = self.clock()
+        deadline = t0 + self.deadline_s
+        if self.gate is not None and not self.gate.draining:
+            self.gate.begin_drain()
+        migrated: list[str] = []
+        closed: list[str] = []
+        for tid in self.registry.resident():
+            if tid == DEFAULT_TENANT:
+                continue
+            remaining = deadline - self.clock()
+            if (
+                self.migrator is not None
+                and self.target is not None
+                and remaining > 0
+            ):
+                try:
+                    self.migrator.migrate(
+                        tid, self.target,
+                        retry_after_s=self.retry_after_s,
+                        timeout_s=max(1.0, remaining),
+                    )
+                    migrated.append(tid)
+                    continue
+                except Exception:
+                    log.exception(
+                        "drain: migrating %r failed; falling back to a "
+                        "bounded local close", tid,
+                    )
+            self._close_tenant(tid)
+            closed.append(tid)
+        self.tenants_migrated += len(migrated)
+        self.tenants_closed += len(closed)
+        self.finalize_all()
+        obs = getattr(self.registry.default_engine, "obs", None)
+        if obs is not None:
+            obs.spans.end_trace(
+                f"drain:{self.drains}",
+                duration_s=max(0.0, self.clock() - t0),
+                name="drain",
+                attrs={"reason": reason, "migrated": len(migrated),
+                       "closed": len(closed),
+                       "deadlineS": self.deadline_s},
+                force=True,
+            )
+        return {"reason": reason, "migrated": migrated, "closed": closed,
+                "elapsedS": round(max(0.0, self.clock() - t0), 3)}
+
+    def _close_tenant(self, tid: str) -> None:
+        """Bounded local drain of one tenant: no target to move to, so
+        open sessions are error-framed (the client is told to re-resolve)
+        and the WAL folds. This path also covers a stream-pinned tenant
+        past the drain deadline — it must never hang SIGTERM."""
+        ctx = self.registry.detach(tid)
+        if ctx is None:
+            return
+        mgr = getattr(ctx.engine, "stream_manager", None)
+        if mgr is not None:
+            with mgr._lock:
+                sessions = list(mgr._sessions.values())
+            for sess in sessions:
+                sess.kill(
+                    "draining",
+                    message="server draining; re-resolve and reconnect",
+                )
+                self.sessions_closed += 1
+        try:
+            ctx.close()
+        except Exception:
+            log.exception("drain: closing tenant %r failed", tid)
+
+    def finalize_all(self) -> dict:
+        """Multi-tenant shutdown finalization (the satellite-2 fix): fold
+        the WAL and flush the batcher of EVERY still-resident tenant,
+        flush the default engine's journal and batcher, and dump the
+        OTLP span file — not just the default engine's state."""
+        folded: list[str] = []
+        for tid in self.registry.resident():
+            if tid == DEFAULT_TENANT:
+                continue
+            ctx = self.registry.context_if_resident(tid)
+            if ctx is None:
+                continue
+            eng = ctx.engine
+            if getattr(eng, "batcher", None) is not None:
+                try:
+                    eng.batcher.flush_now()
+                except Exception:
+                    log.exception("drain: batcher flush for %r failed", tid)
+            journal = getattr(eng, "journal", None)
+            if journal is not None:
+                journal.snapshot_now()
+                journal.flush()
+            folded.append(tid)
+        default_eng = self.registry.default_engine
+        journal = getattr(default_eng, "journal", None)
+        if journal is not None:
+            journal.snapshot_now()
+            journal.flush()
+        obs = getattr(default_eng, "obs", None)
+        if obs is not None and self.span_dump_path:
+            try:
+                obs.spans.dump(self.span_dump_path)
+            except OSError:
+                log.exception("drain: span dump failed")
+        return {"folded": folded, "spanDump": self.span_dump_path}
+
+    # --------------------------------------------------------- health watch
+
+    def watch_health(self, check, poll_s: float = 5.0) -> threading.Thread:
+        """Start the health-driven trigger: ``check()`` returns a reason
+        string when the process should evacuate (SLO burn over
+        threshold, breaker stuck open) or None while healthy. The first
+        non-None verdict runs one drain pass and the watch exits."""
+
+        def _loop():
+            while not self._watch_stop.wait(poll_s):
+                if self.draining:
+                    return
+                try:
+                    reason = check()
+                except Exception:
+                    log.exception("drain health check failed")
+                    continue
+                if reason:
+                    log.warning("health watch triggering drain: %s", reason)
+                    self.drain(reason=f"health:{reason}")
+                    return
+
+        with self._lock:
+            if self._watch_thread is not None:
+                return self._watch_thread
+            self._watch_thread = threading.Thread(
+                target=_loop, name="drain-health-watch", daemon=True
+            )
+        self._watch_thread.start()
+        return self._watch_thread
+
+    def stop_watch(self) -> None:
+        self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            draining = self._draining
+        return {
+            "draining": int(draining),
+            "deadlineS": self.deadline_s,
+            "drains": self.drains,
+            "tenantsMigrated": self.tenants_migrated,
+            "tenantsClosed": self.tenants_closed,
+            "sessionsClosed": self.sessions_closed,
+            "target": getattr(self.target, "url", None),
+        }

@@ -161,6 +161,10 @@ class StreamSession:
         self.mode = "device"  # "device" | "golden"
         self.closed = False
         self.kill_reason: str | None = None
+        # optional operator-facing detail for the dead-session error
+        # frame — a "migrated" kill names the new owner here so the
+        # client knows where to reconnect
+        self.kill_message: str | None = None
         self._seq = 0
         self._chunks = 0  # fed chunks, for the session span
         # (line_idx, pattern_id) -> last reported score, for events that
@@ -217,14 +221,17 @@ class StreamSession:
 
     # ------------------------------------------------------------- lifecycle
 
-    def kill(self, reason: str) -> None:
+    def kill(self, reason: str, message: str | None = None) -> None:
         """Terminate the session (poison chunk, injected fault, TTL reap,
-        transport drop). Idempotent; releases the admission slot."""
+        transport drop, migration/drain). Idempotent; releases the
+        admission slot. ``message`` rides the dead-session ``error``
+        frame — a migration kill carries the new owner's URL."""
         with self._lock:
             if self.closed:
                 return
             self.closed = True
             self.kill_reason = reason
+            self.kill_message = message
         self._commit_session_span(reason)
         if self.manager is not None:
             self.manager._discard(self, reason)
@@ -279,7 +286,7 @@ class StreamSession:
                 return [
                     self._frame(
                         "error", reason=self.kill_reason or "closed",
-                        message="session is closed",
+                        message=self.kill_message or "session is closed",
                     )
                 ]
             self._touch()
@@ -589,6 +596,77 @@ class StreamSession:
         if self.manager is not None:
             self.manager._note_rebase()
 
+    # ------------------------------------------------------------ migration
+
+    def export_carry(self) -> dict:
+        """Portable session state for a tenant migration bundle
+        (runtime/migrate.py): the decoded window text, the monotone-emit
+        ledger, and the frame sequence. Device artifacts (bit rows, the
+        automata carry) deliberately do NOT travel — the importer
+        re-scores the window under its own bank, which the migration
+        protocol has already verified is content-identical, so the
+        replayed scores match bit-for-bit. Caller holds the quiesce
+        gate, so the cut is consistent; bytes still undecoded in the
+        normalizer are flushed into the window (and ingested, so the
+        source session stays coherent if the migration aborts) — a
+        multi-byte sequence torn exactly at the cut decodes as
+        replacement characters, the same verdict a torn end-of-stream
+        gets."""
+        with self._lock:
+            tail = self._normalizer.flush()
+            if tail:
+                self._text += tail
+                self._ingest_text(tail)
+            return {
+                "sessionId": self.session_id,
+                "mode": self.mode,
+                "emitThreshold": self.emit_threshold,
+                "text": self._text,
+                "seq": self._seq,
+                "chunks": self._chunks,
+                "ledger": [
+                    [line_idx, pid, score]
+                    for (line_idx, pid), score in self._ledger.items()
+                ],
+            }
+
+    def restore_carry(self, carry: dict) -> None:
+        """Rebuild this freshly-opened session from an exported carry:
+        re-ingest the window text (scoring uncached lines once under the
+        importer's bank) and restore the ledger + sequence so the
+        client's monotone-emit contract continues unbroken across the
+        move."""
+        with self._lock:
+            self.mode = str(carry.get("mode", "device"))
+            self._seq = int(carry.get("seq", 0))
+            self._chunks = int(carry.get("chunks", 0))
+            self.emit_threshold = float(
+                carry.get("emitThreshold", self.emit_threshold)
+            )
+            self._ledger = {
+                (int(line_idx), str(pid)): float(score)
+                for line_idx, pid, score in carry.get("ledger", ())
+            }
+            text = str(carry.get("text", ""))
+            if not text:
+                return
+            with self.engine._request_scope():
+                self._text = text
+                if self.mode != "golden":
+                    batch_idx = self._ingest_text(text)
+                    self._chunk_device_step(text, batch_idx)
+
+    def rebase_onto(self, engine) -> None:
+        """Live-session half of a local tenant handoff: re-point this
+        session at the destination engine and re-base its window there
+        (the same machinery as a hot-reload rebase), so the next feed
+        continues seamlessly under the new owner."""
+        with self._lock:
+            self.engine = engine
+            with engine._request_scope():
+                self._epoch = None  # force: the epoch spaces differ
+                self._rebase()
+
     # ---------------------------------------------------------------- close
 
     def close(self) -> list[dict]:
@@ -602,7 +680,7 @@ class StreamSession:
                 return [
                     self._frame(
                         "error", reason=self.kill_reason or "closed",
-                        message="session is closed",
+                        message=self.kill_message or "session is closed",
                     )
                 ]
             self._touch()
@@ -768,6 +846,8 @@ class StreamManager:
         self.sessions_killed = 0
         self.sessions_reaped = 0
         self.sessions_rebased = 0
+        self.sessions_migrated = 0  # moved OUT by a tenant migration
+        self.sessions_adopted = 0  # moved/restored IN by a migration
         self.chunks_ingested = 0
         self.bytes_ingested = 0
         self.frames_emitted = 0
@@ -806,6 +886,55 @@ class StreamManager:
     def get(self, session_id: str) -> StreamSession | None:
         with self._lock:
             return self._sessions.get(session_id)
+
+    # ------------------------------------------------------------ migration
+
+    def adopt(self, sess: StreamSession) -> StreamSession:
+        """Move a LIVE session from another manager onto this engine (the
+        local-handoff half of a tenant migration): acquire this engine's
+        admission slot, release the source's, re-register the session
+        (keeping its id unless taken) and re-base its window here. The
+        session object survives — the client's next feed lands on the
+        new owner without ever seeing an error frame."""
+        from log_parser_tpu.serve.admission import shared_gate
+
+        shared_gate(self.engine).acquire(batchable=False)
+        src = sess.manager
+        if src is not None and src is not self:
+            moved_out = False
+            with src._lock:
+                if src._sessions.pop(sess.session_id, None) is not None:
+                    moved_out = True
+                    src.sessions_migrated += 1
+            if moved_out:
+                shared_gate(src.engine).release()
+        with self._lock:
+            sid = sess.session_id
+            if sid in self._sessions:
+                self._next_id += 1
+                sid = f"s{self._next_id:06d}"
+                sess.session_id = sid
+            self._sessions[sid] = sess
+            self.sessions_adopted += 1
+        sess.manager = self
+        sess.rebase_onto(self.engine)
+        return sess
+
+    def adopt_carry(self, carry: dict) -> StreamSession:
+        """Restore an exported session carry (cross-process migration):
+        open a fresh admission-gated session here and replay the carried
+        window into it. The restored session keeps the source's frame
+        sequence, so the client's monotone contract holds if it
+        reconnects by session id."""
+        sess = self.open()
+        try:
+            sess.restore_carry(carry)
+        except Exception:
+            sess.kill("internal")
+            raise
+        with self._lock:
+            self.sessions_adopted += 1
+        return sess
 
     def _discard(self, sess: StreamSession, reason: str) -> None:
         from log_parser_tpu.serve.admission import shared_gate
@@ -888,6 +1017,8 @@ class StreamManager:
                 "sessionsKilled": self.sessions_killed,
                 "sessionsReaped": self.sessions_reaped,
                 "sessionsRebased": self.sessions_rebased,
+                "sessionsMigrated": self.sessions_migrated,
+                "sessionsAdopted": self.sessions_adopted,
                 "chunksIngested": self.chunks_ingested,
                 "bytesIngested": self.bytes_ingested,
                 "framesEmitted": self.frames_emitted,

@@ -80,6 +80,22 @@ class TenantError(Exception):
         self.status = status
 
 
+class TenantForwarded(TenantError):
+    """The tenant has been migrated away (runtime/migrate.py): a durable
+    CUTOVER record made another process the owner. Transports render
+    this as a 307 with ``Location`` + ``Retry-After`` (gRPC maps it to
+    UNAVAILABLE with the target in the message) until the caller
+    re-resolves the tenant's placement."""
+
+    def __init__(self, tenant_id: str, location: str, retry_after_s: int = 5):
+        super().__init__(
+            f"tenant {tenant_id!r} migrated to {location}", status=307
+        )
+        self.tenant_id = tenant_id
+        self.location = location
+        self.retry_after_s = int(retry_after_s)
+
+
 class TenantQuota:
     """Per-tenant refinement of the shared admission gate: an in-flight
     cap, a queue share, and a lines/s token bucket. Passive arithmetic
@@ -355,6 +371,11 @@ class TenantRegistry:
         # touches of the same tenant coalesce on the event instead of
         # compiling the bank twice.
         self._building: dict[str, threading.Event] = {}
+        # post-cutover forwards (runtime/migrate.py): tenant id ->
+        # (location, retry_after_s). A forwarded tenant resolves to 307
+        # until the caller re-resolves its placement; forwards are
+        # re-installed on boot from the migration journals.
+        self._forwards: dict[str, tuple[str, int]] = {}
         self.default_context = TenantContext(
             DEFAULT_TENANT,
             default_engine,
@@ -371,13 +392,16 @@ class TenantRegistry:
         self.rebuilds = 0
         self.unknown = 0
         self.invalid = 0
+        self.forwarded = 0
         obs = getattr(default_engine, "obs", None)
         if obs is not None:
             obs.add_stats_collector("tenants", self.stats, METRIC_SAMPLES)
 
     # ------------------------------------------------------------ resolve
 
-    def resolve(self, tenant_id: str | None) -> TenantContext:
+    def resolve(
+        self, tenant_id: str | None, *, ignore_forward: bool = False
+    ) -> TenantContext:
         """Map a wire tenant id to its context, building on first use.
         None/empty → default tenant (single-tenant back-compat).
 
@@ -386,7 +410,13 @@ class TenantRegistry:
         transports do so in the same ``finally`` that releases the
         admission slot). The pin keeps eviction off the engine for the
         whole request — the quota's inflight/queued counters only cover
-        the stretch after ``admission.acquire``."""
+        the stretch after ``admission.acquire``.
+
+        ``ignore_forward`` is for the migration protocol's own internal
+        resolutions (e.g. the target's bank verification while this
+        process still holds a stale outbound forward for a tenant coming
+        BACK): traffic routing must keep answering 307 until ownership
+        actually returns, so only ``runtime/migrate.py`` passes it."""
         faults.fire(  # conlint: contained-by-caller (transport error path)
             "tenant_resolve", key=tenant_id or DEFAULT_TENANT
         )
@@ -398,6 +428,15 @@ class TenantRegistry:
             with self._lock:
                 self.invalid += 1
             raise TenantError(f"invalid tenant id {tenant_id!r}", status=400)
+        if not ignore_forward:
+            with self._lock:
+                fwd = self._forwards.get(tenant_id)
+                if fwd is not None:
+                    # post-cutover: another process owns this tenant now.
+                    # Refuse to serve (stale local state would fork the
+                    # frequency history) and point the caller at the owner.
+                    self.forwarded += 1
+                    raise TenantForwarded(tenant_id, fwd[0], fwd[1])
         while True:
             with self._lock:
                 ctx = self._contexts.get(tenant_id)
@@ -570,6 +609,42 @@ class TenantRegistry:
                 return self.default_context
             return self._contexts.get(tenant_id)
 
+    def set_forward(self, tenant_id: str, location: str,
+                    retry_after_s: int = 5) -> None:
+        """Install a post-cutover forward: every subsequent resolve of
+        ``tenant_id`` raises :class:`TenantForwarded` (307 + Location +
+        Retry-After on the wire) until :meth:`clear_forward`."""
+        with self._lock:
+            self._forwards[tenant_id] = (location, int(retry_after_s))
+
+    def clear_forward(self, tenant_id: str) -> bool:
+        """Drop a forward (the tenant migrated back, or ownership was
+        re-assigned by the fleet router)."""
+        with self._lock:
+            return self._forwards.pop(tenant_id, None) is not None
+
+    def forward_for(self, tenant_id: str) -> tuple[str, int] | None:
+        with self._lock:
+            return self._forwards.get(tenant_id)
+
+    def forward_count(self) -> int:
+        with self._lock:
+            return len(self._forwards)
+
+    def detach(self, tenant_id: str) -> TenantContext | None:
+        """Remove a tenant from residency WITHOUT closing it — the
+        migration engine detaches after cutover and closes the context
+        itself, outside the registry lock. Returns the context, or None
+        if the tenant was not resident (the default tenant is never
+        detachable)."""
+        with self._lock:
+            if not tenant_id or tenant_id == DEFAULT_TENANT:
+                return None
+            ctx = self._contexts.pop(tenant_id, None)
+            if ctx is not None:
+                self._order.remove(tenant_id)
+            return ctx
+
     def shutdown(self) -> None:
         """Close every non-default tenant (the default engine's parts are
         torn down by the server's own shutdown sequence)."""
@@ -602,5 +677,7 @@ class TenantRegistry:
                 "rebuilds": self.rebuilds,
                 "unknown": self.unknown,
                 "invalid": self.invalid,
+                "forwarded": self.forwarded,
+                "forwards": len(self._forwards),
                 "perTenant": per_tenant,
             }

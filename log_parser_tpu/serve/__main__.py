@@ -97,6 +97,29 @@ def main(argv: list[str] | None = None) -> int:
         help="SIGTERM drain deadline: finish in-flight work up to this "
         "many seconds before exiting (LOG_PARSER_TPU_DRAIN_S)",
     )
+    # tenant evacuation (docs/OPS.md "Tenant migration & drain")
+    parser.add_argument(
+        "--drain-deadline-s", type=float, default=None, metavar="SECONDS",
+        help="bound on the drain supervisor's tenant evacuation "
+        "(/admin/drain + SIGTERM): past it, remaining tenants close "
+        "locally — open stream sessions get an explicit error frame, "
+        "never an indefinite hang (default 30; "
+        "LOG_PARSER_TPU_DRAIN_DEADLINE_S)",
+    )
+    parser.add_argument(
+        "--drain-target", default=None, metavar="URL",
+        help="peer base URL (http://host:port) that drained tenants "
+        "migrate to via the crash-safe migration protocol "
+        "(runtime/migrate.py); unset = tenants close locally on drain "
+        "(LOG_PARSER_TPU_DRAIN_TARGET)",
+    )
+    parser.add_argument(
+        "--drain-on-burn", type=float, default=None, metavar="SECONDS",
+        help="poll interval for the health-driven drain trigger: when "
+        "/q/health SLO burn goes DEGRADED or the device breaker sticks "
+        "open, the supervisor evacuates this process; 0 disables "
+        "(default 0; LOG_PARSER_TPU_DRAIN_ON_BURN)",
+    )
     # cross-request micro-batching (docs/OPS.md "Micro-batching")
     parser.add_argument(
         "--batching", choices=("on", "off"), default=None,
@@ -353,6 +376,9 @@ def main(argv: list[str] | None = None) -> int:
         (args.tenant_max_inflight, "LOG_PARSER_TPU_TENANT_MAX_INFLIGHT"),
         (args.tenant_max_queued, "LOG_PARSER_TPU_TENANT_MAX_QUEUED"),
         (args.tenant_lines_per_s, "LOG_PARSER_TPU_TENANT_LINES_PER_S"),
+        (args.drain_deadline_s, "LOG_PARSER_TPU_DRAIN_DEADLINE_S"),
+        (args.drain_target, "LOG_PARSER_TPU_DRAIN_TARGET"),
+        (args.drain_on_burn, "LOG_PARSER_TPU_DRAIN_ON_BURN"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -667,11 +693,90 @@ def main(argv: list[str] | None = None) -> int:
             mgr.emit_threshold,
             mgr.ttl_s,
         )
+    # crash-safe tenant migration + health-driven drain (runtime/migrate.py,
+    # docs/OPS.md "Tenant migration & drain"). The Migrator needs --state-dir
+    # for its per-migration journals; the DrainSupervisor is wired
+    # unconditionally so /admin/drain and SIGTERM finalize EVERY resident
+    # tenant (fold WALs, flush batchers, dump spans) even on stateless nodes.
+    from log_parser_tpu.runtime.migrate import (
+        DrainSupervisor,
+        HttpTarget,
+        Migrator,
+    )
+
+    drain_deadline = float(
+        os.environ.get("LOG_PARSER_TPU_DRAIN_DEADLINE_S", "30") or 30
+    )
+    drain_target_url = (
+        os.environ.get("LOG_PARSER_TPU_DRAIN_TARGET", "").strip() or None
+    )
+    migrator = None
+    if state_dir:
+        migrator = Migrator(
+            tenants,
+            state_root=state_dir,
+            node_url=f"http://{args.host}:{args.port}",
+        )
+        server.migrator = migrator
+        # boot-time recovery: exactly-one-owner after any crash — re-install
+        # forwards for cut-over migrations, resume the ones whose target we
+        # still know, discard half-staged imports
+        recovered = migrator.recover(
+            {drain_target_url: HttpTarget(drain_target_url)}
+            if drain_target_url
+            else None
+        )
+        if any(v for v in recovered.values()):
+            log.info(
+                "Migration recovery: %d forward(s) re-installed, "
+                "%d resumed, %d staged import(s) discarded, %d pending",
+                len(recovered["forwards"]),
+                len(recovered["resumed"]),
+                len(recovered["discarded"]),
+                len(recovered["pending"]),
+            )
+    drain_supervisor = DrainSupervisor(
+        tenants,
+        migrator,
+        gate=server.admission,
+        target=(
+            HttpTarget(drain_target_url, timeout_s=max(5.0, drain_deadline))
+            if drain_target_url
+            else None
+        ),
+        deadline_s=drain_deadline,
+        span_dump_path=engine.obs.span_dump_path,
+    )
+    server.drain_supervisor = drain_supervisor
+    drain_on_burn = float(
+        os.environ.get("LOG_PARSER_TPU_DRAIN_ON_BURN", "0") or 0
+    )
+    if drain_on_burn > 0:
+
+        def _evacuation_check() -> str | None:
+            slo = engine.obs.slo.health()
+            if slo is not None and slo.get("status") != "UP":
+                return "slo-burn"
+            if engine.watchdog.circuit_open:
+                return "device-breaker"
+            return None
+
+        drain_supervisor.watch_health(_evacuation_check, poll_s=drain_on_burn)
+        log.info(
+            "Health-driven drain armed: poll %.1fs, target %s",
+            drain_on_burn,
+            drain_target_url or "<close locally>",
+        )
     install_drain_handlers(
         server,
         server.admission,
         log,
-        on_drained=None if journal is None else journal.flush,
+        # SIGTERM evacuates: migrate every resident tenant to the drain
+        # target (or close it with a final WAL fold) under the bounded
+        # deadline, then finalize the default engine's journal/batcher and
+        # dump the span file — the satellite guarantee that shutdown folds
+        # EVERY tenant, not just the default WAL
+        on_drained=lambda: drain_supervisor.drain(reason="signal"),
     )
     # canary-gated hot reload: POST /patterns/reload re-reads this
     # directory (or takes inline YAML); --watch-patterns polls it
@@ -701,6 +806,7 @@ def main(argv: list[str] | None = None) -> int:
         log.info("Shutting down")
     finally:
         server.server_close()
+        drain_supervisor.stop_watch()
         if server.watcher is not None:
             server.watcher.stop()
         # tenant engines first: closes their batchers/stream sessions and

@@ -50,7 +50,11 @@ from log_parser_tpu.runtime import faults
 from log_parser_tpu.utils import xlacache
 from log_parser_tpu.runtime.engine import AnalysisEngine
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
-from log_parser_tpu.runtime.tenancy import TenantError, TenantRegistry
+from log_parser_tpu.runtime.tenancy import (
+    TenantError,
+    TenantForwarded,
+    TenantRegistry,
+)
 from log_parser_tpu.serve.admission import AdmissionRejected, shared_gate
 
 log = logging.getLogger(__name__)
@@ -60,6 +64,10 @@ _INVALID = b'{"error":"Invalid PodFailureData provided"}'
 # not parse traffic — bound them so a runaway payload cannot balloon the
 # process before validation even starts
 _ADMIN_MAX_BODY = 4 << 20
+# a migration bundle carries a whole tenant's folded state (frequency
+# ages + parked candidates + session windows) — bounded by the same cap
+# the frequency WAL puts on one record
+_MIGRATE_MAX_BODY = 64 << 20
 _TOO_LARGE = b'{"error":"payload too large"}'
 
 
@@ -108,6 +116,11 @@ class ParseServer(ThreadingHTTPServer):
         self.stream_manager = None
         self.stream_enabled = True
         self._stream_lock = threading.Lock()
+        # tenant migration + drain (runtime/migrate.py): wired by
+        # serve/__main__.py when --state-dir is set; None answers the
+        # admin routes with 501
+        self.migrator = None
+        self.drain_supervisor = None
 
     @property
     def dropped_responses(self) -> int:
@@ -195,6 +208,21 @@ class _Handler(BaseHTTPRequestHandler):
             ctx = self.server.tenants.resolve(self.headers.get("X-Tenant"))
             self._leases.append(ctx)
             return ctx
+        except TenantForwarded as exc:
+            # post-cutover forward (runtime/migrate.py): the tenant lives
+            # elsewhere now. 307 preserves the method+body; Retry-After
+            # paces callers that re-resolve through a stale balancer.
+            self._send_json(
+                exc.status,
+                json.dumps(
+                    {"error": exc.reason, "location": exc.location}
+                ).encode(),
+                headers={
+                    "Location": exc.location,
+                    "Retry-After": str(exc.retry_after_s),
+                },
+            )
+            return None
         except TenantError as exc:
             self._send_json(
                 exc.status,
@@ -239,6 +267,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._mined_post()
         if self.path == "/debug/profile":
             return self._debug_profile()
+        if self.path == "/admin/migrate":
+            return self._admin_migrate()
+        if self.path == "/admin/migrate/import":
+            return self._admin_migrate_import()
+        if self.path == "/admin/migrate/activate":
+            return self._admin_migrate_activate()
+        if self.path == "/admin/drain":
+            return self._admin_drain()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
@@ -402,6 +438,152 @@ class _Handler(BaseHTTPRequestHandler):
             )
         return self._send_json(200, json.dumps(result).encode())
 
+    # ---------------------------------------------------- migration admin
+
+    def _admin_body(self, max_body: int = _ADMIN_MAX_BODY):
+        """Parsed JSON object body for an admin route, or None after
+        answering the error."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > max_body:
+                self._send_json(413, _TOO_LARGE)
+                return None
+            body = json.loads(self.rfile.read(length) if length else b"{}")
+        except ValueError:
+            self._send_json(400, b'{"error":"bad request body"}')
+            return None
+        if not isinstance(body, dict):
+            self._send_json(400, b'{"error":"expected a JSON object"}')
+            return None
+        return body
+
+    def _require_migrator(self):
+        mig = self.server.migrator
+        if mig is None:
+            self._send_json(
+                501,
+                b'{"error":"migration is not enabled (serve with '
+                b'--state-dir)"}',
+            )
+        return mig
+
+    def _admin_migrate(self) -> None:
+        """``POST /admin/migrate`` ``{"tenant": id, "target": url[,
+        "retryAfterS": n]}``: run the full source side of the migration
+        protocol against the target process's import endpoints. Blocks
+        until CUTOVER+COMPLETE (or a pre-cutover abort, answered as a
+        structured 4xx/5xx with the tenant still owned here)."""
+        from log_parser_tpu.runtime.migrate import HttpTarget, MigrationError
+
+        mig = self._require_migrator()
+        if mig is None:
+            return
+        body = self._admin_body()
+        if body is None:
+            return
+        tenant = body.get("tenant")
+        target = body.get("target")
+        if not isinstance(tenant, str) or not isinstance(target, str):
+            return self._send_json(
+                400, b'{"error":"expected {tenant, target}"}'
+            )
+        try:
+            retry_after = int(body.get("retryAfterS", 5))
+        except (TypeError, ValueError):
+            return self._send_json(400, b'{"error":"bad retryAfterS"}')
+        try:
+            summary = mig.migrate(
+                tenant, HttpTarget(target), retry_after_s=retry_after
+            )
+        except MigrationError as exc:
+            return self._send_json(
+                exc.status, json.dumps({"error": exc.reason}).encode()
+            )
+        except Exception:
+            log.exception("migration of %r failed", tenant)
+            return self._send_json(
+                500, b'{"error":"Internal migration failure"}'
+            )
+        return self._send_json(200, json.dumps(summary).encode())
+
+    def _admin_migrate_import(self) -> None:
+        """``POST /admin/migrate/import`` ``{"bundle": {...}, "sha":
+        hex}``: the target half's STAGE step — verify + warm-build +
+        persist, ack with the sha. Nothing is applied until activate."""
+        from log_parser_tpu.runtime.migrate import MigrationError
+
+        mig = self._require_migrator()
+        if mig is None:
+            return
+        body = self._admin_body(max_body=_MIGRATE_MAX_BODY)
+        if body is None:
+            return
+        bundle = body.get("bundle")
+        sha = body.get("sha")
+        if not isinstance(bundle, dict) or not isinstance(sha, str):
+            return self._send_json(
+                400, b'{"error":"expected {bundle, sha}"}'
+            )
+        try:
+            ack = mig.stage_import(bundle, sha)
+        except MigrationError as exc:
+            return self._send_json(
+                exc.status, json.dumps({"error": exc.reason}).encode()
+            )
+        except Exception:
+            log.exception("migration import failed")
+            return self._send_json(
+                500, b'{"error":"Internal import failure"}'
+            )
+        return self._send_json(200, json.dumps(ack).encode())
+
+    def _admin_migrate_activate(self) -> None:
+        """``POST /admin/migrate/activate`` ``{"mid": id}``: apply a
+        staged import (the source's CUTOVER is durable by the time it
+        calls this)."""
+        from log_parser_tpu.runtime.migrate import MigrationError
+
+        mig = self._require_migrator()
+        if mig is None:
+            return
+        body = self._admin_body()
+        if body is None:
+            return
+        mid = body.get("mid")
+        if not isinstance(mid, str) or not mid:
+            return self._send_json(400, b'{"error":"expected {mid}"}')
+        try:
+            summary = mig.activate(mid)
+        except MigrationError as exc:
+            return self._send_json(
+                exc.status, json.dumps({"error": exc.reason}).encode()
+            )
+        except Exception:
+            log.exception("migration activate failed")
+            return self._send_json(
+                500, b'{"error":"Internal activate failure"}'
+            )
+        return self._send_json(200, json.dumps(summary).encode())
+
+    def _admin_drain(self) -> None:
+        """``POST /admin/drain``: run one drain-supervisor pass — flip
+        admission (readiness 503), migrate every resident tenant to the
+        configured ``--drain-target`` under ``--drain-deadline-s``
+        (bounded local close when there is no target), finalize every
+        engine. Blocks until the pass completes and returns its summary;
+        the process keeps running (SIGTERM drains AND exits)."""
+        sup = self.server.drain_supervisor
+        if sup is None:
+            return self._send_json(
+                501, b'{"error":"drain supervisor is not enabled"}'
+            )
+        try:
+            summary = sup.drain(reason="admin")
+        except Exception:
+            log.exception("drain failed")
+            return self._send_json(500, b'{"error":"Internal drain failure"}')
+        return self._send_json(200, json.dumps(summary).encode())
+
     def _route_get(self) -> None:
         if self.path in ("/health", "/health/live", "/health/ready", "/q/health"):
             # draining: readiness fails (load balancers stop sending) but
@@ -416,6 +598,24 @@ class _Handler(BaseHTTPRequestHandler):
             # (circuit open) or the coordinator's local devices (follower
             # group dead) — but the degradation is visible to probes
             checks = []
+            sup = self.server.drain_supervisor
+            if (sup is not None and sup.draining) or (
+                self.server.admission.draining
+            ):
+                # the drain supervisor is evacuating this process: the
+                # aggregated probe reports a DRAINING check, and answers
+                # ready-503 so load balancers stop routing here while
+                # in-flight migrations finish. Liveness (/health,
+                # /health/live) holds throughout — killing a draining
+                # process forfeits the handoff.
+                checks.append({"name": "drain", "status": "DRAINING"})
+                if self.path == "/q/health":
+                    return self._send_json(
+                        503,
+                        json.dumps(
+                            {"status": "DRAINING", "checks": checks}
+                        ).encode(),
+                    )
             if self.server.engine.watchdog.circuit_open:
                 checks.append({"name": "device", "status": "DEGRADED"})
             mesh = getattr(self.server.engine, "mesh_health", None)
@@ -549,6 +749,15 @@ class _Handler(BaseHTTPRequestHandler):
             # tenant residency/quota counters (docs/OPS.md "Multi-tenant
             # serving")
             payload["tenants"] = self.server.tenants.stats()
+            migrator = self.server.migrator
+            if migrator is not None:
+                # migration protocol + drain counters (docs/OPS.md
+                # "Tenant migration & drain")
+                mig_stats = migrator.stats()
+                sup = self.server.drain_supervisor
+                if sup is not None:
+                    mig_stats["drain"] = sup.stats()
+                payload["migration"] = mig_stats
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats

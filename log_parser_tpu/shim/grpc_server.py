@@ -57,7 +57,12 @@ def _tenant_code(exc: TenantError):
     """Status for a refused tenant resolution: unknown tenant (404) is
     NOT_FOUND — a typo or a not-yet-provisioned tenant — while a
     malformed id (400) is INVALID_ARGUMENT, the same split the HTTP
-    transport answers."""
+    transport answers. A migrated-away tenant (307, TenantForwarded) is
+    UNAVAILABLE: the status message carries the new owner's URL (same
+    text the HTTP 307 body sends) so the caller can re-resolve — gRPC
+    has no redirect status, and UNAVAILABLE is the retryable class."""
+    if exc.status == 307:
+        return grpc.StatusCode.UNAVAILABLE
     return (
         grpc.StatusCode.NOT_FOUND
         if exc.status == 404

@@ -105,6 +105,13 @@ class LogParserService:
         except QuarantineRejected as exc:
             status, detail = exc.status, "quarantined"
             raise
+        except TenantError as exc:
+            # keep the real tenant status in the trace ring — a migrated
+            # tenant (307, TenantForwarded) must not be counted as a 400;
+            # the exception message carries the new owner's URL for the
+            # transport envelope (framed error frame / gRPC UNAVAILABLE)
+            status, detail = exc.status, type(exc).__name__
+            raise
         except CLIENT_ERRORS as exc:
             status, detail = 400, type(exc).__name__
             raise

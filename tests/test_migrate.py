@@ -1,0 +1,674 @@
+"""Crash-safe tenant live migration (runtime/migrate.py): the
+single-owner contract.
+
+The anchor is the crash matrix: a simulated ``kill -9`` (the
+``crash_after`` hook — fsync'd record, no cleanup) at EVERY protocol
+record boundary, on both sides, must recover to exactly one owner, and
+the surviving owner's responses and frequency state must stay
+bit-identical to an unmigrated control run of the same traffic under
+the same (fake) clock. Around it: happy-path parity (unbatched,
+batched, streaming, line cache on/off), the forward envelope
+(TenantForwarded 307 with location + Retry-After), live stream-session
+adoption vs bounded error-frame close, bundle integrity (sha sidecar,
+version gate, bank content-hash mismatch), the CRC-framed journal's
+torn-tail quarantine, and the DrainSupervisor: migrate-everything-out
+under a bounded deadline, the no-target/past-deadline bounded close
+(a stream-pinned tenant never hangs SIGTERM), multi-tenant
+finalization, and the health-driven trigger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.patterns import load_pattern_directory
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.migrate import (
+    BUNDLE_VERSION,
+    DrainSupervisor,
+    LocalTarget,
+    MigrationCrash,
+    MigrationError,
+    MigrationJournal,
+    Migrator,
+    SOURCE_RECORDS,
+    TARGET_RECORDS,
+    canonical_bundle_bytes,
+)
+from log_parser_tpu.runtime.stream import shared_manager
+from log_parser_tpu.runtime.tenancy import (
+    TenantError,
+    TenantForwarded,
+    TenantRegistry,
+)
+from log_parser_tpu.serve.admission import shared_gate
+
+from helpers import make_pattern, make_pattern_set
+
+ACME_YAML = """
+metadata:
+  library_id: acme-lib
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+  - id: err
+    name: Errors
+    severity: LOW
+    primary_pattern:
+      regex: "\\\\bERROR\\\\b"
+      confidence: 0.5
+"""
+
+GLOBEX_YAML = """
+metadata:
+  library_id: globex-lib
+patterns:
+  - id: conn
+    name: Connection refused
+    severity: HIGH
+    primary_pattern:
+      regex: "Connection refused"
+      confidence: 0.7
+"""
+
+# a DIFFERENT acme library (extra pattern): staging against it must fail
+# the bank content-hash check, not silently change scores
+ACME_DRIFTED_YAML = ACME_YAML + """\
+  - id: extra
+    name: Drifted
+    severity: LOW
+    primary_pattern:
+      regex: DRIFT
+      confidence: 0.4
+"""
+
+TRAFFIC = [
+    "INFO boot\njava.lang.OutOfMemoryError: heap\nan ERROR here",
+    "Connection refused by peer\nINFO ok",
+    "ERROR twice\nERROR again\nOutOfMemoryError",
+    "nothing to see",
+    "Connection refused\njava.lang.OutOfMemoryError: metaspace\nERROR",
+    "INFO a\nINFO b\nan ERROR here",
+]
+
+PREFIX, SUFFIX = TRAFFIC[:3], TRAFFIC[3:]
+
+
+class FakeClock:
+    """Shared, manually-stepped monotonic clock: frequency ages are
+    clock-relative, so bit-identical parity needs every engine — the
+    migrating pair AND the unmigrated control — to observe the same
+    request at the same instant."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture()
+def root(tmp_path):
+    for tid, text in (("acme", ACME_YAML), ("globex", GLOBEX_YAML)):
+        d = tmp_path / "tenants" / tid
+        d.mkdir(parents=True)
+        (d / "lib.yaml").write_text(text)
+    return str(tmp_path / "tenants")
+
+
+def _default_engine(clk=None) -> AnalysisEngine:
+    return AnalysisEngine(
+        [make_pattern_set([make_pattern("base", regex="BASE")], "base-lib")],
+        ScoringConfig(),
+        clock=clk or time.monotonic,
+    )
+
+
+def _data(blob: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "t"}}, logs=blob)
+
+
+def _events(result) -> list[tuple]:
+    d = result.to_dict(drop_none=True)
+    return [
+        (e["lineNumber"], e["matchedPattern"]["id"], e["score"])
+        for e in d.get("events", [])
+    ] + [
+        (d["summary"]["significantEvents"], d["summary"]["highestSeverity"])
+    ]
+
+
+def _side(tmp_path, root, name, clk, crash_after=None, journaled=False,
+          engine_setup=None):
+    """One 'process': a registry over the shared tenant root + its
+    Migrator over a per-side state dir. Re-calling with the same name
+    over the same dirs is the restart half of a kill -9 simulation."""
+    state = tmp_path / name
+    state.mkdir(exist_ok=True)
+    setup = engine_setup
+    if journaled:
+        def setup(eng, tid):  # noqa: F811 - deliberate override
+            # the journal stamps records with wall time; parity across a
+            # simulated restart needs that clock frozen too
+            eng.attach_journal(str(state / "wal" / tid), wall=clk)
+
+    reg = TenantRegistry(
+        _default_engine(clk), root=root, clock=clk or time.monotonic,
+        engine_setup=setup,
+    )
+    mig = Migrator(
+        reg, state_root=str(state), node_url=f"local://{name}",
+        crash_after=crash_after,
+    )
+    return reg, mig
+
+
+def _control(tmp_path, root, clk, journaled=False):
+    """The unmigrated control: a dedicated acme engine fed the whole
+    traffic sequence on one node. Rebuilding it over the same WAL dir is
+    the control's matching 'restart'."""
+    eng = AnalysisEngine(
+        load_pattern_directory(f"{root}/acme"), ScoringConfig(), clock=clk
+    )
+    if journaled:
+        eng.attach_journal(str(tmp_path / "control" / "acme"), wall=clk)
+    return eng
+
+
+# -------------------------------------------------------- happy path
+
+
+class TestHappyPath:
+    def test_completed_migration_moves_ownership(self, root, tmp_path):
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+            res = mig_a.migrate(
+                "acme", LocalTarget(mig_b, url="local://b"), retry_after_s=7
+            )
+            assert res["outcome"] == "completed"
+            assert res["tenant"] == "acme" and res["target"] == "local://b"
+            # the forward envelope: 307 + location + Retry-After
+            assert reg_a.forward_for("acme") == ("local://b", 7)
+            with pytest.raises(TenantForwarded) as ei:
+                reg_a.resolve("acme")
+            assert ei.value.status == 307
+            assert ei.value.location == "local://b"
+            assert ei.value.retry_after_s == 7
+            # the target serves; the source's other tenants are untouched
+            assert reg_b.resolve("acme").engine.bank.n_patterns == 2
+            assert reg_a.resolve(None) is reg_a.default_context
+            assert mig_a.stats()["completed"] == 1
+            assert mig_a.stats()["forwards"] == 1
+            assert mig_b.stats()["staged"] == 1
+            assert mig_b.stats()["activated"] == 1
+            # a second attempt is refused: the tenant already left
+            with pytest.raises(MigrationError) as mei:
+                mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            assert mei.value.status == 409
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_default_tenant_is_not_migratable(self, root, tmp_path):
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            with pytest.raises(MigrationError) as ei:
+                mig_a.migrate("default", LocalTarget(mig_b))
+            assert ei.value.status == 400
+            with pytest.raises((MigrationError, TenantError)):
+                mig_a.migrate("no-such-tenant", LocalTarget(mig_b))
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    @pytest.mark.parametrize("cache", [False, True], ids=["nocache", "cache"])
+    def test_parity_unbatched(self, root, tmp_path, cache):
+        clk = FakeClock()
+        setup = (
+            (lambda eng, tid: eng.enable_line_cache(8)) if cache else None
+        )
+        reg_a, mig_a = _side(tmp_path, root, "a", clk, engine_setup=setup)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk, engine_setup=setup)
+        ctl = _control(tmp_path, root, clk)
+        if cache:
+            ctl.enable_line_cache(8)
+        try:
+            for i, blob in enumerate(PREFIX):
+                clk.t = float(i + 1)
+                got = _events(reg_a.resolve("acme").engine.analyze(_data(blob)))
+                assert got == _events(ctl.analyze(_data(blob))), blob
+            clk.t = 10.0
+            mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            for i, blob in enumerate(SUFFIX):
+                clk.t = float(20 + i)
+                got = _events(reg_b.resolve("acme").engine.analyze(_data(blob)))
+                assert got == _events(ctl.analyze(_data(blob))), blob
+            clk.t = 40.0
+            snap = reg_b.resolve("acme").engine.frequency.snapshot()
+            assert snap == ctl.frequency.snapshot()
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_parity_batched(self, root, tmp_path):
+        clk = FakeClock()
+
+        def setup(eng, tid):
+            eng.enable_batching(wait_ms=1.0, batch_max=4)
+
+        reg_a, mig_a = _side(tmp_path, root, "a", clk, engine_setup=setup)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk, engine_setup=setup)
+        ctl = _control(tmp_path, root, clk)
+        ctl.enable_batching(wait_ms=1.0, batch_max=4)
+        try:
+            for i, blob in enumerate(PREFIX):
+                clk.t = float(i + 1)
+                got = _events(
+                    reg_a.resolve("acme").engine.analyze_batched(_data(blob))
+                )
+                assert got == _events(ctl.analyze_batched(_data(blob))), blob
+            clk.t = 10.0
+            mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            for i, blob in enumerate(SUFFIX):
+                clk.t = float(20 + i)
+                got = _events(
+                    reg_b.resolve("acme").engine.analyze_batched(_data(blob))
+                )
+                assert got == _events(ctl.analyze_batched(_data(blob))), blob
+            clk.t = 40.0
+            snap = reg_b.resolve("acme").engine.frequency.snapshot()
+            assert snap == ctl.frequency.snapshot()
+        finally:
+            ctl.batcher.close()
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+
+# ------------------------------------------------- live stream sessions
+
+
+class TestStreamHandoff:
+    def test_local_target_adopts_live_session(self, root, tmp_path):
+        clk = FakeClock()
+        reg_a, mig_a = _side(tmp_path, root, "a", clk)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk)
+        ctl = _control(tmp_path, root, clk)
+        try:
+            mgr_a = shared_manager(reg_a.resolve("acme").engine)
+            sess = mgr_a.open()
+            csess = shared_manager(ctl).open()
+            blob = ("\n".join(TRAFFIC) + "\n").encode()
+            chunks = [blob[i:i + 37] for i in range(0, len(blob), 37)]
+            half = len(chunks) // 2
+            for i, c in enumerate(chunks[:half]):
+                clk.t = float(i + 1)
+                assert [f["type"] for f in sess.feed(c)] == [
+                    f["type"] for f in csess.feed(c)
+                ]
+            clk.t = 100.0
+            res = mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            # the session MOVED: same object, re-based onto b's engine,
+            # no error frame ever reached the client
+            assert res["sessionsMoved"] == 1 and res["sessionsClosed"] == 0
+            mgr_b = reg_b.resolve("acme").engine.stream_manager
+            assert sess.manager is mgr_b
+            assert mgr_a.stats()["sessionsMigrated"] == 1
+            assert mgr_b.stats()["sessionsAdopted"] == 1
+            for i, c in enumerate(chunks[half:]):
+                clk.t = float(101 + i)
+                assert [f["type"] for f in sess.feed(c)] == [
+                    f["type"] for f in csess.feed(c)
+                ]
+            clk.t = 200.0
+            assert [f["type"] for f in sess.close()] == [
+                f["type"] for f in csess.close()
+            ]
+            # streaming frequency commits exactly once, at close: the
+            # adopted session's history matches the unmigrated control
+            snap = reg_b.resolve("acme").engine.frequency.snapshot()
+            assert snap == ctl.frequency.snapshot()
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_unadoptable_session_closes_with_error_frame(self, root,
+                                                         tmp_path):
+        # an HttpTarget cannot carry a live socket; the session must be
+        # closed with an explicit error frame naming the new owner —
+        # never left to hang (satellite: bounded drain of pinned streams)
+        class NoAdopt(LocalTarget):
+            can_adopt_sessions = False
+
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            sess = shared_manager(reg_a.resolve("acme").engine).open()
+            sess.feed(b"an ERROR here\n")
+            res = mig_a.migrate("acme", NoAdopt(mig_b, url="local://b"))
+            assert res["sessionsClosed"] == 1 and res["sessionsMoved"] == 0
+            frames = sess.feed(b"more\n")
+            assert frames[-1]["type"] == "error"
+            assert frames[-1]["reason"] == "migrated"
+            assert "local://b" in frames[-1]["message"]
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+
+# ----------------------------------------------------- the crash matrix
+
+# every record boundary where the crash_after hook can fire: the two
+# terminal records (complete/applied) have nothing after them to lose
+CRASH_KINDS = [
+    k for k in SOURCE_RECORDS + TARGET_RECORDS
+    if k not in ("complete", "applied")
+]
+# boundaries past the commit point: ownership has moved, recovery must
+# finish the handoff; everything earlier recovers to source-owned
+POST_CUTOVER = ("cutover", "activate")
+
+
+class TestCrashMatrix:
+    @pytest.mark.parametrize("kind", CRASH_KINDS)
+    def test_kill_at_boundary_recovers_single_owner(self, root, tmp_path,
+                                                    kind):
+        clk = FakeClock()
+        reg_a, mig_a = _side(tmp_path, root, "a", clk,
+                             crash_after={kind}, journaled=True)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk,
+                             crash_after={kind}, journaled=True)
+        ctl = _control(tmp_path, root, clk, journaled=True)
+        for i, blob in enumerate(PREFIX):
+            clk.t = float(i + 1)
+            got = _events(reg_a.resolve("acme").engine.analyze(_data(blob)))
+            assert got == _events(ctl.analyze(_data(blob))), blob
+        # pre-migration traffic is durable on both sides; the crash under
+        # test is the migration boundary, not the WAL's group fsync
+        clk.t = 10.0
+        reg_a.resolve("acme").engine.journal.flush()
+        ctl.journal.flush()
+        with pytest.raises(MigrationCrash):
+            mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+        # kill -9 both nodes: no shutdown, no flush — fresh registries and
+        # Migrators over the same state dirs are the restarted processes
+        reg_a2, mig_a2 = _side(tmp_path, root, "a", clk, journaled=True)
+        reg_b2, mig_b2 = _side(tmp_path, root, "b", clk, journaled=True)
+        ctl2 = _control(tmp_path, root, clk, journaled=True)
+        try:
+            sum_b = mig_b2.recover()
+            sum_a = mig_a2.recover(
+                {"local://b": LocalTarget(mig_b2, url="local://b")}
+            )
+            if kind in POST_CUTOVER:
+                # the commit record is durable: ownership moved; recovery
+                # re-installs the forward and finishes the handoff
+                assert reg_a2.forward_for("acme") == ("local://b", 5)
+                assert sum_a["forwards"] == ["acme"]
+                assert sum_a["resumed"] or sum_b["resumed"]
+                with pytest.raises(TenantForwarded) as ei:
+                    reg_a2.resolve("acme")
+                assert ei.value.location == "local://b"
+                owner = reg_b2
+            else:
+                # no commit record: the tenant never left; the source
+                # journal seals to ABORT and any staged copy is discarded
+                assert reg_a2.forward_for("acme") is None
+                assert len(sum_a["discarded"]) == 1
+                assert not sum_a["forwards"] and not sum_a["resumed"]
+                if kind in ("import_ack", "stage", "staged"):
+                    assert len(sum_b["discarded"]) == 1
+                assert mig_b2.stats()["stagedNow"] == 0
+                owner = reg_a2
+            for i, blob in enumerate(SUFFIX):
+                clk.t = float(20 + i)
+                got = _events(
+                    owner.resolve("acme").engine.analyze(_data(blob))
+                )
+                assert got == _events(ctl2.analyze(_data(blob))), (kind, blob)
+            # the single owner's frequency history is bit-identical to a
+            # run that never migrated (and never crashed mid-protocol)
+            clk.t = 40.0
+            snap = owner.resolve("acme").engine.frequency.snapshot()
+            assert snap == ctl2.frequency.snapshot()
+            # exactly ONE owner: the other side either forwards (raises
+            # 307) or never had the tenant staged
+            loser = reg_a2 if owner is reg_b2 else reg_b2
+            if owner is reg_b2:
+                with pytest.raises(TenantForwarded):
+                    loser.resolve("acme")
+            else:
+                assert "acme" not in loser.resident()
+                assert loser.forward_for("acme") is None
+        finally:
+            reg_a2.shutdown()
+            reg_b2.shutdown()
+
+
+# --------------------------------------------------- bundle integrity
+
+
+def _bare_bundle(mid="mX", tenant="acme"):
+    return {
+        "version": BUNDLE_VERSION,
+        "mid": mid,
+        "tenant": tenant,
+        "libraryKey": None,
+        "frequency": {"ages": {}, "epoch": 0},
+        "pending": [],
+        "sessions": [],
+    }
+
+
+class TestBundleIntegrity:
+    def test_canonical_bytes_are_key_order_independent(self, root):
+        a = canonical_bundle_bytes({"b": 1, "a": [2, {"z": 0, "y": 1}]})
+        b = canonical_bundle_bytes({"a": [2, {"y": 1, "z": 0}], "b": 1})
+        assert a == b
+
+    def test_stage_rejects_bad_sha_and_version(self, root, tmp_path):
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            bundle = _bare_bundle()
+            sha = hashlib.sha256(canonical_bundle_bytes(bundle)).hexdigest()
+            with pytest.raises(MigrationError):
+                mig_b.stage_import(bundle, "0" * 64)
+            bad = dict(bundle, version=99)
+            bad_sha = hashlib.sha256(
+                canonical_bundle_bytes(bad)
+            ).hexdigest()
+            with pytest.raises(MigrationError) as ei:
+                mig_b.stage_import(bad, bad_sha)
+            assert ei.value.status == 400
+            assert mig_b.stats()["stagedNow"] == 0
+            # and the well-formed bundle stages + activates
+            ack = mig_b.stage_import(bundle, sha)
+            assert ack["sha"] == sha
+            assert mig_b.stats()["stagedNow"] == 1
+            out = mig_b.activate("mX")
+            assert out["outcome"] == "activated"
+            with pytest.raises(MigrationError) as nf:
+                mig_b.activate("mX")
+            assert nf.value.status == 404
+        finally:
+            reg_b.shutdown()
+
+    def test_bank_content_hash_mismatch_aborts(self, root, tmp_path):
+        # the target's acme library drifted: staging must fail and the
+        # SOURCE must keep the tenant (scores never silently change)
+        drift_root = tmp_path / "drift-tenants"
+        d = drift_root / "acme"
+        d.mkdir(parents=True)
+        (d / "lib.yaml").write_text(ACME_DRIFTED_YAML)
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, str(drift_root), "b", None)
+        try:
+            reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+            with pytest.raises(MigrationError) as ei:
+                mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            assert "mismatch" in str(ei.value)
+            assert mig_a.stats()["aborted"] == 1
+            assert reg_a.forward_for("acme") is None
+            assert reg_a.resolve("acme").engine.bank.n_patterns == 2
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+
+class TestJournal:
+    def test_torn_tail_is_quarantined(self, tmp_path):
+        path = str(tmp_path / "m.src.wal")
+        jr = MigrationJournal(path)
+        jr.append("begin", mid="m1", tenant="acme")
+        jr.append("quiesce")
+        jr.close()
+        with open(path, "ab") as f:
+            f.write(b"\xff\x00\x00\x00torn-mid-append")
+        recs = MigrationJournal.replay(path)
+        assert [r["k"] for r in recs] == ["begin", "quiesce"]
+        assert os.path.exists(path + ".torn")
+        # the torn bytes were truncated away: replay is now clean and a
+        # reopened journal appends from the last whole record
+        jr2 = MigrationJournal(path)
+        jr2.append("abort", reason="test")
+        jr2.close()
+        assert [r["k"] for r in MigrationJournal.replay(path)] == [
+            "begin", "quiesce", "abort",
+        ]
+
+
+# ------------------------------------------------------------- drain
+
+
+class TestDrain:
+    def test_drain_migrates_every_tenant_out(self, root, tmp_path):
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+            reg_a.resolve("globex").engine.analyze(_data(TRAFFIC[1]))
+            gate = shared_gate(reg_a.default_engine)
+            ds = DrainSupervisor(
+                reg_a, mig_a, gate=gate,
+                target=LocalTarget(mig_b, url="local://b"), deadline_s=30.0,
+            )
+            res = ds.drain(reason="test")
+            assert sorted(res["migrated"]) == ["acme", "globex"]
+            assert res["closed"] == []
+            assert ds.draining and gate.draining
+            # both tenants now live on b; a forwards both
+            assert reg_b.resolve("acme").engine.bank.n_patterns == 2
+            assert reg_b.resolve("globex").engine.bank.n_patterns == 1
+            for tid in ("acme", "globex"):
+                with pytest.raises(TenantForwarded):
+                    reg_a.resolve(tid)
+            # idempotent: a second drain is a no-op, not a second pass
+            assert ds.drain() == {"alreadyDraining": True}
+            s = ds.stats()
+            assert s["drains"] == 1 and s["tenantsMigrated"] == 2
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_drain_without_target_bounded_close(self, root, tmp_path):
+        # no handoff target AND a live stream session pinning the
+        # tenant: drain must still complete, closing the session with an
+        # explicit error frame — never an indefinite hang
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        try:
+            sess = shared_manager(reg_a.resolve("acme").engine).open()
+            sess.feed(b"an ERROR here\n")
+            ds = DrainSupervisor(reg_a, mig_a, deadline_s=5.0)
+            res = ds.drain(reason="sigterm")
+            assert res["closed"] == ["acme"] and res["migrated"] == []
+            assert res["elapsedS"] <= 5.0
+            frames = sess.feed(b"more\n")
+            assert frames[-1]["type"] == "error"
+            assert frames[-1]["reason"] == "draining"
+            assert ds.stats()["sessionsClosed"] == 1
+            assert "acme" not in reg_a.resident()
+        finally:
+            reg_a.shutdown()
+
+    def test_expired_deadline_forces_close_over_migrate(self, root,
+                                                        tmp_path):
+        # a target exists, but the deadline is already gone: the bounded
+        # local close wins — a stream-pinned tenant cannot hold SIGTERM
+        # past --drain-deadline-s
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        reg_b, mig_b = _side(tmp_path, root, "b", None)
+        try:
+            sess = shared_manager(reg_a.resolve("acme").engine).open()
+            sess.feed(b"an ERROR here\n")
+            ds = DrainSupervisor(
+                reg_a, mig_a, target=LocalTarget(mig_b, url="local://b"),
+                deadline_s=0.0,
+            )
+            res = ds.drain(reason="deadline")
+            assert res["closed"] == ["acme"] and res["migrated"] == []
+            assert mig_b.stats()["staged"] == 0
+            assert sess.feed(b"more\n")[-1]["type"] == "error"
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_finalize_all_folds_every_resident_tenant(self, root, tmp_path):
+        # the satellite-2 pin: shutdown finalization covers EVERY
+        # resident tenant's WAL, not just the default engine's
+        clk = FakeClock()
+        reg_a, mig_a = _side(tmp_path, root, "a", clk, journaled=True)
+        try:
+            clk.t = 1.0
+            reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+            clk.t = 2.0
+            reg_a.resolve("globex").engine.analyze(_data(TRAFFIC[1]))
+            clk.t = 5.0
+            snaps = {
+                tid: reg_a.resolve(tid).engine.frequency.snapshot()
+                for tid in ("acme", "globex")
+            }
+            span_path = str(tmp_path / "spans.jsonl")
+            ds = DrainSupervisor(reg_a, mig_a, span_dump_path=span_path)
+            out = ds.finalize_all()
+            assert sorted(out["folded"]) == ["acme", "globex"]
+            assert os.path.exists(span_path)
+            # the fold is durable: a restarted side (no clean shutdown)
+            # rebuilds both tenants to exactly the finalized state
+            reg_a2, _ = _side(tmp_path, root, "a", clk, journaled=True)
+            try:
+                for tid in ("acme", "globex"):
+                    got = reg_a2.resolve(tid).engine.frequency.snapshot()
+                    assert got == snaps[tid], tid
+            finally:
+                reg_a2.shutdown()
+        finally:
+            reg_a.shutdown()
+
+    def test_health_watch_triggers_one_drain(self, root, tmp_path):
+        reg_a, mig_a = _side(tmp_path, root, "a", None)
+        try:
+            reg_a.resolve("acme")
+            verdicts = iter([None, None, "slo-burn"])
+            ds = DrainSupervisor(reg_a, mig_a, deadline_s=5.0)
+            ds.watch_health(lambda: next(verdicts, "slo-burn"), poll_s=0.01)
+            deadline = time.monotonic() + 10.0
+            while not ds.draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            ds.stop_watch()
+            assert ds.draining
+            s = ds.stats()
+            assert s["drains"] == 1 and s["tenantsClosed"] == 1
+        finally:
+            reg_a.shutdown()

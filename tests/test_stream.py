@@ -433,6 +433,7 @@ def test_manager_stats_keys_are_stable():
         [
             "openSessions", "sessionsOpened", "sessionsClosed",
             "sessionsKilled", "sessionsReaped", "sessionsRebased",
+            "sessionsMigrated", "sessionsAdopted",
             "chunksIngested", "bytesIngested", "framesEmitted",
             "framesRevised", "goldenContinuations", "poisonKills",
         ]

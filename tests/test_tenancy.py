@@ -556,7 +556,7 @@ class TestResidency:
             assert set(s) == {
                 "residentTenants", "budgetMb", "residentBankMb", "resolved",
                 "created", "evicted", "rebuilds", "unknown", "invalid",
-                "perTenant",
+                "forwarded", "forwards", "perTenant",
             }
             assert set(s["perTenant"]) == {DEFAULT_TENANT, "acme"}
             per = s["perTenant"]["acme"]

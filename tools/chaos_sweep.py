@@ -198,8 +198,31 @@ tracing & utilization accounting"):
                         force-kept flush traces still commit, and the
                         staging dict drains to zero (no orphans).
 
+Migrate group (``--group migrate``; crash-safe tenant live migration +
+health-driven drain — docs/OPS.md "Tenant migration & drain"):
+
+- ``migrate-live-cutover``     acme moves between two processes over
+                        HTTP; the source 307-forwards with Location +
+                        Retry-After, the target serves the migrated
+                        state.
+- ``migrate-crash-mid-export`` the ``migrate_export`` fault under the
+                        quiesce gate: structured 409 abort, the source
+                        keeps the tenant, no forward.
+- ``migrate-crash-pre-cutover`` the ``migrate_cutover`` fault after the
+                        target staged: the source aborts and keeps
+                        serving; the target's staged copy never
+                        activates (single-owner invariant).
+- ``migrate-drain-under-burst`` /admin/drain races a burst: every
+                        tenant closes under --drain-deadline-s,
+                        /q/health flips to a DRAINING 503, SIGTERM
+                        exits clean.
+- ``migrate-stream-handoff``   a live follow-mode session on the moving
+                        tenant is closed with an explicit error frame
+                        naming the new owner — cutover never hangs on
+                        a pinned stream.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|all]
                                    [--keep-logs]
 """
 
@@ -866,12 +889,16 @@ class StreamClient:
     NDJSON response headers, then interleave chunk writes with frame
     reads on one connection."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, tenant: str | None = None):
         host, _, port = url.removeprefix("http://").partition(":")
         self.sock = socket.create_connection((host, int(port)), timeout=120)
+        tenant_hdr = (
+            f"X-Tenant: {tenant}\r\n".encode() if tenant else b""
+        )
         self.sock.sendall(
             b"POST /parse/stream HTTP/1.1\r\nHost: chaos\r\n"
-            b"Transfer-Encoding: chunked\r\n\r\n"
+            + tenant_hdr
+            + b"Transfer-Encoding: chunked\r\n\r\n"
         )
         buf = b""
         while b"\r\n\r\n" not in buf:
@@ -1469,6 +1496,234 @@ TENANT_STANDALONE = [
 ]
 
 
+# ------------------------------------------------- migrate group scenarios
+
+
+def _migrate_pair(tmp: str, src_name: str, dst_name: str,
+                  src_env: dict | None = None,
+                  src_flags: list | None = None):
+    """Two serve processes sharing one tenant library root (the bank
+    content-hash check requires identical pattern config on both sides),
+    each with its own --state-dir for WALs and migration journals."""
+    root = _make_tenant_root(tmp)
+    src = Server(
+        src_name,
+        ["--tenant-root", root,
+         "--state-dir", os.path.join(tmp, "src_state"),
+         *(src_flags or [])],
+        src_env or {},
+    )
+    dst = Server(
+        dst_name,
+        ["--tenant-root", root,
+         "--state-dir", os.path.join(tmp, "dst_state")],
+        {},
+    )
+    return src, dst
+
+
+def scenario_migrate_live_cutover():
+    """The happy path end to end: acme migrates from source to target
+    over HTTP; afterwards the source answers acme with a 307 (Location +
+    Retry-After) while the target serves it with the migrated frequency
+    history applied."""
+    with tempfile.TemporaryDirectory(prefix="chaos_migrate_") as tmp:
+        src, dst = _migrate_pair(tmp, "migrate-src", "migrate-dst")
+        try:
+            src.wait_ready()
+            dst.wait_ready()
+            hdr = {"X-Tenant": "acme"}
+            for _ in range(2):  # build frequency history worth moving
+                assert post(src.url, hdr)[0] == 200
+            status, body = post_raw(
+                src.url, "/admin/migrate",
+                json.dumps({"tenant": "acme", "target": dst.url}).encode(),
+            )
+            assert status == 200 and body["outcome"] == "completed", (
+                status, body,
+            )
+            # the source now 307-forwards acme with the redirect envelope
+            code, fbody, fhdrs = post(src.url, hdr)
+            assert code == 307, (code, fbody)
+            assert fhdrs["Location"].startswith(dst.url), fhdrs
+            assert int(fhdrs["Retry-After"]) >= 1, fhdrs
+            assert dst.url in fbody["location"], fbody
+            # ...while the target owns it (and the default tenant on the
+            # source is untouched)
+            assert post(dst.url, hdr)[0] == 200
+            assert post(src.url)[0] == 200
+            _, strace = get(src.url, "/trace/last")
+            m = strace["migration"]
+            assert m["completed"] == 1 and m["forwards"] == 1, m
+            assert m["aborted"] == 0, m
+            _, dtrace = get(dst.url, "/trace/last")
+            dm = dtrace["migration"]
+            assert dm["staged"] == 1 and dm["activated"] == 1, dm
+        finally:
+            src.stop()
+            dst.stop()
+
+
+def scenario_migrate_crash_mid_export():
+    """The ``migrate_export`` fault fires under the quiesce gate: the
+    migration aborts with a structured 409, the source keeps the tenant
+    (no forward, still 200), and the abort is durable — a journaled
+    ABORT record, not a wedge."""
+    with tempfile.TemporaryDirectory(prefix="chaos_migrate_") as tmp:
+        root = _make_tenant_root(tmp)
+        srv = Server(
+            "migrate-crash-export",
+            ["--tenant-root", root,
+             "--state-dir", os.path.join(tmp, "state")],
+            {"LOG_PARSER_TPU_FAULTS": "migrate_export_raise@times=1"},
+        )
+        try:
+            srv.wait_ready()
+            hdr = {"X-Tenant": "acme"}
+            assert post(srv.url, hdr)[0] == 200
+            status, body = post_raw(
+                srv.url, "/admin/migrate",
+                json.dumps({"tenant": "acme",
+                            "target": "http://127.0.0.1:9"}).encode(),
+            )
+            assert status == 409, (status, body)
+            # the source still owns acme: served locally, no forward
+            assert post(srv.url, hdr)[0] == 200
+            _, trace = get(srv.url, "/trace/last")
+            m = trace["migration"]
+            assert m["aborted"] == 1 and m["forwards"] == 0, m
+            assert m["completed"] == 0, m
+            assert trace["faults"]["fired"]["migrate_export_raise"] == 1, (
+                trace["faults"]
+            )
+        finally:
+            srv.stop()
+
+
+def scenario_migrate_crash_pre_cutover():
+    """The ``migrate_cutover`` fault fires AFTER the target staged the
+    bundle but before the commit record: the source aborts and keeps
+    serving; the target's staged-but-never-activated copy must never
+    apply (single-owner invariant)."""
+    with tempfile.TemporaryDirectory(prefix="chaos_migrate_") as tmp:
+        src, dst = _migrate_pair(
+            tmp, "migrate-precut-src", "migrate-precut-dst",
+            src_env={
+                "LOG_PARSER_TPU_FAULTS": "migrate_cutover_raise@times=1"
+            },
+        )
+        try:
+            src.wait_ready()
+            dst.wait_ready()
+            hdr = {"X-Tenant": "acme"}
+            assert post(src.url, hdr)[0] == 200
+            status, body = post_raw(
+                src.url, "/admin/migrate",
+                json.dumps({"tenant": "acme", "target": dst.url}).encode(),
+            )
+            assert status == 409, (status, body)
+            # source still owns: 200, no forward installed
+            assert post(src.url, hdr)[0] == 200
+            _, strace = get(src.url, "/trace/last")
+            m = strace["migration"]
+            assert m["aborted"] == 1 and m["forwards"] == 0, m
+            # the target staged the bundle but never activated it
+            _, dtrace = get(dst.url, "/trace/last")
+            dm = dtrace["migration"]
+            assert dm["staged"] == 1 and dm["activated"] == 0, dm
+            assert dm["stagedNow"] == 1, dm
+        finally:
+            src.stop()
+            dst.stop()
+
+
+def scenario_migrate_drain_under_burst():
+    """POST /admin/drain while a default-tenant burst is in flight: the
+    drain closes every resident tenant under the deadline, /q/health
+    flips to a DRAINING 503 for the LBs, the burst sees only 200s (head)
+    or structured 503s (tail), and SIGTERM afterwards exits clean."""
+    with tempfile.TemporaryDirectory(prefix="chaos_migrate_") as tmp:
+        root = _make_tenant_root(tmp)
+        srv = Server(
+            "migrate-drain-burst",
+            ["--tenant-root", root,
+             "--state-dir", os.path.join(tmp, "state"),
+             "--drain-deadline-s", "15"],
+            {},
+        )
+        try:
+            srv.wait_ready()
+            assert post(srv.url, {"X-Tenant": "acme"})[0] == 200
+            assert post(srv.url, {"X-Tenant": "globex"})[0] == 200
+            burst = Burst(srv.url, 6)
+            status, body = post_raw(srv.url, "/admin/drain", b"{}")
+            assert status == 200, (status, body)
+            assert sorted(body["closed"]) == ["acme", "globex"], body
+            assert body["elapsedS"] <= 15, body
+            codes = [s for s, _ in burst.join(timeout=120)]
+            assert set(codes) <= {200, 503}, codes
+            hstatus, health = get(srv.url, "/q/health")
+            assert hstatus == 503 and health["status"] == "DRAINING", (
+                hstatus, health,
+            )
+            assert any(
+                c["name"] == "drain" and c["status"] == "DRAINING"
+                for c in health["checks"]
+            ), health
+            _, trace = get(srv.url, "/trace/last")
+            d = trace["migration"]["drain"]
+            assert d["draining"] == 1 and d["tenantsClosed"] == 2, d
+        finally:
+            srv.stop(expect_zero=True)
+
+
+def scenario_migrate_stream_handoff():
+    """A live follow-mode session is open on the migrating tenant: the
+    cutover must not hang on it — across processes the session closes
+    with an explicit ``error`` frame naming the new owner, and the
+    tenant's blob traffic 307-forwards."""
+    with tempfile.TemporaryDirectory(prefix="chaos_migrate_") as tmp:
+        src, dst = _migrate_pair(tmp, "migrate-stream-src",
+                                 "migrate-stream-dst")
+        try:
+            src.wait_ready()
+            dst.wait_ready()
+            hdr = {"X-Tenant": "acme"}
+            assert post(src.url, hdr)[0] == 200
+            c = StreamClient(src.url, tenant="acme")
+            c.send(b"INFO pinned session\n")
+            status, body = post_raw(
+                src.url, "/admin/migrate",
+                json.dumps({"tenant": "acme", "target": dst.url}).encode(),
+            )
+            assert status == 200 and body["outcome"] == "completed", (
+                status, body,
+            )
+            assert body["sessionsClosed"] == 1, body
+            # the handler thread is blocked reading chunks; the next
+            # chunk lands on the killed session and flushes its terminal
+            # error frame back down this connection
+            c.send(b"INFO post-cutover chunk\n")
+            frames = c.read_frames()
+            assert frames and frames[-1]["type"] == "error", frames
+            assert frames[-1]["reason"] == "migrated", frames[-1]
+            assert dst.url in frames[-1]["message"], frames[-1]
+            assert post(src.url, hdr)[0] == 307
+            assert post(dst.url, hdr)[0] == 200
+        finally:
+            src.stop()
+            dst.stop()
+
+
+MIGRATE_STANDALONE = [
+    ("migrate-live-cutover", scenario_migrate_live_cutover),
+    ("migrate-crash-mid-export", scenario_migrate_crash_mid_export),
+    ("migrate-crash-pre-cutover", scenario_migrate_crash_pre_cutover),
+    ("migrate-drain-under-burst", scenario_migrate_drain_under_burst),
+    ("migrate-stream-handoff", scenario_migrate_stream_handoff),
+]
+
+
 def scenario_miner_tap_overflow(srv: Server):
     """A wedged miner worker (miner_hang:inf) under a tiny tap capacity:
     the bounded queue fills, further novel lines become DROPS — counted
@@ -1903,7 +2158,7 @@ def main(argv: list[str] | None = None) -> int:
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
             "streaming", "distributed", "tenant", "miner", "obs", "spans",
-            "all",
+            "migrate", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -1964,6 +2219,8 @@ def main(argv: list[str] | None = None) -> int:
         standalone.extend(TENANT_STANDALONE)
     if args.group in ("miner", "all"):
         standalone.extend(MINER_STANDALONE)
+    if args.group in ("migrate", "all"):
+        standalone.extend(MIGRATE_STANDALONE)
     for name, check in standalone:
         if args.only and name != args.only:
             continue

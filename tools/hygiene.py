@@ -741,6 +741,103 @@ def check_span_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_migrate_vocab_pinned(root: Path) -> list[str]:
+    """Check 18: the tenant-migration vocabulary must be pinned the way
+    checks 13/17 pin tenancy's and the span store's. The migration fault
+    sites (``FAULT_SITES`` in runtime/migrate.py — ``migrate_export`` /
+    ``migrate_import`` / ``migrate_cutover``) each need a docs/OPS.md
+    row and a live ``faults.fire`` call site (comment-tolerant scan: the
+    fire calls carry conlint waivers). The migration span names and the
+    ``logparser_migration_*`` metric families are pinned BY NAME to
+    their vocabularies and to docs/OPS.md — checks 16/17 already demand
+    rows for whatever exists, but losing one of these must point at the
+    migration runbook, not read as a routine vocabulary shrink. The
+    ``--drain-*`` serve flags get the same backtick-row standard the
+    miner and obs flags are held to."""
+    src = root / "log_parser_tpu" / "runtime" / "migrate.py"
+    spans_src = root / "log_parser_tpu" / "obs" / "spans.py"
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    sites = _dict_keys_of(src, "FAULT_SITES")
+    for required in ("migrate_export", "migrate_import", "migrate_cutover"):
+        if required not in sites:
+            problems.append(
+                f"{src}: migration fault site {required!r} is missing from "
+                "FAULT_SITES — the crash-matrix drills depend on it"
+            )
+    for key in sites:
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{src}: migration fault site {key!r} is not documented in "
+                "docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{src}: migration fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    if spans_src.is_file():
+        span_names = set(_dict_keys_of(spans_src, "SPANS"))
+        for name in (
+            "migration",
+            "migrate_export",
+            "migrate_import",
+            "migrate_cutover",
+            "drain",
+        ):
+            if name not in span_names:
+                problems.append(
+                    f"{spans_src}: migration span {name!r} is missing from "
+                    "SPANS — the migration causal trace depends on it"
+                )
+            elif f"`{name}`" not in ops_text:
+                problems.append(
+                    f"{spans_src}: migration span {name!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if registry_src.is_file():
+        metrics = set(_dict_keys_of(registry_src, "METRICS"))
+        migration_fams = {m for m in metrics if m.startswith("logparser_migration_")}
+        if not migration_fams:
+            problems.append(
+                f"{registry_src}: no logparser_migration_* metric families — "
+                "the migration dashboards and alert rules depend on them"
+            )
+        for fam in sorted(migration_fams):
+            if f"`{fam}`" not in ops_text:
+                problems.append(
+                    f"{registry_src}: migration family {fam!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--drain-[a-z0-9-]+)"', serve_src.read_text()
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: drain serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -772,6 +869,7 @@ def main() -> int:
         problems.extend(check_kernel_admission(root))
         problems.extend(check_obs_vocab_pinned(root))
         problems.extend(check_span_vocab_pinned(root))
+        problems.extend(check_migrate_vocab_pinned(root))
 
     for p in problems:
         print(p)
