@@ -33,6 +33,26 @@ RUN g++ -O3 -std=c++17 -shared -fPIC native/log_parser_native.cpp \
         -o native/build/log_parser_native.so \
     && pip install --no-cache-dir --no-deps .
 
+# ---- optional: native-rebuild (GLIBCXX mismatch recovery) --------------
+# A prebuilt log_parser_native.so carried over from a newer build host
+# fails dlopen with "GLIBCXX_x.y.z not found" and the server silently
+# runs the scalar fallback (python tools/check_native.py prints the
+# required-vs-provided diagnosis; /metrics shows it as
+# logparser_native_loaded{reason="glibcxx_mismatch"}). This stage
+# rebuilds the scanner from source against THIS image's own libstdc++,
+# so the produced .so can never outrun the runtime stage's C++ ABI:
+#   docker build --target native-rebuild -t lp-native .
+#   docker run --rm -v "$PWD/native/build:/out" lp-native
+FROM ${PYTHON_IMAGE} AS native-rebuild
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /build
+COPY native/log_parser_native.cpp native/
+RUN mkdir -p native/build \
+    && g++ -O3 -std=c++17 -shared -fPIC native/log_parser_native.cpp \
+        -o native/build/log_parser_native.so
+CMD ["cp", "/build/native/build/log_parser_native.so", "/out/"]
+
 # ---- stage 3: slim runtime serving :8080 (mirrors ubi-minimal stage) ---
 FROM ${PYTHON_IMAGE}
 WORKDIR /work

@@ -1064,6 +1064,21 @@ def probe_backend(metric: str, unit: str) -> str:
     return "cpu"
 
 
+def host_load() -> dict | None:
+    """The host's concurrent-load fingerprint at measurement time: a
+    number means nothing without knowing what else the box was doing.
+    Stamped into every artifact; tools/bench_diff.py marks comparisons
+    whose sides ran under very different load advisory-only."""
+    try:
+        one, five, fifteen = os.getloadavg()
+    except OSError:  # pragma: no cover - platform without getloadavg
+        return None
+    return {
+        "loadavg": [round(one, 3), round(five, 3), round(fifteen, 3)],
+        "cpus": os.cpu_count(),
+    }
+
+
 def exit_null(metric: str, unit: str, platform: str, error: str) -> None:
     """Emit the null-value diagnostics artifact and hard-exit: used when
     no honest number can be produced (explicit platform unavailable,
@@ -1078,6 +1093,7 @@ def exit_null(metric: str, unit: str, platform: str, error: str) -> None:
                 "platform": platform,
                 "error": error,
                 "device_probe": last_probe_diagnostics,
+                "host_load": host_load(),
             }
         )
     )
@@ -1096,6 +1112,9 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float | None,
         "platform": platform,
     }
     doc.update(extra)
+    load = host_load()
+    if load is not None:
+        doc["host_load"] = load
     if last_backend is not None:
         # the label says what the bench CLAIMS; ``backend`` says what
         # the device layer actually was when the probe pinned it — plus

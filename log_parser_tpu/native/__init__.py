@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import re
 import subprocess
 import threading
 from pathlib import Path
@@ -27,6 +28,74 @@ _tried = False
 # surfaced at GET /trace/last "native" (docs/OPS.md) — a GLIBCXX mismatch
 # on this host class used to require PERF.md archaeology to diagnose
 _load_error: str | None = None
+# the symbol-level diagnosis for the GLIBCXX case (see glibcxx_triage):
+# stats() carries it so /trace/last and tools/check_native.py agree
+_load_triage: dict | None = None
+
+_GLIBCXX_RE = re.compile(rb"GLIBCXX_(\d+(?:\.\d+)+)")
+
+
+def _glibcxx_versions(path) -> list[tuple[int, ...]]:
+    """Every GLIBCXX_x.y.z version tag embedded in ``path``, sorted.
+    Reading .dynstr as raw bytes needs no ELF tooling and matches what
+    ``strings … | grep GLIBCXX`` shows an operator."""
+    try:
+        data = Path(path).read_bytes()
+    except OSError:
+        return []
+    return sorted({
+        tuple(int(part) for part in m.group(1).split(b"."))
+        for m in _GLIBCXX_RE.finditer(data)
+    })
+
+
+def _fmt_glibcxx(v: tuple[int, ...]) -> str:
+    return "GLIBCXX_" + ".".join(str(p) for p in v)
+
+
+def find_libstdcxx() -> str | None:
+    """The libstdc++ this process would dlopen against: the copy already
+    mapped in (JAX links it) wins; otherwise scan the usual soname dirs."""
+    try:
+        with open("/proc/self/maps", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                path = line.rsplit(None, 1)[-1]
+                if "libstdc++" in os.path.basename(path):
+                    return path
+    except OSError:
+        pass
+    dirs = [d for d in os.environ.get("LD_LIBRARY_PATH", "").split(os.pathsep)
+            if d]
+    dirs += [
+        "/usr/lib/x86_64-linux-gnu", "/lib/x86_64-linux-gnu",
+        "/usr/lib/aarch64-linux-gnu", "/lib/aarch64-linux-gnu",
+        "/usr/lib64", "/usr/lib", "/usr/local/lib",
+    ]
+    for d in dirs:
+        p = os.path.join(d, "libstdc++.so.6")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def glibcxx_triage(so_path=None) -> dict:
+    """Required-vs-provided GLIBCXX symbol versions: which versions the
+    prebuilt .so asks for, which the host's libstdc++ actually exports,
+    and the gap. This is the whole diagnosis for the classic 'built on a
+    newer distro' failure — tools/check_native.py prints it, and a load
+    failure records it into stats()."""
+    so_path = str(so_path or _SO)
+    provider = find_libstdcxx()
+    required = _glibcxx_versions(so_path)
+    provided = _glibcxx_versions(provider) if provider else []
+    missing = [v for v in required if provided and v > max(provided)]
+    return {
+        "so": so_path,
+        "libstdcxx": provider,
+        "required": [_fmt_glibcxx(v) for v in required],
+        "provided": [_fmt_glibcxx(v) for v in provided],
+        "missing": [_fmt_glibcxx(v) for v in missing],
+    }
 
 
 def _compile() -> bool:
@@ -166,7 +235,25 @@ def get_lib() -> ctypes.CDLL | None:
             # the GLIBCXX case lands here: the .so links a newer
             # libstdc++ than the host ships (PERF.md §10)
             log.warning("native library unavailable: %s", e)
-            _load_error = f"load failed: {e}"
+            global _load_triage
+            if "GLIBCXX" in str(e):
+                tri = glibcxx_triage()
+                _load_triage = tri
+                gap = (
+                    f"needs {', '.join(tri['missing'])}; host "
+                    f"{tri['libstdcxx'] or 'libstdc++ (not found)'} tops "
+                    f"out at "
+                    f"{tri['provided'][-1] if tri['provided'] else '?'}"
+                    if tri["missing"]
+                    else str(e)[:200]
+                )
+                _load_error = (
+                    f"glibcxx mismatch: {gap} — rebuild on this host "
+                    "(python tools/check_native.py --rebuild) or use the "
+                    "Dockerfile native-rebuild stage"
+                )
+            else:
+                _load_error = f"load failed: {e}"
             _lib = None
         except AttributeError as e:
             # a prebuilt .so from an older source revision lacks newly
@@ -186,7 +273,10 @@ def stats() -> dict:
     this process is running, and — when the scalar fallback is active —
     the recorded reason the shared object refused to load."""
     lib = get_lib()
-    return {
+    doc = {
         "available": lib is not None,
         "loadError": _load_error,
     }
+    if _load_triage is not None:
+        doc["glibcxx"] = _load_triage
+    return doc

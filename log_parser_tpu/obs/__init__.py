@@ -70,6 +70,12 @@ _NATIVE_REASONS = (
     ("disabled", "disabled"),
     ("compile failed", "compile_failed"),
     ("no prebuilt library", "no_library"),
+    # before the generic dlopen bucket: native/__init__.py diagnoses the
+    # built-on-a-newer-distro case (required GLIBCXX symbol versions the
+    # host libstdc++ doesn't export) and prefixes it distinctly, so the
+    # scrape can alert on it specifically (tools/check_native.py prints
+    # the full required-vs-provided table)
+    ("glibcxx mismatch", "glibcxx_mismatch"),
     ("load failed", "load_failed"),
     ("stale library", "stale"),
 )
@@ -78,7 +84,7 @@ _NATIVE_REASONS = (
 def native_load_reason(stats: dict) -> str:
     """Map native.stats() onto the bounded ``reason`` label vocabulary
     (ok / not_loaded / disabled / compile_failed / no_library /
-    load_failed / stale / other)."""
+    glibcxx_mismatch / load_failed / stale / other)."""
     if stats.get("available"):
         return "ok"
     err = stats.get("loadError")

@@ -165,6 +165,26 @@ METRICS = {
         "gauge", "Tenants 307-forwarded to another process post-cutover."),
     "logparser_migration_draining": (
         "gauge", "1 while the drain supervisor is evacuating this process."),
+    # ------------------------------------------------- replication
+    "logparser_replication_lag_records": (
+        "gauge", "Whole WAL records fsynced on the primary but not yet "
+        "acked by the standby, per tenant."),
+    "logparser_replication_lag_bytes": (
+        "gauge", "WAL bytes past the standby's acked offset, per tenant."),
+    "logparser_replication_lag_seconds": (
+        "gauge", "Age of the oldest un-acked WAL record, per tenant."),
+    "logparser_replication_acked_offset": (
+        "gauge", "Replication byte offset acked per tenant, by side "
+        "(sender/receiver)."),
+    "logparser_replication_epoch": (
+        "gauge", "Ownership epoch this process last journaled; role label "
+        "says primary or standby."),
+    "logparser_replication_total": (
+        "counter", "Replication batch outcomes "
+        "(shipped/applied/rejected/reseed/send_error)."),
+    "logparser_replication_promotions_total": (
+        "counter", "Fenced ownership transitions journaled by this "
+        "process (kind=promote/demote)."),
 }
 
 # /trace/last payload block -> covering /metrics families. Hygiene
@@ -220,6 +240,13 @@ TRACE_BLOCKS = {
                   "logparser_migration_active",
                   "logparser_migration_forwards",
                   "logparser_migration_draining"),
+    "replication": ("logparser_replication_lag_records",
+                    "logparser_replication_lag_bytes",
+                    "logparser_replication_lag_seconds",
+                    "logparser_replication_acked_offset",
+                    "logparser_replication_epoch",
+                    "logparser_replication_total",
+                    "logparser_replication_promotions_total"),
 }
 
 # request latency: sub-ms cache hits through multi-second cold compiles

@@ -73,6 +73,9 @@ SPANS = {
     "migrate_import": "bundle verify, warm stage and activate (target)",
     "migrate_cutover": "ownership commit: forward install + handoff",
     "drain": "one drain-supervisor pass: migrate-or-close every tenant",
+    "replicate": "one replication batch applied on the standby (warm bank)",
+    "promote": "fenced failover: PROMOTE journaled, tenants activated",
+    "demote": "stale-epoch step-down: DEMOTE journaled, registry fenced",
 }
 
 

@@ -63,6 +63,41 @@ SNAPSHOT_NAME = "snapshot.json"
 JOURNAL_NAME = "journal.wal"
 
 
+def apply_record(state: dict[str, list[float]], payload: dict, now: float) -> None:
+    """Apply one WAL record payload to a portable ages state, in place.
+
+    This IS the replay semantic — boot recovery (:meth:`FrequencyJournal._apply`)
+    and the replication receiver (runtime/replicate.py) both go through it,
+    so a standby fed shipped frames converges to exactly what a local replay
+    of the same prefix would produce. Ages are relative to ``now``; unknown
+    kinds are skipped so a newer writer's records never brick an older
+    reader.
+    """
+    kind = payload.get("k")
+    if kind == "m":  # match: n timestamps at wall-clock w
+        pid = payload.get("id")
+        n = int(payload.get("n", 0))
+        if not pid or n <= 0:
+            return
+        age = max(0.0, now - float(payload.get("w", now)))
+        state.setdefault(str(pid), []).extend([age] * n)
+    elif kind == "r":  # reset one id (entry kept, emptied) or all
+        pid = payload.get("id")
+        if pid is None:
+            state.clear()
+        elif pid in state:
+            state[pid] = []
+    elif kind == "b":  # barrier: full-state replace (admin restore,
+        # rollback) — replay converges here regardless of the tail above
+        ages = payload.get("ages")
+        if not isinstance(ages, dict):
+            return
+        drift = max(0.0, now - float(payload.get("w", now)))
+        state.clear()
+        for pid, ages_list in ages.items():
+            state[str(pid)] = [max(0.0, float(a)) + drift for a in ages_list]
+
+
 def _atomic_write(path: str, data: bytes) -> None:
     """tmp + fsync + rename, then the sha256 sidecar (same publish
     discipline as patterns/libcache — the sidecar window is two fsyncs
@@ -224,31 +259,7 @@ class FrequencyJournal:
         return out
 
     def _apply(self, state: dict[str, list[float]], payload: dict, now: float) -> None:
-        kind = payload.get("k")
-        if kind == "m":  # match: n timestamps at wall-clock w
-            pid = payload.get("id")
-            n = int(payload.get("n", 0))
-            if not pid or n <= 0:
-                return
-            age = max(0.0, now - float(payload.get("w", now)))
-            state.setdefault(str(pid), []).extend([age] * n)
-        elif kind == "r":  # reset one id (entry kept, emptied) or all
-            pid = payload.get("id")
-            if pid is None:
-                state.clear()
-            elif pid in state:
-                state[pid] = []
-        elif kind == "b":  # barrier: full-state replace (admin restore,
-            # rollback) — replay converges here regardless of the tail above
-            ages = payload.get("ages")
-            if not isinstance(ages, dict):
-                return
-            drift = max(0.0, now - float(payload.get("w", now)))
-            state.clear()
-            for pid, ages_list in ages.items():
-                state[str(pid)] = [max(0.0, float(a)) + drift for a in ages_list]
-        # unknown kinds are skipped: a newer writer's records must not
-        # brick an older reader
+        apply_record(state, payload, now)
 
     # ------------------------------------------------------------- appends
 
@@ -343,6 +354,38 @@ class FrequencyJournal:
             self.write_errors += 1
             self.healthy = False
             log.error("journal fsync failed: %s", exc)
+
+    def wal_feed(self, offset: int, max_bytes: int = 1 << 20) -> tuple[int, int, bytes]:
+        """Read raw frame bytes for the replication sender.
+
+        Returns ``(epoch, wal_size, data)`` where ``data`` is up to
+        ``max_bytes`` of the on-disk WAL starting at ``offset`` (frame
+        boundaries NOT guaranteed — the caller trims to whole frames).
+        Runs under ``_mu``, the same lock ``snapshot_now`` holds for its
+        truncate + epoch bump, so the (epoch, size, bytes) triple is always
+        consistent: a rotation can never truncate between the size read and
+        the byte read. ``max_bytes <= 0`` reads nothing — the cheap way to
+        sample (epoch, size).
+        """
+        offset = max(0, int(offset))
+        with self._mu:
+            fp = self._fp
+            if fp is not None:
+                try:
+                    fp.flush()
+                except (OSError, ValueError):  # pragma: no cover - fd gone
+                    pass
+            epoch = self.epoch
+            try:
+                with open(self._wal_path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    if max_bytes <= 0 or offset >= size:
+                        return epoch, size, b""
+                    f.seek(offset)
+                    return epoch, size, f.read(max_bytes)
+            except OSError:
+                return epoch, 0, b""
 
     def snapshot_now(self) -> bool:
         """Write an atomic snapshot of the live tracker and truncate the

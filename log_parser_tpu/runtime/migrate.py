@@ -835,6 +835,18 @@ class Migrator:
         - target journal with ACTIVATE but no APPLIED → re-apply the
           bundle (restore is a full-state barrier, so replay-after-
           partial-apply converges), then APPLIED.
+
+        Forwards are NOT installed per-journal: a tenant that round-
+        tripped (out via an old src journal, back via a newer dst
+        journal) has both a CUTOVER and an APPLIED on disk, and the
+        journals never expire. Each journal instead votes an ownership
+        *verdict* stamped with its pre-recovery mtime, and only the
+        latest verdict per tenant is applied — an APPLIED that post-
+        dates a CUTOVER clears the stale forward instead of losing to
+        journal replay order. (Mid order can't arbitrate: sequence
+        numbers are per-node.) This also makes recover() idempotent
+        under a double boot: re-running it converges to the same
+        forwards and appends nothing new to an already-sealed journal.
         """
         summary = {"forwards": [], "resumed": [], "discarded": [],
                    "pending": []}
@@ -842,24 +854,53 @@ class Migrator:
             names = sorted(os.listdir(self.dir))
         except OSError:
             return summary
+        verdicts: dict[str, tuple[float, str, str, int]] = {}
         for name in names:
             path = os.path.join(self.dir, name)
+            try:
+                # the decisive record's age — read BEFORE recovery appends
+                # its own seal (abort/discard/complete) and bumps it
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
             if name.endswith(".src.wal"):
-                self._recover_source(path, targets or {}, summary)
+                verdict = self._recover_source(path, targets or {}, summary)
             elif name.endswith(".dst.wal"):
-                self._recover_target(path, summary)
+                verdict = self._recover_target(path, summary)
+            else:
+                continue
+            if verdict is None:
+                continue
+            tenant_id, kind, location, retry_after = verdict
+            prev = verdicts.get(tenant_id)
+            if prev is None or mtime >= prev[0]:
+                verdicts[tenant_id] = (mtime, kind, location, retry_after)
+        for tenant_id in sorted(verdicts):
+            _mtime, kind, location, retry_after = verdicts[tenant_id]
+            if kind == "forward":
+                self.registry.set_forward(tenant_id, location, retry_after)
+                summary["forwards"].append(tenant_id)
+            else:
+                # this node re-imported the tenant after forwarding it
+                # out: ownership came back, the old forward is stale
+                self.registry.clear_forward(tenant_id)
         return summary
 
-    def _recover_source(self, path, targets, summary) -> None:
+    def _recover_source(
+        self, path, targets, summary
+    ) -> tuple[str, str, str, int] | None:
+        """Converge one source journal; returns the ownership verdict
+        ``(tenant, "forward", location, retry_after_s)`` when the
+        journal proves the tenant moved out, else ``None``."""
         records = MigrationJournal.replay(path)
         if not records:
-            return
+            return None
         kinds = [r.get("k") for r in records]
         meta = records[0]
         mid = str(meta.get("mid") or os.path.basename(path).split(".")[0])
         tenant_id = str(meta.get("tenant") or "")
         if "abort" in kinds:
-            return
+            return None
         cutover = next((r for r in records if r.get("k") == "cutover"), None)
         if cutover is None:
             # crash anywhere before the commit point: the tenant never
@@ -875,14 +916,16 @@ class Migrator:
                 "migration %s recovered to ABORT (no cutover); tenant %r "
                 "stays owned here", mid, tenant_id,
             )
-            return
+            return None
         location = str(cutover.get("location") or "")
         retry_after = int(cutover.get("retryAfterS") or 5)
-        if tenant_id:
-            self.registry.set_forward(tenant_id, location, retry_after)
-            summary["forwards"].append(tenant_id)
+        verdict = (
+            (tenant_id, "forward", location, retry_after)
+            if tenant_id
+            else None
+        )
         if "complete" in kinds:
-            return
+            return verdict
         # CUTOVER durable, COMPLETE missing: ownership moved but the
         # handoff didn't finish. Resume it if we can reach the target.
         target = targets.get(location)
@@ -893,7 +936,7 @@ class Migrator:
                 "for %r was supplied; tenant %r stays forwarded",
                 mid, location, tenant_id,
             )
-            return
+            return verdict
         try:
             bundle = self._read_bundle(mid)
             sha = hashlib.sha256(canonical_bundle_bytes(bundle)).hexdigest()
@@ -902,7 +945,7 @@ class Migrator:
         except (MigrationError, OSError, ValueError) as exc:
             summary["pending"].append(mid)
             log.error("migration %s resume failed: %s", mid, exc)
-            return
+            return verdict
         detached = self.registry.detach(tenant_id)
         if detached is not None:
             detached.close()
@@ -912,16 +955,25 @@ class Migrator:
         self._drop_bundle(mid)
         self.recovered_resumed += 1
         summary["resumed"].append(mid)
+        return verdict
 
-    def _recover_target(self, path, summary) -> None:
+    def _recover_target(
+        self, path, summary
+    ) -> tuple[str, str, str, int] | None:
+        """Converge one target journal; returns the ownership verdict
+        ``(tenant, "owned", "", 0)`` when the journal proves the tenant
+        was imported here, else ``None``."""
         records = MigrationJournal.replay(path)
         if not records:
-            return
+            return None
         kinds = [r.get("k") for r in records]
         meta = records[0]
         mid = str(meta.get("mid") or os.path.basename(path).split(".")[0])
-        if "applied" in kinds or "discard" in kinds:
-            return
+        tenant_id = str(meta.get("tenant") or "")
+        if "discard" in kinds:
+            return None
+        if "applied" in kinds:
+            return (tenant_id, "owned", "", 0) if tenant_id else None
         if "activate" not in kinds:
             # staged (acked or not) but never activated: the source may
             # have recovered as owner — this copy must die
@@ -933,7 +985,7 @@ class Migrator:
             summary["discarded"].append(mid)
             log.info("staged import %s discarded on boot (never activated)",
                      mid)
-            return
+            return None
         # ACTIVATE durable, APPLIED missing: finish the apply. restore()
         # is a full-state barrier, so a partial first attempt converges.
         try:
@@ -941,7 +993,7 @@ class Migrator:
         except (MigrationError, OSError, ValueError) as exc:
             summary["pending"].append(mid)
             log.error("activated import %s lost its bundle: %s", mid, exc)
-            return
+            return None
         self._apply_bundle(bundle)
         jr = MigrationJournal(path)
         jr.append("applied")
@@ -949,6 +1001,7 @@ class Migrator:
         self._drop_bundle(mid)
         self.recovered_resumed += 1
         summary["resumed"].append(mid)
+        return (tenant_id, "owned", "", 0) if tenant_id else None
 
     # -------------------------------------------------------------- stats
 

@@ -1,0 +1,1045 @@
+"""Warm-standby replication: continuous WAL shipping + fenced failover.
+
+A primary ships each tenant's frequency WAL (runtime/journal.py) to a
+configured standby as it is fsynced; the standby applies the frames
+through the SAME replay semantic boot recovery uses
+(:func:`~log_parser_tpu.runtime.journal.apply_record`) and keeps a warm,
+journaled bank per tenant — promotion is O(activate), not O(rebuild).
+
+Protocol shape (per tenant):
+
+- :class:`ReplicaSender` tails the journal with
+  :meth:`FrequencyJournal.wal_feed`: a snapshot **barrier** first (live
+  tracker state read under the engine state lock, paired with the WAL
+  (epoch, size) sampled under the journal mutex, so the barrier and the
+  resume offset are one consistent cut), then incremental CRC-framed
+  records, each batch acked by byte offset. Reconnect uses exponential
+  backoff + jitter and resumes from the last acked offset; when the
+  primary has rotated (snapshot + truncate) past it, the sender falls
+  back to a fresh barrier.
+- The receiver (:meth:`Replicator.feed`, served as POST
+  /admin/replica/feed and the framed-shim ``ReplicaFeed`` method)
+  verifies every frame — length, CRC, JSON — and rejects a batch WHOLE
+  on any anomaly, keeping its acked offset so the sender re-sends;
+  a partial record is never applied. Verified batches apply to the
+  tenant's ages and land in the standby engine via the journaled
+  ``DurableFrequencyTracker.restore`` path, so a standby crash recovers
+  from its own WAL.
+
+Failover is fenced by a monotonically increasing **ownership epoch**
+persisted in a CRC-framed protocol journal (``_replica/epoch.wal``,
+reusing :class:`~log_parser_tpu.runtime.migrate.MigrationJournal`) on
+BOTH sides. The :class:`FailoverSupervisor` on the standby probes the
+primary's ``/q/health``; after ``--failover-after-s`` of consecutive
+failures (or an explicit POST /admin/promote) it journals
+PROMOTE(epoch+1), activates every replicated tenant, and lifts the
+registry fence. A primary that comes back with a stale epoch sees the
+higher epoch in the first feed response, journals DEMOTE, fences itself
+(tenancy.set_fence → 307 for every tenant, default included), and
+becomes the standby. Exactly-one-owner holds across a crash at every
+protocol boundary: each transition is journaled-then-acted (the
+``crash_after`` hook fires right after the fsync'd record, PR 16
+style), and :meth:`Replicator.recover` replays the journal to converge.
+
+Fault sites (LOG_PARSER_TPU_FAULTS): ``replica_send`` (a WAL batch ship
+fails — contained: the sender counts the error and backs off, the
+primary keeps serving), ``replica_apply`` (the standby's verify+apply
+refuses the batch — contained: 503 to the sender, which re-sends; the
+acked offset never moves), ``promote`` (the promotion aborts before the
+PROMOTE record is journaled — contained: the standby stays fenced and
+the supervisor retries on its next probe).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from typing import Callable
+
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.journal import _FRAME, _MAX_PAYLOAD, apply_record
+from log_parser_tpu.runtime.migrate import MigrationJournal
+from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
+
+log = logging.getLogger(__name__)
+
+FAULT_SITES = {
+    "replica_send": "WAL batch ship to the standby fails (sender backs off "
+                    "and re-sends from the last acked offset)",
+    "replica_apply": "standby verify+apply refuses the batch (503; acked "
+                     "offset keeps its value, sender re-sends)",
+    "promote": "promotion aborts before the PROMOTE record is journaled "
+               "(standby stays fenced; the supervisor retries)",
+}
+
+REPLICA_DIR = "_replica"
+EPOCH_JOURNAL = "epoch.wal"
+
+# protocol journal record kinds, in the order a failover writes them —
+# the crash-matrix axis in tests/test_replicate.py
+PROTOCOL_RECORDS = ("epoch", "promote", "demote")
+
+_MAX_BATCH_BYTES = 8 << 20
+_BACKOFF_BASE_S = 0.25
+_BACKOFF_CAP_S = 15.0
+
+
+class ReplicationError(Exception):
+    """A refused feed/promotion. ``status`` maps onto HTTP directly;
+    ``extra`` carries the receiver's protocol position (ownership
+    ``epoch``, per-tenant ``acked`` offset + ``walEpoch``, owner
+    ``location``) so the sender can re-sync or demote from the error
+    alone."""
+
+    def __init__(self, reason: str, status: int = 409, **extra):
+        super().__init__(reason)
+        self.reason = reason
+        self.status = int(status)
+        self.extra = dict(extra)
+
+    def to_json(self) -> dict:
+        doc = {"error": self.reason}
+        doc.update(self.extra)
+        return doc
+
+
+class ReplicaCrash(RuntimeError):
+    """Injected kill -9 for the crash matrix: raised right AFTER the
+    named protocol record is fsynced, before any in-memory state
+    changes — tests rebuild fresh objects over the same state dir and
+    recover()."""
+
+
+def split_frames(data: bytes) -> tuple[list[dict], int]:
+    """Parse whole verified frames off ``data``.
+
+    Returns ``(payloads, consumed)`` where ``consumed`` is the byte
+    length of the verified whole-frame prefix. The walk stops at the
+    first anomaly — short header, over-long or truncated payload, CRC
+    mismatch, non-JSON — exactly the boot-replay rule, so sender and
+    receiver agree byte-for-byte on what a "whole frame" is.
+    """
+    out: list[dict] = []
+    off = 0
+    while off + _FRAME.size <= len(data):
+        length, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        if length > _MAX_PAYLOAD or start + length > len(data):
+            break
+        payload = data[start:start + length]
+        if zlib.crc32(payload) != crc:
+            break
+        try:
+            out.append(json.loads(payload.decode("utf-8")))
+        except ValueError:
+            break
+        off = start + length
+    return out, off
+
+
+# ------------------------------------------------------------------ targets
+
+
+class LocalReplicaTarget:
+    """In-process standby — tests and single-process drills. Feeds go
+    straight into the peer :class:`Replicator`; rejections come back as
+    (status, doc) exactly like the HTTP target reports them."""
+
+    def __init__(self, replicator: "Replicator", url: str = "local://standby"):
+        self.replicator = replicator
+        self.url = url
+
+    def feed(self, body: dict) -> tuple[int, dict]:
+        try:
+            return 200, self.replicator.feed(body)
+        except ReplicationError as exc:
+            return exc.status, exc.to_json()
+
+
+class HttpReplicaTarget:
+    """POST /admin/replica/feed on a real standby. Transport failures
+    (unreachable, timeout) raise :class:`ReplicationError` with status
+    0 so the sender backs off; protocol rejections return the standby's
+    (status, body) for re-sync/demote handling."""
+
+    def __init__(self, url: str, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def feed(self, body: dict) -> tuple[int, dict]:
+        data = json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/admin/replica/feed", data=data,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            try:
+                doc = json.loads(exc.read().decode("utf-8"))
+            except Exception:
+                doc = {"error": f"HTTP {exc.code}"}
+            return exc.code, doc if isinstance(doc, dict) else {"error": str(doc)}
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise ReplicationError(
+                f"standby unreachable: {exc}", status=0
+            ) from exc
+
+
+# ------------------------------------------------------------------- sender
+
+
+class ReplicaSender:
+    """Ships ONE tenant's frequency WAL to the standby.
+
+    ``pump()`` is synchronous and does one bounded round — the
+    replicator's pump thread loops it; tests call it directly. State
+    machine: un-seeded → ship a barrier (consistent live-snapshot +
+    WAL-offset cut) → seeded, then incremental whole-frame batches from
+    the acked offset. A WAL rotation (journal epoch change, or the
+    acked offset past the truncated size) falls back to a fresh
+    barrier; a rejection carrying the receiver's position re-syncs; a
+    response carrying a HIGHER ownership epoch demotes this whole
+    process.
+    """
+
+    def __init__(
+        self,
+        replicator: "Replicator",
+        tenant_id: str,
+        engine,
+        target,
+        *,
+        rng: random.Random | None = None,
+    ):
+        self.replicator = replicator
+        self.tenant_id = tenant_id
+        self.engine = engine
+        self.journal = engine.journal
+        self.target = target
+        self.rng = rng or random.Random(zlib.crc32(tenant_id.encode("utf-8")))
+        self.seeded = False
+        self.acked_offset = 0
+        self.wal_epoch = -1
+        # lag gauges (standby's view lags these by one in-flight batch)
+        self.lag_records = 0
+        self.lag_bytes = 0
+        self.lag_seconds = 0.0
+        # counters
+        self.shipped_batches = 0
+        self.shipped_records = 0
+        self.reseeds = 0
+        self.resyncs = 0
+        self.send_errors = 0
+        self.last_error = ""
+        self._failures = 0
+        self._next_try = 0.0
+
+    # one replication round; returns the outcome for tests/logging
+    def pump(self) -> str:
+        rep = self.replicator
+        if rep.role != "primary":
+            return "standby"
+        now = rep.clock()
+        if now < self._next_try:
+            return "backoff"
+        try:
+            outcome = self._seed() if not self.seeded else self._ship()
+        except faults.InjectedFault as exc:
+            return self._note_error(f"injected: {exc}", now)
+        except ReplicationError as exc:
+            return self._note_error(str(exc), now)
+        if outcome in ("seeded", "shipped", "idle", "resync"):
+            self._failures = 0
+            self._next_try = 0.0
+        return outcome
+
+    def _note_error(self, reason: str, now: float) -> str:
+        self.send_errors += 1
+        self.last_error = reason[:256]
+        self._failures += 1
+        backoff = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2.0 ** min(self._failures, 10)))
+        self._next_try = now + backoff * (0.5 + self.rng.random() / 2.0)
+        return "error"
+
+    def backoff_s(self) -> float:
+        return max(0.0, self._next_try - self.replicator.clock())
+
+    def _seed(self) -> str:
+        eng = self.engine
+        # one consistent cut: appends happen under the engine state lock
+        # (journal.py thread contract), so a snapshot read + WAL size
+        # sampled while holding it bound exactly the same record prefix
+        with eng.state_lock:
+            ages = eng.frequency.snapshot()
+            wall = self.replicator.wall()
+            epoch, size, _ = self.journal.wal_feed(0, max_bytes=0)
+        body = {
+            "barrier": {"k": "b", "ages": ages, "w": wall},
+            "walEpoch": epoch,
+            "offset": size,
+            "frames": "",
+        }
+        status, doc = self._send(body)
+        if status == 200:
+            self.seeded = True
+            self.wal_epoch = epoch
+            self.acked_offset = int(doc.get("acked", size))
+            self.reseeds += 1
+            self.lag_records = 0
+            self.lag_bytes = 0
+            self.lag_seconds = 0.0
+            return "seeded"
+        return self._handle_reject(status, doc)
+
+    def _ship(self) -> str:
+        epoch, size, data = self.journal.wal_feed(
+            self.acked_offset, _MAX_BATCH_BYTES
+        )
+        if epoch != self.wal_epoch or self.acked_offset > size:
+            # the primary rotated (snapshot + truncate) past the resume
+            # point: incremental frames are gone, fall back to a barrier
+            self.seeded = False
+            return self._seed()
+        payloads, consumed = split_frames(data)
+        self._note_lag(size, payloads, consumed)
+        if consumed == 0:
+            if data:
+                # bytes are pending but no whole frame parses at our
+                # resume point: the offset is misaligned (a corrupt ack
+                # bookkeeping, never a torn append — the journal writes
+                # whole frames under the same mutex wal_feed reads
+                # under). An incremental resume can't recover; reseed.
+                self.seeded = False
+                return self._seed()
+            return "idle"
+        body = {
+            "barrier": None,
+            "walEpoch": epoch,
+            "offset": self.acked_offset,
+            "frames": base64.b64encode(data[:consumed]).decode("ascii"),
+        }
+        status, doc = self._send(body)
+        if status == 200:
+            self.acked_offset = int(doc.get("acked", self.acked_offset + consumed))
+            self.shipped_batches += 1
+            self.shipped_records += len(payloads)
+            self.lag_bytes = max(0, size - self.acked_offset)
+            if self.lag_bytes == 0:
+                self.lag_records = 0
+                self.lag_seconds = 0.0
+            return "shipped"
+        return self._handle_reject(status, doc)
+
+    def _note_lag(self, size: int, payloads: list[dict], consumed: int) -> None:
+        self.lag_bytes = max(0, size - self.acked_offset)
+        self.lag_records = len(payloads)
+        oldest = min(
+            (float(p.get("w", 0.0)) for p in payloads if "w" in p),
+            default=None,
+        )
+        self.lag_seconds = (
+            max(0.0, self.replicator.wall() - oldest) if oldest is not None else 0.0
+        )
+
+    def _send(self, body: dict) -> tuple[int, dict]:
+        faults.fire(  # conlint: contained-by-caller (pump counts the error and backs off)
+            "replica_send", key=self.tenant_id
+        )
+        rep = self.replicator
+        body["tenant"] = self.tenant_id
+        body["epoch"] = rep.epoch
+        body["wall"] = rep.wall()
+        status, doc = self.target.feed(body)
+        if not isinstance(doc, dict):
+            doc = {}
+        return status, doc
+
+    def _handle_reject(self, status: int, doc: dict) -> str:
+        rep = self.replicator
+        try:
+            peer_epoch = int(doc.get("epoch", -1))
+        except (TypeError, ValueError):
+            peer_epoch = -1
+        if peer_epoch > rep.epoch:
+            # the standby owns a HIGHER epoch: we are the stale side of a
+            # split brain — step down before another write is accepted
+            rep.demote(
+                peer_epoch,
+                str(doc.get("location") or getattr(self.target, "url", "")),
+            )
+            return "demoted"
+        if status == 409 and "acked" in doc:
+            # receiver told us its position: re-sync without a backoff
+            try:
+                peer_wal_epoch = int(doc.get("walEpoch", -1))
+                peer_acked = int(doc["acked"])
+            except (TypeError, ValueError):
+                raise ReplicationError(f"malformed reject: {doc!r}")
+            if peer_wal_epoch != self.wal_epoch or peer_wal_epoch < 0:
+                self.seeded = False
+            else:
+                self.acked_offset = peer_acked
+            self.resyncs += 1
+            return "resync"
+        raise ReplicationError(
+            f"feed rejected ({status}): {doc.get('error', '?')}", status=status
+        )
+
+    def stats(self) -> dict:
+        return {
+            "acked": self.acked_offset,
+            "walEpoch": self.wal_epoch,
+            "seeded": self.seeded,
+            "lagRecords": self.lag_records,
+            "lagBytes": self.lag_bytes,
+            "lagSeconds": round(self.lag_seconds, 6),
+            "shipped": self.shipped_batches,
+            "records": self.shipped_records,
+            "reseeds": self.reseeds,
+            "resyncs": self.resyncs,
+            "errors": self.send_errors,
+            "backoffS": round(self.backoff_s(), 3),
+        }
+
+
+class _TenantFeed:
+    """Receiver-side position + warm state for one replicated tenant."""
+
+    __slots__ = ("wal_epoch", "acked", "ages", "wall", "records", "barriers",
+                 "rejects")
+
+    def __init__(self):
+        self.wal_epoch = -1
+        self.acked = 0
+        self.ages: dict[str, list[float]] = {}
+        self.wall = 0.0
+        self.records = 0
+        self.barriers = 0
+        self.rejects = 0
+
+
+# --------------------------------------------------------------- replicator
+
+
+class Replicator:
+    """Both halves of the replication channel plus the fenced ownership
+    state machine, for one process.
+
+    Role ``primary``: senders pump; feeds are refused (409 + own epoch,
+    which demotes a stale peer that tries to ship here). Role
+    ``standby``: the registry is fenced (every client resolve 307s to
+    the peer), feeds apply, the :class:`FailoverSupervisor` may be
+    armed. ``promote``/``demote`` journal the transition BEFORE acting
+    on it; ``recover()`` replays the journal so a crash at any boundary
+    converges to exactly one owner.
+    """
+
+    def __init__(
+        self,
+        registry,
+        *,
+        state_root: str,
+        node_url: str = "",
+        peer_url: str | None = None,
+        target=None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        crash_after=None,
+        pump_interval_s: float = 0.2,
+    ):
+        self.registry = registry
+        self.node_url = node_url
+        self.peer_url = peer_url or ""
+        self.target = target
+        self.clock = clock
+        self.wall = wall
+        self.crash_after = frozenset(crash_after or ())
+        self.pump_interval_s = float(pump_interval_s)
+        self.role = "standby" if peer_url else "primary"
+        self.epoch = 0
+        self.dir = os.path.join(str(state_root), REPLICA_DIR)
+        self._journal = MigrationJournal(os.path.join(self.dir, EPOCH_JOURNAL))
+        self._lock = threading.RLock()
+        self._senders: dict[str, ReplicaSender] = {}
+        self._feeds: dict[str, _TenantFeed] = {}
+        self._known_tenants: set[str] = set()
+        self.supervisor: FailoverSupervisor | None = None
+        # counters
+        self.applied_batches = 0
+        self.applied_records = 0
+        self.rejected_batches = 0
+        self.promotions = 0
+        self.demotions = 0
+        self.adoptions = 0
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        obs = getattr(registry.default_engine, "obs", None)
+        if obs is not None:
+            obs.registry.register_collector("replication", self._metric_samples)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _crash(self, kind: str) -> None:
+        if kind in self.crash_after:
+            raise ReplicaCrash(f"injected crash after {kind}")
+
+    def _spans(self):
+        obs = getattr(self.registry.default_engine, "obs", None)
+        return getattr(obs, "spans", None) if obs is not None else None
+
+    def attach_sender(self, tenant_id: str, engine) -> ReplicaSender | None:
+        """Start shipping one tenant's WAL (called from the serve layer's
+        ``engine_setup`` hook as tenant engines come up). No-op without
+        a target or a journal — a pure standby attaches no senders."""
+        if self.target is None or getattr(engine, "journal", None) is None:
+            return None
+        with self._lock:
+            sender = self._senders.get(tenant_id)
+            if sender is None:
+                sender = ReplicaSender(self, tenant_id, engine, self.target)
+                self._senders[tenant_id] = sender
+                self._known_tenants.add(tenant_id)
+            return sender
+
+    # ------------------------------------------------------------ receiver
+
+    def feed(self, body: dict) -> dict:
+        """Verify + apply one shipped batch. Raises
+        :class:`ReplicationError` on any refusal; the error body carries
+        the receiver's position so the sender can re-sync, or its
+        (higher) epoch so a stale primary demotes itself."""
+        if not isinstance(body, dict):
+            raise ReplicationError("feed body must be a JSON object", status=400)
+        tenant = body.get("tenant")
+        if not isinstance(tenant, str) or not tenant:
+            raise ReplicationError("feed missing tenant", status=400)
+        try:
+            feed_epoch = int(body.get("epoch", -1))
+            wal_epoch = int(body.get("walEpoch", -1))
+            offset = int(body.get("offset", -1))
+        except (TypeError, ValueError):
+            raise ReplicationError("malformed feed fields", status=400)
+        try:
+            faults.fire("replica_apply", key=tenant)
+        except faults.InjectedFault as exc:
+            raise ReplicationError(
+                f"injected apply fault: {exc}", status=503, epoch=self.epoch
+            ) from exc
+        with self._lock:
+            if feed_epoch < self.epoch:
+                raise ReplicationError(
+                    "stale ownership epoch", status=409,
+                    epoch=self.epoch, location=self.node_url,
+                )
+            if self.role != "standby":
+                raise ReplicationError(
+                    "not a standby", status=409,
+                    epoch=self.epoch, location=self.node_url,
+                )
+            if feed_epoch > self.epoch:
+                # the fleet moved on while we were dark (e.g. this is a
+                # re-provisioned standby): adopt the primary's epoch,
+                # durably, BEFORE applying anything under it
+                self._journal.append("epoch", epoch=feed_epoch)
+                self._crash("epoch")
+                self.epoch = feed_epoch
+                self.adoptions += 1
+            st = self._feeds.setdefault(tenant, _TenantFeed())
+            self._known_tenants.add(tenant)
+            t0 = time.perf_counter()
+            now = self.wall()
+            barrier = body.get("barrier")
+            if barrier is not None:
+                if not isinstance(barrier, dict):
+                    raise ReplicationError("malformed barrier", status=400)
+                state: dict[str, list[float]] = {}
+                apply_record(state, barrier, now)
+                st.ages = state
+                st.wal_epoch = wal_epoch
+                st.acked = max(0, offset)
+                st.wall = now
+                st.barriers += 1
+                applied = 0
+            else:
+                if wal_epoch != st.wal_epoch or offset != st.acked:
+                    st.rejects += 1
+                    self.rejected_batches += 1
+                    raise ReplicationError(
+                        "offset mismatch", status=409, epoch=self.epoch,
+                        acked=st.acked, walEpoch=st.wal_epoch,
+                        location=self.node_url,
+                    )
+                try:
+                    data = base64.b64decode(body.get("frames") or "", validate=True)
+                except (TypeError, ValueError):
+                    raise ReplicationError("bad frame encoding", status=400)
+                payloads, consumed = split_frames(data)
+                if not payloads or consumed != len(data):
+                    # torn or CRC-corrupt frame ANYWHERE in the batch:
+                    # reject it whole, keep the acked offset — a partial
+                    # record must never apply (mirror of the WAL
+                    # torn-tail rule)
+                    st.rejects += 1
+                    self.rejected_batches += 1
+                    raise ReplicationError(
+                        "torn or corrupt frame in batch", status=409,
+                        epoch=self.epoch, acked=st.acked,
+                        walEpoch=st.wal_epoch, location=self.node_url,
+                    )
+                # age the warm state forward to 'now', then apply — an
+                # all-or-nothing staged copy, same arithmetic a local
+                # replay of the identical prefix performs
+                drift = max(0.0, now - st.wall) if st.wall else 0.0
+                staged = {
+                    pid: [a + drift for a in ages] for pid, ages in st.ages.items()
+                }
+                for payload in payloads:
+                    apply_record(staged, payload, now)
+                st.ages = staged
+                st.acked = offset + consumed
+                st.wall = now
+                st.records += len(payloads)
+                applied = len(payloads)
+            self._warm_apply(tenant, st)
+            self.applied_batches += 1
+            self.applied_records += applied
+            if tenant != DEFAULT_TENANT:
+                # standby answers client traffic for this tenant with the
+                # primary's address even if the registry-wide fence is
+                # lifted by an operator
+                self.registry.set_forward(tenant, self.peer_url or self.node_url)
+            spans = self._spans()
+            if spans is not None:
+                spans.end_trace(
+                    f"replicate:{tenant}:{self.applied_batches}",
+                    duration_s=time.perf_counter() - t0, tenant=tenant,
+                    name="replicate",
+                    attrs={"records": applied, "acked": st.acked,
+                           "barrier": barrier is not None},
+                    force=True,
+                )
+            return {"acked": st.acked, "walEpoch": st.wal_epoch,
+                    "epoch": self.epoch}
+
+    def _warm_apply(self, tenant: str, st: _TenantFeed) -> None:
+        """Push the fed state into the standby's OWN tenant engine via
+        the journaled restore path: the bank stays warm (promotion is
+        O(activate)) and the state is durable in the standby's own WAL,
+        so a standby crash re-warms from disk, not from the primary."""
+        tid = None if tenant == DEFAULT_TENANT else tenant
+        try:
+            ctx = self.registry.resolve(tid, ignore_forward=True)
+        except Exception as exc:
+            raise ReplicationError(
+                f"standby cannot host tenant {tenant!r}: {exc}", status=404,
+                epoch=self.epoch,
+            ) from exc
+        try:
+            eng = ctx.engine
+            with eng.state_lock:
+                eng.frequency.restore(st.ages)
+        finally:
+            ctx.unpin()
+
+    # ------------------------------------------------------------ failover
+
+    def promote(self, reason: str = "admin") -> dict:
+        """Take ownership: journal PROMOTE(epoch+1), then activate every
+        replicated tenant and lift the fence. Idempotent when already
+        primary."""
+        with self._lock:
+            if self.role == "primary":
+                return {"status": "primary", "epoch": self.epoch}
+            try:
+                faults.fire("promote", key=reason)
+            except faults.InjectedFault as exc:
+                raise ReplicationError(
+                    f"injected promote fault: {exc}", status=503,
+                    epoch=self.epoch,
+                ) from exc
+            t0 = self.clock()
+            new_epoch = self.epoch + 1
+            tenants = sorted(self._known_tenants | set(self._feeds))
+            self._journal.append(
+                "promote", epoch=new_epoch, reason=reason, tenants=tenants
+            )
+            self._crash("promote")
+            self.epoch = new_epoch
+            self.role = "primary"
+            self.promotions += 1
+            self._activate(tenants)
+            log.warning(
+                "PROMOTED to primary at epoch %d (%s): %d tenant(s) live",
+                new_epoch, reason, len(tenants),
+            )
+            spans = self._spans()
+            if spans is not None:
+                spans.end_trace(
+                    f"promote:{new_epoch}",
+                    duration_s=max(0.0, self.clock() - t0), name="promote",
+                    attrs={"epoch": new_epoch, "reason": reason,
+                           "tenants": len(tenants)},
+                    force=True,
+                )
+            return {"status": "promoted", "epoch": new_epoch,
+                    "reason": reason, "tenants": tenants}
+
+    def demote(self, new_epoch: int, location: str) -> dict:
+        """Step down: journal DEMOTE, fence the registry toward
+        ``location``, install reverse forwards. Called when any feed
+        response carries a higher ownership epoch (stale-primary
+        split-brain heal), or by recover() replaying a DEMOTE record."""
+        with self._lock:
+            if self.role == "standby" and new_epoch <= self.epoch:
+                return {"status": "standby", "epoch": self.epoch}
+            t0 = self.clock()
+            tenants = sorted(
+                self._known_tenants | set(self._feeds) | set(self._senders)
+            )
+            self._journal.append(
+                "demote", epoch=int(new_epoch), location=location,
+                tenants=tenants,
+            )
+            self._crash("demote")
+            self.epoch = max(self.epoch, int(new_epoch))
+            self.role = "standby"
+            self.demotions += 1
+            if location:
+                self.peer_url = location
+            self._fence_all(tenants)
+            log.warning(
+                "DEMOTED to standby at epoch %d: owner is %s", self.epoch,
+                location or "(unknown)",
+            )
+            spans = self._spans()
+            if spans is not None:
+                spans.end_trace(
+                    f"demote:{self.epoch}",
+                    duration_s=max(0.0, self.clock() - t0), name="demote",
+                    attrs={"epoch": self.epoch, "location": location,
+                           "tenants": len(tenants)},
+                    force=True,
+                )
+            return {"status": "demoted", "epoch": self.epoch,
+                    "location": location}
+
+    def _activate(self, tenants: list[str]) -> None:
+        """Make every replicated tenant live on this (now-primary)
+        process: lift the fence, drop reverse forwards, resolve each
+        tenant so its engine (and journaled warm bank) is up, and flush
+        its journal so the promoted state is durable. Idempotent — the
+        recover() walk re-runs it after a crash mid-activation."""
+        reg = self.registry
+        reg.clear_fence()
+        for tid in tenants:
+            if tid != DEFAULT_TENANT:
+                reg.clear_forward(tid)
+        for tid in tenants:
+            try:
+                ctx = reg.resolve(
+                    None if tid == DEFAULT_TENANT else tid, ignore_forward=True
+                )
+            except Exception:
+                log.exception("promote: tenant %r failed to activate", tid)
+                continue
+            try:
+                journal = getattr(ctx.engine, "journal", None)
+                if journal is not None:
+                    journal.flush()
+            finally:
+                ctx.unpin()
+
+    def _fence_all(self, tenants: list[str]) -> None:
+        if self.peer_url:
+            self.registry.set_fence(self.peer_url)
+        for tid in tenants:
+            if tid != DEFAULT_TENANT and self.peer_url:
+                self.registry.set_forward(tid, self.peer_url)
+
+    def arm_failover(
+        self, primary_url: str, *, after_s: float, poll_s: float = 1.0
+    ) -> "FailoverSupervisor":
+        self.supervisor = FailoverSupervisor(
+            self, primary_url, after_s=after_s, poll_s=poll_s, clock=self.clock
+        )
+        return self.supervisor
+
+    # ------------------------------------------------------------ recovery
+
+    def recover(self) -> dict:
+        """Boot-time convergence: replay the protocol journal. The
+        highest journaled epoch wins; the LAST promote/demote record
+        decides the role, and its side effects are re-run idempotently
+        (a crash between the record and the activation/fencing leaves
+        the record as the single source of truth)."""
+        records = MigrationJournal.replay(self._journal.path)
+        role_rec: dict | None = None
+        for rec in records:
+            try:
+                e = int(rec.get("epoch", 0))
+            except (TypeError, ValueError):
+                continue
+            if e > self.epoch:
+                self.epoch = e
+            if rec.get("k") in ("promote", "demote"):
+                role_rec = rec
+            for tid in rec.get("tenants") or ():
+                self._known_tenants.add(str(tid))
+        if role_rec is not None:
+            if role_rec.get("k") == "promote":
+                self.role = "primary"
+                self._activate(sorted(self._known_tenants))
+            else:
+                self.role = "standby"
+                loc = str(role_rec.get("location") or "")
+                if loc:
+                    self.peer_url = loc
+                self._fence_all(sorted(self._known_tenants))
+        elif self.role == "standby":
+            # never promoted/demoted: a boot-time standby fences until
+            # it is promoted
+            self._fence_all(sorted(self._known_tenants))
+        summary = {
+            "role": self.role,
+            "epoch": self.epoch,
+            "records": len(records),
+            "tenants": sorted(self._known_tenants),
+        }
+        log.info("replication recover: %s", summary)
+        return summary
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Begin the pump loop (primary side) and the failover watch
+        (standby side, when armed)."""
+        if self._thread is None and self.target is not None:
+            self._thread = threading.Thread(
+                target=self._pump_loop, name="replica-pump", daemon=True
+            )
+            self._thread.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+
+    def _pump_loop(self) -> None:
+        while not self._stop_evt.wait(self.pump_interval_s):
+            for sender in list(self._senders.values()):
+                try:
+                    sender.pump()
+                except Exception:
+                    log.exception(
+                        "replica pump failed for %r", sender.tenant_id
+                    )
+
+    def pump_all(self) -> dict[str, str]:
+        """One synchronous round over every sender (tests, drills)."""
+        return {tid: s.pump() for tid, s in list(self._senders.items())}
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        self._journal.close()
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            senders = {tid: s.stats() for tid, s in self._senders.items()}
+            feeds = {
+                tid: {"acked": st.acked, "walEpoch": st.wal_epoch,
+                      "records": st.records, "barriers": st.barriers,
+                      "rejects": st.rejects}
+                for tid, st in self._feeds.items()
+            }
+            doc = {
+                "role": self.role,
+                "epoch": self.epoch,
+                "peer": self.peer_url,
+                "tenants": sorted(self._known_tenants),
+                "lagRecords": sum(s.lag_records for s in self._senders.values()),
+                "lagBytes": sum(s.lag_bytes for s in self._senders.values()),
+                "lagSeconds": round(
+                    max(
+                        (s.lag_seconds for s in self._senders.values()),
+                        default=0.0,
+                    ), 6,
+                ),
+                "shippedBatches": sum(
+                    s.shipped_batches for s in self._senders.values()
+                ),
+                "shippedRecords": sum(
+                    s.shipped_records for s in self._senders.values()
+                ),
+                "reseeds": sum(s.reseeds for s in self._senders.values()),
+                "sendErrors": sum(s.send_errors for s in self._senders.values()),
+                "appliedBatches": self.applied_batches,
+                "appliedRecords": self.applied_records,
+                "rejectedBatches": self.rejected_batches,
+                "promotions": self.promotions,
+                "demotions": self.demotions,
+                "adoptions": self.adoptions,
+                "senders": senders,
+                "feeds": feeds,
+            }
+            if self.supervisor is not None:
+                doc["failover"] = self.supervisor.stats()
+            return doc
+
+    def _metric_samples(self):
+        """Raw collector for the per-tenant ``logparser_replication_*``
+        families (obs/registry.py drops undeclared names and swallows
+        errors, so this can never take down /metrics)."""
+        with self._lock:
+            out = [
+                ("logparser_replication_epoch", {"role": self.role},
+                 float(self.epoch)),
+                ("logparser_replication_promotions_total",
+                 {"kind": "promote"}, float(self.promotions)),
+                ("logparser_replication_promotions_total",
+                 {"kind": "demote"}, float(self.demotions)),
+                ("logparser_replication_total", {"outcome": "shipped"},
+                 float(sum(s.shipped_batches for s in self._senders.values()))),
+                ("logparser_replication_total", {"outcome": "reseed"},
+                 float(sum(s.reseeds for s in self._senders.values()))),
+                ("logparser_replication_total", {"outcome": "send_error"},
+                 float(sum(s.send_errors for s in self._senders.values()))),
+                ("logparser_replication_total", {"outcome": "applied"},
+                 float(self.applied_batches)),
+                ("logparser_replication_total", {"outcome": "rejected"},
+                 float(self.rejected_batches)),
+            ]
+            for tid, s in self._senders.items():
+                labels = {"tenant": tid, "side": "sender"}
+                out.append(
+                    ("logparser_replication_lag_records", labels,
+                     float(s.lag_records))
+                )
+                out.append(
+                    ("logparser_replication_lag_bytes", labels,
+                     float(s.lag_bytes))
+                )
+                out.append(
+                    ("logparser_replication_lag_seconds", labels,
+                     float(s.lag_seconds))
+                )
+                out.append(
+                    ("logparser_replication_acked_offset", labels,
+                     float(s.acked_offset))
+                )
+            for tid, st in self._feeds.items():
+                out.append(
+                    ("logparser_replication_acked_offset",
+                     {"tenant": tid, "side": "receiver"}, float(st.acked))
+                )
+        return out
+
+
+# --------------------------------------------------------------- supervisor
+
+
+class FailoverSupervisor:
+    """Standby-side health watch with consecutive-failure counting
+    (unlike DrainSupervisor.watch_health's one-shot verdict): probe the
+    primary's ``/q/health`` every ``poll_s``; once it has been down for
+    ``after_s`` CONSECUTIVE seconds, promote. One successful probe
+    resets the clock — a flapping primary never trips a promotion."""
+
+    def __init__(
+        self,
+        replicator: Replicator,
+        primary_url: str,
+        *,
+        after_s: float,
+        poll_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        probe: Callable[[], bool] | None = None,
+    ):
+        self.replicator = replicator
+        self.primary_url = primary_url.rstrip("/")
+        self.after_s = float(after_s)
+        self.poll_s = float(poll_s)
+        self.clock = clock
+        self.probe = probe or self._http_probe
+        self.probes = 0
+        self.failures = 0
+        self._down_since: float | None = None
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _http_probe(self) -> bool:
+        try:
+            req = urllib.request.Request(self.primary_url + "/q/health")
+            with urllib.request.urlopen(req, timeout=max(1.0, self.poll_s)) as r:
+                return 200 <= r.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def check_once(self) -> str | None:
+        """One probe; returns "promoted" when the failover fired."""
+        if self.replicator.role == "primary":
+            return None
+        now = self.clock()
+        self.probes += 1
+        if self.probe():
+            self._down_since = None
+            return None
+        self.failures += 1
+        if self._down_since is None:
+            self._down_since = now
+        if now - self._down_since >= self.after_s:
+            try:
+                self.replicator.promote(reason="health")
+            except ReplicationError as exc:
+                log.warning("failover promote refused: %s", exc)
+                return None
+            return "promoted"
+        return None
+
+    def start(self) -> threading.Thread:
+        if self._thread is None:
+            def loop():
+                while not self._stop_evt.wait(self.poll_s):
+                    try:
+                        if self.check_once() == "promoted":
+                            return
+                    except Exception:
+                        log.exception("failover probe failed")
+
+            self._thread = threading.Thread(
+                target=loop, name="failover-watch", daemon=True
+            )
+            self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def stats(self) -> dict:
+        down_s = 0.0
+        if self._down_since is not None:
+            down_s = max(0.0, self.clock() - self._down_since)
+        return {
+            "armed": self._thread is not None and self._thread.is_alive(),
+            "primary": self.primary_url,
+            "afterS": self.after_s,
+            "probes": self.probes,
+            "failures": self.failures,
+            "downS": round(down_s, 3),
+        }

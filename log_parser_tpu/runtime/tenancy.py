@@ -376,6 +376,12 @@ class TenantRegistry:
         # until the caller re-resolves its placement; forwards are
         # re-installed on boot from the migration journals.
         self._forwards: dict[str, tuple[str, int]] = {}
+        # registry-wide fence (runtime/replicate.py): a demoted/standby
+        # process must refuse EVERY tenant resolution — including the
+        # default tenant, which per-tenant forwards cannot cover — or
+        # stale local state would fork the frequency history under a
+        # split brain. (location, retry_after_s) → 307 to the owner.
+        self._fence: tuple[str, int] | None = None
         self.default_context = TenantContext(
             DEFAULT_TENANT,
             default_engine,
@@ -393,6 +399,7 @@ class TenantRegistry:
         self.unknown = 0
         self.invalid = 0
         self.forwarded = 0
+        self.fenced = 0
         obs = getattr(default_engine, "obs", None)
         if obs is not None:
             obs.add_stats_collector("tenants", self.stats, METRIC_SAMPLES)
@@ -420,6 +427,17 @@ class TenantRegistry:
         faults.fire(  # conlint: contained-by-caller (transport error path)
             "tenant_resolve", key=tenant_id or DEFAULT_TENANT
         )
+        if not ignore_forward:
+            with self._lock:
+                fence = self._fence
+                if fence is not None:
+                    # fenced (standby / demoted primary): every resolution
+                    # — default tenant included, which the per-tenant
+                    # forward check below never sees — 307s to the owner
+                    self.fenced += 1
+                    raise TenantForwarded(
+                        tenant_id or DEFAULT_TENANT, fence[0], fence[1]
+                    )
         if not tenant_id or tenant_id == DEFAULT_TENANT:
             with self._lock:
                 self.resolved += 1
@@ -631,6 +649,27 @@ class TenantRegistry:
         with self._lock:
             return len(self._forwards)
 
+    def set_fence(self, location: str, retry_after_s: int = 5) -> None:
+        """Fence the WHOLE registry: every resolve — default tenant
+        included — raises :class:`TenantForwarded` (307 to ``location``)
+        until :meth:`clear_fence`. Installed by runtime/replicate.py when
+        this process is (or demotes to) the warm standby; internal
+        ``ignore_forward`` resolutions (replication apply, migration)
+        pass through so the standby can keep its bank warm."""
+        with self._lock:
+            self._fence = (location, int(retry_after_s))
+
+    def clear_fence(self) -> bool:
+        """Drop the registry fence (this process was promoted to owner)."""
+        with self._lock:
+            was = self._fence is not None
+            self._fence = None
+            return was
+
+    def fence_for(self) -> tuple[str, int] | None:
+        with self._lock:
+            return self._fence
+
     def detach(self, tenant_id: str) -> TenantContext | None:
         """Remove a tenant from residency WITHOUT closing it — the
         migration engine detaches after cutover and closes the context
@@ -679,5 +718,7 @@ class TenantRegistry:
                 "invalid": self.invalid,
                 "forwarded": self.forwarded,
                 "forwards": len(self._forwards),
+                "fenced": self.fenced,
+                "fence": self._fence[0] if self._fence is not None else "",
                 "perTenant": per_tenant,
             }

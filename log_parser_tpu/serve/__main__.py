@@ -120,6 +120,28 @@ def main(argv: list[str] | None = None) -> int:
         "open, the supervisor evacuates this process; 0 disables "
         "(default 0; LOG_PARSER_TPU_DRAIN_ON_BURN)",
     )
+    # warm-standby replication (docs/OPS.md "Warm-standby replication")
+    parser.add_argument(
+        "--replica-target", default=None, metavar="URL",
+        help="standby base URL (http://host:port) every tenant's "
+        "frequency WAL continuously ships to as it is fsynced "
+        "(runtime/replicate.py; requires --state-dir; "
+        "LOG_PARSER_TPU_REPLICA_TARGET)",
+    )
+    parser.add_argument(
+        "--replica-of", default=None, metavar="URL",
+        help="primary base URL this process is the warm standby of: "
+        "boot fenced (every client resolve 307s to the primary), "
+        "accept /admin/replica/feed, arm the failover supervisor "
+        "(requires --state-dir; LOG_PARSER_TPU_REPLICA_OF)",
+    )
+    parser.add_argument(
+        "--failover-after-s", type=float, default=None, metavar="SECONDS",
+        help="consecutive seconds the primary's /q/health must fail "
+        "before the standby journals PROMOTE(epoch+1) and takes "
+        "ownership; 0 = manual POST /admin/promote only (default 0; "
+        "LOG_PARSER_TPU_FAILOVER_AFTER_S)",
+    )
     # cross-request micro-batching (docs/OPS.md "Micro-batching")
     parser.add_argument(
         "--batching", choices=("on", "off"), default=None,
@@ -379,6 +401,9 @@ def main(argv: list[str] | None = None) -> int:
         (args.drain_deadline_s, "LOG_PARSER_TPU_DRAIN_DEADLINE_S"),
         (args.drain_target, "LOG_PARSER_TPU_DRAIN_TARGET"),
         (args.drain_on_burn, "LOG_PARSER_TPU_DRAIN_ON_BURN"),
+        (args.replica_target, "LOG_PARSER_TPU_REPLICA_TARGET"),
+        (args.replica_of, "LOG_PARSER_TPU_REPLICA_OF"),
+        (args.failover_after_s, "LOG_PARSER_TPU_FAILOVER_AFTER_S"),
     ):
         if flag is not None:
             os.environ[env_key] = str(flag)
@@ -595,6 +620,10 @@ def main(argv: list[str] | None = None) -> int:
         )
         tenant_root = None
 
+    # filled after the replicator is built below; tenant engines that come
+    # up later (lazy first-touch builds) attach their WAL senders here
+    replication_holder: dict = {"rep": None}
+
     def tenant_engine_setup(eng, tenant_id: str) -> None:
         # mirror the default engine's serving features; env carries the
         # flag values (the flag→env loop above ran before boot)
@@ -636,6 +665,11 @@ def main(argv: list[str] | None = None) -> int:
                     os.environ.get("LOG_PARSER_TPU_SNAPSHOT_EVERY", "512")
                 ),
             )
+            rep = replication_holder["rep"]
+            if rep is not None:
+                # primary side: this tenant's WAL starts shipping to the
+                # standby as soon as the engine is up (no-op on standbys)
+                rep.attach_sender(tenant_id, eng)
 
     t_inflight = int(os.environ.get("LOG_PARSER_TPU_TENANT_MAX_INFLIGHT", "0") or 0)
     t_queued = int(os.environ.get("LOG_PARSER_TPU_TENANT_MAX_QUEUED", "0") or 0)
@@ -767,6 +801,61 @@ def main(argv: list[str] | None = None) -> int:
             drain_on_burn,
             drain_target_url or "<close locally>",
         )
+    # warm-standby replication + fenced failover (runtime/replicate.py,
+    # docs/OPS.md "Warm-standby replication"). A primary (--replica-target)
+    # ships every tenant WAL to the standby; a standby (--replica-of) boots
+    # fenced, applies feeds, and promotes on sustained primary death.
+    replica_target_url = (
+        os.environ.get("LOG_PARSER_TPU_REPLICA_TARGET", "").strip() or None
+    )
+    replica_of_url = (
+        os.environ.get("LOG_PARSER_TPU_REPLICA_OF", "").strip() or None
+    )
+    failover_after = float(
+        os.environ.get("LOG_PARSER_TPU_FAILOVER_AFTER_S", "0") or 0
+    )
+    if (replica_target_url or replica_of_url) and not state_dir:
+        log.warning(
+            "replication needs --state-dir for the WAL + epoch journal; "
+            "--replica-target/--replica-of ignored"
+        )
+    elif replica_target_url or replica_of_url:
+        from log_parser_tpu.runtime.replicate import (
+            HttpReplicaTarget,
+            Replicator,
+        )
+        from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
+
+        replicator = Replicator(
+            tenants,
+            state_root=state_dir,
+            node_url=f"http://{args.host}:{args.port}",
+            peer_url=replica_of_url,
+            target=(
+                HttpReplicaTarget(replica_target_url)
+                if replica_target_url
+                else None
+            ),
+        )
+        server.replicator = replicator
+        # before recover(): tenants the recovery walk activates must come
+        # up with their WAL senders attached
+        replication_holder["rep"] = replicator
+        rep_summary = replicator.recover()
+        # the default engine's sender (tenant engines attach via
+        # tenant_engine_setup as they build)
+        if journal is not None:
+            replicator.attach_sender(DEFAULT_TENANT, engine)
+        if replica_of_url and failover_after > 0:
+            replicator.arm_failover(replica_of_url, after_s=failover_after)
+        replicator.start()
+        log.info(
+            "Replication role %s at epoch %d (%d protocol record(s) "
+            "replayed); target %s, failover %s",
+            replicator.role, replicator.epoch, rep_summary["records"],
+            replica_target_url or "<none>",
+            "%.1fs" % failover_after if failover_after > 0 else "manual",
+        )
     install_drain_handlers(
         server,
         server.admission,
@@ -807,6 +896,10 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.server_close()
         drain_supervisor.stop_watch()
+        if server.replicator is not None:
+            # stop the pump + failover watch; the epoch journal closes
+            # with its last fsynced record as the durable role
+            server.replicator.stop()
         if server.watcher is not None:
             server.watcher.stop()
         # tenant engines first: closes their batchers/stream sessions and

@@ -121,6 +121,10 @@ class ParseServer(ThreadingHTTPServer):
         # admin routes with 501
         self.migrator = None
         self.drain_supervisor = None
+        # warm-standby replication (runtime/replicate.py): wired by
+        # serve/__main__.py when --replica-target/--replica-of is set;
+        # None answers /admin/replica/feed and /admin/promote with 501
+        self.replicator = None
 
     @property
     def dropped_responses(self) -> int:
@@ -275,6 +279,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._admin_migrate_activate()
         if self.path == "/admin/drain":
             return self._admin_drain()
+        if self.path == "/admin/replica/feed":
+            return self._admin_replica_feed()
+        if self.path == "/admin/promote":
+            return self._admin_promote()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
@@ -467,6 +475,76 @@ class _Handler(BaseHTTPRequestHandler):
             )
         return mig
 
+    def _require_replication(self):
+        rep = self.server.replicator
+        if rep is None:
+            self._send_json(
+                501,
+                b'{"error":"replication is not enabled (serve with '
+                b'--state-dir and --replica-target/--replica-of)"}',
+            )
+        return rep
+
+    def _admin_replica_feed(self) -> None:
+        """``POST /admin/replica/feed``: one shipped WAL batch from the
+        primary — a snapshot barrier, or base64 CRC-framed records at
+        the tenant's acked offset. Verified and applied whole, or
+        refused with the receiver's position so the sender re-syncs;
+        a refused batch never moves the acked offset."""
+        from log_parser_tpu.runtime.replicate import ReplicationError
+
+        rep = self._require_replication()
+        if rep is None:
+            return
+        body = self._admin_body(max_body=_MIGRATE_MAX_BODY)
+        if body is None:
+            return
+        try:
+            ack = rep.feed(body)
+        except ReplicationError as exc:
+            return self._send_json(
+                exc.status if exc.status else 503,
+                json.dumps(exc.to_json()).encode(),
+            )
+        except Exception:
+            log.exception("replica feed failed")
+            return self._send_json(
+                500, b'{"error":"Internal replication failure"}'
+            )
+        return self._send_json(200, json.dumps(ack).encode())
+
+    def _admin_promote(self) -> None:
+        """``POST /admin/promote`` ``{["reason": text]}``: manual
+        failover — journal PROMOTE(epoch+1), activate every replicated
+        tenant, lift the fence. Idempotent on an already-primary
+        process; the abandoned primary demotes itself the moment it
+        sees the higher epoch."""
+        from log_parser_tpu.runtime.replicate import ReplicationError
+
+        rep = self._require_replication()
+        if rep is None:
+            return
+        body = self._admin_body()
+        if body is None:
+            return
+        reason = body.get("reason")
+        try:
+            summary = rep.promote(
+                reason=str(reason) if isinstance(reason, str) and reason
+                else "admin"
+            )
+        except ReplicationError as exc:
+            return self._send_json(
+                exc.status if exc.status else 503,
+                json.dumps(exc.to_json()).encode(),
+            )
+        except Exception:
+            log.exception("promotion failed")
+            return self._send_json(
+                500, b'{"error":"Internal replication failure"}'
+            )
+        return self._send_json(200, json.dumps(summary).encode())
+
     def _admin_migrate(self) -> None:
         """``POST /admin/migrate`` ``{"tenant": id, "target": url[,
         "retryAfterS": n]}``: run the full source side of the migration
@@ -631,6 +709,16 @@ class _Handler(BaseHTTPRequestHandler):
                 # the divergent pattern(s) serve from the host regex until
                 # a clean half-open probe (docs/OPS.md "Shadow divergence")
                 checks.append({"name": "shadow", "status": "DEGRADED"})
+            rep = self.server.replicator
+            if rep is not None and rep.role == "standby":
+                # informational, not DOWN: a standby is healthy but fenced
+                # — client traffic 307s to the owner while feeds apply.
+                # The failover supervisor on the OTHER side probes this
+                # same endpoint, which must stay 200 while we are alive.
+                checks.append({
+                    "name": "replication", "status": "STANDBY",
+                    "epoch": rep.epoch,
+                })
             slo = self.server.obs.slo.health()
             if slo is not None and slo["status"] != "UP":
                 # SLO burn: an objective is spending its error budget
@@ -758,6 +846,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if sup is not None:
                     mig_stats["drain"] = sup.stats()
                 payload["migration"] = mig_stats
+            replicator = self.server.replicator
+            if replicator is not None:
+                # replication channel + failover position (docs/OPS.md
+                # "Warm-standby replication")
+                payload["replication"] = replicator.stats()
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats

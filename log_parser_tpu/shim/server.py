@@ -36,6 +36,10 @@ class ShimServer(socketserver.ThreadingTCPServer):
             name: (req_t, getattr(self.service, attr))
             for name, req_t, _resp_t, attr in RPCS
         }
+        # warm-standby replication (runtime/replicate.py): set by the
+        # embedding process to answer ReplicaFeed/Promote envelopes —
+        # the framed twin of POST /admin/replica/feed + /admin/promote
+        self.replicator = None
 
     @property
     def engine(self):
@@ -83,6 +87,48 @@ class _Handler(socketserver.BaseRequestHandler):
                             else b""
                         ),
                     )
+                elif method in ("ReplicaFeed", "Promote"):
+                    # replication protocol over the framed transport: the
+                    # envelope payload is the same JSON body the HTTP admin
+                    # routes take; a refusal answers payload=position JSON
+                    # + error text so the sender re-syncs (or demotes) from
+                    # the framed reply exactly like an HTTP 409 body
+                    from log_parser_tpu.runtime.replicate import (
+                        ReplicationError,
+                    )
+
+                    rep = self.server.replicator
+                    if rep is None:
+                        response = pb.Envelope(
+                            method=envelope.method,
+                            error="replication is not enabled",
+                        )
+                    else:
+                        import json as _json
+
+                        try:
+                            body = _json.loads(
+                                envelope.payload.decode("utf-8") or "{}"
+                            )
+                            doc = (
+                                rep.feed(body)
+                                if method == "ReplicaFeed"
+                                else rep.promote(
+                                    reason=str(body.get("reason") or "shim")
+                                    if isinstance(body, dict)
+                                    else "shim"
+                                )
+                            )
+                            response = pb.Envelope(
+                                method=envelope.method,
+                                payload=_json.dumps(doc).encode(),
+                            )
+                        except ReplicationError as exc:
+                            response = pb.Envelope(
+                                method=envelope.method,
+                                payload=_json.dumps(exc.to_json()).encode(),
+                                error=str(exc),
+                            )
                 elif (entry := self.server.dispatch.get(method)) is None:
                     response = pb.Envelope(
                         method=envelope.method,

@@ -75,10 +75,15 @@ def test_union_minimize_byte_walk_parity():
         )
 
 
-def test_union_minimize_shrinks_shared_suffixes():
+def test_union_minimize_shrinks_shared_suffixes(monkeypatch):
     """Distinct alternation branches with a common tail are exactly what
     subset construction duplicates and minimization merges — the shrink
-    must be real, not a no-op rename."""
+    must be real, not a no-op rename. Forces the Python construction
+    path: the native union builder Moore-minimizes as it packs, so its
+    output has no duplicated suffix states left to shrink."""
+    import log_parser_tpu.native.dfabuild as dfabuild
+
+    monkeypatch.setattr(dfabuild, "get_lib", lambda: None)
     regexes = [("abcdefgh|xbcdefgh|ybcdefgh", False), ("zzcdefgh", False)]
     raw = compile_union_regexes(regexes, minimize=False)
     mini = minimize_multi_dfa(raw)

@@ -457,6 +457,87 @@ class TestCrashMatrix:
             reg_b2.shutdown()
 
 
+# ------------------------------------------------ recover idempotency
+
+
+def _journal_records(state_dir) -> dict[str, int]:
+    mdir = state_dir / "_migrate"
+    if not mdir.exists():
+        return {}
+    return {
+        p.name: len(MigrationJournal.replay(str(p)))
+        for p in sorted(mdir.iterdir())
+        if p.suffix == ".wal"
+    }
+
+
+class TestRecoverIdempotency:
+    """recover() is a convergence, not a transition: running it twice —
+    same process or a double boot — must land on the same forwards and
+    append nothing new to an already-sealed journal."""
+
+    def test_double_boot_after_precutover_crash(self, root, tmp_path):
+        clk = FakeClock()
+        reg_a, mig_a = _side(tmp_path, root, "a", clk,
+                             crash_after={"export"}, journaled=True)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk, journaled=True)
+        reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+        with pytest.raises(MigrationCrash):
+            mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+        reg_a.shutdown()
+        reg_b.shutdown()
+        # first boot seals the journal with ABORT
+        reg_a2, mig_a2 = _side(tmp_path, root, "a", clk, journaled=True)
+        sum1 = mig_a2.recover()
+        assert len(sum1["discarded"]) == 1
+        sealed = _journal_records(tmp_path / "a")
+        # second boot: already aborted — nothing appended, same answer
+        reg_a3, mig_a3 = _side(tmp_path, root, "a", clk, journaled=True)
+        sum2 = mig_a3.recover()
+        assert sum2 == {"forwards": [], "resumed": [], "discarded": [],
+                        "pending": []}
+        assert _journal_records(tmp_path / "a") == sealed
+        assert mig_a3.recover() == sum2  # and a third pass in-process
+        assert reg_a3.forward_for("acme") is None
+        reg_a3.resolve("acme")  # still owned here
+        reg_a2.shutdown()
+        reg_a3.shutdown()
+
+    def test_round_trip_reboot_does_not_resurrect_stale_forward(
+        self, root, tmp_path
+    ):
+        clk = FakeClock()
+        reg_a, mig_a = _side(tmp_path, root, "a", clk, journaled=True)
+        reg_b, mig_b = _side(tmp_path, root, "b", clk, journaled=True)
+        reg_a.resolve("acme").engine.analyze(_data(TRAFFIC[0]))
+        # out and back: A -> B, then B -> A. Live round trips work
+        # (activate clears the stale forward); the regression was the
+        # REBOOT — replaying the old outbound cutover re-installed the
+        # forward and the owner 307'd its own tenant forever.
+        mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+        mig_b.migrate("acme", LocalTarget(mig_a, url="local://a"))
+        assert reg_a.forward_for("acme") is None
+        reg_a.shutdown()
+        reg_b.shutdown()
+        reg_a2, mig_a2 = _side(tmp_path, root, "a", clk, journaled=True)
+        sum1 = mig_a2.recover()
+        assert "acme" not in sum1["forwards"]
+        assert reg_a2.forward_for("acme") is None
+        reg_a2.resolve("acme")  # A serves: no TenantForwarded
+        # double boot converges identically
+        sealed = _journal_records(tmp_path / "a")
+        assert mig_a2.recover() == sum1
+        assert _journal_records(tmp_path / "a") == sealed
+        # B's reboot still forwards to A — exactly one owner either side
+        reg_b2, mig_b2 = _side(tmp_path, root, "b", clk, journaled=True)
+        assert mig_b2.recover()["forwards"] == ["acme"]
+        with pytest.raises(TenantForwarded) as ei:
+            reg_b2.resolve("acme")
+        assert ei.value.location == "local://a"
+        reg_a2.shutdown()
+        reg_b2.shutdown()
+
+
 # --------------------------------------------------- bundle integrity
 
 

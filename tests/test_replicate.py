@@ -1,0 +1,664 @@
+"""Warm-standby replication (runtime/replicate.py): the fenced-failover
+single-owner contract.
+
+The anchor is the crash matrix: a simulated ``kill -9`` (the
+``crash_after`` hook — fsync'd protocol record, no cleanup) at every
+replication/promotion journal-record boundary (``epoch`` adoption,
+``promote``, ``demote``) × fresh-process ``recover()`` must converge to
+exactly one owner per tenant, and the promoted standby's frequency
+state must be bit-identical to an acked-prefix replay control under a
+frozen clock (the PR 16 technique). Around it: WAL shipping (barrier
+seed, incremental whole-frame batches, rotation fallback, offset
+re-sync, backoff), the receiver's reject-whole-batch rule for torn and
+CRC-corrupt frames (the satellite mirror of the WAL torn-tail tests),
+the registry-wide fence (default tenant included), and the
+FailoverSupervisor's consecutive-failure promotion.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+import zlib
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.patterns import load_pattern_directory
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.journal import _FRAME
+from log_parser_tpu.runtime.replicate import (
+    FailoverSupervisor,
+    LocalReplicaTarget,
+    PROTOCOL_RECORDS,
+    ReplicaCrash,
+    ReplicationError,
+    Replicator,
+)
+from log_parser_tpu.runtime.tenancy import (
+    DEFAULT_TENANT,
+    TenantForwarded,
+    TenantRegistry,
+)
+
+from helpers import make_pattern, make_pattern_set
+
+ACME_YAML = """
+metadata:
+  library_id: acme-lib
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+  - id: err
+    name: Errors
+    severity: LOW
+    primary_pattern:
+      regex: "\\\\bERROR\\\\b"
+      confidence: 0.5
+"""
+
+TRAFFIC = [
+    "INFO boot\njava.lang.OutOfMemoryError: heap\nan ERROR here",
+    "ERROR twice\nERROR again\nOutOfMemoryError",
+    "nothing to see",
+    "java.lang.OutOfMemoryError: metaspace\nERROR",
+    "INFO a\nINFO b\nan ERROR here",
+]
+
+
+class FakeClock:
+    """Shared frozen clock: integer-valued steps keep the age/timestamp
+    round trips float-exact, which bit-identical parity depends on."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture()
+def root(tmp_path):
+    d = tmp_path / "tenants" / "acme"
+    d.mkdir(parents=True)
+    (d / "lib.yaml").write_text(ACME_YAML)
+    return str(tmp_path / "tenants")
+
+
+def _default_engine(clk) -> AnalysisEngine:
+    return AnalysisEngine(
+        [make_pattern_set([make_pattern("base", regex="BASE")], "base-lib")],
+        ScoringConfig(),
+        clock=clk,
+    )
+
+
+def _data(blob: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "t"}}, logs=blob)
+
+
+def _node(tmp_path, root, name, clk, *, peer=None, target=None,
+          crash_after=None):
+    """One 'process': a journaled registry + its Replicator over a
+    per-side state dir. Re-calling with the same name over the same
+    dirs is the restart half of a kill -9 simulation."""
+    state = tmp_path / name
+    state.mkdir(exist_ok=True)
+
+    def setup(eng, tid):
+        # WAL wall-time frozen to the shared clock: parity across a
+        # simulated restart and across the replication channel needs
+        # every side to stamp records at the same instant
+        eng.attach_journal(str(state / "wal" / tid), wall=clk)
+
+    reg = TenantRegistry(
+        _default_engine(clk), root=root, clock=clk, engine_setup=setup
+    )
+    rep = Replicator(
+        reg, state_root=str(state), node_url=f"local://{name}",
+        peer_url=peer, target=target, clock=clk, wall=clk,
+        crash_after=crash_after,
+    )
+    return reg, rep
+
+
+def _pair(tmp_path, root, clk, *, standby_crash=None, primary_crash=None):
+    """primary 'a' shipping to standby 'b' (in-process target)."""
+    reg_b, rep_b = _node(
+        tmp_path, root, "b", clk, peer="local://a",
+        crash_after=standby_crash,
+    )
+    rep_b.recover()  # installs the boot fence
+    target = LocalReplicaTarget(rep_b, url="local://b")
+    reg_a, rep_a = _node(
+        tmp_path, root, "a", clk, target=target, crash_after=primary_crash
+    )
+    rep_a.recover()
+    return (reg_a, rep_a), (reg_b, rep_b), target
+
+
+def _serve(reg, rep, tenant, blob):
+    ctx = reg.resolve(tenant)
+    try:
+        ctx.engine.analyze(_data(blob))
+    finally:
+        ctx.unpin()
+
+
+def _sender(reg, rep, tenant="acme"):
+    ctx = reg.resolve(tenant)
+    sender = rep.attach_sender(tenant, ctx.engine)
+    ctx.unpin()
+    assert sender is not None
+    return sender
+
+
+def _control(tmp_path, root, clk, prefix, step=lambda c: None):
+    """Unreplicated control: a fresh acme engine fed ``prefix`` at the
+    same clock instants (the caller's ``step`` mirrors its stepping)."""
+    eng = AnalysisEngine(
+        load_pattern_directory(f"{root}/acme"), ScoringConfig(), clock=clk
+    )
+    for blob in prefix:
+        eng.analyze(_data(blob))
+        step(clk)
+    return eng
+
+
+def _snapshot(reg, tenant="acme"):
+    ctx = reg.resolve(tenant, ignore_forward=True)
+    try:
+        with ctx.engine.state_lock:
+            return ctx.engine.frequency.snapshot()
+    finally:
+        ctx.unpin()
+
+
+# ------------------------------------------------------------- shipping
+
+
+class TestShipping:
+    def test_seed_then_incremental_batches_apply(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        clk.t += 1.0
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        assert sender.pump() == "shipped"
+        assert sender.pump() == "idle"
+        assert rep_b.stats()["appliedBatches"] == 2
+        # the standby's warm bank equals the primary's live state
+        assert _snapshot(reg_b) == _snapshot(reg_a)
+
+    def test_standby_state_is_durable_in_its_own_wal(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        before = _snapshot(reg_b)
+        assert before  # non-trivial state actually shipped
+        # standby process dies (no clean close) and reboots: the fed
+        # state must come back from the standby's OWN journal
+        reg_b2, rep_b2 = _node(tmp_path, root, "b", clk, peer="local://a")
+        rep_b2.recover()
+        assert _snapshot(reg_b2) == before
+
+    def test_rotation_falls_back_to_fresh_barrier(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        ctx = reg_a.resolve("acme")
+        try:
+            journal = ctx.engine.journal
+            _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+            # rotate: snapshot + truncate bumps the WAL epoch and drops
+            # the frames the sender was about to ship
+            assert journal.snapshot_now()
+        finally:
+            ctx.unpin()
+        assert sender.pump() == "seeded"
+        assert sender.reseeds == 2
+        assert _snapshot(reg_b) == _snapshot(reg_a)
+
+    def test_offset_mismatch_resyncs_from_receiver_position(
+        self, root, tmp_path
+    ):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        # the standby process restarts: its in-memory feed position is
+        # gone (acked=0, walEpoch=-1); the sender's next incremental
+        # batch is refused with the receiver's position and the sender
+        # re-syncs via a fresh barrier
+        reg_b2, rep_b2 = _node(tmp_path, root, "b", clk, peer="local://a")
+        rep_b2.recover()
+        sender.target = LocalReplicaTarget(rep_b2, url="local://b")
+        assert sender.pump() == "resync"
+        assert sender.pump() == "seeded"
+        assert sender.resyncs == 1
+        assert _snapshot(reg_b2) == _snapshot(reg_a)
+
+    def test_misaligned_resume_offset_reseeds(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        # corrupt ack bookkeeping: the resume point lands mid-frame, so
+        # no incremental batch can ever parse — must not wedge on idle
+        sender.acked_offset = max(0, sender.acked_offset - 3)
+        assert sender.pump() == "seeded"
+        assert _snapshot(reg_b) == _snapshot(reg_a)
+
+    def test_unreachable_standby_backs_off_with_jitter(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), target = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+
+        class Down:
+            url = "local://b"
+
+            def feed(self, body):
+                raise ReplicationError("standby unreachable", status=0)
+
+        sender.target = Down()
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "error"
+        assert sender.pump() == "backoff"
+        assert 0.0 < sender.backoff_s() <= 15.0
+        # reconnect resumes — and because nothing was ever acked, the
+        # resume is the fresh-snapshot path
+        sender.target = target
+        clk.t += 60.0
+        assert sender.pump() == "seeded"
+        assert sender.send_errors == 1
+        assert _snapshot(reg_b) == _snapshot(reg_a)
+
+    def test_lag_gauges_and_metrics_render(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        clk.t += 5.0
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        clk.t += 3.0
+        # peek at the lag without shipping: wedge the target
+        real_target, sender.target = sender.target, None
+        try:
+            sender.pump()
+        except AttributeError:
+            pass
+        finally:
+            sender.target = real_target
+        stats = rep_a.stats()
+        assert stats["lagBytes"] > 0
+        assert stats["lagRecords"] > 0
+        assert stats["lagSeconds"] >= 3.0
+        text = reg_a.default_engine.obs.registry.render()
+        assert "logparser_replication_lag_bytes" in text
+        assert "logparser_replication_lag_records" in text
+        assert "logparser_replication_epoch" in text
+
+
+# ----------------------------------------------- receiver verification
+
+
+class TestReceiverIntegrity:
+    """Satellite: a torn or CRC-corrupt frame mid-stream must reject the
+    batch WHOLE, keep the acked offset, and force a re-send — a partial
+    record is never applied."""
+
+    def _shipped_body(self, reg_a, rep_a, sender):
+        """A valid incremental feed body, captured without sending."""
+        ctx = reg_a.resolve("acme")
+        try:
+            journal = ctx.engine.journal
+            epoch, size, data = journal.wal_feed(sender.acked_offset, 1 << 20)
+        finally:
+            ctx.unpin()
+        assert data, "test needs pending WAL frames"
+        return {
+            "tenant": "acme",
+            "epoch": rep_a.epoch,
+            "walEpoch": epoch,
+            "offset": sender.acked_offset,
+            "frames": base64.b64encode(data).decode("ascii"),
+            "barrier": None,
+            "wall": rep_a.wall(),
+        }
+
+    @pytest.fixture()
+    def fed_pair(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        clk.t += 1.0
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        return clk, (reg_a, rep_a, sender), (reg_b, rep_b)
+
+    def _reject_roundtrip(self, corrupt, fed_pair):
+        clk, (reg_a, rep_a, sender), (reg_b, rep_b) = fed_pair
+        body = self._shipped_body(reg_a, rep_a, sender)
+        raw = base64.b64decode(body["frames"])
+        acked_before = rep_b.stats()["feeds"]["acme"]["acked"]
+        state_before = _snapshot(reg_b)
+        bad = dict(body)
+        bad["frames"] = base64.b64encode(corrupt(raw)).decode("ascii")
+        with pytest.raises(ReplicationError) as exc:
+            rep_b.feed(bad)
+        assert exc.value.status == 409
+        assert exc.value.extra["acked"] == acked_before
+        # NOTHING applied — not even the whole frames before the bad one
+        assert rep_b.stats()["feeds"]["acme"]["acked"] == acked_before
+        assert _snapshot(reg_b) == state_before
+        assert rep_b.stats()["rejectedBatches"] == 1
+        # the sender re-sends the intact batch and converges
+        assert sender.pump() == "shipped"
+        assert _snapshot(reg_b) == _snapshot(reg_a)
+
+    def test_torn_final_frame_rejects_batch(self, fed_pair):
+        self._reject_roundtrip(lambda raw: raw[:-3], fed_pair)
+
+    def test_crc_corrupt_frame_mid_stream_rejects_batch(self, fed_pair):
+        def flip(raw):
+            # corrupt one payload byte of the FIRST frame: every later
+            # frame in the batch is intact, and must still not apply
+            length, _crc = _FRAME.unpack_from(raw, 0)
+            assert _FRAME.size + length < len(raw), "need 2+ frames"
+            i = _FRAME.size
+            return raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+
+        self._reject_roundtrip(flip, fed_pair)
+
+    def test_non_json_payload_rejects_batch(self, fed_pair):
+        def forge(raw):
+            payload = b"\xff{not json"
+            frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+            return raw + frame
+
+        self._reject_roundtrip(forge, fed_pair)
+
+    def test_stale_epoch_feed_is_refused_with_owner(self, fed_pair):
+        clk, (reg_a, rep_a, sender), (reg_b, rep_b) = fed_pair
+        body = self._shipped_body(reg_a, rep_a, sender)
+        rep_b.promote(reason="test")
+        body["epoch"] = 0
+        with pytest.raises(ReplicationError) as exc:
+            rep_b.feed(body)
+        assert exc.value.status == 409
+        assert exc.value.extra["epoch"] == rep_b.epoch == 1
+        assert exc.value.extra["location"] == "local://b"
+
+
+# ------------------------------------------------------------- fencing
+
+
+class TestFence:
+    def test_standby_fences_every_tenant_including_default(
+        self, root, tmp_path
+    ):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        for tid in (None, DEFAULT_TENANT, "acme"):
+            with pytest.raises(TenantForwarded) as exc:
+                reg_b.resolve(tid)
+            assert exc.value.status == 307
+            assert exc.value.location == "local://a"
+        assert reg_b.stats()["fenced"] == 3
+        assert reg_b.stats()["fence"] == "local://a"
+        # the primary is NOT fenced
+        reg_a.resolve("acme").unpin()
+
+    def test_promote_lifts_fence_and_serves(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        summary = rep_b.promote(reason="drill")
+        assert summary["status"] == "promoted"
+        assert summary["epoch"] == 1
+        assert "acme" in summary["tenants"]
+        # promoted standby serves everything, fence gone
+        reg_b.resolve(None).unpin()
+        reg_b.resolve("acme").unpin()
+        # idempotent second promote
+        assert rep_b.promote(reason="again")["status"] == "primary"
+        assert rep_b.epoch == 1
+
+    def test_stale_primary_demotes_and_forwards(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        sender = _sender(reg_a, rep_a)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        assert sender.pump() == "seeded"
+        rep_b.promote(reason="partition")
+        # the partition heals: the stale primary's next ship sees the
+        # higher epoch in the refusal and steps down
+        _serve(reg_a, rep_a, "acme", TRAFFIC[1])
+        assert sender.pump() == "demoted"
+        assert rep_a.role == "standby"
+        assert rep_a.epoch == 1
+        with pytest.raises(TenantForwarded) as exc:
+            reg_a.resolve("acme")
+        assert exc.value.location == "local://b"
+        with pytest.raises(TenantForwarded):
+            reg_a.resolve(None)
+        # exactly one owner: b serves, a forwards
+        reg_b.resolve("acme").unpin()
+
+
+# -------------------------------------------------------- crash matrix
+
+
+def _step(clk):
+    clk.t += 1.0
+
+
+class TestCrashMatrix:
+    """kill -9 at every protocol journal-record boundary × fresh-process
+    recover() → exactly one owner, state bit-identical to the
+    acked-prefix control."""
+
+    def _shipped_prefix(self, tmp_path, root, clk, n_acked, **pair_kw):
+        (reg_a, rep_a), (reg_b, rep_b), target = _pair(
+            tmp_path, root, clk, **pair_kw
+        )
+        sender = _sender(reg_a, rep_a)
+        for i, blob in enumerate(TRAFFIC[:n_acked]):
+            _serve(reg_a, rep_a, "acme", blob)
+            outcome = sender.pump()
+            # "idle" happens when the blob matched nothing (no new
+            # WAL frames) — still a fully acked position
+            assert outcome in ("seeded", "shipped", "idle")
+            _step(clk)
+        # un-acked tail: served on the primary but never shipped — the
+        # standby must NOT know it (TRAFFIC[0] always produces frames)
+        _serve(reg_a, rep_a, "acme", TRAFFIC[0])
+        return (reg_a, rep_a, sender), (reg_b, rep_b), target
+
+    @pytest.mark.parametrize("n_acked", [1, 2, 4])
+    def test_promoted_state_equals_acked_prefix_control(
+        self, root, tmp_path, n_acked
+    ):
+        clk = FakeClock()
+        (reg_a, rep_a, _s), (reg_b, rep_b), _t = self._shipped_prefix(
+            tmp_path, root, clk, n_acked
+        )
+        # primary dies (kill -9: nothing folded); the standby promotes
+        rep_b.promote(reason="health")
+        control_clk = FakeClock()
+        control = _control(
+            tmp_path, root, control_clk, TRAFFIC[:n_acked], step=_step
+        )
+        assert control_clk.t == clk.t
+        assert _snapshot(reg_b) == control.frequency.snapshot()
+        # and the promoted standby's scoring matches the control's
+        ctx = reg_b.resolve("acme")
+        try:
+            got = ctx.engine.analyze(_data(TRAFFIC[4])).to_dict(drop_none=True)
+        finally:
+            ctx.unpin()
+        want = control.analyze(_data(TRAFFIC[4])).to_dict(drop_none=True)
+        assert [e["score"] for e in got.get("events", [])] == [
+            e["score"] for e in want.get("events", [])
+        ]
+
+    def test_crash_after_promote_record_recovers_promoted(
+        self, root, tmp_path
+    ):
+        clk = FakeClock()
+        (reg_a, rep_a, sender), (reg_b, rep_b), target = self._shipped_prefix(
+            tmp_path, root, clk, 2, standby_crash={"promote"}
+        )
+        with pytest.raises(ReplicaCrash):
+            rep_b.promote(reason="health")
+        # the record IS durable: a fresh process over the same dirs must
+        # come up as the owner (idempotent re-activation)
+        reg_b2, rep_b2 = _node(tmp_path, root, "b", clk, peer="local://a")
+        summary = rep_b2.recover()
+        assert summary["role"] == "primary"
+        assert rep_b2.epoch == 1
+        reg_b2.resolve("acme").unpin()  # serves — fence lifted
+        # double boot (crash during recovery): recover() again over the
+        # same journals must re-install the same state and nothing else
+        reg_b3, rep_b3 = _node(tmp_path, root, "b", clk, peer="local://a")
+        assert rep_b3.recover() == summary
+        # the revived stale primary sees epoch 1 and steps down
+        target.replicator = rep_b2
+        _serve(reg_a, rep_a, "acme", TRAFFIC[3])
+        assert sender.pump() == "demoted"
+        with pytest.raises(TenantForwarded):
+            reg_a.resolve("acme")
+        # control parity for the acked prefix survives the crash
+        control_clk = FakeClock()
+        control = _control(
+            tmp_path, root, control_clk, TRAFFIC[:2], step=_step
+        )
+        assert _snapshot(reg_b2) == control.frequency.snapshot()
+
+    def test_crash_after_demote_record_recovers_fenced(self, root, tmp_path):
+        clk = FakeClock()
+        (reg_a, rep_a, sender), (reg_b, rep_b), _t = self._shipped_prefix(
+            tmp_path, root, clk, 2, primary_crash={"demote"}
+        )
+        rep_b.promote(reason="partition")
+        with pytest.raises(ReplicaCrash):
+            sender.pump()
+        # fresh process over the stale primary's dirs: the DEMOTE record
+        # is durable, so it must come up standby + fenced
+        reg_a2, rep_a2 = _node(tmp_path, root, "a", clk)
+        summary = rep_a2.recover()
+        assert summary["role"] == "standby"
+        assert rep_a2.epoch == 1
+        with pytest.raises(TenantForwarded) as exc:
+            reg_a2.resolve("acme")
+        assert exc.value.location == "local://b"
+        with pytest.raises(TenantForwarded):
+            reg_a2.resolve(None)
+        # exactly one owner throughout
+        reg_b.resolve("acme").unpin()
+
+    def test_crash_after_epoch_adoption_record(self, root, tmp_path):
+        clk = FakeClock()
+        # a re-provisioned standby at epoch 0 fed by a primary already
+        # at epoch 2 (two failovers ago)
+        reg_b, rep_b = _node(
+            tmp_path, root, "b", clk, peer="local://a",
+            crash_after={"epoch"},
+        )
+        rep_b.recover()
+        body = {
+            "tenant": "acme", "epoch": 2, "walEpoch": 0, "offset": 0,
+            "frames": "", "barrier": {"k": "b", "ages": {"oom": [0.0]},
+                                      "w": clk()},
+            "wall": clk(),
+        }
+        with pytest.raises(ReplicaCrash):
+            rep_b.feed(body)
+        # the adoption record is durable: recover() resumes at epoch 2
+        # and the SAME feed then applies
+        reg_b2, rep_b2 = _node(tmp_path, root, "b", clk, peer="local://a")
+        assert rep_b2.recover()["epoch"] == 2
+        ack = rep_b2.feed(body)
+        assert ack["epoch"] == 2
+        assert rep_b2.stats()["adoptions"] == 0  # no second adoption
+
+    def test_protocol_record_vocabulary_is_pinned(self):
+        assert PROTOCOL_RECORDS == ("epoch", "promote", "demote")
+
+    def test_recover_is_idempotent_without_records(self, root, tmp_path):
+        clk = FakeClock()
+        reg_a, rep_a = _node(tmp_path, root, "a", clk)
+        assert rep_a.recover()["role"] == "primary"
+        assert rep_a.recover() == {
+            "role": "primary", "epoch": 0, "records": 0, "tenants": [],
+        }
+
+
+# ----------------------------------------------------------- failover
+
+
+class TestFailoverSupervisor:
+    def _supervised(self, root, tmp_path, after_s=5.0):
+        clk = FakeClock()
+        (reg_a, rep_a), (reg_b, rep_b), _ = _pair(tmp_path, root, clk)
+        health = {"up": True}
+        sup = FailoverSupervisor(
+            rep_b, "local://a", after_s=after_s, poll_s=1.0, clock=clk,
+            probe=lambda: health["up"],
+        )
+        return clk, rep_b, sup, health
+
+    def test_promotes_after_consecutive_failures(self, root, tmp_path):
+        clk, rep_b, sup, health = self._supervised(root, tmp_path, 5.0)
+        assert sup.check_once() is None  # healthy
+        health["up"] = False
+        assert sup.check_once() is None  # failure clock starts
+        clk.t += 4.0
+        assert sup.check_once() is None  # 4s down < 5s
+        clk.t += 1.0
+        assert sup.check_once() == "promoted"
+        assert rep_b.role == "primary"
+        assert rep_b.epoch == 1
+        assert sup.check_once() is None  # already primary: watch is done
+        assert sup.stats()["failures"] == 3
+
+    def test_flapping_primary_never_trips(self, root, tmp_path):
+        clk, rep_b, sup, health = self._supervised(root, tmp_path, 5.0)
+        for _ in range(10):
+            health["up"] = False
+            assert sup.check_once() is None
+            clk.t += 4.0
+            health["up"] = True
+            assert sup.check_once() is None  # resets the down clock
+            clk.t += 1.0
+        assert rep_b.role == "standby"
+        assert rep_b.promotions == 0
+
+    def test_stats_shape(self, root, tmp_path):
+        clk, rep_b, sup, health = self._supervised(root, tmp_path, 5.0)
+        health["up"] = False
+        sup.check_once()
+        s = sup.stats()
+        assert s["primary"] == "local://a"
+        assert s["afterS"] == 5.0
+        assert s["probes"] == 1 and s["failures"] == 1
+        assert s["downS"] == 0.0 and s["armed"] is False

@@ -382,11 +382,15 @@ class TestHTTPQuotaEnvelope:
     def test_429_with_retry_after(self, root):
         # 4-token bucket for acme only: its 3-line request fits ONCE,
         # then the drained bucket sheds with a real retry window, while
-        # globex and the default tenant are unbounded
+        # globex and the default tenant are unbounded. The refill rate is
+        # deliberately slow (0.2/s: the 2-token shortfall takes 10s to
+        # recover) so a loaded host can't refill the bucket in the wall
+        # clock between the two posts.
         reg = _registry(
             root,
             quota_factory=lambda tid: TenantQuota(
-                lines_per_s=2.0 if tid == "acme" else 0.0
+                lines_per_s=0.2 if tid == "acme" else 0.0,
+                burst_s=20.0,
             ),
         )
         server, url = self._serve(reg)
@@ -556,7 +560,7 @@ class TestResidency:
             assert set(s) == {
                 "residentTenants", "budgetMb", "residentBankMb", "resolved",
                 "created", "evicted", "rebuilds", "unknown", "invalid",
-                "forwarded", "forwards", "perTenant",
+                "forwarded", "forwards", "fenced", "fence", "perTenant",
             }
             assert set(s["perTenant"]) == {DEFAULT_TENANT, "acme"}
             per = s["perTenant"]["acme"]

@@ -22,6 +22,14 @@ lower-is-better. A delta inside ``--threshold`` percent is ``ok``
 Exit code is 0 unless ``--strict`` is given, in which case any
 ``regressed`` row exits 1 — the refresh script runs this advisorily
 (a slow machine is not a broken bench), CI may opt into --strict.
+
+Bench honesty: artifacts stamped by ``bench_common.emit`` carry a
+``host_load`` block (``os.getloadavg()`` + cpu count). When the two
+sides ran under per-cpu load that differs by more than 2x, every
+verdict here is comparing machine weather, not code — the diff still
+prints, but it is marked advisory-untrustworthy (``load_advisory`` in
+the JSON summary, a warning banner in the table) and ``--strict``
+ignores regressions from such a pair.
 """
 
 from __future__ import annotations
@@ -117,6 +125,44 @@ def diff(old: dict, new: dict, threshold: float) -> list[dict]:
     return rows
 
 
+# per-cpu load below this is idle-box noise; ratios of two near-zero
+# loads say nothing about comparability
+_LOAD_FLOOR = 0.05
+_LOAD_RATIO_LIMIT = 2.0
+
+
+def load_advisory(old: dict, new: dict) -> dict | None:
+    """None when the two artifacts ran under comparable host load (or
+    either side predates the ``host_load`` stamp); otherwise a dict
+    naming the imbalance — the caller marks the whole diff advisory."""
+
+    def norm(doc):
+        h = doc.get("host_load")
+        if not isinstance(h, dict):
+            return None
+        la, cpus = h.get("loadavg"), h.get("cpus")
+        if not isinstance(la, (list, tuple)) or not la:
+            return None
+        try:
+            return max(float(la[0]), 0.0) / max(int(cpus or 1), 1)
+        except (TypeError, ValueError):
+            return None
+
+    a, b = norm(old), norm(new)
+    if a is None or b is None:
+        return None
+    lo, hi = sorted((max(a, _LOAD_FLOOR), max(b, _LOAD_FLOOR)))
+    ratio = hi / lo
+    if ratio <= _LOAD_RATIO_LIMIT:
+        return None
+    return {
+        "old_load_per_cpu": round(a, 3),
+        "new_load_per_cpu": round(b, 3),
+        "ratio": round(ratio, 2),
+        "limit": _LOAD_RATIO_LIMIT,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two bench artifacts with a +/-threshold verdict")
@@ -138,9 +184,12 @@ def main(argv=None) -> int:
     rows = diff(old, new, args.threshold)
     regressed = sum(1 for r in rows if r["verdict"] == "regressed")
     improved = sum(1 for r in rows if r["verdict"] == "improved")
+    advisory = load_advisory(old, new)
     summary = {"rows": rows, "compared": len(rows), "regressed": regressed,
                "improved": improved, "threshold_pct": args.threshold,
-               "old": args.old, "new": args.new}
+               "old": args.old, "new": args.new,
+               "load_advisory": advisory,
+               "trustworthy": advisory is None}
 
     if args.as_json:
         print(json.dumps(summary, indent=2))
@@ -156,7 +205,18 @@ def main(argv=None) -> int:
                   f"{r['new']:>14,.1f}  {pct:>9}  {r['verdict']}")
         print(f"-- {len(rows)} compared, {improved} improved, "
               f"{regressed} regressed (threshold ±{args.threshold}%)")
-    return 1 if (args.strict and regressed) else 0
+    if advisory is not None:
+        print(
+            "!! ADVISORY: host load differed "
+            f"{advisory['ratio']}x between the two runs "
+            f"(old {advisory['old_load_per_cpu']}/cpu, "
+            f"new {advisory['new_load_per_cpu']}/cpu, limit "
+            f"{advisory['limit']}x) — verdicts above compare machine "
+            "weather, not code; re-run on a quiet host before trusting "
+            "them",
+            file=sys.stderr,
+        )
+    return 1 if (args.strict and regressed and advisory is None) else 0
 
 
 if __name__ == "__main__":

@@ -221,8 +221,27 @@ health-driven drain — docs/OPS.md "Tenant migration & drain"):
                         naming the new owner — cutover never hangs on
                         a pinned stream.
 
+Replica group (``--group replica``; warm-standby replication + fenced
+failover — docs/OPS.md "Warm-standby replication & failover"):
+
+- ``replica-failover-kill9``   a live primary/standby pair shipping WAL
+                        (``logparser_replication_lag_*`` visible on
+                        /metrics) loses its primary to SIGKILL; the
+                        armed supervisor promotes the standby, which
+                        then serves the tenant's replicated history.
+- ``replica-stale-primary-demotes`` the standby is promoted while the
+                        primary is still alive (the operator error the
+                        fence exists for): the stale primary's next
+                        shipped batch is refused with the higher
+                        epoch, it demotes itself, and client traffic
+                        307-forwards to the new owner.
+- ``replica-lagging-promotion`` the primary is killed with an unshipped
+                        WAL tail; a manual /admin/promote serves the
+                        acked prefix — the documented state-loss bound
+                        — and the promotion is journaled.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|replica|all]
                                    [--keep-logs]
 """
 
@@ -280,8 +299,11 @@ def get(url: str, path: str):
 class Server:
     """One serve child; scenario args via CLI flags, chaos via env."""
 
-    def __init__(self, name: str, args: list[str], env: dict[str, str]):
-        self.port = free_port()
+    def __init__(self, name: str, args: list[str], env: dict[str, str],
+                 port: int | None = None):
+        # replica pairs need each other's URL at boot, so their ports are
+        # allocated up front and passed in
+        self.port = port or free_port()
         self.url = f"http://127.0.0.1:{self.port}"
         self.log = tempfile.NamedTemporaryFile(
             "wb", prefix=f"chaos_{name}_", suffix=".log", delete=False
@@ -1724,6 +1746,204 @@ MIGRATE_STANDALONE = [
 ]
 
 
+# Replica group (``--group replica``; warm-standby replication + fenced
+# failover — docs/OPS.md "Warm-standby replication & failover"): real
+# primary/standby pairs over HTTP; where a drill needs a dead primary it
+# dies by SIGKILL, so promotion must work from the epoch journal and the
+# standby's own re-journaled WAL alone.
+
+
+def _replica_pair(tmp: str, prefix: str, failover_s: float | None = None):
+    """A primary continuously shipping to a warm standby. The primary
+    boots first and must be ready before the standby exists: an armed
+    standby starts probing immediately, and primary boot latency must
+    never be counted as primary death."""
+    root = _make_tenant_root(tmp)
+    a_port, b_port = free_port(), free_port()
+    primary = Server(
+        f"{prefix}-primary",
+        ["--tenant-root", root,
+         "--state-dir", os.path.join(tmp, "a_state"),
+         "--replica-target", f"http://127.0.0.1:{b_port}"],
+        {}, port=a_port,
+    )
+    primary.wait_ready()
+    flags = ["--tenant-root", root,
+             "--state-dir", os.path.join(tmp, "b_state"),
+             "--replica-of", f"http://127.0.0.1:{a_port}"]
+    if failover_s is not None:
+        flags += ["--failover-after-s", str(failover_s)]
+    standby = Server(f"{prefix}-standby", flags, {}, port=b_port)
+    standby.wait_ready()
+    return primary, standby
+
+
+def _applied_records(url: str) -> int:
+    _, trace = get(url, "/trace/last")
+    rep = trace.get("replication") or {}
+    return int(rep.get("appliedRecords", 0))
+
+
+def scenario_replica_failover_kill9():
+    """The acceptance drill end to end: a pair ships live WAL (the lag
+    families are on /metrics), the primary dies by SIGKILL, the armed
+    supervisor promotes the standby, and the standby serves the
+    tenant's replicated history un-fenced."""
+    with tempfile.TemporaryDirectory(prefix="chaos_replica_") as tmp:
+        primary, standby = _replica_pair(tmp, "replica-kill9",
+                                         failover_s=3.0)
+        try:
+            hdr = {"X-Tenant": "acme"}
+            assert post(primary.url, hdr)[0] == 200
+            assert post(primary.url)[0] == 200  # default tenant too
+            # the standby is fenced while its primary lives
+            code, _, fhdrs = post(standby.url, hdr)
+            assert code == 307, code
+            assert fhdrs["Location"].startswith(primary.url), fhdrs
+            # shipping is continuous: both tenants' frames land and are
+            # re-journaled on the standby
+            _poll_trace(
+                standby.url,
+                lambda t: (t.get("replication") or {}).get(
+                    "appliedRecords", 0) >= 2,
+                timeout=45.0,
+            )
+            _, text = get_text(primary.url, "/metrics")
+            assert "logparser_replication_lag_bytes" in text, (
+                "lag families missing from /metrics"
+            )
+            assert "logparser_replication_lag_records" in text
+            primary.proc.kill()  # SIGKILL: no drain, no goodbye
+            primary.proc.wait(10)
+            trace = _poll_trace(
+                standby.url,
+                lambda t: (t.get("replication") or {}).get("role")
+                == "primary",
+                timeout=30.0,
+            )
+            rep = trace["replication"]
+            assert rep["promotions"] >= 1 and rep["epoch"] >= 1, rep
+            # the supervisor fired and disarmed itself: it counted the
+            # primary down for the full threshold before promoting
+            fo = rep["failover"]
+            assert fo["failures"] >= 1 and fo["downS"] >= 3.0, fo
+            # the fence is lifted: the replicated history serves here now
+            assert post(standby.url, hdr)[0] == 200
+            assert post(standby.url)[0] == 200
+            _, text = get_text(standby.url, "/metrics")
+            assert "logparser_replication_promotions_total" in text
+        finally:
+            primary.stop()
+            standby.stop()
+
+
+def scenario_replica_stale_primary_demotes():
+    """Promote the standby while the primary is still alive — the
+    operator error split-brain fencing exists for. The stale primary's
+    next shipped batch is refused with the higher epoch, it demotes
+    itself durably, and its client traffic 307-forwards to the new
+    owner instead of double-serving."""
+    with tempfile.TemporaryDirectory(prefix="chaos_replica_") as tmp:
+        primary, standby = _replica_pair(tmp, "replica-stale")
+        try:
+            hdr = {"X-Tenant": "acme"}
+            assert post(primary.url, hdr)[0] == 200
+            _poll_trace(
+                standby.url,
+                lambda t: (t.get("replication") or {}).get(
+                    "appliedRecords", 0) >= 1,
+                timeout=45.0,
+            )
+            status, body = post_raw(standby.url, "/admin/promote",
+                                    b'{"reason":"drill"}')
+            assert status == 200 and body["status"] == "promoted", (
+                status, body,
+            )
+            assert body["epoch"] >= 1, body
+            # new traffic on the stale primary journals fresh frames; its
+            # pump ships them with the old epoch and gets refused
+            assert post(primary.url, hdr)[0] in (200, 307)
+            trace = _poll_trace(
+                primary.url,
+                lambda t: (t.get("replication") or {}).get("role")
+                == "standby",
+                timeout=30.0,
+            )
+            rep = trace["replication"]
+            assert rep["demotions"] >= 1, rep
+            assert rep["epoch"] >= body["epoch"], rep
+            # fenced: the loser forwards to the winner
+            code, _, fhdrs = post(primary.url, hdr)
+            assert code == 307, code
+            assert fhdrs["Location"].startswith(standby.url), fhdrs
+            assert post(standby.url, hdr)[0] == 200
+        finally:
+            primary.stop()
+            standby.stop()
+
+
+def scenario_replica_lagging_promotion():
+    """SIGKILL the primary with an unshipped WAL tail, then promote by
+    hand: the standby serves the acked prefix — the documented
+    state-loss bound — and the promotion is journaled (idempotent on a
+    second POST)."""
+    with tempfile.TemporaryDirectory(prefix="chaos_replica_") as tmp:
+        primary, standby = _replica_pair(tmp, "replica-lag")
+        try:
+            hdr = {"X-Tenant": "acme"}
+            assert post(primary.url, hdr)[0] == 200
+            _poll_trace(
+                standby.url,
+                lambda t: (t.get("replication") or {}).get(
+                    "appliedRecords", 0) >= 1,
+                timeout=45.0,
+            )
+            acked = _applied_records(standby.url)
+            # pile on a tail and kill before the 0.2s pump can ship all
+            # of it — some of these frames (and some of these requests)
+            # die with the primary, which is the point
+            def fire():
+                try:
+                    post(primary.url, hdr, timeout=10)
+                except OSError:
+                    pass  # connection died under SIGKILL
+            threads = [threading.Thread(target=fire) for _ in range(4)]
+            for t in threads:
+                t.start()
+            primary.proc.kill()
+            primary.proc.wait(10)
+            for t in threads:
+                t.join(30)
+            assert all(not t.is_alive() for t in threads), "burst stuck"
+            status, body = post_raw(standby.url, "/admin/promote",
+                                    b'{"reason":"primary dead"}')
+            assert status == 200 and body["status"] == "promoted", (
+                status, body,
+            )
+            # idempotent re-promote: already primary, same epoch
+            status2, body2 = post_raw(standby.url, "/admin/promote", b"{}")
+            assert status2 == 200 and body2["status"] == "primary", (
+                status2, body2,
+            )
+            assert body2["epoch"] == body["epoch"], (body, body2)
+            # the acked prefix survived the failover and serves
+            assert _applied_records(standby.url) >= acked
+            assert post(standby.url, hdr)[0] == 200
+            _, trace = get(standby.url, "/trace/last")
+            rep = trace["replication"]
+            assert rep["role"] == "primary" and rep["promotions"] >= 1, rep
+        finally:
+            primary.stop()
+            standby.stop()
+
+
+REPLICA_STANDALONE = [
+    ("replica-failover-kill9", scenario_replica_failover_kill9),
+    ("replica-stale-primary-demotes", scenario_replica_stale_primary_demotes),
+    ("replica-lagging-promotion", scenario_replica_lagging_promotion),
+]
+
+
 def scenario_miner_tap_overflow(srv: Server):
     """A wedged miner worker (miner_hang:inf) under a tiny tap capacity:
     the bounded queue fills, further novel lines become DROPS — counted
@@ -2158,7 +2378,7 @@ def main(argv: list[str] | None = None) -> int:
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
             "streaming", "distributed", "tenant", "miner", "obs", "spans",
-            "migrate", "all",
+            "migrate", "replica", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -2221,6 +2441,8 @@ def main(argv: list[str] | None = None) -> int:
         standalone.extend(MINER_STANDALONE)
     if args.group in ("migrate", "all"):
         standalone.extend(MIGRATE_STANDALONE)
+    if args.group in ("replica", "all"):
+        standalone.extend(REPLICA_STANDALONE)
     for name, check in standalone:
         if args.only and name != args.only:
             continue

@@ -69,7 +69,23 @@ Checks (all files tracked by git, minus excluded dirs):
  17. the causal-span vocabulary (``SPANS`` in obs/spans.py — the
      ``GET /trace/spans`` / OTLP span-name contract) and the
      ``logparser_device_*`` utilization families each have a
-     backtick-quoted docs/OPS.md row.
+     backtick-quoted docs/OPS.md row;
+ 18. the tenant-migration vocabulary is pinned by name: the migration
+     fault sites (``FAULT_SITES`` in runtime/migrate.py) each have a
+     docs/OPS.md row AND a live ``faults.fire`` call site, the
+     migration spans and ``logparser_migration_*`` families exist and
+     have rows, and every ``--drain-*`` serve flag has a
+     backtick-quoted row;
+ 19. the warm-standby replication vocabulary is pinned the same way:
+     the replication fault sites (``FAULT_SITES`` in
+     runtime/replicate.py — ``replica_send`` / ``replica_apply`` /
+     ``promote``) each have a docs/OPS.md row AND a live
+     ``faults.fire`` call site, the replication spans (``replicate`` /
+     ``promote`` / ``demote``) and the ``logparser_replication_*``
+     metric families exist and have backtick-quoted rows, and the
+     ``--replica-*``/``--failover-*`` serve flags meet the same
+     backtick-row standard (losing any of these must read as a hole in
+     the failover runbook, not a routine vocabulary shrink).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -838,6 +854,97 @@ def check_migrate_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_replica_vocab_pinned(root: Path) -> list[str]:
+    """Check 19: the warm-standby replication vocabulary must be pinned
+    the way check 18 pins migration's. The replication fault sites
+    (``FAULT_SITES`` in runtime/replicate.py — ``replica_send`` /
+    ``replica_apply`` / ``promote``, one per protocol leg) each need a
+    docs/OPS.md row and a live ``faults.fire`` call site
+    (comment-tolerant scan). The replication span names and the
+    ``logparser_replication_*`` families are pinned BY NAME — losing
+    one must point at the failover runbook. The ``--replica-*`` and
+    ``--failover-*`` serve flags get the backtick-row standard."""
+    src = root / "log_parser_tpu" / "runtime" / "replicate.py"
+    spans_src = root / "log_parser_tpu" / "obs" / "spans.py"
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    sites = _dict_keys_of(src, "FAULT_SITES")
+    for required in ("replica_send", "replica_apply", "promote"):
+        if required not in sites:
+            problems.append(
+                f"{src}: replication fault site {required!r} is missing "
+                "from FAULT_SITES — the failover chaos drills depend on it"
+            )
+    for key in sites:
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{src}: replication fault site {key!r} is not documented "
+                "in docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{src}: replication fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    if spans_src.is_file():
+        span_names = set(_dict_keys_of(spans_src, "SPANS"))
+        for name in ("replicate", "promote", "demote"):
+            if name not in span_names:
+                problems.append(
+                    f"{spans_src}: replication span {name!r} is missing "
+                    "from SPANS — the failover causal trace depends on it"
+                )
+            elif f"`{name}`" not in ops_text:
+                problems.append(
+                    f"{spans_src}: replication span {name!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if registry_src.is_file():
+        metrics = set(_dict_keys_of(registry_src, "METRICS"))
+        replica_fams = {
+            m for m in metrics if m.startswith("logparser_replication_")
+        }
+        if not replica_fams:
+            problems.append(
+                f"{registry_src}: no logparser_replication_* metric "
+                "families — the replication-lag alerts depend on them"
+            )
+        for fam in sorted(replica_fams):
+            if f"`{fam}`" not in ops_text:
+                problems.append(
+                    f"{registry_src}: replication family {fam!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--(?:replica|failover)-[a-z0-9-]+)"',
+            serve_src.read_text(),
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: replication serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -870,6 +977,7 @@ def main() -> int:
         problems.extend(check_obs_vocab_pinned(root))
         problems.extend(check_span_vocab_pinned(root))
         problems.extend(check_migrate_vocab_pinned(root))
+        problems.extend(check_replica_vocab_pinned(root))
 
     for p in problems:
         print(p)
