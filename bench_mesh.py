@@ -32,6 +32,14 @@ operator sees when the tenant set outgrows ``--tenant-budget-mb``.
 between two registries (runtime/migrate.py) inside every measured pass,
 folding migration churn into the same fleet figure.
 
+``--fleet`` runs the router front-door scenario instead (no mesh):
+``--fleet-backends`` serving subprocesses behind a ``--role router``
+subprocess, ``--tenants`` (default 1,000) tenant libraries under
+zipf-distributed traffic, one mid-rank tenant going hot mid-run and the
+placement loop converting its quota sheds into a live migration —
+metric ``fleet_router_lines_per_sec``, with the move count, post-move
+recovery, and the compiled-pack dedupe savings in the artifact.
+
 Prints exactly one JSON line like every bench:
     {"metric": "dp_mesh_lines_per_sec", "value": N, "unit": "lines/s",
      "vs_baseline": value / 1e6, "platform": ..., ...}
@@ -79,6 +87,22 @@ N_MIGRATIONS = (
     int(sys.argv[sys.argv.index("--tenant-migrations") + 1])
     if "--tenant-migrations" in sys.argv
     else 0
+)
+# --fleet: the router front-door scenario (log_parser_tpu/fleet/) —
+# >= 3 serving SUBPROCESSES behind a router subprocess, >= 1,000
+# tenants under zipf traffic, one tenant going hot mid-run and the
+# placement loop reacting with a live migration. The parent process
+# only drives HTTP, so the mesh env setup below is inert for it.
+FLEET = "--fleet" in sys.argv
+FLEET_BACKENDS = (
+    int(sys.argv[sys.argv.index("--fleet-backends") + 1])
+    if "--fleet-backends" in sys.argv
+    else 3
+)
+FLEET_REQUESTS = (
+    int(sys.argv[sys.argv.index("--fleet-requests") + 1])
+    if "--fleet-requests" in sys.argv
+    else 1500
 )
 MODE = os.environ.get("LOG_PARSER_TPU_MESH", "virtual")
 if MODE not in ("virtual", "real"):
@@ -351,7 +375,373 @@ def tenant_residency_main() -> None:
     )
 
 
+_TENANT_LIB_YAML = """
+metadata:
+  library_id: fleet-lib
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+  - id: err
+    name: Errors
+    severity: LOW
+    primary_pattern:
+      regex: "\\\\bERROR\\\\b"
+      confidence: 0.5
+"""
+
+
+class _FleetChild:
+    """One serve subprocess (backend or router); log to a temp file so
+    the parent's stdout stays a single artifact JSON line."""
+
+    def __init__(self, name: str, args: list):
+        import socket
+        import subprocess
+        import tempfile
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.log = tempfile.NamedTemporaryFile(
+            "wb", prefix=f"bench_fleet_{name}_", suffix=".log", delete=False
+        )
+        pattern_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "log_parser_tpu", "patterns", "builtin",
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "log_parser_tpu.serve",
+             "--pattern-dir", pattern_dir,
+             "--host", "127.0.0.1", "--port", str(self.port), *args],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONUNBUFFERED": "1"},
+            stdout=self.log, stderr=self.log,
+        )
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        import time
+        import urllib.request
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet child died rc={self.proc.returncode} "
+                    f"(log: {self.log.name})"
+                )
+            try:
+                with urllib.request.urlopen(
+                    self.url + "/health/ready", timeout=5
+                ) as resp:
+                    if resp.status == 200:
+                        return
+            except OSError:
+                time.sleep(0.25)
+        raise RuntimeError(f"fleet child never ready (log: {self.log.name})")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(20)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(10)
+
+
+def _fleet_post(url: str, body: bytes, tenant: str) -> int:
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/parse", data=body,
+        headers={"Content-Type": "application/json", "X-Tenant": tenant},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            resp.read()
+            return resp.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except OSError:
+        return -1
+
+
+def _fleet_metric(url: str, family: str, label: str = "") -> float:
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(family) and (not label or label in line):
+            try:
+                total += float(line.rsplit(None, 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _dedupe_probe(n_banks: int) -> dict:
+    """The compiled-bank substructure-sharing half of the fleet story,
+    measured in-process: N identical banks with the pack memo on vs
+    off. Sharing must build exactly ONE pack; the unshared baseline
+    re-loads (and re-holds) a private pack per bank."""
+    import tempfile
+    import time
+
+    from log_parser_tpu.patterns import libcache
+    from log_parser_tpu.patterns.bank import PatternBank
+    from log_parser_tpu.patterns.builtin import load_builtin_pattern_sets
+
+    sets = load_builtin_pattern_sets()
+    os.environ["LOG_PARSER_TPU_CACHE"] = tempfile.mkdtemp(
+        prefix="bench-fleet-packs-"
+    )
+    PatternBank(sets)  # seed the on-disk snapshot outside both timings
+
+    libcache.reset_packs()
+    t0 = time.perf_counter()
+    shared_banks = [PatternBank(sets) for _ in range(n_banks)]
+    dt_shared = time.perf_counter() - t0
+    stats = libcache.pack_stats()
+    assert stats["built"] <= 1 and stats["shared"] >= n_banks - 1, stats
+
+    os.environ["LOG_PARSER_TPU_PACK_SHARE"] = "0"
+    libcache.reset_packs()
+    t0 = time.perf_counter()
+    unshared_banks = [PatternBank(sets) for _ in range(n_banks)]
+    dt_unshared = time.perf_counter() - t0
+    del os.environ["LOG_PARSER_TPU_PACK_SHARE"]
+    assert len(shared_banks) == len(unshared_banks)
+
+    pack_bytes = stats["residentBytes"]
+    return {
+        "dedupe_banks": n_banks,
+        "pack_builds": stats["built"],
+        "pack_shared": stats["shared"],
+        "pack_bytes": pack_bytes,
+        "dedupe_saved_mb": round(pack_bytes * (n_banks - 1) / 2**20, 2),
+        "build_s_shared": round(dt_shared, 3),
+        "build_s_unshared": round(dt_unshared, 3),
+        "build_speedup": round(dt_unshared / max(dt_shared, 1e-9), 1),
+    }
+
+
+def fleet_main() -> None:
+    """Fleet front-door scenario: FLEET_BACKENDS serving subprocesses
+    behind a router subprocess, >= 1,000 tenants under zipf-distributed
+    traffic, one mid-rank tenant going hot mid-run. The placement loop
+    must convert the hot tenant's quota sheds into a live migration; the
+    artifact records the aggregate routed lines/s, the move count, and
+    the hot tenant's post-move recovery, plus the compiled-pack dedupe
+    savings that make 1,000 same-pattern tenants per process viable."""
+    import bisect
+    import json as _json
+    import random
+    import shutil
+    import tempfile
+    import threading
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    n_tenants = N_TENANTS or 1000
+    metric = "fleet_router_lines_per_sec"
+    platform = f"cpu-fleet{FLEET_BACKENDS}"
+    bounded = bench_common.bounded_runner(metric, "lines/s", lambda: platform)
+
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+    tenants = [f"t{i:04d}" for i in range(n_tenants)]
+    children: list[_FleetChild] = []
+
+    def setup():
+        root = os.path.join(tmp, "tenants")
+        for tid in tenants:
+            d = os.path.join(root, tid)
+            os.makedirs(d)
+            with open(os.path.join(d, "lib.yaml"), "w") as f:
+                f.write(_TENANT_LIB_YAML)
+        backends = [
+            _FleetChild(
+                f"backend{i}",
+                ["--tenant-root", root,
+                 "--state-dir", os.path.join(tmp, f"state{i}"),
+                 "--tenant-lines-per-s", "100"],
+            )
+            for i in range(FLEET_BACKENDS)
+        ]
+        children.extend(backends)
+        for b in backends:
+            b.wait_ready()
+        router = _FleetChild(
+            "router",
+            ["--role", "router",
+             "--backends", ",".join(f"127.0.0.1:{b.port}" for b in backends),
+             "--fleet-poll-s", "0.5", "--fleet-shed-rate", "0.5",
+             # 1,000 cold tenants all build banks on first touch; that
+             # is fill, not thrash — park the thrash trigger so the
+             # only move is the hot tenant's quota-shed one
+             "--fleet-thrash-rebuilds", "100000",
+             "--fleet-down-after", "10"],
+        )
+        children.append(router)
+        router.wait_ready()
+        return router
+
+    router = bounded(setup, bench_common.PROBE_TIMEOUT_S, "fleet boot")
+
+    # zipf(1.1) over the tenant ranks — a head-heavy fleet traffic shape
+    alpha = 1.1
+    weights = [1.0 / (r ** alpha) for r in range(1, n_tenants + 1)]
+    cum = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc)
+    rng = random.Random(4217)
+
+    def pick() -> str:
+        return tenants[bisect.bisect_left(cum, rng.random() * acc)]
+
+    body_lines = 20
+    body = _json.dumps(
+        {"pod": {"metadata": {"name": "bench-fleet"}},
+         "logs": build_corpus(body_lines)}
+    ).encode()
+    hot_tenant = tenants[42]  # mid-rank: background share is negligible
+    hot_body = _json.dumps(
+        {"pod": {"metadata": {"name": "bench-fleet-hot"}},
+         "logs": build_corpus(200)}
+    ).encode()
+
+    counts = {"ok": 0, "shed": 0, "other": 0, "lines_ok": 0}
+    lock = threading.Lock()
+
+    def drive(tenant: str, payload: bytes, n_lines: int) -> int:
+        status = _fleet_post(router.url, payload, tenant)
+        with lock:
+            if status == 200:
+                counts["ok"] += 1
+                counts["lines_ok"] += n_lines
+            elif status == 429:
+                counts["shed"] += 1
+            else:
+                counts["other"] += 1
+        return status
+
+    report: dict = {}
+
+    def campaign():
+        t0 = time.perf_counter()
+        # steady zipf phase
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            for f in [pool.submit(drive, pick(), body, body_lines)
+                      for _ in range(FLEET_REQUESTS)]:
+                f.result()
+        # hot phase: hammer one tenant past its lines/s budget while
+        # background zipf traffic keeps flowing, until the placer moves it
+        stop = threading.Event()
+        hot_sheds = [0]
+
+        def hammer():
+            while not stop.is_set():
+                if drive(hot_tenant, hot_body, 200) == 429:
+                    hot_sheds[0] += 1
+
+        def background():
+            while not stop.is_set():
+                drive(pick(), body, body_lines)
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        threads += [threading.Thread(target=background) for _ in range(2)]
+        for t in threads:
+            t.start()
+        moved_at = None
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if _fleet_metric(router.url,
+                                 "logparser_fleet_moves_total") >= 1:
+                    moved_at = time.monotonic()
+                    break
+                time.sleep(0.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+        if moved_at is None:
+            raise RuntimeError("placer never moved the hot tenant")
+        # recovery: the moved tenant lands on a fresh lines/s bucket, so
+        # normal-pace traffic must be clean again
+        recovered_at = None
+        post_ok = 0
+        for _ in range(10):
+            if drive(hot_tenant, body, body_lines) == 200:
+                post_ok += 1
+                recovered_at = recovered_at or time.monotonic()
+            time.sleep(0.2)
+        dt = time.perf_counter() - t0
+        report.update(
+            requests_ok=counts["ok"],
+            requests_shed=counts["shed"],
+            requests_other=counts["other"],
+            hot_sheds_pre_move=hot_sheds[0],
+            moves=_fleet_metric(router.url, "logparser_fleet_moves_total"),
+            **{
+                f"moves_{reason}": _fleet_metric(
+                    router.url, "logparser_fleet_moves_total", reason
+                )
+                for reason in ("quota_shed", "slo_burn", "residency_thrash")
+            },
+            backends_up=_fleet_metric(
+                router.url, "logparser_fleet_backends_up"
+            ),
+            post_move_ok=post_ok,
+            post_move_recovery_s=(
+                round(recovered_at - moved_at, 2) if recovered_at else None
+            ),
+        )
+        assert report["moves_quota_shed"] >= 1, report
+        assert report["requests_other"] <= 2, report
+        assert post_ok >= 8, report  # SLO burn recovered after the move
+        return counts["lines_ok"] / dt
+
+    try:
+        rate = bounded(campaign, bench_common.PROBE_TIMEOUT_S,
+                       "fleet campaign")
+        dedupe = bounded(lambda: _dedupe_probe(64),
+                         bench_common.PROBE_TIMEOUT_S, "pack dedupe")
+    finally:
+        for c in reversed(children):
+            c.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    bench_common.emit(
+        metric,
+        round(rate, 1),
+        "lines/s",
+        round(rate / NORTH_STAR_LINES_PER_SEC, 4),
+        platform,
+        n_tenants=n_tenants,
+        n_backends=FLEET_BACKENDS,
+        zipf_alpha=alpha,
+        hot_tenant=hot_tenant,
+        **report,
+        **dedupe,
+    )
+
+
 def main() -> None:
+    if FLEET:
+        fleet_main()
+        return
     if N_TENANTS and (RESIDENCY or BUDGET_MB):
         tenant_residency_main()
         return

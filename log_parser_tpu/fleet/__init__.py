@@ -1,0 +1,30 @@
+"""Fleet front-door: consistent-hash tenant routing, signal-driven
+placement, and fleet-arbitrated budgets (docs/OPS.md "Fleet routing &
+placement").
+
+One router process (``serve --role router --backends host:port,...``)
+terminates the public transports, resolves the tenant id at the edge
+(the exact ``runtime/tenancy.py`` extraction), and proxies each request
+to one of N backend serving processes picked by consistent hashing over
+a ring with virtual nodes (``ring.py``). Tenant moves are LIVE
+MIGRATIONS through ``runtime/migrate.py`` — the 307 ``TenantForwarded``
+envelope is the move mechanism, the router's ring override map is the
+steady state (``router.py``). A control loop (``placement.py``) polls
+backend ``/metrics`` + ``/q/health`` and converts sustained SLO burn,
+quota shedding, or residency thrash into those moves; ``budget.py``
+re-arbitrates the engine-local cache/residency budgets from observed
+per-tenant traffic.
+"""
+
+from log_parser_tpu.fleet.budget import FleetBudget
+from log_parser_tpu.fleet.placement import FleetController
+from log_parser_tpu.fleet.ring import HashRing
+from log_parser_tpu.fleet.router import RouterServer, make_router
+
+__all__ = [
+    "FleetBudget",
+    "FleetController",
+    "HashRing",
+    "RouterServer",
+    "make_router",
+]

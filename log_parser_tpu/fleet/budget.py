@@ -1,0 +1,101 @@
+"""Fleet-arbitrated budgets: router-assigned shares of one fleet-wide
+cache/residency allowance, replacing the per-process ``--line-cache-mb``
+and ``--tenant-budget-mb`` constants.
+
+The arbiter splits ``--fleet-cache-mb`` / ``--fleet-tenant-budget-mb``
+across live backends proportional to the request traffic each one
+actually observed over the last window (requests_total deltas scraped
+by fleet/placement.py). An idle backend keeps a floor share — a cold
+backend with zero traffic must still be able to warm its first tenant —
+and shares only re-push when they drift past a hysteresis band, so a
+noisy 51/49 split does not thrash the backends' eviction loops.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# never hand a backend less than this, whatever traffic says
+MIN_SHARE_MB = 8.0
+# re-push only when a share moved by this fraction of its previous value
+HYSTERESIS = 0.10
+
+
+class FleetBudget:
+    def __init__(self, cache_mb: float, tenant_budget_mb: float):
+        self.cache_mb = max(0.0, float(cache_mb))
+        self.tenant_budget_mb = max(0.0, float(tenant_budget_mb))
+        self._lock = threading.Lock()
+        self._shares: dict[str, dict[str, float]] = {}
+        self.rebalances = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.cache_mb > 0 or self.tenant_budget_mb > 0
+
+    def _split(self, total_mb: float, traffic: dict[str, float]) -> dict:
+        if total_mb <= 0 or not traffic:
+            return {}
+        floor = min(MIN_SHARE_MB, total_mb / max(1, len(traffic)))
+        pool = total_mb - floor * len(traffic)
+        volume = sum(traffic.values())
+        shares = {}
+        for backend, observed in traffic.items():
+            weight = (observed / volume) if volume > 0 else 1 / len(traffic)
+            shares[backend] = round(floor + max(0.0, pool) * weight, 2)
+        return shares
+
+    def recompute(self, traffic: dict[str, float]) -> dict[str, dict]:
+        """``{backend: requests-this-window}`` -> the backends whose
+        assignment changed enough to push: ``{backend: {"lineCacheMb":
+        x, "tenantBudgetMb": y}}``. Call with every UP backend present
+        (zero traffic included) so floors are handed out fleet-wide."""
+        cache = self._split(self.cache_mb, traffic)
+        tenant = self._split(self.tenant_budget_mb, traffic)
+        changed: dict[str, dict] = {}
+        with self._lock:
+            for backend in traffic:
+                assignment = {}
+                if self.cache_mb > 0:
+                    assignment["lineCacheMb"] = cache[backend]
+                if self.tenant_budget_mb > 0:
+                    assignment["tenantBudgetMb"] = tenant[backend]
+                if not assignment:
+                    continue
+                prev = self._shares.get(backend)
+                if prev is None or any(
+                    abs(assignment[k] - prev.get(k, 0.0))
+                    > HYSTERESIS * max(prev.get(k, 0.0), MIN_SHARE_MB)
+                    for k in assignment
+                ):
+                    self._shares[backend] = assignment
+                    changed[backend] = assignment
+            if changed:
+                self.rebalances += 1
+            # a backend that left the fleet forgets its share: when it
+            # returns it re-earns one from live traffic
+            for gone in set(self._shares) - set(traffic):
+                del self._shares[gone]
+        return changed
+
+    def shares(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {b: dict(s) for b, s in self._shares.items()}
+
+    def samples(self):
+        """Registry-collector view: one gauge sample per (backend, kind)."""
+        out = []
+        for backend, share in self.shares().items():
+            if "lineCacheMb" in share:
+                out.append((
+                    "logparser_fleet_budget_mb",
+                    {"backend": backend, "kind": "line_cache"},
+                    share["lineCacheMb"],
+                ))
+            if "tenantBudgetMb" in share:
+                out.append((
+                    "logparser_fleet_budget_mb",
+                    {"backend": backend, "kind": "tenant"},
+                    share["tenantBudgetMb"],
+                ))
+        return out

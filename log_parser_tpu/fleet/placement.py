@@ -1,0 +1,372 @@
+"""Signal-driven placement: the fleet control loop.
+
+One thread per router polls every backend's ``/q/health`` +
+``/metrics`` and converts the PR 14–15 signal families into actions:
+
+=====================  =========================================  ============================
+signal                 trigger                                    action
+=====================  =========================================  ============================
+health probe fails     ``down_after`` consecutive failures        ring.remove (arc re-maps);
+                                                                  probe keeps running, ring.add
+                                                                  on recovery
+``slo_burn_rate``      > ``burn_threshold`` for ``burn_polls``    move the backend's hottest
+                       consecutive polls                          tenant to the least-loaded
+                                                                  backend
+per-tenant sheds       429/503 rate for one tenant above          move THAT tenant
+(``requests_total``)   ``shed_rate``/s over the poll window
+residency thrash       ``tenant_builds_total`` delta ≥            move the hottest tenant
+                       ``thrash_rebuilds`` in one window          (residency pressure follows
+                                                                  traffic)
+=====================  =========================================  ============================
+
+Moves are LIVE MIGRATIONS: ``POST /admin/migrate`` on the source drives
+the full ``runtime/migrate.py`` protocol against the chosen target, and
+on success the controller installs the router override directly — the
+next request never pays the 307 hop. A per-tenant cooldown
+(``move_cooldown_s``) stops a flapping signal from ping-ponging a
+tenant between backends.
+
+The same scrape feeds :class:`~log_parser_tpu.fleet.budget.FleetBudget`:
+per-backend request deltas become traffic weights, and changed shares
+are pushed through each backend's ``POST /admin/budget``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from log_parser_tpu.fleet.budget import FleetBudget
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
+
+log = logging.getLogger(__name__)
+
+_SERIES = re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_SHED_STATUSES = frozenset({"429", "503"})
+
+
+def parse_prom(text: str) -> dict[str, list[tuple[dict[str, str], float]]]:
+    """Minimal Prometheus text parse: name -> [(labels, value)]."""
+    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = {
+            k: v.replace('\\"', '"').replace("\\\\", "\\")
+            for k, v in _LABEL.findall(raw_labels or "")
+        }
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+class _Snapshot:
+    """One backend's counters at one poll — deltas against the previous
+    snapshot are the window signals."""
+
+    __slots__ = ("tenant_requests", "tenant_sheds", "builds", "burn", "when")
+
+    def __init__(self, metrics: dict, when: float):
+        self.when = when
+        self.tenant_requests: dict[str, float] = {}
+        self.tenant_sheds: dict[str, float] = {}
+        for labels, value in metrics.get("logparser_requests_total", ()):
+            tenant = labels.get("tenant", DEFAULT_TENANT)
+            self.tenant_requests[tenant] = (
+                self.tenant_requests.get(tenant, 0.0) + value
+            )
+            if labels.get("status") in _SHED_STATUSES:
+                self.tenant_sheds[tenant] = (
+                    self.tenant_sheds.get(tenant, 0.0) + value
+                )
+        self.builds = sum(
+            v for _, v in metrics.get("logparser_tenant_builds_total", ())
+        )
+        burns = [v for _, v in metrics.get("logparser_slo_burn_rate", ())]
+        self.burn = max(burns) if burns else 0.0
+
+
+class FleetController:
+    def __init__(
+        self,
+        router,
+        *,
+        poll_s: float = 2.0,
+        burn_threshold: float = 1.0,
+        burn_polls: int = 3,
+        shed_rate: float = 1.0,
+        thrash_rebuilds: int = 3,
+        move_cooldown_s: float = 30.0,
+        probe_timeout_s: float = 2.0,
+        migrate_timeout_s: float = 120.0,
+        retry_after_s: int = 2,
+        budget: FleetBudget | None = None,
+        clock=time.monotonic,
+    ):
+        self.router = router
+        self.poll_s = float(poll_s)
+        self.burn_threshold = float(burn_threshold)
+        self.burn_polls = max(1, int(burn_polls))
+        self.shed_rate = float(shed_rate)
+        self.thrash_rebuilds = max(1, int(thrash_rebuilds))
+        self.move_cooldown_s = float(move_cooldown_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.migrate_timeout_s = float(migrate_timeout_s)
+        self.retry_after_s = int(retry_after_s)
+        self.budget = budget
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._prev: dict[str, _Snapshot] = {}
+        self._burn_streak: dict[str, int] = {}
+        self._last_move: dict[str, float] = {}  # tenant -> clock()
+        self._window: dict[str, float] = {}  # backend -> requests last poll
+        self.polls = 0
+        self.moves_failed = 0
+        self.last_errors: dict[str, str] = {}
+        self.moves_total = router.obs.registry.counter(
+            "logparser_fleet_moves_total", ("reason",)
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-placement", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self.poll_s + self.probe_timeout_s + 1)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("placement tick failed")
+
+    # --------------------------------------------------------------- poll
+
+    def _get(self, backend: str, path: str) -> tuple[int, bytes]:
+        req = urllib.request.Request(backend + path, method="GET")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.probe_timeout_s
+            ) as resp:
+                return resp.status, resp.read(4 << 20)
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read() if exc.fp else b""
+
+    def tick(self) -> list[dict]:
+        """One control round: probe, diff, act. Returns the moves
+        executed (for tests and /fleet/status)."""
+        router = self.router
+        now = self.clock()
+        window: dict[str, float] = {}
+        snaps: dict[str, _Snapshot] = {}
+        for backend in router.all_backends:
+            try:
+                status, _ = self._get(backend, "/q/health")
+                if status != 200:
+                    raise OSError(f"health answered {status}")
+                _, body = self._get(backend, "/metrics")
+            except (OSError, urllib.error.URLError) as exc:
+                router.note_backend_error(backend, str(exc))
+                self.last_errors[backend] = str(exc)[:200]
+                self._burn_streak.pop(backend, None)
+                self._prev.pop(backend, None)
+                continue
+            router.note_backend_ok(backend)
+            self.last_errors.pop(backend, None)
+            snap = _Snapshot(parse_prom(body.decode("utf-8", "replace")), now)
+            snaps[backend] = snap
+            prev = self._prev.get(backend)
+            if prev is not None:
+                window[backend] = max(
+                    0.0,
+                    sum(snap.tenant_requests.values())
+                    - sum(prev.tenant_requests.values()),
+                )
+            else:
+                window[backend] = 0.0
+
+        moves = []
+        for backend, snap in snaps.items():
+            prev = self._prev.get(backend)
+            move = self._decide(backend, snap, prev, window)
+            if move is not None:
+                moves.append(move)
+        self._prev = snaps
+        with self._lock:
+            self._window = window
+        self.polls += 1
+
+        if self.budget is not None and self.budget.enabled and window:
+            self._push_budgets(self.budget.recompute(window))
+        return moves
+
+    # ------------------------------------------------------------ signals
+
+    def _decide(self, backend: str, snap: _Snapshot,
+                prev: _Snapshot | None, window: dict) -> dict | None:
+        if prev is None:
+            self._burn_streak[backend] = 0
+            return None
+        dt = max(1e-3, snap.when - prev.when)
+
+        if snap.burn > self.burn_threshold:
+            self._burn_streak[backend] = self._burn_streak.get(backend, 0) + 1
+        else:
+            self._burn_streak[backend] = 0
+
+        # per-tenant shed rate beats the backend-wide signals: the
+        # offender is named, move exactly that tenant
+        for tenant in snap.tenant_sheds:
+            delta = snap.tenant_sheds[tenant] - prev.tenant_sheds.get(
+                tenant, 0.0
+            )
+            if delta / dt >= self.shed_rate and self._movable(tenant):
+                return self._move(backend, tenant, "quota_shed", window)
+
+        if self._burn_streak.get(backend, 0) >= self.burn_polls:
+            hot = self._hottest(backend, snap, prev)
+            if hot is not None:
+                self._burn_streak[backend] = 0
+                return self._move(backend, hot, "slo_burn", window)
+
+        if snap.builds - prev.builds >= self.thrash_rebuilds:
+            hot = self._hottest(backend, snap, prev)
+            if hot is not None:
+                return self._move(backend, hot, "residency_thrash", window)
+        return None
+
+    def _hottest(self, backend: str, snap: _Snapshot,
+                 prev: _Snapshot | None) -> str | None:
+        deltas = {
+            tenant: count
+            - (prev.tenant_requests.get(tenant, 0.0) if prev else 0.0)
+            for tenant, count in snap.tenant_requests.items()
+            if self._movable(tenant)
+        }
+        deltas = {t: d for t, d in deltas.items() if d > 0}
+        if not deltas:
+            return None
+        return max(deltas, key=deltas.get)
+
+    def _movable(self, tenant: str) -> bool:
+        if not tenant or tenant in (DEFAULT_TENANT, "invalid"):
+            return False
+        last = self._last_move.get(tenant)
+        return last is None or self.clock() - last >= self.move_cooldown_s
+
+    # -------------------------------------------------------------- moves
+
+    def _target_for(self, source: str, window: dict) -> str | None:
+        candidates = [
+            b for b in self.router.backends_up() if b != source
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda b: window.get(b, 0.0))
+
+    def _move(self, source: str, tenant: str, reason: str,
+              window: dict) -> dict | None:
+        target = self._target_for(source, window)
+        if target is None:
+            return None
+        self._last_move[tenant] = self.clock()  # cooldown even on failure
+        outcome = "ok"
+        try:
+            # chaos point: a failed move leaves the tenant owned by the
+            # source — the trigger simply fires again next window
+            faults.fire("placement_move", key=tenant)
+            body = json.dumps({
+                "tenant": tenant,
+                "target": target,
+                "retryAfterS": self.retry_after_s,
+            }).encode()
+            req = urllib.request.Request(
+                source + "/admin/migrate", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.migrate_timeout_s
+            ) as resp:
+                if resp.status != 200:
+                    raise OSError(f"migrate answered {resp.status}")
+        except Exception as exc:
+            self.moves_failed += 1
+            outcome = str(exc)[:200]
+            log.warning("move %s %s -> %s failed: %s",
+                        tenant, source, target, exc)
+            return {"tenant": tenant, "from": source, "to": target,
+                    "reason": reason, "outcome": outcome}
+        self.router.ring.set_override(tenant, target)
+        self.moves_total.inc(reason=reason)
+        log.info("moved tenant %s %s -> %s (%s)",
+                 tenant, source, target, reason)
+        return {"tenant": tenant, "from": source, "to": target,
+                "reason": reason, "outcome": outcome}
+
+    # ------------------------------------------------------------- budget
+
+    def _push_budgets(self, changed: dict[str, dict]) -> None:
+        for backend, assignment in changed.items():
+            try:
+                req = urllib.request.Request(
+                    backend + "/admin/budget",
+                    data=json.dumps(assignment).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.probe_timeout_s
+                ):
+                    pass
+            except (OSError, urllib.error.URLError) as exc:
+                log.warning("budget push to %s failed: %s", backend, exc)
+
+    # -------------------------------------------------------------- stats
+
+    def samples(self):
+        out = []
+        if self.budget is not None:
+            out.extend(self.budget.samples())
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            window = dict(self._window)
+        return {
+            "polls": self.polls,
+            "windowRequests": window,
+            "burnStreaks": dict(self._burn_streak),
+            "movesFailed": self.moves_failed,
+            "lastErrors": dict(self.last_errors),
+            "cooldowns": len(self._last_move),
+            "budget": self.budget.shares() if self.budget else {},
+        }

@@ -1,0 +1,174 @@
+"""Consistent-hash ring with virtual nodes — the router's tenant map.
+
+Each backend owns ``vnodes`` points on a 64-bit ring
+(``blake2b(backend#i)``); a tenant routes to the first point clockwise
+of ``blake2b(tenant)``. Properties the fleet depends on:
+
+- **Stability**: adding or removing one backend re-maps only the tenants
+  whose arc it owned (~1/N of the keyspace), so a rolling restart does
+  not reshuffle the whole fleet's residency.
+- **Spread**: virtual nodes smooth the arc lengths; 64 vnodes keeps the
+  per-backend share within a few percent of uniform for small N.
+- **Overrides**: live migrations (runtime/migrate.py) deliberately break
+  the hash placement — the router learns the new owner from the 307
+  ``Location`` envelope and records a per-tenant override here. The
+  override IS the steady state: the source's forward entry can be
+  dropped once the router map has converged. Overrides pointing at a
+  backend that leaves the ring die with it (the hash placement takes
+  back over), and an override that matches the hash owner is dropped as
+  redundant.
+
+Thread-safe: the router's handler threads and the placement loop share
+one ring.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+
+DEFAULT_VNODES = 64
+
+
+def _point(key: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8", "replace"), digest_size=8).digest(),
+        "big",
+    )
+
+
+class HashRing:
+    def __init__(self, backends: list[str] | None = None,
+                 vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        self._points: list[int] = []  # sorted vnode hashes
+        self._owners: list[str] = []  # parallel: backend per point
+        self._backends: list[str] = []  # membership, insertion order
+        self._overrides: dict[str, str] = {}  # tenant -> backend
+        self.remaps = 0  # membership changes (add/remove)
+        for b in backends or ():
+            self.add(b)
+
+    # -------------------------------------------------------- membership
+
+    def add(self, backend: str) -> bool:
+        with self._lock:
+            if backend in self._backends:
+                return False
+            for i in range(self.vnodes):
+                p = _point(f"{backend}#{i}")
+                at = bisect.bisect_left(self._points, p)
+                self._points.insert(at, p)
+                self._owners.insert(at, backend)
+            self._backends.append(backend)
+            self.remaps += 1
+            # an override targeting a returning backend is stale only if
+            # it now matches the hash owner — drop the redundant ones
+            for t in [t for t, b in self._overrides.items()
+                      if b == self._owner_locked(t)]:
+                del self._overrides[t]
+            return True
+
+    def remove(self, backend: str) -> bool:
+        with self._lock:
+            if backend not in self._backends:
+                return False
+            keep = [(p, o) for p, o in zip(self._points, self._owners)
+                    if o != backend]
+            self._points = [p for p, _ in keep]
+            self._owners = [o for _, o in keep]
+            self._backends.remove(backend)
+            self.remaps += 1
+            # overrides pointing at the dead backend die with it: the
+            # hash placement (minus the backend's arcs) takes back over
+            for t in [t for t, b in self._overrides.items() if b == backend]:
+                del self._overrides[t]
+            return True
+
+    def backends(self) -> list[str]:
+        with self._lock:
+            return list(self._backends)
+
+    def __contains__(self, backend: str) -> bool:
+        with self._lock:
+            return backend in self._backends
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._backends)
+
+    # ----------------------------------------------------------- routing
+
+    def _owner_locked(self, tenant_id: str) -> str | None:
+        if not self._points:
+            return None
+        at = bisect.bisect_right(self._points, _point(tenant_id))
+        return self._owners[at % len(self._points)]
+
+    def owner(self, tenant_id: str) -> str | None:
+        """The backend serving ``tenant_id``: its override when one is
+        installed, the clockwise vnode owner otherwise. None on an
+        empty ring."""
+        with self._lock:
+            override = self._overrides.get(tenant_id)
+            if override is not None:
+                return override
+            return self._owner_locked(tenant_id)
+
+    def hash_owner(self, tenant_id: str) -> str | None:
+        """The pure hash placement, ignoring overrides — what ``owner``
+        converges back to once an override is cleared."""
+        with self._lock:
+            return self._owner_locked(tenant_id)
+
+    # --------------------------------------------------------- overrides
+
+    def set_override(self, tenant_id: str, backend: str) -> bool:
+        """Record a learned placement (307 ``Location`` or a completed
+        placement move). Only ring members are accepted — a forward to
+        an address outside the fleet is the client's business, not the
+        map's. Redundant overrides (matching the hash owner) clear any
+        existing entry instead."""
+        with self._lock:
+            if backend not in self._backends:
+                return False
+            if self._owner_locked(tenant_id) == backend:
+                self._overrides.pop(tenant_id, None)
+                return True
+            self._overrides[tenant_id] = backend
+            return True
+
+    def clear_override(self, tenant_id: str) -> bool:
+        with self._lock:
+            return self._overrides.pop(tenant_id, None) is not None
+
+    def overrides(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._overrides)
+
+    # ------------------------------------------------------------- stats
+
+    def spread(self) -> dict[str, int]:
+        """Vnode-arc share per backend over a 16k-key probe — a cheap
+        uniformity diagnostic for /fleet/status, not a load measure."""
+        with self._lock:
+            if not self._points:
+                return {}
+            counts = {b: 0 for b in self._backends}
+            for i in range(16384):
+                counts[self._owner_locked(f"probe-{i}")] += 1
+            return counts
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "backends": list(self._backends),
+                "vnodes": self.vnodes,
+                "points": len(self._points),
+                "overrides": dict(self._overrides),
+                "remaps": self.remaps,
+            }

@@ -1,0 +1,868 @@
+"""Router front-door: terminate the public transports, resolve the
+tenant at the edge, proxy to the backend that owns it.
+
+``serve --role router --backends host:port,...`` boots one of these in
+front of N ordinary serving processes. The router holds NO engine — no
+patterns, no jax — just the consistent-hash ring (``fleet/ring.py``),
+a per-backend health view, and an :class:`~log_parser_tpu.obs.Obs`
+bundle of its own (``logparser_fleet_*`` families + the ``route`` span).
+
+Tenant resolution at the edge reuses ``runtime/tenancy.py`` verbatim
+(:func:`~log_parser_tpu.runtime.tenancy.edge_tenant_id` — the same
+normalization + ``_ID_RE`` validation ``TenantRegistry.resolve``
+applies), so an id the backend would 400 never costs a proxy hop.
+
+Forwarding rules (docs/OPS.md "Fleet routing & placement"):
+
+- A backend 307 (``TenantForwarded`` / standby fence) with a
+  ``Location`` inside the fleet teaches the router: the override is
+  recorded on the ring and the request retries against the new owner —
+  bounded hops, loop detection — so the client sees the post-move 200,
+  never the redirect. A ``Location`` outside the fleet passes through
+  untouched (the client's 307-follow handles it).
+- A backend connect/read failure marks it down, takes it off the ring
+  (its arc re-maps to the survivors) and retries the re-mapped owner;
+  the health loop (fleet/placement.py) probes it back in.
+- ``POST /parse/stream`` (chunked) is spliced raw — full-duplex byte
+  pumps, no 307 interception mid-stream (the open-response 307 passes
+  through to the client's follow logic).
+
+The framed shim and gRPC fronts ride the same ring: the framed front
+forwards Envelope frames to the owner's shim address; the gRPC front
+terminates gRPC generically (raw-bytes handlers) and rides the framed
+back-channel.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import socketserver
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from log_parser_tpu.fleet.ring import DEFAULT_VNODES, HashRing
+from log_parser_tpu.obs import Obs
+from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime.tenancy import (
+    DEFAULT_TENANT,
+    TenantError,
+    edge_tenant_id,
+)
+
+log = logging.getLogger(__name__)
+
+# the fleet chaos vocabulary (tools/chaos_sweep.py --group fleet);
+# tools/hygiene.py check 20 pins every key to a docs/OPS.md row AND to a
+# live faults.fire site. placement_move fires in fleet/placement.py.
+FAULT_SITES = {
+    "route": "edge tenant resolution + ring lookup (fleet/router.py)",
+    "route_backend": "one proxied backend attempt (fleet/router.py)",
+    "placement_move": "placer-initiated live migration (fleet/placement.py)",
+}
+
+# request/response bodies the buffering proxy will carry — the same cap
+# the backend's migration routes accept (serve/http.py _MIGRATE_MAX_BODY)
+_PROXY_MAX_BODY = 64 << 20
+# end-to-end hop budget for learned-forward retries: a migration chain
+# is 1 hop; 4 absorbs a concurrent re-move without letting a forward
+# cycle spin the router
+_MAX_HOPS = 4
+# hop-by-hop headers never forwarded in either direction (RFC 9110 §7.6.1)
+_HOP_HEADERS = frozenset({
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade",
+})
+
+
+def parse_backends(spec: str) -> list[str]:
+    """``host:port,host:port`` (or full ``http://`` bases) -> normalized
+    base URLs. Raises ValueError on an empty or malformed list."""
+    backends: list[str] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "://" not in part:
+            part = f"http://{part}"
+        parsed = urllib.parse.urlparse(part)
+        if parsed.scheme != "http" or not parsed.hostname or not parsed.port:
+            raise ValueError(f"bad backend {part!r}: need host:port")
+        backends.append(f"http://{parsed.hostname}:{parsed.port}")
+    if not backends:
+        raise ValueError("--backends needs at least one host:port")
+    if len(set(backends)) != len(backends):
+        raise ValueError("duplicate backend in --backends")
+    return backends
+
+
+def _hostport(base_url: str) -> tuple[str, int]:
+    parsed = urllib.parse.urlparse(base_url)
+    return parsed.hostname or "127.0.0.1", int(parsed.port or 80)
+
+
+def base_of(location: str) -> str | None:
+    """Normalize a 307 ``Location`` to a ring-comparable base URL."""
+    try:
+        parsed = urllib.parse.urlparse(location)
+    except ValueError:
+        return None
+    if parsed.scheme != "http" or not parsed.hostname or not parsed.port:
+        return None
+    return f"http://{parsed.hostname}:{parsed.port}"
+
+
+class _BackendState:
+    """Router-side health view of one backend. ``fails`` counts
+    consecutive transport failures; ``down_after`` of them take the
+    backend off the ring until a health probe brings it back."""
+
+    __slots__ = ("up", "fails", "last_error", "since")
+
+    def __init__(self) -> None:
+        self.up = True
+        self.fails = 0
+        self.last_error = ""
+        self.since = time.monotonic()
+
+
+class RouterServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 128
+
+    def handle_error(self, request, client_address) -> None:
+        # a front-door eats connection aborts quietly: clients hanging
+        # up mid-request (or port scanners) are routine, not tracebacks
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            TimeoutError)):
+            log.debug("router connection aborted from %s: %s",
+                      client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
+    def __init__(
+        self,
+        address: tuple[str, int],
+        backends: list[str],
+        *,
+        vnodes: int = DEFAULT_VNODES,
+        proxy_timeout_s: float = 60.0,
+        down_after: int = 2,
+        obs: Obs | None = None,
+    ):
+        super().__init__(address, _RouterHandler)
+        self.ring = HashRing(backends, vnodes=vnodes)
+        self.all_backends = list(backends)  # membership superset, fixed
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.down_after = max(1, int(down_after))
+        self.obs = obs if obs is not None else Obs()
+        self._lock = threading.Lock()
+        self.health: dict[str, _BackendState] = {
+            b: _BackendState() for b in backends
+        }
+        self.routed_total = self.obs.registry.counter(
+            "logparser_fleet_routed_total", ("backend", "outcome"),
+            max_series=256,
+        )
+        self.reroutes_total = self.obs.registry.counter(
+            "logparser_fleet_reroutes_total", ("reason",)
+        )
+        self.obs.registry.register_collector("fleet", self._fleet_samples)
+        # wired by serve/__main__.py --role router: the control loop and
+        # the framed front; stats-only here
+        self.controller = None
+        self.framed_front = None
+        self.grpc_front = None
+        self.started_monotonic = time.monotonic()
+
+    # -------------------------------------------------------- health map
+
+    def note_backend_error(self, backend: str, error: str) -> bool:
+        """One failed transport attempt. Returns True when this crossed
+        the threshold and the backend just left the ring."""
+        with self._lock:
+            st = self.health.get(backend)
+            if st is None:
+                return False
+            st.fails += 1
+            st.last_error = error[:200]
+            if st.up and st.fails >= self.down_after:
+                st.up = False
+                st.since = time.monotonic()
+                removed = True
+            else:
+                removed = False
+        if removed:
+            self.ring.remove(backend)
+            self.reroutes_total.inc(reason="backend_down")
+            log.warning("backend %s marked DOWN (%s)", backend, error)
+        return removed
+
+    def note_backend_ok(self, backend: str) -> None:
+        with self._lock:
+            st = self.health.get(backend)
+            if st is None:
+                return
+            st.fails = 0
+            if not st.up:
+                st.up = True
+                st.since = time.monotonic()
+                readmitted = True
+            else:
+                readmitted = False
+        if readmitted:
+            self.ring.add(backend)
+            log.info("backend %s back UP", backend)
+
+    def backends_up(self) -> list[str]:
+        with self._lock:
+            return [b for b, st in self.health.items() if st.up]
+
+    # ------------------------------------------------------------- stats
+
+    def _fleet_samples(self):
+        with self._lock:
+            up = sum(1 for st in self.health.values() if st.up)
+        ring = self.ring.stats()
+        samples = [
+            ("logparser_fleet_backends_up", {}, up),
+            ("logparser_fleet_overrides", {}, len(ring["overrides"])),
+        ]
+        ctl = self.controller
+        if ctl is not None:
+            samples.extend(ctl.samples())
+        return samples
+
+    def fleet_status(self) -> dict:
+        with self._lock:
+            health = {
+                b: {
+                    "up": st.up,
+                    "fails": st.fails,
+                    "lastError": st.last_error,
+                    "sinceS": round(time.monotonic() - st.since, 1),
+                }
+                for b, st in self.health.items()
+            }
+        status = {
+            "ring": self.ring.stats(),
+            "spread": self.ring.spread(),
+            "backends": health,
+            "uptimeS": round(time.monotonic() - self.started_monotonic, 1),
+        }
+        ctl = self.controller
+        if ctl is not None:
+            status["placement"] = ctl.stats()
+        front = self.framed_front
+        if front is not None:
+            status["framed"] = front.stats()
+        return status
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server: RouterServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    # ------------------------------------------------------------ helpers
+
+    def _send_json(self, status: int, payload: bytes,
+                   headers: dict[str, str] | None = None) -> None:
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            self.server.obs.note_dropped("http")
+            self.close_connection = True
+
+    # ------------------------------------------------------------- routes
+
+    def do_GET(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path in ("/health", "/health/live", "/health/ready", "/q/health"):
+            return self._health()
+        if path == "/metrics":
+            try:
+                self.send_response(200)
+                body = self.server.obs.registry.render().encode()
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                self.server.obs.note_dropped("http")
+                self.close_connection = True
+            return
+        if path == "/fleet/status":
+            return self._send_json(
+                200, json.dumps(self.server.fleet_status()).encode()
+            )
+        return self._proxy()
+
+    def do_POST(self) -> None:
+        if self.path == "/fleet/override":
+            return self._fleet_override()
+        return self._proxy()
+
+    def _health(self) -> None:
+        """Aggregate fleet health: UP while at least one backend serves.
+        Per-backend checks mirror the single-process /q/health shape so
+        the same probes work against router and backend alike."""
+        up = self.server.backends_up()
+        checks = []
+        with self.server._lock:
+            for b, st in self.server.health.items():
+                checks.append({
+                    "name": f"backend:{b}",
+                    "status": "UP" if st.up else "DOWN",
+                })
+        status = "UP" if up else "DOWN"
+        return self._send_json(
+            200 if up else 503,
+            json.dumps({"status": status, "role": "router",
+                        "checks": checks}).encode(),
+        )
+
+    def _fleet_override(self) -> None:
+        """``POST /fleet/override`` ``{"tenant": id, "backend": url|null}``:
+        operator override surface — the manual twin of the 307-learned
+        entries (runbooks: pre-warming a move, pinning a debug tenant)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > 1 << 20:
+                return self._send_json(413, b'{"error":"payload too large"}')
+            body = json.loads(self.rfile.read(length) if length else b"{}")
+        except ValueError:
+            return self._send_json(400, b'{"error":"bad request body"}')
+        tenant = body.get("tenant") if isinstance(body, dict) else None
+        backend = body.get("backend") if isinstance(body, dict) else None
+        if not isinstance(tenant, str) or not tenant:
+            return self._send_json(400, b'{"error":"expected {tenant}"}')
+        # same edge validation the proxy applies: an id the backends
+        # would refuse can never be a routable override key
+        try:
+            if edge_tenant_id(tenant) is None:
+                return self._send_json(
+                    400, b'{"error":"cannot override the default tenant"}'
+                )
+        except TenantError as exc:
+            return self._send_json(
+                400, json.dumps({"error": str(exc)}).encode()
+            )
+        if backend is None:
+            cleared = self.server.ring.clear_override(tenant)
+            return self._send_json(
+                200, json.dumps({"cleared": cleared}).encode()
+            )
+        if not isinstance(backend, str) or not self.server.ring.set_override(
+            tenant, backend
+        ):
+            return self._send_json(
+                400, b'{"error":"backend is not a ring member"}'
+            )
+        return self._send_json(
+            200,
+            json.dumps({"tenant": tenant,
+                        "owner": self.server.ring.owner(tenant)}).encode(),
+        )
+
+    # -------------------------------------------------------------- proxy
+
+    def _proxy(self) -> None:
+        server = self.server
+        obs = server.obs
+        rid = obs.clean_request_id(
+            self.headers.get("X-Request-Id")
+        ) or obs.new_request_id()
+        started = obs.clock()
+        raw_tenant = self.headers.get("X-Tenant")
+        outcome = "ok"
+        status = 200
+        backend = ""
+        hops = 0
+        try:
+            # chaos point: an injected route fault answers a structured
+            # 500 below, the same containment the backend's sites have
+            faults.fire("route", key=raw_tenant or DEFAULT_TENANT)
+            # EDGE tenant resolution: the exact runtime/tenancy.py
+            # validation, so malformed ids are refused without a hop
+            tenant = edge_tenant_id(raw_tenant)
+        except TenantError as exc:
+            outcome, status = "invalid_tenant", exc.status
+            self._send_json(
+                status, json.dumps({"error": exc.reason}).encode()
+            )
+            self._route_done(rid, started, raw_tenant, outcome, backend,
+                             hops, status)
+            return
+        except Exception as exc:
+            outcome, status = "route_fault", 500
+            self._send_json(status, json.dumps({"error": str(exc)}).encode())
+            self._route_done(rid, started, raw_tenant, outcome, backend,
+                             hops, status)
+            return
+        route_key = tenant or DEFAULT_TENANT
+
+        chunked = "chunked" in (
+            self.headers.get("Transfer-Encoding") or ""
+        ).lower()
+        if chunked:
+            backend = server.ring.owner(route_key) or ""
+            if not backend:
+                outcome, status = "no_backend", 503
+                self._send_json(status, b'{"error":"no backend available"}')
+            else:
+                outcome = self._splice(backend)
+                status = {"ok": 200, "backend_error": 502}.get(outcome, 500)
+            self._route_done(rid, started, raw_tenant, outcome, backend,
+                             hops, status)
+            return
+
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self._send_json(400, b'{"error":"bad Content-Length"}')
+            self._route_done(rid, started, raw_tenant, "bad_request",
+                             "", 0, 400)
+            return
+        if length > _PROXY_MAX_BODY:
+            self._send_json(413, b'{"error":"payload too large"}')
+            self._route_done(rid, started, raw_tenant, "too_large",
+                             "", 0, 413)
+            return
+        body = self.rfile.read(length) if length else b""
+
+        seen: set[str] = set()
+        while True:
+            backend = server.ring.owner(route_key) or ""
+            if not backend or backend in seen and hops >= _MAX_HOPS:
+                outcome, status = "no_backend", 503
+                self._send_json(status, b'{"error":"no backend available"}')
+                break
+            try:
+                # chaos point: contained as one failed attempt — the
+                # backend is marked down and the ring re-maps
+                faults.fire("route_backend", key=backend)
+                status, headers, payload = self._attempt(
+                    backend, body, rid, tenant
+                )
+            except (OSError, http.client.HTTPException) as exc:
+                server.note_backend_error(backend, str(exc))
+                seen.add(backend)
+                hops += 1
+                if hops > _MAX_HOPS or not server.ring.backends():
+                    outcome, status = "backend_error", 502
+                    self._send_json(
+                        status,
+                        json.dumps(
+                            {"error": f"backend {backend} unreachable"}
+                        ).encode(),
+                    )
+                    break
+                continue
+            server.note_backend_ok(backend)
+            if status == 307 and tenant is not None:
+                new_base = base_of(headers.get("Location", ""))
+                learned = (
+                    new_base is not None
+                    and new_base != backend
+                    and server.ring.set_override(tenant, new_base)
+                )
+                if learned:
+                    server.reroutes_total.inc(reason="forward")
+                    seen.add(backend)
+                    hops += 1
+                    if new_base not in seen and hops <= _MAX_HOPS:
+                        continue
+                # hop budget spent, a forward loop, or a Location outside
+                # the fleet: hand the 307 to the client's follow logic
+                outcome = "forwarded"
+                self._relay(status, headers, payload)
+                break
+            outcome = "ok" if status < 500 else "backend_5xx"
+            self._relay(status, headers, payload)
+            break
+        self._route_done(rid, started, raw_tenant, outcome, backend,
+                         hops, status)
+
+    def _attempt(
+        self, backend: str, body: bytes, rid: str, tenant: str | None
+    ) -> tuple[int, dict, bytes]:
+        """One buffered proxy attempt against ``backend``. Raises OSError
+        / HTTPException on transport failure; HTTP statuses (307
+        included) return normally."""
+        host, port = _hostport(backend)
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.server.proxy_timeout_s
+        )
+        try:
+            headers = {
+                k: v
+                for k, v in self.headers.items()
+                if k.lower() not in _HOP_HEADERS
+                and k.lower() not in ("host", "content-length")
+            }
+            headers["Host"] = f"{host}:{port}"
+            headers["X-Request-Id"] = rid
+            headers["Connection"] = "close"
+            client = self.client_address[0] if self.client_address else ""
+            prior = self.headers.get("X-Forwarded-For")
+            headers["X-Forwarded-For"] = (
+                f"{prior}, {client}" if prior else client
+            )
+            conn.request(self.command, self.path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read(_PROXY_MAX_BODY + 1)
+            if len(payload) > _PROXY_MAX_BODY:
+                raise http.client.HTTPException(
+                    f"backend response over {_PROXY_MAX_BODY} bytes"
+                )
+            return resp.status, dict(resp.getheaders()), payload
+        finally:
+            conn.close()
+
+    def _relay(self, status: int, headers: dict, payload: bytes) -> None:
+        try:
+            self.send_response(status)
+            for key, value in headers.items():
+                if key.lower() in _HOP_HEADERS or key.lower() in (
+                    "content-length", "date", "server",
+                ):
+                    continue
+                self.send_header(key, value)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            self.server.obs.note_dropped("http")
+            self.close_connection = True
+
+    # ----------------------------------------------------------- splice
+
+    def _splice(self, backend: str) -> str:
+        """Raw full-duplex byte splice for chunked requests
+        (``POST /parse/stream``): replay the request head, then pump
+        client→backend and backend→client until the backend closes.
+        Returns the route outcome label."""
+        host, port = _hostport(backend)
+        try:
+            upstream = socket.create_connection(
+                (host, port), timeout=self.server.proxy_timeout_s
+            )
+        except OSError as exc:
+            self.server.note_backend_error(backend, str(exc))
+            self._send_json(502, b'{"error":"backend unreachable"}')
+            return "backend_error"
+        self.server.note_backend_ok(backend)
+        try:
+            head = [f"{self.command} {self.path} HTTP/1.1"]
+            for key, value in self.headers.items():
+                lk = key.lower()
+                if lk in ("host", "connection"):
+                    continue
+                head.append(f"{key}: {value}")
+            head.append(f"Host: {host}:{port}")
+            head.append("Connection: close")
+            upstream.sendall(("\r\n".join(head) + "\r\n\r\n").encode())
+
+            def pump_up() -> None:
+                try:
+                    while True:
+                        chunk = self.rfile.read1(1 << 16)
+                        if not chunk:
+                            break
+                        upstream.sendall(chunk)
+                    upstream.shutdown(socket.SHUT_WR)
+                except (OSError, ValueError):
+                    pass  # either side gone: the down pump notices
+
+            feeder = threading.Thread(target=pump_up, daemon=True)
+            feeder.start()
+            while True:
+                chunk = upstream.recv(1 << 16)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
+            self.close_connection = True
+            return "ok"
+        except (OSError, ValueError) as exc:
+            log.debug("stream splice to %s ended: %s", backend, exc)
+            self.close_connection = True
+            return "stream_error"
+        finally:
+            try:
+                upstream.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ account
+
+    def _route_done(self, rid: str, started: float, raw_tenant: str | None,
+                    outcome: str, backend: str, hops: int,
+                    status: int) -> None:
+        obs = self.server.obs
+        # an id that failed edge validation is unbounded attacker input —
+        # never a label value
+        tenant = ("invalid" if outcome == "invalid_tenant"
+                  else raw_tenant or DEFAULT_TENANT)
+        duration = obs.clock() - started
+        self.server.routed_total.inc(
+            backend=backend or "none", outcome=outcome
+        )
+        # note_request ends the trace itself for non-200s; the `route`
+        # span (backend + hop count) covers the successful path only
+        obs.note_request("http", "route", status, tenant, duration,
+                         request_id=rid, detail=outcome)
+        if status == 200:
+            obs.spans.end_trace(
+                rid, duration, tenant=tenant, name="route",
+                attrs={"backend": backend or "none", "outcome": outcome,
+                       "hops": hops},
+            )
+
+
+# ----------------------------------------------------------- framed front
+
+
+class FramedRouterFront(socketserver.ThreadingTCPServer):
+    """Framed-shim front-door: Envelope frames in, Envelope frames out,
+    each forwarded whole to the OWNER backend's shim address. The
+    tenant rides the ``method@tenant`` envelope suffix exactly as on a
+    direct shim connection; a backend refusal whose error text carries
+    ``migrated to <url>`` (the framed rendering of ``TenantForwarded``)
+    teaches the ring the same override the HTTP 307 does."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: tuple[str, int], router: RouterServer,
+                 shim_addrs: dict[str, tuple[str, int]]):
+        super().__init__(address, _FramedFrontHandler)
+        self.router = router
+        # http base url -> (host, port) of that backend's framed shim
+        self.shim_addrs = dict(shim_addrs)
+        self.frames = 0
+        self.forward_follows = 0
+        self._lock = threading.Lock()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "frames": self.frames,
+                "forwardFollows": self.forward_follows,
+                "backends": {b: f"{h}:{p}"
+                             for b, (h, p) in self.shim_addrs.items()},
+            }
+
+
+class _FramedFrontHandler(socketserver.BaseRequestHandler):
+    server: FramedRouterFront
+
+    def handle(self) -> None:
+        from log_parser_tpu.shim import logparser_pb2 as pb
+        from log_parser_tpu.shim.framing import (
+            FramingError,
+            read_frame,
+            write_frame,
+        )
+
+        sock = self.request
+        router = self.server.router
+        while True:
+            try:
+                frame = read_frame(sock)
+            except FramingError as exc:
+                log.warning("framed front connection dropped: %s", exc)
+                return
+            if frame is None:
+                return
+            envelope = pb.Envelope()
+            response: bytes
+            try:
+                envelope.ParseFromString(frame)
+                _method, _, raw_tenant = envelope.method.partition("@")
+                faults.fire("route", key=raw_tenant or DEFAULT_TENANT)
+                tenant = edge_tenant_id(raw_tenant or None)
+                response = self._forward(frame, envelope.method, tenant)
+            except TenantError as exc:
+                response = pb.Envelope(
+                    method=envelope.method, error=str(exc)
+                ).SerializeToString()
+            except Exception as exc:  # contained per frame
+                log.debug("framed front call failed: %s", exc)
+                response = pb.Envelope(
+                    method=envelope.method, error=f"router: {exc}"
+                ).SerializeToString()
+            with self.server._lock:
+                self.server.frames += 1
+            try:
+                write_frame(sock, response)
+            except OSError:
+                router.obs.note_dropped("shim")
+                return
+
+    def _forward(self, frame: bytes, method: str,
+                 tenant: str | None) -> bytes:
+        """Proxy one frame to the owner's shim, following a bounded
+        number of framed ``migrated to`` refusals the way the HTTP
+        proxy follows 307s."""
+        import re as _re
+
+        from log_parser_tpu.shim import logparser_pb2 as pb
+        from log_parser_tpu.shim.framing import read_frame, write_frame
+
+        router = self.server.router
+        route_key = tenant or DEFAULT_TENANT
+        seen: set[str] = set()
+        hops = 0
+        while True:
+            backend = router.ring.owner(route_key)
+            addr = self.server.shim_addrs.get(backend or "")
+            if backend is None or addr is None:
+                return pb.Envelope(
+                    method=method, error="router: no backend available"
+                ).SerializeToString()
+            try:
+                faults.fire("route_backend", key=backend)
+                with socket.create_connection(
+                    addr, timeout=router.proxy_timeout_s
+                ) as up:
+                    up.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    write_frame(up, frame)
+                    reply = read_frame(up)
+            except (OSError, ConnectionError) as exc:
+                router.note_backend_error(backend, str(exc))
+                seen.add(backend)
+                hops += 1
+                if hops > _MAX_HOPS or not router.ring.backends():
+                    return pb.Envelope(
+                        method=method,
+                        error=f"router: backend {backend} unreachable",
+                    ).SerializeToString()
+                continue
+            router.note_backend_ok(backend)
+            if reply is None:
+                return pb.Envelope(
+                    method=method,
+                    error=f"router: backend {backend} closed mid-call",
+                ).SerializeToString()
+            env = pb.Envelope()
+            env.ParseFromString(reply)
+            moved = _re.search(r"migrated to (\S+)", env.error or "")
+            if moved and tenant is not None:
+                new_base = base_of(moved.group(1).rstrip(";,"))
+                if (
+                    new_base is not None
+                    and new_base != backend
+                    and router.ring.set_override(tenant, new_base)
+                    and new_base not in seen
+                    and hops < _MAX_HOPS
+                ):
+                    router.reroutes_total.inc(reason="forward")
+                    with self.server._lock:
+                        self.server.forward_follows += 1
+                    seen.add(backend)
+                    hops += 1
+                    continue
+            return reply
+
+
+# ------------------------------------------------------------- gRPC front
+
+
+def make_grpc_front(router: RouterServer, framed_front: FramedRouterFront,
+                    host: str, port: int, max_workers: int = 8):
+    """Generic gRPC front: terminate ``/logparser.LogParser/<Method>``
+    with raw-bytes handlers (no per-message schema — the router never
+    parses payloads) and ride the framed back-channel to the owner's
+    shim. Returns the started server, or None when grpcio is absent."""
+    try:
+        import grpc
+    except ImportError:
+        log.warning("grpc front disabled: grpcio is not installed")
+        return None
+    from concurrent import futures
+
+    from log_parser_tpu.shim import logparser_pb2 as pb
+
+    def unary(method_name: str):
+        def call(request: bytes, context) -> bytes:
+            tenant = None
+            for key, value in context.invocation_metadata() or ():
+                if key == "x-tenant":
+                    tenant = value or None
+            try:
+                tenant = edge_tenant_id(tenant)
+            except TenantError as exc:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, exc.reason
+                )
+            wire_method = (
+                f"{method_name}@{tenant}" if tenant else method_name
+            )
+            envelope = pb.Envelope(method=wire_method, payload=request)
+            handler = _FramedFrontHandler.__new__(_FramedFrontHandler)
+            handler.server = framed_front
+            reply = pb.Envelope()
+            reply.ParseFromString(
+                handler._forward(
+                    envelope.SerializeToString(), wire_method, tenant
+                )
+            )
+            if reply.error:
+                context.abort(grpc.StatusCode.UNAVAILABLE, reply.error)
+            return reply.payload
+
+        return grpc.unary_unary_rpc_method_handler(
+            call,
+            request_deserializer=None,  # raw bytes through
+            response_serializer=None,
+        )
+
+    class _Generic(grpc.GenericRpcHandler):
+        def service(self, handler_call_details):
+            path = handler_call_details.method or ""
+            prefix = "/logparser.LogParser/"
+            if not path.startswith(prefix):
+                return None
+            return unary(path[len(prefix):])
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_Generic(),))
+    server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server
+
+
+def make_router(
+    host: str,
+    port: int,
+    backends: list[str],
+    *,
+    vnodes: int = DEFAULT_VNODES,
+    proxy_timeout_s: float = 60.0,
+    down_after: int = 2,
+) -> RouterServer:
+    return RouterServer(
+        (host, port),
+        backends,
+        vnodes=vnodes,
+        proxy_timeout_s=proxy_timeout_s,
+        down_after=down_after,
+    )

@@ -185,6 +185,21 @@ METRICS = {
     "logparser_replication_promotions_total": (
         "counter", "Fenced ownership transitions journaled by this "
         "process (kind=promote/demote)."),
+    # ------------------------------------------------------- fleet
+    "logparser_fleet_routed_total": (
+        "counter", "Router-proxied requests by backend and outcome."),
+    "logparser_fleet_reroutes_total": (
+        "counter", "Ring re-routes by reason (forward/backend_down)."),
+    "logparser_fleet_backends_up": (
+        "gauge", "Backends currently on the router's ring."),
+    "logparser_fleet_overrides": (
+        "gauge", "Per-tenant ring overrides installed on the router."),
+    "logparser_fleet_moves_total": (
+        "counter", "Placer-initiated live tenant moves by trigger "
+        "(quota_shed/slo_burn/residency_thrash)."),
+    "logparser_fleet_budget_mb": (
+        "gauge", "Fleet-arbitrated budget share by backend and kind "
+        "(line_cache/tenant)."),
 }
 
 # /trace/last payload block -> covering /metrics families. Hygiene

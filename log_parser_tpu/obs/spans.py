@@ -76,6 +76,7 @@ SPANS = {
     "replicate": "one replication batch applied on the standby (warm bank)",
     "promote": "fenced failover: PROMOTE journaled, tenants activated",
     "demote": "stale-epoch step-down: DEMOTE journaled, registry fenced",
+    "route": "router edge: tenant resolve, ring lookup, backend proxy",
 }
 
 

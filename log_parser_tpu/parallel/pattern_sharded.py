@@ -285,21 +285,38 @@ class TenantPlacement:
     drives this mode end-to-end on a virtual or real mesh.
     """
 
-    def __init__(self, devices: list | None = None):
+    def __init__(self, devices: list | None = None, load=None):
         self.devices = list(devices) if devices is not None else jax.devices()
         if not self.devices:
             raise ValueError("TenantPlacement needs at least one device")
         self.assignments: dict[str, object] = {}
         self._next = 0
+        # optional ``load(device) -> float``: when given, NEW tenants
+        # prefer the least-loaded device — the single-process analogue
+        # of the fleet placer (fleet/placement.py). No callback keeps
+        # blind round-robin, which is also the fallback when the
+        # callback itself fails (a broken load signal must not stop
+        # placement).
+        self.load = load
+
+    def _pick(self):
+        if self.load is not None:
+            try:
+                return min(self.devices, key=self.load)
+            except Exception:
+                pass
+        device = self.devices[self._next % len(self.devices)]
+        self._next += 1
+        return device
 
     def assign(self, engine: AnalysisEngine, tenant_id: str) -> AnalysisEngine:
-        """Place ``engine`` on the next device (round-robin). A tenant
-        re-assigned after eviction+rebuild lands back on ITS device, not
-        the rotation's next one — placement stays stable under churn."""
+        """Place ``engine`` on the least-loaded device (with a load
+        callback) or the next in rotation. A tenant re-assigned after
+        eviction+rebuild lands back on ITS device, not the rotation's
+        next one — placement stays stable under churn."""
         device = self.assignments.get(str(tenant_id))
         if device is None:
-            device = self.devices[self._next % len(self.devices)]
-            self._next += 1
+            device = self._pick()
             self.assignments[str(tenant_id)] = device
         return pin_engine(engine, device)
 
@@ -313,8 +330,7 @@ class TenantPlacement:
         the rebuilt engine must land."""
         tid = str(tenant_id)
         if device is None:
-            device = self.devices[self._next % len(self.devices)]
-            self._next += 1
+            device = self._pick()
         self.assignments[tid] = device
         return device
 

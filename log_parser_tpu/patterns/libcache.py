@@ -39,6 +39,18 @@ one rebuild, not wrong scores. The ``cache`` fault site
 (``LOG_PARSER_TPU_FAULTS=cache_raise``) injects read failures here —
 contained as a miss, never a quarantine of a healthy entry.
 
+Compiled-group substructure sharing: the content key already proves two
+banks identical, so within one process every bank built from the same
+key SHARES one snapshot object — 1,000 tenants on the same infra
+patterns hold one DFA pack, not 1,000 pickle-copies of it.
+``PatternBank``'s warm path assigns ``snap["columns"]`` by reference and
+``MatcherColumn`` is immutable (lazy ``host`` compile is idempotent), so
+aliasing the pack across engines is safe by construction. The memo is
+keyed by (cache dir, content key), LRU-bounded
+(``LOG_PARSER_TPU_PACK_CACHE`` entries, default 64) so tenant eviction
+still frees memory for fleets of *distinct* banks, and disabled together
+with the layer (or alone via ``LOG_PARSER_TPU_PACK_SHARE=0``).
+
 Disable with ``LOG_PARSER_TPU_CACHE=0`` (shared switch with the DFA
 cache); ``LOG_PARSER_TPU_LIBCACHE=0`` disables just this layer.
 """
@@ -52,6 +64,8 @@ import os
 import pathlib
 import pickle
 import sys
+import threading
+from collections import OrderedDict
 from typing import Any
 
 from log_parser_tpu.patterns.regex.cache import (
@@ -76,6 +90,104 @@ def _dir() -> pathlib.Path | None:
     if os.environ.get("LOG_PARSER_TPU_LIBCACHE") == "0":
         return None
     return cache_subdir("bank")
+
+
+# ------------------------------------------------- shared compiled packs
+
+_DEFAULT_PACK_ENTRIES = 64
+
+_pack_lock = threading.Lock()
+# (cache dir, content key) -> snapshot dict, LRU order. Keyed by dir so
+# tests pointing LOG_PARSER_TPU_CACHE at a tmpdir never see another
+# run's packs.
+_packs: OrderedDict[tuple[str, str], dict[str, Any]] = OrderedDict()
+_pack_stats = {"built": 0, "shared": 0}
+
+
+def _pack_limit() -> int:
+    try:
+        return max(0, int(os.environ.get("LOG_PARSER_TPU_PACK_CACHE",
+                                         _DEFAULT_PACK_ENTRIES)))
+    except ValueError:
+        return _DEFAULT_PACK_ENTRIES
+
+
+def _share_enabled() -> bool:
+    return (os.environ.get("LOG_PARSER_TPU_PACK_SHARE") != "0"
+            and _pack_limit() > 0)
+
+
+def _attr_values(obj: Any):
+    if hasattr(obj, "__dict__"):
+        return list(vars(obj).values())
+    return [getattr(obj, s, None) for s in getattr(obj, "__slots__", ())]
+
+
+def _pack_bytes(snap: dict[str, Any]) -> int:
+    """Approximate resident bytes of one pack: the numpy DFA planes are
+    the dominant term; everything else is noise. The planes live one
+    level down (MatcherColumn.dfa is a CompiledDfa holding the
+    ndarrays), so descend one attribute level."""
+    total = 0
+    for column in snap.get("columns", ()) or ():
+        for value in _attr_values(column):
+            nbytes = getattr(value, "nbytes", None)
+            if isinstance(nbytes, int):
+                total += nbytes
+            elif value is not None and not isinstance(
+                value, (str, bytes, int, float, bool, frozenset, tuple)
+            ):
+                for inner in _attr_values(value):
+                    nbytes = getattr(inner, "nbytes", None)
+                    if isinstance(nbytes, int):
+                        total += nbytes
+    return total
+
+
+def _pack_get(dir_key: str, key: str) -> dict[str, Any] | None:
+    with _pack_lock:
+        snap = _packs.get((dir_key, key))
+        if snap is not None:
+            _packs.move_to_end((dir_key, key))
+            _pack_stats["shared"] += 1
+        return snap
+
+
+def _pack_put(dir_key: str, key: str, snap: dict[str, Any]) -> None:
+    limit = _pack_limit()
+    with _pack_lock:
+        if (dir_key, key) not in _packs:
+            _pack_stats["built"] += 1
+        _packs[(dir_key, key)] = snap
+        _packs.move_to_end((dir_key, key))
+        while len(_packs) > limit:
+            _packs.popitem(last=False)
+
+
+def pack_stats() -> dict[str, Any]:
+    """Sharing counters for tests and bench artifacts: ``built`` packs
+    entered the memo, ``shared`` warm loads were answered from it (no
+    disk read, no pickle copy), ``sharedBytes`` estimates what one
+    resident pack weighs times its extra users."""
+    with _pack_lock:
+        resident = len(_packs)
+        shared = _pack_stats["shared"]
+        built = _pack_stats["built"]
+        shared_bytes = sum(_pack_bytes(s) for s in _packs.values())
+    return {
+        "built": built,
+        "shared": shared,
+        "resident": resident,
+        "residentBytes": shared_bytes,
+    }
+
+
+def reset_packs() -> None:
+    """Drop the memo and zero the counters (test isolation)."""
+    with _pack_lock:
+        _packs.clear()
+        _pack_stats["built"] = 0
+        _pack_stats["shared"] = 0
 
 
 def library_key(pattern_sets, context_regexes) -> str | None:
@@ -128,6 +240,12 @@ def load(key: str | None) -> dict[str, Any] | None:
     d = _dir()
     if d is None or key is None:
         return None
+    if _share_enabled():
+        # same content key ⇒ identical bank: alias the resident pack
+        # instead of re-reading and re-materializing the pickle
+        snap = _pack_get(str(d), key)
+        if snap is not None:
+            return snap
     path = d / f"{key}.pkl"
     if not path.exists():
         return None
@@ -151,6 +269,8 @@ def load(key: str | None) -> dict[str, Any] | None:
         snap = pickle.loads(blob)
         if snap.get("version") != SNAPSHOT_VERSION:
             return None
+        if _share_enabled():
+            _pack_put(str(d), key, snap)
         return snap
     except Exception as exc:
         # checksum passed (or legacy) yet unpicklable: torn/truncated
@@ -172,6 +292,10 @@ def save(key: str | None, snap: dict[str, Any]) -> None:
         return
     blob = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
     digest = hashlib.sha256(blob).hexdigest()
+    if _share_enabled():
+        # the builder's own snapshot seeds the memo: tenant #2 with the
+        # same key shares tenant #1's pack without touching disk
+        _pack_put(str(d), key, snap)
     atomic_publish(d, f"{key}.pkl", lambda f: f.write(blob))
     # sidecar second: a crash between the two leaves a good snapshot with
     # a stale/missing sidecar — worst case one spurious rebuild

@@ -508,6 +508,17 @@ class LineCache:
                 [k for k, _ in items], np.stack([r for _, r in items])
             )
 
+    def set_budget(self, budget_bytes: int) -> None:
+        """Re-arbitrate the byte budget live (fleet/budget.py pushes
+        shares through ``POST /admin/budget``): shrink evicts LRU
+        entries down to the new budget immediately."""
+        with self.lock:
+            self.budget_bytes = max(0, int(budget_bytes))
+            while self.resident_bytes > self.budget_bytes and self._entries:
+                self._entries.popitem(last=False)
+                self.resident_bytes -= self._entry_cost
+                self.evictions += 1
+
     def _insert(self, ready: list[tuple[bytes, bytes]]) -> None:
         with self.lock:
             for k, p in ready:

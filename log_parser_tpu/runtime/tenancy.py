@@ -80,6 +80,20 @@ class TenantError(Exception):
         self.status = status
 
 
+def edge_tenant_id(tenant_id: str | None) -> str | None:
+    """The wire-id normalization + validation :meth:`TenantRegistry
+    .resolve` applies, as a standalone function so the fleet router can
+    refuse a malformed id AT THE EDGE (fleet/router.py) with the exact
+    semantics the backend would: None/empty/``default`` → None (the
+    default tenant), a well-formed id is returned unchanged, anything
+    else raises the same 400 :class:`TenantError`."""
+    if not tenant_id or tenant_id == DEFAULT_TENANT:
+        return None
+    if not _ID_RE.match(tenant_id):
+        raise TenantError(f"invalid tenant id {tenant_id!r}", status=400)
+    return tenant_id
+
+
 class TenantForwarded(TenantError):
     """The tenant has been migrated away (runtime/migrate.py): a durable
     CUTOVER record made another process the owner. Transports render
@@ -438,14 +452,19 @@ class TenantRegistry:
                     raise TenantForwarded(
                         tenant_id or DEFAULT_TENANT, fence[0], fence[1]
                     )
-        if not tenant_id or tenant_id == DEFAULT_TENANT:
+        try:
+            # the shared edge validation (also run by fleet/router.py
+            # before a request ever reaches this process)
+            edge_id = edge_tenant_id(tenant_id)
+        except TenantError:
+            with self._lock:
+                self.invalid += 1
+            raise
+        if edge_id is None:
             with self._lock:
                 self.resolved += 1
             return self.default_context.pin()
-        if not _ID_RE.match(tenant_id):
-            with self._lock:
-                self.invalid += 1
-            raise TenantError(f"invalid tenant id {tenant_id!r}", status=400)
+        tenant_id = edge_id
         if not ignore_forward:
             with self._lock:
                 fwd = self._forwards.get(tenant_id)
@@ -565,6 +584,28 @@ class TenantRegistry:
 
     def _resident_bytes(self) -> int:
         return sum(c.bank_bytes for c in self._contexts.values())
+
+    def set_line_cache_budget(self, budget_bytes: int) -> None:
+        """Push a re-arbitrated line-cache budget to every resident
+        engine, default included (the fleet share covers the process,
+        not one engine)."""
+        with self._lock:
+            engines = [self.default_engine] + [
+                ctx.engine for ctx in self._contexts.values()
+            ]
+        for engine in engines:
+            cache = getattr(engine, "line_cache", None)
+            if cache is not None:
+                cache.set_budget(budget_bytes)
+
+    def set_budget_mb(self, budget_mb: float) -> None:
+        """Re-arbitrate the residency budget live (fleet/budget.py
+        pushes shares through ``POST /admin/budget``). Shrinking evicts
+        idle tenants down to the new budget immediately; growth simply
+        stops the next eviction sooner."""
+        with self._lock:
+            self.budget_bytes = int(float(budget_mb) * 1024 * 1024)
+            self._evict_over_budget()
 
     def _evict_over_budget(self) -> None:
         """LRU-evict idle non-default tenants until resident bank bytes

@@ -29,6 +29,84 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--log-level", default="INFO")
+    # fleet router front-door (docs/OPS.md "Fleet routing & placement")
+    parser.add_argument(
+        "--role", default="serve", choices=("serve", "router"),
+        help="'serve' boots the engine process (default); 'router' boots "
+        "the fleet front-door instead: no engine, no patterns — requests "
+        "are proxied to --backends by consistent hashing on the tenant id "
+        "(log_parser_tpu/fleet/)",
+    )
+    parser.add_argument(
+        "--backends", default=None, metavar="HOST:PORT,...",
+        help="router mode: comma-separated backend serving processes "
+        "(HTTP base addresses) forming the consistent-hash ring",
+    )
+    parser.add_argument(
+        "--backends-shim", default=None, metavar="HOST:PORT,...",
+        help="router mode: the framed-shim address of each --backends "
+        "entry (same order); enables the router's framed front on "
+        "--shim-port",
+    )
+    parser.add_argument(
+        "--shim-port", type=int, default=None, metavar="PORT",
+        help="router mode: listen port for the framed Envelope front-door "
+        "(requires --backends-shim)",
+    )
+    parser.add_argument(
+        "--grpc-port", type=int, default=None, metavar="PORT",
+        help="router mode: listen port for the gRPC front-door, proxied "
+        "over the framed back-channel (requires --backends-shim; "
+        "disabled when grpcio is absent)",
+    )
+    parser.add_argument(
+        "--fleet-vnodes", type=int, default=64,
+        help="virtual nodes per backend on the consistent-hash ring "
+        "(router mode; default 64)",
+    )
+    parser.add_argument(
+        "--fleet-down-after", type=int, default=2,
+        help="consecutive probe/proxy failures before a backend leaves "
+        "the ring; it re-joins on the first healthy probe (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-poll-s", type=float, default=2.0, metavar="SECONDS",
+        help="placement control-loop poll interval over backend "
+        "/q/health + /metrics (router mode; fleet/placement.py)",
+    )
+    parser.add_argument(
+        "--fleet-burn-polls", type=int, default=3,
+        help="consecutive polls with SLO burn rate > 1 before the placer "
+        "moves the backend's hottest tenant (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-shed-rate", type=float, default=1.0, metavar="PER_S",
+        help="per-tenant 429/503 rate that triggers a live move of that "
+        "tenant; 0 is never reached in practice (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-thrash-rebuilds", type=int, default=3,
+        help="tenant-engine rebuilds within one poll window that count "
+        "as residency thrash and trigger a move (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-move-cooldown-s", type=float, default=30.0,
+        metavar="SECONDS",
+        help="minimum seconds between placer-initiated moves of the SAME "
+        "tenant, so a flapping signal cannot ping-pong it (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-cache-mb", type=float, default=0.0, metavar="MB",
+        help="fleet-wide line-cache budget arbitrated across backends "
+        "from observed traffic, pushed via POST /admin/budget — replaces "
+        "per-process --line-cache-mb; 0 disables (router mode)",
+    )
+    parser.add_argument(
+        "--fleet-tenant-budget-mb", type=float, default=0.0, metavar="MB",
+        help="fleet-wide tenant-residency budget arbitrated across "
+        "backends from observed traffic — replaces per-process "
+        "--tenant-budget-mb; 0 disables (router mode)",
+    )
     parser.add_argument(
         "--sharded",
         action="store_true",
@@ -413,6 +491,11 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
     )
     log = logging.getLogger("log_parser_tpu.serve")
+
+    if args.role == "router":
+        # the router holds no engine: no pattern directory, no jax —
+        # branch before any of the engine boot requirements below
+        return _run_router(args, log)
 
     config = (
         ScoringConfig.from_properties_file(args.config)
@@ -936,6 +1019,107 @@ def main(argv: list[str] | None = None) -> int:
             # sentinel with a request broadcast would desync the followers
             with server.analyze_lock:
                 engine.shutdown_followers()
+    return 0
+
+
+def _run_router(args, log) -> int:
+    """Boot the fleet front-door (``--role router``): the HTTP proxy,
+    the optional framed/gRPC fronts, and the placement control loop.
+    No engine is constructed — the router is deliberately thin."""
+    import threading
+
+    from log_parser_tpu.fleet.budget import FleetBudget
+    from log_parser_tpu.fleet.placement import FleetController
+    from log_parser_tpu.fleet.router import (
+        FramedRouterFront,
+        make_grpc_front,
+        make_router,
+        parse_backends,
+    )
+
+    try:
+        backends = parse_backends(args.backends or "")
+    except ValueError as exc:
+        log.error("%s", exc)
+        return 2
+
+    router = make_router(
+        args.host, args.port, backends,
+        vnodes=args.fleet_vnodes, down_after=args.fleet_down_after,
+    )
+
+    budget = None
+    if args.fleet_cache_mb > 0 or args.fleet_tenant_budget_mb > 0:
+        budget = FleetBudget(args.fleet_cache_mb, args.fleet_tenant_budget_mb)
+    controller = FleetController(
+        router,
+        poll_s=args.fleet_poll_s,
+        burn_polls=args.fleet_burn_polls,
+        shed_rate=args.fleet_shed_rate,
+        thrash_rebuilds=args.fleet_thrash_rebuilds,
+        move_cooldown_s=args.fleet_move_cooldown_s,
+        budget=budget,
+    )
+    router.controller = controller
+
+    framed = None
+    grpc_front = None
+    if args.backends_shim:
+        shim_specs = [s.strip() for s in args.backends_shim.split(",")
+                      if s.strip()]
+        if len(shim_specs) != len(backends):
+            log.error(
+                "--backends-shim must list one host:port per --backends entry"
+            )
+            return 2
+        if args.shim_port is None:
+            log.error("--backends-shim requires --shim-port")
+            return 2
+        shim_addrs = {}
+        for base, spec in zip(backends, shim_specs):
+            host, _, port = spec.rpartition(":")
+            try:
+                shim_addrs[base] = (host or "127.0.0.1", int(port))
+            except ValueError:
+                log.error("bad --backends-shim entry %r: need host:port",
+                          spec)
+                return 2
+        framed = FramedRouterFront(
+            (args.host, args.shim_port), router, shim_addrs
+        )
+        router.framed_front = framed
+        threading.Thread(
+            target=framed.serve_forever, name="fleet-framed", daemon=True
+        ).start()
+        log.info("Framed front on %s:%d", args.host, args.shim_port)
+        if args.grpc_port:
+            grpc_front = make_grpc_front(
+                router, framed, args.host, args.grpc_port
+            )
+            router.grpc_front = grpc_front
+            if grpc_front is not None:
+                log.info("gRPC front on %s:%d", args.host, args.grpc_port)
+    elif args.grpc_port:
+        log.error("--grpc-port on the router requires --backends-shim")
+        return 2
+
+    controller.start()
+    log.info(
+        "Fleet router on %s:%d -> %d backends (%d vnodes each)",
+        args.host, args.port, len(backends), args.fleet_vnodes,
+    )
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        log.info("Shutting down router")
+    finally:
+        controller.stop()
+        if grpc_front is not None:
+            grpc_front.stop(grace=1.0)
+        if framed is not None:
+            framed.shutdown()
+            framed.server_close()
+        router.server_close()
     return 0
 
 

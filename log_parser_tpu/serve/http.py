@@ -283,6 +283,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._admin_replica_feed()
         if self.path == "/admin/promote":
             return self._admin_promote()
+        if self.path == "/admin/budget":
+            return self._admin_budget()
         if self.path == "/frequency/restore":
             bad = b'{"error":"expected {patternId: [ageSeconds >= 0]}"}'
             try:
@@ -464,6 +466,40 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, b'{"error":"expected a JSON object"}')
             return None
         return body
+
+    def _admin_budget(self) -> None:
+        """``POST /admin/budget`` ``{"lineCacheMb": x, "tenantBudgetMb":
+        y}``: apply a fleet-arbitrated budget share live — the router's
+        arbiter (fleet/budget.py) replaces the process-local
+        ``--line-cache-mb`` / ``--tenant-budget-mb`` constants with
+        these pushes. Shrinking evicts down immediately."""
+        body = self._admin_body()
+        if body is None:
+            return
+        line_mb = body.get("lineCacheMb")
+        tenant_mb = body.get("tenantBudgetMb")
+        if line_mb is None and tenant_mb is None:
+            return self._send_json(
+                400,
+                b'{"error":"expected {lineCacheMb and/or tenantBudgetMb}"}',
+            )
+        applied = {}
+        try:
+            if line_mb is not None:
+                line_mb = max(0.0, float(line_mb))
+                self.server.tenants.set_line_cache_budget(
+                    int(line_mb * 1024 * 1024)
+                )
+                applied["lineCacheMb"] = line_mb
+            if tenant_mb is not None:
+                tenant_mb = max(0.0, float(tenant_mb))
+                self.server.tenants.set_budget_mb(tenant_mb)
+                applied["tenantBudgetMb"] = tenant_mb
+        except (TypeError, ValueError):
+            return self._send_json(
+                400, b'{"error":"budgets must be numbers"}'
+            )
+        return self._send_json(200, json.dumps(applied).encode())
 
     def _require_migrator(self):
         mig = self.server.migrator

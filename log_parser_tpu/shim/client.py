@@ -12,6 +12,19 @@ error envelope (the framed-wire analogue of HTTP 429 + ``Retry-After``,
 shim/server.py) is honored the same way: sleep the server's hint
 (capped), then retry. ``last_attempts`` on the client records how many
 attempts the most recent call consumed — the shim's metadata channel.
+
+Forward-follow contract: a live migration (runtime/migrate.py) or a
+standby fence (runtime/replicate.py) answers
+``tenant 'x' migrated to <url>[; retry after Ns]`` — the framed
+rendering of the HTTP 307 + ``Location`` + ``Retry-After``. The client
+follows it: honor the pacing hint, resolve the HTTP ``Location`` to a
+framed shim address (``forward_resolver``; the default assumes the new
+owner serves its shim on THIS client's port at the Location's host),
+reconnect there, and resend the same frame — bounded by ``max_hops``
+with loop detection, so a forwarding cycle surfaces the error instead
+of orbiting it. CLI and test clients survive a mid-run migration
+without manual retry; ``last_hops`` records what the most recent call
+followed.
 """
 
 from __future__ import annotations
@@ -31,6 +44,25 @@ log = logging.getLogger(__name__)
 # shim/server.py sheds with str(AdmissionRejected):
 #   "overloaded: <reason>; retry after <N>s"
 _RETRY_AFTER = re.compile(r"retry after (\d+(?:\.\d+)?)s")
+# shim/server.py forwards with TenantForwarded.reason (+ pacing):
+#   "tenant 'x' migrated to <url>[; retry after <N>s]"
+_FORWARDED = re.compile(r"migrated to (\S+?)[;,]?(?:\s|$)")
+
+
+def default_forward_resolver(location: str, port: int) -> tuple[str, int] | None:
+    """HTTP ``Location`` -> framed shim address: the fleet convention is
+    one shim port fleet-wide, so the new owner's shim lives at the
+    Location's host on the SAME port this client already uses. Deploys
+    with per-backend shim ports pass an explicit ``forward_resolver``."""
+    import urllib.parse
+
+    try:
+        parsed = urllib.parse.urlparse(location)
+    except ValueError:
+        return None
+    if not parsed.hostname:
+        return None
+    return parsed.hostname, port
 
 
 class ShimClient:
@@ -42,6 +74,8 @@ class ShimClient:
         retries: int = 2,
         backoff_s: float = 0.05,
         retry_after_cap_s: float = 5.0,
+        max_hops: int = 3,
+        forward_resolver=None,
         sleep=time.sleep,
     ):
         self.host = host
@@ -49,8 +83,15 @@ class ShimClient:
         self.retries = max(0, int(retries))
         self.backoff_s = backoff_s
         self.retry_after_cap_s = retry_after_cap_s
+        self.max_hops = max(0, int(max_hops))
+        # forward_resolver(location_url) -> (host, port) shim address,
+        # or None to refuse the hop; default: Location host, same port
+        self.forward_resolver = forward_resolver or (
+            lambda loc: default_forward_resolver(loc, self.port)
+        )
         self._sleep = sleep
         self.last_attempts = 0  # attempts consumed by the most recent call
+        self.last_hops = 0  # forwards followed by the most recent call
         self.sock: socket.socket | None = None
         self._connect_with_retry()
 
@@ -100,11 +141,38 @@ class ShimClient:
     # ------------------------------------------------------------------ rpc
 
     def call(self, method: str, message) -> pb.Envelope:
-        """One framed RPC with bounded retry (see module docstring). The
-        request frame is built once and resent verbatim on each attempt."""
+        """One framed RPC with bounded retry AND bounded forward-follow
+        (see module docstring). The request frame is built once and
+        resent verbatim on each attempt and each hop."""
         payload = pb.Envelope(
             method=method, payload=message.SerializeToString()
         ).SerializeToString()
+        self.last_hops = 0
+        seen = {(self.host, self.port)}
+        env = self._call_once(method, payload)
+        while self.last_hops < self.max_hops:
+            hint = self._forward_hint(env)
+            if hint is None:
+                break
+            location, wait = hint
+            addr = self.forward_resolver(location)
+            if addr is None or tuple(addr) in seen:
+                # unresolvable Location or a forwarding loop: surface
+                # the envelope rather than orbit the cycle
+                break
+            if wait > 0:
+                self._sleep(min(wait, self.retry_after_cap_s))
+            self.host, self.port = addr  # ownership moved: so do we
+            seen.add(tuple(addr))
+            self.last_hops += 1
+            log.debug("shim %s following forward to %s:%d",
+                      method, self.host, self.port)
+            self._connect_with_retry()
+            env = self._call_once(method, payload)
+        return env
+
+    def _call_once(self, method: str, payload: bytes) -> pb.Envelope:
+        """The bounded-retry send against the CURRENT address."""
         env = pb.Envelope()
         for attempt in range(self.retries + 1):
             self.last_attempts = attempt + 1
@@ -145,6 +213,15 @@ class ShimClient:
             return None
         m = _RETRY_AFTER.search(env.error)
         return float(m.group(1)) if m else 1.0
+
+    @staticmethod
+    def _forward_hint(env: pb.Envelope) -> tuple[str, float] | None:
+        """(Location, pacing seconds) from a forward envelope, else None."""
+        m = _FORWARDED.search(env.error or "")
+        if m is None:
+            return None
+        after = _RETRY_AFTER.search(env.error)
+        return m.group(1), float(after.group(1)) if after else 0.0
 
     # ---------------------------------------------------------- convenience
 

@@ -16,6 +16,7 @@ import logging
 import socketserver
 
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
+from log_parser_tpu.runtime.tenancy import TenantForwarded
 from log_parser_tpu.serve.admission import AdmissionRejected
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import FramingError, read_frame, write_frame
@@ -151,6 +152,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 # the error text
                 log.info("shim request shed on %s: %s", envelope.method, exc)
                 response = pb.Envelope(method=envelope.method, error=str(exc))
+            except TenantForwarded as exc:
+                # the framed rendering of the HTTP 307: the Location is
+                # already in the reason text, the Retry-After pacing is
+                # appended so a following client (shim/client.py,
+                # fleet/router.py framed front) can honor both
+                log.info("shim request forwarded on %s: %s",
+                         envelope.method, exc)
+                response = pb.Envelope(
+                    method=envelope.method,
+                    error=f"{exc.reason}; retry after {exc.retry_after_s}s",
+                )
             except CLIENT_ERRORS as exc:
                 # expected client errors only (null pod, malformed JSON,
                 # invalid snapshot payload): no traceback, keep the log

@@ -25,6 +25,13 @@ def clean_faults():
 @pytest.fixture()
 def cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+    # these tests pin the DISK snapshot layer (quarantine, fault
+    # injection, lazy restore); the in-process pack memo would answer
+    # warm loads before the disk is ever read, so park it — the memo
+    # has its own coverage (tests/test_fleet.py TestPackSharing)
+    monkeypatch.setenv("LOG_PARSER_TPU_PACK_SHARE", "0")
+    from log_parser_tpu.patterns import libcache
+    libcache.reset_packs()
     return tmp_path
 
 

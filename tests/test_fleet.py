@@ -1,0 +1,551 @@
+"""Fleet front-door (log_parser_tpu/fleet/): consistent-hash ring
+semantics, router→backend parity (routed responses bit-identical to a
+direct hit), the 307-taught override lifecycle (a hot tenant migrated
+mid-traffic costs clients zero errors), backend-death re-mapping, the
+framed front, the shim client's bounded forward-follow, and the shared
+compiled-pack memo (N identical banks → one pack built, scores
+bit-identical with sharing on or off)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.fleet.ring import HashRing
+from log_parser_tpu.fleet.router import (
+    FramedRouterFront,
+    base_of,
+    make_router,
+    parse_backends,
+)
+from log_parser_tpu.runtime import AnalysisEngine
+from log_parser_tpu.runtime.migrate import Migrator
+from log_parser_tpu.runtime.tenancy import TenantRegistry
+from log_parser_tpu.serve import make_server
+
+from helpers import make_pattern, make_pattern_set
+
+ACME_YAML = """
+metadata:
+  library_id: acme-lib
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+  - id: err
+    name: Errors
+    severity: LOW
+    primary_pattern:
+      regex: "\\\\bERROR\\\\b"
+      confidence: 0.5
+"""
+
+TRAFFIC = [
+    "ERROR twice\nERROR again\nOutOfMemoryError",
+    "nothing to see",
+    "java.lang.OutOfMemoryError: metaspace\nERROR",
+]
+
+
+@pytest.fixture()
+def root(tmp_path):
+    for tid in ("acme", "globex"):
+        d = tmp_path / "tenants" / tid
+        d.mkdir(parents=True)
+        (d / "lib.yaml").write_text(ACME_YAML.replace("acme-lib",
+                                                      f"{tid}-lib"))
+    return str(tmp_path / "tenants")
+
+
+def _default_engine() -> AnalysisEngine:
+    return AnalysisEngine(
+        [make_pattern_set([make_pattern("base", regex="BASE")], "base-lib")],
+        ScoringConfig(),
+    )
+
+
+def _post(url, payload, headers=None, path="/parse"):
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _payload(logs: str) -> dict:
+    return {"pod": {"metadata": {"name": "fleet"}}, "logs": logs}
+
+
+def _scrub(body: dict) -> dict:
+    """Drop the per-request nondeterminism (ids, clocks) so parity
+    compares what routing could actually change."""
+    out = json.loads(json.dumps(body))
+    out.pop("analysisId", None)
+    meta = out.get("metadata") or {}
+    meta.pop("processingTimeMs", None)
+    meta.pop("analyzedAt", None)
+    return out
+
+
+# ------------------------------------------------------------------- ring
+
+
+class TestHashRing:
+    def test_owner_is_deterministic_and_a_member(self):
+        backends = [f"http://10.0.0.{i}:8080" for i in range(1, 4)]
+        ring = HashRing(backends)
+        owners = {t: ring.owner(f"tenant-{t}") for t in range(200)}
+        assert set(owners.values()) <= set(backends)
+        again = HashRing(list(backends))
+        assert owners == {t: again.owner(f"tenant-{t}") for t in range(200)}
+
+    def test_spread_is_roughly_fair(self):
+        backends = [f"http://10.0.0.{i}:8080" for i in range(1, 4)]
+        spread = HashRing(backends).spread()
+        total = sum(spread.values())
+        # 64 vnodes x 3 backends: nobody owns the ring, nobody starves
+        assert all(0.15 < n / total < 0.55 for n in spread.values()), spread
+
+    def test_removal_remaps_only_the_dead_arcs(self):
+        backends = [f"http://10.0.0.{i}:8080" for i in range(1, 4)]
+        ring = HashRing(backends)
+        keys = [f"tenant-{i}" for i in range(300)]
+        before = {k: ring.owner(k) for k in keys}
+        dead = backends[0]
+        ring.remove(dead)
+        for k in keys:
+            if before[k] != dead:
+                assert ring.owner(k) == before[k], k  # survivors keep theirs
+            else:
+                assert ring.owner(k) != dead
+        ring.add(dead)
+        assert {k: ring.owner(k) for k in keys} == before  # re-join restores
+
+    def test_override_lifecycle(self):
+        backends = [f"http://10.0.0.{i}:8080" for i in range(1, 3)]
+        ring = HashRing(backends)
+        tenant = "acme"
+        natural = ring.owner(tenant)
+        other = next(b for b in backends if b != natural)
+        assert not ring.set_override(tenant, "http://10.9.9.9:1")  # non-member
+        assert ring.set_override(tenant, other)
+        assert ring.owner(tenant) == other
+        assert ring.overrides() == {tenant: other}
+        # redundant override (back to the hash owner) self-clears
+        assert ring.set_override(tenant, natural)
+        assert ring.overrides() == {}
+        # an override dies with its backend
+        assert ring.set_override(tenant, other)
+        ring.remove(other)
+        assert ring.overrides() == {}
+        assert ring.owner(tenant) == natural
+
+    def test_parse_backends(self):
+        assert parse_backends("127.0.0.1:8080, http://h:9") == [
+            "http://127.0.0.1:8080", "http://h:9",
+        ]
+        for bad in ("", "no-port", "https://h:1", "h:1,h:1"):
+            with pytest.raises(ValueError):
+                parse_backends(bad)
+
+    def test_base_of(self):
+        assert base_of("http://h:8080/parse?x=1") == "http://h:8080"
+        assert base_of("not a url") is None
+        assert base_of("/relative/path") is None
+
+
+# ------------------------------------------------- router parity over HTTP
+
+
+class _Backend:
+    """One in-process serving backend with tenants + a migrator."""
+
+    def __init__(self, root, state_dir):
+        self.registry = TenantRegistry(_default_engine(), root=root)
+        self.server = make_server(
+            self.registry.default_engine, "127.0.0.1", 0,
+            tenants=self.registry,
+        )
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.server.migrator = Migrator(
+            self.registry, state_root=str(state_dir), node_url=self.url
+        )
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.registry.shutdown()
+
+
+@pytest.fixture()
+def fleet(root, tmp_path):
+    backends = [_Backend(root, tmp_path / f"state{i}") for i in range(2)]
+    router = make_router(
+        "127.0.0.1", 0, [b.url for b in backends], down_after=1
+    )
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    router_url = f"http://127.0.0.1:{router.server_address[1]}"
+    try:
+        yield router, router_url, backends
+    finally:
+        router.shutdown()
+        router.server_close()
+        for b in backends:
+            b.close()
+
+
+class TestRouterParity:
+    def test_routed_is_bit_identical_to_direct(self, fleet, root, tmp_path):
+        router, url, backends = fleet
+        direct = _Backend(root, tmp_path / "direct")
+        try:
+            for tenant in (None, "acme", "globex"):
+                hdr = {"X-Tenant": tenant} if tenant else None
+                for blob in TRAFFIC:
+                    ds, dbody, _ = _post(direct.url, _payload(blob), hdr)
+                    rs, rbody, _ = _post(url, _payload(blob), hdr)
+                    assert (ds, _scrub(dbody)) == (rs, _scrub(rbody))
+        finally:
+            direct.close()
+
+    def test_edge_refuses_invalid_tenant(self, fleet):
+        _, url, backends = fleet
+        status, body, _ = _post(url, _payload(TRAFFIC[0]),
+                                {"X-Tenant": "../evil"})
+        assert status == 400 and "invalid tenant id" in body["error"]
+
+    def test_health_and_status_surface(self, fleet):
+        router, url, backends = fleet
+        with urllib.request.urlopen(url + "/q/health", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "UP" and health["role"] == "router"
+        with urllib.request.urlopen(url + "/fleet/status", timeout=30) as r:
+            status = json.loads(r.read())
+        assert sorted(status["ring"]["backends"]) == sorted(
+            b.url for b in backends
+        )
+        assert status["ring"]["overrides"] == {}
+
+
+class TestBackendDeath:
+    def test_ring_remaps_and_serves_from_survivor(self, fleet):
+        router, url, backends = fleet
+        for blob in TRAFFIC:
+            assert _post(url, _payload(blob), {"X-Tenant": "acme"})[0] == 200
+        # kill the backend that owns acme, so the very next acme request
+        # finds the corpse (eviction is traffic-driven)
+        victim = next(b for b in backends
+                      if router.ring.owner("acme") == b.url)
+        survivor = next(b for b in backends if b is not victim)
+        victim.server.shutdown()
+        victim.server.server_close()
+        # zero client errors across the detection window: the in-flight
+        # request that finds the corpse retries the next ring owner
+        for _ in range(4):
+            for hdr in (None, {"X-Tenant": "acme"}, {"X-Tenant": "globex"}):
+                status, body, _ = _post(url, _payload(TRAFFIC[0]), hdr)
+                assert status == 200, body
+        assert router.ring.backends() == [survivor.url]
+        assert router.backends_up() == [survivor.url]
+        with urllib.request.urlopen(url + "/q/health", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "UP"
+        down = next(c for c in health["checks"] if victim.url in c["name"])
+        assert down["status"] == "DOWN"
+
+
+class TestHotTenantMove:
+    def test_mid_traffic_migration_zero_client_errors(self, fleet):
+        """The full fleet story: traffic flows through the router while
+        the tenant is live-migrated under it. The client never sees the
+        307 (the router follows it and learns the override); responses
+        stay 200 and bit-identical in shape before and after."""
+        router, url, backends = fleet
+        hdr = {"X-Tenant": "acme"}
+        # land acme somewhere real
+        assert _post(url, _payload(TRAFFIC[0]), hdr)[0] == 200
+        source = next(b for b in backends
+                      if router.ring.owner("acme") == b.url)
+        target = next(b for b in backends if b is not source)
+
+        statuses: list[int] = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                statuses.append(_post(url, _payload(TRAFFIC[0]), hdr)[0])
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            status, summary, _ = _post(
+                source.url, {"tenant": "acme", "target": target.url,
+                             "retryAfterS": 1},
+                path="/admin/migrate",
+            )
+        finally:
+            # a few post-cutover requests exercise the forward-follow
+            for _ in range(3):
+                statuses.append(_post(url, _payload(TRAFFIC[0]), hdr)[0])
+            stop.set()
+            t.join(30)
+        assert status == 200 and summary["outcome"] == "completed", summary
+        assert statuses and set(statuses) == {200}, statuses
+        # the forward taught the router the new owner
+        assert router.ring.owner("acme") == target.url
+        assert router.ring.overrides() == {"acme": target.url}
+        # and the source itself now answers 307 (clients talking to the
+        # router never see it)
+        status, _, headers = _post(source.url, _payload(TRAFFIC[0]), hdr)
+        assert status == 307 and headers["Location"].startswith(target.url)
+
+
+# ------------------------------------------------------------ framed front
+
+
+class TestFramedFront:
+    def test_framed_parity_and_edge_validation(self, fleet):
+        grpc_pb = pytest.importorskip("log_parser_tpu.shim.logparser_pb2")
+        from log_parser_tpu.shim.client import ShimClient
+        from log_parser_tpu.shim.server import make_shim_server
+
+        router, url, backends = fleet
+        shims = []
+        shim_addrs = {}
+        for b in backends:
+            shim = make_shim_server(
+                b.registry.default_engine, "127.0.0.1", 0,
+                tenants=b.registry,
+            )
+            threading.Thread(target=shim.serve_forever, daemon=True).start()
+            shims.append(shim)
+            shim_addrs[b.url] = ("127.0.0.1", shim.server_address[1])
+        front = FramedRouterFront(("127.0.0.1", 0), router, shim_addrs)
+        threading.Thread(target=front.serve_forever, daemon=True).start()
+        try:
+            front_port = front.server_address[1]
+            with ShimClient("127.0.0.1", front_port) as via_router:
+                routed = via_router.parse({"metadata": {"name": "fleet"}},
+                                          TRAFFIC[0])
+            owner = router.ring.owner("default")
+            with ShimClient(*shim_addrs[owner]) as direct:
+                expected = direct.parse({"metadata": {"name": "fleet"}},
+                                        TRAFFIC[0])
+            for resp in (routed, expected):  # drop ids and clocks
+                resp.analysis_id = ""
+                resp.metadata.processing_time_ms = 0
+                resp.metadata.analyzed_at = ""
+            assert routed.SerializeToString() == expected.SerializeToString()
+            # malformed tenant suffix refused at the front, not proxied
+            with ShimClient("127.0.0.1", front_port) as bad:
+                env = bad.call(
+                    "Parse@../evil",
+                    grpc_pb.ParseRequest(pod_json="{}", logs="x"),
+                )
+            assert "invalid tenant id" in env.error
+        finally:
+            front.shutdown()
+            front.server_close()
+            for shim in shims:
+                shim.shutdown()
+                shim.server_close()
+
+
+# ------------------------------------- shim client bounded forward-follow
+
+
+class _ForwardingClient:
+    """ShimClient with the transport stubbed: each address answers with
+    a scripted envelope, so the hop loop is tested without sockets."""
+
+    def __init__(self, script, **kw):
+        from log_parser_tpu.shim.client import ShimClient
+
+        self.script = script  # (host, port) -> error text ('' = success)
+        self.calls: list[tuple[str, int]] = []
+
+        outer = self
+
+        class Stubbed(ShimClient):
+            def _connect_with_retry(self):
+                pass
+
+            def _call_once(self, method, payload):
+                from log_parser_tpu.shim import logparser_pb2 as pb
+
+                outer.calls.append((self.host, self.port))
+                return pb.Envelope(
+                    method=method,
+                    error=outer.script[(self.host, self.port)],
+                )
+
+        self.client = Stubbed("a", 1, sleep=lambda s: None, **kw)
+
+    def call(self):
+        from log_parser_tpu.shim import logparser_pb2 as pb
+
+        return self.client.call("Health", pb.HealthRequest())
+
+
+class TestShimForwardFollow:
+    def test_follows_to_the_new_owner(self):
+        fc = _ForwardingClient({
+            ("a", 1): "tenant 'acme' migrated to http://b:1; retry after 0s",
+            ("b", 1): "",
+        })
+        env = fc.call()
+        assert env.error == ""
+        assert fc.calls == [("a", 1), ("b", 1)]
+        assert (fc.client.host, fc.client.port) == ("b", 1)  # moved for good
+        assert fc.client.last_hops == 1
+
+    def test_loop_is_detected_not_orbited(self):
+        fc = _ForwardingClient({
+            ("a", 1): "tenant 'acme' migrated to http://b:1",
+            ("b", 1): "tenant 'acme' migrated to http://a:1",
+        })
+        env = fc.call()
+        assert "migrated to" in env.error  # surfaced, not retried forever
+        assert fc.calls == [("a", 1), ("b", 1)]
+
+    def test_hops_are_bounded(self):
+        script = {
+            ("a", 1): "tenant 'x' migrated to http://b:1",
+            ("b", 1): "tenant 'x' migrated to http://c:1",
+            ("c", 1): "tenant 'x' migrated to http://d:1",
+            ("d", 1): "tenant 'x' migrated to http://e:1",
+            ("e", 1): "",
+        }
+        fc = _ForwardingClient(script, max_hops=2)
+        env = fc.call()
+        assert fc.client.last_hops == 2
+        assert "migrated to" in env.error
+        assert fc.calls == [("a", 1), ("b", 1), ("c", 1)]
+
+    def test_default_resolver_keeps_the_port(self):
+        from log_parser_tpu.shim.client import default_forward_resolver
+
+        assert default_forward_resolver("http://new-host:8080/x", 9090) == (
+            "new-host", 9090,
+        )
+        assert default_forward_resolver("nonsense", 9090) is None
+
+
+# ------------------------------------- load-aware single-process placement
+
+
+class TestTenantPlacementLoad:
+    def _placement(self, load=None):
+        from log_parser_tpu.parallel.pattern_sharded import TenantPlacement
+
+        return TenantPlacement(devices=["d0", "d1", "d2"], load=load)
+
+    def test_new_tenants_prefer_the_least_loaded_device(self):
+        loads = {"d0": 5.0, "d1": 0.5, "d2": 3.0}
+        place = self._placement(load=loads.__getitem__)
+        assert place.move("t1") == "d1"
+        loads["d1"] = 9.0
+        assert place.move("t2") == "d2"
+        assert place.assignments == {"t1": "d1", "t2": "d2"}
+
+    def test_broken_load_signal_falls_back_to_round_robin(self):
+        def load(_device):
+            raise RuntimeError("scrape failed")
+
+        place = self._placement(load=load)
+        assert [place.move(f"t{i}") for i in range(4)] == [
+            "d0", "d1", "d2", "d0",
+        ]
+
+    def test_no_callback_is_round_robin(self):
+        place = self._placement()
+        assert [place.move(f"t{i}") for i in range(3)] == ["d0", "d1", "d2"]
+
+
+# ------------------------------------------------- shared compiled packs
+
+
+class TestPackSharing:
+    @pytest.fixture(autouse=True)
+    def isolated_cache(self, tmp_path, monkeypatch):
+        from log_parser_tpu.patterns import libcache
+
+        monkeypatch.setenv("LOG_PARSER_TPU_CACHE", str(tmp_path))
+        libcache.reset_packs()
+        yield
+        libcache.reset_packs()
+
+    def _sets(self):
+        return [
+            make_pattern_set(
+                [make_pattern("oom", regex="OutOfMemoryError",
+                              confidence=0.9)],
+                "shared-lib",
+            )
+        ]
+
+    def test_n_identical_banks_build_one_pack(self):
+        from log_parser_tpu.patterns import libcache
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        banks = [PatternBank(self._sets()) for _ in range(5)]
+        stats = libcache.pack_stats()
+        assert stats["built"] == 1, stats
+        assert stats["shared"] >= 4, stats
+        assert stats["resident"] == 1, stats
+        # the shared substructure is literally the same objects
+        first = banks[0].columns[0]
+        assert all(b.columns[0] is first for b in banks[1:])
+
+    def test_shared_scores_match_unshared(self, monkeypatch):
+        from log_parser_tpu.patterns import libcache
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        shared = PatternBank(self._sets())
+        again = PatternBank(self._sets())
+        assert libcache.pack_stats()["shared"] >= 1
+
+        monkeypatch.setenv("LOG_PARSER_TPU_PACK_SHARE", "0")
+        libcache.reset_packs()
+        unshared = PatternBank(self._sets())
+        assert libcache.pack_stats() == {
+            "built": 0, "shared": 0, "resident": 0, "residentBytes": 0,
+        }
+        for warm in (again, unshared):
+            assert [p.id for p in warm.patterns] == [
+                p.id for p in shared.patterns
+            ]
+            assert [c.regex for c in warm.columns] == [
+                c.regex for c in shared.columns
+            ]
+
+    def test_pack_memo_is_lru_bounded(self, monkeypatch):
+        from log_parser_tpu.patterns import libcache
+        from log_parser_tpu.patterns.bank import PatternBank
+
+        monkeypatch.setenv("LOG_PARSER_TPU_PACK_CACHE", "2")
+        for i in range(4):
+            PatternBank([
+                make_pattern_set(
+                    [make_pattern(f"p{i}", regex=f"needle{i}")],
+                    f"lib-{i}",
+                )
+            ])
+        stats = libcache.pack_stats()
+        assert stats["built"] == 4 and stats["resident"] <= 2, stats

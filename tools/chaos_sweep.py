@@ -240,8 +240,32 @@ failover — docs/OPS.md "Warm-standby replication & failover"):
                         acked prefix — the documented state-loss bound
                         — and the promotion is journaled.
 
+Fleet group (``--group fleet``; router front-door + signal-driven
+placement — docs/OPS.md "Fleet routing & placement"): a real
+``--role router`` process over real backend serving processes.
+
+- ``fleet-backend-kill-reroute`` a backend dies by SIGKILL mid-fleet:
+                        the ring evicts it after ``--fleet-down-after``
+                        failures, every subsequent request is served by
+                        the survivors (zero client errors), and the
+                        router's health + ``logparser_fleet_*`` metrics
+                        reflect the loss.
+- ``fleet-hot-tenant-automove`` one tenant burns its quota (429 sheds):
+                        the placer scrapes the shed rate off the
+                        backend's /metrics and live-migrates the tenant
+                        to the least-loaded backend; clients see only
+                        200s and structured 429s, never a 5xx, and the
+                        tenant serves from its new owner afterwards.
+- ``fleet-budget-rebalance`` fleet-arbitrated budgets replace the
+                        per-process flags: the router pushes
+                        traffic-derived line-cache + tenant-residency
+                        shares via POST /admin/budget and both sides
+                        agree — the backend's /trace/last shows the
+                        applied share, the router's /fleet/status the
+                        assignment.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|replica|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|replica|fleet|all]
                                    [--keep-logs]
 """
 
@@ -2326,6 +2350,220 @@ MINER_STANDALONE = [
 ]
 
 
+# Fleet group (``--group fleet``; router front-door + signal-driven
+# placement — docs/OPS.md "Fleet routing & placement"): a real
+# ``--role router`` process proxying to real backend serving processes
+# over a consistent-hash ring, with the placement control loop live.
+
+
+def _fleet(tmp: str, prefix: str, router_flags: list | None = None,
+           backend_flags: list | None = None,
+           backend_env: dict | None = None):
+    """A router over two backend serving processes sharing one tenant
+    library root (migrations need identical pattern config fleet-wide),
+    each backend with its own --state-dir. Backends boot and become
+    ready BEFORE the router exists, so backend boot latency is never
+    counted against --fleet-down-after."""
+    root = _make_tenant_root(tmp)
+    backends = [
+        Server(
+            f"{prefix}-backend{i}",
+            ["--tenant-root", root,
+             "--state-dir", os.path.join(tmp, f"state{i}"),
+             *(backend_flags or [])],
+            backend_env or {},
+        )
+        for i in range(2)
+    ]
+    for b in backends:
+        b.wait_ready()
+    router = Server(
+        f"{prefix}-router",
+        ["--role", "router",
+         "--backends", ",".join(f"127.0.0.1:{b.port}" for b in backends),
+         *(router_flags or [])],
+        {},
+    )
+    router.wait_ready()
+    return router, backends
+
+
+def _router_metric(url: str, name: str, label: str = "") -> float:
+    """Sum of a metric family's samples on the router's /metrics,
+    optionally filtered by a label substring."""
+    _, text = get_text(url, "/metrics")
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and (not label or label in line):
+            try:
+                total += float(line.rsplit(None, 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _poll_until(pred, timeout: float = 30.0, every: float = 0.5):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = pred()
+        if last:
+            return last
+        time.sleep(every)
+    raise AssertionError(f"condition never held (last: {last!r})")
+
+
+def scenario_fleet_backend_kill_reroute():
+    """SIGKILL one backend of two: the ring evicts it after
+    --fleet-down-after failed contacts, every subsequent request —
+    including the ones racing the detection window — is served by the
+    survivor, and the router's aggregate health stays UP."""
+    with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as tmp:
+        router, backends = _fleet(
+            tmp, "fleet-kill",
+            router_flags=["--fleet-down-after", "1", "--fleet-poll-s", "0.5"],
+        )
+        try:
+            # both tenants route through the front-door while the fleet
+            # is whole
+            for hdr in (None, {"X-Tenant": "acme"}):
+                status, _, _ = post(router.url, hdr)
+                assert status == 200, status
+            assert _router_metric(
+                router.url, "logparser_fleet_backends_up"
+            ) == 2.0
+            backends[0].proc.kill()
+            backends[0].proc.wait(10)
+            # zero client errors across the detection window: a request
+            # that lands on the dead backend retries the next ring owner
+            # in-flight
+            for i in range(8):
+                hdr = {"X-Tenant": "acme"} if i % 2 else None
+                status, body, _ = post(router.url, hdr)
+                assert status == 200, (i, status, body)
+            _poll_until(lambda: _router_metric(
+                router.url, "logparser_fleet_backends_up") == 1.0)
+            assert _router_metric(
+                router.url, "logparser_fleet_reroutes_total", "backend_down"
+            ) >= 1.0
+            hstatus, health = get(router.url, "/q/health")
+            assert hstatus == 200 and health["status"] == "UP", (
+                hstatus, health,
+            )
+            _, fleet = get(router.url, "/fleet/status")
+            assert len(fleet["ring"]["backends"]) == 1, fleet["ring"]
+            assert fleet["ring"]["remaps"] > 0, fleet["ring"]
+            down = fleet["backends"][
+                f"http://127.0.0.1:{backends[0].port}"]
+            assert not down["up"] and down["lastError"], down
+        finally:
+            router.stop()
+            for b in backends:
+                b.stop()
+
+
+def scenario_fleet_hot_tenant_automove():
+    """A tenant burning its rate quota (429 sheds on the backend) is
+    live-migrated by the placer: the shed rate is scraped off the
+    backend's own /metrics, the move runs the real migrate protocol,
+    and the tenant serves from its new owner — clients never see a
+    5xx, only 200s and the structured 429s the quota already answers."""
+    with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as tmp:
+        router, backends = _fleet(
+            tmp, "fleet-hot",
+            router_flags=["--fleet-poll-s", "0.5",
+                          "--fleet-shed-rate", "0.5",
+                          "--fleet-down-after", "10"],
+            # PAYLOAD is 3 lines; lines/s 2 = a 4-token bucket, so a
+            # concurrent burst sheds structured 429s per tenant
+            backend_flags=["--tenant-lines-per-s", "2"],
+        )
+        try:
+            hdr = {"X-Tenant": "acme"}
+            assert post(router.url, hdr)[0] == 200
+            statuses = []
+            # sustained sheds across several placer polls
+            for _ in range(3):
+                burst = Burst(router.url, 6, hdr)
+                statuses.extend(s for s, _ in burst.join())
+                time.sleep(0.6)
+            assert set(statuses) <= {200, 429}, statuses
+            assert 429 in statuses, statuses
+            _poll_until(lambda: _router_metric(
+                router.url, "logparser_fleet_moves_total") >= 1.0)
+            assert _router_metric(
+                router.url, "logparser_fleet_moves_total", "quota_shed"
+            ) >= 1.0
+            # the moved tenant serves from its new owner once the token
+            # bucket refills; the router already routes there (the
+            # override was installed on the migrate ack)
+            def served():
+                status, _, _ = post(router.url, hdr)
+                return status == 200
+            _poll_until(served, timeout=15.0)
+            _, fleet = get(router.url, "/fleet/status")
+            assert fleet["placement"]["movesFailed"] == 0, fleet["placement"]
+        finally:
+            router.stop()
+            for b in backends:
+                b.stop()
+
+
+def scenario_fleet_budget_rebalance():
+    """Fleet-arbitrated budgets land on both sides: the router splits
+    --fleet-cache-mb / --fleet-tenant-budget-mb from observed traffic
+    and pushes POST /admin/budget; each backend's /trace/last shows the
+    applied share replacing its boot-time flag value."""
+    with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as tmp:
+        router, backends = _fleet(
+            tmp, "fleet-budget",
+            router_flags=["--fleet-poll-s", "0.5",
+                          "--fleet-cache-mb", "32",
+                          "--fleet-tenant-budget-mb", "48"],
+            backend_flags=["--line-cache-mb", "64"],
+        )
+        try:
+            for hdr in (None, {"X-Tenant": "acme"}):
+                assert post(router.url, hdr)[0] == 200
+
+            def applied():
+                shares = []
+                for b in backends:
+                    _, trace = get(b.url, "/trace/last")
+                    cache_mb = trace.get("lineCache", {}).get("budgetMb")
+                    tenant_mb = trace.get("tenants", {}).get("budgetMb")
+                    if cache_mb is None or cache_mb == 64.0:
+                        return None  # boot-time flag value still in force
+                    if not tenant_mb:
+                        return None
+                    shares.append((cache_mb, tenant_mb))
+                return shares
+
+            shares = _poll_until(applied)
+            # the shares partition the fleet-wide budgets (floor 8 MiB
+            # each plus the traffic-proportional pool)
+            assert abs(sum(s[0] for s in shares) - 32.0) < 0.1, shares
+            assert abs(sum(s[1] for s in shares) - 48.0) < 0.1, shares
+            assert all(s[0] >= 8.0 and s[1] >= 8.0 for s in shares), shares
+            _, fleet = get(router.url, "/fleet/status")
+            budget = fleet["placement"]["budget"]
+            assert len(budget) == 2, budget
+            assert _router_metric(
+                router.url, "logparser_fleet_budget_mb", "line_cache"
+            ) > 0.0
+        finally:
+            router.stop()
+            for b in backends:
+                b.stop()
+
+
+FLEET_STANDALONE = [
+    ("fleet-backend-kill-reroute", scenario_fleet_backend_kill_reroute),
+    ("fleet-hot-tenant-automove", scenario_fleet_hot_tenant_automove),
+    ("fleet-budget-rebalance", scenario_fleet_budget_rebalance),
+]
+
+
 SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
@@ -2378,7 +2616,7 @@ def main(argv: list[str] | None = None) -> int:
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
             "streaming", "distributed", "tenant", "miner", "obs", "spans",
-            "migrate", "replica", "all",
+            "migrate", "replica", "fleet", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -2443,6 +2681,8 @@ def main(argv: list[str] | None = None) -> int:
         standalone.extend(MIGRATE_STANDALONE)
     if args.group in ("replica", "all"):
         standalone.extend(REPLICA_STANDALONE)
+    if args.group in ("fleet", "all"):
+        standalone.extend(FLEET_STANDALONE)
     for name, check in standalone:
         if args.only and name != args.only:
             continue

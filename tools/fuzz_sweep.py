@@ -63,9 +63,21 @@ Three modes:
   through the bank's own compile entry points (``compile_java_regex``,
   ``classify_regex`` off the skipped tier).
 
+- ``--router``: NOT a parity sweep — a robustness sweep over the fleet
+  router front-door (log_parser_tpu/fleet/router.py). A real router
+  proxies to a real in-process backend while seeded hostile traffic
+  hits the edge: hostile ``X-Tenant`` headers (traversal, control soup,
+  overlong ids — refused 400 AT the router, never forwarded), hostile
+  request bodies and paths (relayed verbatim, the backend's verdict
+  passed through), malformed ``POST /fleet/override`` bodies (400 with
+  the ring provably untouched), and raw-socket garbage at the router
+  port. After every seed the router must still answer ``/q/health`` UP,
+  the ring must still hold its backend, and a clean ``POST /parse``
+  must still round-trip — a wedged or 5xx-ing router is the finding.
+
 Usage: python tools/fuzz_sweep.py [--start N] [--end M]
        [--sharded | --pattern-sharded | --long | --admin | --ingest |
-        --stream | --miner | --quick]
+        --stream | --miner | --router | --quick]
 (defaults per mode: 8..200 single-device, 1004..1054 sharded,
 9003..9053 pattern-sharded, 31006..31056 long — a bare run reproduces
 the documented records below; --end exclusive)
@@ -131,6 +143,7 @@ def main() -> int:
     mode.add_argument("--ingest", action="store_true")
     mode.add_argument("--stream", action="store_true")
     mode.add_argument("--miner", action="store_true")
+    mode.add_argument("--router", action="store_true")
     mode.add_argument(
         "--quick",
         action="store_true",
@@ -157,7 +170,17 @@ def main() -> int:
         start = _MODE_DEFAULTS["miner"][0]
         print(f"== quick sweep: miner seeds {start}..{start + 4}", flush=True)
         rc |= run_miner_sweep(start, start + 5)
+        start = _MODE_DEFAULTS["router"][0]
+        print(f"== quick sweep: router seeds {start}..{start + 4}", flush=True)
+        rc |= run_router_sweep(start, start + 5)
         return rc
+    if args.router:
+        start, end = _MODE_DEFAULTS["router"]
+        if args.start is not None:
+            start = args.start
+        if args.end is not None:
+            end = args.end
+        return run_router_sweep(start, end)
     if args.miner:
         start, end = _MODE_DEFAULTS["miner"]
         if args.start is not None:
@@ -215,6 +238,7 @@ _MODE_DEFAULTS = {
     "ingest": (51000, 51050),
     "stream": (61000, 61050),
     "miner": (71000, 71024),
+    "router": (81000, 81050),
 }
 
 
@@ -876,6 +900,180 @@ def run_miner_sweep(start: int, end: int) -> int:
             print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
     engine.miner.stop()
     print(f"DONE miner seeds {start}..{end - 1} fails: {fails} "
+          f"({time.time() - t0:.0f}s)")
+    return 1 if fails else 0
+
+
+def _router_tenant_headers(rng: "random.Random") -> list[str]:
+    """Hostile X-Tenant values. urllib refuses header injection itself,
+    so the corpus stays latin-1-printable — the interesting surface is
+    the edge validator, not the client library."""
+    # the trailing "|" is outside [A-Za-z0-9._-], so the soup is always
+    # invalid no matter what the prefix draws
+    soup = "".join(
+        rng.choice("abz09._-/\\~!$%&*()+=:;'\"<>?|{}[] ")
+        for _ in range(rng.randrange(1, 40))
+    ) + "|"
+    return [
+        "../evil",                          # traversal
+        "..",                               # bare dots
+        "a" * rng.randrange(65, 200),       # over the 64-char id bound
+        "UPPER CASE",                       # space + case
+        "acme/../default",                  # embedded traversal
+        ".hidden",                          # leading dot
+        "-dash-lead",                       # leading dash is refused
+        soup,
+        "%2e%2e%2fescape",                  # encoded traversal
+        "tab\tin\ttenant",
+    ]
+
+
+def _router_garbage(rng: "random.Random") -> list[bytes]:
+    return [
+        bytes(rng.randrange(256) for _ in range(rng.randrange(1, 128))),
+        b"GET / HTTP/9.9\r\n\r\n",
+        b"POST /parse HTTP/1.1\r\nContent-Length: 99999999\r\n\r\nxx",
+        b"\r\n\r\n\r\n",
+        b"POST /parse HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nZZZ\r\n",
+    ]
+
+
+def run_router_sweep(start: int, end: int) -> int:
+    """Fuzz the fleet-router front-door: hostile tenants are refused 400
+    AT the edge (never proxied), hostile bodies/paths relay the
+    backend's own verdict, malformed /fleet/override bodies answer 400
+    with the ring untouched, raw-socket garbage never wedges the
+    listener — and after every seed the router still routes."""
+    import json
+    import random
+    import socket
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from log_parser_tpu.config import ScoringConfig
+    from log_parser_tpu.fleet.router import make_router
+    from log_parser_tpu.patterns import load_pattern_directory
+    from log_parser_tpu.runtime import AnalysisEngine
+    from log_parser_tpu.serve.http import make_server
+
+    pattern_dir = os.path.join(_REPO, "log_parser_tpu", "patterns", "builtin")
+    engine = AnalysisEngine(load_pattern_directory(pattern_dir), ScoringConfig())
+    backend = make_server(engine, "127.0.0.1", 0)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    backend_url = f"http://127.0.0.1:{backend.server_address[1]}"
+    router = make_router("127.0.0.1", 0, [backend_url], down_after=5)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{router.server_address[1]}"
+    parse_body = json.dumps(
+        {"pod": {"metadata": {"name": "fuzz"}}, "logs": "INFO boot"}
+    ).encode()
+
+    def req(path: str, body: bytes | None = None,
+            headers: dict | None = None) -> tuple[int, bytes]:
+        r = urllib.request.Request(
+            url + path, data=body,
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=60) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def ring_fingerprint() -> str:
+        stats = router.ring.stats()
+        return json.dumps(
+            {"backends": stats["backends"], "overrides": stats["overrides"]},
+            sort_keys=True,
+        )
+
+    t0 = time.time()
+    fails: list[tuple[int, str]] = []
+    try:
+        for seed in range(start, end):
+            rng = random.Random(seed)
+            try:
+                for tenant in _router_tenant_headers(rng):
+                    try:
+                        status, payload = req(
+                            "/parse", parse_body, {"X-Tenant": tenant}
+                        )
+                    except ValueError:
+                        continue  # urllib itself refused the header value
+                    if status != 400:
+                        raise AssertionError(
+                            f"hostile tenant {tenant[:40]!r} answered "
+                            f"{status}, want 400 at the edge"
+                        )
+                    err = json.loads(payload)
+                    if "error" not in err:
+                        raise AssertionError(
+                            f"400 without structured error: {payload[:120]!r}"
+                        )
+                # hostile bodies and paths relay the backend verdict —
+                # anything but a router-minted 5xx is acceptable
+                hostile = bytes(
+                    rng.randrange(256) for _ in range(rng.randrange(1, 256))
+                )
+                for path, body in (
+                    ("/parse", hostile),
+                    ("/parse", b"[]"),
+                    (f"/no-such-{seed}", None),
+                ):
+                    status, _ = req(path, body)
+                    if status >= 500:
+                        raise AssertionError(
+                            f"{path} answered {status} with the backend up"
+                        )
+                # malformed override bodies: 400, ring untouched
+                ring_before = ring_fingerprint()
+                for body in (
+                    b"not json",
+                    b"[]",
+                    b"{}",
+                    json.dumps({"tenant": "../evil",
+                                "backend": backend_url}).encode(),
+                    json.dumps({"tenant": "acme",
+                                "backend": "http://10.0.0.1:1"}).encode(),
+                    hostile,
+                ):
+                    status, _ = req("/fleet/override", body)
+                    if status != 400:
+                        raise AssertionError(
+                            f"override fuzz answered {status}, want 400"
+                        )
+                if ring_fingerprint() != ring_before:
+                    raise AssertionError("override fuzz mutated the ring")
+                # raw-socket garbage must never wedge the listener
+                for garbage in _router_garbage(rng):
+                    with socket.create_connection(
+                        ("127.0.0.1", router.server_address[1]), timeout=10
+                    ) as s:
+                        s.sendall(garbage)
+                        s.settimeout(5)
+                        try:
+                            s.recv(4096)
+                        except (socket.timeout, OSError):
+                            pass
+                # the router still routes after every hostile pass
+                status, _ = req("/q/health")
+                if status != 200:
+                    raise AssertionError(f"health {status} after fuzz")
+                status, _ = req("/parse", parse_body)
+                if status != 200:
+                    raise AssertionError(f"clean parse {status} after fuzz")
+            except Exception as exc:  # noqa: BLE001 - recorded, sweep continues
+                fails.append((seed, repr(exc)[:300]))
+                print(f"SEED {seed} FAILED: {exc!r}", flush=True)
+            if seed % 10 == 0:
+                print(f"seed {seed} done ({time.time() - t0:.0f}s)", flush=True)
+    finally:
+        router.shutdown()
+        router.server_close()
+        backend.shutdown()
+        backend.server_close()
+    print(f"DONE router seeds {start}..{end - 1} fails: {fails} "
           f"({time.time() - t0:.0f}s)")
     return 1 if fails else 0
 

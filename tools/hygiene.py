@@ -945,6 +945,95 @@ def check_replica_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_fleet_vocab_pinned(root: Path) -> list[str]:
+    """Check 20: the fleet-router vocabulary must be pinned the way
+    check 19 pins replication's. The router fault sites (``FAULT_SITES``
+    in fleet/router.py — ``route`` / ``route_backend`` /
+    ``placement_move``, one per routing leg) each need a docs/OPS.md row
+    and a live ``faults.fire`` call site (comment-tolerant scan). The
+    ``route`` span and the ``logparser_fleet_*`` families are pinned BY
+    NAME — losing one must point at the fleet runbook. The
+    ``--role``/``--backends*``/``--shim-port``/``--grpc-port``/
+    ``--fleet-*`` serve flags get the backtick-row standard."""
+    src = root / "log_parser_tpu" / "fleet" / "router.py"
+    spans_src = root / "log_parser_tpu" / "obs" / "spans.py"
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    sites = _dict_keys_of(src, "FAULT_SITES")
+    for required in ("route", "route_backend", "placement_move"):
+        if required not in sites:
+            problems.append(
+                f"{src}: fleet fault site {required!r} is missing from "
+                "FAULT_SITES — the fleet chaos drills depend on it"
+            )
+    for key in sites:
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{src}: fleet fault site {key!r} is not documented in "
+                "docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{src}: fleet fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    if spans_src.is_file():
+        span_names = set(_dict_keys_of(spans_src, "SPANS"))
+        if "route" not in span_names:
+            problems.append(
+                f"{spans_src}: fleet span 'route' is missing from SPANS "
+                "— the router causal trace depends on it"
+            )
+        elif "`route`" not in ops_text:
+            problems.append(
+                f"{spans_src}: fleet span 'route' has no backtick-quoted "
+                "docs/OPS.md row"
+            )
+    if registry_src.is_file():
+        metrics = set(_dict_keys_of(registry_src, "METRICS"))
+        fleet_fams = {m for m in metrics if m.startswith("logparser_fleet_")}
+        if not fleet_fams:
+            problems.append(
+                f"{registry_src}: no logparser_fleet_* metric families — "
+                "the fleet routing alerts depend on them"
+            )
+        for fam in sorted(fleet_fams):
+            if f"`{fam}`" not in ops_text:
+                problems.append(
+                    f"{registry_src}: fleet family {fam!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--(?:role|backends|backends-shim'
+            r'|shim-port|grpc-port|fleet-[a-z0-9-]+))"',
+            serve_src.read_text(),
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: fleet serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -978,6 +1067,7 @@ def main() -> int:
         problems.extend(check_span_vocab_pinned(root))
         problems.extend(check_migrate_vocab_pinned(root))
         problems.extend(check_replica_vocab_pinned(root))
+        problems.extend(check_fleet_vocab_pinned(root))
 
     for p in problems:
         print(p)

@@ -100,3 +100,7 @@ run "config5_http_c4_${platform}"  python bench_latency.py --http --concurrency 
 # (ISSUE 9 acceptance shape; headline row of BENCH_r09)
 run "config5_stream_${platform}" \
   python bench_latency.py --stream --repeat-ratio 0.9 --line-cache-mb 64
+# fleet front-door: 1,000 tenants, zipf traffic, 3 backends behind the
+# router, one hot tenant moved live by the placement loop, plus the
+# compiled-pack dedupe savings. Pure subprocess HTTP — fixed cpu stem
+run "fleet_1k_cpu" python bench_mesh.py --fleet
