@@ -38,6 +38,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import socket
 import socketserver
 import threading
@@ -47,7 +48,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from log_parser_tpu.fleet.ring import DEFAULT_VNODES, HashRing
 from log_parser_tpu.obs import Obs
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
+from log_parser_tpu.runtime.migrate import MigrationJournal, _frame_records
 from log_parser_tpu.runtime.tenancy import (
     DEFAULT_TENANT,
     TenantError,
@@ -130,6 +132,121 @@ class _BackendState:
         self.since = time.monotonic()
 
 
+OVERRIDE_JOURNAL = "router_overrides.wal"
+
+
+class OverrideJournal:
+    """CRC-framed ring-override log under the router's state dir.
+
+    Every learned placement (HTTP 307 ``Location``, framed ``migrated
+    to`` refusal) and manual one (``POST /fleet/override``) is appended
+    as one frame, so a router restart replays the placements the fleet
+    already taught it instead of re-discovering each with a redirect
+    hop. Replay applies the surviving last-record-per-tenant set through
+    :meth:`~log_parser_tpu.fleet.ring.HashRing.set_override`, which is
+    where stale entries self-clear: a backend that is no longer a ring
+    member is refused, and an override matching the hash owner drops
+    out. After replay the log is compacted to exactly the overrides the
+    ring kept.
+
+    Appends are contained: a failed write costs re-learning one
+    placement after a restart, never a routed request — the ring stays
+    authoritative in memory either way."""
+
+    def __init__(self, state_dir: str):
+        os.makedirs(state_dir, exist_ok=True)
+        self.path = os.path.join(state_dir, OVERRIDE_JOURNAL)
+        self.applied = 0
+        self.stale = 0
+        self.appended = 0
+        self.write_errors = 0
+        self._mu = threading.Lock()
+        self._journal = MigrationJournal(self.path)
+
+    def recover(self, ring: HashRing) -> dict:
+        """Replay onto ``ring`` and compact. Returns counts."""
+        live: dict[str, str] = {}
+        for rec in MigrationJournal.replay(self.path):
+            tenant = rec.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                continue
+            if rec.get("k") == "clear":
+                live.pop(tenant, None)
+            elif rec.get("k") == "override" and isinstance(
+                rec.get("backend"), str
+            ):
+                live[tenant] = rec["backend"]
+        for tenant, backend in live.items():
+            # set_override returning True covers the redundant case too
+            # (backend == hash owner — correctly routed, entry dropped);
+            # only a non-member backend is stale
+            if ring.set_override(tenant, backend):
+                self.applied += 1
+            else:
+                self.stale += 1
+        self.compact(ring)
+        return {"applied": self.applied, "stale": self.stale}
+
+    def note(self, tenant: str, backend: str | None) -> None:
+        """Append one placement record (``backend=None`` is a clear)."""
+        with self._mu:
+            if self._journal is None:  # pragma: no cover - closed race
+                return
+            try:
+                if backend is None:
+                    self._journal.append("clear", tenant=tenant)
+                else:
+                    self._journal.append(
+                        "override", tenant=tenant, backend=backend
+                    )
+                self.appended += 1
+            except OSError as exc:
+                self.write_errors += 1
+                pressure.note_write_error(exc, "override_journal")
+                log.warning("override journal append failed: %s", exc)
+
+    def compact(self, ring: HashRing) -> None:
+        """Rewrite the log to exactly the ring's live override set
+        (tmp + fsync + atomic replace), so cleared and stale entries
+        cannot grow the file without bound."""
+        records = [
+            {"k": "override", "tenant": t, "backend": b}
+            for t, b in sorted(ring.overrides().items())
+        ]
+        raw = _frame_records(records)
+        with self._mu:
+            self._journal.close()
+            tmp = self.path + ".compact"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(raw)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            except OSError as exc:
+                self.write_errors += 1
+                pressure.note_write_error(exc, "override_journal")
+                log.warning("override journal compaction failed: %s", exc)
+            finally:
+                self._journal = MigrationJournal(self.path)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "path": self.path,
+                "applied": self.applied,
+                "stale": self.stale,
+                "appended": self.appended,
+                "writeErrors": self.write_errors,
+            }
+
+    def close(self) -> None:
+        with self._mu:
+            jr, self._journal = self._journal, None
+            if jr is not None:
+                jr.close()
+
+
 class RouterServer(ThreadingHTTPServer):
     daemon_threads = True
     request_queue_size = 128
@@ -156,9 +273,19 @@ class RouterServer(ThreadingHTTPServer):
         proxy_timeout_s: float = 60.0,
         down_after: int = 2,
         obs: Obs | None = None,
+        state_dir: str | None = None,
     ):
         super().__init__(address, _RouterHandler)
         self.ring = HashRing(backends, vnodes=vnodes)
+        self.override_journal: OverrideJournal | None = None
+        if state_dir:
+            self.override_journal = OverrideJournal(state_dir)
+            recovered = self.override_journal.recover(self.ring)
+            if recovered["applied"] or recovered["stale"]:
+                log.info(
+                    "override journal replayed: %d applied, %d stale",
+                    recovered["applied"], recovered["stale"],
+                )
         self.all_backends = list(backends)  # membership superset, fixed
         self.proxy_timeout_s = float(proxy_timeout_s)
         self.down_after = max(1, int(down_after))
@@ -181,6 +308,24 @@ class RouterServer(ThreadingHTTPServer):
         self.framed_front = None
         self.grpc_front = None
         self.started_monotonic = time.monotonic()
+
+    # --------------------------------------------------------- overrides
+
+    def learn_override(self, tenant: str, backend: str) -> bool:
+        """``set_override`` + journal: the single path every learned
+        placement (HTTP 307, framed ``migrated to``, manual POST) goes
+        through, so a restart replays what the fleet already taught."""
+        if not self.ring.set_override(tenant, backend):
+            return False
+        if self.override_journal is not None:
+            self.override_journal.note(tenant, backend)
+        return True
+
+    def forget_override(self, tenant: str) -> bool:
+        cleared = self.ring.clear_override(tenant)
+        if cleared and self.override_journal is not None:
+            self.override_journal.note(tenant, None)
+        return cleared
 
     # -------------------------------------------------------- health map
 
@@ -263,6 +408,8 @@ class RouterServer(ThreadingHTTPServer):
         front = self.framed_front
         if front is not None:
             status["framed"] = front.stats()
+        if self.override_journal is not None:
+            status["overrideJournal"] = self.override_journal.stats()
         return status
 
 
@@ -366,11 +513,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 400, json.dumps({"error": str(exc)}).encode()
             )
         if backend is None:
-            cleared = self.server.ring.clear_override(tenant)
+            cleared = self.server.forget_override(tenant)
             return self._send_json(
                 200, json.dumps({"cleared": cleared}).encode()
             )
-        if not isinstance(backend, str) or not self.server.ring.set_override(
+        if not isinstance(backend, str) or not self.server.learn_override(
             tenant, backend
         ):
             return self._send_json(
@@ -448,6 +595,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         body = self.rfile.read(length) if length else b""
 
+        budget = pressure.retry_budget()
+        attempts = 0
         seen: set[str] = set()
         while True:
             backend = server.ring.owner(route_key) or ""
@@ -455,6 +604,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 outcome, status = "no_backend", 503
                 self._send_json(status, b'{"error":"no backend available"}')
                 break
+            # retry budget: the first attempt deposits, every re-route
+            # (next owner after a failure, a 307 follow) spends a token
+            # — an exhausted bucket sheds instead of feeding the storm
+            if attempts and budget is not None and not budget.allow(
+                f"router:{backend}"
+            ):
+                outcome, status = "retry_shed", 503
+                self._send_json(
+                    status, b'{"error":"retry budget exhausted"}'
+                )
+                break
+            attempts += 1
+            if attempts == 1 and budget is not None:
+                budget.note_request(f"router:{backend}")
             try:
                 # chaos point: contained as one failed attempt — the
                 # backend is marked down and the ring re-maps
@@ -482,7 +645,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 learned = (
                     new_base is not None
                     and new_base != backend
-                    and server.ring.set_override(tenant, new_base)
+                    and server.learn_override(tenant, new_base)
                 )
                 if learned:
                     server.reroutes_total.inc(reason="forward")
@@ -729,6 +892,8 @@ class _FramedFrontHandler(socketserver.BaseRequestHandler):
 
         router = self.server.router
         route_key = tenant or DEFAULT_TENANT
+        budget = pressure.retry_budget()
+        attempts = 0
         seen: set[str] = set()
         hops = 0
         while True:
@@ -738,6 +903,16 @@ class _FramedFrontHandler(socketserver.BaseRequestHandler):
                 return pb.Envelope(
                     method=method, error="router: no backend available"
                 ).SerializeToString()
+            # same retry budget as the HTTP proxy: re-routes spend
+            if attempts and budget is not None and not budget.allow(
+                f"router:{backend}"
+            ):
+                return pb.Envelope(
+                    method=method, error="router: retry budget exhausted"
+                ).SerializeToString()
+            attempts += 1
+            if attempts == 1 and budget is not None:
+                budget.note_request(f"router:{backend}")
             try:
                 faults.fire("route_backend", key=backend)
                 with socket.create_connection(
@@ -770,7 +945,7 @@ class _FramedFrontHandler(socketserver.BaseRequestHandler):
                 if (
                     new_base is not None
                     and new_base != backend
-                    and router.ring.set_override(tenant, new_base)
+                    and router.learn_override(tenant, new_base)
                     and new_base not in seen
                     and hops < _MAX_HOPS
                 ):
@@ -858,6 +1033,7 @@ def make_router(
     vnodes: int = DEFAULT_VNODES,
     proxy_timeout_s: float = 60.0,
     down_after: int = 2,
+    state_dir: str | None = None,
 ) -> RouterServer:
     return RouterServer(
         (host, port),
@@ -865,4 +1041,5 @@ def make_router(
         vnodes=vnodes,
         proxy_timeout_s=proxy_timeout_s,
         down_after=down_after,
+        state_dir=state_dir,
     )

@@ -43,7 +43,7 @@ from log_parser_tpu.mining.admit import (
 from log_parser_tpu.mining.synthesize import candidate_yaml, synthesize
 from log_parser_tpu.mining.templates import TemplateClusterer
 from log_parser_tpu.models.pattern import PatternSet
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.runtime.linecache import DEFAULT_TAP_CAPACITY, MissTap
 
 log = logging.getLogger(__name__)
@@ -101,6 +101,7 @@ class TemplateMiner:
         self.promoted = 0
         self.admitted = 0
         self.errors = 0
+        self.park_skipped = 0  # pending-YAML persists paused/refused by disk pressure
         self._rejected: Counter[str] = Counter()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -218,13 +219,34 @@ class TemplateMiner:
         }
         with self.lock:
             self._pending[pid] = entry
-        if self.pending_dir:
+        self._persist_pending(pid, text)
+
+    def _persist_pending(self, pid: str, text: str) -> None:
+        """Write one parked candidate's YAML beside the WAL. Under disk
+        pressure (soft or hard) parking pauses: the candidate stays
+        reviewable in memory — losing a mined *suggestion* across a
+        crash is the cheapest possible shed, so this is the first
+        writer the ladder turns off."""
+        if not self.pending_dir:
+            return
+        if pressure.miner_park_paused():
+            with self.lock:
+                self.park_skipped += 1
+            return
+        try:
             os.makedirs(self.pending_dir, exist_ok=True)
             path = os.path.join(self.pending_dir, f"{pid}.yaml")
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as fh:
                 fh.write(text)
             os.replace(tmp, path)
+        except OSError as exc:
+            # organic full disk on the same writer: contained — mining
+            # must never take the serving path (or the worker) down
+            with self.lock:
+                self.park_skipped += 1
+            pressure.note_write_error(exc, "miner_park")
+            log.warning("parking candidate %s failed: %s", pid, exc)
 
     def adopt_pending(self, entries) -> int:
         """Re-park candidate entries exported by a tenant migration
@@ -244,13 +266,7 @@ class TemplateMiner:
                     continue
                 self._pending[pid] = dict(entry)
             adopted += 1
-            if self.pending_dir:
-                os.makedirs(self.pending_dir, exist_ok=True)
-                path = os.path.join(self.pending_dir, f"{pid}.yaml")
-                tmp = path + ".tmp"
-                with open(tmp, "w", encoding="utf-8") as fh:
-                    fh.write(text)
-                os.replace(tmp, path)
+            self._persist_pending(pid, str(text))
         return adopted
 
     def _load_pending(self) -> None:
@@ -332,4 +348,5 @@ class TemplateMiner:
                 "pending": len(self._pending),
                 "retrying": len(self._retry),
                 "errors": self.errors,
+                "parkSkipped": self.park_skipped,
             }

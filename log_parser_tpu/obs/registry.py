@@ -200,6 +200,20 @@ METRICS = {
     "logparser_fleet_budget_mb": (
         "gauge", "Fleet-arbitrated budget share by backend and kind "
         "(line_cache/tenant)."),
+    # ---------------------------------------------------- pressure
+    "logparser_pressure_state": (
+        "gauge", "Resource-pressure ladder rung per resource "
+        "(0=ok, 1=soft, 2=hard)."),
+    "logparser_pressure_transitions_total": (
+        "counter", "Pressure ladder transitions by resource and "
+        "entered state."),
+    "logparser_pressure_degraded_writes_total": (
+        "counter", "WAL records absorbed by in-memory rings while disk "
+        "durability is degraded."),
+    "logparser_pressure_levers_total": (
+        "counter", "Memory-pressure lever pulls by lever name."),
+    "logparser_pressure_retry_total": (
+        "counter", "Retry-budget verdicts by outcome (allowed/shed)."),
 }
 
 # /trace/last payload block -> covering /metrics families. Hygiene
@@ -262,6 +276,11 @@ TRACE_BLOCKS = {
                     "logparser_replication_epoch",
                     "logparser_replication_total",
                     "logparser_replication_promotions_total"),
+    "pressure": ("logparser_pressure_state",
+                 "logparser_pressure_transitions_total",
+                 "logparser_pressure_degraded_writes_total",
+                 "logparser_pressure_levers_total",
+                 "logparser_pressure_retry_total"),
 }
 
 # request latency: sub-ms cache hits through multi-second cold compiles

@@ -77,6 +77,7 @@ SPANS = {
     "promote": "fenced failover: PROMOTE journaled, tenants activated",
     "demote": "stale-epoch step-down: DEMOTE journaled, registry fenced",
     "route": "router edge: tenant resolve, ring lookup, backend proxy",
+    "pressure": "resource-pressure ladder transition (attrs resource/state)",
 }
 
 
@@ -334,13 +335,40 @@ class SpanStore:
             }],
         }
 
-    def dump(self, path: str) -> str:
+    def dump(self, path: str) -> str | None:
         """Write the OTLP document to ``path`` (tmp + rename so a
-        crashed dump never leaves a torn file). Returns the path."""
+        crashed dump never leaves a torn file). Returns the path, or
+        None when the write was skipped atomically because the disk
+        ladder is hard — a span dump is the least valuable bytes in the
+        process and must never raise into a drain (runtime/pressure.py)."""
+        from log_parser_tpu.runtime import pressure
+
+        if pressure.writes_paused():
+            return None
         doc = self.export_otlp()
         tmp = f"{path}.tmp"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh, separators=(",", ":"))
-        os.replace(tmp, path)
+        try:
+            pressure.disk_write_guard("otlp_dump")
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError as exc:
+            pressure.note_write_error(exc, "otlp_dump")
+            raise
         return path
+
+    def trim_staging(self, capacity: int) -> int:
+        """Memory-pressure lever (runtime/pressure.py): shrink the
+        staging bound and evict oldest staged buckets down to it.
+        Evicted buckets count as ``staging_evicted`` — their traces
+        commit rootless-children-free, exactly like a staging overflow
+        today. Returns how many buckets were evicted."""
+        evicted = 0
+        with self._lock:
+            self.staging_capacity = max(1, int(capacity))
+            while len(self._staging) > self.staging_capacity:
+                self._staging.pop(next(iter(self._staging)))
+                self.staging_evicted += 1
+                evicted += 1
+        return evicted

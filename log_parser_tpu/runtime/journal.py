@@ -46,11 +46,12 @@ import struct
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Callable
 
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.golden.engine import GoldenFrequencyTracker
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
 
 log = logging.getLogger(__name__)
 
@@ -164,6 +165,13 @@ class FrequencyJournal:
         self._dirty = False
         self._since_snapshot = 0
         self._wedged = False  # a journal_torn fault leaves the torn frame final
+        # hard disk pressure: appends divert to this bounded ring — an
+        # observability echo of state the live tracker already holds, so
+        # overflow loses nothing rearm()'s barrier would not recover
+        self.degraded = False
+        self.degraded_records = 0
+        self.snapshot_skips = 0  # snapshots skipped while writes paused
+        self._degraded_ring: deque | None = None
 
         self.recovered_ages: dict[str, list[float]] = self._recover()
 
@@ -290,6 +298,12 @@ class FrequencyJournal:
             faults.fire("journal_torn")
         except faults.InjectedFault:
             torn = True
+        if self.degraded:
+            with self._mu:
+                if self._degraded_ring is not None:
+                    self._degraded_ring.append(payload_obj)
+                    self.degraded_records += 1
+            return
         payload = json.dumps(payload_obj, separators=(",", ":")).encode("utf-8")
         frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
         if torn:
@@ -297,6 +311,7 @@ class FrequencyJournal:
             # must stay FINAL for recovery to exercise the truncate path
             frame = frame[: _FRAME.size + max(0, len(payload) // 2)]
         try:
+            pressure.disk_write_guard("wal_append")
             with self._mu:
                 if torn:
                     self._wedged = True
@@ -311,6 +326,7 @@ class FrequencyJournal:
             self.write_errors += 1
             self.healthy = False
             log.error("journal append failed: %s", exc)
+            pressure.note_write_error(exc, "wal_append")
 
     # --------------------------------------------------------- maintenance
 
@@ -346,6 +362,7 @@ class FrequencyJournal:
                 fp = self._fp
                 if fp is None or not self._dirty:
                     return
+                pressure.disk_write_guard("fsync")
                 fp.flush()
                 os.fsync(fp.fileno())
                 self._dirty = False
@@ -354,6 +371,7 @@ class FrequencyJournal:
             self.write_errors += 1
             self.healthy = False
             log.error("journal fsync failed: %s", exc)
+            pressure.note_write_error(exc, "fsync")
 
     def wal_feed(self, offset: int, max_bytes: int = 1 << 20) -> tuple[int, int, bytes]:
         """Read raw frame bytes for the replication sender.
@@ -390,14 +408,20 @@ class FrequencyJournal:
     def snapshot_now(self) -> bool:
         """Write an atomic snapshot of the live tracker and truncate the
         journal. An injected/organic failure aborts WITHOUT truncating —
-        the journal keeps the full tail, nothing is lost."""
+        the journal keeps the full tail, nothing is lost. Under hard
+        disk pressure the writer skips atomically instead of raising
+        (rearm() calls back in once the ladder clears)."""
         source, lock = self._source, self._source_lock
         if source is None or lock is None or self._fp is None:
+            return False
+        if pressure.writes_paused():
+            self.snapshot_skips += 1
             return False
         with lock:
             ages = source()
         try:
             faults.fire("snapshot")
+            pressure.disk_write_guard("snapshot_rotate")
             doc = {
                 "version": 1,
                 "epoch": self.epoch + 1,
@@ -411,6 +435,8 @@ class FrequencyJournal:
         except (faults.InjectedFault, OSError, ValueError) as exc:
             self.snapshot_errors += 1
             log.error("snapshot aborted (journal NOT truncated): %s", exc)
+            if isinstance(exc, OSError):
+                pressure.note_write_error(exc, "snapshot_rotate")
             return False
         # snapshot + sidecar durable -> the journal tail is now redundant
         try:
@@ -429,7 +455,47 @@ class FrequencyJournal:
             self.write_errors += 1
             self.healthy = False
             log.error("journal truncate failed: %s", exc)
+            pressure.note_write_error(exc, "snapshot_rotate")
             return False
+        return True
+
+    # ------------------------------------------------------ disk pressure
+
+    def degrade(self) -> None:
+        """Hard disk pressure: divert appends to a bounded in-memory
+        ring and surface unhealthy. The ring is an *echo* — the live
+        tracker still holds every mutation — so the only real loss is
+        crash-durability of post-degrade mutations, which is exactly
+        what the ``durability: degraded`` stamp announces."""
+        with self._mu:
+            if self.degraded:
+                return
+            self.degraded = True
+            self._degraded_ring = deque(maxlen=pressure.DEGRADED_RING_RECORDS)
+            self.healthy = False
+        log.warning(
+            "journal %s degraded: appends divert to a %d-record ring",
+            self._wal_path, pressure.DEGRADED_RING_RECORDS,
+        )
+
+    def rearm(self) -> bool:
+        """Recovery barrier after pressure clears: one clean snapshot of
+        the live tracker (which the diverted ring records merely echoed)
+        plus the WAL truncate re-establishes fsync'd journaling — a
+        crash after this replays bit-identically to an unpressured run.
+        The ring is dropped only on success; a failed snapshot leaves
+        the journal degraded for the next poll to retry."""
+        if not self.degraded:
+            return True
+        if not self.snapshot_now():
+            return False
+        with self._mu:
+            self.degraded = False
+            self._degraded_ring = None
+            if not self._wedged:
+                self.healthy = True
+        log.warning("journal %s re-armed: fsync'd journaling restored",
+                    self._wal_path)
         return True
 
     # ------------------------------------------------------------ shutdown
@@ -483,6 +549,9 @@ class FrequencyJournal:
                 "snapshotErrors": self.snapshot_errors,
                 "tornTails": self.torn_tails,
                 "snapshotCorrupt": self.snapshot_corrupt,
+                "degraded": self.degraded,
+                "degradedRecords": self.degraded_records,
+                "snapshotSkips": self.snapshot_skips,
             }
 
 

@@ -186,6 +186,27 @@ class KeyInterner:
         w[: self._n] = self._words[: self._n]
         self._words = w
 
+    def evict_half(self) -> int:
+        """Memory-pressure lever (runtime/pressure.py): drop the
+        least-recently-used half of the *current* entries, regardless of
+        table fullness. Returns how many entries were dropped. Safe at
+        any time — a dropped digest is recomputed on next touch."""
+        with self.lock:
+            keep_n = self._n // 2
+            if self._n <= 1 or keep_n < 1:
+                return 0
+            dropped = self._n - keep_n
+            keep = np.argpartition(self._stamp[: self._n], dropped)[dropped:]
+            self.evictions += dropped
+            for name in ("_probes", "_lengths", "_stamp", "_digests"):
+                arr = getattr(self, name)
+                arr[:keep_n] = arr[keep]
+                setattr(self, name, arr)
+            self._words[:keep_n] = self._words[keep]
+            self._n = keep_n
+            self._sorted = None
+            return dropped
+
     def _evict_half(self) -> None:
         """Table full: keep the most-recently-used half. Coarser than a
         per-entry LRU but keeps eviction a single vectorized compaction

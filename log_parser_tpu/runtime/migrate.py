@@ -82,7 +82,7 @@ import threading
 import time
 import zlib
 
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.runtime.journal import _atomic_write
 from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
 
@@ -191,6 +191,88 @@ class MigrationJournal:
             except OSError:  # pragma: no cover - quarantine is best-effort
                 log.exception("failed to quarantine torn migration journal")
         return out
+
+
+def _frame_records(records: list[dict]) -> bytes:
+    out = []
+    for payload in records:
+        raw = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        out.append(_FRAME.pack(len(raw), zlib.crc32(raw)) + raw)
+    return b"".join(out)
+
+
+def compact_journal(path: str) -> bool:
+    """Truncate ONE terminal migration journal past its decision records.
+
+    Migration journals are append-only and never expire (forwards live
+    in them, nowhere else), so a long-lived node accretes every record
+    of every migration it ever ran. Past the terminal record only the
+    *decision* matters: a source journal compacts to
+    ``[meta, cutover, complete]`` (or ``[meta, abort]``), a target
+    journal to ``[meta, applied]`` (or ``[meta, discard]``) — exactly
+    the records :meth:`Migrator.recover` consults. Non-terminal
+    journals (a migration still running, or one recover() must still
+    converge) are left untouched, which also keeps compaction safe
+    against the open ``_dst_journals`` handles: only *closed* journals
+    carry a terminal record.
+
+    The rewrite is atomic (tmp + fsync + ``os.replace``) and preserves
+    the file's mtime — recover() arbitrates ownership verdicts between
+    a tenant's src and dst journals BY mtime, so compaction must not
+    promote a stale verdict to newest. A crash before the replace
+    leaves the original intact (the ``.compact`` tmp is swept on the
+    next pass); a crash after leaves the already-valid compacted form.
+    """
+    records = MigrationJournal.replay(path)
+    if len(records) < 2:
+        return False
+    kinds = [r.get("k") for r in records]
+    meta = records[0]
+    if path.endswith(".src.wal"):
+        terminal = next(
+            (k for k in ("complete", "abort") if k in kinds), None
+        )
+        if terminal is None:
+            return False
+        keep = [meta]
+        if terminal == "complete":
+            cutover = next(
+                (r for r in records if r.get("k") == "cutover"), None
+            )
+            if cutover is not None and cutover is not meta:
+                keep.append(cutover)
+        keep.append(next(r for r in records if r.get("k") == terminal))
+    elif path.endswith(".dst.wal"):
+        terminal = next(
+            (k for k in ("applied", "discard") if k in kinds), None
+        )
+        if terminal is None:
+            return False
+        keep = [meta, next(r for r in records if r.get("k") == terminal)]
+    else:
+        return False
+    if len(keep) >= len(records):
+        return False  # already compact — idempotent
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    tmp = path + ".compact"
+    with open(tmp, "wb") as f:
+        f.write(_frame_records(keep))
+        f.flush()
+        os.fsync(f.fileno())
+    os.utime(tmp, (st.st_atime, st.st_mtime))
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - platform-specific directory fsync
+        pass
+    return True
 
 
 def canonical_bundle_bytes(bundle: dict) -> bytes:
@@ -381,6 +463,7 @@ class Migrator:
         self.recovered_discarded = 0
         self.sessions_moved = 0
         self.sessions_closed = 0
+        self.compacted = 0  # terminal journals truncated (boot + soft pressure)
         obs = getattr(registry.default_engine, "obs", None)
         if obs is not None:
             obs.add_stats_collector("migrate", self.stats, METRIC_SAMPLES)
@@ -621,7 +704,15 @@ class Migrator:
             "sessions": carries,
         }
         raw = canonical_bundle_bytes(bundle)
-        _atomic_write(self._bundle_path(mid), raw)
+        try:
+            pressure.disk_write_guard("bundle_write")
+            _atomic_write(self._bundle_path(mid), raw)
+        except OSError as exc:
+            # contained by migrate(): the protocol seals ABORT and the
+            # tenant stays owned here — a full disk refuses the move, it
+            # never strands the tenant half-exported
+            pressure.note_write_error(exc, "bundle_write")
+            raise
         return bundle, hashlib.sha256(raw).hexdigest()
 
     def _hand_off_sessions(self, tenant_id, eng, target) -> tuple[int, int]:
@@ -684,7 +775,12 @@ class Migrator:
                     f"bundle hash mismatch: want {sha[:12]}…, got {have[:12]}…"
                 )
             self._verify_bank(tenant_id, bundle.get("libraryKey"))
-            _atomic_write(self._bundle_path(mid), raw)
+            try:
+                pressure.disk_write_guard("bundle_write")
+                _atomic_write(self._bundle_path(mid), raw)
+            except OSError as exc:
+                pressure.note_write_error(exc, "bundle_write")
+                raise
             jr.append("staged", sha=sha)
             self._crash("staged")
         except MigrationCrash:
@@ -815,6 +911,40 @@ class Migrator:
                         )
         finally:
             ctx.unpin()
+
+    # --------------------------------------------------------- compaction
+
+    def compact(self) -> int:
+        """Truncate every terminal migration journal past its decision
+        records (see :func:`compact_journal`) and sweep stale ``.compact``
+        tmps from an interrupted pass. Run at boot (after recover) and
+        on entry into soft disk pressure; returns how many journals
+        shrank."""
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return 0
+        n = 0
+        for name in names:
+            path = os.path.join(self.dir, name)
+            if name.endswith(".compact"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if not name.endswith((".src.wal", ".dst.wal")):
+                continue
+            try:
+                if compact_journal(path):
+                    n += 1
+            except OSError:
+                log.exception("compacting migration journal %s failed", path)
+        if n:
+            with self._lock:
+                self.compacted += n
+            log.info("compacted %d terminal migration journal(s)", n)
+        return n
 
     # ----------------------------------------------------------- recovery
 
@@ -1019,6 +1149,7 @@ class Migrator:
             "recoveredDiscarded": self.recovered_discarded,
             "sessionsMoved": self.sessions_moved,
             "sessionsClosed": self.sessions_closed,
+            "compacted": self.compacted,
             "active": active,
             "stagedNow": staged_now,
             "forwards": self.registry.forward_count(),
@@ -1178,11 +1309,38 @@ class DrainSupervisor:
             log.exception("drain: closing tenant %r failed", tid)
 
     def finalize_all(self) -> dict:
-        """Multi-tenant shutdown finalization (the satellite-2 fix): fold
-        the WAL and flush the batcher of EVERY still-resident tenant,
-        flush the default engine's journal and batcher, and dump the
-        OTLP span file — not just the default engine's state."""
+        """Multi-tenant shutdown finalization: fold the WAL and flush
+        the batcher of EVERY still-resident tenant, flush the default
+        engine's journal and batcher, and dump the OTLP span file — not
+        just the default engine's state.
+
+        Every writer here can hit a full disk, and none of them may
+        mask the drain outcome: each is contained per-writer (logged
+        once), the drain completes regardless, and the summary carries
+        an accurate ``writerErrors``/``writersSkipped`` tally so the
+        exit status can be nonzero-but-honest instead of an exception
+        half-way through finalization."""
         folded: list[str] = []
+        errors = 0
+        skipped = 0
+
+        def _fold(journal, who: str) -> None:
+            nonlocal errors, skipped
+            if journal is None:
+                return
+            if pressure.writes_paused():
+                # hard pressure: the skip is the contract — the journal
+                # is degraded and rearm() owns the recovery barrier
+                skipped += 1
+                return
+            try:
+                if not journal.snapshot_now():
+                    errors += 1  # contained inside; the WAL keeps its tail
+                journal.flush()
+            except Exception:
+                errors += 1
+                log.exception("drain: journal fold for %s failed", who)
+
         for tid in self.registry.resident():
             if tid == DEFAULT_TENANT:
                 continue
@@ -1194,24 +1352,27 @@ class DrainSupervisor:
                 try:
                     eng.batcher.flush_now()
                 except Exception:
+                    errors += 1
                     log.exception("drain: batcher flush for %r failed", tid)
-            journal = getattr(eng, "journal", None)
-            if journal is not None:
-                journal.snapshot_now()
-                journal.flush()
+            _fold(getattr(eng, "journal", None), repr(tid))
             folded.append(tid)
         default_eng = self.registry.default_engine
-        journal = getattr(default_eng, "journal", None)
-        if journal is not None:
-            journal.snapshot_now()
-            journal.flush()
+        _fold(getattr(default_eng, "journal", None), "default engine")
         obs = getattr(default_eng, "obs", None)
         if obs is not None and self.span_dump_path:
             try:
-                obs.spans.dump(self.span_dump_path)
-            except OSError:
+                if not obs.spans.dump(self.span_dump_path):
+                    skipped += 1
+            except OSError as exc:
+                errors += 1
+                pressure.note_write_error(exc, "otlp_dump")
                 log.exception("drain: span dump failed")
-        return {"folded": folded, "spanDump": self.span_dump_path}
+        return {
+            "folded": folded,
+            "spanDump": self.span_dump_path,
+            "writerErrors": errors,
+            "writersSkipped": skipped,
+        }
 
     # --------------------------------------------------------- health watch
 

@@ -64,9 +64,9 @@ import urllib.request
 import zlib
 from typing import Callable
 
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.runtime.journal import _FRAME, _MAX_PAYLOAD, apply_record
-from log_parser_tpu.runtime.migrate import MigrationJournal
+from log_parser_tpu.runtime.migrate import MigrationJournal, _frame_records
 from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
 
 log = logging.getLogger(__name__)
@@ -249,9 +249,25 @@ class ReplicaSender:
         rep = self.replicator
         if rep.role != "primary":
             return "standby"
+        if pressure.durability_degraded():
+            # local hard disk pressure: our own WAL is a degraded ring,
+            # so there is nothing trustworthy to ship — pause until the
+            # ladder re-arms (the next pump after recovery reseeds from
+            # a fresh barrier if the WAL rotated underneath us)
+            return "paused"
         now = rep.clock()
         if now < self._next_try:
             return "backoff"
+        if self._failures > 0:
+            # this attempt is a retry after a failure: it costs a retry
+            # token. An exhausted budget sheds for a full backoff cap
+            # instead of joining a synchronized retry storm.
+            budget = pressure.retry_budget()
+            dest = f"replica:{getattr(self.target, 'url', '?')}"
+            if budget is not None and not budget.allow(dest):
+                self.last_error = "retry budget exhausted"
+                self._next_try = now + _BACKOFF_CAP_S
+                return "shed"
         try:
             outcome = self._seed() if not self.seeded else self._ship()
         except faults.InjectedFault as exc:
@@ -261,6 +277,11 @@ class ReplicaSender:
         if outcome in ("seeded", "shipped", "idle", "resync"):
             self._failures = 0
             self._next_try = 0.0
+            budget = pressure.retry_budget()
+            if budget is not None:
+                budget.note_request(
+                    f"replica:{getattr(self.target, 'url', '?')}"
+                )
         return outcome
 
     def _note_error(self, reason: str, now: float) -> str:
@@ -481,6 +502,7 @@ class Replicator:
         self.promotions = 0
         self.demotions = 0
         self.adoptions = 0
+        self.epoch_compactions = 0
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
         obs = getattr(registry.default_engine, "obs", None)
@@ -535,6 +557,16 @@ class Replicator:
             raise ReplicationError(
                 f"injected apply fault: {exc}", status=503, epoch=self.epoch
             ) from exc
+        if pressure.durability_degraded():
+            # hard disk pressure on the standby: applying would claim
+            # durability this side cannot provide (the re-journal would
+            # divert to a ring). Distinct 409 reason; the sender backs
+            # off and re-sends once we recover — acked never moves.
+            raise ReplicationError(
+                "durability degraded: standby cannot journal feeds",
+                status=409, epoch=self.epoch, reason="degraded",
+                location=self.node_url,
+            )
         with self._lock:
             if feed_epoch < self.epoch:
                 raise ReplicationError(
@@ -646,8 +678,19 @@ class Replicator:
             ) from exc
         try:
             eng = ctx.engine
+            pressure.disk_write_guard("replica_rejournal")
             with eng.state_lock:
                 eng.frequency.restore(st.ages)
+        except OSError as exc:
+            # the re-journal write path refused (ENOSPC): 503 so the
+            # sender re-sends later. st.ages keeps the batch; the next
+            # successful _warm_apply restores the FULL state (restore is
+            # a barrier), so nothing is lost by the missed round.
+            pressure.note_write_error(exc, "replica_rejournal")
+            raise ReplicationError(
+                f"standby re-journal failed: {exc}", status=503,
+                epoch=self.epoch,
+            ) from exc
         finally:
             ctx.unpin()
 
@@ -818,6 +861,70 @@ class Replicator:
         log.info("replication recover: %s", summary)
         return summary
 
+    def compact_epoch_journal(self) -> int:
+        """Truncate ``_replica/epoch.wal`` past its terminal state.
+
+        The protocol journal grows by one record per epoch adoption and
+        per promote/demote, forever. recover() only needs three facts —
+        the max epoch, the LAST promote/demote record (role + peer
+        location), and the union of every record's tenant list — so the
+        whole history compacts to ONE record carrying exactly those,
+        and replaying it converges to the identical role/epoch/tenants.
+        Runs at boot and on the soft-pressure trigger; returns 1 when
+        the journal shrank. The open append handle is closed around an
+        atomic rewrite (tmp + fsync + ``os.replace``) and reopened, all
+        under ``_lock`` so no append races the swap; a crash before the
+        replace leaves the original, a crash after leaves the valid
+        compacted form.
+        """
+        with self._lock:
+            path = self._journal.path
+            records = MigrationJournal.replay(path)
+            if len(records) <= 1:
+                return 0
+            max_epoch = self.epoch
+            role_rec: dict | None = None
+            tenants: set[str] = set()
+            for rec in records:
+                try:
+                    e = int(rec.get("epoch", 0))
+                except (TypeError, ValueError):
+                    continue
+                max_epoch = max(max_epoch, e)
+                if rec.get("k") in ("promote", "demote"):
+                    role_rec = rec
+                for tid in rec.get("tenants") or ():
+                    tenants.add(str(tid))
+            terminal: dict = {
+                "k": role_rec.get("k") if role_rec else "epoch",
+                "epoch": max_epoch,
+                "tenants": sorted(tenants),
+            }
+            if role_rec is not None:
+                if role_rec.get("location"):
+                    terminal["location"] = role_rec["location"]
+                if role_rec.get("reason"):
+                    terminal["reason"] = role_rec["reason"]
+            self._journal.close()
+            tmp = path + ".compact"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(_frame_records([terminal]))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError:
+                log.exception("epoch journal compaction failed")
+                self._journal = MigrationJournal(path)
+                return 0
+            self._journal = MigrationJournal(path)
+            self.epoch_compactions += 1
+            log.info(
+                "compacted epoch journal: %d record(s) -> 1 (epoch %d)",
+                len(records), max_epoch,
+            )
+            return 1
+
     # ----------------------------------------------------------- lifecycle
 
     def start(self) -> None:
@@ -892,6 +999,7 @@ class Replicator:
                 "promotions": self.promotions,
                 "demotions": self.demotions,
                 "adoptions": self.adoptions,
+                "epochCompactions": self.epoch_compactions,
                 "senders": senders,
                 "feeds": feeds,
             }

@@ -607,6 +607,27 @@ class TenantRegistry:
             self.budget_bytes = int(float(budget_mb) * 1024 * 1024)
             self._evict_over_budget()
 
+    def shed_idle(self, frac: float = 0.5) -> int:
+        """Memory-pressure lever (runtime/pressure.py): LRU-evict idle
+        non-default tenants down to ``frac`` of their *current* resident
+        bank bytes, without touching the configured budget — pressure is
+        transient, the operator's budget is policy. Returns how many
+        tenants were evicted; busy tenants are skipped exactly as in
+        budget eviction."""
+        with self._lock:
+            resident = self._resident_bytes()
+            target = int(resident * max(0.0, min(1.0, float(frac))))
+            if resident <= 0 or target <= 0:
+                return 0
+            before = self.evicted
+            saved = self.budget_bytes
+            self.budget_bytes = target
+            try:
+                self._evict_over_budget()
+            finally:
+                self.budget_bytes = saved
+            return self.evicted - before
+
     def _evict_over_budget(self) -> None:
         """LRU-evict idle non-default tenants until resident bank bytes
         fit the budget. Busy tenants are skipped — an in-flight request

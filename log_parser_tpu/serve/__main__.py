@@ -368,6 +368,37 @@ def main(argv: list[str] | None = None) -> int:
         help="journal records between background snapshots; a snapshot "
         "truncates the WAL (LOG_PARSER_TPU_SNAPSHOT_EVERY)",
     )
+    # resource-pressure plane (docs/OPS.md "Resource exhaustion")
+    parser.add_argument(
+        "--disk-soft-mb", type=float, default=None, metavar="MB",
+        help="free-byte soft watermark over --state-dir: below it every "
+        "journal snapshots+truncates and the migration/epoch journals "
+        "compact (runtime/pressure.py; 0 disables; "
+        "LOG_PARSER_TPU_DISK_SOFT_MB)",
+    )
+    parser.add_argument(
+        "--disk-hard-mb", type=float, default=None, metavar="MB",
+        help="free-byte hard watermark: below it journals degrade to a "
+        "bounded in-memory ring and responses carry 'durability: "
+        "degraded' — the serving path keeps answering 200s (0 disables; "
+        "LOG_PARSER_TPU_DISK_HARD_MB)",
+    )
+    parser.add_argument(
+        "--mem-soft-mb", type=float, default=None, metavar="MB",
+        help="RSS soft watermark: over it the memory levers apply one "
+        "per poll in severity order (line-cache shrink, interner evict, "
+        "tenant eviction, span staging trim, miner tap close), released "
+        "in reverse with hysteresis (0 disables; "
+        "LOG_PARSER_TPU_MEM_SOFT_MB)",
+    )
+    parser.add_argument(
+        "--retry-budget", type=float, default=None, metavar="RATIO",
+        help="retry-budget ratio shared per destination: sustained "
+        "retries (shim reconnects, router re-routes, replica sender "
+        "backoff) are capped at this fraction of recent first attempts; "
+        "exhausted budgets shed 'retry budget exhausted'; 0 disables "
+        "(default 0.1; LOG_PARSER_TPU_RETRY_BUDGET)",
+    )
     parser.add_argument(
         "--watch-patterns", type=float, default=None, metavar="SECONDS",
         help="poll the pattern directory at this interval and hot-reload "
@@ -468,6 +499,10 @@ def main(argv: list[str] | None = None) -> int:
         (args.state_dir, "LOG_PARSER_TPU_STATE_DIR"),
         (args.journal_fsync_ms, "LOG_PARSER_TPU_JOURNAL_FSYNC_MS"),
         (args.snapshot_every, "LOG_PARSER_TPU_SNAPSHOT_EVERY"),
+        (args.disk_soft_mb, "LOG_PARSER_TPU_DISK_SOFT_MB"),
+        (args.disk_hard_mb, "LOG_PARSER_TPU_DISK_HARD_MB"),
+        (args.mem_soft_mb, "LOG_PARSER_TPU_MEM_SOFT_MB"),
+        (args.retry_budget, "LOG_PARSER_TPU_RETRY_BUDGET"),
         (args.watch_patterns, "LOG_PARSER_TPU_WATCH_PATTERNS"),
         (args.lint_patterns, "LOG_PARSER_TPU_LINT_PATTERNS"),
         (args.compile_cache_dir, "LOG_PARSER_TPU_XLA_CACHE"),
@@ -613,6 +648,30 @@ def main(argv: list[str] | None = None) -> int:
         engine.follower_loop()
         return 0
 
+    # resource-pressure plane: one controller per process, installed
+    # BEFORE the journal opens so the very first append is already
+    # guarded; journals/levers/compactors attach below as their
+    # subsystems come up (runtime/pressure.py, docs/OPS.md "Resource
+    # exhaustion")
+    from log_parser_tpu.runtime import pressure
+
+    pressure_ctl = pressure.PressureController(
+        os.environ.get("LOG_PARSER_TPU_STATE_DIR") or None,
+        disk_soft_mb=float(
+            os.environ.get("LOG_PARSER_TPU_DISK_SOFT_MB", "0") or 0
+        ),
+        disk_hard_mb=float(
+            os.environ.get("LOG_PARSER_TPU_DISK_HARD_MB", "0") or 0
+        ),
+        mem_soft_mb=float(
+            os.environ.get("LOG_PARSER_TPU_MEM_SOFT_MB", "0") or 0
+        ),
+        retry_ratio=float(
+            os.environ.get("LOG_PARSER_TPU_RETRY_BUDGET", "0.1") or 0
+        ),
+    )
+    pressure.install(pressure_ctl)
+
     # durable frequency state: recover + journal under --state-dir.
     # Followers never reach this point (follower_loop above), so in
     # distributed mode only the coordinator journals — its tracker is the
@@ -636,6 +695,7 @@ def main(argv: list[str] | None = None) -> int:
             journal.replayed,
             ", torn tail quarantined" if journal.torn_tails else "",
         )
+        pressure_ctl.register_journal(journal)
         # on-demand device profiling (POST /debug/profile) captures into a
         # state-dir subdirectory; without --state-dir the route answers 503
         engine.obs.profiler.configure(os.path.join(state_dir, "profiles"))
@@ -684,6 +744,7 @@ def main(argv: list[str] | None = None) -> int:
                 miner_sample,
                 miner_support,
             )
+            pressure_ctl.register_miner(engine.miner)
 
     # tenant registry: X-Tenant (HTTP) / x-tenant (gRPC) / method@tenant
     # (framed shim) resolve through one registry; each non-default tenant
@@ -739,7 +800,7 @@ def main(argv: list[str] | None = None) -> int:
             # namespaced WAL/snapshot dir: tenants/<id> under the default
             # tenant's state dir, so recovery is per-tenant and a tenant
             # eviction's final snapshot lands where its rebuild looks
-            eng.attach_journal(
+            tenant_journal = eng.attach_journal(
                 os.path.join(state_dir, "tenants", tenant_id),
                 fsync_ms=float(
                     os.environ.get("LOG_PARSER_TPU_JOURNAL_FSYNC_MS", "50")
@@ -748,6 +809,10 @@ def main(argv: list[str] | None = None) -> int:
                     os.environ.get("LOG_PARSER_TPU_SNAPSHOT_EVERY", "512")
                 ),
             )
+            if tenant_journal is not None:
+                # rides the same ladder as the default WAL: soft
+                # snapshots it, hard degrades it to its ring
+                pressure_ctl.register_journal(tenant_journal)
             rep = replication_holder["rep"]
             if rep is not None:
                 # primary side: this tenant's WAL starts shipping to the
@@ -852,6 +917,9 @@ def main(argv: list[str] | None = None) -> int:
                 len(recovered["discarded"]),
                 len(recovered["pending"]),
             )
+        # bounded growth: terminal migration journals compact at boot
+        # and on every entry into soft disk pressure
+        pressure_ctl.register_compactor("migration", migrator.compact)
     drain_supervisor = DrainSupervisor(
         tenants,
         migrator,
@@ -931,6 +999,11 @@ def main(argv: list[str] | None = None) -> int:
             replicator.attach_sender(DEFAULT_TENANT, engine)
         if replica_of_url and failover_after > 0:
             replicator.arm_failover(replica_of_url, after_s=failover_after)
+        # epoch WAL compaction: a long promote/demote history folds to
+        # one terminal record at boot and on soft disk pressure
+        pressure_ctl.register_compactor(
+            "epoch", replicator.compact_epoch_journal
+        )
         replicator.start()
         log.info(
             "Replication role %s at epoch %d (%d protocol record(s) "
@@ -970,6 +1043,72 @@ def main(argv: list[str] | None = None) -> int:
         # follower liveness probe + degraded-mesh readmission; serializes
         # with request broadcasts on the engine's state_lock
         engine.start_health_loop()
+
+    # memory levers in severity order: cheapest/least-visible reclaim
+    # first, each applied one poll apart while RSS stays over the
+    # watermark, released in reverse once it clears (hysteresis)
+    saved_knobs: dict = {}
+
+    def _lever_line_cache() -> None:
+        cache = getattr(engine, "line_cache", None)
+        if cache is None:
+            return
+        saved_knobs["line_cache_bytes"] = cache.budget_bytes
+        tenants.set_line_cache_budget(cache.budget_bytes // 2)
+
+    def _release_line_cache() -> None:
+        if "line_cache_bytes" in saved_knobs:
+            tenants.set_line_cache_budget(
+                saved_knobs.pop("line_cache_bytes")
+            )
+
+    def _lever_interner() -> None:
+        interner = getattr(engine, "key_interner", None)
+        if interner is not None:
+            interner.evict_half()
+
+    def _lever_span_staging() -> None:
+        spans = engine.obs.spans
+        saved_knobs["staging_capacity"] = spans.staging_capacity
+        spans.trim_staging(spans.staging_capacity // 2)
+
+    def _release_span_staging() -> None:
+        if "staging_capacity" in saved_knobs:
+            engine.obs.spans.staging_capacity = saved_knobs.pop(
+                "staging_capacity"
+            )
+
+    def _lever_miner_tap() -> None:
+        m = getattr(engine, "miner", None)
+        if m is not None:
+            # the tap is the miner's only feed; closing it stops new
+            # miss buffering (parked candidates stay reviewable)
+            m.tap.close()
+
+    pressure_ctl.add_lever(
+        "line_cache", _lever_line_cache, _release_line_cache
+    )
+    pressure_ctl.add_lever("interner", _lever_interner)
+    pressure_ctl.add_lever("tenants", lambda: tenants.shed_idle(0.5))
+    pressure_ctl.add_lever(
+        "span_staging", _lever_span_staging, _release_span_staging
+    )
+    pressure_ctl.add_lever("miner_tap", _lever_miner_tap)
+    pressure_ctl.bind_obs(engine.obs)
+    pressure_ctl.bootstrap()
+    pressure_ctl.start()
+    if pressure_ctl.disk_soft_bytes or pressure_ctl.disk_hard_bytes or (
+        pressure_ctl.mem_soft_bytes
+    ):
+        log.info(
+            "Pressure plane armed: disk soft/hard %.0f/%.0f MB free, "
+            "mem soft %.0f MB, retry budget %s",
+            pressure_ctl.disk_soft_bytes / 2**20,
+            pressure_ctl.disk_hard_bytes / 2**20,
+            pressure_ctl.mem_soft_bytes / 2**20,
+            "%.0f%%" % (pressure_ctl.retry.ratio * 100)
+            if pressure_ctl.retry.enabled else "off",
+        )
     log.info("Serving POST /parse on %s:%d", args.host, args.port)
     try:
         server.serve_forever()
@@ -1009,10 +1148,19 @@ def main(argv: list[str] | None = None) -> int:
             journal.close()
         if engine.obs.span_dump_path:
             try:
-                engine.obs.spans.dump(engine.obs.span_dump_path)
-                log.info("Span store dumped to %s", engine.obs.span_dump_path)
+                if engine.obs.spans.dump(engine.obs.span_dump_path):
+                    log.info(
+                        "Span store dumped to %s", engine.obs.span_dump_path
+                    )
+                else:
+                    # hard disk pressure: the dump skipped atomically —
+                    # the least valuable bytes lose first, the drain
+                    # completes either way
+                    log.warning("span dump skipped: durability degraded")
             except OSError:
                 log.exception("span dump failed")
+        pressure_ctl.stop()
+        pressure.install(None)
         if args.coordinator:
             # under the analyze lock: a daemon handler thread may still be
             # mid-broadcast inside analyze(); interleaving the shutdown
@@ -1043,10 +1191,38 @@ def _run_router(args, log) -> int:
         log.error("%s", exc)
         return 2
 
+    # the router rides the same pressure plane as a backend: the retry
+    # budget bounds its re-route storms, and a --state-dir gives its
+    # override journal a home plus disk watermarks over it
+    from log_parser_tpu.runtime import faults, pressure
+
+    faults.ensure_env()
+    state_dir = os.environ.get("LOG_PARSER_TPU_STATE_DIR") or None
+    pressure_ctl = pressure.PressureController(
+        state_dir,
+        disk_soft_mb=float(
+            os.environ.get("LOG_PARSER_TPU_DISK_SOFT_MB", "0") or 0
+        ),
+        disk_hard_mb=float(
+            os.environ.get("LOG_PARSER_TPU_DISK_HARD_MB", "0") or 0
+        ),
+        mem_soft_mb=float(
+            os.environ.get("LOG_PARSER_TPU_MEM_SOFT_MB", "0") or 0
+        ),
+        retry_ratio=float(
+            os.environ.get("LOG_PARSER_TPU_RETRY_BUDGET", "0.1") or 0
+        ),
+    )
+    pressure.install(pressure_ctl)
+
     router = make_router(
         args.host, args.port, backends,
         vnodes=args.fleet_vnodes, down_after=args.fleet_down_after,
+        state_dir=state_dir,
     )
+    pressure_ctl.bind_obs(router.obs)
+    pressure_ctl.bootstrap()
+    pressure_ctl.start()
 
     budget = None
     if args.fleet_cache_mb > 0 or args.fleet_tenant_budget_mb > 0:
@@ -1119,7 +1295,11 @@ def _run_router(args, log) -> int:
         if framed is not None:
             framed.shutdown()
             framed.server_close()
+        if router.override_journal is not None:
+            router.override_journal.close()
         router.server_close()
+        pressure_ctl.stop()
+        pressure.install(None)
     return 0
 
 

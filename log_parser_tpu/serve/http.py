@@ -46,7 +46,7 @@ from log_parser_tpu import native
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.obs import SPANS
 from log_parser_tpu.obs.profiler import ProfilerBusy, ProfilerUnavailable
-from log_parser_tpu.runtime import faults
+from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.utils import xlacache
 from log_parser_tpu.runtime.engine import AnalysisEngine
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
@@ -755,6 +755,15 @@ class _Handler(BaseHTTPRequestHandler):
                     "name": "replication", "status": "STANDBY",
                     "epoch": rep.epoch,
                 })
+            ctl = pressure.current()
+            if ctl is not None:
+                pc = ctl.health_check()
+                if pc["status"] != "UP":
+                    # resource pressure (disk/memory ladder off ``ok``):
+                    # still a 200 — the ladder's whole contract is that
+                    # the serving path keeps answering while degraded
+                    # (docs/OPS.md "Resource exhaustion")
+                    checks.append(pc)
             slo = self.server.obs.slo.health()
             if slo is not None and slo["status"] != "UP":
                 # SLO burn: an objective is spending its error budget
@@ -887,6 +896,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # replication channel + failover position (docs/OPS.md
                 # "Warm-standby replication")
                 payload["replication"] = replicator.stats()
+            ctl = pressure.current()
+            if ctl is not None:
+                # resource-pressure ladder, levers and retry budget
+                # (docs/OPS.md "Resource exhaustion")
+                payload["pressure"] = ctl.stats()
             fault_stats = faults.stats()
             if fault_stats is not None:
                 payload["faults"] = fault_stats
@@ -1233,7 +1247,12 @@ class _Handler(BaseHTTPRequestHandler):
             data.pod_name,
             result.summary.significant_events if result.summary else 0,
         )
-        reply(200, json.dumps(result.to_dict(drop_none=True)).encode())
+        # pressure.stamp marks the envelope ``durability: degraded``
+        # while the disk ladder is hard — its absence is a promise that
+        # this response's frequency updates ride an fsync'd journal
+        reply(200, json.dumps(
+            pressure.stamp(result.to_dict(drop_none=True))
+        ).encode())
 
 
 def make_server(

@@ -36,6 +36,7 @@ import re
 import socket
 import time
 
+from log_parser_tpu.runtime import pressure
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import read_frame, write_frame
 
@@ -77,6 +78,7 @@ class ShimClient:
         max_hops: int = 3,
         forward_resolver=None,
         sleep=time.sleep,
+        retry_budget: pressure.RetryBudget | None = None,
     ):
         self.host = host
         self.port = port
@@ -90,6 +92,10 @@ class ShimClient:
             lambda loc: default_forward_resolver(loc, self.port)
         )
         self._sleep = sleep
+        # explicit budget, else whatever controller the process installed
+        # (runtime/pressure.py); None from both means retries are free
+        self._retry_budget = retry_budget
+        self.sheds = 0  # retries refused by the budget
         self.last_attempts = 0  # attempts consumed by the most recent call
         self.last_hops = 0  # forwards followed by the most recent call
         self.sock: socket.socket | None = None
@@ -108,6 +114,22 @@ class ShimClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock = sock
 
+    def _budget(self) -> pressure.RetryBudget | None:
+        return (
+            self._retry_budget
+            if self._retry_budget is not None
+            else pressure.retry_budget()
+        )
+
+    def _retry_allowed(self) -> bool:
+        """Spend one retry token toward the current address; False
+        means the budget is dry and the retry must shed."""
+        budget = self._budget()
+        if budget is None or budget.allow(f"shim:{self.host}:{self.port}"):
+            return True
+        self.sheds += 1
+        return False
+
     def _connect_with_retry(self) -> None:
         for attempt in range(self.retries + 1):
             try:
@@ -115,6 +137,12 @@ class ShimClient:
                 return
             except OSError as exc:
                 if attempt >= self.retries:
+                    raise
+                if not self._retry_allowed():
+                    log.debug(
+                        "shim connect to %s:%d: retry budget exhausted",
+                        self.host, self.port,
+                    )
                     raise
                 delay = self._delay(attempt)
                 log.debug(
@@ -147,6 +175,9 @@ class ShimClient:
         payload = pb.Envelope(
             method=method, payload=message.SerializeToString()
         ).SerializeToString()
+        budget = self._budget()
+        if budget is not None:
+            budget.note_request(f"shim:{self.host}:{self.port}")
         self.last_hops = 0
         seen = {(self.host, self.port)}
         env = self._call_once(method, payload)
@@ -186,6 +217,10 @@ class ShimClient:
             except (ConnectionError, OSError) as exc:
                 if attempt >= self.retries:
                     raise
+                if not self._retry_allowed():
+                    return pb.Envelope(
+                        method=method, error="retry budget exhausted"
+                    )
                 delay = self._delay(attempt)
                 log.debug(
                     "shim %s attempt %d failed (%s); reconnect + retry in %.3fs",
@@ -199,6 +234,8 @@ class ShimClient:
                 continue
             hint = self._overload_hint(env)
             if hint is not None and attempt < self.retries:
+                if not self._retry_allowed():
+                    return env  # dry budget: surface the shed envelope
                 # shed, not failed: wait out the server's own hint
                 self._sleep(min(hint, self.retry_after_cap_s))
                 continue

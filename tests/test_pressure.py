@@ -1,0 +1,898 @@
+"""Resource-exhaustion robustness (runtime/pressure.py).
+
+The contract under test: disk-full, memory pressure and retry storms
+NEVER 5xx a request. ENOSPC at any of the six guarded durability sites
+(``pressure.DISK_SITES``) is contained where it lands, escalates the
+disk ladder to hard, and degrades durability honestly — every response
+envelope carries ``durability: degraded`` until recovery re-arms
+fsync'd journaling from a clean snapshot barrier. The acceptance
+anchor is crash parity ACROSS a pressure episode: a ``kill -9``
+(``journal.abandon()``) after the ladder recovered must replay
+bit-identically to a run that never saw pressure, because the rearm
+barrier snapshots the live tracker that the degraded ring merely
+echoed. Around it: the hysteretic ladder itself (forced probes,
+watermarks, the 1.25x margin + probe write), the memory lever ladder
+(applied one per poll in severity order, released in reverse), retry
+budgets (the 10% rule; ``--retry-budget 0`` is the unbounded control),
+protocol-journal compaction (migration + epoch) with crash safety at
+the compaction boundary, shutdown-writer containment, and the router's
+override journal replay. tools/chaos_sweep.py ``--group pressure``
+drives the same ladders through live subprocesses.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.fleet.ring import HashRing
+from log_parser_tpu.fleet.router import OverrideJournal
+from log_parser_tpu.models.pod import PodFailureData
+from log_parser_tpu.runtime import AnalysisEngine, faults, pressure
+from log_parser_tpu.runtime.faults import FaultRegistry
+from log_parser_tpu.runtime.journal import JOURNAL_NAME, FrequencyJournal
+from log_parser_tpu.runtime.migrate import (
+    MIGRATE_DIR,
+    DrainSupervisor,
+    LocalTarget,
+    MigrationError,
+    MigrationJournal,
+    Migrator,
+    compact_journal,
+)
+from log_parser_tpu.runtime.replicate import (
+    EPOCH_JOURNAL,
+    REPLICA_DIR,
+    LocalReplicaTarget,
+    Replicator,
+)
+from log_parser_tpu.runtime.tenancy import TenantRegistry
+from log_parser_tpu.serve import make_server
+
+from helpers import make_pattern, make_pattern_set
+
+
+@pytest.fixture(autouse=True)
+def clean_switchboards():
+    faults.install(None)
+    pressure.install(None)
+    yield
+    faults.install(None)
+    pressure.install(None)
+
+
+# ----------------------------------------------------------- harness
+
+
+def _sets():
+    return [
+        make_pattern_set(
+            [
+                make_pattern("oom", regex="OutOfMemoryError", confidence=0.9,
+                             severity="CRITICAL", context=(1, 1)),
+                make_pattern("conn", regex="Connection refused",
+                             confidence=0.7),
+                make_pattern("fatal", regex="FATAL", confidence=0.8),
+            ]
+        )
+    ]
+
+
+REQUESTS = [
+    "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after",
+    "WARN x\nConnection refused\nFATAL crash",
+    "java.lang.OutOfMemoryError: heap\nINFO again",
+    "Connection refused\njava.lang.OutOfMemoryError: heap\nFATAL boom",
+]
+
+
+def _pod(logs: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "crash"}}, logs=logs)
+
+
+def _events(result) -> list[tuple]:
+    return [
+        (
+            e.line_number,
+            e.matched_pattern.id if e.matched_pattern else None,
+            e.score,
+        )
+        for e in result.events
+    ]
+
+
+def _ctl(tmp_path, **kw) -> pressure.PressureController:
+    return pressure.PressureController(str(tmp_path), **kw)
+
+
+def _wal(dirname) -> str:
+    return os.path.join(str(dirname), JOURNAL_NAME)
+
+
+def _started_journal(tmp_path, source=None) -> FrequencyJournal:
+    """A bare journal with maintenance started (snapshot source wired),
+    so degrade()/rearm()/snapshot_now() behave as they do under an
+    engine — the rearm barrier needs a live tracker to snapshot."""
+    j = FrequencyJournal(str(tmp_path / "wal"), fsync_ms=10_000)
+    j.start(source or (lambda: {}), threading.Lock())
+    return j
+
+
+ENOSPC = OSError(errno.ENOSPC, "No space left on device")
+
+
+def post(url: str, payload):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(url: str):
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# tenant-root fixtures for the protocol-path legs (migrate/replicate)
+
+ACME_YAML = """
+metadata:
+  library_id: acme-lib
+patterns:
+  - id: oom
+    name: Out of memory
+    severity: CRITICAL
+    primary_pattern:
+      regex: OutOfMemoryError
+      confidence: 0.9
+  - id: err
+    name: Errors
+    severity: LOW
+    primary_pattern:
+      regex: "\\\\bERROR\\\\b"
+      confidence: 0.5
+"""
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture()
+def tenant_root(tmp_path):
+    d = tmp_path / "tenants" / "acme"
+    d.mkdir(parents=True)
+    (d / "lib.yaml").write_text(ACME_YAML)
+    return str(tmp_path / "tenants")
+
+
+def _base_engine(clk=None) -> AnalysisEngine:
+    import time as _time
+
+    return AnalysisEngine(
+        [make_pattern_set([make_pattern("base", regex="BASE")], "base-lib")],
+        ScoringConfig(),
+        clock=clk or _time.monotonic,
+    )
+
+
+def _data(blob: str) -> PodFailureData:
+    return PodFailureData(pod={"metadata": {"name": "t"}}, logs=blob)
+
+
+def _mig_side(tmp_path, root, name, clk=None, journaled=False):
+    state = tmp_path / name
+    state.mkdir(exist_ok=True)
+    setup = None
+    if journaled:
+        def setup(eng, tid):
+            eng.attach_journal(str(state / "wal" / tid), wall=clk)
+
+    import time as _time
+
+    reg = TenantRegistry(
+        _base_engine(clk), root=root, clock=clk or _time.monotonic,
+        engine_setup=setup,
+    )
+    mig = Migrator(reg, state_root=str(state), node_url=f"local://{name}")
+    return reg, mig
+
+
+def _rep_node(tmp_path, root, name, clk, *, peer=None, target=None):
+    state = tmp_path / name
+    state.mkdir(exist_ok=True)
+
+    def setup(eng, tid):
+        eng.attach_journal(str(state / "wal" / tid), wall=clk)
+
+    reg = TenantRegistry(
+        _base_engine(clk), root=root, clock=clk, engine_setup=setup
+    )
+    rep = Replicator(
+        reg, state_root=str(state), node_url=f"local://{name}",
+        peer_url=peer, target=target, clock=clk, wall=clk,
+    )
+    return reg, rep
+
+
+def _rep_snapshot(reg, tenant="acme"):
+    ctx = reg.resolve(tenant, ignore_forward=True)
+    try:
+        with ctx.engine.state_lock:
+            return ctx.engine.frequency.snapshot()
+    finally:
+        ctx.unpin()
+
+
+# -------------------------------------------------------- retry budget
+
+
+class TestRetryBudget:
+    def test_floor_lets_cold_destinations_retry_then_sheds(self):
+        b = pressure.RetryBudget(0.1)
+        assert [b.allow("d") for _ in range(3)] == [True, True, True]
+        assert b.allow("d") is False
+        assert b.stats()["shed"] == 1 and b.stats()["allowed"] == 3
+
+    def test_first_attempts_deposit_ratio_tokens(self):
+        b = pressure.RetryBudget(0.5)
+        for _ in range(3):
+            assert b.allow("d")
+        assert not b.allow("d")  # dry
+        for _ in range(4):
+            b.note_request("d")  # 4 first attempts x 0.5 = 2 tokens
+        assert b.allow("d") and b.allow("d")
+        assert not b.allow("d")
+
+    def test_cap_bounds_a_banked_burst(self):
+        b = pressure.RetryBudget(1.0, cap=5.0)
+        for _ in range(100):
+            b.note_request("d")
+        assert sum(1 for _ in range(10) if b.allow("d")) == 5
+
+    def test_destinations_are_isolated(self):
+        b = pressure.RetryBudget(0.1)
+        for _ in range(3):
+            assert b.allow("a")
+        assert not b.allow("a")
+        assert b.allow("b")  # a storm toward one backend starves only it
+
+    def test_retry_storm_fault_sheds_deterministically(self):
+        faults.install(FaultRegistry.parse("retry_storm_raise"))
+        b = pressure.RetryBudget(0.1)
+        assert b.allow("d") is False
+        assert b.stats()["shed"] == 1
+
+    def test_zero_ratio_disables_even_under_the_fault(self):
+        # the chaos drill's unbounded control: --retry-budget 0 with the
+        # same fault armed must allow every retry
+        faults.install(FaultRegistry.parse("retry_storm_raise"))
+        b = pressure.RetryBudget(0.0)
+        assert all(b.allow("d") for _ in range(50))
+        assert b.stats()["enabled"] is False and b.stats()["shed"] == 0
+
+
+# ---------------------------------------------------------- disk ladder
+
+
+class TestDiskLadder:
+    def test_inert_without_watermarks_or_faults(self, tmp_path):
+        c = _ctl(tmp_path)
+        c.poll()
+        assert c.disk_state == "ok" and c.mem_state == "ok"
+        assert c.health_check()["status"] == "UP"
+        assert not c.durability_degraded()
+
+    def test_soft_reclaims_and_recovers(self, tmp_path):
+        c = _ctl(tmp_path)
+        pressure.install(c)
+        j = _started_journal(tmp_path)
+        j.append_match("a", 1)
+        assert os.path.getsize(_wal(tmp_path / "wal")) > 0
+        c.register_journal(j)
+        c.register_compactor("migration", lambda: 2)
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=watermark:soft@times=1"))
+        c.poll()
+        assert c.disk_state == "soft"
+        assert c.miner_park_paused() and pressure.miner_park_paused()
+        assert not c.writes_paused()  # soft still journals fsync'd
+        assert j.snapshots == 1  # snapshot+truncate rode the soft entry
+        assert os.path.getsize(_wal(tmp_path / "wal")) == 0
+        assert c.compacted["migration"] == 2
+        assert c.health_check()["status"] == "DEGRADED"
+        c.poll()  # fault exhausted; no watermark set -> clears at once
+        assert c.disk_state == "ok"
+        assert c.stats()["transitions"] == {"disk:ok": 1, "disk:soft": 1}
+        j.abandon()
+
+    def test_hard_degrades_journals_then_rearms(self, tmp_path):
+        c = _ctl(tmp_path)
+        pressure.install(c)
+        j = _started_journal(tmp_path, source=lambda: {"a": [1.0]})
+        c.register_journal(j)
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=watermark:hard@times=2"))
+        c.poll()
+        assert c.disk_state == "hard"
+        assert c.writes_paused() and pressure.durability_degraded()
+        assert j.degraded is True
+        j.append_match("a", 1)  # diverted: the ring is an echo
+        assert j.degraded_records == 1
+        assert c.degraded_writes() == 1
+        assert pressure.stamp({})["durability"] == "degraded"
+        c.poll()  # fault still firing: pinned hard, no flap
+        assert c.disk_state == "hard"
+        c.poll()  # exhausted -> the probe write proves the disk again
+        assert c.disk_state == "ok"
+        assert j.degraded is False  # rearm barrier: snapshot + truncate
+        assert j.snapshots >= 1
+        assert "durability" not in pressure.stamp({})
+        assert c.health_check()["status"] == "UP"
+        j.abandon()
+
+    def test_watermarks_drive_states_with_hysteresis(self, tmp_path):
+        c = _ctl(tmp_path)
+        free = c.free_disk_bytes()
+        assert free > 0
+        c.disk_soft_bytes = free * 2  # free <= soft watermark
+        c.poll()
+        assert c.disk_state == "soft"
+        # free is above the watermark but NOT by the recovery margin:
+        # the ladder must hold (hysteresis), not flap
+        c.disk_soft_bytes = int(c.free_disk_bytes() / 1.1)
+        c.poll()
+        assert c.disk_state == "soft"
+        # well clear of margin x watermark -> recovers
+        c.disk_soft_bytes = int(c.free_disk_bytes() / 2)
+        c.poll()
+        assert c.disk_state == "ok"
+
+    def test_hard_watermark_goes_straight_to_hard(self, tmp_path):
+        c = _ctl(tmp_path)
+        c.disk_hard_bytes = c.free_disk_bytes() * 2
+        c.poll()
+        assert c.disk_state == "hard"
+        assert c.stats()["transitions"] == {"disk:hard": 1}
+
+    def test_write_error_pins_hard_immediately(self, tmp_path):
+        # ENOSPC observed by a durability writer cannot wait for the
+        # next watermark poll — the very next append would race it
+        c = _ctl(tmp_path)
+        c.note_write_error(ENOSPC, "wal_append")
+        assert c.disk_state == "hard" and c.write_errors == 1
+        c2 = _ctl(tmp_path)
+        c2.note_write_error(OSError(errno.EIO, "I/O error"), "fsync")
+        assert c2.disk_state == "hard"
+
+    def test_non_disk_errors_do_not_escalate(self, tmp_path):
+        c = _ctl(tmp_path)
+        c.note_write_error(OSError(errno.EPERM, "denied"), "wal_append")
+        c.note_write_error(ValueError("not an os error"), "wal_append")
+        assert c.disk_state == "ok" and c.write_errors == 0
+
+    def test_register_while_hard_degrades_immediately(self, tmp_path):
+        c = _ctl(tmp_path)
+        c.note_write_error(ENOSPC, "wal_append")
+        j = _started_journal(tmp_path)
+        c.register_journal(j)
+        assert j.degraded is True  # a late tenant WAL gets no fsync lie
+        j.abandon()
+
+    def test_closed_journals_are_pruned_not_degraded(self, tmp_path):
+        c = _ctl(tmp_path)
+        j = _started_journal(tmp_path)
+        c.register_journal(j)
+        j.close()  # tenant eviction closes its WAL; nothing unregisters
+        c.note_write_error(ENOSPC, "wal_append")
+        assert j.degraded is False
+        assert c.degraded_writes() == 0
+
+
+# -------------------------------------------------------- memory ladder
+
+
+class TestMemoryLadder:
+    def test_levers_apply_in_order_release_in_reverse(self, tmp_path):
+        order = []
+        c = _ctl(tmp_path)
+        c.add_lever("one", lambda: order.append("+one"),
+                    lambda: order.append("-one"))
+        c.add_lever("two", lambda: order.append("+two"),
+                    lambda: order.append("-two"))
+        c.add_lever("three", lambda: order.append("+three"))  # no release
+        faults.install(FaultRegistry.parse("mem_pressure_raise@times=2"))
+        c.poll()
+        assert c.mem_state == "soft" and order == ["+one"]
+        c.poll()  # one lever per poll, severity order
+        assert order == ["+one", "+two"]
+        c.poll()  # fault exhausted -> released in reverse
+        assert c.mem_state == "ok"
+        assert order == ["+one", "+two", "-two", "-one"]
+        assert c.lever_counts == {"one": 1, "two": 1}
+        assert c.stats()["transitions"] == {
+            "memory:ok": 1, "memory:soft": 1,
+        }
+
+    def test_broken_lever_does_not_stop_the_ladder(self, tmp_path):
+        order = []
+        c = _ctl(tmp_path)
+
+        def boom():
+            raise RuntimeError("lever broke")
+
+        c.add_lever("boom", boom)
+        c.add_lever("two", lambda: order.append("+two"))
+        faults.install(FaultRegistry.parse("mem_pressure_raise@times=2"))
+        c.poll()
+        c.poll()
+        assert order == ["+two"]
+        assert "boom" not in c.lever_counts
+
+
+# --------------------------------------------------- module switchboard
+
+
+class TestModuleSwitchboard:
+    def test_inert_defaults_without_a_controller(self):
+        assert pressure.current() is None
+        assert pressure.durability_degraded() is False
+        assert pressure.writes_paused() is False
+        assert pressure.miner_park_paused() is False
+        assert pressure.retry_budget() is None
+        payload = {"a": 1}
+        assert pressure.stamp(payload) is payload
+        assert "durability" not in payload
+        pressure.note_write_error(ENOSPC, "wal_append")  # no-op, no raise
+
+    def test_installed_controller_answers_for_the_process(self, tmp_path):
+        c = _ctl(tmp_path)
+        pressure.install(c)
+        assert pressure.current() is c
+        assert pressure.retry_budget() is c.retry
+        c.note_write_error(ENOSPC, "wal_append")
+        assert pressure.writes_paused() is True
+        assert pressure.stamp({})["durability"] == "degraded"
+
+
+# -------------------------------- ENOSPC matrix: request-serving sites
+
+
+class TestEnospcServingPath:
+    """wal_append / fsync / snapshot_rotate through a live in-process
+    server: every response stays 200 (zero 5xx), the envelope is
+    stamped while degraded, and recovery drops the stamp."""
+
+    @pytest.fixture()
+    def served(self, tmp_path):
+        engine = AnalysisEngine(_sets(), ScoringConfig())
+        journal = engine.attach_journal(str(tmp_path / "state"),
+                                        fsync_ms=10_000)
+        ctl = pressure.PressureController(str(tmp_path / "state"))
+        pressure.install(ctl)
+        ctl.register_journal(journal)
+        server = make_server(engine, host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        yield url, journal, ctl
+        server.shutdown()
+        server.server_close()
+        journal.abandon()
+
+    def _parse(self, url, logs=REQUESTS[0]):
+        return post(url + "/parse",
+                    {"pod": {"metadata": {"name": "p"}}, "logs": logs})
+
+    def test_wal_append_enospc_degrades_never_500s(self, served):
+        url, journal, ctl = served
+        status, body = self._parse(url)
+        assert status == 200 and "durability" not in body
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=wal_append@times=1"))
+        status, body = self._parse(url)
+        assert status == 200  # the injected ENOSPC never surfaces
+        assert body.get("durability") == "degraded"
+        assert ctl.disk_state == "hard" and journal.degraded
+        assert ctl.stats()["writeErrors"] == 1
+        # /q/health carries the pressure check; /trace/last the block
+        status, health = get(url + "/q/health")
+        assert status == 200
+        row = next(ch for ch in health["checks"]
+                   if ch["name"] == "pressure")
+        assert row["status"] == "DEGRADED"
+        assert row["data"]["disk"] == "hard"
+        status, trace = get(url + "/trace/last")
+        assert status == 200 and trace["pressure"]["disk"] == "hard"
+        # further requests are echoed to the ring, still 200 + stamped
+        status, body = self._parse(url, REQUESTS[1])
+        assert status == 200 and body.get("durability") == "degraded"
+        assert journal.degraded_records >= 1
+        # fault exhausted: one poll probes the disk and re-arms
+        faults.install(None)
+        ctl.poll()
+        status, body = self._parse(url)
+        assert status == 200 and "durability" not in body
+        assert journal.degraded is False
+
+    def test_fsync_enospc_contained_and_escalates(self, served):
+        url, journal, ctl = served
+        assert self._parse(url)[0] == 200  # a dirty WAL to fsync
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=fsync@times=1"))
+        journal.flush()  # the group-fsync interval, driven by hand
+        faults.install(None)
+        assert ctl.disk_state == "hard"
+        assert journal.healthy is False
+        status, body = self._parse(url)
+        assert status == 200 and body.get("durability") == "degraded"
+
+    def test_snapshot_rotate_enospc_keeps_the_tail(self, served):
+        url, journal, ctl = served
+        assert self._parse(url)[0] == 200
+        journal.flush()
+        tail = os.path.getsize(journal._wal_path)
+        assert tail > 0
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=snapshot_rotate@times=1"))
+        assert journal.snapshot_now() is False  # aborts WITHOUT truncate
+        faults.install(None)
+        assert os.path.getsize(journal._wal_path) == tail
+        assert journal.snapshot_errors == 1
+        assert ctl.disk_state == "hard"
+        status, body = self._parse(url)
+        assert status == 200 and body.get("durability") == "degraded"
+
+
+# ------------------------------- ENOSPC matrix: protocol-journal sites
+
+
+class TestEnospcProtocolPaths:
+    def test_bundle_write_enospc_refuses_the_move(self, tenant_root,
+                                                  tmp_path):
+        reg_a, mig_a = _mig_side(tmp_path, tenant_root, "a")
+        reg_b, mig_b = _mig_side(tmp_path, tenant_root, "b")
+        ctl = pressure.PressureController(str(tmp_path / "a"))
+        pressure.install(ctl)
+        try:
+            reg_a.resolve("acme").engine.analyze(
+                _data("java.lang.OutOfMemoryError: heap"))
+            faults.install(FaultRegistry.parse(
+                "disk_enospc_raise@match=bundle_write@times=1"))
+            with pytest.raises(MigrationError):
+                mig_a.migrate("acme", LocalTarget(mig_b, url="local://b"))
+            faults.install(None)
+            assert ctl.disk_state == "hard"
+            # a full disk refuses the move: the tenant stays owned and
+            # serving on the source, nothing was staged half-exported
+            ctx = reg_a.resolve("acme")
+            ctx.engine.analyze(_data("an ERROR here"))
+            ctx.unpin()
+            assert mig_b.stats()["staged"] == 0
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+
+    def test_replica_rejournal_enospc_pauses_then_resends(self, tenant_root,
+                                                          tmp_path):
+        clk = FakeClock()
+        reg_b, rep_b = _rep_node(tmp_path, tenant_root, "b", clk,
+                                 peer="local://a")
+        rep_b.recover()
+        target = LocalReplicaTarget(rep_b, url="local://b")
+        reg_a, rep_a = _rep_node(tmp_path, tenant_root, "a", clk,
+                                 target=target)
+        rep_a.recover()
+        ctl = pressure.PressureController(str(tmp_path / "b"))
+        pressure.install(ctl)
+        try:
+            ctx = reg_a.resolve("acme")
+            sender = rep_a.attach_sender("acme", ctx.engine)
+            ctx.engine.analyze(
+                _data("java.lang.OutOfMemoryError: heap\nan ERROR here"))
+            ctx.unpin()
+            faults.install(FaultRegistry.parse(
+                "disk_enospc_raise@match=replica_rejournal@times=1"))
+            # the standby 503s the batch; the sender contains and backs
+            # off — restore is a barrier, so nothing is half-applied
+            assert sender.pump() == "error"
+            faults.install(None)
+            assert sender.send_errors == 1
+            assert ctl.disk_state == "hard"
+            # while the ladder is hard the sender parks outright
+            clk.t += 3600.0
+            assert sender.pump() == "paused"
+            ctl.poll()  # disk takes writes again
+            assert ctl.disk_state == "ok"
+            clk.t += 3600.0  # clear the failure backoff
+            assert sender.pump() == "seeded"  # the re-send converges
+            assert _rep_snapshot(reg_b) == _rep_snapshot(reg_a)
+        finally:
+            reg_a.shutdown()
+            reg_b.shutdown()
+            rep_a.stop()
+            rep_b.stop()
+
+    def test_otlp_dump_enospc_raises_then_hard_skips(self, tmp_path):
+        engine = AnalysisEngine(_sets(), ScoringConfig())
+        ctl = pressure.PressureController(str(tmp_path))
+        pressure.install(ctl)
+        path = str(tmp_path / "spans.json")
+        faults.install(FaultRegistry.parse(
+            "disk_enospc_raise@match=otlp_dump@times=1"))
+        with pytest.raises(OSError):
+            engine.obs.spans.dump(path)
+        faults.install(None)
+        assert ctl.disk_state == "hard"
+        assert not os.path.exists(path)  # tmp+rename: no torn file
+        # under hard the writer skips atomically instead of raising
+        assert engine.obs.spans.dump(path) is None
+        ctl.poll()
+        assert ctl.disk_state == "ok"
+        assert engine.obs.spans.dump(path) == path
+
+    def test_shutdown_containment_is_per_writer(self, tenant_root,
+                                                tmp_path):
+        # satellite 2: one failing writer during finalization is logged
+        # and counted — the drain completes and every OTHER writer runs
+        reg_a, mig_a = _mig_side(tmp_path, tenant_root, "a",
+                                 journaled=True)
+        ctl = pressure.PressureController(str(tmp_path / "a"))
+        pressure.install(ctl)
+        try:
+            reg_a.resolve("acme").engine.analyze(_data("an ERROR here"))
+            span_path = str(tmp_path / "spans.json")
+            ds = DrainSupervisor(reg_a, mig_a, span_dump_path=span_path)
+            faults.install(FaultRegistry.parse(
+                "disk_enospc_raise@match=otlp_dump@times=1"))
+            out = ds.finalize_all()  # must not raise
+            faults.install(None)
+            assert out["writerErrors"] == 1  # the span dump, contained
+            assert out["folded"] == ["acme"]  # journals still folded
+            assert ctl.disk_state == "hard"
+            # under hard pressure folds SKIP honestly (rearm owns the
+            # recovery barrier) instead of counting phantom errors
+            out2 = ds.finalize_all()
+            assert out2["writerErrors"] == 0
+            assert out2["writersSkipped"] >= 2  # acme + default + span
+        finally:
+            reg_a.shutdown()
+
+
+# --------------------------------------- crash parity across pressure
+
+
+class TestCrashParityAcrossPressure:
+    """The acceptance anchor: recovery re-arms fsync'd journaling from
+    a clean snapshot barrier, so a kill -9 AFTER a pressure episode
+    replays bit-identically to a run that never saw pressure."""
+
+    def _control(self, extra):
+        engine = AnalysisEngine(_sets(), ScoringConfig())
+        results = [engine.analyze(_pod(logs))
+                   for logs in REQUESTS + [extra]]
+        return (_events(results[-1]),
+                engine.frequency.get_frequency_statistics())
+
+    def test_kill9_after_recovery_replays_bit_identically(self, tmp_path):
+        extra = REQUESTS[1]
+        want_events, want_stats = self._control(extra)
+
+        first = AnalysisEngine(_sets(), ScoringConfig())
+        journal = first.attach_journal(str(tmp_path), fsync_ms=10_000)
+        ctl = pressure.PressureController(str(tmp_path))
+        pressure.install(ctl)
+        ctl.register_journal(journal)
+
+        first.analyze(_pod(REQUESTS[0]))  # fsync'd
+        ctl.note_write_error(ENOSPC, "wal_append")  # disk fills
+        assert journal.degraded is True
+        for logs in REQUESTS[1:3]:  # echoed to the ring only
+            first.analyze(_pod(logs))
+        assert journal.degraded_records >= 1
+        ctl.poll()  # disk takes writes again: hard -> ok + rearm barrier
+        assert ctl.disk_state == "ok" and journal.degraded is False
+        first.analyze(_pod(REQUESTS[3]))  # fsync'd again
+        journal.abandon()  # kill -9 after the episode
+        pressure.install(None)
+
+        second = AnalysisEngine(_sets(), ScoringConfig())
+        second.attach_journal(str(tmp_path), fsync_ms=10_000)
+        result = second.analyze(_pod(extra))
+        assert _events(result) == want_events
+        assert second.frequency.get_frequency_statistics() == want_stats
+        second.journal.abandon()
+
+    def test_kill9_during_hard_loses_only_the_diverted_window(
+            self, tmp_path):
+        # the documented exposure: a crash WHILE degraded loses exactly
+        # the ring-diverted records — never the fsync'd prefix
+        control = AnalysisEngine(_sets(), ScoringConfig())
+        control.analyze(_pod(REQUESTS[0]))
+        want = control.frequency.get_frequency_statistics()
+
+        first = AnalysisEngine(_sets(), ScoringConfig())
+        journal = first.attach_journal(str(tmp_path), fsync_ms=10_000)
+        ctl = pressure.PressureController(str(tmp_path))
+        pressure.install(ctl)
+        ctl.register_journal(journal)
+        first.analyze(_pod(REQUESTS[0]))
+        ctl.note_write_error(ENOSPC, "wal_append")
+        first.analyze(_pod(REQUESTS[1]))  # diverted, stamped degraded
+        journal.abandon()
+        pressure.install(None)
+
+        second = AnalysisEngine(_sets(), ScoringConfig())
+        second.attach_journal(str(tmp_path), fsync_ms=10_000)
+        assert second.frequency.get_frequency_statistics() == want
+        second.journal.abandon()
+
+
+# --------------------------------------- protocol-journal compaction
+
+
+class TestMigrationJournalCompaction:
+    def _terminal_src(self, path):
+        jr = MigrationJournal(path)
+        jr.append("begin", mid="m1", tenant="ghost", target="local://b")
+        jr.append("quiesce")
+        jr.append("export", sha="x")
+        jr.append("import_ack", sha="x")
+        jr.append("cutover", location="local://b", retryAfterS=5)
+        jr.append("complete")
+        jr.close()
+
+    def test_terminal_source_compacts_to_decision_records(self, tmp_path):
+        path = str(tmp_path / "m1.src.wal")
+        self._terminal_src(path)
+        before = os.stat(path).st_mtime
+        assert compact_journal(path) is True
+        recs = MigrationJournal.replay(path)
+        assert [r["k"] for r in recs] == ["begin", "cutover", "complete"]
+        assert recs[1]["location"] == "local://b"
+        # mtime arbitrates ownership verdicts: compaction preserves it
+        assert os.stat(path).st_mtime == before
+        assert compact_journal(path) is False  # idempotent
+
+    def test_non_terminal_journals_are_left_alone(self, tmp_path):
+        path = str(tmp_path / "m2.src.wal")
+        jr = MigrationJournal(path)
+        jr.append("begin", mid="m2", tenant="ghost", target="local://b")
+        jr.append("quiesce")
+        jr.close()
+        assert compact_journal(path) is False
+        assert len(MigrationJournal.replay(path)) == 2
+
+    def test_crash_at_the_compaction_boundary_is_safe(self, tenant_root,
+                                                      tmp_path):
+        # satellite 1: a crash between tmp write and replace leaves the
+        # original journal intact plus a stale .compact tmp; the next
+        # pass sweeps the tmp, compacts, and recover() still installs
+        # the same forward from the decision records
+        reg, mig = _mig_side(tmp_path, tenant_root, "a")
+        try:
+            mdir = os.path.join(str(tmp_path / "a"), MIGRATE_DIR)
+            os.makedirs(mdir, exist_ok=True)
+            path = os.path.join(mdir, "m1.src.wal")
+            self._terminal_src(path)
+            with open(path + ".compact", "wb") as f:
+                f.write(b"torn garbage from a crashed pass")
+            assert mig.compact() == 1
+            assert not os.path.exists(path + ".compact")
+            recs = MigrationJournal.replay(path)
+            assert [r["k"] for r in recs] == ["begin", "cutover",
+                                              "complete"]
+            mig.recover()
+            assert reg.forward_for("ghost") == ("local://b", 5)
+        finally:
+            reg.shutdown()
+
+
+class TestEpochJournalCompaction:
+    def test_compaction_preserves_the_recover_verdict(self, tenant_root,
+                                                      tmp_path):
+        state = tmp_path / "b"
+        state.mkdir()
+        jr = MigrationJournal(str(state / REPLICA_DIR / EPOCH_JOURNAL))
+        jr.append("epoch", epoch=1, tenants=["acme"])
+        jr.append("epoch", epoch=3, tenants=["globex"])
+        jr.append("epoch", epoch=2, tenants=["acme"])
+        jr.close()
+        reg1, rep1 = _rep_node(tmp_path, tenant_root, "b", FakeClock())
+        try:
+            s1 = rep1.recover()
+            assert s1["records"] == 3 and s1["epoch"] == 3
+            assert rep1.compact_epoch_journal() == 1
+        finally:
+            reg1.shutdown()
+            rep1.stop()
+        reg2, rep2 = _rep_node(tmp_path, tenant_root, "b", FakeClock())
+        try:
+            s2 = rep2.recover()
+            assert s2["records"] == 1  # the whole history, one record
+            assert s2["epoch"] == s1["epoch"]
+            assert s2["tenants"] == s1["tenants"]
+            assert s2["role"] == s1["role"]
+        finally:
+            reg2.shutdown()
+            rep2.stop()
+
+
+# ------------------------------------------------- override journal
+
+
+class TestOverrideJournal:
+    BACKENDS = ["http://10.0.0.1:8080", "http://10.0.0.2:8080"]
+
+    def _other(self, ring, tenant):
+        owner = ring.owner(tenant)
+        return next(b for b in self.BACKENDS if b != owner)
+
+    def test_replay_restores_learned_placements(self, tmp_path):
+        ring = HashRing(list(self.BACKENDS))
+        oj = OverrideJournal(str(tmp_path))
+        moved = self._other(ring, "acme")
+        assert ring.set_override("acme", moved)
+        oj.note("acme", moved)
+        oj.close()
+        # router restart: replay teaches the fresh ring the placement
+        ring2 = HashRing(list(self.BACKENDS))
+        oj2 = OverrideJournal(str(tmp_path))
+        out = oj2.recover(ring2)
+        assert out == {"applied": 1, "stale": 0}
+        assert ring2.owner("acme") == moved
+        # and the log is compacted to exactly the live set
+        recs = MigrationJournal.replay(oj2.path)
+        assert [(r["tenant"], r["backend"]) for r in recs] == [
+            ("acme", moved)]
+        oj2.close()
+
+    def test_cleared_stale_and_redundant_records_self_resolve(
+            self, tmp_path):
+        ring = HashRing(list(self.BACKENDS))
+        oj = OverrideJournal(str(tmp_path))
+        oj.note("t-cleared", self._other(ring, "t-cleared"))
+        oj.note("t-cleared", None)  # cleared later: last record wins
+        oj.note("t-stale", "http://gone.example:1")  # left the ring
+        oj.note("t-redundant", ring.owner("t-redundant"))  # hash owner
+        oj.close()
+        ring2 = HashRing(list(self.BACKENDS))
+        oj2 = OverrideJournal(str(tmp_path))
+        out = oj2.recover(ring2)
+        assert out == {"applied": 1, "stale": 1}  # redundant applies,
+        # drops out; the non-member backend is the only stale entry
+        assert ring2.overrides() == {}
+        assert MigrationJournal.replay(oj2.path) == []  # compacted away
+        oj2.close()
+
+    def test_append_failure_is_contained_and_escalates(self, tmp_path):
+        ctl = pressure.PressureController(str(tmp_path))
+        pressure.install(ctl)
+        oj = OverrideJournal(str(tmp_path))
+
+        def boom(*a, **k):
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        oj._journal.append = boom
+        oj.note("acme", self.BACKENDS[0])  # contained: never raises
+        assert oj.stats()["writeErrors"] == 1
+        assert ctl.disk_state == "hard"  # the ladder heard about it
+        oj.close()

@@ -264,8 +264,31 @@ placement — docs/OPS.md "Fleet routing & placement"): a real
                         applied share, the router's /fleet/status the
                         assignment.
 
+Pressure group (``--group pressure``; resource-exhaustion robustness —
+docs/OPS.md "Resource exhaustion"):
+
+- ``pressure-soft-compaction`` a forced ``watermark:soft`` raise: the
+                        ladder reclaims (a seeded terminal migration
+                        journal compacts to its decision records),
+                        /q/health carries a DEGRADED pressure check,
+                        and responses stay 200 WITHOUT a durability
+                        stamp — soft never downgrades durability.
+- ``pressure-hard-degrade-rearm`` a @times-bounded ``watermark:hard``
+                        raise: 200s stamped ``durability: degraded``
+                        with the WAL diverted to the in-memory ring,
+                        then automatic hysteretic recovery — the stamp
+                        disappears and fsync'd journaling re-arms from
+                        a clean snapshot barrier.
+- ``pressure-retry-storm-shed`` a dead backend under an armed
+                        ``retry_storm`` fault: router re-route retries
+                        shed structured 503s (``retry budget
+                        exhausted``) and the service recovers once the
+                        corpse is evicted; the identical kill with
+                        ``--retry-budget 0`` retries unbounded to a
+                        200 — the storm the budget prevents.
+
 Usage: python tools/chaos_sweep.py [--only NAME]
-                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|replica|fleet|all]
+                                   [--group base|batcher|state|poison|linecache|kernel|streaming|distributed|tenant|miner|obs|spans|migrate|replica|fleet|pressure|all]
                                    [--keep-logs]
 """
 
@@ -286,6 +309,9 @@ import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# in-process drills import log_parser_tpu directly (script mode puts
+# tools/ on sys.path, not the repo root)
+sys.path.insert(0, REPO)
 PATTERN_DIR = os.path.join(REPO, "log_parser_tpu", "patterns", "builtin")
 LOGS = "INFO boot\njava.lang.OutOfMemoryError: heap\nINFO after"
 PAYLOAD = json.dumps(
@@ -2358,7 +2384,8 @@ MINER_STANDALONE = [
 
 def _fleet(tmp: str, prefix: str, router_flags: list | None = None,
            backend_flags: list | None = None,
-           backend_env: dict | None = None):
+           backend_env: dict | None = None,
+           router_env: dict | None = None):
     """A router over two backend serving processes sharing one tenant
     library root (migrations need identical pattern config fleet-wide),
     each backend with its own --state-dir. Backends boot and become
@@ -2382,7 +2409,7 @@ def _fleet(tmp: str, prefix: str, router_flags: list | None = None,
         ["--role", "router",
          "--backends", ",".join(f"127.0.0.1:{b.port}" for b in backends),
          *(router_flags or [])],
-        {},
+        router_env or {},
     )
     router.wait_ready()
     return router, backends
@@ -2564,6 +2591,202 @@ FLEET_STANDALONE = [
 ]
 
 
+# Pressure group (``--group pressure``; resource-exhaustion ladder —
+# docs/OPS.md "Resource exhaustion"): the disk watermark ladder, the
+# durability-degrade/re-arm cycle, and retry-budget shedding, all forced
+# through the ``disk_enospc`` / ``retry_storm`` fault sites so the
+# drills run on any host without filling a real disk.
+
+
+def scenario_pressure_soft_compaction():
+    """Soft disk pressure (a ``watermark:soft`` probe raise): the ladder
+    reclaims — a seeded terminal migration journal compacts past its
+    decision records — while /q/health answers 200 with a DEGRADED
+    pressure check and responses stay 200 WITHOUT the ``durability``
+    stamp: soft reclaims space, it never downgrades durability."""
+    from log_parser_tpu.runtime.migrate import MIGRATE_DIR, MigrationJournal
+
+    with tempfile.TemporaryDirectory(prefix="chaos_pressure_") as tmp:
+        state = os.path.join(tmp, "state")
+        # a finished migration's source journal: begin + chatter +
+        # cutover + complete. Only [begin, cutover, complete] matter
+        # after the terminal record — compaction must reclaim the rest.
+        seeded = os.path.join(state, MIGRATE_DIR, "m-old.src.wal")
+        jr = MigrationJournal(seeded)
+        jr.append("begin", mid="m-old", tenant="ghost",
+                  src="local", dst="http://127.0.0.1:1")
+        for i in range(16):
+            jr.append("copy", chunk=i)
+        jr.append("cutover", location="http://127.0.0.1:1", retryAfterS=1)
+        jr.append("complete")
+        jr.close()
+        srv = Server(
+            "pressure-soft",
+            ["--state-dir", state],
+            {"LOG_PARSER_TPU_FAULTS":
+                 "disk_enospc_raise@match=watermark:soft"},
+        )
+        try:
+            srv.wait_ready()
+            status, body, _ = post(srv.url)
+            assert status == 200, (status, body)
+            assert "durability" not in body, body
+            hstatus, health = get(srv.url, "/q/health")
+            assert hstatus == 200, (hstatus, health)
+            pres = [c for c in health.get("checks", [])
+                    if c.get("name") == "pressure"]
+            assert pres and pres[0]["status"] == "DEGRADED", health
+            assert pres[0]["data"]["disk"] == "soft", health
+            _, trace = get(srv.url, "/trace/last")
+            p = trace["pressure"]
+            assert p["disk"] == "soft", p
+            assert p["compacted"].get("migration", 0) >= 1, p
+            kinds = [r.get("k") for r in MigrationJournal.replay(seeded)]
+            assert kinds == ["begin", "cutover", "complete"], kinds
+            srv.stop(expect_zero=True)
+        finally:
+            srv.stop()
+
+
+def scenario_pressure_hard_degrade_rearm():
+    """Hard disk pressure forced for a few polls (``watermark:hard``
+    raise, @times-bounded): responses stay 200 but carry ``durability:
+    degraded`` and the WAL diverts to the in-memory ring; when the
+    fault exhausts, the ladder re-arms from a clean snapshot barrier
+    and the stamp disappears — its absence is the durability promise."""
+    with tempfile.TemporaryDirectory(prefix="chaos_pressure_") as tmp:
+        state = os.path.join(tmp, "state")
+        srv = Server(
+            "pressure-hard",
+            ["--state-dir", state],
+            # match-specs only consume on their own key, so @times=N is
+            # exactly N ladder polls pinned hard (~N seconds at the 1s
+            # poll) — sized to outlive the first request's jit warm-up
+            {"LOG_PARSER_TPU_FAULTS":
+                 "disk_enospc_raise@match=watermark:hard@times=45"},
+        )
+        try:
+            srv.wait_ready()
+            status, body, _ = post(srv.url)
+            assert status == 200, (status, body)
+            assert body.get("durability") == "degraded", body
+            hstatus, health = get(srv.url, "/q/health")
+            pres = [c for c in health.get("checks", [])
+                    if c.get("name") == "pressure"]
+            assert hstatus == 200 and pres, (hstatus, health)
+            assert pres[0]["data"]["disk"] == "hard", health
+            _, trace = get(srv.url, "/trace/last")
+            assert trace["journal"]["degraded"] is True, trace["journal"]
+            assert trace["journal"]["degradedRecords"] >= 1, trace["journal"]
+
+            def recovered():
+                _, t = get(srv.url, "/trace/last")
+                return t["pressure"]["disk"] == "ok"
+            _poll_until(recovered, timeout=90.0)
+            status, body, _ = post(srv.url)
+            assert status == 200, (status, body)
+            assert "durability" not in body, body
+            _, trace = get(srv.url, "/trace/last")
+            assert trace["journal"]["degraded"] is False, trace["journal"]
+            assert trace["pressure"]["transitions"].get("disk:ok", 0) >= 1, (
+                trace["pressure"]
+            )
+            hstatus, health = get(srv.url, "/q/health")
+            assert hstatus == 200 and not [
+                c for c in health.get("checks", [])
+                if c.get("name") == "pressure"
+            ], health
+            srv.stop(expect_zero=True)
+        finally:
+            srv.stop()
+
+
+def scenario_pressure_retry_storm_shed():
+    """A dead backend under an armed ``retry_storm`` fault: the
+    router's re-route retries shed a structured 503 ``retry budget
+    exhausted`` instead of hammering the fleet, and once the request
+    path has evicted the corpse, requests serve 200 again. The control
+    fleet — the SAME kill and fault with ``--retry-budget 0`` — retries
+    unbounded straight to a 200, which is exactly the storm the budget
+    converts into deterministic sheds."""
+    from log_parser_tpu.fleet.ring import HashRing
+
+    # the pump poll is parked at 30s so ONLY request-path failures
+    # (--fleet-down-after 2) evict the corpse: the shed sequence is
+    # then deterministic, not a race against the health loop
+    flags = ["--fleet-poll-s", "30", "--fleet-down-after", "2"]
+    storm = {"LOG_PARSER_TPU_FAULTS": "retry_storm_raise"}
+    hdr = {"X-Tenant": "acme"}
+
+    def kill_owner(router, backends):
+        # ports are random per run, so compute acme's ring owner the
+        # way the router does and kill exactly that backend
+        urls = [f"http://127.0.0.1:{b.port}" for b in backends]
+        victim = backends[urls.index(HashRing(urls).owner("acme"))]
+        victim.proc.kill()
+        victim.proc.wait(10)
+
+    with tempfile.TemporaryDirectory(prefix="chaos_pressure_") as tmp:
+        router, backends = _fleet(
+            tmp, "pressure-storm", router_flags=flags, router_env=storm,
+        )
+        try:
+            assert post(router.url, hdr)[0] == 200
+            kill_owner(router, backends)
+            # first post: the attempt on the corpse fails, the re-route
+            # wants a retry token, the storm fault says the bucket is
+            # dry -> structured shed
+            status, body, _ = post(router.url, hdr)
+            assert status == 503, (status, body)
+            assert body.get("error") == "retry budget exhausted", body
+            assert _router_metric(
+                router.url, "logparser_pressure_retry_total", "shed"
+            ) >= 1.0
+
+            # each shed post still charged the corpse one failure; once
+            # it leaves the ring the survivor answers first-attempt (no
+            # retry, so the armed storm fault never fires)
+            def served():
+                status, body, _ = post(router.url, hdr)
+                if status == 503:
+                    assert body.get("error") == "retry budget exhausted", body
+                    return False
+                return status == 200
+            _poll_until(served, timeout=20.0)
+        finally:
+            router.stop()
+            for b in backends:
+                b.stop()
+
+    with tempfile.TemporaryDirectory(prefix="chaos_pressure_") as tmp:
+        router, backends = _fleet(
+            tmp, "pressure-storm-ctl",
+            router_flags=[*flags, "--retry-budget", "0"], router_env=storm,
+        )
+        try:
+            assert post(router.url, hdr)[0] == 200
+            kill_owner(router, backends)
+            # unbounded control: the same fault is armed but a disabled
+            # budget never consults it — the very first post retries
+            # through the corpse (evicting it) to the survivor's 200
+            status, body, _ = post(router.url, hdr)
+            assert status == 200, (status, body)
+            assert _router_metric(
+                router.url, "logparser_pressure_retry_total", "shed"
+            ) == 0.0
+        finally:
+            router.stop()
+            for b in backends:
+                b.stop()
+
+
+PRESSURE_STANDALONE = [
+    ("pressure-soft-compaction", scenario_pressure_soft_compaction),
+    ("pressure-hard-degrade-rearm", scenario_pressure_hard_degrade_rearm),
+    ("pressure-retry-storm-shed", scenario_pressure_retry_storm_shed),
+]
+
+
 SCENARIOS = [
     ("baseline", [], {}, scenario_baseline),
     (
@@ -2616,7 +2839,7 @@ def main(argv: list[str] | None = None) -> int:
         choices=(
             "base", "batcher", "state", "poison", "linecache", "kernel",
             "streaming", "distributed", "tenant", "miner", "obs", "spans",
-            "migrate", "replica", "fleet", "all",
+            "migrate", "replica", "fleet", "pressure", "all",
         ),
         default="base",
         help="which scenario group to sweep (default: base; the "
@@ -2683,6 +2906,8 @@ def main(argv: list[str] | None = None) -> int:
         standalone.extend(REPLICA_STANDALONE)
     if args.group in ("fleet", "all"):
         standalone.extend(FLEET_STANDALONE)
+    if args.group in ("pressure", "all"):
+        standalone.extend(PRESSURE_STANDALONE)
     for name, check in standalone:
         if args.only and name != args.only:
             continue

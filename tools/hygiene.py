@@ -1034,6 +1034,135 @@ def check_fleet_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def _tuple_items_of(path: Path, name: str) -> list[str]:
+    """String items of the module-level tuple/list literal assigned to
+    ``name`` in ``path`` — ast-harvested like :func:`_dict_keys_of`."""
+    import ast
+
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []  # check 5 owns syntax reporting
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return [
+                el.value
+                for el in node.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+    return []
+
+
+def check_pressure_vocab_pinned(root: Path) -> list[str]:
+    """Check 21: the resource-pressure vocabulary must be pinned the way
+    check 20 pins the fleet's. The pressure fault sites (``FAULT_SITES``
+    in runtime/pressure.py — ``disk_enospc`` / ``mem_pressure`` /
+    ``retry_storm``) each need a docs/OPS.md row and a live
+    ``faults.fire`` call site; every guarded durability site in
+    ``DISK_SITES`` (the ``@match=`` targets operators drill against)
+    needs a docs/OPS.md row; the ``pressure`` span and every
+    ``logparser_pressure_*`` family are pinned BY NAME; the
+    ``--disk-soft-mb``/``--disk-hard-mb``/``--mem-soft-mb``/
+    ``--retry-budget`` serve flags get the backtick-row standard."""
+    src = root / "log_parser_tpu" / "runtime" / "pressure.py"
+    spans_src = root / "log_parser_tpu" / "obs" / "spans.py"
+    registry_src = root / "log_parser_tpu" / "obs" / "registry.py"
+    serve_src = root / "log_parser_tpu" / "serve" / "__main__.py"
+    ops_doc = root / "docs" / "OPS.md"
+    pkg = root / "log_parser_tpu"
+    if not src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    fired: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        if excluded(path):
+            continue
+        fired.update(
+            re.findall(
+                r'faults\.fire\([^"]*?"([a-z0-9_]+)"',
+                path.read_text(),
+                re.S,
+            )
+        )
+    sites = _dict_keys_of(src, "FAULT_SITES")
+    for required in ("disk_enospc", "mem_pressure", "retry_storm"):
+        if required not in sites:
+            problems.append(
+                f"{src}: pressure fault site {required!r} is missing from "
+                "FAULT_SITES — the resource-exhaustion drills depend on it"
+            )
+    for key in sites:
+        if f"`{key}`" not in ops_text:
+            problems.append(
+                f"{src}: pressure fault site {key!r} is not documented in "
+                "docs/OPS.md"
+            )
+        if key not in fired:
+            problems.append(
+                f"{src}: pressure fault site {key!r} has no live "
+                "faults.fire call site"
+            )
+    disk_sites = _tuple_items_of(src, "DISK_SITES")
+    if not disk_sites:
+        problems.append(
+            f"{src}: DISK_SITES is empty or missing — the ENOSPC drill "
+            "matrix depends on it"
+        )
+    for site in disk_sites:
+        if f"`{site}`" not in ops_text:
+            problems.append(
+                f"{src}: durability site {site!r} (a disk_enospc @match "
+                "target) has no backtick-quoted docs/OPS.md row"
+            )
+    if spans_src.is_file():
+        span_names = set(_dict_keys_of(spans_src, "SPANS"))
+        if "pressure" not in span_names:
+            problems.append(
+                f"{spans_src}: span 'pressure' is missing from SPANS — "
+                "the ladder-transition trace depends on it"
+            )
+        elif "`pressure`" not in ops_text:
+            problems.append(
+                f"{spans_src}: span 'pressure' has no backtick-quoted "
+                "docs/OPS.md row"
+            )
+    if registry_src.is_file():
+        metrics = set(_dict_keys_of(registry_src, "METRICS"))
+        fams = {m for m in metrics if m.startswith("logparser_pressure_")}
+        if not fams:
+            problems.append(
+                f"{registry_src}: no logparser_pressure_* metric families "
+                "— the resource-exhaustion alerts depend on them"
+            )
+        for fam in sorted(fams):
+            if f"`{fam}`" not in ops_text:
+                problems.append(
+                    f"{registry_src}: pressure family {fam!r} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    if serve_src.is_file():
+        for flag in re.findall(
+            r'add_argument\(\s*"(--(?:disk-soft-mb|disk-hard-mb'
+            r'|mem-soft-mb|retry-budget))"',
+            serve_src.read_text(),
+        ):
+            if f"`{flag}`" not in ops_text:
+                problems.append(
+                    f"{serve_src}: pressure serve flag {flag} has no "
+                    "backtick-quoted docs/OPS.md row"
+                )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -1068,6 +1197,7 @@ def main() -> int:
         problems.extend(check_migrate_vocab_pinned(root))
         problems.extend(check_replica_vocab_pinned(root))
         problems.extend(check_fleet_vocab_pinned(root))
+        problems.extend(check_pressure_vocab_pinned(root))
 
     for p in problems:
         print(p)
