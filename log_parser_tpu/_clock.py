@@ -1,0 +1,106 @@
+"""Swappable process clock: wall + monotonic + sleep/wait behind one seam.
+
+Production code calls the module-level helpers (:func:`wall`, :func:`mono`,
+:func:`sleep`, :func:`wait`) instead of touching ``time.*`` directly.  By
+default they delegate to a :class:`SystemClock` (real ``time.time`` /
+``time.monotonic`` / ``time.sleep`` / ``Event.wait``), so live behaviour is
+byte-identical to the pre-seam code.  The deterministic simulation harness
+(``log_parser_tpu.sim``) installs a virtual clock via :func:`install` and the
+*same* production bytes run under simulated time — the FoundationDB trick.
+
+The switchboard mirrors ``runtime.faults`` / ``runtime.pressure``: a single
+module-global read at call time, no per-object plumbing required (although
+most constructors still accept an explicit ``clock=`` override, which wins).
+
+Design notes
+------------
+* ``wait(event, timeout)`` exists because ``threading.Event.wait`` is a
+  hidden time source: under a virtual clock a timed wait must *advance*
+  virtual time rather than block the only thread.  SystemClock simply
+  forwards to ``event.wait``.
+* Installation is process-global and intentionally not thread-scoped — the
+  simulator runs the whole fleet on one thread, and production never
+  installs anything.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class Clock:
+    """Interface: a source of wall time, monotonic time, and blocking."""
+
+    def wall(self) -> float:
+        raise NotImplementedError
+
+    def mono(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait(self, event: threading.Event, timeout: Optional[float] = None) -> bool:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """The real thing — used unless a simulator installs a replacement."""
+
+    def wall(self) -> float:
+        return time.time()
+
+    def mono(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def wait(self, event: threading.Event, timeout: Optional[float] = None) -> bool:
+        return event.wait(timeout)
+
+
+_SYSTEM = SystemClock()
+_CLOCK: Clock = _SYSTEM
+
+
+def install(clock: Optional[Clock]) -> None:
+    """Install *clock* as the process clock (``None`` restores the system clock)."""
+    global _CLOCK
+    _CLOCK = clock if clock is not None else _SYSTEM
+
+
+def active() -> Clock:
+    """Return the currently installed clock."""
+    return _CLOCK
+
+
+def installed() -> bool:
+    """True when a non-system clock is installed (i.e. we are in a simulation)."""
+    return _CLOCK is not _SYSTEM
+
+
+def wall() -> float:
+    """Wall-clock seconds (``time.time`` equivalent; may step backwards)."""
+    return _CLOCK.wall()
+
+
+def mono() -> float:
+    """Monotonic seconds (``time.monotonic`` equivalent; never steps back)."""
+    return _CLOCK.mono()
+
+
+def sleep(seconds: float) -> None:
+    """Sleep for *seconds* on the installed clock."""
+    _CLOCK.sleep(seconds)
+
+
+def wait(event: threading.Event, timeout: Optional[float] = None) -> bool:
+    """``event.wait(timeout)`` routed through the installed clock.
+
+    Returns True when the event is set.  Under a virtual clock a timed wait
+    advances simulated time instead of blocking the (single) thread.
+    """
+    return _CLOCK.wait(event, timeout)

@@ -41,6 +41,7 @@ import time
 import urllib.error
 import urllib.request
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.fleet.budget import FleetBudget
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
@@ -117,7 +118,7 @@ class FleetController:
         migrate_timeout_s: float = 120.0,
         retry_after_s: int = 2,
         budget: FleetBudget | None = None,
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         self.router = router
         self.poll_s = float(poll_s)
@@ -163,7 +164,7 @@ class FleetController:
             self._thread = None
 
     def _run(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while not pclock.wait(self._stop, self.poll_s):
             try:
                 self.tick()
             except Exception:

@@ -46,6 +46,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.fleet.ring import DEFAULT_VNODES, HashRing
 from log_parser_tpu.obs import Obs
 from log_parser_tpu.runtime import faults, pressure
@@ -129,7 +130,7 @@ class _BackendState:
         self.up = True
         self.fails = 0
         self.last_error = ""
-        self.since = time.monotonic()
+        self.since = pclock.mono()
 
 
 OVERRIDE_JOURNAL = "router_overrides.wal"
@@ -307,7 +308,7 @@ class RouterServer(ThreadingHTTPServer):
         self.controller = None
         self.framed_front = None
         self.grpc_front = None
-        self.started_monotonic = time.monotonic()
+        self.started_monotonic = pclock.mono()
 
     # --------------------------------------------------------- overrides
 
@@ -340,7 +341,7 @@ class RouterServer(ThreadingHTTPServer):
             st.last_error = error[:200]
             if st.up and st.fails >= self.down_after:
                 st.up = False
-                st.since = time.monotonic()
+                st.since = pclock.mono()
                 removed = True
             else:
                 removed = False
@@ -358,7 +359,7 @@ class RouterServer(ThreadingHTTPServer):
             st.fails = 0
             if not st.up:
                 st.up = True
-                st.since = time.monotonic()
+                st.since = pclock.mono()
                 readmitted = True
             else:
                 readmitted = False
@@ -392,7 +393,7 @@ class RouterServer(ThreadingHTTPServer):
                     "up": st.up,
                     "fails": st.fails,
                     "lastError": st.last_error,
-                    "sinceS": round(time.monotonic() - st.since, 1),
+                    "sinceS": round(pclock.mono() - st.since, 1),
                 }
                 for b, st in self.health.items()
             }
@@ -400,7 +401,7 @@ class RouterServer(ThreadingHTTPServer):
             "ring": self.ring.stats(),
             "spread": self.ring.spread(),
             "backends": health,
-            "uptimeS": round(time.monotonic() - self.started_monotonic, 1),
+            "uptimeS": round(pclock.mono() - self.started_monotonic, 1),
         }
         ctl = self.controller
         if ctl is not None:

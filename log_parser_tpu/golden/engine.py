@@ -43,6 +43,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.golden.javacompat import compile_java_regex, java_split_lines
 from log_parser_tpu.javamath import java_div, java_min
@@ -99,7 +100,7 @@ class GoldenFrequencyTracker:
     """FrequencyTrackingService.java:20-134 — cross-request sliding-window
     match counts keyed by pattern id."""
 
-    def __init__(self, config: ScoringConfig, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, config: ScoringConfig, clock: Callable[[], float] = pclock.mono):
         self.config = config
         self.clock = clock
         self._frequencies: dict[str, PatternFrequency] = {}
@@ -191,7 +192,11 @@ class GoldenFrequencyTracker:
         out: dict[str, list[float]] = {}
         for pid, freq in self._frequencies.items():
             freq._prune(now)
-            out[pid] = [now - ts for ts in freq._timestamps]
+            # A backwards wall step (NTP slew, VM pause) can leave recorded
+            # timestamps ahead of `now`; the resulting negative age would be
+            # rejected by restore() on the peer and brick replica seeding.
+            # Clamp to zero: "matched just now" is the honest floor.
+            out[pid] = [max(0.0, now - ts) for ts in freq._timestamps]
         return out
 
     def restore(self, ages: dict[str, list[float]]) -> None:
@@ -272,7 +277,7 @@ class GoldenAnalyzer:
         self,
         pattern_sets: list[PatternSet],
         config: ScoringConfig | None = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         self.pattern_sets = pattern_sets
         self.config = config or ScoringConfig()
@@ -316,7 +321,7 @@ class GoldenAnalyzer:
 
     def analyze(self, data: PodFailureData) -> AnalysisResult:
         """AnalysisService.java:50-122."""
-        start = time.monotonic()
+        start = pclock.mono()
         lines = java_split_lines(data.logs or "")
         events: list[MatchedEvent] = []
 
@@ -491,7 +496,7 @@ def build_metadata(
     """AnalysisService.java:166-180 — patterns_used lists every loaded
     library id, matched or not."""
     return AnalysisMetadata(
-        processing_time_ms=int((time.monotonic() - start_monotonic) * 1000),
+        processing_time_ms=int((pclock.mono() - start_monotonic) * 1000),
         total_lines=total_lines,
         analyzed_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
         patterns_used=[

@@ -17,6 +17,7 @@ import dataclasses
 import time
 from typing import ClassVar
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.javamath import java_div
 from log_parser_tpu.models._base import Model
 from log_parser_tpu.models.pattern import Pattern
@@ -107,7 +108,7 @@ class PatternFrequency:
     agree on a deterministic time model in parity tests.
     """
 
-    def __init__(self, window_seconds: float, clock=time.monotonic):
+    def __init__(self, window_seconds: float, clock=pclock.mono):
         self.window_seconds = float(window_seconds)
         self._clock = clock
         self._timestamps: list[float] = []

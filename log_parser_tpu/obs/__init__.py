@@ -16,6 +16,7 @@ import os
 import time
 import uuid
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.obs.profiler import (  # noqa: F401  (re-export)
     DeviceProfiler,
     ProfilerBusy,
@@ -141,7 +142,7 @@ class Obs:
     """Registry + trace ring + SLO tracker + profiler for one engine
     family. Cheap to construct (no threads, no jax imports)."""
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=pclock.mono):
         self.registry = Registry()
         self.ring = TraceRing(
             capacity=int(

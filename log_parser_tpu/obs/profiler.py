@@ -10,6 +10,7 @@ import logging
 import os
 import threading
 import time
+from log_parser_tpu import _clock as pclock
 
 log = logging.getLogger("log_parser_tpu.obs")
 
@@ -69,7 +70,7 @@ class DeviceProfiler:
             from log_parser_tpu.utils.trace import profiler_trace
 
             with profiler_trace(capture_dir):
-                time.sleep(seconds)
+                pclock.sleep(seconds)
             with self._lock:
                 self.captures += 1
                 self.last_dir = capture_dir

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from log_parser_tpu import _clock as pclock
 
 DEFAULT_WINDOWS_S = (60.0, 300.0)
 DEFAULT_BURN_THRESHOLD = 2.0
@@ -24,7 +25,7 @@ class SloTracker:
     def __init__(self, p99_ms: float = 0.0, availability: float = 0.0,
                  windows_s=DEFAULT_WINDOWS_S,
                  burn_threshold: float = DEFAULT_BURN_THRESHOLD,
-                 clock=time.monotonic):
+                 clock=pclock.mono):
         self.p99_ms = float(p99_ms)
         self.availability = float(availability)
         self.windows_s = tuple(
@@ -36,6 +37,10 @@ class SloTracker:
         # second -> [total, errors, slow]; bounded by the longest window
         self._cells: dict[int, list[int]] = {}
         self._horizon = int(max(self.windows_s)) + 2
+        # High-water mark: bucketing must not run backwards when the clock
+        # does, or fresh outcomes land in cells the window filter already
+        # passed (undercounting burn) and eviction can eat recent cells.
+        self._hwm = 0
 
     @property
     def enabled(self) -> bool:
@@ -46,6 +51,7 @@ class SloTracker:
             return
         now = int(self.clock())
         with self._lock:
+            now = self._hwm = max(now, self._hwm)
             cell = self._cells.get(now)
             if cell is None:
                 cell = self._cells[now] = [0, 0, 0]
@@ -60,10 +66,10 @@ class SloTracker:
                 cell[2] += 1
 
     def _window_counts(self, window_s: float) -> tuple[int, int, int]:
-        now = self.clock()
-        floor = now - window_s
         total = errors = slow = 0
         with self._lock:
+            now = max(self.clock(), self._hwm)
+            floor = now - window_s
             for sec, (t, e, s) in self._cells.items():
                 if floor <= sec <= now:
                     total += t

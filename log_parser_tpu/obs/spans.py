@@ -43,6 +43,7 @@ import json
 import os
 import threading
 import time
+from log_parser_tpu import _clock as pclock
 import zlib
 from collections import deque
 
@@ -169,7 +170,7 @@ class SpanStore:
         would exceed its capacity, so an abandoned trace id can never
         grow the store."""
         if t0 is None:
-            t0 = time.time() - duration_s
+            t0 = pclock.wall() - duration_s
         with self._lock:
             span = self._new_span(name, _span_id(trace_id), t0,
                                   duration_s, attrs, links)
@@ -201,7 +202,7 @@ class SpanStore:
         total_ms = duration_s * 1e3
         keep = force or total_ms >= self.slow_ms or self.sampled(trace_id)
         if t0 is None:
-            t0 = time.time() - duration_s
+            t0 = pclock.wall() - duration_s
         with self._lock:
             staged = self._staging.pop(trace_id, None)
             if not keep:

@@ -36,6 +36,7 @@ from typing import Callable
 import jax
 import numpy as np
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.models.pattern import PatternSet, PatternSetMetadata
 from log_parser_tpu.ops.fused import FusedMatchScore, MatchRecords
@@ -85,7 +86,7 @@ class PatternShardedEngine(AnalysisEngine):
         config: ScoringConfig | None = None,
         devices: list | None = None,
         n_blocks: int | None = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         # the base engine's bank carries the FULL library: finalization,
         # frequency slots, event assembly, and global pattern indexes all

@@ -54,6 +54,7 @@ import os
 import random
 import threading
 import time
+from log_parser_tpu import _clock as pclock
 
 log = logging.getLogger(__name__)
 
@@ -192,7 +193,7 @@ def dispatch_with_retry(
     policy: RetryPolicy,
     health: "MeshHealth | None" = None,
     label: str = "broadcast",
-    sleep=time.sleep,
+    sleep=pclock.sleep,
     recorder=None,
 ):
     """Bounded attempts of ``fn(ctx)`` with backoff between them. Retries
@@ -204,14 +205,14 @@ def dispatch_with_retry(
     after the dispatch resolves — the span hook that attributes mesh
     work to its originating request trace (``broadcast`` spans,
     obs/spans.py). Recorder failures never fail a dispatch."""
-    t0 = time.monotonic()
+    t0 = pclock.mono()
 
     def _record(outcome: str, attempts: int) -> None:
         if recorder is None:
             return
         try:
             recorder(
-                time.monotonic() - t0,
+                pclock.mono() - t0,
                 {"label": label, "outcome": outcome, "attempts": attempts},
             )
         except Exception:  # pragma: no cover - observability is best-effort
@@ -257,7 +258,7 @@ class MeshHealth:
         self,
         process_count: int,
         dead_after: int | None = None,
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         if dead_after is None:
             try:

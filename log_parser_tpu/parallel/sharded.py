@@ -42,6 +42,7 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.ops.fused import (
     K_LADDER,
@@ -344,7 +345,7 @@ class ShardedEngine(AnalysisEngine):
     def __init__(self, pattern_sets, config=None, mesh=None, clock=None):
         import time as _time
 
-        super().__init__(pattern_sets, config, clock=clock or _time.monotonic)
+        super().__init__(pattern_sets, config, clock=clock or pclock.mono)
         if mesh is None:
             from log_parser_tpu.parallel.mesh import make_mesh
 

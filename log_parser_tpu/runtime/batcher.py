@@ -72,6 +72,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.native.ingest import Corpus
 from log_parser_tpu.ops.encode import _pad_rows
 from log_parser_tpu.runtime import faults
@@ -112,7 +113,7 @@ class _Pending:
         self.om = om
         self.ov = ov
         self.deadline = deadline  # monotonic seconds, or None
-        self.enqueued_at = time.monotonic()
+        self.enqueued_at = pclock.mono()
         self.done = threading.Event()
         self.result = None
         self.error: BaseException | None = None
@@ -195,7 +196,7 @@ class MicroBatcher:
             # flush their batchmates share — straight to the host path
             fp = self.engine._quarantine_check(data)
             if fp is not None:
-                start = time.monotonic()
+                start = pclock.mono()
                 with self.engine.state_lock:
                     result = self.engine._serve_quarantined(data, fp)
                 self.engine._note_golden(
@@ -219,7 +220,7 @@ class MicroBatcher:
         request into its shape bucket. Returns None when closed. A prepare
         failure takes the engine's normal fallback/propagate path — under
         ``state_lock``, exactly like ``_analyze``'s prepare except-arm."""
-        start = time.monotonic()
+        start = pclock.mono()
         trace = PhaseTrace()
         trace.route = "batched"
         # always a concrete id: the flush span links its member traces
@@ -301,7 +302,7 @@ class MicroBatcher:
         while True:
             with self._cv:
                 while True:
-                    now = time.monotonic()
+                    now = pclock.mono()
                     bucket, when = self._pick_flush(now)
                     if bucket is not None:
                         reason = when
@@ -350,8 +351,8 @@ class MicroBatcher:
         # back-links it through trace.links) instead of parenting under
         # any single one — the fan-in the flat trace ring cannot express
         flush_id = engine.obs.new_request_id()
-        flush_t0 = time.monotonic()
-        now = time.monotonic()
+        flush_t0 = pclock.mono()
+        now = pclock.mono()
         for item in items:
             wait_s = now - item.enqueued_at
             item.trace.add("batch_wait", wait_s)
@@ -444,7 +445,7 @@ class MicroBatcher:
         # lives — sampling must never drop them)
         spans.end_trace(
             flush_id,
-            duration_s=time.monotonic() - flush_t0,
+            duration_s=pclock.mono() - flush_t0,
             tenant=engine.obs_tenant,
             name="flush",
             attrs={

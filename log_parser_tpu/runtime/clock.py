@@ -1,0 +1,38 @@
+"""Public surface of the swappable process clock.
+
+The implementation lives in :mod:`log_parser_tpu._clock` — a zero-dependency
+top-level module so that ``golden/``, ``models/`` and ``obs/`` (which
+``runtime.engine`` itself imports) can use the seam without creating an
+import cycle through ``runtime/__init__``.  This module is the documented
+import path for the simulator and tests::
+
+    from log_parser_tpu.runtime import clock
+    clock.install(my_virtual_clock)
+
+Both paths share one switchboard: ``install`` here and ``install`` on
+``log_parser_tpu._clock`` mutate the same global.
+"""
+
+from log_parser_tpu._clock import (  # noqa: F401
+    Clock,
+    SystemClock,
+    active,
+    install,
+    installed,
+    mono,
+    sleep,
+    wait,
+    wall,
+)
+
+__all__ = [
+    "Clock",
+    "SystemClock",
+    "active",
+    "install",
+    "installed",
+    "mono",
+    "sleep",
+    "wait",
+    "wall",
+]

@@ -38,6 +38,7 @@ from typing import Callable
 
 import numpy as np
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.golden.engine import (
     GoldenFrequencyTracker,
@@ -221,7 +222,7 @@ class DeviceWatchdog:
                 if (
                     self.cooldown_s > 0
                     and not self._probing
-                    and time.monotonic() - self._opened_at >= self.cooldown_s
+                    and pclock.mono() - self._opened_at >= self.cooldown_s
                 ):
                     # half-open: this request is the single recovery trial
                     self._probing = True
@@ -277,7 +278,7 @@ class DeviceWatchdog:
                     # longer be un-done by this set).
                     abandoned[0] = True
                     self._open = True
-                    self._opened_at = time.monotonic()
+                    self._opened_at = pclock.mono()
                     if probe:
                         # failed trial: re-arm the cool-down, next probe
                         # waits a full period again
@@ -295,7 +296,7 @@ class DeviceWatchdog:
                     # the backend RESPONDED (not wedged) but with an error:
                     # don't close on an error — re-arm the cool-down and
                     # let the inflight==0 bookkeeping decide as before
-                    self._opened_at = time.monotonic()
+                    self._opened_at = pclock.mono()
                 else:
                     # trial succeeded: the backend serves again. Close even
                     # with abandoned workers still pending — the stuck-open
@@ -380,7 +381,7 @@ class AnalysisEngine:
         self,
         pattern_sets: list[PatternSet],
         config: ScoringConfig | None = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         self.config = config or ScoringConfig()
         # warm restarts must not re-pay multi-second XLA compiles
@@ -1162,14 +1163,14 @@ class AnalysisEngine:
         runs inside the quiesced critical section — the distributed
         coordinator broadcasts the reload there so no request broadcast
         can interleave. Returns the new reload epoch."""
-        deadline = time.monotonic() + timeout_s
+        deadline = pclock.mono() + timeout_s
         with self._quiesce_cv:
             if self._swap_pending:
                 raise RuntimeError("another pattern reload is in progress")
             self._swap_pending = True
             try:
                 while self._active_requests > 0:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - pclock.mono()
                     if remaining <= 0:
                         raise TimeoutError(
                             f"reload quiesce timed out after {timeout_s:g}s "
@@ -1352,7 +1353,7 @@ class AnalysisEngine:
         serve/admission.py) — NOT because anything failed. Same frequency
         state, same rollback-on-failure invariant as the error fallback,
         separate counter."""
-        start = time.monotonic()
+        start = pclock.mono()
         with self._request_scope(), self.state_lock:
             self.host_routed_count += 1
             result = self._golden_serve(data)
@@ -1382,7 +1383,7 @@ class AnalysisEngine:
     def _analyze_in_scope(
         self, data: PodFailureData, lock, request_id: str | None = None
     ) -> AnalysisResult:
-        start = time.monotonic()
+        start = pclock.mono()
         fp = self._quarantine_check(data)
         if fp is not None:
             with lock:
@@ -1508,7 +1509,7 @@ class AnalysisEngine:
         self.last_finalized = None
         result = self._golden_serve(data)
         self._note_golden(
-            start if start is not None else time.monotonic(),
+            start if start is not None else pclock.mono(),
             route, request_id, "fallback", error=type(exc).__name__,
         )
         return result
@@ -1518,7 +1519,7 @@ class AnalysisEngine:
         frequency read. Touches no shared mutable state beyond the
         ``_k_hint`` perf hint — safe to run concurrently with another
         request's :meth:`_finish`."""
-        start = time.monotonic()
+        start = pclock.mono()
         trace = PhaseTrace()
         with trace.phase("ingest"):
             faults.fire("ingest")  # conlint: contained-by-caller (serve handler / batcher bisection)

@@ -49,6 +49,7 @@ import zlib
 from collections import deque
 from typing import Callable
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.config import ScoringConfig
 from log_parser_tpu.golden.engine import GoldenFrequencyTracker
 from log_parser_tpu.runtime import faults, pressure
@@ -141,7 +142,7 @@ class FrequencyJournal:
         *,
         fsync_ms: float = 50.0,
         snapshot_every: int = 512,
-        wall: Callable[[], float] = time.time,
+        wall: Callable[[], float] = pclock.wall,
     ):
         self.state_dir = str(state_dir)
         self.fsync_ms = float(fsync_ms)
@@ -349,7 +350,7 @@ class FrequencyJournal:
 
     def _maintain(self) -> None:
         interval = max(0.001, self.fsync_ms / 1000.0)
-        while not self._stop.wait(interval):
+        while not pclock.wait(self._stop, interval):
             self.flush()
             if self._since_snapshot >= self.snapshot_every:
                 self.snapshot_now()

@@ -82,9 +82,10 @@ import threading
 import time
 import zlib
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.runtime.journal import _atomic_write
-from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT
+from log_parser_tpu.runtime.tenancy import DEFAULT_TENANT, TenantForwarded
 
 log = logging.getLogger(__name__)
 
@@ -290,14 +291,14 @@ def _quiesced(engine, timeout_s: float):
     top-level requests, wait for in-flight ones to drain, hold the gate
     for the export, release on exit. Mirrors ``apply_library``'s
     critical section without swapping anything."""
-    deadline = time.monotonic() + timeout_s
+    deadline = pclock.mono() + timeout_s
     with engine._quiesce_cv:
         if engine._swap_pending:
             raise MigrationError("a reload or migration is already quiescing")
         engine._swap_pending = True
         try:
             while engine._active_requests > 0:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - pclock.mono()
                 if remaining <= 0:
                     raise MigrationError(
                         f"migration quiesce timed out after {timeout_s:g}s "
@@ -438,7 +439,7 @@ class Migrator:
         state_root: str,
         node_url: str = "",
         quiesce_timeout_s: float = 30.0,
-        clock=time.monotonic,
+        clock=pclock.mono,
         crash_after=None,
     ):
         self.registry = registry
@@ -464,6 +465,20 @@ class Migrator:
         self.sessions_moved = 0
         self.sessions_closed = 0
         self.compacted = 0  # terminal journals truncated (boot + soft pressure)
+        # composition-root hooks: on_release is called as
+        # (tenant_id, location) whenever a durable verdict says the tenant
+        # moved off this node — wired to Replicator.release_tenant so the
+        # standby stops warming a tenant this node no longer owns (else a
+        # later promotion resurrects it); on_adopt is called as
+        # (tenant_id,) when a verdict says ownership came back, voiding
+        # any standing release
+        self.on_release = None
+        self.on_adopt = None
+        # on_primacy_check is called with no args before accepting an
+        # import; wired to Replicator.verify_primacy so a stale primary
+        # (standby promoted, demotion not yet observed) refuses the
+        # bundle pre-cutover instead of discovering the fence mid-adopt
+        self.on_primacy_check = None
         obs = getattr(registry.default_engine, "obs", None)
         if obs is not None:
             obs.add_stats_collector("migrate", self.stats, METRIC_SAMPLES)
@@ -473,6 +488,24 @@ class Migrator:
     def _crash(self, kind: str) -> None:
         if kind in self.crash_after:
             raise MigrationCrash(f"injected crash after {kind!r} record")
+
+    def _notify_release(self, tenant_id: str, location: str) -> None:
+        if self.on_release is None or not tenant_id or not location:
+            return
+        try:
+            self.on_release(tenant_id, location)
+        except Exception:  # pragma: no cover - hook must not break cutover
+            log.exception(
+                "release hook failed for %r -> %r", tenant_id, location
+            )
+
+    def _notify_adopt(self, tenant_id: str) -> None:
+        if self.on_adopt is None or not tenant_id:
+            return
+        try:
+            self.on_adopt(tenant_id)
+        except Exception:  # pragma: no cover - hook must not break import
+            log.exception("adopt hook failed for %r", tenant_id)
 
     def _spans(self):
         obs = getattr(self.registry.default_engine, "obs", None)
@@ -542,9 +575,18 @@ class Migrator:
             if ctx is not None:
                 ctx.pin()
             else:
-                # a cold tenant still migrates: build it warm from disk so
-                # its folded state travels (resolve pins for us)
-                ctx = self.registry.resolve(tenant_id)
+                try:
+                    # a cold tenant still migrates: build it warm from disk
+                    # so its folded state travels (resolve pins for us)
+                    ctx = self.registry.resolve(tenant_id)
+                except TenantForwarded as exc:
+                    # a fence (demoted node) or forward installed outside
+                    # the migration plane: this node cannot export what it
+                    # does not own — same refusal as the forward_for guard
+                    raise MigrationError(
+                        f"tenant {tenant_id!r} is not owned here"
+                        f" ({exc})", 409
+                    ) from exc
             return self._migrate_pinned(
                 tenant_id, ctx, target, retry_after_s, timeout_s, mid
             )
@@ -557,7 +599,7 @@ class Migrator:
         with self._lock:
             self._seq += 1
             mid = mid or f"m{self._seq:06d}-{tenant_id}"
-        t0 = time.monotonic()
+        t0 = pclock.mono()
         self.started += 1
         jr = MigrationJournal(self._src_path(mid))
         eng = ctx.engine
@@ -608,6 +650,11 @@ class Migrator:
         # below must converge even if it fails here — recover() finishes
         # the same steps from the journal + bundle.
         self.registry.set_forward(tenant_id, target.url, int(retry_after_s))
+        # release at the commit point, not at COMPLETE: ownership moved
+        # with the CUTOVER record, and a crash anywhere between here and
+        # COMPLETE must not leave the standby believing the tenant is
+        # still pair-owned (a later promotion would resurrect it empty)
+        self._notify_release(tenant_id, target.url)
         ctx.unpin()
         moved, closed = self._hand_off_sessions(tenant_id, eng, target)
         if spans is not None:
@@ -626,7 +673,7 @@ class Migrator:
         self.completed += 1
         if spans is not None:
             spans.end_trace(
-                trace, duration_s=time.monotonic() - t0, tenant=tenant_id,
+                trace, duration_s=pclock.mono() - t0, tenant=tenant_id,
                 name="migration",
                 attrs={"outcome": "completed", "target": target.url,
                        "sessionsMoved": moved, "sessionsClosed": closed},
@@ -657,7 +704,7 @@ class Migrator:
         spans = self._spans()
         if spans is not None:
             spans.end_trace(
-                f"migrate:{mid}", duration_s=time.monotonic() - t0,
+                f"migrate:{mid}", duration_s=pclock.mono() - t0,
                 tenant=tenant_id, name="migration",
                 attrs={"outcome": "aborted", "reason": repr(exc)[:128]},
                 force=True,
@@ -757,6 +804,44 @@ class Migrator:
         tenant_id = str(bundle.get("tenant") or "")
         if not mid or not tenant_id:
             raise MigrationError("bundle missing mid/tenant", 400)
+        dst_path = self._dst_path(mid)
+        if os.path.exists(dst_path):
+            kinds = {r.get("k") for r in MigrationJournal.replay(dst_path)}
+            if "applied" in kinds:
+                # a re-sent handoff: the source crashed after our APPLIED
+                # record and is resuming from its journal. The import is
+                # already live — possibly with traffic served since — so
+                # ack idempotently and NEVER re-apply the stale bundle
+                return {"mid": mid, "tenant": tenant_id, "sha": sha,
+                        "alreadyApplied": True}
+            if "discard" in kinds:
+                # a previous attempt at this mid died pre-activation and
+                # was sealed on boot: this re-stage is a fresh attempt,
+                # not a continuation of a dead journal
+                os.unlink(dst_path)
+        if self.registry.fence_for() is not None:
+            # a fenced process (demoted replica) is stale by definition:
+            # importing a tenant onto it would hand ownership to a node
+            # that 307s every request. Refuse pre-cutover — the source
+            # keeps the tenant and aborts cleanly.
+            raise MigrationError(
+                "target is fenced (demoted replica): refusing import", 409
+            )
+        if self.on_primacy_check is not None:
+            try:
+                primary = bool(self.on_primacy_check())
+            except Exception:  # pragma: no cover - probe must not 500 stage
+                log.exception("primacy probe failed; accepting import")
+                primary = True
+            if not primary:
+                # stale (peer promoted — the probe demoted us), or the
+                # peer is unreachable so primacy is unconfirmable: either
+                # way refuse before the source cuts over; the tenant
+                # stays at the (healthy, servable) source
+                raise MigrationError(
+                    "target cannot confirm pair primacy:"
+                    " refusing import", 409
+                )
         jr = MigrationJournal(self._dst_path(mid))
         jr.append("stage", mid=mid, tenant=tenant_id, sha=sha)
         self._crash("stage")
@@ -817,6 +902,7 @@ class Migrator:
         # ignore_forward: on a round-trip the target may still hold its
         # own stale outbound forward for this tenant; verification is an
         # internal resolution, not traffic routing
+        was_resident = self.registry.context_if_resident(tenant_id) is not None
         ctx = self.registry.resolve(tenant_id, ignore_forward=True)
         try:
             have_key = library_key(
@@ -829,6 +915,14 @@ class Migrator:
                 )
         finally:
             ctx.unpin()
+        if not was_resident:
+            # the verify build must not leave the tenant resident before
+            # ACTIVATE: ownership hasn't moved yet, and a source crash
+            # here would otherwise strand a warm, EMPTY engine on the
+            # target accepting whatever traffic reaches it directly
+            detached = self.registry.detach(tenant_id)
+            if detached is not None:
+                detached.close()
 
     def activate(self, mid: str) -> dict:
         """Target half, step two (runs only after the source's CUTOVER
@@ -840,6 +934,15 @@ class Migrator:
             bundle = self._staged.pop(mid, None)
             jr = self._dst_journals.pop(mid, None)
         if bundle is None:
+            path = self._dst_path(mid)
+            if os.path.exists(path):
+                records = MigrationJournal.replay(path)
+                if any(r.get("k") == "applied" for r in records):
+                    # idempotent ack for a resumed handoff (see
+                    # stage_import): the import already went live here
+                    return {"mid": mid,
+                            "tenant": records[0].get("tenant"),
+                            "alreadyApplied": True}
             raise MigrationError(f"no staged import {mid!r}", 404)
         if jr is None:  # pragma: no cover - staged and journal travel together
             jr = MigrationJournal(self._dst_path(mid))
@@ -865,8 +968,10 @@ class Migrator:
         # a round-trip (A -> B -> A) lands here with A still holding its
         # own stale forward from the outbound leg; becoming the owner
         # supersedes it — clear before resolve, which would otherwise
-        # answer 307 for a tenant this process now owns
+        # answer 307 for a tenant this process now owns. The adopt hook
+        # durably voids any standing replication release the same way.
         self.registry.clear_forward(tenant_id)
+        self._notify_adopt(tenant_id)
         ctx = self.registry.resolve(tenant_id)
         try:
             eng = ctx.engine
@@ -979,7 +1084,7 @@ class Migrator:
         forwards and appends nothing new to an already-sealed journal.
         """
         summary = {"forwards": [], "resumed": [], "discarded": [],
-                   "pending": []}
+                   "pending": [], "owned": []}
         try:
             names = sorted(os.listdir(self.dir))
         except OSError:
@@ -1002,6 +1107,16 @@ class Migrator:
             if verdict is None:
                 continue
             tenant_id, kind, location, retry_after = verdict
+            if os.environ.get("LOG_PARSER_TPU_SIM_BUG_FORWARD_RESURRECTION"):
+                # regression lever for the simulator ONLY: reintroduce the
+                # pre-fix behaviour — forwards installed per-journal in
+                # replay order with no latest-verdict arbitration, so an
+                # A→B→A round trip plus a reboot resurrects the stale
+                # forward (the PR 17 fix-3 bug)
+                if kind == "forward":
+                    self.registry.set_forward(tenant_id, location, retry_after)
+                    summary["forwards"].append(tenant_id)
+                continue
             prev = verdicts.get(tenant_id)
             if prev is None or mtime >= prev[0]:
                 verdicts[tenant_id] = (mtime, kind, location, retry_after)
@@ -1014,6 +1129,11 @@ class Migrator:
                 # this node re-imported the tenant after forwarding it
                 # out: ownership came back, the old forward is stale
                 self.registry.clear_forward(tenant_id)
+                summary["owned"].append(tenant_id)
+            # NOTE: no release/adopt hooks here — boot-time verdicts are
+            # replayed by the composition root AFTER the replicator
+            # recovers (with ship deferred), so recover() never runs the
+            # epoch handshake mid-replay
         return summary
 
     def _recover_source(
@@ -1198,7 +1318,7 @@ class DrainSupervisor:
         deadline_s: float = 30.0,
         retry_after_s: int = 5,
         span_dump_path: str | None = None,
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         self.registry = registry
         self.migrator = migrator
@@ -1383,7 +1503,7 @@ class DrainSupervisor:
         non-None verdict runs one drain pass and the watch exits."""
 
         def _loop():
-            while not self._watch_stop.wait(poll_s):
+            while not pclock.wait(self._watch_stop, poll_s):
                 if self.draining:
                     return
                 try:

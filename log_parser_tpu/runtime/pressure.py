@@ -77,6 +77,7 @@ import threading
 import time
 from typing import Callable
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.runtime import faults
 
 log = logging.getLogger(__name__)
@@ -242,7 +243,7 @@ class PressureController:
         mem_soft_mb: float = 0.0,
         retry_ratio: float = 0.1,
         poll_s: float = 1.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         self.state_dir = str(state_dir) if state_dir else None
         self.disk_soft_bytes = max(0, int(float(disk_soft_mb) * 2**20))
@@ -584,7 +585,7 @@ class PressureController:
         return self
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while not pclock.wait(self._stop, self.poll_s):
             self.poll()
 
     def stop(self) -> None:

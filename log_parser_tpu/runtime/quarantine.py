@@ -44,6 +44,7 @@ import time
 from collections import OrderedDict
 from typing import Callable
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.native.ingest import normalize_blob
 
 DEFAULT_STRIKES = 2
@@ -107,7 +108,7 @@ class QuarantineTable:
         strikes: int = DEFAULT_STRIKES,
         ttl_s: float = DEFAULT_TTL_S,
         capacity: int = DEFAULT_CAPACITY,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         self.threshold = max(1, int(strikes))
         self.ttl_s = float(ttl_s)
@@ -213,7 +214,7 @@ class PatternBreakerBoard:
     def __init__(
         self,
         cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
     ):
         self.cooldown_s = max(0.0, float(cooldown_s))
         self.clock = clock

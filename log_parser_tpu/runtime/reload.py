@@ -38,6 +38,7 @@ import time
 
 import yaml
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.models.pattern import PatternSet
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.patterns.loader import (
@@ -194,7 +195,7 @@ def build_candidate(
         if not sets:
             raise ValueError("no pattern sets")
         source = AnalysisEngine(
-            sets, config, clock=engine_clock or time.monotonic
+            sets, config, clock=engine_clock or pclock.mono
         )
         # canary must not hide device failures behind the host fallback
         source.fallback_to_golden = False
@@ -387,7 +388,7 @@ class PatternWatcher:
         return self
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not pclock.wait(self._stop, self.interval_s):
             sig = self._signature()
             if sig == self._last_sig:
                 continue

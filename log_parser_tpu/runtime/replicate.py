@@ -64,6 +64,7 @@ import urllib.request
 import zlib
 from typing import Callable
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.runtime import faults, pressure
 from log_parser_tpu.runtime.journal import _FRAME, _MAX_PAYLOAD, apply_record
 from log_parser_tpu.runtime.migrate import MigrationJournal, _frame_records
@@ -84,8 +85,10 @@ REPLICA_DIR = "_replica"
 EPOCH_JOURNAL = "epoch.wal"
 
 # protocol journal record kinds, in the order a failover writes them —
-# the crash-matrix axis in tests/test_replicate.py
-PROTOCOL_RECORDS = ("epoch", "promote", "demote")
+# the crash-matrix axis in tests/test_replicate.py ("release" is written
+# by either side when a tenant migrates off the replication pair;
+# "adopt" voids a standing release when the tenant migrates back on)
+PROTOCOL_RECORDS = ("epoch", "promote", "demote", "release", "adopt")
 
 _MAX_BATCH_BYTES = 8 << 20
 _BACKOFF_BASE_S = 0.25
@@ -335,6 +338,12 @@ class ReplicaSender:
         self._note_lag(size, payloads, consumed)
         if consumed == 0:
             if data:
+                if os.environ.get("LOG_PARSER_TPU_SIM_BUG_MISALIGNED_WEDGE"):
+                    # regression lever for the simulator ONLY: reintroduce
+                    # the pre-fix behaviour (misaligned resume reports
+                    # "idle" forever instead of reseeding) so sim sweeps
+                    # can prove they rediscover the historical wedge
+                    return "idle"
                 # bytes are pending but no whole frame parses at our
                 # resume point: the offset is misaligned (a corrupt ack
                 # bookkeeping, never a torn append — the journal writes
@@ -473,8 +482,8 @@ class Replicator:
         node_url: str = "",
         peer_url: str | None = None,
         target=None,
-        clock: Callable[[], float] = time.monotonic,
-        wall: Callable[[], float] = time.time,
+        clock: Callable[[], float] = pclock.mono,
+        wall: Callable[[], float] = pclock.wall,
         crash_after=None,
         pump_interval_s: float = 0.2,
     ):
@@ -494,6 +503,14 @@ class Replicator:
         self._senders: dict[str, ReplicaSender] = {}
         self._feeds: dict[str, _TenantFeed] = {}
         self._known_tenants: set[str] = set()
+        # tenants that migrated OFF this replication pair: location of the
+        # new owner, journaled on both sides so a promotion installs a
+        # forward instead of resurrecting the departed tenant's stale
+        # state (the cross-plane migration x failover hazard)
+        self._released: dict[str, str] = {}
+        self._release_pending: dict[str, str] = {}  # primary: awaiting ship
+        self._adopt_pending: set[str] = set()  # un-releases awaiting ship
+        self.releases = 0
         self.supervisor: FailoverSupervisor | None = None
         # counters
         self.applied_batches = 0
@@ -526,12 +543,168 @@ class Replicator:
         if self.target is None or getattr(engine, "journal", None) is None:
             return None
         with self._lock:
+            # a tenant coming (back) up locally is owned here again: any
+            # standing release is void — durably (ADOPT record), or a
+            # reboot would replay the stale release forward — and the
+            # resumed shipping stream clears it on the standby too
+            # (feed-side un-release)
+            self._adopt_locked(tenant_id)
             sender = self._senders.get(tenant_id)
             if sender is None:
                 sender = ReplicaSender(self, tenant_id, engine, self.target)
                 self._senders[tenant_id] = sender
                 self._known_tenants.add(tenant_id)
             return sender
+
+    def _adopt_locked(self, tenant_id: str) -> bool:
+        """Void a standing release for ``tenant_id`` (caller holds
+        ``_lock``). Journals an ADOPT record so the un-release survives a
+        reboot and queues the notice for the standby (whose own journal
+        still says released); returns True when a release stood."""
+        self._release_pending.pop(tenant_id, None)
+        if self._released.pop(tenant_id, None) is None:
+            return False
+        self._journal.append("adopt", epoch=self.epoch, tenant=tenant_id)
+        self._crash("adopt")
+        if self.target is not None:
+            self._adopt_pending.add(tenant_id)
+        return True
+
+    def adopt_tenant(self, tenant_id: str, *, ship: bool = True) -> None:
+        """The tenant is owned here again (migrated back, or a boot-time
+        ownership verdict said so): durably void any standing release and
+        drop its forward. Idempotent; wired to ``Migrator.on_adopt`` by
+        the composition root. ``ship=False`` defers the standby notice to
+        the next pump round (boot-time verdict replay must not run the
+        epoch handshake mid-recover)."""
+        if not tenant_id:
+            return
+        with self._lock:
+            if not self._adopt_locked(tenant_id):
+                return
+        if tenant_id != DEFAULT_TENANT:
+            self.registry.clear_forward(tenant_id)
+        if ship:
+            self._ship_releases()
+
+    def release_tenant(self, tenant_id: str, location: str, *,
+                       ship: bool = True) -> None:
+        """The tenant migrated off this node: stop shipping its WAL and
+        tell the standby durably (journal-then-ship) so a later promotion
+        installs a forward to ``location`` instead of resurrecting the
+        departed tenant's stale replica state. Idempotent; wired to
+        ``Migrator.on_release`` by the composition root. ``ship=False``
+        defers the standby notice to the next pump round (boot-time
+        verdict replay must not run the epoch handshake mid-recover)."""
+        if not tenant_id or tenant_id == DEFAULT_TENANT or not location:
+            return
+        with self._lock:
+            if self._released.get(tenant_id) != location:
+                self._journal.append(
+                    "release", epoch=self.epoch, tenant=tenant_id,
+                    location=location,
+                )
+                self._crash("release")
+                self._released[tenant_id] = location
+                self.releases += 1
+            self._senders.pop(tenant_id, None)
+            self._feeds.pop(tenant_id, None)
+            self._known_tenants.discard(tenant_id)
+            self._adopt_pending.discard(tenant_id)
+            if self.target is not None:
+                self._release_pending[tenant_id] = location
+        if ship:
+            # ship the notice NOW, not on the next pump round: the window
+            # between cutover and the standby learning of it is exactly
+            # the window a promotion resurrects the departed tenant.
+            # Best-effort — an unreachable standby leaves it pending for
+            # the pump to retry.
+            self._ship_releases()
+
+    def verify_primacy(self) -> bool:
+        """Confirm with the standby that this process is still the pair
+        primary before an *elective* ownership change (wired to
+        ``Migrator.on_primacy_check`` so a stale primary refuses a
+        migration import pre-cutover instead of discovering the
+        promotion mid-adopt). Deliberately CP: in a two-node pair an
+        unreachable standby is indistinguishable from a promoted one, so
+        an unanswered probe refuses — the tenant stays at the (healthy,
+        servable) source. Live traffic never pays this: only ownership
+        changes require a confirmed epoch. When the probe surfaces a
+        higher epoch the stale primary demotes on the spot."""
+        if self.role != "primary":
+            return False
+        if self.target is None:
+            return True  # unpaired node: nothing to be stale against
+        body = {"tenant": DEFAULT_TENANT, "epoch": self.epoch,
+                "probe": True, "wall": self.wall()}
+        try:
+            status, doc = self.target.feed(body)
+        except ReplicationError:
+            return False  # unreachable: primacy unconfirmable, refuse
+        if status == 200:
+            return True
+        if not isinstance(doc, dict):
+            return False
+        try:
+            peer_epoch = int(doc.get("epoch", -1))
+        except (TypeError, ValueError):
+            peer_epoch = -1
+        if peer_epoch > self.epoch:
+            self.demote(
+                peer_epoch,
+                str(doc.get("location") or getattr(self.target, "url", "")),
+            )
+        return False
+
+    def _ship_releases(self) -> dict[str, str]:
+        """Push pending release/adopt notices to the standby (retried on
+        every pump round until acked; the receiver is idempotent)."""
+        if self.target is None or self.role != "primary":
+            return {}
+        with self._lock:
+            notices = [(tid, loc) for tid, loc
+                       in sorted(self._release_pending.items())]
+            notices += [(tid, None) for tid in sorted(self._adopt_pending)]
+        out: dict[str, str] = {}
+        for tid, loc in notices:
+            body = {"tenant": tid, "epoch": self.epoch, "wall": self.wall()}
+            if loc is None:
+                body["adopt"] = True
+            else:
+                body["release"] = loc
+            try:
+                status, doc = self.target.feed(body)
+            except ReplicationError as exc:
+                out[tid] = f"error: {exc.reason[:80]}"
+                continue
+            if not isinstance(doc, dict):
+                doc = {}
+            if status == 200:
+                with self._lock:
+                    if loc is None:
+                        self._adopt_pending.discard(tid)
+                    else:
+                        self._release_pending.pop(tid, None)
+                out[tid] = "adopted" if loc is None else "released"
+                continue
+            try:
+                peer_epoch = int(doc.get("epoch", -1))
+            except (TypeError, ValueError):
+                peer_epoch = -1
+            if peer_epoch > self.epoch:
+                # the standby promoted meanwhile: we are stale — step
+                # down; the notice is already durable in our journal and
+                # the new primary's own replay governs from here
+                self.demote(
+                    peer_epoch,
+                    str(doc.get("location")
+                        or getattr(self.target, "url", "")),
+                )
+                out[tid] = "demoted"
+                break
+            out[tid] = f"rejected ({status})"
+        return out
 
     # ------------------------------------------------------------ receiver
 
@@ -568,6 +741,16 @@ class Replicator:
                 location=self.node_url,
             )
         with self._lock:
+            if body.get("probe"):
+                # primacy probe (no payload): answer with our epoch so a
+                # stale primary demotes BEFORE acting on the belief that
+                # it still owns the pair (e.g. accepting a migration)
+                if feed_epoch < self.epoch:
+                    raise ReplicationError(
+                        "stale ownership epoch", status=409,
+                        epoch=self.epoch, location=self.node_url,
+                    )
+                return {"epoch": self.epoch, "role": self.role}
             if feed_epoch < self.epoch:
                 raise ReplicationError(
                     "stale ownership epoch", status=409,
@@ -586,8 +769,45 @@ class Replicator:
                 self._crash("epoch")
                 self.epoch = feed_epoch
                 self.adoptions += 1
+            release = body.get("release")
+            if release is not None:
+                # the tenant migrated off the primary: journal the new
+                # owner's location and drop the warm replica, so a later
+                # promotion forwards instead of resurrecting stale state
+                if not isinstance(release, str) or not release:
+                    raise ReplicationError("malformed release", status=400)
+                if self._released.get(tenant) != release:
+                    self._journal.append(
+                        "release", epoch=self.epoch, tenant=tenant,
+                        location=release,
+                    )
+                    self._crash("release")
+                    self._released[tenant] = release
+                    self.releases += 1
+                self._feeds.pop(tenant, None)
+                self._known_tenants.discard(tenant)
+                if tenant != DEFAULT_TENANT:
+                    self.registry.set_forward(tenant, release)
+                    detached = self.registry.detach(tenant)
+                    if detached is not None:
+                        detached.close()
+                return {"released": tenant, "epoch": self.epoch}
+            if body.get("adopt"):
+                # the tenant migrated back onto the primary: durably void
+                # the release and point its forward back at the pair
+                # primary (the blanket standby stance), not the stale
+                # migrated-to location
+                self._adopt_locked(tenant)
+                self._known_tenants.add(tenant)
+                self._refence_tenant(tenant)
+                return {"adopted": tenant, "epoch": self.epoch}
             st = self._feeds.setdefault(tenant, _TenantFeed())
             self._known_tenants.add(tenant)
+            # a live feed for a previously-released tenant implies the
+            # adopt: void the release durably, else a standby reboot
+            # replays the stale forward
+            if self._adopt_locked(tenant):
+                self._refence_tenant(tenant)
             t0 = time.perf_counter()
             now = self.wall()
             barrier = body.get("barrier")
@@ -632,8 +852,12 @@ class Replicator:
                 # all-or-nothing staged copy, same arithmetic a local
                 # replay of the identical prefix performs
                 drift = max(0.0, now - st.wall) if st.wall else 0.0
+                # clamp stored ages too: a seed snapshot cut while the wall
+                # clock was stepped back can carry a negative age, which
+                # would otherwise become a future timestamp on promote
                 staged = {
-                    pid: [a + drift for a in ages] for pid, ages in st.ages.items()
+                    pid: [max(0.0, a) + drift for a in ages]
+                    for pid, ages in st.ages.items()
                 }
                 for payload in payloads:
                     apply_record(staged, payload, now)
@@ -712,7 +936,10 @@ class Replicator:
                 ) from exc
             t0 = self.clock()
             new_epoch = self.epoch + 1
-            tenants = sorted(self._known_tenants | set(self._feeds))
+            tenants = sorted(
+                (self._known_tenants | set(self._feeds))
+                - set(self._released)
+            )
             self._journal.append(
                 "promote", epoch=new_epoch, reason=reason, tenants=tenants
             )
@@ -747,7 +974,8 @@ class Replicator:
                 return {"status": "standby", "epoch": self.epoch}
             t0 = self.clock()
             tenants = sorted(
-                self._known_tenants | set(self._feeds) | set(self._senders)
+                (self._known_tenants | set(self._feeds) | set(self._senders))
+                - set(self._released)
             )
             self._journal.append(
                 "demote", epoch=int(new_epoch), location=location,
@@ -809,6 +1037,16 @@ class Replicator:
             if tid != DEFAULT_TENANT and self.peer_url:
                 self.registry.set_forward(tid, self.peer_url)
 
+    def _refence_tenant(self, tid: str) -> None:
+        """Restore the blanket standby stance for one re-adopted tenant:
+        forward to the pair primary (replacing a stale release forward)."""
+        if tid == DEFAULT_TENANT:
+            return
+        if self.role == "standby" and self.peer_url:
+            self.registry.set_forward(tid, self.peer_url)
+        else:
+            self.registry.clear_forward(tid)
+
     def arm_failover(
         self, primary_url: str, *, after_s: float, poll_s: float = 1.0
     ) -> "FailoverSupervisor":
@@ -827,6 +1065,8 @@ class Replicator:
         the record as the single source of truth)."""
         records = MigrationJournal.replay(self._journal.path)
         role_rec: dict | None = None
+        released: dict[str, str] = {}
+        adopted: set[str] = set()
         for rec in records:
             try:
                 e = int(rec.get("epoch", 0))
@@ -836,8 +1076,30 @@ class Replicator:
                 self.epoch = e
             if rec.get("k") in ("promote", "demote"):
                 role_rec = rec
+            if rec.get("k") == "release":
+                tid = str(rec.get("tenant") or "")
+                loc = str(rec.get("location") or "")
+                if tid and loc:
+                    released[tid] = loc
+                    adopted.discard(tid)
+            if rec.get("k") == "adopt":
+                tid = str(rec.get("tenant") or "")
+                if tid:
+                    released.pop(tid, None)
+                    adopted.add(tid)
+            for tid, loc in (rec.get("releases") or {}).items():
+                released[str(tid)] = str(loc)
+                adopted.discard(str(tid))
             for tid in rec.get("tenants") or ():
                 self._known_tenants.add(str(tid))
+        # strip released tenants BEFORE re-running role side effects:
+        # neither activation nor peer-fencing may touch a tenant that
+        # migrated off the pair
+        for tid in released:
+            self._known_tenants.discard(tid)
+            self._feeds.pop(tid, None)
+            self._senders.pop(tid, None)
+        self._released.update(released)
         if role_rec is not None:
             if role_rec.get("k") == "promote":
                 self.role = "primary"
@@ -852,11 +1114,26 @@ class Replicator:
             # never promoted/demoted: a boot-time standby fences until
             # it is promoted
             self._fence_all(sorted(self._known_tenants))
+        # released tenants forward to their migrated-to owner — applied
+        # AFTER the role side effects so the release forward wins over
+        # the standby's blanket peer forwards; a recovered primary also
+        # re-queues the notice (the receiver is idempotent)
+        for tid, loc in sorted(released.items()):
+            if tid != DEFAULT_TENANT:
+                self.registry.set_forward(tid, loc)
+            if self.target is not None:
+                self._release_pending[tid] = loc
+        if self.target is not None:
+            # re-queue the adopt notices too: the standby's journal may
+            # still say released (the notice never shipped before the
+            # crash); over-notifying is idempotent on the receiver
+            self._adopt_pending.update(adopted - set(released))
         summary = {
             "role": self.role,
             "epoch": self.epoch,
             "records": len(records),
             "tenants": sorted(self._known_tenants),
+            "released": sorted(released),
         }
         log.info("replication recover: %s", summary)
         return summary
@@ -885,6 +1162,7 @@ class Replicator:
             max_epoch = self.epoch
             role_rec: dict | None = None
             tenants: set[str] = set()
+            released: dict[str, str] = {}
             for rec in records:
                 try:
                     e = int(rec.get("epoch", 0))
@@ -893,6 +1171,15 @@ class Replicator:
                 max_epoch = max(max_epoch, e)
                 if rec.get("k") in ("promote", "demote"):
                     role_rec = rec
+                if rec.get("k") == "release":
+                    tid = str(rec.get("tenant") or "")
+                    loc = str(rec.get("location") or "")
+                    if tid and loc:
+                        released[tid] = loc
+                if rec.get("k") == "adopt":
+                    released.pop(str(rec.get("tenant") or ""), None)
+                for tid, loc in (rec.get("releases") or {}).items():
+                    released[str(tid)] = str(loc)
                 for tid in rec.get("tenants") or ():
                     tenants.add(str(tid))
             terminal: dict = {
@@ -900,6 +1187,8 @@ class Replicator:
                 "epoch": max_epoch,
                 "tenants": sorted(tenants),
             }
+            if released:
+                terminal["releases"] = dict(sorted(released.items()))
             if role_rec is not None:
                 if role_rec.get("location"):
                     terminal["location"] = role_rec["location"]
@@ -939,7 +1228,11 @@ class Replicator:
             self.supervisor.start()
 
     def _pump_loop(self) -> None:
-        while not self._stop_evt.wait(self.pump_interval_s):
+        while not pclock.wait(self._stop_evt, self.pump_interval_s):
+            try:
+                self._ship_releases()
+            except Exception:
+                log.exception("release ship round failed")
             for sender in list(self._senders.values()):
                 try:
                     sender.pump()
@@ -949,8 +1242,17 @@ class Replicator:
                     )
 
     def pump_all(self) -> dict[str, str]:
-        """One synchronous round over every sender (tests, drills)."""
-        return {tid: s.pump() for tid, s in list(self._senders.items())}
+        """One synchronous round over every sender (tests, drills) —
+        pending release notices ship first, so the standby stops warming
+        a tenant before its successor state ships a single frame."""
+        out: dict[str, str] = {
+            tid: f"release:{status}"
+            for tid, status in self._ship_releases().items()
+        }
+        out.update(
+            {tid: s.pump() for tid, s in list(self._senders.items())}
+        )
+        return out
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -1073,7 +1375,7 @@ class FailoverSupervisor:
         *,
         after_s: float,
         poll_s: float = 1.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = pclock.mono,
         probe: Callable[[], bool] | None = None,
     ):
         self.replicator = replicator
@@ -1120,7 +1422,7 @@ class FailoverSupervisor:
     def start(self) -> threading.Thread:
         if self._thread is None:
             def loop():
-                while not self._stop_evt.wait(self.poll_s):
+                while not pclock.wait(self._stop_evt, self.poll_s):
                     try:
                         if self.check_once() == "promoted":
                             return

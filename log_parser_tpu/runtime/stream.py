@@ -63,6 +63,7 @@ import uuid
 
 import numpy as np
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.golden.engine import (
     build_metadata,
     build_summary,
@@ -143,8 +144,8 @@ class StreamSession:
         self.emit_threshold = float(emit_threshold)
         self.manager = manager
         self._lock = threading.RLock()
-        self._start = time.monotonic()
-        self.last_active = manager.clock() if manager else time.monotonic()
+        self._start = pclock.mono()
+        self.last_active = manager.clock() if manager else pclock.mono()
 
         self._normalizer = StreamNormalizer()
         self._text = ""  # full decoded window (the would-be blob)
@@ -238,7 +239,7 @@ class StreamSession:
 
     def _touch(self) -> None:
         self.last_active = (
-            self.manager.clock() if self.manager else time.monotonic()
+            self.manager.clock() if self.manager else pclock.mono()
         )
 
     # ----------------------------------------------------------- span hooks
@@ -263,7 +264,7 @@ class StreamSession:
         eng = self.engine
         eng.obs.spans.end_trace(
             self.session_id,
-            duration_s=time.monotonic() - self._start,
+            duration_s=pclock.mono() - self._start,
             tenant=eng.obs_tenant,
             name="session",
             attrs={
@@ -830,7 +831,7 @@ class StreamManager:
         engine,
         emit_threshold: float = DEFAULT_EMIT_THRESHOLD,
         ttl_s: float = DEFAULT_STREAM_TTL_S,
-        clock=time.monotonic,
+        clock=pclock.mono,
         start_reaper: bool = True,
     ):
         self.engine = engine
@@ -962,6 +963,12 @@ class StreamManager:
             return 0
         now = self.clock()
         with self._lock:
+            # Clock stepped backwards (injected/wall clocks only — the
+            # default is monotonic): rebase instead of letting the negative
+            # idle age shield the session from the TTL forever.
+            for s in self._sessions.values():
+                if s.last_active > now:
+                    s.last_active = now
             stale = [
                 s for s in self._sessions.values()
                 if now - s.last_active > self.ttl_s
@@ -972,7 +979,7 @@ class StreamManager:
 
     def _reap_loop(self) -> None:
         interval = max(0.05, min(self.ttl_s / 4.0, 1.0))
-        while not self._stop.wait(interval):
+        while not pclock.wait(self._stop, interval):
             self.reap_now()
 
     def shutdown(self) -> None:

@@ -49,6 +49,7 @@ import time
 
 import numpy as np
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.runtime import faults
 
 log = logging.getLogger(__name__)
@@ -130,7 +131,7 @@ class TenantQuota:
         max_queued: int = 0,
         lines_per_s: float = 0.0,
         burst_s: float = 2.0,
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         self.max_inflight = int(max_inflight)
         self.max_queued = int(max_queued)
@@ -364,7 +365,7 @@ class TenantRegistry:
         engine_setup=None,
         quota_factory=None,
         lint_mode: str = "warn",
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         self.default_engine = default_engine
         self.root = root
@@ -534,7 +535,7 @@ class TenantRegistry:
                 f"tenant {tenant_id!r} has no pattern sets in {lib_dir!r}", 404
             )
         t0 = self.clock()
-        wt0 = time.monotonic()
+        wt0 = pclock.mono()
         eng = AnalysisEngine(
             sets, self.default_engine.config, clock=self.clock
         )
@@ -568,7 +569,7 @@ class TenantRegistry:
             # repeated tenant_build/tenant_evict trees for one id
             primary_obs.spans.end_trace(
                 f"tenant:{tenant_id}",
-                duration_s=time.monotonic() - wt0,
+                duration_s=pclock.mono() - wt0,
                 tenant=tenant_id,
                 name="tenant_build",
                 attrs={
@@ -663,13 +664,13 @@ class TenantRegistry:
                 "rebuilds from the library snapshot",
                 victim, ctx.bank_bytes / 2**20,
             )
-            t0 = time.monotonic()
+            t0 = pclock.mono()
             ctx.close()
             obs = getattr(self.default_engine, "obs", None)
             if obs is not None:
                 obs.spans.end_trace(
                     f"tenant:{victim}",
-                    duration_s=time.monotonic() - t0,
+                    duration_s=pclock.mono() - t0,
                     tenant=victim,
                     name="tenant_evict",
                     attrs={"bankBytes": ctx.bank_bytes,

@@ -1004,6 +1004,22 @@ def main(argv: list[str] | None = None) -> int:
         pressure_ctl.register_compactor(
             "epoch", replicator.compact_epoch_journal
         )
+        if migrator is not None:
+            # cross-plane wiring: a tenant cut over to another node must
+            # stop shipping here AND be released on the standby, or a later
+            # promotion resurrects the departed tenant's stale replica; a
+            # tenant migrated back durably voids its release. Replay the
+            # boot-recovered ownership verdicts through the same hooks
+            # (migrator.recover() ran before the replicator existed).
+            migrator.on_release = replicator.release_tenant
+            migrator.on_adopt = replicator.adopt_tenant
+            migrator.on_primacy_check = replicator.verify_primacy
+            for tid in recovered.get("forwards", ()):
+                fwd = tenants.forward_for(tid)
+                if fwd:
+                    replicator.release_tenant(tid, fwd[0], ship=False)
+            for tid in recovered.get("owned", ()):
+                replicator.adopt_tenant(tid, ship=False)
         replicator.start()
         log.info(
             "Replication role %s at epoch %d (%d protocol record(s) "

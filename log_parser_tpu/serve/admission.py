@@ -50,6 +50,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from log_parser_tpu import _clock as pclock
 
 ENV_MAX_INFLIGHT = "LOG_PARSER_TPU_MAX_INFLIGHT"
 ENV_MAX_QUEUE = "LOG_PARSER_TPU_MAX_QUEUE"
@@ -86,7 +87,7 @@ class AdmissionController:
         max_queue: int = 0,
         default_deadline_ms: float = 0.0,
         drain_deadline_s: float = 10.0,
-        clock=time.monotonic,
+        clock=pclock.mono,
     ):
         self.max_inflight = int(max_inflight)
         self.max_queue = int(max_queue)

@@ -42,6 +42,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu import native
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.obs import SPANS
@@ -1097,7 +1098,7 @@ class _Handler(BaseHTTPRequestHandler):
         rid = obs.clean_request_id(self.headers.get("X-Request-Id"))
         if rid is None:
             rid = obs.new_request_id()
-        started = time.monotonic()
+        started = pclock.mono()
         tenant = "default"
         route = "device"
 
@@ -1109,7 +1110,7 @@ class _Handler(BaseHTTPRequestHandler):
                 route,
                 status,
                 tenant,
-                time.monotonic() - started,
+                pclock.mono() - started,
                 request_id=rid,
                 detail=detail,
             )
@@ -1153,7 +1154,7 @@ class _Handler(BaseHTTPRequestHandler):
         engine = ctx.engine
         batcher = getattr(engine, "batcher", None)
         n_lines = (data.logs.count("\n") + 1) if data.logs else 0
-        arrival = time.monotonic()
+        arrival = pclock.mono()
         try:
             route = self.server.admission.acquire(
                 deadline_ms,
@@ -1169,7 +1170,7 @@ class _Handler(BaseHTTPRequestHandler):
             # the staged admission child attaches when reply()'s
             # note_request commits this shed request's trace
             obs.spans.annotate(
-                rid, "admission", time.monotonic() - arrival,
+                rid, "admission", pclock.mono() - arrival,
                 attrs={"verdict": exc.reason, "tenant": tenant},
             )
             route = "admission"
@@ -1184,7 +1185,7 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
             )
         obs.spans.annotate(
-            rid, "admission", time.monotonic() - arrival,
+            rid, "admission", pclock.mono() - arrival,
             attrs={"verdict": route, "tenant": tenant},
         )
         try:
@@ -1207,7 +1208,7 @@ class _Handler(BaseHTTPRequestHandler):
                         else (self.server.admission.default_deadline_ms or None)
                     )
                     if effective is not None:
-                        effective -= (time.monotonic() - arrival) * 1e3
+                        effective -= (pclock.mono() - arrival) * 1e3
                     result = engine.analyze_batched(
                         data, effective, request_id=rid
                     )

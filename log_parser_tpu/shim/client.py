@@ -36,6 +36,7 @@ import re
 import socket
 import time
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.runtime import pressure
 from log_parser_tpu.shim import logparser_pb2 as pb
 from log_parser_tpu.shim.framing import read_frame, write_frame
@@ -77,7 +78,7 @@ class ShimClient:
         retry_after_cap_s: float = 5.0,
         max_hops: int = 3,
         forward_resolver=None,
-        sleep=time.sleep,
+        sleep=pclock.sleep,
         retry_budget: pressure.RetryBudget | None = None,
     ):
         self.host = host

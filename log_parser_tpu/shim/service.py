@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import time
 
+from log_parser_tpu import _clock as pclock
 from log_parser_tpu.models.pod import PodFailureData
 from log_parser_tpu.runtime import faults
 from log_parser_tpu.runtime.quarantine import QuarantineRejected
@@ -85,7 +86,7 @@ class LogParserService:
         obs = getattr(self.engine, "obs", None)
         if obs is not None:
             request_id = obs.clean_request_id(request_id) or obs.new_request_id()
-        started = time.monotonic()
+        started = pclock.mono()
         # holder lets _parse_leased report the admitted route back out so
         # the finally arm labels the request correctly on every outcome
         holder = {"route": "device"}
@@ -125,7 +126,7 @@ class LogParserService:
                     holder["route"],
                     status,
                     tenant_id or "default",
-                    time.monotonic() - started,
+                    pclock.mono() - started,
                     request_id=request_id,
                     detail=detail,
                 )
@@ -149,7 +150,7 @@ class LogParserService:
         batcher = getattr(engine, "batcher", None)
         n_lines = (req.logs.count("\n") + 1) if req.logs else 0
         obs = getattr(engine, "obs", None)
-        arrival = time.monotonic()
+        arrival = pclock.mono()
         try:
             route = self.admission.acquire(
                 batchable=batcher is not None, tenant=tctx.quota,
@@ -160,13 +161,13 @@ class LogParserService:
             # note_request commits the shed request's trace
             if obs is not None and request_id:
                 obs.spans.annotate(
-                    request_id, "admission", time.monotonic() - arrival,
+                    request_id, "admission", pclock.mono() - arrival,
                     attrs={"verdict": exc.reason, "tenant": tctx.tenant_id},
                 )
             raise
         if obs is not None and request_id:
             obs.spans.annotate(
-                request_id, "admission", time.monotonic() - arrival,
+                request_id, "admission", pclock.mono() - arrival,
                 attrs={"verdict": route, "tenant": tctx.tenant_id},
             )
         if holder is not None:

@@ -1,0 +1,33 @@
+"""Deterministic fleet simulation (the FoundationDB technique).
+
+One process hosts an entire fleet — router + backends + warm standby +
+migration/failover supervisors — under a :class:`~log_parser_tpu.sim.clock.
+VirtualClock` and an in-memory fault-injecting transport, driven by a
+seeded multi-fault schedule.  After every op a global invariant sweep runs
+(`sim/invariants.py`, ids ``SIM-I1``..``SIM-I5``); any violation pins the
+seed, which replays byte-identically and minimizes to the shortest failing
+schedule (`sim/schedule.py`).
+
+The point is that the simulated code paths are the *same bytes* as
+production: the clock rides the :mod:`log_parser_tpu.runtime.clock`
+switchboard every ``time.*`` call site already reads, transports reuse
+``LocalTarget``/``LocalReplicaTarget``, crashes reuse the ``crash_after``
+journal hooks, and disk faults reuse the journal degrade ladder.  See
+docs/OPS.md § "Deterministic fleet simulation".
+"""
+
+from log_parser_tpu.sim.clock import VirtualClock
+from log_parser_tpu.sim.harness import SimResult, minimize, run_schedule, run_seed
+from log_parser_tpu.sim.invariants import INVARIANTS
+from log_parser_tpu.sim.schedule import SCHEDULE_OPS, generate_schedule
+
+__all__ = [
+    "INVARIANTS",
+    "SCHEDULE_OPS",
+    "SimResult",
+    "VirtualClock",
+    "generate_schedule",
+    "minimize",
+    "run_schedule",
+    "run_seed",
+]

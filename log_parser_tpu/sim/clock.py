@@ -1,0 +1,84 @@
+"""Virtual time for the fleet simulator.
+
+A :class:`VirtualClock` plugs into the :mod:`log_parser_tpu.runtime.clock`
+switchboard so every production ``time.*`` call site — journal aging,
+stream TTLs, SLO cells, retry backoff, supervisor deadlines — reads
+simulated time.  Three properties matter:
+
+* **Integer ticks.**  The schedule only ever advances by whole seconds, so
+  every ``now - (now - w)`` round trip through age-relative snapshots is
+  float-exact — the bit-identical frequency-parity invariant depends on it
+  (the same trick the PR 16/17 FakeClock tests use).
+* **Wall and monotonic are separate streams.**  ``advance`` moves both;
+  ``pause_wall`` moves only the monotonic stream (a paused wall clock —
+  VM freeze, NTP hold); ``skew_wall`` steps the wall clock, negative steps
+  included (the backwards-clock hazard the S1 clamps guard).
+* **Single-driver threading.**  The simulation runs the whole fleet on the
+  driver thread.  Background threads that production code insists on
+  starting (the journal maintenance thread) park in ``wait``: a non-driver
+  thread blocks on the *real* event with no timeout, so it wakes exactly
+  once — at shutdown — and never injects nondeterminism.  A non-driver
+  ``sleep`` yields briefly in real time without touching virtual time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from log_parser_tpu import _clock as pclock
+
+
+class VirtualClock(pclock.Clock):
+    def __init__(self, start: float = 1000.0):
+        self._wall = float(start)
+        self._mono = float(start)
+        self._lock = threading.Lock()
+        self._driver = threading.get_ident()
+
+    # ------------------------------------------------------- Clock API
+
+    def wall(self) -> float:
+        with self._lock:
+            return self._wall
+
+    def mono(self) -> float:
+        with self._lock:
+            return self._mono
+
+    def sleep(self, seconds: float) -> None:
+        if threading.get_ident() != self._driver:
+            # a stray background thread: yield without advancing sim time
+            time.sleep(0.001)
+            return
+        self.advance(max(0.0, seconds))
+
+    def wait(self, event: threading.Event, timeout: Optional[float] = None) -> bool:
+        if threading.get_ident() != self._driver:
+            # background threads park until shutdown sets their stop event
+            return event.wait()
+        if event.is_set():
+            return True
+        if timeout is not None:
+            self.advance(max(0.0, timeout))
+        return event.is_set()
+
+    # --------------------------------------------------- schedule hooks
+
+    def advance(self, seconds: float) -> None:
+        """Move wall AND monotonic time forward together."""
+        with self._lock:
+            self._mono += seconds
+            self._wall += seconds
+
+    def pause_wall(self, seconds: float) -> None:
+        """Wall clock frozen for *seconds* of monotonic time (VM pause)."""
+        with self._lock:
+            self._mono += seconds
+
+    def skew_wall(self, seconds: float) -> None:
+        """Step the wall clock by *seconds* — negative means backwards
+        (the NTP step the S1 clamps exist for). Monotonic never moves."""
+        with self._lock:
+            self._wall += seconds

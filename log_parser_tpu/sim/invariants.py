@@ -1,0 +1,149 @@
+"""Global invariants swept after every schedule op.
+
+Each invariant has a pinned id (``SIM-I1``..``SIM-I5``) that appears in
+failure output, in the sweep JSON artifact and in the docs/OPS.md table —
+hygiene check 22 keeps the three in lockstep.  A check receives the fleet
+plus the event the last op produced and returns violation strings
+(prefixed with its id by the sweep).
+
+The checks only *read*: all fleet mutation happens in schedule ops, so a
+sweep never perturbs the state it is judging.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from log_parser_tpu.sim.fleet import MAX_FORWARD_HOPS, SimFleet
+
+
+@dataclass(frozen=True)
+class Invariant:
+    id: str
+    title: str
+    description: str
+    check: Callable[[SimFleet, dict], list[str]]
+
+
+def _check_exactly_one_owner(fleet: SimFleet, event: dict) -> list[str]:
+    out = []
+    for tenant in fleet.tenants:
+        acceptors = [
+            name for name, node in fleet.nodes.items()
+            if node.resident(tenant) and node.accepts(tenant)
+        ]
+        if len(acceptors) > 1:
+            # a just-rebooted stale primary is tolerated until its next
+            # ship is rejected by the standby's higher epoch, and the
+            # pair standby is tolerated while this tenant's release
+            # notice is still in flight to it (both documented
+            # convergence windows); anything else is split-brain
+            live = [n for n in acceptors if n not in fleet.fencing_pending]
+            if tenant in fleet.release_unshipped:
+                live = [n for n in live if n != fleet.standby_name]
+            if len(live) > 1:
+                out.append(
+                    f"tenant {tenant}: {sorted(live)} all accept writes"
+                )
+    return out
+
+
+def _check_frequency_parity(fleet: SimFleet, event: dict) -> list[str]:
+    out = []
+    if event.get("op") == "serve" and event.get("ok") \
+            and fleet.parity_exact and event.get("parity") is False:
+        out.append(
+            f"tenant {event['tenant']}: served events diverged from the"
+            f" fault-free control on {event.get('node')}"
+        )
+    if event.get("op") == "quiesce":
+        for tenant, lag in event.get("lags", {}).items():
+            if lag:
+                out.append(
+                    f"tenant {tenant}: replication wedged —"
+                    f" {lag} bytes unshipped after quiesce"
+                )
+        for tenant, why in event.get("state_diffs", {}).items():
+            out.append(f"tenant {tenant}: {why}")
+    return out
+
+
+def _check_no_unexplained_5xx(fleet: SimFleet, event: dict) -> list[str]:
+    if event.get("op") == "serve" and not event.get("ok", True) \
+            and event.get("reason") is None:
+        return [
+            f"tenant {event['tenant']}: request failed with no active"
+            f" fault to blame (chain {event.get('chain')})"
+        ]
+    return []
+
+
+def _check_forwards_quiesce(fleet: SimFleet, event: dict) -> list[str]:
+    out = []
+    for tenant in fleet.tenants:
+        chain = fleet.route_chain(tenant)
+        if len(chain) > MAX_FORWARD_HOPS:
+            out.append(
+                f"tenant {tenant}: forward loop {' -> '.join(chain)}"
+            )
+    for tenant, why in event.get("unservable", {}).items():
+        out.append(
+            f"tenant {tenant}: still not servable after quiesce ({why})"
+        )
+    return out
+
+
+def _check_idempotent_replay(fleet: SimFleet, event: dict) -> list[str]:
+    return [
+        f"node {name}: second recover() changed state — {why}"
+        for name, why in event.get("replay_diffs", {}).items()
+    ]
+
+
+INVARIANTS: tuple[Invariant, ...] = (
+    Invariant(
+        "SIM-I1", "exactly one owner",
+        "No tenant ever has two live nodes accepting writes (fenced"
+        " standby and forwarded source do not count; a rebooted stale"
+        " primary is tolerated only until its next rejected ship).",
+        _check_exactly_one_owner,
+    ),
+    Invariant(
+        "SIM-I2", "frequency parity",
+        "Every accepted request produces the same event projection as a"
+        " fault-free control engine, and after quiesce the owner's"
+        " recovered frequency state matches the control byte-for-byte"
+        " (count-only after a backwards wall step; replication fully"
+        " drained).",
+        _check_frequency_parity,
+    ),
+    Invariant(
+        "SIM-I3", "no unexplained 5xx",
+        "Every failed request is attributable to an active fault (dead"
+        " node, fenced standby, truncated forward chain).",
+        _check_no_unexplained_5xx,
+    ),
+    Invariant(
+        "SIM-I4", "forwards quiesce",
+        "Forward chains never loop, and once every fault is lifted each"
+        " tenant becomes servable again.",
+        _check_forwards_quiesce,
+    ),
+    Invariant(
+        "SIM-I5", "idempotent replay",
+        "Running every node's recover() a second time changes nothing:"
+        " roles, fences and forwards are fixpoints.",
+        _check_idempotent_replay,
+    ),
+)
+
+
+def sweep(fleet: SimFleet, event: dict) -> list[str]:
+    """Run every invariant against the post-op state; returns id-prefixed
+    violation strings."""
+    out = []
+    for inv in INVARIANTS:
+        for msg in inv.check(fleet, event):
+            out.append(f"{inv.id}: {msg}")
+    return out

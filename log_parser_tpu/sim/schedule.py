@@ -1,0 +1,105 @@
+"""Seeded fault schedules: the op vocabulary and the generator.
+
+A schedule is a flat list of tuples — ``(op, *args)`` — interpreted by
+the harness.  ``generate_schedule(seed, n_ops)`` is a pure function of its
+arguments (one ``random.Random(seed)``, no ambient entropy), which is the
+whole determinism story: same seed, same schedule, same fleet, same
+digest.
+
+``SCHEDULE_OPS`` is the authoritative op vocabulary; hygiene check 22
+pins every name to a row in the docs/OPS.md schedule-grammar table so an
+op can't be added without documenting what it simulates.
+"""
+
+from __future__ import annotations
+
+import random
+
+from log_parser_tpu.runtime.migrate import SOURCE_RECORDS, TARGET_RECORDS
+
+SCHEDULE_OPS: dict[str, str] = {
+    "serve": "route one tenant request through the fleet and verify "
+             "parity against the fault-free control",
+    "advance": "move virtual wall+monotonic time forward N whole seconds",
+    "pump": "one synchronous WAL-ship round on a node's replicator",
+    "supervise": "one standby failover probe (promotes after sustained "
+                 "primary downtime)",
+    "promote": "admin-path standby promotion",
+    "migrate": "live-migrate a tenant between backends, optionally "
+               "crashing at a journal record boundary",
+    "kill": "kill -9 a node (journals abandoned at the durable prefix)",
+    "revive": "rebuild a dead node over its state dirs and run recover()",
+    "partition": "cut the network edge between two nodes (symmetric)",
+    "heal": "lift every partition",
+    "drop": "lose the next delivery on a directed edge in flight",
+    "dup": "apply the next delivery on a directed edge twice",
+    "defer": "queue the next delivery instead of applying it (ambiguous "
+             "timeout); a later flush_net delivers it late and reordered",
+    "flush_net": "deliver every deferred payload, in queue order",
+    "enospc": "shared-disk ENOSPC: every journal degrades to its "
+              "in-memory ring",
+    "disk_recover": "pressure cleared: re-arm every journal "
+                    "(snapshot + truncate)",
+    "clock_pause": "freeze the wall clock for N seconds of monotonic "
+                   "time (VM pause / NTP hold)",
+    "clock_skew": "step the wall clock by N seconds, negative included "
+                  "(the backwards-clock hazard)",
+    "ack_skew": "corrupt a replica sender's resume offset (misaligned "
+                "resume hazard; the sender must reseed)",
+    "wal_rotate": "force a journal snapshot+rotate on a node "
+                  "(senders must chase the epoch)",
+}
+
+_CRASH_KINDS = tuple(SOURCE_RECORDS) + tuple(TARGET_RECORDS)
+
+
+def generate_schedule(
+    seed: int,
+    n_ops: int = 40,
+    *,
+    tenants: tuple[str, ...] = ("acme", "globex"),
+    backends: tuple[str, ...] = ("a", "b"),
+    standby: str = "s",
+) -> list[tuple]:
+    """Deterministically expand a seed into a serve-heavy multi-fault
+    schedule. Roughly half the ops are traffic; the rest are time and
+    faults, so most seeds exercise several fault families at once."""
+    rng = random.Random(seed)
+    nodes = tuple(backends) + (standby,)
+    pumpable = (backends[0], standby)
+
+    def _edge():
+        a, b = rng.sample(nodes, 2)
+        return a, b
+
+    table = (
+        (40, lambda: ("serve", rng.choice(tenants), rng.randrange(6))),
+        (13, lambda: ("advance", rng.randint(1, 30))),
+        (9, lambda: ("pump", rng.choice(pumpable))),
+        (6, lambda: ("supervise",)),
+        (2, lambda: ("promote",)),
+        (5, lambda: ("migrate", rng.choice(tenants), rng.choice(backends),
+                     rng.choice(_CRASH_KINDS) if rng.random() < 0.35
+                     else None)),
+        (4, lambda: ("kill", rng.choice(nodes))),
+        (6, lambda: ("revive", rng.choice(nodes))),
+        (3, lambda: ("partition", *_edge())),
+        (3, lambda: ("heal",)),
+        (1, lambda: ("drop", *_edge())),
+        (1, lambda: ("dup", *_edge())),
+        (1, lambda: ("defer", *_edge())),
+        (1, lambda: ("flush_net",)),
+        (1, lambda: ("enospc",)),
+        (2, lambda: ("disk_recover",)),
+        (1, lambda: ("clock_pause", rng.randint(1, 10))),
+        (1, lambda: ("clock_skew", rng.choice((-5, -2, -1, 1, 3)))),
+        (1, lambda: ("ack_skew", rng.choice(tenants))),
+        (1, lambda: ("wal_rotate", rng.choice(nodes))),
+    )
+    weights = [w for w, _ in table]
+    makers = [m for _, m in table]
+    out = []
+    for _ in range(n_ops):
+        (maker,) = rng.choices(makers, weights=weights)
+        out.append(maker())
+    return out

@@ -1,0 +1,180 @@
+"""In-memory simulated transport with per-edge fault state.
+
+The production in-process transports (``LocalReplicaTarget`` for WAL
+shipping, ``LocalTarget`` for migration) already drive the destination
+object directly — the simulator wraps them in a :class:`SimNet` edge that
+consults mutable fault state on every delivery:
+
+* **partition** — the send fails with a transport error; nothing reaches
+  the receiver (the sender backs off, exactly as against a dead peer).
+* **drop** — the next delivery on the edge is lost in flight (one-shot).
+* **duplicate** — the next delivery is applied twice; the caller sees the
+  second response (receiver-side idempotency is what's under test).
+* **defer** — the next delivery is queued instead of applied, and the
+  sender sees a transport error (a timeout whose request actually arrived
+  — the classic ambiguous failure).  A later ``flush_net`` op delivers
+  everything queued, in queue order, which by then is *out of order*
+  relative to retries the sender already pushed through.
+
+Destination objects are resolved *at delivery time* through a callable, so
+a receiver that was killed and revived (a brand-new ``Replicator`` /
+``Migrator`` over the same state dirs) is reached through its current
+incarnation — like a TCP connect, not a stale object reference.  A dead
+destination is a transport error, same as a partition.
+
+All state is mutated only by schedule ops on the driver thread, so every
+delivery decision is a pure function of the schedule prefix —
+deterministic by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from log_parser_tpu.runtime.migrate import MigrationError
+from log_parser_tpu.runtime.replicate import ReplicationError
+
+
+class SimPartitioned(Exception):
+    """Transport-level failure on a partitioned/lossy edge or dead peer."""
+
+
+class SimNet:
+    """Fault state for the fleet's point-to-point edges, keyed by
+    ``(src, dst)`` node-name pairs. Partitions are symmetric; the one-shot
+    flags (drop/duplicate/defer) are per-directed-edge."""
+
+    def __init__(self):
+        self.partitions: set[frozenset[str]] = set()
+        self.drop_next: set[tuple[str, str]] = set()
+        self.dup_next: set[tuple[str, str]] = set()
+        self.defer_next: set[tuple[str, str]] = set()
+        self.deferred: list[tuple[str, Callable[[], object]]] = []
+
+    # ------------------------------------------------------- schedule ops
+
+    def partition(self, a: str, b: str) -> None:
+        self.partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        if a is None:
+            self.partitions.clear()
+        else:
+            self.partitions.discard(frozenset((a, b or a)))
+
+    def partitioned(self, a: str, b: str) -> bool:
+        return frozenset((a, b)) in self.partitions
+
+    def flush(self) -> list[str]:
+        """Deliver every deferred payload, in queue order. Returns labels
+        of the deliveries made (for the event log). Receiver-side errors
+        are swallowed — a late duplicate being rejected IS the tested
+        behaviour."""
+        queued, self.deferred = self.deferred, []
+        labels = []
+        for label, thunk in queued:
+            try:
+                thunk()
+                labels.append(label)
+            except Exception as exc:  # noqa: BLE001 - receiver rejects late junk
+                labels.append(f"{label}:rejected:{type(exc).__name__}")
+        return labels
+
+    # --------------------------------------------------------- delivery
+
+    def deliver(self, src: str, dst: str, label: str,
+                thunk: Callable[[], object]):
+        """Run one synchronous RPC over the (src, dst) edge under the
+        current fault state. Raises :class:`SimPartitioned` when the
+        sender must observe a transport failure."""
+        if self.partitioned(src, dst):
+            raise SimPartitioned(f"partition {src}<->{dst}")
+        edge = (src, dst)
+        if edge in self.drop_next:
+            self.drop_next.discard(edge)
+            raise SimPartitioned(f"dropped in flight {src}->{dst}")
+        if edge in self.defer_next:
+            self.defer_next.discard(edge)
+            self.deferred.append((label, thunk))
+            raise SimPartitioned(f"deferred {src}->{dst}")
+        if edge in self.dup_next:
+            self.dup_next.discard(edge)
+            thunk()  # first copy applies; caller sees the second
+        return thunk()
+
+
+class SimReplicaTarget:
+    """A replica target behind a :class:`SimNet` edge. Duck-typed to the
+    replica target protocol (``feed(body) -> (status, doc)``); the inner
+    ``LocalReplicaTarget`` is produced by ``get_inner()`` at delivery time
+    (None means the destination process is dead)."""
+
+    def __init__(self, net: SimNet, src: str, dst: str,
+                 get_inner: Callable[[], object]):
+        self.net = net
+        self.src = src
+        self.dst = dst
+        self.get_inner = get_inner
+        self.url = f"local://{dst}"
+
+    def feed(self, body: dict) -> tuple[int, dict]:
+        def _thunk():
+            inner = self.get_inner()
+            if inner is None:
+                raise SimPartitioned(f"peer {self.dst} is down")
+            return inner.feed(body)
+
+        try:
+            return self.net.deliver(
+                self.src, self.dst, f"feed:{self.src}->{self.dst}", _thunk
+            )
+        except SimPartitioned as exc:
+            raise ReplicationError(str(exc), status=503) from exc
+
+
+class SimMigrationTarget:
+    """A migration target behind a :class:`SimNet` edge (stage/activate
+    are the two deliveries). Transport failures surface as exceptions:
+    ``Migrator.migrate`` aborts pre-cutover and leaves a resumable journal
+    post-cutover — both paths are exactly what ``recover()`` is for."""
+
+    can_adopt_sessions = True
+
+    def __init__(self, net: SimNet, src: str, dst: str,
+                 get_inner: Callable[[], object]):
+        self.net = net
+        self.src = src
+        self.dst = dst
+        self.get_inner = get_inner
+        self.url = f"local://{dst}"
+
+    def _rpc(self, label: str, call: Callable[[object], object]):
+        def _thunk():
+            inner = self.get_inner()
+            if inner is None:
+                raise SimPartitioned(f"peer {self.dst} is down")
+            return call(inner)
+
+        try:
+            return self.net.deliver(self.src, self.dst, label, _thunk)
+        except SimPartitioned as exc:
+            # the production HttpTarget contract: transport failure is a
+            # MigrationError, so migrate() aborts pre-cutover and
+            # recover() parks an unreachable resume as "pending"
+            raise MigrationError(
+                f"target {self.url} unreachable: {exc}"
+            ) from exc
+
+    def stage(self, bundle: dict, sha: str) -> dict:
+        return self._rpc(f"stage:{self.src}->{self.dst}",
+                         lambda inner: inner.stage(bundle, sha))
+
+    def activate(self, mid: str) -> dict:
+        return self._rpc(f"activate:{self.src}->{self.dst}",
+                         lambda inner: inner.activate(mid))
+
+    def adopt_session(self, tenant_id: str, sess) -> bool:
+        inner = self.get_inner()
+        if inner is None or self.net.partitioned(self.src, self.dst):
+            return False
+        return inner.adopt_session(tenant_id, sess)

@@ -495,7 +495,7 @@ class TestRecoverIdempotency:
         reg_a3, mig_a3 = _side(tmp_path, root, "a", clk, journaled=True)
         sum2 = mig_a3.recover()
         assert sum2 == {"forwards": [], "resumed": [], "discarded": [],
-                        "pending": []}
+                        "pending": [], "owned": []}
         assert _journal_records(tmp_path / "a") == sealed
         assert mig_a3.recover() == sum2  # and a third pass in-process
         assert reg_a3.forward_for("acme") is None
@@ -580,8 +580,15 @@ class TestBundleIntegrity:
             assert mig_b.stats()["stagedNow"] == 1
             out = mig_b.activate("mX")
             assert out["outcome"] == "activated"
+            # a re-sent activate for an already-applied mid acks
+            # idempotently — a revived source resuming a post-cutover
+            # handoff re-sends it, and re-applying the stale bundle
+            # would clobber live served state
+            again = mig_b.activate("mX")
+            assert again.get("alreadyApplied") is True
+            assert again["tenant"] == "acme"
             with pytest.raises(MigrationError) as nf:
-                mig_b.activate("mX")
+                mig_b.activate("mZ")
             assert nf.value.status == 404
         finally:
             reg_b.shutdown()

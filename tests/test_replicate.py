@@ -602,7 +602,9 @@ class TestCrashMatrix:
         assert rep_b2.stats()["adoptions"] == 0  # no second adoption
 
     def test_protocol_record_vocabulary_is_pinned(self):
-        assert PROTOCOL_RECORDS == ("epoch", "promote", "demote")
+        assert PROTOCOL_RECORDS == (
+            "epoch", "promote", "demote", "release", "adopt",
+        )
 
     def test_recover_is_idempotent_without_records(self, root, tmp_path):
         clk = FakeClock()
@@ -610,6 +612,7 @@ class TestCrashMatrix:
         assert rep_a.recover()["role"] == "primary"
         assert rep_a.recover() == {
             "role": "primary", "epoch": 0, "records": 0, "tenants": [],
+            "released": [],
         }
 
 

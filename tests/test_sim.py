@@ -1,0 +1,363 @@
+"""Deterministic fleet simulation (log_parser_tpu/sim/).
+
+The tentpole contract: a whole fleet — router placement, two backends,
+warm standby, migration and failover supervisors — runs in ONE process
+under a :class:`VirtualClock` and an in-memory :class:`SimNet`, driven by
+seeded multi-fault schedules with the SIM-I1..I5 invariants swept after
+every op.  The tests pin the three properties everything else rests on:
+
+* **Determinism** — the same seed always produces the byte-identical
+  event log (equal sha256 digests), so a failing seed IS its repro.
+* **Rediscovery** — re-introducing a fixed historical bug via its
+  ``LOG_PARSER_TPU_SIM_BUG_*`` guard flag makes the sweep find it again
+  within 200 seeds, and the minimizer shrinks the failing schedule.
+* **Clamps (S1)** — every production site that ages state by wall-clock
+  arithmetic survives a backwards step (NTP slew, VM pause): snapshot
+  ages clamp at zero, TTL reaping rebases, SLO cells never run
+  backwards.  The ``clock_skew`` schedule op drives the same sites
+  end-to-end inside the simulator.
+"""
+
+from __future__ import annotations
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from log_parser_tpu.config import ScoringConfig
+from log_parser_tpu.golden.engine import (
+    GoldenFrequencyTracker,
+    SnapshotValidationError,
+)
+from log_parser_tpu.obs.slo import SloTracker
+from log_parser_tpu.runtime.replicate import ReplicationError
+from log_parser_tpu.runtime.stream import StreamManager
+from log_parser_tpu.sim.clock import VirtualClock
+from log_parser_tpu.sim.harness import minimize, run_schedule, run_seed
+from log_parser_tpu.sim.invariants import INVARIANTS
+from log_parser_tpu.sim.schedule import SCHEDULE_OPS, generate_schedule
+from log_parser_tpu.sim.transport import (
+    SimNet,
+    SimPartitioned,
+    SimReplicaTarget,
+)
+
+SMOKE_SEEDS = 200
+SMOKE_OPS = 40
+
+
+# --------------------------------------------------------- virtual clock
+
+
+class TestVirtualClock:
+    def test_advance_moves_wall_and_monotonic_together(self):
+        clk = VirtualClock(start=1000.0)
+        clk.advance(7)
+        assert clk.wall() == 1007.0
+        assert clk.mono() == 1007.0
+
+    def test_pause_wall_freezes_wall_while_monotonic_runs(self):
+        clk = VirtualClock(start=1000.0)
+        clk.pause_wall(30)
+        assert clk.mono() == 1030.0
+        assert clk.wall() == 1000.0  # the VM-pause shape
+
+    def test_skew_wall_steps_wall_only_including_backwards(self):
+        clk = VirtualClock(start=1000.0)
+        clk.skew_wall(-5)
+        assert clk.wall() == 995.0
+        assert clk.mono() == 1000.0  # monotonic NEVER moves backwards
+
+    def test_driver_sleep_advances_virtual_time(self):
+        clk = VirtualClock(start=1000.0)
+        clk.sleep(12)
+        assert clk.mono() == 1012.0 and clk.wall() == 1012.0
+
+    def test_background_thread_sleep_never_advances_virtual_time(self):
+        clk = VirtualClock(start=1000.0)
+        t = threading.Thread(target=clk.sleep, args=(3600,))
+        t.start()
+        t.join(10)
+        assert not t.is_alive()
+        assert clk.mono() == 1000.0 and clk.wall() == 1000.0
+
+    def test_driver_wait_advances_by_timeout_and_reports_event(self):
+        clk = VirtualClock(start=1000.0)
+        ev = threading.Event()
+        assert clk.wait(ev, timeout=15) is False
+        assert clk.mono() == 1015.0
+        ev.set()
+        assert clk.wait(ev, timeout=15) is True
+        assert clk.mono() == 1015.0  # set event: no time passes
+
+
+# ----------------------------------------------------- simulated network
+
+
+class TestSimNet:
+    def test_partition_is_symmetric_and_heals(self):
+        net = SimNet()
+        net.partition("a", "s")
+        for src, dst in (("a", "s"), ("s", "a")):
+            with pytest.raises(SimPartitioned):
+                net.deliver(src, dst, "x", lambda: "ok")
+        net.heal()
+        assert net.deliver("a", "s", "x", lambda: "ok") == "ok"
+
+    def test_drop_is_one_shot(self):
+        net = SimNet()
+        net.drop_next.add(("a", "s"))
+        with pytest.raises(SimPartitioned):
+            net.deliver("a", "s", "x", lambda: "ok")
+        assert net.deliver("a", "s", "x", lambda: "ok") == "ok"
+
+    def test_duplicate_applies_twice_caller_sees_second(self):
+        net = SimNet()
+        calls = []
+        net.dup_next.add(("a", "s"))
+        out = net.deliver(
+            "a", "s", "x", lambda: calls.append(len(calls)) or len(calls)
+        )
+        assert calls == [0, 1]
+        assert out == 2  # the second application's response
+        net.deliver("a", "s", "x", lambda: calls.append(len(calls)))
+        assert calls == [0, 1, 2]  # one-shot
+
+    def test_defer_queues_then_flush_delivers_out_of_band(self):
+        net = SimNet()
+        landed = []
+        net.defer_next.add(("a", "s"))
+        with pytest.raises(SimPartitioned):
+            # the ambiguous failure: the sender sees a timeout, but the
+            # request is sitting in the queue
+            net.deliver("a", "s", "late", lambda: landed.append("late"))
+        assert landed == []
+        assert net.deliver("a", "s", "now", lambda: landed.append("now"))\
+            is None
+        assert net.flush() == ["late"]
+        assert landed == ["now", "late"]  # late delivery lands after
+
+    def test_flush_swallows_receiver_rejection(self):
+        net = SimNet()
+        net.defer_next.add(("a", "s"))
+
+        def boom():
+            raise ReplicationError("stale", status=409)
+
+        with pytest.raises(SimPartitioned):
+            net.deliver("a", "s", "dup", boom)
+        labels = net.flush()
+        assert labels == ["dup:rejected:ReplicationError"]
+
+    def test_replica_target_surfaces_dead_peer_as_503(self):
+        net = SimNet()
+        target = SimReplicaTarget(net, "a", "s", lambda: None)
+        with pytest.raises(ReplicationError) as exc:
+            target.feed({"tenant": "acme"})
+        assert exc.value.status == 503
+
+
+# --------------------------------------------------- schedule generation
+
+
+class TestScheduleGeneration:
+    def test_seed_expansion_is_deterministic(self):
+        a = generate_schedule(123, 40)
+        b = generate_schedule(123, 40)
+        assert a == b
+        assert len(a) == 40
+
+    def test_only_documented_ops_are_generated(self):
+        for seed in range(20):
+            for op in generate_schedule(seed, 40):
+                assert op[0] in SCHEDULE_OPS, op
+
+    def test_invariant_ids_are_pinned(self):
+        assert [inv.id for inv in INVARIANTS] == [
+            "SIM-I1", "SIM-I2", "SIM-I3", "SIM-I4", "SIM-I5",
+        ]
+
+
+# ------------------------------------------------- schedule-driven tests
+
+
+@pytest.mark.sim
+class TestDeterministicReplay:
+    def test_same_seed_replays_byte_identically(self):
+        first = run_seed(11, n_ops=SMOKE_OPS)
+        second = run_seed(11, n_ops=SMOKE_OPS)
+        assert first.digest == second.digest
+        assert first.events == second.events
+        assert first.ok, first.violations
+
+    def test_different_seeds_diverge(self):
+        assert run_seed(11, n_ops=20).digest != run_seed(12, n_ops=20).digest
+
+    def test_clock_pause_and_skew_schedule_passes(self):
+        # the S1 clamp sites driven end-to-end: traffic, a shipped batch,
+        # a VM pause, a backwards NTP step, failover — invariants hold
+        res = run_schedule([
+            ("serve", "acme", 0),
+            ("serve", "globex", 1),
+            ("pump", "a"),
+            ("clock_pause", 30),
+            ("serve", "acme", 2),
+            ("clock_skew", -5),
+            ("serve", "acme", 3),
+            ("pump", "a"),
+            ("promote",),
+            ("serve", "globex", 2),
+        ])
+        assert res.ok, res.violations
+
+
+@pytest.mark.sim
+class TestCrossPlaneCrashMatrix:
+    def test_pressure_hard_x_migration_cutover_x_promote(self):
+        """The S3 acceptance schedule: a migration target crashed at its
+        ACTIVATE record, hard disk pressure across the fleet, and a
+        standby promotion — three planes interleaved in one schedule —
+        must still quiesce to exactly one owner per tenant with clean
+        forwards and idempotent recovery."""
+        res = run_schedule([
+            ("serve", "acme", 0),
+            ("serve", "globex", 1),
+            ("pump", "a"),
+            # migration plane: acme cuts over, the target dies mid-adopt
+            ("migrate", "acme", "a", "activate"),
+            # pressure plane: every journal diverts to its ring
+            ("enospc",),
+            ("serve", "globex", 2),
+            # replication plane: the standby takes the pair
+            ("promote",),
+            ("serve", "globex", 3),
+            ("disk_recover",),
+            ("supervise",),
+        ])
+        assert res.ok, res.violations
+        ops = [ev.get("op") for ev in res.events]
+        assert "enospc" in ops and "promote" in ops
+        crash = next(ev for ev in res.events if ev.get("op") == "migrate")
+        assert crash["outcome"] == "crash" and crash["at"] == "activate"
+        promote = next(ev for ev in res.events if ev.get("op") == "promote")
+        assert promote["result"]["status"] == "promoted"
+
+
+@pytest.mark.sim
+class TestGuardFlagRediscovery:
+    """Re-introduce each fixed historical bug behind its guard flag: the
+    sweep must rediscover it within 200 seeds, the failing seed must
+    replay byte-identically, and the minimizer must shrink the repro."""
+
+    @pytest.mark.parametrize("flag", [
+        "LOG_PARSER_TPU_SIM_BUG_MISALIGNED_WEDGE",
+        "LOG_PARSER_TPU_SIM_BUG_FORWARD_RESURRECTION",
+    ])
+    def test_bug_rediscovered_replayed_and_minimized(self, flag):
+        bug_env = {flag: "1"}
+        failing = None
+        for seed in range(200):
+            res = run_seed(seed, n_ops=SMOKE_OPS, bug_env=bug_env)
+            if not res.ok:
+                failing = res
+                break
+        assert failing is not None, f"{flag} not rediscovered in 200 seeds"
+        replay = run_seed(failing.seed, n_ops=SMOKE_OPS, bug_env=bug_env)
+        assert replay.digest == failing.digest
+        assert replay.violations == failing.violations
+        small = minimize(list(failing.schedule), bug_env=bug_env)
+        assert len(small) < len(failing.schedule)
+        assert not run_schedule(small, bug_env=bug_env).ok
+
+
+@pytest.mark.sim
+class TestSeedSmoke:
+    def test_smoke_sweep_all_seeds_pass(self):
+        """The tier-1 campaign: every seed in [0, 200) must pass, and a
+        sample must replay to identical digests (the determinism the
+        repro workflow rests on)."""
+        digests = {}
+        failures = []
+        for seed in range(SMOKE_SEEDS):
+            res = run_seed(seed, n_ops=SMOKE_OPS)
+            digests[seed] = res.digest
+            if not res.ok:
+                failures.append((seed, res.failed_at, res.violations[:1]))
+        assert not failures, failures
+        for seed in range(0, SMOKE_SEEDS, 40):
+            assert run_seed(seed, n_ops=SMOKE_OPS).digest == digests[seed]
+
+    @pytest.mark.slow
+    def test_nightly_sweep(self):
+        """The slow-marked nightly campaign (docs/OPS.md): a wider seed
+        range at the same schedule length."""
+        failures = []
+        for seed in range(SMOKE_SEEDS, SMOKE_SEEDS + 800):
+            res = run_seed(seed, n_ops=SMOKE_OPS)
+            if not res.ok:
+                failures.append((seed, res.failed_at, res.violations[:1]))
+        assert not failures, failures
+
+
+# ------------------------------------------------ S1: backwards-wall S1
+
+
+class TestBackwardsWallClamps:
+    """Unit regression tests for every production clamp the ``clock_skew``
+    schedule op exercises end-to-end."""
+
+    def test_frequency_snapshot_clamps_negative_ages(self):
+        t = [1000.0]
+        tracker = GoldenFrequencyTracker(ScoringConfig(), clock=lambda: t[0])
+        tracker.record_pattern_match("oom")
+        t[0] = 990.0  # wall stepped back: recorded timestamp is "future"
+        snap = tracker.snapshot()
+        assert snap["oom"] == [0.0]  # "matched just now" is the floor
+
+    def test_frequency_restore_rejects_negative_ages(self):
+        tracker = GoldenFrequencyTracker(ScoringConfig(), clock=lambda: 0.0)
+        with pytest.raises(SnapshotValidationError):
+            tracker.restore({"oom": [-1.0]})
+        with pytest.raises(SnapshotValidationError):
+            tracker.restore({"oom": [float("nan")]})
+
+    def test_snapshot_restore_round_trip_after_backwards_step(self):
+        t = [1000.0]
+        src = GoldenFrequencyTracker(ScoringConfig(), clock=lambda: t[0])
+        src.record_pattern_matches("oom", 3)
+        t[0] = 900.0
+        dst = GoldenFrequencyTracker(ScoringConfig(), clock=lambda: t[0])
+        dst.restore(src.snapshot())  # must not raise: ages were clamped
+        assert dst.snapshot()["oom"] == [0.0, 0.0, 0.0]
+
+    def test_stream_reap_rebases_future_sessions(self):
+        t = [1000.0]
+        mgr = StreamManager(
+            engine=None, ttl_s=10, clock=lambda: t[0], start_reaper=False
+        )
+        killed = []
+        sess = SimpleNamespace(
+            last_active=1500.0,  # opened before the wall stepped back
+            kill=lambda reason: killed.append(reason),
+        )
+        mgr._sessions["s1"] = sess
+        assert mgr.reap_now() == 0
+        # the negative idle age no longer shields the session: rebased
+        assert sess.last_active == 1000.0
+        t[0] = 1011.0  # now the TTL applies from the rebased point
+        assert mgr.reap_now() == 1
+        assert killed == ["ttl"]
+
+    def test_slo_cells_never_run_backwards(self):
+        t = [1000.0]
+        slo = SloTracker(availability=0.999, windows_s=(60,),
+                         clock=lambda: t[0])
+        slo.note(ok=True, duration_ms=1.0)
+        t[0] = 900.0  # backwards step mid-stream
+        slo.note(ok=False, duration_ms=1.0)
+        # the fresh error lands at the high-water mark, inside the
+        # window — not in a cell the window filter already passed
+        total, errors, _ = slo._window_counts(60)
+        assert (total, errors) == (2, 1)
+        assert slo.burn_rates()["availability"]["60s"] > 0

@@ -2831,9 +2831,42 @@ SCENARIOS = [
 ]
 
 
+def _group_registry() -> dict[str, list[str]]:
+    """Every scenario name by group — the source of truth ``--list``
+    prints and ``--only`` can be checked against."""
+    return {
+        "base": [s[0] for s in SCENARIOS],
+        "batcher": [s[0] for s in BATCHER_SCENARIOS],
+        "state": [s[0] for s in STATE_SCENARIOS]
+        + [s[0] for s in STATE_STANDALONE],
+        "poison": [s[0] for s in POISON_SCENARIOS],
+        "linecache": [s[0] for s in LINECACHE_SCENARIOS],
+        "kernel": [s[0] for s in KERNEL_SCENARIOS],
+        "streaming": [s[0] for s in STREAMING_SCENARIOS],
+        "distributed": [s[0] for s in DISTRIBUTED_SCENARIOS],
+        "tenant": [s[0] for s in TENANT_STANDALONE],
+        "miner": [s[0] for s in MINER_SCENARIOS]
+        + [s[0] for s in MINER_STANDALONE],
+        "obs": [s[0] for s in OBS_SCENARIOS],
+        "spans": [s[0] for s in SPANS_SCENARIOS],
+        "migrate": [s[0] for s in MIGRATE_STANDALONE],
+        "replica": [s[0] for s in REPLICA_STANDALONE],
+        "fleet": [s[0] for s in FLEET_STANDALONE],
+        "pressure": [s[0] for s in PRESSURE_STANDALONE],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="chaos_sweep")
     parser.add_argument("--only", help="run a single scenario by name")
+    parser.add_argument(
+        "--list", action="store_true",
+        help="print every scenario (group + name) and exit",
+    )
+    parser.add_argument(
+        "--json", metavar="PATH",
+        help="also write the result table to PATH as a JSON artifact",
+    )
     parser.add_argument(
         "--group",
         choices=(
@@ -2850,6 +2883,14 @@ def main(argv: list[str] | None = None) -> int:
         help="keep child logs even for passing scenarios",
     )
     args = parser.parse_args(argv)
+
+    if args.list:
+        registry = _group_registry()
+        width = max(len(g) for g in registry)
+        for group, names in registry.items():
+            for name in names:
+                print(f"{group:<{width}}  {name}")
+        return 0
 
     rows = []
     failed = 0
@@ -2950,6 +2991,24 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{name:<{width}}  {result:<6}  {secs:7.1f}  {detail}")
     passed = sum(1 for r in rows if r[1] == "PASS")
     print(f"\n{passed}/{len(rows)} scenarios passed (seed 42)")
+    if args.json:
+        artifact = {
+            "tool": "chaos_sweep",
+            "group": args.group,
+            "seed": 42,
+            "passed": passed,
+            "failed": failed,
+            "skipped": sum(1 for r in rows if r[1] == "SKIP"),
+            "scenarios": [
+                {"name": name, "result": result,
+                 "seconds": round(secs, 2), "detail": detail}
+                for name, result, secs, detail in rows
+            ],
+        }
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
     return 1 if failed else 0
 
 

@@ -85,7 +85,15 @@ Checks (all files tracked by git, minus excluded dirs):
      metric families exist and have backtick-quoted rows, and the
      ``--replica-*``/``--failover-*`` serve flags meet the same
      backtick-row standard (losing any of these must read as a hole in
-     the failover runbook, not a routine vocabulary shrink).
+     the failover runbook, not a routine vocabulary shrink);
+ 22. the deterministic-simulation vocabulary is pinned: every schedule
+     op (``SCHEDULE_OPS`` in sim/schedule.py) has a backtick-quoted
+     docs/OPS.md row in the schedule-grammar table AND a live handler
+     in the harness interpreter; every invariant id declared in
+     sim/invariants.py (``SIM-I1``..) has a backtick-quoted docs/OPS.md
+     row; the ids are contiguous from SIM-I1; and the replay runbook
+     names ``sim_sweep.py`` (a failing seed nobody can replay is a
+     failing seed nobody fixes).
 
 ``--fix`` rewrites what is mechanically fixable (1 and 2).
 Exit 0 = clean, 1 = violations (listed on stdout).
@@ -1163,6 +1171,71 @@ def check_pressure_vocab_pinned(root: Path) -> list[str]:
     return problems
 
 
+def check_sim_vocab_pinned(root: Path) -> list[str]:
+    """Check 22: the deterministic-simulation vocabulary must be pinned
+    the way check 21 pins the pressure ladder's. Every schedule op
+    (``SCHEDULE_OPS`` in sim/schedule.py) needs a backtick-quoted
+    docs/OPS.md row and a live handler in the harness interpreter;
+    every invariant id (``SIM-I<n>`` declared in sim/invariants.py)
+    needs a backtick-quoted docs/OPS.md row and the sequence must be
+    contiguous from SIM-I1; the replay runbook must name
+    ``sim_sweep.py``."""
+    sched_src = root / "log_parser_tpu" / "sim" / "schedule.py"
+    inv_src = root / "log_parser_tpu" / "sim" / "invariants.py"
+    harness_src = root / "log_parser_tpu" / "sim" / "harness.py"
+    ops_doc = root / "docs" / "OPS.md"
+    if not sched_src.is_file() or not ops_doc.is_file():
+        return []
+    ops_text = ops_doc.read_text()
+    problems: list[str] = []
+    ops = _dict_keys_of(sched_src, "SCHEDULE_OPS")
+    if not ops:
+        problems.append(
+            f"{sched_src}: SCHEDULE_OPS is empty or missing — the seeded"
+            " fault schedules depend on it"
+        )
+    harness_text = harness_src.read_text() if harness_src.is_file() else ""
+    for op in ops:
+        if f"`{op}`" not in ops_text:
+            problems.append(
+                f"{sched_src}: schedule op {op!r} has no backtick-quoted"
+                " docs/OPS.md row in the schedule-grammar table"
+            )
+        if f'"{op}"' not in harness_text:
+            problems.append(
+                f"{sched_src}: schedule op {op!r} has no handler in the"
+                " harness interpreter (sim/harness.py) — the generator"
+                " would emit ops the fleet cannot apply"
+            )
+    ids: list[str] = []
+    if inv_src.is_file():
+        ids = re.findall(r'"(SIM-I\d+)"', inv_src.read_text())
+    if not ids:
+        problems.append(
+            f"{inv_src}: no SIM-I<n> invariant ids declared — the sweep"
+            " has nothing to check"
+        )
+    if ids != [f"SIM-I{i}" for i in range(1, len(ids) + 1)]:
+        problems.append(
+            f"{inv_src}: invariant ids {ids} are not contiguous from"
+            " SIM-I1 — ids are pinned in failure output and the sweep"
+            " artifact, never renumbered"
+        )
+    for inv_id in ids:
+        if f"`{inv_id}`" not in ops_text:
+            problems.append(
+                f"{inv_src}: invariant {inv_id} has no backtick-quoted"
+                " docs/OPS.md row in the invariant table"
+            )
+    if ops and "sim_sweep.py" not in ops_text:
+        problems.append(
+            f"{ops_doc}: the deterministic-simulation runbook must name"
+            " sim_sweep.py — a failing seed nobody can replay is a"
+            " failing seed nobody fixes"
+        )
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fix", action="store_true", help="rewrite fixable problems")
@@ -1198,6 +1271,7 @@ def main() -> int:
         problems.extend(check_replica_vocab_pinned(root))
         problems.extend(check_fleet_vocab_pinned(root))
         problems.extend(check_pressure_vocab_pinned(root))
+        problems.extend(check_sim_vocab_pinned(root))
 
     for p in problems:
         print(p)

@@ -1,0 +1,142 @@
+"""Deterministic fleet-simulation sweep: N seeded multi-fault schedules
+through the in-process simulator (log_parser_tpu/sim/), invariants
+SIM-I1..I5 checked after every op — docs/OPS.md "Deterministic fleet
+simulation".
+
+Each seed expands into a schedule of fleet ops (serve traffic, crash at
+record boundaries, partition/drop/dup/defer the replication transport,
+ENOSPC, clock pause/skew, kill/revive) against a whole fleet — router,
+two backends, warm standby, migration + failover supervisors — in ONE
+process under a virtual clock.  Determinism is exact: the same seed
+always produces the same event log, so a failing row's digest reproduces
+bit-identically with ``--replay`` and ``--minimize`` shrinks it to the
+shortest schedule that still violates.
+
+Usage:
+  python tools/sim_sweep.py --seeds 200                 # campaign
+  python tools/sim_sweep.py --seeds 200 --json out.json # + artifact
+  python tools/sim_sweep.py --replay 137                # one seed, verbose
+  python tools/sim_sweep.py --replay 137 --minimize     # shrink it
+  python tools/sim_sweep.py --seeds 100 --bug-flag \\
+      LOG_PARSER_TPU_SIM_BUG_FORWARD_RESURRECTION       # rediscovery drill
+
+Exit status: 0 when every seed passed, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from log_parser_tpu.sim.harness import (  # noqa: E402
+    minimize,
+    run_schedule,
+    run_seed,
+)
+from log_parser_tpu.sim.schedule import generate_schedule  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="sim_sweep")
+    parser.add_argument(
+        "--seeds", type=int, default=50, metavar="N",
+        help="sweep seeds [--start, --start+N) (default: 50)",
+    )
+    parser.add_argument(
+        "--start", type=int, default=0,
+        help="first seed of the campaign (default: 0)",
+    )
+    parser.add_argument(
+        "--ops", type=int, default=40,
+        help="schedule length per seed (default: 40)",
+    )
+    parser.add_argument(
+        "--replay", type=int, metavar="SEED",
+        help="run ONE seed and print its schedule, events and digest",
+    )
+    parser.add_argument(
+        "--minimize", action="store_true",
+        help="with --replay: shrink a failing schedule to the shortest"
+        " reproduction and print it",
+    )
+    parser.add_argument(
+        "--bug-flag", action="append", default=[], metavar="ENV",
+        help="set this env flag inside the simulated fleet (repeatable;"
+        " the LOG_PARSER_TPU_SIM_BUG_* guards re-introduce fixed"
+        " historical bugs for rediscovery drills)",
+    )
+    parser.add_argument(
+        "--json", metavar="PATH",
+        help="write the campaign result as a JSON artifact",
+    )
+    args = parser.parse_args(argv)
+    bug_env = {flag: "1" for flag in args.bug_flag}
+
+    if args.replay is not None:
+        res = run_seed(args.replay, n_ops=args.ops, bug_env=bug_env or None)
+        print(f"seed {args.replay}: {'PASS' if res.ok else 'FAIL'}"
+              f"  digest {res.digest[:16]}…")
+        for i, op in enumerate(res.schedule):
+            marker = " <- first violation" if res.failed_at == i else ""
+            print(f"  [{i:2d}] {tuple(op)}{marker}")
+        for v in res.violations:
+            print(f"  VIOLATION {v}")
+        if not res.ok and args.minimize:
+            small = minimize(
+                generate_schedule(args.replay, args.ops),
+                bug_env=bug_env or None,
+            )
+            rerun = run_schedule(small, bug_env=bug_env or None)
+            print(f"minimized {len(res.schedule)} -> {len(small)} ops:")
+            for op in small:
+                print(f"  {tuple(op)}")
+            for v in rerun.violations:
+                print(f"  VIOLATION {v}")
+        return 0 if res.ok else 1
+
+    t0 = time.monotonic()
+    rows = []
+    failed = 0
+    for seed in range(args.start, args.start + args.seeds):
+        res = run_seed(seed, n_ops=args.ops, bug_env=bug_env or None)
+        rows.append(res.to_dict())
+        if not res.ok:
+            failed += 1
+            print(f"seed {seed}: FAIL at op {res.failed_at}"
+                  f" — {res.violations[0]}")
+    elapsed = time.monotonic() - t0
+    print(f"{args.seeds - failed}/{args.seeds} seeds passed"
+          f" ({args.ops} ops each) in {elapsed:.1f}s")
+    if failed:
+        first = next(r for r in rows if not r["ok"])
+        print(f"reproduce: python tools/sim_sweep.py"
+              f" --replay {first['seed']} --ops {args.ops} --minimize"
+              + "".join(f" --bug-flag {f}" for f in args.bug_flag))
+    if args.json:
+        artifact = {
+            "tool": "sim_sweep",
+            "start": args.start,
+            "seeds": args.seeds,
+            "ops": args.ops,
+            "bug_flags": sorted(bug_env),
+            "passed": args.seeds - failed,
+            "failed": failed,
+            "elapsed_s": round(elapsed, 2),
+            "results": rows,
+        }
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
